@@ -1,0 +1,55 @@
+"""fabric-tpu benchmark entry point.
+
+Prints exactly ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+North-star metric (BASELINE.md): committed tx/s at 1000-tx blocks with a
+3-of-5 endorsement policy, batched TPU verify vs per-signature host verify.
+Falls back through the implemented pipeline stages as the framework grows:
+currently benches the batched crypto data plane directly.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+
+def bench_sw_verify(n: int = 256) -> float:
+    """Host baseline: per-signature ECDSA-P256 verify throughput (sigs/s).
+
+    Equivalent of `go test -bench` over the reference bccsp/sw
+    (bccsp/sw/ecdsa.go:41)."""
+    from fabric_tpu.csp import SWCSP, VerifyBatchItem
+
+    csp = SWCSP()
+    key = csp.key_gen()
+    items = []
+    for i in range(n):
+        d = csp.hash(b"bench-tx-%d" % i)
+        items.append(VerifyBatchItem(key.public_key(), d, csp.sign(key, d)))
+    t0 = time.perf_counter()
+    ok = csp.verify_batch(items)
+    dt = time.perf_counter() - t0
+    assert all(ok)
+    return n / dt
+
+
+def main() -> None:
+    baseline = bench_sw_verify()
+    # Until the TPU batched pipeline lands, value == host baseline.
+    value = baseline
+    print(
+        json.dumps(
+            {
+                "metric": "ecdsa_p256_verify_throughput",
+                "value": round(value, 2),
+                "unit": "sigs/s",
+                "vs_baseline": round(value / baseline, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,31 @@
+"""fabric-tpu: a TPU-native permissioned distributed-ledger framework.
+
+A ground-up rebuild of the capability surface of Hyperledger Fabric
+(reference: /root/reference) designed TPU-first:
+
+- the *control plane* (ordering, ledger, policies, identity, p2p) is a lean
+  re-implementation of the reference's architecture, and
+- the *data plane* -- hashing, signature verification, pairing checks -- is a
+  batched JAX/XLA/Pallas service: every signature in a block is verified in a
+  single device call instead of one-goroutine-per-tx ECDSA
+  (reference: core/committer/txvalidator/v20/validator.go:180-265,
+  common/policies/policy.go:365-402).
+
+Layer map (mirrors reference SURVEY.md section 1):
+  protos/    wire format + proto utilities        (reference: protoutil/)
+  csp/       crypto service provider, sw + tpu    (reference: bccsp/)
+  msp/       X.509 membership service provider    (reference: msp/)
+  policies/  policy manager + signature policies  (reference: common/policies,
+             common/cauthdsl, common/policydsl)
+  ledger/    block store + MVCC kv ledger         (reference: common/ledger,
+             core/ledger)
+  orderer/   blockcutter, consenters, multichannel (reference: orderer/)
+  peer/      txvalidator, committer, endorser     (reference: core/)
+  gossip/    membership + dissemination           (reference: gossip/)
+  common/    logging, metrics, config             (reference: common/flogging,
+             common/metrics)
+  node/      process assembly                     (reference: internal/peer/node,
+             orderer/common/server)
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,31 @@
+"""Crypto service provider (CSP) -- the pluggable crypto SPI.
+
+Equivalent of the reference's BCCSP (bccsp/bccsp.go:90-134) with one
+deliberate extension the reference lacks: a first-class *batch* API
+(`verify_batch`, `hash_batch`) so a whole block's signatures become a single
+device call. Providers:
+
+- sw:  host reference implementation (OpenSSL via `cryptography`, hashlib)
+- tpu: JAX/XLA batched implementation (csp/tpu/)
+"""
+
+from fabric_tpu.csp.api import (
+    CSP,
+    Key,
+    ECDSAP256PublicKey,
+    ECDSAP256PrivateKey,
+    VerifyBatchItem,
+)
+from fabric_tpu.csp.sw import SWCSP
+from fabric_tpu.csp.factory import get_default, init_factories
+
+__all__ = [
+    "CSP",
+    "Key",
+    "ECDSAP256PublicKey",
+    "ECDSAP256PrivateKey",
+    "VerifyBatchItem",
+    "SWCSP",
+    "get_default",
+    "init_factories",
+]

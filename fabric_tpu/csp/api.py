@@ -1,0 +1,254 @@
+"""CSP interface: keys, options, provider protocol.
+
+Modeled on the reference's BCCSP SPI (bccsp/bccsp.go:15-134: Key, KeyGen,
+KeyImport, GetKey, Hash, Sign, Verify) plus the batch extension described in
+SURVEY.md section 7 step 1: `verify_batch(keys, digests, sigs) -> mask` and
+`hash_batch`.  The batch API returns a *per-item* validity mask, never a
+single bool: the reference's policy evaluation tolerates invalid endorsements
+(common/policies/policy.go:365-402 collects only the valid identities and the
+policy may still pass), so a batch must preserve per-signature failure
+semantics.
+"""
+
+from __future__ import annotations
+
+import abc
+import dataclasses
+import hashlib
+from typing import Sequence
+
+from cryptography.hazmat.primitives import serialization
+from cryptography.hazmat.primitives.asymmetric import ec
+from cryptography.hazmat.primitives.asymmetric.utils import (
+    decode_dss_signature,
+    encode_dss_signature,
+)
+
+# ---------------------------------------------------------------------------
+# P-256 domain parameters (NIST FIPS 186-4).
+# ---------------------------------------------------------------------------
+
+P256_P = 0xFFFFFFFF00000001000000000000000000000000FFFFFFFFFFFFFFFFFFFFFFFF
+P256_A = P256_P - 3
+P256_B = 0x5AC635D8AA3A93E7B3EBBD55769886BC651D06B0CC53B0F63BCE3C3E27D2604B
+P256_N = 0xFFFFFFFF00000000FFFFFFFFFFFFFFFFBCE6FAADA7179E84F3B9CAC2FC632551
+P256_GX = 0x6B17D1F2E12C4247F8BCE6E563A440F277037D812DEB33A0F4A13945D898C296
+P256_GY = 0x4FE342E2FE1A7F9B8EE7EB4A7C0F9E162BCE33576B315ECECBB6406837BF51F5
+P256_HALF_N = P256_N // 2
+
+
+class Key(abc.ABC):
+    """A cryptographic key held by a CSP (reference bccsp/bccsp.go:15-40)."""
+
+    @abc.abstractmethod
+    def ski(self) -> bytes:
+        """Subject key identifier of this key."""
+
+    @abc.abstractmethod
+    def raw(self) -> bytes:
+        """Serialized form (public keys: uncompressed EC point, as the
+        reference hashes for SKI; private keys: PKCS8 DER)."""
+
+    @property
+    def is_private(self) -> bool:
+        return False
+
+    def public_key(self) -> "Key":
+        raise NotImplementedError
+
+
+def _point_ski(x: int, y: int) -> bytes:
+    # Reference computes SKI = SHA-256 over the uncompressed marshaled point
+    # (bccsp/sw/keys.go ecdsaPublicKey.SKI / elliptic.Marshal).
+    raw = b"\x04" + x.to_bytes(32, "big") + y.to_bytes(32, "big")
+    return hashlib.sha256(raw).digest()
+
+
+class ECDSAP256PublicKey(Key):
+    def __init__(self, key: ec.EllipticCurvePublicKey):
+        if not isinstance(key.curve, ec.SECP256R1):
+            raise ValueError("only P-256 keys supported")
+        self._key = key
+        nums = key.public_numbers()
+        self.x: int = nums.x
+        self.y: int = nums.y
+        self._ski = _point_ski(self.x, self.y)
+
+    def ski(self) -> bytes:
+        return self._ski
+
+    def public_key(self) -> "ECDSAP256PublicKey":
+        # A public key's public key is itself (reference bccsp/sw/keys
+        # ecdsaPublicKey.PublicKey).
+        return self
+
+    def raw(self) -> bytes:
+        return b"\x04" + self.x.to_bytes(32, "big") + self.y.to_bytes(32, "big")
+
+    def der(self) -> bytes:
+        return self._key.public_bytes(
+            serialization.Encoding.DER,
+            serialization.PublicFormat.SubjectPublicKeyInfo,
+        )
+
+    def pem(self) -> bytes:
+        return self._key.public_bytes(
+            serialization.Encoding.PEM,
+            serialization.PublicFormat.SubjectPublicKeyInfo,
+        )
+
+    @property
+    def crypto_key(self) -> ec.EllipticCurvePublicKey:
+        return self._key
+
+    @classmethod
+    def from_point(cls, x: int, y: int) -> "ECDSAP256PublicKey":
+        nums = ec.EllipticCurvePublicNumbers(x, y, ec.SECP256R1())
+        return cls(nums.public_key())
+
+    @classmethod
+    def from_der(cls, der: bytes) -> "ECDSAP256PublicKey":
+        key = serialization.load_der_public_key(der)
+        return cls(key)
+
+    @classmethod
+    def from_pem(cls, pem: bytes) -> "ECDSAP256PublicKey":
+        key = serialization.load_pem_public_key(pem)
+        return cls(key)
+
+
+class ECDSAP256PrivateKey(Key):
+    def __init__(self, key: ec.EllipticCurvePrivateKey):
+        if not isinstance(key.curve, ec.SECP256R1):
+            raise ValueError("only P-256 keys supported")
+        self._key = key
+        self._pub = ECDSAP256PublicKey(key.public_key())
+
+    def ski(self) -> bytes:
+        return self._pub.ski()
+
+    def raw(self) -> bytes:
+        return self._key.private_bytes(
+            serialization.Encoding.DER,
+            serialization.PrivateFormat.PKCS8,
+            serialization.NoEncryption(),
+        )
+
+    @property
+    def is_private(self) -> bool:
+        return True
+
+    def public_key(self) -> ECDSAP256PublicKey:
+        return self._pub
+
+    @property
+    def crypto_key(self) -> ec.EllipticCurvePrivateKey:
+        return self._key
+
+    @classmethod
+    def generate(cls) -> "ECDSAP256PrivateKey":
+        return cls(ec.generate_private_key(ec.SECP256R1()))
+
+    @classmethod
+    def from_der(cls, der: bytes) -> "ECDSAP256PrivateKey":
+        return cls(serialization.load_der_private_key(der, password=None))
+
+    @classmethod
+    def from_pem(cls, pem: bytes) -> "ECDSAP256PrivateKey":
+        return cls(serialization.load_pem_private_key(pem, password=None))
+
+
+# ---------------------------------------------------------------------------
+# Signature encoding: DER <-> (r, s), low-S normalization.
+# Reference: bccsp/utils/ecdsa.go:39 MarshalECDSASignature, :84 IsLowS,
+# :94 ToLowS.  Fabric rejects high-S signatures on verify and always emits
+# low-S on sign (signature malleability defense).
+# ---------------------------------------------------------------------------
+
+
+def marshal_ecdsa_signature(r: int, s: int) -> bytes:
+    return encode_dss_signature(r, s)
+
+
+def unmarshal_ecdsa_signature(sig: bytes) -> tuple[int, int]:
+    """DER-decode a signature. Raises ValueError on malformed input or
+    non-positive r/s (reference bccsp/utils/ecdsa.go:47-62)."""
+    try:
+        r, s = decode_dss_signature(sig)
+    except Exception as exc:  # asn1 errors vary by backend
+        raise ValueError(f"invalid DER signature: {exc}") from exc
+    if r <= 0 or s <= 0:
+        raise ValueError("invalid signature: r and s must be positive")
+    return r, s
+
+
+def is_low_s(s: int) -> bool:
+    return s <= P256_HALF_N
+
+
+def to_low_s(s: int) -> int:
+    return P256_N - s if s > P256_HALF_N else s
+
+
+# ---------------------------------------------------------------------------
+# Batch verify item.
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class VerifyBatchItem:
+    """One (public key, digest, signature) triple for batched verification."""
+
+    key: ECDSAP256PublicKey
+    digest: bytes  # 32-byte SHA-256 digest of the signed message
+    signature: bytes  # DER-encoded (r, s)
+
+
+class CSP(abc.ABC):
+    """Provider protocol (reference bccsp/bccsp.go:90-134), plus batch ops."""
+
+    @abc.abstractmethod
+    def key_gen(self) -> ECDSAP256PrivateKey: ...
+
+    @abc.abstractmethod
+    def key_import(self, raw: bytes, private: bool = False) -> Key: ...
+
+    @abc.abstractmethod
+    def get_key(self, ski: bytes) -> Key: ...
+
+    @abc.abstractmethod
+    def hash(self, msg: bytes) -> bytes: ...
+
+    @abc.abstractmethod
+    def sign(self, key: Key, digest: bytes) -> bytes: ...
+
+    @abc.abstractmethod
+    def verify(self, key: Key, signature: bytes, digest: bytes) -> bool: ...
+
+    # -- batch extension (the TPU seam) ------------------------------------
+
+    @abc.abstractmethod
+    def hash_batch(self, msgs: Sequence[bytes]) -> list[bytes]: ...
+
+    @abc.abstractmethod
+    def verify_batch(self, items: Sequence[VerifyBatchItem]) -> list[bool]: ...
+
+
+__all__ = [
+    "CSP",
+    "Key",
+    "ECDSAP256PublicKey",
+    "ECDSAP256PrivateKey",
+    "VerifyBatchItem",
+    "marshal_ecdsa_signature",
+    "unmarshal_ecdsa_signature",
+    "is_low_s",
+    "to_low_s",
+    "P256_P",
+    "P256_A",
+    "P256_B",
+    "P256_N",
+    "P256_GX",
+    "P256_GY",
+    "P256_HALF_N",
+]

@@ -1,0 +1,52 @@
+"""CSP factory: provider selection + process-wide default.
+
+Reference: bccsp/factory/factory.go:42 GetDefault, nopkcs11.go:28
+InitFactories.  Providers: "sw" (host) and "tpu" (JAX batched).  The tpu
+provider is imported lazily so host-only users never pay JAX startup.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from fabric_tpu.csp.api import CSP
+from fabric_tpu.csp.sw import SWCSP
+
+_lock = threading.Lock()
+_default: Optional[CSP] = None
+
+
+def init_factories(provider: str = "sw", force: bool = False, **kwargs) -> CSP:
+    """Initialize the process default CSP.
+
+    Like the reference's InitFactories (bccsp/factory/nopkcs11.go:28 via
+    sync.Once), the first call wins and later calls return the existing
+    default — replacing the default would orphan keys already stored in the
+    previous provider's keystore. Pass force=True to replace anyway (tests).
+    """
+    global _default
+    with _lock:
+        if _default is None or force:
+            _default = _new_csp(provider, **kwargs)
+        return _default
+
+
+def get_default() -> CSP:
+    """Reference bccsp/factory/factory.go:42-62: lazily bootstraps a sw
+    provider when nothing was configured."""
+    global _default
+    with _lock:
+        if _default is None:
+            _default = SWCSP()
+        return _default
+
+
+def _new_csp(provider: str, **kwargs) -> CSP:
+    if provider == "sw":
+        return SWCSP()
+    if provider == "tpu":
+        from fabric_tpu.csp.tpu.provider import TPUCSP
+
+        return TPUCSP(**kwargs)
+    raise ValueError(f"unknown CSP provider {provider!r}")

@@ -1,0 +1,120 @@
+"""Host ("software") CSP provider.
+
+Equivalent of the reference's pure-Go `sw` provider (bccsp/sw/impl.go:36-47,
+ecdsa.go:27-57): OpenSSL-backed ECDSA-P256 via `cryptography`, SHA-256 via
+hashlib.  Serves two roles: (a) the host fallback provider, and (b) the
+parity oracle the TPU provider is tested against.
+
+Verify semantics match the reference exactly (bccsp/sw/ecdsa.go:41-57):
+DER-unmarshal, reject r/s <= 0, reject high-S, then curve verify.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from typing import Sequence
+
+from cryptography.exceptions import InvalidSignature
+from cryptography.hazmat.primitives import hashes
+from cryptography.hazmat.primitives.asymmetric import ec
+from cryptography.hazmat.primitives.asymmetric.utils import Prehashed
+
+from fabric_tpu.csp import api
+from fabric_tpu.csp.api import (
+    CSP,
+    ECDSAP256PrivateKey,
+    ECDSAP256PublicKey,
+    Key,
+    VerifyBatchItem,
+)
+
+_PREHASHED_SHA256 = ec.ECDSA(Prehashed(hashes.SHA256()))
+
+
+class SWCSP(CSP):
+    """In-memory keystore + host crypto. Reference: bccsp/sw/impl.go,
+    bccsp/sw/inmemoryks.go."""
+
+    def __init__(self) -> None:
+        self._keys: dict[bytes, Key] = {}
+        self._lock = threading.Lock()
+
+    # -- key management ----------------------------------------------------
+
+    def key_gen(self) -> ECDSAP256PrivateKey:
+        key = ECDSAP256PrivateKey.generate()
+        self._store(key)
+        return key
+
+    def key_import(self, raw: bytes, private: bool = False) -> Key:
+        key: Key
+        if private:
+            key = ECDSAP256PrivateKey.from_der(raw)
+        elif raw[:1] == b"\x04" and len(raw) == 65:
+            key = ECDSAP256PublicKey.from_point(
+                int.from_bytes(raw[1:33], "big"), int.from_bytes(raw[33:65], "big")
+            )
+        else:
+            key = ECDSAP256PublicKey.from_der(raw)
+        self._store(key)
+        return key
+
+    def get_key(self, ski: bytes) -> Key:
+        with self._lock:
+            key = self._keys.get(ski)
+        if key is None:
+            raise KeyError(f"no key for SKI {ski.hex()}")
+        return key
+
+    def _store(self, key: Key) -> None:
+        with self._lock:
+            self._keys[key.ski()] = key
+
+    # -- hashing -----------------------------------------------------------
+
+    def hash(self, msg: bytes) -> bytes:
+        return hashlib.sha256(msg).digest()
+
+    def hash_batch(self, msgs: Sequence[bytes]) -> list[bytes]:
+        return [hashlib.sha256(m).digest() for m in msgs]
+
+    # -- sign / verify -----------------------------------------------------
+
+    def sign(self, key: Key, digest: bytes) -> bytes:
+        if not isinstance(key, ECDSAP256PrivateKey):
+            raise TypeError("sign requires an ECDSA private key")
+        sig = key.crypto_key.sign(digest, _PREHASHED_SHA256)
+        # Reference always emits low-S (bccsp/utils/ecdsa.go ToLowS via
+        # signECDSA, bccsp/sw/ecdsa.go:27-39).
+        r, s = api.unmarshal_ecdsa_signature(sig)
+        return api.marshal_ecdsa_signature(r, api.to_low_s(s))
+
+    def verify(self, key: Key, signature: bytes, digest: bytes) -> bool:
+        if isinstance(key, ECDSAP256PrivateKey):
+            key = key.public_key()
+        if not isinstance(key, ECDSAP256PublicKey):
+            raise TypeError("verify requires an ECDSA key")
+        return _verify_one(key, signature, digest)
+
+    def verify_batch(self, items: Sequence[VerifyBatchItem]) -> list[bool]:
+        return [_verify_one(it.key, it.signature, it.digest) for it in items]
+
+
+def _verify_one(key: ECDSAP256PublicKey, signature: bytes, digest: bytes) -> bool:
+    try:
+        r, s = api.unmarshal_ecdsa_signature(signature)
+    except ValueError:
+        return False
+    if r >= api.P256_N or s >= api.P256_N:
+        return False
+    # Reference rejects high-S before curve math (bccsp/sw/ecdsa.go:41-52).
+    if not api.is_low_s(s):
+        return False
+    try:
+        key.crypto_key.verify(
+            api.marshal_ecdsa_signature(r, s), digest, _PREHASHED_SHA256
+        )
+        return True
+    except InvalidSignature:
+        return False

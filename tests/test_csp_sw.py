@@ -1,0 +1,112 @@
+"""Unit tests for the sw CSP provider.
+
+Mirrors the reference's bccsp/sw tests (bccsp/sw/ecdsa_test.go,
+impl_test.go): sign/verify roundtrip, low-S enforcement, DER edge cases,
+keystore by SKI.
+"""
+
+import hashlib
+
+import pytest
+
+from fabric_tpu.csp import api
+from fabric_tpu.csp.sw import SWCSP
+
+
+@pytest.fixture()
+def csp():
+    return SWCSP()
+
+
+def test_sign_verify_roundtrip(csp):
+    key = csp.key_gen()
+    digest = csp.hash(b"hello fabric-tpu")
+    sig = csp.sign(key, digest)
+    assert csp.verify(key, sig, digest)
+    assert csp.verify(key.public_key(), sig, digest)
+
+
+def test_verify_rejects_wrong_digest(csp):
+    key = csp.key_gen()
+    sig = csp.sign(key, csp.hash(b"msg"))
+    assert not csp.verify(key, sig, csp.hash(b"other"))
+
+
+def test_sign_always_low_s(csp):
+    key = csp.key_gen()
+    for i in range(20):
+        sig = csp.sign(key, csp.hash(b"m%d" % i))
+        _, s = api.unmarshal_ecdsa_signature(sig)
+        assert api.is_low_s(s)
+
+
+def test_verify_rejects_high_s(csp):
+    # Reference behavior: a mathematically valid but high-S signature fails
+    # (bccsp/sw/ecdsa.go:41-52).
+    key = csp.key_gen()
+    digest = csp.hash(b"msg")
+    sig = csp.sign(key, digest)
+    r, s = api.unmarshal_ecdsa_signature(sig)
+    high = api.marshal_ecdsa_signature(r, api.P256_N - s)
+    assert not api.is_low_s(api.P256_N - s)
+    assert not csp.verify(key, high, digest)
+
+
+def test_verify_rejects_garbage_der(csp):
+    key = csp.key_gen()
+    digest = csp.hash(b"msg")
+    assert not csp.verify(key, b"", digest)
+    assert not csp.verify(key, b"\x30\x02\x01\x00", digest)
+    assert not csp.verify(key, b"\xff" * 70, digest)
+
+
+def test_ski_stable_and_key_lookup(csp):
+    key = csp.key_gen()
+    ski = key.ski()
+    assert len(ski) == 32
+    assert csp.get_key(ski).ski() == ski
+    pub = key.public_key()
+    assert pub.ski() == ski
+    # re-import public key raw point -> same SKI
+    imported = csp.key_import(pub.raw())
+    assert imported.ski() == ski
+
+
+def test_key_import_der_and_point(csp):
+    key = csp.key_gen()
+    pub = key.public_key()
+    by_der = csp.key_import(pub.der())
+    assert by_der.ski() == pub.ski()
+
+
+def test_hash_batch_matches_hashlib(csp):
+    msgs = [b"a", b"b" * 100, b"", b"c" * 1000]
+    assert csp.hash_batch(msgs) == [hashlib.sha256(m).digest() for m in msgs]
+
+
+def test_verify_batch_mask_semantics(csp):
+    # The batch API must return a per-item mask, not all-or-nothing
+    # (SURVEY.md section 7 hard part #4).
+    keys = [csp.key_gen() for _ in range(4)]
+    digests = [csp.hash(b"m%d" % i) for i in range(4)]
+    sigs = [csp.sign(k, d) for k, d in zip(keys, digests)]
+    items = [
+        api.VerifyBatchItem(k.public_key(), d, s)
+        for k, d, s in zip(keys, digests, sigs)
+    ]
+    # corrupt item 2: signature over different digest
+    items[2] = api.VerifyBatchItem(
+        keys[2].public_key(), csp.hash(b"tampered"), sigs[2]
+    )
+    assert csp.verify_batch(items) == [True, True, False, True]
+
+
+def test_der_marshal_roundtrip():
+    r, s = 12345678901234567890, 98765432109876543210
+    der = api.marshal_ecdsa_signature(r, s)
+    assert api.unmarshal_ecdsa_signature(der) == (r, s)
+
+
+def test_to_low_s():
+    assert api.to_low_s(api.P256_HALF_N) == api.P256_HALF_N
+    assert api.to_low_s(api.P256_HALF_N + 1) == api.P256_N - api.P256_HALF_N - 1
