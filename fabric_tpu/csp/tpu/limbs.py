@@ -1,0 +1,282 @@
+"""Vectorized multi-precision modular arithmetic for TPU.
+
+The device-side number format backing the batched crypto data plane
+(SURVEY.md section 7 step 2).  TPUs have no native wide-integer unit, so
+256-bit field elements are decomposed into 16-bit limbs stored in uint32
+lanes; every operation below is elementwise/batched over a leading batch
+dimension and contains no data-dependent control flow, so the whole pipeline
+jits into a single XLA program on the VPU.
+
+Design notes (the "hard part (2)" of SURVEY.md section 7):
+
+* **Limbs.** A field element is ``(..., 17)`` uint32 with each limb < 2**16
+  (canonical limbs), value = sum(limb[i] << 16*i).  The 17th limb gives lazy
+  headroom: the arithmetic maintains the *invariant* value < 2**257 (top
+  limb <= 1) rather than value < m, deferring canonical reduction to a
+  single `canon` at the end of a computation chain.
+* **Products.** 16-bit limb products fit uint32 exactly
+  ((2**16-1)**2 < 2**32).  Column accumulation splits each product into
+  lo/hi 16-bit halves so column sums stay < 2**22, then a carry-resolution
+  pass (two coarse passes + a Kogge-Stone carry-lookahead, log2(width)
+  steps, no serial ripple) restores canonical limbs.
+* **Reduction.** Against a fold table R[i] = 2**(256+16i) mod m: the high
+  limbs of a product are multiplied into the table and added to the low
+  256 bits.  Two folds + a mini-fold bring any 34-limb product back under
+  the invariant without a single conditional subtraction; `canon` does the
+  final (rare) conditional subtracts.
+* **Subtraction** uses a per-modulus relaxed multiple C = c*m whose limbwise
+  representation dominates any invariant-bounded operand, so a - b is
+  computed as a + (C - b) with no borrow handling.
+
+The same machinery serves P-256 (mod p, mod n) and the BN254/FP256BN
+pairing field for idemix batch verification.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax.numpy as jnp
+
+LIMB_BITS = 16
+MASK = 0xFFFF
+NLIMBS = 16  # canonical 256-bit width
+WIDE = 17  # working width under the lazy invariant (value < 2**257)
+
+
+# ---------------------------------------------------------------------------
+# Host <-> limb conversions (numpy, run once per batch on host).
+# ---------------------------------------------------------------------------
+
+
+def int_to_limbs(x: int, width: int = WIDE) -> np.ndarray:
+    """Python int -> canonical uint32 limb vector of `width` limbs."""
+    if x < 0:
+        raise ValueError("negative")
+    out = np.zeros((width,), dtype=np.uint32)
+    for i in range(width):
+        out[i] = x & MASK
+        x >>= LIMB_BITS
+    if x:
+        raise ValueError("does not fit in %d limbs" % width)
+    return out
+
+
+def ints_to_limbs(xs, width: int = WIDE) -> np.ndarray:
+    """Batch of python ints -> (len(xs), width) uint32."""
+    out = np.zeros((len(xs), width), dtype=np.uint32)
+    for j, x in enumerate(xs):
+        for i in range(width):
+            out[j, i] = x & MASK
+            x >>= LIMB_BITS
+    return out
+
+
+def limbs_to_int(a) -> int:
+    a = np.asarray(a, dtype=np.uint64)
+    x = 0
+    for i in range(a.shape[-1] - 1, -1, -1):
+        x = (x << LIMB_BITS) + int(a[..., i])  # `+` not `|`: tolerates relaxed limbs
+    return x
+
+
+def limbs_to_ints(a) -> list:
+    a = np.asarray(a)
+    if a.ndim == 1:
+        return [limbs_to_int(a)]
+    return [limbs_to_int(row) for row in a]
+
+
+# ---------------------------------------------------------------------------
+# Carry resolution.
+# ---------------------------------------------------------------------------
+
+
+def _shift_up(a, d: int):
+    """result[..., i] = a[..., i-d], zero-filled; shifts toward high limbs."""
+    if d == 0:
+        return a
+    pad = [(0, 0)] * (a.ndim - 1) + [(d, 0)]
+    return jnp.pad(a[..., :-d] if d < a.shape[-1] else a[..., :0], pad)
+
+
+def resolve(v, width: int):
+    """Full carry resolution: limbs < 2**31 in, canonical 16-bit limbs out.
+
+    Two coarse carry passes bound every limb by 2**16 (+1), then a
+    Kogge-Stone carry-lookahead network (log2(width) vector steps — no
+    serial ripple, TPU-friendly) resolves the remaining single-bit ripple
+    chain exactly.  The caller guarantees value < 2**(16*width).
+    """
+    if v.shape[-1] < width:
+        pad = [(0, 0)] * (v.ndim - 1) + [(0, width - v.shape[-1])]
+        v = jnp.pad(v, pad)
+    one = jnp.uint32(LIMB_BITS)
+    m = jnp.uint32(MASK)
+    # coarse pass 1: limbs < 2**31 -> carries < 2**15
+    c = v >> one
+    v = (v & m) + _shift_up(c, 1)
+    # coarse pass 2: limbs < 2**17 -> carries <= 1
+    c = v >> one
+    v = (v & m) + _shift_up(c, 1)
+    # exact ripple: limbs <= 2**16
+    g = (v >> one).astype(jnp.uint32)  # generate, in {0, 1}
+    lo = v & m
+    p = (lo == m).astype(jnp.uint32)  # propagate
+    d = 1
+    while d < width:
+        g = g | (p & _shift_up(g, d))
+        p = p & _shift_up(p, d)
+        d *= 2
+    carry_in = _shift_up(g, 1)
+    return (lo + carry_in) & m
+
+
+# ---------------------------------------------------------------------------
+# Full-width multiply (schoolbook, column accumulation).
+# ---------------------------------------------------------------------------
+
+
+def mul_wide(a, b):
+    """(..., na) x (..., nb) canonical limbs -> (..., na+nb) canonical."""
+    na = a.shape[-1]
+    nb = b.shape[-1]
+    p = a[..., :, None] * b[..., None, :]  # (..., na, nb); exact in uint32
+    plo = p & jnp.uint32(MASK)
+    phi = p >> jnp.uint32(LIMB_BITS)
+    acc = jnp.zeros(a.shape[:-1] + (na + nb,), dtype=jnp.uint32)
+    for i in range(na):
+        acc = acc.at[..., i : i + nb].add(plo[..., i, :])
+        acc = acc.at[..., i + 1 : i + nb + 1].add(phi[..., i, :])
+    return resolve(acc, na + nb)
+
+
+# ---------------------------------------------------------------------------
+# Modulus context.
+# ---------------------------------------------------------------------------
+
+
+class Mod:
+    """Precomputed constants for arithmetic mod m (m must be > 2**255 here:
+    the fold-table bounds in add/sub/mul assume a 256-bit modulus)."""
+
+    def __init__(self, m: int):
+        if not (1 << 255) < m < (1 << 256):
+            raise ValueError("Mod expects a 256-bit modulus")
+        self.m = m
+        self.m_limbs = int_to_limbs(m, WIDE)
+        # fold table: R[i] = 2**(256 + 16 i) mod m, canonical 16 limbs.
+        self.fold = np.stack(
+            [int_to_limbs((1 << (256 + LIMB_BITS * i)) % m, NLIMBS) for i in range(18)]
+        )
+        # relaxed subtraction constant C = c*m with C in [2**259, 2**259+m):
+        # limbwise r dominates any invariant-bounded operand (top limb <= 7).
+        c = ((1 << 259) + m - 1) // m
+        e = int_to_limbs(c * m, WIDE).astype(np.int64)
+        r = e.copy()
+        r[0] += 1 << LIMB_BITS
+        r[1:16] += MASK
+        r[16] -= 1
+        assert (r >= 0).all() and r[16] >= 7
+        self.sub_c = r.astype(np.uint32)
+        assert limbs_to_int(self.sub_c) == c * m
+
+    # -- reduction ---------------------------------------------------------
+
+    def _fold_once(self, v, nrows: int, out_width: int):
+        """v (..., 16+nrows) -> (..., out_width): lo + sum hi[i] * R[i]."""
+        lo = v[..., :NLIMBS]
+        hi = v[..., NLIMBS : NLIMBS + nrows]
+        table = jnp.asarray(self.fold[:nrows])  # (nrows, 16)
+        p = hi[..., :, None] * table  # (..., nrows, 16)
+        plo = p & jnp.uint32(MASK)
+        phi = p >> jnp.uint32(LIMB_BITS)
+        acc = jnp.zeros(v.shape[:-1] + (out_width,), dtype=jnp.uint32)
+        acc = acc.at[..., :NLIMBS].add(lo)
+        acc = acc.at[..., :NLIMBS].add(plo.sum(axis=-2))
+        acc = acc.at[..., 1 : NLIMBS + 1].add(phi.sum(axis=-2))
+        return resolve(acc, out_width)
+
+    def reduce_product(self, v):
+        """34-limb product -> invariant element (< 2**257, 17 limbs)."""
+        v = self._fold_once(v, 18, 18)  # value < 2**277
+        v = self._fold_once(v, 2, WIDE)  # value < 2**262
+        return self._fold_once(v, 1, WIDE)  # value < 2**257
+
+    def _minifold(self, v):
+        """17-limb value with small top limb -> invariant element."""
+        return self._fold_once(v, 1, WIDE)
+
+    # -- field ops (all preserve the invariant) ---------------------------
+
+    def add(self, a, b):
+        return self._minifold(resolve(a + b, WIDE))
+
+    def sub(self, a, b):
+        c = jnp.asarray(self.sub_c)
+        return self._minifold(resolve(a + (c - b), WIDE))
+
+    def mul(self, a, b):
+        return self.reduce_product(mul_wide(a, b))
+
+    def sqr(self, a):
+        return self.mul(a, a)
+
+    def mul_const(self, a, k: int):
+        """a * small-constant k (k <= 256: keeps the folded value's top limb
+        within the lazy invariant without an extra fold pass)."""
+        assert 0 < k <= 256
+        p = a * jnp.uint32(k)
+        # limbs < 2**32 exact; resolve to 18 then fold.
+        v = resolve(p, WIDE + 1)
+        return self._fold_once(v, 2, WIDE)
+
+    # -- canonicalization --------------------------------------------------
+
+    def canon(self, a):
+        """Invariant element -> canonical residue < m (17 limbs, top 0)."""
+        v = self._minifold(a)
+        m_pad = jnp.asarray(self.m_limbs)
+        for _ in range(3):
+            v = _cond_sub(v, m_pad)
+        return v
+
+    def is_zero(self, a):
+        return jnp.all(self.canon(a) == 0, axis=-1)
+
+    def eq(self, a, b):
+        return jnp.all(self.canon(a) == self.canon(b), axis=-1)
+
+
+def _cond_sub(a, b_const):
+    """a - b if a >= b else a; a, b canonical limbs, same width."""
+    width = a.shape[-1]
+    notb = jnp.uint32(MASK) - b_const
+    t = a + notb
+    t = t.at[..., 0].add(1)
+    t = resolve(t, width + 1)
+    ge = t[..., width] > 0  # carry out => a >= b
+    return jnp.where(ge[..., None], t[..., :width], a)
+
+
+@functools.lru_cache(maxsize=None)
+def mod_ctx(m: int) -> Mod:
+    return Mod(m)
+
+
+__all__ = [
+    "LIMB_BITS",
+    "MASK",
+    "NLIMBS",
+    "WIDE",
+    "Mod",
+    "mod_ctx",
+    "mul_wide",
+    "resolve",
+    "int_to_limbs",
+    "ints_to_limbs",
+    "limbs_to_int",
+    "limbs_to_ints",
+]

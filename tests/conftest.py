@@ -8,7 +8,10 @@ the reference test strategy of simulating multi-node on one host
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force (not setdefault): the ambient environment pins JAX_PLATFORMS to the
+# TPU platform, but unit tests must be hermetic and run on the virtual CPU
+# mesh even when the TPU tunnel is down.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

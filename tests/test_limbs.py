@@ -1,0 +1,108 @@
+"""Parity tests: vectorized limb arithmetic vs python ints.
+
+The TPU field arithmetic must agree with arbitrary-precision host math on
+random and adversarial values (SURVEY.md section 7 step 9: crypto parity
+vectors against a software oracle)."""
+
+import random
+
+import numpy as np
+import pytest
+
+from fabric_tpu.csp import api
+from fabric_tpu.csp.tpu import limbs
+
+
+P = api.P256_P
+N = api.P256_N
+
+
+def rand_invariant(rng, bound=1 << 257):
+    """Random value satisfying the lazy invariant (< 2**257)."""
+    return rng.randrange(bound)
+
+
+@pytest.mark.parametrize("m", [P, N])
+def test_mod_ops_parity(m):
+    rng = random.Random(1234 + m % 97)
+    ctx = limbs.mod_ctx(m)
+    edge = [0, 1, m - 1, m, m + 1, (1 << 256) - 1, (1 << 257) - 1, m // 2]
+    vals_a = edge + [rand_invariant(rng) for _ in range(56)]
+    vals_b = list(reversed(edge)) + [rand_invariant(rng) for _ in range(56)]
+    a = np.asarray(limbs.ints_to_limbs(vals_a))
+    b = np.asarray(limbs.ints_to_limbs(vals_b))
+
+    got_add = limbs.limbs_to_ints(np.asarray(ctx.add(a, b)))
+    got_sub = limbs.limbs_to_ints(np.asarray(ctx.sub(a, b)))
+    got_mul = limbs.limbs_to_ints(np.asarray(ctx.mul(a, b)))
+    got_sqr = limbs.limbs_to_ints(np.asarray(ctx.sqr(a)))
+    got_canon = limbs.limbs_to_ints(np.asarray(ctx.canon(a)))
+    got_k3 = limbs.limbs_to_ints(np.asarray(ctx.mul_const(a, 3)))
+    got_k8 = limbs.limbs_to_ints(np.asarray(ctx.mul_const(a, 8)))
+
+    for i, (x, y) in enumerate(zip(vals_a, vals_b)):
+        assert got_add[i] % m == (x + y) % m, ("add", i)
+        assert got_sub[i] % m == (x - y) % m, ("sub", i)
+        assert got_mul[i] % m == (x * y) % m, ("mul", i)
+        assert got_sqr[i] % m == (x * x) % m, ("sqr", i)
+        assert got_canon[i] == x % m, ("canon", i)
+        assert got_k3[i] % m == (3 * x) % m, ("k3", i)
+        assert got_k8[i] % m == (8 * x) % m, ("k8", i)
+        # invariant maintained: results below 2**257
+        assert got_add[i] < 1 << 257
+        assert got_sub[i] < 1 << 257
+        assert got_mul[i] < 1 << 257
+
+
+@pytest.mark.parametrize("m", [P, N])
+def test_mod_chain_stress(m):
+    """Long randomly-interleaved op chains keep parity and the invariant."""
+    rng = random.Random(77)
+    ctx = limbs.mod_ctx(m)
+    vals = [rng.randrange(1 << 256) for _ in range(8)]
+    dev = np.asarray(limbs.ints_to_limbs(vals))
+    ref = list(vals)
+    for step in range(60):
+        op = rng.choice(["add", "sub", "mul", "sqr"])
+        j = rng.randrange(8)
+        other = np.roll(dev, j, axis=0)
+        ref_other = ref[-j:] + ref[:-j]
+        if op == "add":
+            dev = np.asarray(ctx.add(dev, other))
+            ref = [(x + y) % m for x, y in zip(ref, ref_other)]
+        elif op == "sub":
+            dev = np.asarray(ctx.sub(dev, other))
+            ref = [(x - y) % m for x, y in zip(ref, ref_other)]
+        elif op == "mul":
+            dev = np.asarray(ctx.mul(dev, other))
+            ref = [(x * y) % m for x, y in zip(ref, ref_other)]
+        else:
+            dev = np.asarray(ctx.sqr(dev))
+            ref = [(x * x) % m for x in ref]
+        got = limbs.limbs_to_ints(dev)
+        for i in range(8):
+            assert got[i] < 1 << 257, (step, op, i)
+            assert got[i] % m == ref[i], (step, op, i)
+
+
+def test_eq_is_zero():
+    ctx = limbs.mod_ctx(P)
+    vals = [0, P, 2 * P - 1, 5, P + 5]
+    a = np.asarray(limbs.ints_to_limbs(vals))
+    z = np.asarray(ctx.is_zero(a))
+    assert list(z) == [True, True, False, False, False]
+    b = np.asarray(limbs.ints_to_limbs([P, 0, P - 2, 5 + P, 5]))
+    e = np.asarray(ctx.eq(a, b))
+    # 2P-1 ≡ P-1 ≢ P-2 (mod P)
+    assert list(e) == [True, True, False, True, True]
+
+
+def test_mul_wide_parity():
+    rng = random.Random(5)
+    xs = [rng.randrange(1 << 272) for _ in range(16)]
+    ys = [rng.randrange(1 << 272) for _ in range(16)]
+    a = np.asarray(limbs.ints_to_limbs(xs, 17))
+    b = np.asarray(limbs.ints_to_limbs(ys, 17))
+    got = limbs.limbs_to_ints(np.asarray(limbs.mul_wide(a, b)))
+    for i in range(16):
+        assert got[i] == xs[i] * ys[i]
