@@ -38,6 +38,7 @@ import functools
 
 import numpy as np
 
+import jax
 import jax.numpy as jnp
 
 LIMB_BITS = 16
@@ -139,18 +140,41 @@ def resolve(v, width: int):
 # ---------------------------------------------------------------------------
 
 
+@functools.lru_cache(maxsize=None)
+def _column_matrix(na: int, nb: int) -> np.ndarray:
+    """0/1 matrix summing limb products into result columns.
+
+    Row layout matches the flattened (lo | hi) product halves; column k
+    collects lo products with i+j == k and hi products with i+j+1 == k.
+    Kept in float32 so the contraction runs on the MXU: every operand is an
+    integer < 2**16 and every column sum < 2**22 < 2**24, so f32 arithmetic
+    is exact.  This is the "limb products as matmul" MXU mapping from
+    SURVEY.md §7 — one dot op instead of O(na) slice-adds, which also keeps
+    the XLA graph small enough to compile fast.
+    """
+    s = np.zeros((2 * na * nb, na + nb), np.float32)
+    for i in range(na):
+        for j in range(nb):
+            s[i * nb + j, i + j] = 1.0
+            s[na * nb + i * nb + j, i + j + 1] = 1.0
+    return s
+
+
 def mul_wide(a, b):
     """(..., na) x (..., nb) canonical limbs -> (..., na+nb) canonical."""
     na = a.shape[-1]
     nb = b.shape[-1]
     p = a[..., :, None] * b[..., None, :]  # (..., na, nb); exact in uint32
-    plo = p & jnp.uint32(MASK)
-    phi = p >> jnp.uint32(LIMB_BITS)
-    acc = jnp.zeros(a.shape[:-1] + (na + nb,), dtype=jnp.uint32)
-    for i in range(na):
-        acc = acc.at[..., i : i + nb].add(plo[..., i, :])
-        acc = acc.at[..., i + 1 : i + nb + 1].add(phi[..., i, :])
-    return resolve(acc, na + nb)
+    plo = (p & jnp.uint32(MASK)).astype(jnp.float32)
+    phi = (p >> jnp.uint32(LIMB_BITS)).astype(jnp.float32)
+    flat = jnp.concatenate(
+        [plo.reshape(*a.shape[:-1], na * nb), phi.reshape(*a.shape[:-1], na * nb)],
+        axis=-1,
+    )
+    cols = jnp.matmul(
+        flat, _column_matrix(na, nb), precision=jax.lax.Precision.HIGHEST
+    )
+    return resolve(cols.astype(jnp.uint32), na + nb)
 
 
 # ---------------------------------------------------------------------------
