@@ -1,0 +1,129 @@
+"""TPU CSP provider: the `bccsp/tpu` seam.
+
+The sibling the reference never had (BASELINE.json north star): same SPI as
+the `sw` provider (bccsp/sw/impl.go dispatch surface), but `verify_batch`
+and `hash_batch` execute as single jitted XLA programs over the whole batch
+instead of per-item host calls.
+
+Key management and signing delegate to the host `sw` provider — the
+reference's hot path is *verification* at commit time (SURVEY.md §3.4:
+N_txs x (1 creator + K endorsers) ECDSA verifies per block); signing is
+one-per-proposal on the endorser and stays host-side.
+
+Static-shape discipline (SURVEY.md §7 hard part (1)): batches are padded to
+bucket sizes (powers of two) so XLA compiles once per bucket; oversized
+batches are chunked.  Per-item failure semantics are preserved end to end:
+host prechecks mark items invalid without throwing, and the kernel returns
+a per-lane mask (hard part (4)).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Sequence
+
+import numpy as np
+
+from fabric_tpu.csp import api
+from fabric_tpu.csp.api import (
+    CSP,
+    ECDSAP256PrivateKey,
+    ECDSAP256PublicKey,
+    Key,
+    VerifyBatchItem,
+)
+from fabric_tpu.csp.sw import SWCSP
+
+_BATCH_BUCKETS = (32, 128, 512, 2048, 8192)
+_HASH_BUCKETS = (32, 128, 512, 2048, 8192)
+
+
+def _bucket(n: int, buckets) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    return buckets[-1]
+
+
+class TPUCSP(CSP):
+    """Batched JAX/XLA crypto provider (ECDSA-P256 verify + SHA-256)."""
+
+    def __init__(self, sw: SWCSP | None = None, min_device_batch: int = 16):
+        self._sw = sw or SWCSP()
+        # Below this size, host verify wins on latency (device dispatch
+        # overhead); the sw provider is also the fallback oracle.
+        self._min_device_batch = min_device_batch
+
+    # -- key management / signing: host side ------------------------------
+
+    def key_gen(self) -> ECDSAP256PrivateKey:
+        return self._sw.key_gen()
+
+    def key_import(self, raw: bytes, private: bool = False) -> Key:
+        return self._sw.key_import(raw, private)
+
+    def get_key(self, ski: bytes) -> Key:
+        return self._sw.get_key(ski)
+
+    def sign(self, key: Key, digest: bytes) -> bytes:
+        return self._sw.sign(key, digest)
+
+    # -- hashing -----------------------------------------------------------
+
+    def hash(self, msg: bytes) -> bytes:
+        return hashlib.sha256(msg).digest()
+
+    def hash_batch(self, msgs: Sequence[bytes]) -> list[bytes]:
+        if len(msgs) < self._min_device_batch:
+            return [hashlib.sha256(m).digest() for m in msgs]
+        from fabric_tpu.csp.tpu import sha256 as dev_sha
+
+        # Bucket by padded block count AND batch size to bound compiles.
+        nb = max((len(m) + 9 + 63) // 64 for m in msgs)
+        nb = 1 << (nb - 1).bit_length()
+        n = len(msgs)
+        bsz = _bucket(n, _HASH_BUCKETS)
+        out: list[bytes] = []
+        for off in range(0, n, bsz):
+            chunk = list(msgs[off : off + bsz])
+            pad = bsz - len(chunk)
+            chunk += [b""] * pad
+            digs = dev_sha.sha256_batch(chunk, n_blocks=nb)
+            out.extend(digs[: bsz - pad])
+        return out
+
+    # -- verification ------------------------------------------------------
+
+    def verify(self, key: Key, signature: bytes, digest: bytes) -> bool:
+        return self._sw.verify(key, signature, digest)
+
+    def verify_batch(self, items: Sequence[VerifyBatchItem]) -> list[bool]:
+        if len(items) < self._min_device_batch:
+            return self._sw.verify_batch(items)
+        from fabric_tpu.csp.tpu import ec
+
+        tuples = []
+        for it in items:
+            key = it.key
+            if isinstance(key, ECDSAP256PrivateKey):
+                key = key.public_key()
+            try:
+                r, s = api.unmarshal_ecdsa_signature(it.signature)
+            except ValueError:
+                r, s = -1, -1  # prepare_batch marks the lane invalid
+            tuples.append((key.x, key.y, it.digest, r, s))
+
+        n = len(tuples)
+        bsz = _bucket(n, _BATCH_BUCKETS)
+        results: list[bool] = []
+        for off in range(0, n, bsz):
+            chunk = tuples[off : off + bsz]
+            pad = bsz - len(chunk)
+            chunk = chunk + [(api.P256_GX, api.P256_GY, b"", -1, -1)] * pad
+            prep = ec.prepare_batch(chunk)
+            mask = np.asarray(ec.verify_prepared(**prep))
+            results.extend(bool(v) for v in mask[: bsz - pad])
+        return results
+
+
+__all__ = ["TPUCSP"]

@@ -1,0 +1,58 @@
+"""TPU provider parity vs the sw oracle (hash + verify batch APIs)."""
+
+import hashlib
+import random
+
+from fabric_tpu.csp import SWCSP, VerifyBatchItem, api, init_factories
+from fabric_tpu.csp.tpu.provider import TPUCSP
+
+
+def test_factory_selects_tpu():
+    csp = init_factories("tpu", force=True)
+    assert isinstance(csp, TPUCSP)
+    init_factories("sw", force=True)
+
+
+def test_hash_batch_parity():
+    rng = random.Random(3)
+    csp = TPUCSP(min_device_batch=1)
+    msgs = [bytes(rng.randrange(256) for _ in range(rng.randrange(0, 200))) for _ in range(37)]
+    msgs += [b"", b"a" * 55, b"a" * 56, b"a" * 64, b"a" * 119, b"a" * 120]
+    got = csp.hash_batch(msgs)
+    want = [hashlib.sha256(m).digest() for m in msgs]
+    assert got == want
+
+
+def test_verify_batch_parity_with_tampering():
+    rng = random.Random(11)
+    sw = SWCSP()
+    tpu = TPUCSP(sw=sw, min_device_batch=1)
+    items = []
+    for i in range(40):
+        key = sw.key_gen()
+        digest = sw.hash(b"payload-%d" % i)
+        sig = sw.sign(key, digest)
+        roll = rng.random()
+        if roll < 0.15:
+            sig = sig[:-2] + bytes([sig[-2] ^ 1, sig[-1]])
+        elif roll < 0.25:
+            digest = sw.hash(b"evil-%d" % i)
+        elif roll < 0.3:
+            sig = b"\x30\x02\x01\x01"  # malformed DER
+        elif roll < 0.35:
+            r, s = api.unmarshal_ecdsa_signature(sig)
+            sig = api.marshal_ecdsa_signature(r, api.P256_N - s)  # high-S
+        items.append(VerifyBatchItem(key.public_key(), digest, sig))
+    got = tpu.verify_batch(items)
+    want = sw.verify_batch(items)
+    assert got == want
+    assert any(got) and not all(got)
+
+
+def test_verify_batch_small_falls_back_to_host():
+    sw = SWCSP()
+    tpu = TPUCSP(sw=sw, min_device_batch=64)
+    key = sw.key_gen()
+    d = sw.hash(b"x")
+    items = [VerifyBatchItem(key.public_key(), d, sw.sign(key, d))]
+    assert tpu.verify_batch(items) == [True]
