@@ -1,0 +1,75 @@
+"""Proto utilities: canonical construction/extraction of wire messages.
+
+Equivalent surface to the reference's protoutil package (SURVEY.md L0:
+protoutil/commonutils.go, proputils.go, txutils.go, blockutils.go) — the
+helpers every layer above uses to build and unpack Envelopes, Blocks,
+Proposals, and Transactions.
+"""
+
+from fabric_tpu.protoutil.common import (
+    SignedData,
+    compute_tx_id,
+    check_tx_id,
+    make_channel_header,
+    make_signature_header,
+    make_payload_bytes,
+    make_envelope,
+    random_nonce,
+    unmarshal_envelope,
+    unmarshal_payload,
+    unmarshal_channel_header,
+    unmarshal_signature_header,
+)
+from fabric_tpu.protoutil.blocks import (
+    block_data_hash,
+    block_header_hash,
+    block_header_bytes,
+    new_block,
+    create_next_block,
+    extract_envelope,
+    get_last_config_index,
+    init_block_metadata,
+    tx_filter,
+    set_tx_filter,
+)
+from fabric_tpu.protoutil.txs import (
+    create_chaincode_proposal,
+    proposal_hash,
+    create_proposal_response,
+    create_signed_tx,
+    get_action_from_envelope,
+    unpack_proposal,
+    unpack_transaction,
+)
+
+__all__ = [
+    "SignedData",
+    "compute_tx_id",
+    "check_tx_id",
+    "make_channel_header",
+    "make_signature_header",
+    "make_payload_bytes",
+    "make_envelope",
+    "random_nonce",
+    "unmarshal_envelope",
+    "unmarshal_payload",
+    "unmarshal_channel_header",
+    "unmarshal_signature_header",
+    "block_data_hash",
+    "block_header_hash",
+    "block_header_bytes",
+    "new_block",
+    "create_next_block",
+    "extract_envelope",
+    "get_last_config_index",
+    "init_block_metadata",
+    "tx_filter",
+    "set_tx_filter",
+    "create_chaincode_proposal",
+    "proposal_hash",
+    "create_proposal_response",
+    "create_signed_tx",
+    "get_action_from_envelope",
+    "unpack_proposal",
+    "unpack_transaction",
+]

@@ -1,0 +1,103 @@
+"""Block construction & hashing (reference protoutil/blockutils.go).
+
+Header hashing is the consensus-critical part: the reference hashes the
+DER (ASN.1) encoding of (Number, PreviousHash, DataHash) so independent
+implementations agree byte-for-byte; we implement the same encoding with a
+minimal DER writer (no external asn1 dependency).
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+from fabric_tpu.protos.common import common_pb2
+
+
+def _der_len(n: int) -> bytes:
+    if n < 0x80:
+        return bytes([n])
+    body = n.to_bytes((n.bit_length() + 7) // 8, "big")
+    return bytes([0x80 | len(body)]) + body
+
+
+def _der_integer(v: int) -> bytes:
+    if v == 0:
+        body = b"\x00"
+    else:
+        body = v.to_bytes((v.bit_length() + 8) // 8, "big")  # extra byte if MSB set
+        if len(body) > 1 and body[0] == 0 and body[1] < 0x80:
+            body = body[1:]
+    return b"\x02" + _der_len(len(body)) + body
+
+
+def _der_octets(b: bytes) -> bytes:
+    return b"\x04" + _der_len(len(b)) + b
+
+
+def block_header_bytes(header: common_pb2.BlockHeader) -> bytes:
+    """ASN.1 SEQUENCE { number INTEGER, previous_hash OCTET STRING,
+    data_hash OCTET STRING } — deterministic across implementations."""
+    body = (
+        _der_integer(header.number)
+        + _der_octets(header.previous_hash)
+        + _der_octets(header.data_hash)
+    )
+    return b"\x30" + _der_len(len(body)) + body
+
+
+def block_header_hash(header: common_pb2.BlockHeader) -> bytes:
+    return hashlib.sha256(block_header_bytes(header)).digest()
+
+
+def block_data_hash(data: common_pb2.BlockData) -> bytes:
+    """SHA-256 over the concatenation of the serialized envelopes."""
+    return hashlib.sha256(b"".join(data.data)).digest()
+
+
+def init_block_metadata(block: common_pb2.Block) -> None:
+    while len(block.metadata.metadata) <= common_pb2.COMMIT_HASH:
+        block.metadata.metadata.append(b"")
+
+
+def new_block(seq: int, previous_hash: bytes) -> common_pb2.Block:
+    blk = common_pb2.Block()
+    blk.header.number = seq
+    blk.header.previous_hash = previous_hash
+    init_block_metadata(blk)
+    return blk
+
+
+def create_next_block(prev_header: common_pb2.BlockHeader, envelopes) -> common_pb2.Block:
+    blk = new_block(prev_header.number + 1, block_header_hash(prev_header))
+    for env in envelopes:
+        blk.data.data.append(env.SerializeToString())
+    blk.header.data_hash = block_data_hash(blk.data)
+    return blk
+
+
+def extract_envelope(block: common_pb2.Block, idx: int) -> common_pb2.Envelope:
+    return common_pb2.Envelope.FromString(block.data.data[idx])
+
+
+def tx_filter(block: common_pb2.Block) -> bytearray:
+    """The per-tx validation-code byte array in block metadata
+    (BlockMetadataIndex.TRANSACTIONS_FILTER)."""
+    init_block_metadata(block)
+    raw = block.metadata.metadata[common_pb2.TRANSACTIONS_FILTER]
+    if len(raw) != len(block.data.data):
+        return bytearray(len(block.data.data))
+    return bytearray(raw)
+
+
+def set_tx_filter(block: common_pb2.Block, flags) -> None:
+    init_block_metadata(block)
+    block.metadata.metadata[common_pb2.TRANSACTIONS_FILTER] = bytes(flags)
+
+
+def get_last_config_index(block: common_pb2.Block) -> int:
+    meta = common_pb2.Metadata.FromString(
+        block.metadata.metadata[common_pb2.SIGNATURES]
+    )
+    if not meta.value:
+        return 0
+    return common_pb2.OrdererBlockMetadata.FromString(meta.value).last_config.index

@@ -1,0 +1,98 @@
+"""Envelope/header helpers (reference protoutil/commonutils.go:23-60,
+proputils.go:368 CheckTxID)."""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import os
+import time
+
+from fabric_tpu.protos.common import common_pb2
+
+
+@dataclasses.dataclass(frozen=True)
+class SignedData:
+    """A (message, identity, signature) triple — the unit fed to policy
+    evaluation and batch verification (reference protoutil/signeddata.go)."""
+
+    data: bytes
+    identity: bytes  # marshaled msp.SerializedIdentity
+    signature: bytes
+
+
+def random_nonce(n: int = 24) -> bytes:
+    """CSPRNG nonce (reference common/crypto/random.go: 24-byte nonces)."""
+    return os.urandom(n)
+
+
+def compute_tx_id(nonce: bytes, creator: bytes) -> str:
+    """TxID = hex(SHA-256(nonce || creator)) — the binding the reference
+    enforces in protoutil CheckTxID."""
+    return hashlib.sha256(nonce + creator).hexdigest()
+
+
+def check_tx_id(txid: str, nonce: bytes, creator: bytes) -> bool:
+    return txid == compute_tx_id(nonce, creator)
+
+
+def make_channel_header(
+    header_type: int,
+    channel_id: str,
+    tx_id: str = "",
+    epoch: int = 0,
+    extension: bytes = b"",
+    version: int = 0,
+    timestamp: float | None = None,
+) -> common_pb2.ChannelHeader:
+    ch = common_pb2.ChannelHeader(
+        type=header_type,
+        version=version,
+        channel_id=channel_id,
+        tx_id=tx_id,
+        epoch=epoch,
+        extension=extension,
+    )
+    ts = time.time() if timestamp is None else timestamp
+    ch.timestamp.seconds = int(ts)
+    return ch
+
+
+def make_signature_header(creator: bytes, nonce: bytes) -> common_pb2.SignatureHeader:
+    return common_pb2.SignatureHeader(creator=creator, nonce=nonce)
+
+
+def make_payload_bytes(
+    channel_header: common_pb2.ChannelHeader,
+    signature_header: common_pb2.SignatureHeader,
+    data: bytes,
+) -> bytes:
+    return common_pb2.Payload(
+        header=common_pb2.Header(
+            channel_header=channel_header.SerializeToString(),
+            signature_header=signature_header.SerializeToString(),
+        ),
+        data=data,
+    ).SerializeToString()
+
+
+def make_envelope(payload_bytes: bytes, signer=None) -> common_pb2.Envelope:
+    """Wrap payload bytes; `signer` (optional) has .sign(msg) -> bytes."""
+    sig = signer.sign(payload_bytes) if signer is not None else b""
+    return common_pb2.Envelope(payload=payload_bytes, signature=sig)
+
+
+def unmarshal_envelope(raw: bytes) -> common_pb2.Envelope:
+    return common_pb2.Envelope.FromString(raw)
+
+
+def unmarshal_payload(raw: bytes) -> common_pb2.Payload:
+    return common_pb2.Payload.FromString(raw)
+
+
+def unmarshal_channel_header(raw: bytes) -> common_pb2.ChannelHeader:
+    return common_pb2.ChannelHeader.FromString(raw)
+
+
+def unmarshal_signature_header(raw: bytes) -> common_pb2.SignatureHeader:
+    return common_pb2.SignatureHeader.FromString(raw)

@@ -1,0 +1,213 @@
+"""Proposal / transaction construction & unpacking.
+
+Reference surface: protoutil/proputils.go (CreateChaincodeProposal,
+GetProposalHash1/2), protoutil/txutils.go (CreateSignedTx), and the
+endorser-side UnpackProposal (core/endorser/msgvalidation.go:43).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+
+from fabric_tpu.protos.common import common_pb2
+from fabric_tpu.protos.peer import (
+    chaincode_pb2,
+    proposal_pb2,
+    proposal_response_pb2,
+    transaction_pb2,
+)
+from fabric_tpu.protoutil import common as putil
+
+
+def create_chaincode_proposal(
+    creator: bytes,
+    channel_id: str,
+    chaincode_name: str,
+    args: list[bytes],
+    transient: dict[str, bytes] | None = None,
+    nonce: bytes | None = None,
+) -> tuple[proposal_pb2.Proposal, str]:
+    """Build an ENDORSER_TRANSACTION proposal; returns (proposal, tx_id)."""
+    nonce = nonce if nonce is not None else putil.random_nonce()
+    tx_id = putil.compute_tx_id(nonce, creator)
+    ext = proposal_pb2.ChaincodeHeaderExtension(
+        chaincode_id=chaincode_pb2.ChaincodeID(name=chaincode_name)
+    )
+    chdr = putil.make_channel_header(
+        common_pb2.ENDORSER_TRANSACTION,
+        channel_id,
+        tx_id=tx_id,
+        extension=ext.SerializeToString(),
+    )
+    shdr = putil.make_signature_header(creator, nonce)
+    cis = chaincode_pb2.ChaincodeInvocationSpec(
+        chaincode_spec=chaincode_pb2.ChaincodeSpec(
+            type=chaincode_pb2.ChaincodeSpec.GOLANG,
+            chaincode_id=chaincode_pb2.ChaincodeID(name=chaincode_name),
+            input=chaincode_pb2.ChaincodeInput(args=args),
+        )
+    )
+    ccpp = proposal_pb2.ChaincodeProposalPayload(input=cis.SerializeToString())
+    for k, v in (transient or {}).items():
+        ccpp.TransientMap[k] = v
+    prop = proposal_pb2.Proposal(
+        header=common_pb2.Header(
+            channel_header=chdr.SerializeToString(),
+            signature_header=shdr.SerializeToString(),
+        ).SerializeToString(),
+        payload=ccpp.SerializeToString(),
+    )
+    return prop, tx_id
+
+
+def proposal_hash(chdr_bytes: bytes, shdr_bytes: bytes, ccpp_bytes: bytes) -> bytes:
+    """SHA-256 binding of the endorsement to the proposal: channel header ||
+    signature header || ChaincodeProposalPayload with TransientMap cleared
+    (the reference's GetProposalHash1 semantics — transient data must not
+    influence the hash since committers never see it)."""
+    ccpp = proposal_pb2.ChaincodeProposalPayload.FromString(ccpp_bytes)
+    ccpp.ClearField("TransientMap")
+    h = hashlib.sha256()
+    h.update(chdr_bytes)
+    h.update(shdr_bytes)
+    h.update(ccpp.SerializeToString())
+    return h.digest()
+
+
+def create_proposal_response(
+    prop: proposal_pb2.Proposal,
+    results: bytes,
+    events: bytes,
+    response: proposal_pb2.Response,
+    chaincode_id,
+    endorser_signer,
+) -> proposal_response_pb2.ProposalResponse:
+    """Simulate-then-sign (the default endorsement plugin's job:
+    core/handlers/endorsement/builtin/default_endorsement.go:36)."""
+    hdr = common_pb2.Header.FromString(prop.header)
+    p_hash = proposal_hash(hdr.channel_header, hdr.signature_header, prop.payload)
+    action = proposal_pb2.ChaincodeAction(
+        results=results, events=events, response=response, chaincode_id=chaincode_id
+    )
+    prp = proposal_response_pb2.ProposalResponsePayload(
+        proposal_hash=p_hash, extension=action.SerializeToString()
+    ).SerializeToString()
+    endorser = endorser_signer.serialize()
+    sig = endorser_signer.sign(prp + endorser)
+    return proposal_response_pb2.ProposalResponse(
+        version=1,
+        response=proposal_pb2.Response(status=200),
+        payload=prp,
+        endorsement=proposal_response_pb2.Endorsement(endorser=endorser, signature=sig),
+    )
+
+
+def create_signed_tx(
+    prop: proposal_pb2.Proposal,
+    signer,
+    responses: list[proposal_response_pb2.ProposalResponse],
+) -> common_pb2.Envelope:
+    """Assemble the endorsed transaction envelope (reference
+    protoutil/txutils.go CreateSignedTx): all responses must carry identical
+    payloads, the creator must match the proposal's, transient data is
+    stripped."""
+    if not responses:
+        raise ValueError("at least one proposal response is required")
+    hdr = common_pb2.Header.FromString(prop.header)
+    shdr = common_pb2.SignatureHeader.FromString(hdr.signature_header)
+    if shdr.creator != signer.serialize():
+        raise ValueError("signer must match proposal creator")
+    payload0 = responses[0].payload
+    endorsements = []
+    for r in responses:
+        if r.response.status < 200 or r.response.status >= 400:
+            raise ValueError(f"proposal response was not successful: {r.response.status}")
+        if r.payload != payload0:
+            raise ValueError("proposal responses do not match")
+        endorsements.append(r.endorsement)
+    ccpp = proposal_pb2.ChaincodeProposalPayload.FromString(prop.payload)
+    ccpp.ClearField("TransientMap")
+    cap = transaction_pb2.ChaincodeActionPayload(
+        chaincode_proposal_payload=ccpp.SerializeToString(),
+        action=transaction_pb2.ChaincodeEndorsedAction(
+            proposal_response_payload=payload0, endorsements=endorsements
+        ),
+    )
+    tx = transaction_pb2.Transaction(
+        actions=[
+            transaction_pb2.TransactionAction(
+                header=hdr.signature_header, payload=cap.SerializeToString()
+            )
+        ]
+    )
+    payload = common_pb2.Payload(
+        header=hdr, data=tx.SerializeToString()
+    ).SerializeToString()
+    return common_pb2.Envelope(payload=payload, signature=signer.sign(payload))
+
+
+@dataclasses.dataclass
+class UnpackedProposal:
+    proposal: proposal_pb2.Proposal
+    channel_header: common_pb2.ChannelHeader
+    signature_header: common_pb2.SignatureHeader
+    chaincode_name: str
+    input: chaincode_pb2.ChaincodeInput
+
+
+def unpack_proposal(signed: proposal_pb2.SignedProposal) -> UnpackedProposal:
+    """Endorser-side unpack + structural checks (reference
+    core/endorser/msgvalidation.go:43 UnpackProposal)."""
+    prop = proposal_pb2.Proposal.FromString(signed.proposal_bytes)
+    hdr = common_pb2.Header.FromString(prop.header)
+    chdr = common_pb2.ChannelHeader.FromString(hdr.channel_header)
+    shdr = common_pb2.SignatureHeader.FromString(hdr.signature_header)
+    ext = proposal_pb2.ChaincodeHeaderExtension.FromString(chdr.extension)
+    if not ext.chaincode_id.name:
+        raise ValueError("ChaincodeHeaderExtension.chaincode_id.name is empty")
+    ccpp = proposal_pb2.ChaincodeProposalPayload.FromString(prop.payload)
+    cis = chaincode_pb2.ChaincodeInvocationSpec.FromString(ccpp.input)
+    return UnpackedProposal(
+        proposal=prop,
+        channel_header=chdr,
+        signature_header=shdr,
+        chaincode_name=ext.chaincode_id.name,
+        input=cis.chaincode_spec.input,
+    )
+
+
+@dataclasses.dataclass
+class UnpackedTransaction:
+    payload: common_pb2.Payload
+    channel_header: common_pb2.ChannelHeader
+    signature_header: common_pb2.SignatureHeader
+    transaction: transaction_pb2.Transaction
+    actions: list[transaction_pb2.ChaincodeActionPayload]
+
+
+def unpack_transaction(env: common_pb2.Envelope) -> UnpackedTransaction:
+    payload = common_pb2.Payload.FromString(env.payload)
+    chdr = common_pb2.ChannelHeader.FromString(payload.header.channel_header)
+    shdr = common_pb2.SignatureHeader.FromString(payload.header.signature_header)
+    tx = transaction_pb2.Transaction.FromString(payload.data)
+    actions = [
+        transaction_pb2.ChaincodeActionPayload.FromString(a.payload) for a in tx.actions
+    ]
+    return UnpackedTransaction(
+        payload=payload,
+        channel_header=chdr,
+        signature_header=shdr,
+        transaction=tx,
+        actions=actions,
+    )
+
+
+def get_action_from_envelope(env: common_pb2.Envelope):
+    """Extract the (ChaincodeActionPayload, ChaincodeAction) of action 0."""
+    unpacked = unpack_transaction(env)
+    cap = unpacked.actions[0]
+    prp = proposal_response_pb2.ProposalResponsePayload.FromString(
+        cap.action.proposal_response_payload
+    )
+    return cap, proposal_pb2.ChaincodeAction.FromString(prp.extension)

@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# Regenerate Python protobuf modules from fabric_tpu/protos/**/*.proto.
+# Generated *_pb2.py files are checked in so runtime/test environments
+# never need protoc.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+protoc -I. $(find fabric_tpu/protos -name '*.proto') --python_out=.
+# package markers for generated dirs
+for d in $(find fabric_tpu/protos -type d); do
+  [ -f "$d/__init__.py" ] || touch "$d/__init__.py"
+done
+echo "generated $(find fabric_tpu/protos -name '*_pb2.py' | wc -l) modules"

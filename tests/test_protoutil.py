@@ -1,0 +1,140 @@
+"""protoutil construction/extraction round trips (reference protoutil tests'
+coverage model: tx id binding, header hashing determinism, signed-tx
+assembly invariants)."""
+
+import hashlib
+
+import pytest
+
+from fabric_tpu.csp import SWCSP
+from fabric_tpu.protos.common import common_pb2
+from fabric_tpu.protos.peer import chaincode_pb2, proposal_pb2
+from fabric_tpu import protoutil
+
+
+class LocalSigner:
+    """Minimal signing identity for tests (MSP provides the real one)."""
+
+    def __init__(self, mspid="Org1MSP"):
+        from fabric_tpu.protos.msp import identities_pb2
+
+        self.csp = SWCSP()
+        self.key = self.csp.key_gen()
+        self.sid = identities_pb2.SerializedIdentity(
+            mspid=mspid, id_bytes=self.key.public_key().pem()
+        ).SerializeToString()
+
+    def serialize(self):
+        return self.sid
+
+    def sign(self, msg: bytes) -> bytes:
+        return self.csp.sign(self.key, self.csp.hash(msg))
+
+
+def test_tx_id_binding():
+    nonce, creator = b"n" * 24, b"creator"
+    txid = protoutil.compute_tx_id(nonce, creator)
+    assert txid == hashlib.sha256(nonce + creator).hexdigest()
+    assert protoutil.check_tx_id(txid, nonce, creator)
+    assert not protoutil.check_tx_id(txid, b"x" * 24, creator)
+
+
+def test_block_header_hash_asn1():
+    hdr = common_pb2.BlockHeader(number=7, previous_hash=b"\xaa" * 32, data_hash=b"\xbb" * 32)
+    raw = protoutil.block_header_bytes(hdr)
+    # SEQUENCE(INTEGER 7, OCTET STRING (32), OCTET STRING (32))
+    assert raw[0] == 0x30
+    assert raw[2:5] == b"\x02\x01\x07"
+    assert protoutil.block_header_hash(hdr) == hashlib.sha256(raw).digest()
+    # large number needs the high-bit padding byte
+    hdr2 = common_pb2.BlockHeader(number=0x80, previous_hash=b"", data_hash=b"")
+    assert b"\x02\x02\x00\x80" in protoutil.block_header_bytes(hdr2)
+
+
+def test_create_next_block_chain():
+    genesis = protoutil.new_block(0, b"")
+    genesis.header.data_hash = protoutil.block_data_hash(genesis.data)
+    env = common_pb2.Envelope(payload=b"tx0")
+    blk = protoutil.create_next_block(genesis.header, [env])
+    assert blk.header.number == 1
+    assert blk.header.previous_hash == protoutil.block_header_hash(genesis.header)
+    assert protoutil.extract_envelope(blk, 0).payload == b"tx0"
+    flags = protoutil.tx_filter(blk)
+    assert len(flags) == 1
+    flags[0] = 11
+    protoutil.set_tx_filter(blk, flags)
+    assert protoutil.tx_filter(blk)[0] == 11
+
+
+def test_proposal_tx_roundtrip():
+    signer = LocalSigner()
+    prop, txid = protoutil.create_chaincode_proposal(
+        signer.serialize(), "testchannel", "mycc", [b"invoke", b"a", b"b"],
+        transient={"secret": b"s3cret"},
+    )
+    unpacked = protoutil.unpack_proposal(
+        proposal_pb2.SignedProposal(proposal_bytes=prop.SerializeToString())
+    )
+    assert unpacked.chaincode_name == "mycc"
+    assert list(unpacked.input.args) == [b"invoke", b"a", b"b"]
+    assert protoutil.check_tx_id(
+        txid, unpacked.signature_header.nonce, unpacked.signature_header.creator
+    )
+
+    resp = protoutil.create_proposal_response(
+        prop,
+        results=b"rwset-bytes",
+        events=b"",
+        response=proposal_pb2.Response(status=200),
+        chaincode_id=chaincode_pb2.ChaincodeID(name="mycc", version="1.0"),
+        endorser_signer=signer,
+    )
+    env = protoutil.create_signed_tx(prop, signer, [resp])
+    tx = protoutil.unpack_transaction(env)
+    assert tx.channel_header.tx_id == txid
+    cap, action = protoutil.get_action_from_envelope(env)
+    assert action.results == b"rwset-bytes"
+    # transient data must have been stripped from the committed payload
+    ccpp = proposal_pb2.ChaincodeProposalPayload.FromString(
+        cap.chaincode_proposal_payload
+    )
+    assert not ccpp.TransientMap
+    # proposal hash binds: recompute from tx parts equals endorsed hash
+    from fabric_tpu.protos.peer import proposal_response_pb2
+
+    prp = proposal_response_pb2.ProposalResponsePayload.FromString(
+        cap.action.proposal_response_payload
+    )
+    recomputed = protoutil.proposal_hash(
+        tx.payload.header.channel_header,
+        tx.payload.header.signature_header,
+        cap.chaincode_proposal_payload,
+    )
+    assert recomputed == prp.proposal_hash
+
+
+def test_create_signed_tx_rejects_mismatches():
+    signer = LocalSigner()
+    other = LocalSigner()
+    prop, _ = protoutil.create_chaincode_proposal(
+        signer.serialize(), "ch", "cc", [b"x"]
+    )
+    resp = protoutil.create_proposal_response(
+        prop, b"r", b"", proposal_pb2.Response(status=200),
+        chaincode_pb2.ChaincodeID(name="cc"), signer,
+    )
+    with pytest.raises(ValueError, match="creator"):
+        protoutil.create_signed_tx(prop, other, [resp])
+    bad = proposal_pb2.Response(status=500)
+    resp2 = protoutil.create_proposal_response(
+        prop, b"r", b"", bad, chaincode_pb2.ChaincodeID(name="cc"), signer
+    )
+    resp2.response.status = 500
+    with pytest.raises(ValueError, match="not successful"):
+        protoutil.create_signed_tx(prop, signer, [resp2])
+    resp3 = protoutil.create_proposal_response(
+        prop, b"other-rwset", b"", proposal_pb2.Response(status=200),
+        chaincode_pb2.ChaincodeID(name="cc"), signer,
+    )
+    with pytest.raises(ValueError, match="do not match"):
+        protoutil.create_signed_tx(prop, signer, [resp, resp3])
