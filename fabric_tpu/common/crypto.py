@@ -1,0 +1,191 @@
+"""In-memory certificate authority + cert utilities.
+
+Equivalent of the reference's common/crypto/tlsgen (test CAs, chaincode TLS)
+and the CA core of the cryptogen tool (internal/cryptogen/ca).  ECDSA-P256
+throughout, matching the fabric default.  Also hosts the cert-expiration
+warning helper (reference common/crypto/expiration.go).
+"""
+
+from __future__ import annotations
+
+import datetime
+import hashlib
+
+from cryptography import x509
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric import ec
+from cryptography.x509.oid import ExtendedKeyUsageOID, NameOID
+
+
+def _name(common_name: str, org: str | None = None, ou: str | None = None) -> x509.Name:
+    attrs = [x509.NameAttribute(NameOID.COMMON_NAME, common_name)]
+    if org:
+        attrs.append(x509.NameAttribute(NameOID.ORGANIZATION_NAME, org))
+    if ou:
+        attrs.append(x509.NameAttribute(NameOID.ORGANIZATIONAL_UNIT_NAME, ou))
+    return x509.Name(attrs)
+
+
+def _ski(pub) -> bytes:
+    raw = pub.public_bytes(
+        serialization.Encoding.X962, serialization.PublicFormat.UncompressedPoint
+    )
+    return hashlib.sha256(raw).digest()
+
+
+class CertKeyPair:
+    def __init__(self, cert: x509.Certificate, key: ec.EllipticCurvePrivateKey | None):
+        self.cert = cert
+        self.key = key
+
+    @property
+    def cert_pem(self) -> bytes:
+        return self.cert.public_bytes(serialization.Encoding.PEM)
+
+    @property
+    def key_pem(self) -> bytes:
+        assert self.key is not None
+        return self.key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.PKCS8,
+            serialization.NoEncryption(),
+        )
+
+
+class CA:
+    """Issuing CA. `new_intermediate()` chains; `issue()` creates leaf certs
+    with optional OUs (the hooks NodeOUs classification keys off)."""
+
+    def __init__(
+        self,
+        common_name: str = "ca.example.com",
+        org: str = "example.com",
+        parent: "CA | None" = None,
+        validity_days: int = 3650,
+    ):
+        self.key = ec.generate_private_key(ec.SECP256R1())
+        self.org = org
+        subject = _name(common_name, org)
+        issuer = subject if parent is None else parent.cert.subject
+        sign_key = self.key if parent is None else parent.key
+        now = datetime.datetime.now(datetime.timezone.utc)
+        builder = (
+            x509.CertificateBuilder()
+            .subject_name(subject)
+            .issuer_name(issuer)
+            .public_key(self.key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - datetime.timedelta(minutes=5))
+            .not_valid_after(now + datetime.timedelta(days=validity_days))
+            .add_extension(x509.BasicConstraints(ca=True, path_length=None), critical=True)
+            .add_extension(
+                x509.KeyUsage(
+                    digital_signature=True, key_cert_sign=True, crl_sign=True,
+                    content_commitment=False, key_encipherment=False,
+                    data_encipherment=False, key_agreement=False,
+                    encipher_only=False, decipher_only=False,
+                ),
+                critical=True,
+            )
+            .add_extension(x509.SubjectKeyIdentifier(_ski(self.key.public_key())), critical=False)
+        )
+        self.cert = builder.sign(sign_key, hashes.SHA256())
+        self.parent = parent
+        self._revoked: list[x509.Certificate] = []
+
+    @property
+    def cert_pem(self) -> bytes:
+        return self.cert.public_bytes(serialization.Encoding.PEM)
+
+    def new_intermediate(self, common_name: str = "ica.example.com") -> "CA":
+        return CA(common_name, self.org, parent=self)
+
+    def issue(
+        self,
+        common_name: str,
+        ous: list[str] | None = None,
+        sans: list[str] | None = None,
+        client: bool = True,
+        server: bool = False,
+        validity_days: int = 3650,
+        not_after: datetime.datetime | None = None,
+    ) -> CertKeyPair:
+        key = ec.generate_private_key(ec.SECP256R1())
+        now = datetime.datetime.now(datetime.timezone.utc)
+        na = not_after or (now + datetime.timedelta(days=validity_days))
+        nb = min(now - datetime.timedelta(minutes=5), na - datetime.timedelta(minutes=10))
+        attrs = [x509.NameAttribute(NameOID.COMMON_NAME, common_name)]
+        for ou in ous or []:
+            attrs.append(x509.NameAttribute(NameOID.ORGANIZATIONAL_UNIT_NAME, ou))
+        eku = []
+        if client:
+            eku.append(ExtendedKeyUsageOID.CLIENT_AUTH)
+        if server:
+            eku.append(ExtendedKeyUsageOID.SERVER_AUTH)
+        builder = (
+            x509.CertificateBuilder()
+            .subject_name(x509.Name(attrs))
+            .issuer_name(self.cert.subject)
+            .public_key(key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(nb)
+            .not_valid_after(na)
+            .add_extension(x509.BasicConstraints(ca=False, path_length=None), critical=True)
+            .add_extension(
+                x509.KeyUsage(
+                    digital_signature=True, key_cert_sign=False, crl_sign=False,
+                    content_commitment=False, key_encipherment=False,
+                    data_encipherment=False, key_agreement=False,
+                    encipher_only=False, decipher_only=False,
+                ),
+                critical=True,
+            )
+            .add_extension(x509.SubjectKeyIdentifier(_ski(key.public_key())), critical=False)
+            .add_extension(
+                x509.AuthorityKeyIdentifier.from_issuer_public_key(self.key.public_key()),
+                critical=False,
+            )
+        )
+        if sans:
+            builder = builder.add_extension(
+                x509.SubjectAlternativeName([x509.DNSName(s) for s in sans]), critical=False
+            )
+        if eku:
+            builder = builder.add_extension(x509.ExtendedKeyUsage(eku), critical=False)
+        return CertKeyPair(builder.sign(self.key, hashes.SHA256()), key)
+
+    # -- revocation --------------------------------------------------------
+
+    def revoke(self, cert: x509.Certificate) -> None:
+        self._revoked.append(cert)
+
+    def gen_crl(self) -> bytes:
+        """PEM CRL over everything revoked so far (reference MSPs carry PEM
+        CRLs in FabricMSPConfig.revocation_list)."""
+        now = datetime.datetime.now(datetime.timezone.utc)
+        builder = (
+            x509.CertificateRevocationListBuilder()
+            .issuer_name(self.cert.subject)
+            .last_update(now - datetime.timedelta(minutes=5))
+            .next_update(now + datetime.timedelta(days=365))
+        )
+        for cert in self._revoked:
+            builder = builder.add_revoked_certificate(
+                x509.RevokedCertificateBuilder()
+                .serial_number(cert.serial_number)
+                .revocation_date(now)
+                .build()
+            )
+        return builder.sign(self.key, hashes.SHA256()).public_bytes(
+            serialization.Encoding.PEM
+        )
+
+
+def cert_expiration(pem: bytes) -> datetime.datetime:
+    """Earliest not-after among certs in a PEM bundle (reference
+    common/crypto/expiration.go warns ahead of expiry)."""
+    certs = x509.load_pem_x509_certificates(pem)
+    return min(c.not_valid_after_utc for c in certs)
+
+
+__all__ = ["CA", "CertKeyPair", "cert_expiration"]

@@ -1,0 +1,146 @@
+"""MSP configuration builders.
+
+Reference surface: msp/configbuilder.go (GetLocalMspConfig /
+GetVerifyingMspConfig read the cacerts/ intermediatecerts/ admincerts/
+signcerts/ keystore/ crls/ config.yaml directory layout).  Additionally a
+programmatic builder from an in-memory CA for tests and the devnet — the
+role the reference fills with cryptogen-generated fixtures.
+"""
+
+from __future__ import annotations
+
+import os
+
+import yaml
+
+from fabric_tpu.common.crypto import CA
+from fabric_tpu.protos.msp import msp_config_pb2
+
+ROLE_OUS = {"client": "client", "peer": "peer", "admin": "admin", "orderer": "orderer"}
+
+
+def msp_config_from_ca(
+    ca: CA,
+    mspid: str,
+    node_ous: bool = True,
+    admins: list[bytes] | None = None,
+    intermediates: list[CA] | None = None,
+    crls: list[bytes] | None = None,
+    signer_cert_pem: bytes | None = None,
+    signer_key_pem: bytes | None = None,
+) -> msp_config_pb2.MSPConfig:
+    fconf = msp_config_pb2.FabricMSPConfig(
+        name=mspid,
+        root_certs=[ca.cert_pem],
+        intermediate_certs=[ic.cert_pem for ic in intermediates or []],
+        admins=admins or [],
+        revocation_list=crls or [],
+        crypto_config=msp_config_pb2.FabricCryptoConfig(
+            signature_hash_family="SHA2",
+            identity_identifier_hash_function="SHA256",
+        ),
+    )
+    if node_ous:
+        fconf.fabric_node_ous.enable = True
+        fconf.fabric_node_ous.client_ou_identifier.organizational_unit_identifier = "client"
+        fconf.fabric_node_ous.peer_ou_identifier.organizational_unit_identifier = "peer"
+        fconf.fabric_node_ous.admin_ou_identifier.organizational_unit_identifier = "admin"
+        fconf.fabric_node_ous.orderer_ou_identifier.organizational_unit_identifier = "orderer"
+    if signer_cert_pem:
+        fconf.signing_identity.public_signer = signer_cert_pem
+        fconf.signing_identity.private_signer.key_material = signer_key_pem or b""
+    return msp_config_pb2.MSPConfig(type=0, config=fconf.SerializeToString())
+
+
+def _read_pems(d: str) -> list[bytes]:
+    if not os.path.isdir(d):
+        return []
+    out = []
+    for name in sorted(os.listdir(d)):
+        with open(os.path.join(d, name), "rb") as f:
+            out.append(f.read())
+    return out
+
+
+def load_msp_dir(path: str, mspid: str, load_signer: bool = False) -> msp_config_pb2.MSPConfig:
+    """Read the standard MSP directory layout into an MSPConfig."""
+    fconf = msp_config_pb2.FabricMSPConfig(
+        name=mspid,
+        root_certs=_read_pems(os.path.join(path, "cacerts")),
+        intermediate_certs=_read_pems(os.path.join(path, "intermediatecerts")),
+        admins=_read_pems(os.path.join(path, "admincerts")),
+        revocation_list=_read_pems(os.path.join(path, "crls")),
+        tls_root_certs=_read_pems(os.path.join(path, "tlscacerts")),
+        tls_intermediate_certs=_read_pems(os.path.join(path, "tlsintermediatecerts")),
+        crypto_config=msp_config_pb2.FabricCryptoConfig(
+            signature_hash_family="SHA2",
+            identity_identifier_hash_function="SHA256",
+        ),
+    )
+    cfg_yaml = os.path.join(path, "config.yaml")
+    if os.path.exists(cfg_yaml):
+        with open(cfg_yaml) as f:
+            doc = yaml.safe_load(f) or {}
+        nou = doc.get("NodeOUs") or {}
+        if nou.get("Enable"):
+            fconf.fabric_node_ous.enable = True
+            for key, field in (
+                ("ClientOUIdentifier", fconf.fabric_node_ous.client_ou_identifier),
+                ("PeerOUIdentifier", fconf.fabric_node_ous.peer_ou_identifier),
+                ("AdminOUIdentifier", fconf.fabric_node_ous.admin_ou_identifier),
+                ("OrdererOUIdentifier", fconf.fabric_node_ous.orderer_ou_identifier),
+            ):
+                ident = nou.get(key) or {}
+                field.organizational_unit_identifier = ident.get(
+                    "OrganizationalUnitIdentifier", ""
+                )
+    if load_signer:
+        signcerts = _read_pems(os.path.join(path, "signcerts"))
+        keys = _read_pems(os.path.join(path, "keystore"))
+        if signcerts and keys:
+            fconf.signing_identity.public_signer = signcerts[0]
+            fconf.signing_identity.private_signer.key_material = keys[0]
+    return msp_config_pb2.MSPConfig(type=0, config=fconf.SerializeToString())
+
+
+def write_msp_dir(
+    path: str,
+    ca: CA,
+    node_ous: bool = True,
+    signer_cert_pem: bytes | None = None,
+    signer_key_pem: bytes | None = None,
+) -> None:
+    """Materialize the standard layout on disk (cryptogen's msp/ output)."""
+    os.makedirs(os.path.join(path, "cacerts"), exist_ok=True)
+    with open(os.path.join(path, "cacerts", "ca.pem"), "wb") as f:
+        f.write(ca.cert_pem)
+    if node_ous:
+        with open(os.path.join(path, "config.yaml"), "w") as f:
+            yaml.safe_dump(
+                {
+                    "NodeOUs": {
+                        "Enable": True,
+                        **{
+                            f"{r.capitalize()}OUIdentifier": {
+                                "Certificate": "cacerts/ca.pem",
+                                "OrganizationalUnitIdentifier": ou,
+                            }
+                            for r, ou in (
+                                ("client", "client"), ("peer", "peer"),
+                                ("admin", "admin"), ("orderer", "orderer"),
+                            )
+                        },
+                    }
+                },
+                f,
+            )
+    if signer_cert_pem:
+        os.makedirs(os.path.join(path, "signcerts"), exist_ok=True)
+        os.makedirs(os.path.join(path, "keystore"), exist_ok=True)
+        with open(os.path.join(path, "signcerts", "cert.pem"), "wb") as f:
+            f.write(signer_cert_pem)
+        with open(os.path.join(path, "keystore", "key.pem"), "wb") as f:
+            f.write(signer_key_pem or b"")
+
+
+__all__ = ["msp_config_from_ca", "load_msp_dir", "write_msp_dir"]

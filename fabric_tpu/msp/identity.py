@@ -1,0 +1,85 @@
+"""X.509 identities (reference msp/identities.go).
+
+`Identity.verify` is the single-signature call the reference issues per
+endorsement (msp/identities.go:169-196: hash then bccsp.Verify).  The TPU
+build adds `verification_item` so callers can *collect* instead of verify —
+the whole block's items go to one `CSP.verify_batch` call (SURVEY.md §3.4).
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+from cryptography import x509
+from cryptography.hazmat.primitives import serialization
+from cryptography.x509.oid import NameOID
+
+from fabric_tpu.csp import api as csp_api
+from fabric_tpu.csp.api import ECDSAP256PublicKey, VerifyBatchItem
+from fabric_tpu.protos.msp import identities_pb2
+
+
+def cert_pubkey(cert: x509.Certificate) -> ECDSAP256PublicKey:
+    der = cert.public_key().public_bytes(
+        serialization.Encoding.DER, serialization.PublicFormat.SubjectPublicKeyInfo
+    )
+    return ECDSAP256PublicKey.from_der(der)
+
+
+def cert_ous(cert: x509.Certificate) -> list[str]:
+    return [
+        a.value
+        for a in cert.subject.get_attributes_for_oid(NameOID.ORGANIZATIONAL_UNIT_NAME)
+    ]
+
+
+class Identity:
+    """A deserialized, not-necessarily-valid identity bound to its MSP."""
+
+    def __init__(self, mspid: str, cert: x509.Certificate, csp):
+        self.mspid = mspid
+        self.cert = cert
+        self._csp = csp
+        self.public_key = cert_pubkey(cert)
+        der = cert.public_bytes(serialization.Encoding.DER)
+        # IdentityIdentifier: (mspid, hash of the raw cert) — reference
+        # msp/mspimpl.go getIdentityFromConf.
+        self.id = (mspid, hashlib.sha256(der).hexdigest())
+        self.ous = cert_ous(cert)
+
+    def serialize(self) -> bytes:
+        return identities_pb2.SerializedIdentity(
+            mspid=self.mspid,
+            id_bytes=self.cert.public_bytes(serialization.Encoding.PEM),
+        ).SerializeToString()
+
+    def expires_at(self):
+        return self.cert.not_valid_after_utc
+
+    # -- verification ------------------------------------------------------
+
+    def verify(self, msg: bytes, sig: bytes) -> bool:
+        """Hash + verify (single call; hot paths use verification_item)."""
+        return self._csp.verify(self.public_key, sig, self._csp.hash(msg))
+
+    def verification_item(self, msg: bytes, sig: bytes) -> VerifyBatchItem:
+        """Deferred-verification triple for CSP.verify_batch."""
+        return VerifyBatchItem(self.public_key, hashlib.sha256(msg).digest(), sig)
+
+
+class SigningIdentity(Identity):
+    def __init__(self, mspid: str, cert: x509.Certificate, private_key, csp):
+        super().__init__(mspid, cert, csp)
+        self._key = private_key  # csp_api.ECDSAP256PrivateKey
+
+    def sign(self, msg: bytes) -> bytes:
+        return self._csp.sign(self._key, self._csp.hash(msg))
+
+    @classmethod
+    def from_pem(cls, mspid: str, cert_pem: bytes, key_pem: bytes, csp):
+        cert = x509.load_pem_x509_certificates(cert_pem)[0]
+        key = csp_api.ECDSAP256PrivateKey.from_pem(key_pem)
+        return cls(mspid, cert, key, csp)
+
+
+__all__ = ["Identity", "SigningIdentity", "cert_pubkey", "cert_ous"]

@@ -1,0 +1,33 @@
+"""Shared test fixture: in-memory organizations with CAs and identities
+(the role cryptogen-generated fixtures play in the reference's tests)."""
+
+from __future__ import annotations
+
+import dataclasses
+
+from fabric_tpu.common.crypto import CA, CertKeyPair
+from fabric_tpu.csp import SWCSP
+from fabric_tpu.msp import MSP, SigningIdentity, msp_config_from_ca
+
+
+@dataclasses.dataclass
+class Org:
+    mspid: str
+    ca: CA
+    msp: MSP
+    csp: SWCSP
+
+    def signer(self, name: str, role_ou: str = "peer") -> SigningIdentity:
+        pair = self.ca.issue(name, ous=[role_ou])
+        return SigningIdentity.from_pem(self.mspid, pair.cert_pem, pair.key_pem, self.csp)
+
+    def issue(self, name: str, ous: list[str]) -> CertKeyPair:
+        return self.ca.issue(name, ous=ous)
+
+
+def make_org(mspid: str = "Org1MSP", node_ous: bool = True, admins=None) -> Org:
+    csp = SWCSP()
+    ca = CA(f"ca.{mspid.lower()}.example.com", mspid)
+    conf = msp_config_from_ca(ca, mspid, node_ous=node_ous, admins=admins or [])
+    msp = MSP.from_config(conf, csp)
+    return Org(mspid, ca, msp, csp)

@@ -1,0 +1,138 @@
+"""MSP behavior: deserialize/validate/principals (reference msp/ tests'
+coverage model: valid members, expired/revoked/foreign certs, NodeOU
+classification, admin matching, principal satisfaction)."""
+
+import datetime
+
+import pytest
+
+from fabric_tpu.common.crypto import CA
+from fabric_tpu.csp import SWCSP
+from fabric_tpu.msp import MSP, MSPError, MSPManager, SigningIdentity, msp_config_from_ca
+from fabric_tpu.protos.msp import msp_principal_pb2 as mp
+
+from orgfix import make_org
+
+
+def role_principal(mspid, role):
+    return mp.MSPPrincipal(
+        principal_classification=mp.MSPPrincipal.ROLE,
+        principal=mp.MSPRole(msp_identifier=mspid, role=role).SerializeToString(),
+    )
+
+
+def test_deserialize_validate_roundtrip():
+    org = make_org()
+    signer = org.signer("peer0", role_ou="peer")
+    ident = org.msp.deserialize_identity(signer.serialize())
+    org.msp.validate(ident)
+    assert ident.mspid == "Org1MSP"
+    assert ident.id == signer.id
+    # signature roundtrip through identity verify
+    sig = signer.sign(b"hello")
+    assert ident.verify(b"hello", sig)
+    assert not ident.verify(b"hello2", sig)
+
+
+def test_validate_rejects_foreign_and_expired():
+    org = make_org()
+    other = make_org("Org2MSP")
+    foreign = other.signer("peer0")
+    # foreign cert chains to Org2's CA, not Org1's
+    ident = org.msp.deserialize_identity(
+        foreign.serialize().replace(b"Org2MSP", b"Org1MSP")
+    )
+    with pytest.raises(MSPError, match="chain"):
+        org.msp.validate(ident)
+    # expired cert
+    past = datetime.datetime.now(datetime.timezone.utc) - datetime.timedelta(days=1)
+    pair = org.ca.issue("old", ous=["peer"], not_after=past)
+    expired = SigningIdentity.from_pem("Org1MSP", pair.cert_pem, pair.key_pem, org.csp)
+    with pytest.raises(MSPError, match="validity"):
+        org.msp.validate(expired)
+
+
+def test_crl_revocation():
+    csp = SWCSP()
+    ca = CA("ca.org1", "Org1MSP")
+    pair = ca.issue("peer0", ous=["peer"])
+    ca.revoke(pair.cert)
+    conf = msp_config_from_ca(ca, "Org1MSP", crls=[ca.gen_crl()])
+    msp = MSP.from_config(conf, csp)
+    ident = SigningIdentity.from_pem("Org1MSP", pair.cert_pem, pair.key_pem, csp)
+    with pytest.raises(MSPError, match="revoked"):
+        msp.validate(ident)
+    # a different cert from the same CA stays valid
+    ok = ca.issue("peer1", ous=["peer"])
+    msp.validate(SigningIdentity.from_pem("Org1MSP", ok.cert_pem, ok.key_pem, csp))
+
+
+def test_intermediate_chain():
+    csp = SWCSP()
+    root = CA("root.org1", "Org1MSP")
+    ica = root.new_intermediate("ica.org1")
+    conf = msp_config_from_ca(root, "Org1MSP", intermediates=[ica])
+    msp = MSP.from_config(conf, csp)
+    pair = ica.issue("peer0", ous=["peer"])
+    ident = SigningIdentity.from_pem("Org1MSP", pair.cert_pem, pair.key_pem, csp)
+    msp.validate(ident)
+
+
+def test_node_ou_classification_and_principals():
+    org = make_org()
+    peer = org.signer("peer0", role_ou="peer")
+    client = org.signer("user1", role_ou="client")
+    admin = org.signer("admin1", role_ou="admin")
+    R = mp.MSPRole
+    org.msp.satisfies_principal(peer, role_principal("Org1MSP", R.MEMBER))
+    org.msp.satisfies_principal(peer, role_principal("Org1MSP", R.PEER))
+    with pytest.raises(MSPError):
+        org.msp.satisfies_principal(peer, role_principal("Org1MSP", R.CLIENT))
+    org.msp.satisfies_principal(client, role_principal("Org1MSP", R.CLIENT))
+    org.msp.satisfies_principal(admin, role_principal("Org1MSP", R.ADMIN))
+    with pytest.raises(MSPError):
+        org.msp.satisfies_principal(peer, role_principal("Org1MSP", R.ADMIN))
+    # wrong MSP id
+    with pytest.raises(MSPError, match="MSP"):
+        org.msp.satisfies_principal(peer, role_principal("OtherMSP", R.MEMBER))
+    # identity with no role OU fails NodeOU validation
+    bare = org.ca.issue("norole", ous=[])
+    bare_id = SigningIdentity.from_pem("Org1MSP", bare.cert_pem, bare.key_pem, org.csp)
+    with pytest.raises(MSPError, match="NodeOUs"):
+        org.msp.validate(bare_id)
+
+
+def test_identity_and_ou_and_combined_principals():
+    org = make_org()
+    peer = org.signer("peer0", role_ou="peer")
+    ident_principal = mp.MSPPrincipal(
+        principal_classification=mp.MSPPrincipal.IDENTITY,
+        principal=peer.serialize(),
+    )
+    org.msp.satisfies_principal(peer, ident_principal)
+    ou_principal = mp.MSPPrincipal(
+        principal_classification=mp.MSPPrincipal.ORGANIZATION_UNIT,
+        principal=mp.OrganizationUnit(
+            msp_identifier="Org1MSP", organizational_unit_identifier="peer"
+        ).SerializeToString(),
+    )
+    org.msp.satisfies_principal(peer, ou_principal)
+    comb = mp.MSPPrincipal(
+        principal_classification=mp.MSPPrincipal.COMBINED,
+        principal=mp.CombinedPrincipal(
+            principals=[ident_principal, ou_principal]
+        ).SerializeToString(),
+    )
+    org.msp.satisfies_principal(peer, comb)
+
+
+def test_msp_manager_routing():
+    org1 = make_org("Org1MSP")
+    org2 = make_org("Org2MSP")
+    mgr = MSPManager([org1.msp, org2.msp])
+    s2 = org2.signer("peer0")
+    ident = mgr.deserialize_identity(s2.serialize())
+    assert ident.mspid == "Org2MSP"
+    mgr.validate(ident)
+    with pytest.raises(MSPError, match="unknown"):
+        mgr.get_msp("NopeMSP")
