@@ -1,0 +1,48 @@
+"""Policy engine: signature policies, text DSL, hierarchical manager.
+
+Reference: common/cauthdsl (compiler/evaluator), common/policydsl (text
+parser), common/policies (manager + implicit meta).  All policies speak the
+two-phase prepare/finish protocol so signature verification batches onto
+the TPU data plane (SURVEY.md §7 step 3).
+"""
+
+from fabric_tpu.policies.signature_policy import (
+    PendingEvaluation,
+    PolicyError,
+    SignaturePolicy,
+    n_out_of,
+    signed_by,
+    signed_by_any_member,
+    signed_by_msp_role,
+)
+from fabric_tpu.policies.policydsl import DSLError, from_string
+from fabric_tpu.policies.manager import (
+    BLOCK_VALIDATION,
+    CHANNEL_ADMINS,
+    CHANNEL_READERS,
+    CHANNEL_WRITERS,
+    ImplicitMetaPolicy,
+    Manager,
+    RejectPolicy,
+    manager_from_config_group,
+)
+
+__all__ = [
+    "PendingEvaluation",
+    "PolicyError",
+    "SignaturePolicy",
+    "n_out_of",
+    "signed_by",
+    "signed_by_any_member",
+    "signed_by_msp_role",
+    "DSLError",
+    "from_string",
+    "Manager",
+    "ImplicitMetaPolicy",
+    "RejectPolicy",
+    "manager_from_config_group",
+    "BLOCK_VALIDATION",
+    "CHANNEL_ADMINS",
+    "CHANNEL_READERS",
+    "CHANNEL_WRITERS",
+]

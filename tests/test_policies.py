@@ -1,0 +1,162 @@
+"""Policy engine semantics vs the reference's cauthdsl behavior:
+NOutOf combinatorics, signature dedup, the used[] no-double-spend rule,
+DSL parsing, implicit meta thresholds."""
+
+import pytest
+
+from fabric_tpu.msp import MSPManager
+from fabric_tpu.policies import (
+    ImplicitMetaPolicy,
+    SignaturePolicy,
+    from_string,
+    manager_from_config_group,
+)
+from fabric_tpu.protos.common import configtx_pb2, policies_pb2
+from fabric_tpu.protoutil import SignedData
+
+from orgfix import make_org
+
+
+def sd(signer, msg=b"payload"):
+    return SignedData(msg, signer.serialize(), signer.sign(msg))
+
+
+def bad_sd(signer, msg=b"payload"):
+    return SignedData(msg, signer.serialize(), b"\x30\x03\x02\x01\x01")
+
+
+@pytest.fixture(scope="module")
+def orgs():
+    org1 = make_org("Org1MSP")
+    org2 = make_org("Org2MSP")
+    org3 = make_org("Org3MSP")
+    mgr = MSPManager([org1.msp, org2.msp, org3.msp])
+    return org1, org2, org3, mgr
+
+
+def test_dsl_parse_shapes():
+    env = from_string("AND('Org1MSP.member', OR('Org2MSP.admin', 'Org3MSP.peer'))")
+    assert env.rule.n_out_of.n == 2
+    assert len(env.identities) == 3
+    inner = env.rule.n_out_of.rules[1]
+    assert inner.n_out_of.n == 1
+    env2 = from_string("OutOf(2, 'A.member', 'B.member', 'C.member')")
+    assert env2.rule.n_out_of.n == 2
+    # dedup of repeated principals
+    env3 = from_string("OR('A.member', 'A.member')")
+    assert len(env3.identities) == 1
+    with pytest.raises(Exception):
+        from_string("NAND('A.member')")
+    with pytest.raises(Exception):
+        from_string("OutOf(4, 'A.member')")
+
+
+def test_one_of_and_two_of(orgs):
+    org1, org2, org3, mgr = orgs
+    csp = org1.csp
+    pol = SignaturePolicy(
+        from_string("OR('Org1MSP.member', 'Org2MSP.member')"), mgr
+    )
+    s1 = org1.signer("peer0")
+    s2 = org2.signer("peer0")
+    s3 = org3.signer("peer0")
+    assert pol.evaluate_signed_data([sd(s1)], csp)
+    assert pol.evaluate_signed_data([sd(s2)], csp)
+    assert not pol.evaluate_signed_data([sd(s3)], csp)
+    assert not pol.evaluate_signed_data([bad_sd(s1)], csp)
+
+    and_pol = SignaturePolicy(
+        from_string("AND('Org1MSP.member', 'Org2MSP.member')"), mgr
+    )
+    assert and_pol.evaluate_signed_data([sd(s1), sd(s2)], csp)
+    assert not and_pol.evaluate_signed_data([sd(s1)], csp)
+    # invalid second signature: AND fails even though identity satisfies
+    assert not and_pol.evaluate_signed_data([sd(s1), bad_sd(s2)], csp)
+
+
+def test_same_identity_cannot_satisfy_two_leaves(orgs):
+    """The used[] rule (cauthdsl.go:40-60): one signer cannot count twice
+    for AND('Org1.member','Org1.member')."""
+    org1, _, _, mgr = orgs
+    pol = SignaturePolicy(
+        from_string("AND('Org1MSP.member', 'Org1MSP.member')"), mgr
+    )
+    s1 = org1.signer("peer0")
+    s1b = org1.signer("peer1")
+    # the same signed-data twice dedups to one identity -> fails
+    assert not pol.evaluate_signed_data([sd(s1), sd(s1)], org1.csp)
+    # two distinct org members pass
+    assert pol.evaluate_signed_data([sd(s1), sd(s1b)], org1.csp)
+
+
+def test_three_of_five(orgs):
+    org1, org2, org3, mgr = orgs
+    signers = [org1.signer(f"p{i}") for i in range(3)] + [
+        org2.signer("p3"), org3.signer("p4")
+    ]
+    pol = SignaturePolicy(
+        from_string(
+            "OutOf(3, 'Org1MSP.member', 'Org1MSP.member', 'Org1MSP.member',"
+            " 'Org2MSP.member', 'Org3MSP.member')"
+        ),
+        mgr,
+    )
+    csp = org1.csp
+    assert pol.evaluate_signed_data([sd(s) for s in signers[:3]], csp)
+    assert pol.evaluate_signed_data([sd(signers[0]), sd(signers[3]), sd(signers[4])], csp)
+    assert not pol.evaluate_signed_data([sd(signers[0]), sd(signers[3])], csp)
+    # 3 sigs, one invalid -> only 2 valid -> fail
+    assert not pol.evaluate_signed_data(
+        [sd(signers[0]), sd(signers[3]), bad_sd(signers[4])], csp
+    )
+
+
+def test_prepare_finish_batching_split(orgs):
+    """The two-phase protocol: items collected without verification, then
+    finish() consumes an externally-computed mask."""
+    org1, org2, _, mgr = orgs
+    pol = SignaturePolicy(from_string("AND('Org1MSP.member', 'Org2MSP.member')"), mgr)
+    s1, s2 = org1.signer("x"), org2.signer("y")
+    pending = pol.prepare([sd(s1), sd(s2)])
+    assert len(pending.items) == 2
+    assert pending.finish([True, True])
+    assert not pending.finish([True, False])
+    mask = org1.csp.verify_batch(pending.items)
+    assert pending.finish(mask)
+
+
+def test_implicit_meta_and_manager(orgs):
+    org1, org2, org3, mgr = orgs
+    csp = org1.csp
+
+    def group_with_writers(dsl):
+        g = configtx_pb2.ConfigGroup()
+        g.policies["Writers"].policy.type = policies_pb2.Policy.SIGNATURE
+        g.policies["Writers"].policy.value = from_string(dsl).SerializeToString()
+        return g
+
+    app = configtx_pb2.ConfigGroup()
+    app.groups["Org1"].CopyFrom(group_with_writers("'Org1MSP.member'"))
+    app.groups["Org2"].CopyFrom(group_with_writers("'Org2MSP.member'"))
+    app.groups["Org3"].CopyFrom(group_with_writers("'Org3MSP.member'"))
+    app.policies["Writers"].policy.type = policies_pb2.Policy.IMPLICIT_META
+    app.policies["Writers"].policy.value = policies_pb2.ImplicitMetaPolicy(
+        sub_policy="Writers", rule=policies_pb2.ImplicitMetaPolicy.MAJORITY
+    ).SerializeToString()
+    channel = configtx_pb2.ConfigGroup()
+    channel.groups["Application"].CopyFrom(app)
+
+    mgr_tree = manager_from_config_group("Channel", channel, mgr)
+    pol = mgr_tree.get_policy("/Channel/Application/Writers")
+    s1, s2, s3 = org1.signer("a"), org2.signer("b"), org3.signer("c")
+    # MAJORITY of 3 orgs = 2
+    assert pol.evaluate_signed_data([sd(s1), sd(s2)], csp)
+    assert not pol.evaluate_signed_data([sd(s1)], csp)
+    assert pol.evaluate_signed_data([sd(s1), sd(s2), sd(s3)], csp)
+    # relative lookup from the Application manager
+    app_mgr = mgr_tree.manager(["Application"])
+    assert app_mgr.get_policy("Org1/Writers").evaluate_signed_data([sd(s1)], csp)
+    # unknown policy rejects
+    assert not mgr_tree.get_policy("/Channel/Nope/Writers").evaluate_signed_data(
+        [sd(s1)], csp
+    )
