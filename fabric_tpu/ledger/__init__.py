@@ -1,0 +1,38 @@
+"""Ledger stack: block storage, versioned state, MVCC, history.
+
+Reference: common/ledger (blkstorage, leveldbhelper) + core/ledger
+(kvledger, txmgmt, statedb, history).  See each module's docstring for the
+exact reference surface it mirrors.
+"""
+
+from fabric_tpu.ledger.kvstore import (
+    KVStore,
+    MemKVStore,
+    NamedDB,
+    SqliteKVStore,
+    open_kvstore,
+)
+from fabric_tpu.ledger.statedb import Height, VersionedDB, VersionedValue
+from fabric_tpu.ledger.blkstorage import BlockStore, BlockStoreError
+from fabric_tpu.ledger.history import HistoryDB
+from fabric_tpu.ledger.txmgmt import MVCCValidator, TxSimulator
+from fabric_tpu.ledger.kvledger import KVLedger, LedgerProvider, extract_rwsets
+
+__all__ = [
+    "KVStore",
+    "MemKVStore",
+    "SqliteKVStore",
+    "NamedDB",
+    "open_kvstore",
+    "Height",
+    "VersionedDB",
+    "VersionedValue",
+    "BlockStore",
+    "BlockStoreError",
+    "HistoryDB",
+    "MVCCValidator",
+    "TxSimulator",
+    "KVLedger",
+    "LedgerProvider",
+    "extract_rwsets",
+]

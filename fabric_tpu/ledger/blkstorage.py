@@ -1,0 +1,212 @@
+"""Block storage: append-only block files + KV index.
+
+Reference: common/ledger/blkstorage (blockfile_mgr.go append-only files,
+blockindex.go number/hash/txid indexes, restart recovery via checkpoint +
+tail scan, blocks_itr.go iterators).  Same design: length-prefixed
+serialized blocks in rolling .dat files, an index in the KVStore SPI, and
+crash recovery that re-indexes complete trailing records and truncates a
+torn final write.  `dir=None` keeps blocks in memory (test/ephemeral
+ledgers, the reference's ramledger role).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+
+from fabric_tpu.ledger.kvstore import KVStore, MemKVStore, NamedDB
+from fabric_tpu.protos.common import common_pb2
+from fabric_tpu import protoutil
+
+_LEN = struct.Struct(">I")
+ROLL_SIZE = 64 * 1024 * 1024
+
+
+class BlockStoreError(Exception):
+    pass
+
+
+class BlockStore:
+    def __init__(self, dir: str | None, index_store: KVStore | None = None, name: str = "chain"):
+        self._dir = dir
+        self._index = NamedDB(index_store or MemKVStore(), f"blkindex/{name}")
+        self._lock = threading.RLock()
+        self._mem_blocks: list[bytes] | None = [] if dir is None else None
+        self._height = 0
+        self._last_hash = b""
+        if dir is not None:
+            os.makedirs(dir, exist_ok=True)
+            self._recover()
+        else:
+            self._recover_index_only()
+
+    # -- file plumbing -----------------------------------------------------
+
+    def _file_path(self, idx: int) -> str:
+        return os.path.join(self._dir, f"blocks_{idx:06d}.dat")
+
+    def _checkpoint(self) -> tuple[int, int, int]:
+        """(file_idx, offset_after_last_indexed, height)"""
+        raw = self._index.get(b"cp")
+        if raw is None:
+            return (0, 0, 0)
+        return struct.unpack(">QQQ", raw)  # type: ignore[return-value]
+
+    def _recover_index_only(self) -> None:
+        _, _, self._height = self._checkpoint()
+
+    def _recover(self) -> None:
+        """Re-index any blocks appended after the last checkpoint; truncate
+        a torn trailing record (reference blockfile_helper scanForLastCompleteBlock)."""
+        file_idx, offset, height = self._checkpoint()
+        self._height = height
+        while True:
+            path = self._file_path(file_idx)
+            if not os.path.exists(path):
+                break
+            size = os.path.getsize(path)
+            with open(path, "rb") as f:
+                f.seek(offset)
+                while True:
+                    hdr = f.read(_LEN.size)
+                    if len(hdr) < _LEN.size:
+                        break
+                    (n,) = _LEN.unpack(hdr)
+                    raw = f.read(n)
+                    if len(raw) < n:
+                        break
+                    blk = common_pb2.Block.FromString(raw)
+                    self._index_block(blk, file_idx, offset)
+                    offset += _LEN.size + n
+                    self._height = blk.header.number + 1
+            if offset < size:
+                with open(path, "r+b") as f:
+                    f.truncate(offset)
+            next_path = self._file_path(file_idx + 1)
+            if os.path.exists(next_path):
+                file_idx += 1
+                offset = 0
+            else:
+                break
+        if self._height > 0:
+            last = self.get_block_by_number(self._height - 1)
+            self._last_hash = protoutil.block_header_hash(last.header)
+        self._write_checkpoint(file_idx, offset)
+
+    def _write_checkpoint(self, file_idx: int, offset: int) -> None:
+        self._index.put(b"cp", struct.pack(">QQQ", file_idx, offset, self._height))
+
+    def _index_block(self, blk: common_pb2.Block, file_idx: int, offset: int) -> None:
+        puts = {
+            b"n" + struct.pack(">Q", blk.header.number): struct.pack(">QQ", file_idx, offset),
+            b"h" + protoutil.block_header_hash(blk.header): struct.pack(">Q", blk.header.number),
+        }
+        for pos, raw_env in enumerate(blk.data.data):
+            try:
+                env = common_pb2.Envelope.FromString(raw_env)
+                payload = common_pb2.Payload.FromString(env.payload)
+                chdr = common_pb2.ChannelHeader.FromString(payload.header.channel_header)
+                txid = chdr.tx_id
+            except Exception:
+                continue
+            if txid:
+                key = b"t" + txid.encode()
+                if self._index.get(key) is None:  # first occurrence wins
+                    puts[key] = struct.pack(">QQ", blk.header.number, pos)
+        self._index.write_batch(puts)
+
+    # -- public API --------------------------------------------------------
+
+    @property
+    def height(self) -> int:
+        return self._height
+
+    @property
+    def last_block_hash(self) -> bytes:
+        return self._last_hash
+
+    def info(self):
+        return {"height": self._height, "currentBlockHash": self._last_hash}
+
+    def add_block(self, blk: common_pb2.Block) -> None:
+        with self._lock:
+            if blk.header.number != self._height:
+                raise BlockStoreError(
+                    f"block number {blk.header.number} != expected {self._height}"
+                )
+            raw = blk.SerializeToString()
+            if self._mem_blocks is not None:
+                self._mem_blocks.append(raw)
+                self._index_block(blk, 0, len(self._mem_blocks) - 1)
+                self._height += 1
+                self._write_checkpoint(0, len(self._mem_blocks))
+            else:
+                file_idx, offset, _ = self._checkpoint()
+                if offset > ROLL_SIZE:
+                    file_idx += 1
+                    offset = 0
+                path = self._file_path(file_idx)
+                with open(path, "ab") as f:
+                    if f.tell() != offset:
+                        f.seek(offset)
+                    f.write(_LEN.pack(len(raw)))
+                    f.write(raw)
+                    f.flush()
+                    os.fsync(f.fileno())
+                self._index_block(blk, file_idx, offset)
+                self._height += 1
+                self._write_checkpoint(file_idx, offset + _LEN.size + len(raw))
+            self._last_hash = protoutil.block_header_hash(blk.header)
+
+    def get_block_by_number(self, num: int) -> common_pb2.Block | None:
+        if num >= self._height:
+            return None
+        loc = self._index.get(b"n" + struct.pack(">Q", num))
+        if loc is None:
+            return None
+        file_idx, offset = struct.unpack(">QQ", loc)
+        if self._mem_blocks is not None:
+            return common_pb2.Block.FromString(self._mem_blocks[offset])
+        with open(self._file_path(file_idx), "rb") as f:
+            f.seek(offset)
+            (n,) = _LEN.unpack(f.read(_LEN.size))
+            return common_pb2.Block.FromString(f.read(n))
+
+    def get_block_by_hash(self, block_hash: bytes) -> common_pb2.Block | None:
+        raw = self._index.get(b"h" + block_hash)
+        if raw is None:
+            return None
+        return self.get_block_by_number(struct.unpack(">Q", raw)[0])
+
+    def get_tx_loc(self, txid: str) -> tuple[int, int] | None:
+        raw = self._index.get(b"t" + txid.encode())
+        if raw is None:
+            return None
+        num, pos = struct.unpack(">QQ", raw)
+        return num, pos
+
+    def get_tx_by_id(self, txid: str) -> common_pb2.Envelope | None:
+        loc = self.get_tx_loc(txid)
+        if loc is None:
+            return None
+        blk = self.get_block_by_number(loc[0])
+        return protoutil.extract_envelope(blk, loc[1])
+
+    def get_tx_validation_code(self, txid: str) -> int | None:
+        loc = self.get_tx_loc(txid)
+        if loc is None:
+            return None
+        blk = self.get_block_by_number(loc[0])
+        flags = protoutil.tx_filter(blk)
+        return flags[loc[1]]
+
+    def iterator(self, start: int = 0):
+        """Blocking-free iterator over existing blocks from `start`."""
+        num = start
+        while num < self._height:
+            yield self.get_block_by_number(num)
+            num += 1
+
+
+__all__ = ["BlockStore", "BlockStoreError"]

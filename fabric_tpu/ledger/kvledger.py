@@ -1,0 +1,189 @@
+"""The peer ledger: block store + state DB + history DB orchestration.
+
+Reference: core/ledger/kvledger/kv_ledger.go:447-530 CommitLegacy
+(ValidateAndPrepare -> block store -> state DB -> history DB), provider in
+kv_ledger_provider.go, recovery-on-open (state/history DBs replay blocks
+newer than their savepoints), ledgermgmt/ledger_mgmt.go lifecycle.
+"""
+
+from __future__ import annotations
+
+import os
+
+from fabric_tpu.ledger.blkstorage import BlockStore
+from fabric_tpu.ledger.history import HistoryDB
+from fabric_tpu.ledger.kvstore import KVStore, MemKVStore, open_kvstore
+from fabric_tpu.ledger.statedb import Height, VersionedDB
+from fabric_tpu.ledger.txmgmt import MVCCValidator, TxSimulator, VALID
+from fabric_tpu.protos.common import common_pb2
+from fabric_tpu.protos.ledger.rwset import rwset_pb2
+from fabric_tpu.protos.ledger.rwset.kvrwset import kv_rwset_pb2
+from fabric_tpu import protoutil
+
+
+def extract_rwsets(block: common_pb2.Block) -> list[bytes | None]:
+    """Per-tx marshaled TxReadWriteSet for endorser txs (None otherwise)."""
+    out: list[bytes | None] = []
+    for i in range(len(block.data.data)):
+        raw = None
+        try:
+            env = protoutil.extract_envelope(block, i)
+            payload = common_pb2.Payload.FromString(env.payload)
+            chdr = common_pb2.ChannelHeader.FromString(payload.header.channel_header)
+            if chdr.type == common_pb2.ENDORSER_TRANSACTION:
+                _, action = protoutil.get_action_from_envelope(env)
+                raw = action.results
+        except Exception:
+            raw = None
+        out.append(raw)
+    return out
+
+
+def _history_writes(rwsets: list[bytes | None], flags: list[int]):
+    """Per-tx (ns, key) write lists for the history index (valid txs only)."""
+    writes_per_tx: list[list[tuple[str, str]]] = [[] for _ in flags]
+    for tx_num, raw in enumerate(rwsets):
+        if flags[tx_num] != VALID or raw is None:
+            continue
+        try:
+            txrw = rwset_pb2.TxReadWriteSet.FromString(raw)
+            for nsrw in txrw.ns_rwset:
+                kvrw = kv_rwset_pb2.KVRWSet.FromString(nsrw.rwset)
+                writes_per_tx[tx_num].extend(
+                    (nsrw.namespace, w.key) for w in kvrw.writes
+                )
+        except Exception:
+            continue
+    return writes_per_tx
+
+
+class KVLedger:
+    """One channel's ledger (reference ledger.PeerLedger,
+    core/ledger/ledger_interface.go:142)."""
+
+    def __init__(self, ledger_id: str, block_store: BlockStore, kv: KVStore):
+        self.ledger_id = ledger_id
+        self._blocks = block_store
+        self._state = VersionedDB(kv, f"statedb/{ledger_id}")
+        self._history = HistoryDB(kv, f"historydb/{ledger_id}")
+        self._mvcc = MVCCValidator(self._state)
+        self._recover()
+
+    # -- recovery (reference recoverDBs / syncStateAndHistoryDBWithBlockstore)
+
+    def _recover(self) -> None:
+        height = self._blocks.height
+        sp = self._state.savepoint()
+        first = 0 if sp is None else sp.block_num + 1
+        for num in range(first, height):
+            block = self._blocks.get_block_by_number(num)
+            self._apply_state_updates(block)
+
+    def _apply_state_updates(self, block: common_pb2.Block) -> None:
+        flags = list(protoutil.tx_filter(block))
+        rwsets = extract_rwsets(block)
+        # replay trusts the recorded validation flags; MVCC re-application
+        # is deterministic because only VALID txs contribute writes
+        batch = self._mvcc.validate_and_prepare(block.header.number, rwsets, flags)
+        self._state.apply_updates(batch, Height(block.header.number, len(flags)))
+        self._history.commit(
+            block.header.number, _history_writes(rwsets, flags)
+        )
+
+    # -- commit path (reference kv_ledger.go:447 CommitLegacy) -------------
+
+    def commit(self, block: common_pb2.Block) -> None:
+        """MVCC-validate (updating the tx filter), persist block, apply
+        state + history.  Signature/policy flags must already be set by the
+        txvalidator; this adds the MVCC codes."""
+        flags = list(protoutil.tx_filter(block))
+        rwsets = extract_rwsets(block)
+        batch = self._mvcc.validate_and_prepare(block.header.number, rwsets, flags)
+        protoutil.set_tx_filter(block, flags)
+        self._blocks.add_block(block)
+        self._state.apply_updates(batch, Height(block.header.number, len(flags)))
+        self._history.commit(
+            block.header.number, _history_writes(rwsets, flags)
+        )
+
+    # -- queries -----------------------------------------------------------
+
+    @property
+    def height(self) -> int:
+        return self._blocks.height
+
+    def get_blockchain_info(self):
+        return self._blocks.info()
+
+    def get_block_by_number(self, num: int):
+        return self._blocks.get_block_by_number(num)
+
+    def get_block_by_hash(self, h: bytes):
+        return self._blocks.get_block_by_hash(h)
+
+    def get_tx_by_id(self, txid: str):
+        return self._blocks.get_tx_by_id(txid)
+
+    def get_tx_validation_code(self, txid: str):
+        return self._blocks.get_tx_validation_code(txid)
+
+    def tx_id_exists(self, txid: str) -> bool:
+        return self._blocks.get_tx_loc(txid) is not None
+
+    def new_tx_simulator(self) -> TxSimulator:
+        return TxSimulator(self._state)
+
+    def get_state(self, ns: str, key: str) -> bytes | None:
+        vv = self._state.get_state(ns, key)
+        return vv.value if vv else None
+
+    def get_state_range(self, ns: str, start: str, end: str):
+        for key, vv in self._state.get_state_range(ns, start, end):
+            yield key, vv.value
+
+    def get_history_for_key(self, ns: str, key: str):
+        return self._history.get_history_for_key(ns, key)
+
+
+class LedgerProvider:
+    """Opens/creates per-channel ledgers under one root (reference
+    kv_ledger_provider.go + ledgermgmt)."""
+
+    def __init__(self, root_dir: str | None = None):
+        self._root = root_dir
+        if root_dir is None:
+            self._kv = MemKVStore()
+        else:
+            os.makedirs(root_dir, exist_ok=True)
+            self._kv = open_kvstore(os.path.join(root_dir, "index.sqlite"))
+        self._ledgers: dict[str, KVLedger] = {}
+
+    def create(self, genesis_block: common_pb2.Block) -> KVLedger:
+        """Create from a genesis block (ledger id = channel id inside)."""
+        env = protoutil.extract_envelope(genesis_block, 0)
+        payload = common_pb2.Payload.FromString(env.payload)
+        chdr = common_pb2.ChannelHeader.FromString(payload.header.channel_header)
+        ledger = self.open(chdr.channel_id)
+        if ledger.height == 0:
+            ledger.commit(genesis_block)
+        return ledger
+
+    def open(self, ledger_id: str) -> KVLedger:
+        if ledger_id in self._ledgers:
+            return self._ledgers[ledger_id]
+        block_dir = (
+            None if self._root is None else os.path.join(self._root, ledger_id, "chains")
+        )
+        store = BlockStore(block_dir, self._kv, name=ledger_id)
+        ledger = KVLedger(ledger_id, store, self._kv)
+        self._ledgers[ledger_id] = ledger
+        return ledger
+
+    def list(self) -> list[str]:
+        return sorted(self._ledgers)
+
+    def close(self) -> None:
+        self._kv.close()
+
+
+__all__ = ["KVLedger", "LedgerProvider", "extract_rwsets"]

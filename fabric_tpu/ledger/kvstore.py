@@ -1,0 +1,180 @@
+"""Key-value store SPI + implementations.
+
+Equivalent of the reference's common/ledger/util/leveldbhelper (a shared
+goleveldb wrapper with db-name prefixing, batches and range iterators).
+goleveldb has no Python counterpart in this image, so the durable backend
+is sqlite (WAL mode, ordered BLOB keys give the same range-scan
+contract); an in-memory impl serves tests and ephemeral ledgers.
+"""
+
+from __future__ import annotations
+
+import bisect
+import os
+import sqlite3
+import threading
+from typing import Iterator
+
+
+class KVStore:
+    """Ordered byte-key store. Iteration is over a half-open [start, end)
+    range in lexicographic key order, like leveldb iterators."""
+
+    def get(self, key: bytes) -> bytes | None:
+        raise NotImplementedError
+
+    def write_batch(self, puts: dict[bytes, bytes], deletes=()) -> None:
+        raise NotImplementedError
+
+    def put(self, key: bytes, value: bytes) -> None:
+        self.write_batch({key: value})
+
+    def delete(self, key: bytes) -> None:
+        self.write_batch({}, [key])
+
+    def iterate(self, start: bytes = b"", end: bytes | None = None) -> Iterator[tuple[bytes, bytes]]:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class MemKVStore(KVStore):
+    def __init__(self) -> None:
+        self._data: dict[bytes, bytes] = {}
+        self._keys: list[bytes] = []
+        self._lock = threading.RLock()
+
+    def get(self, key: bytes) -> bytes | None:
+        with self._lock:
+            return self._data.get(key)
+
+    def write_batch(self, puts, deletes=()) -> None:
+        with self._lock:
+            for k, v in puts.items():
+                if k not in self._data:
+                    bisect.insort(self._keys, k)
+                self._data[k] = v
+            for k in deletes:
+                if k in self._data:
+                    del self._data[k]
+                    i = bisect.bisect_left(self._keys, k)
+                    if i < len(self._keys) and self._keys[i] == k:
+                        self._keys.pop(i)
+
+    def iterate(self, start: bytes = b"", end: bytes | None = None):
+        with self._lock:
+            i = bisect.bisect_left(self._keys, start)
+            keys = []
+            while i < len(self._keys):
+                k = self._keys[i]
+                if end is not None and k >= end:
+                    break
+                keys.append(k)
+                i += 1
+            snapshot = [(k, self._data[k]) for k in keys]
+        yield from snapshot
+
+
+class SqliteKVStore(KVStore):
+    """Durable backend. One table of BLOB key/value; WAL journaling gives
+    atomic batch commits (the recovery property blkstorage/kvledger rely
+    on, reference blockfile checkpoints + leveldb atomicity)."""
+
+    def __init__(self, path: str):
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._conn.execute("PRAGMA journal_mode=WAL")
+        self._conn.execute("PRAGMA synchronous=NORMAL")
+        self._conn.execute(
+            "CREATE TABLE IF NOT EXISTS kv (k BLOB PRIMARY KEY, v BLOB NOT NULL)"
+        )
+        self._conn.commit()
+        self._lock = threading.RLock()
+
+    def get(self, key: bytes) -> bytes | None:
+        with self._lock:
+            row = self._conn.execute("SELECT v FROM kv WHERE k = ?", (key,)).fetchone()
+        return None if row is None else row[0]
+
+    def write_batch(self, puts, deletes=()) -> None:
+        with self._lock:
+            with self._conn:
+                self._conn.executemany(
+                    "INSERT INTO kv(k, v) VALUES(?, ?) "
+                    "ON CONFLICT(k) DO UPDATE SET v = excluded.v",
+                    [(k, v) for k, v in puts.items()],
+                )
+                self._conn.executemany(
+                    "DELETE FROM kv WHERE k = ?", [(k,) for k in deletes]
+                )
+
+    def iterate(self, start: bytes = b"", end: bytes | None = None):
+        with self._lock:
+            if end is None:
+                rows = self._conn.execute(
+                    "SELECT k, v FROM kv WHERE k >= ? ORDER BY k", (start,)
+                ).fetchall()
+            else:
+                rows = self._conn.execute(
+                    "SELECT k, v FROM kv WHERE k >= ? AND k < ? ORDER BY k",
+                    (start, end),
+                ).fetchall()
+        yield from rows
+
+    def close(self) -> None:
+        self._conn.close()
+
+
+class NamedDB(KVStore):
+    """A prefixed view over a shared store — the reference's
+    leveldbhelper.Provider GetDBHandle(dbName) pattern."""
+
+    _SEP = b"\x00\xff"
+
+    def __init__(self, base: KVStore, name: str):
+        self._base = base
+        self._prefix = name.encode() + self._SEP
+
+    def _k(self, key: bytes) -> bytes:
+        return self._prefix + key
+
+    def get(self, key: bytes) -> bytes | None:
+        return self._base.get(self._k(key))
+
+    def write_batch(self, puts, deletes=()) -> None:
+        self._base.write_batch(
+            {self._k(k): v for k, v in puts.items()}, [self._k(k) for k in deletes]
+        )
+
+    def iterate(self, start: bytes = b"", end: bytes | None = None):
+        pend = self._prefix + end if end is not None else _prefix_end(self._prefix)
+        for k, v in self._base.iterate(self._prefix + start, pend):
+            yield k[len(self._prefix):], v
+
+
+def _prefix_end(prefix: bytes) -> bytes | None:
+    """Smallest key greater than every key with this prefix."""
+    p = bytearray(prefix)
+    while p:
+        if p[-1] != 0xFF:
+            p[-1] += 1
+            return bytes(p)
+        p.pop()
+    return None
+
+
+def open_kvstore(path: str | None) -> KVStore:
+    """None/':memory:' -> MemKVStore, else sqlite at path."""
+    if path in (None, ":memory:"):
+        return MemKVStore()
+    return SqliteKVStore(path)
+
+
+__all__ = [
+    "KVStore",
+    "MemKVStore",
+    "SqliteKVStore",
+    "NamedDB",
+    "open_kvstore",
+]

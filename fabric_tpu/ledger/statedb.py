@@ -1,0 +1,114 @@
+"""Versioned state database.
+
+Reference SPI: core/ledger/kvledger/txmgmt/statedb/statedb.go:29
+(VersionedDB: GetState/GetStateMultipleKeys/GetStateRangeScanIterator/
+ApplyUpdates with a savepoint height).  Backend here is the KVStore SPI
+(stateleveldb equivalent); a CouchDB-style rich-query backend can slot in
+behind the same interface later.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import struct
+
+from fabric_tpu.ledger.kvstore import KVStore, NamedDB
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class Height:
+    """Commit height (block, tx) — the MVCC version (reference
+    txmgmt/version/version.go)."""
+
+    block_num: int
+    tx_num: int
+
+    def pack(self) -> bytes:
+        return struct.pack(">QQ", self.block_num, self.tx_num)
+
+    @classmethod
+    def unpack(cls, raw: bytes) -> "Height":
+        b, t = struct.unpack(">QQ", raw)
+        return cls(b, t)
+
+
+@dataclasses.dataclass
+class VersionedValue:
+    value: bytes
+    version: Height
+    metadata: bytes = b""
+
+
+_NS_SEP = b"\x00"
+_SAVEPOINT_KEY = b"\x01savepoint"
+
+
+def _state_key(ns: str, key: str) -> bytes:
+    return b"\x02" + ns.encode() + _NS_SEP + key.encode()
+
+
+def _encode_value(vv: VersionedValue) -> bytes:
+    return (
+        vv.version.pack()
+        + struct.pack(">I", len(vv.metadata))
+        + vv.metadata
+        + vv.value
+    )
+
+
+def _decode_value(raw: bytes) -> VersionedValue:
+    version = Height.unpack(raw[:16])
+    (mlen,) = struct.unpack(">I", raw[16:20])
+    metadata = raw[20 : 20 + mlen]
+    return VersionedValue(raw[20 + mlen :], version, metadata)
+
+
+class VersionedDB:
+    """KV-backed versioned state (reference stateleveldb.VersionedDB)."""
+
+    def __init__(self, store: KVStore, name: str = "statedb"):
+        self._db = NamedDB(store, name)
+
+    def get_state(self, ns: str, key: str) -> VersionedValue | None:
+        raw = self._db.get(_state_key(ns, key))
+        return None if raw is None else _decode_value(raw)
+
+    def get_version(self, ns: str, key: str) -> Height | None:
+        vv = self.get_state(ns, key)
+        return None if vv is None else vv.version
+
+    def get_state_multiple(self, ns: str, keys) -> list[VersionedValue | None]:
+        return [self.get_state(ns, k) for k in keys]
+
+    def get_state_range(self, ns: str, start_key: str, end_key: str):
+        """Iterate (key, VersionedValue) over [start, end); empty end = open."""
+        start = _state_key(ns, start_key)
+        if end_key:
+            end = _state_key(ns, end_key)
+        else:
+            end = b"\x02" + ns.encode() + b"\x01"  # past the \x00 separator
+        prefix_len = len(b"\x02" + ns.encode() + _NS_SEP)
+        for k, v in self._db.iterate(start, end):
+            yield k[prefix_len:].decode(), _decode_value(v)
+
+    def apply_updates(self, batch: dict, height: Height | None) -> None:
+        """batch: {ns: {key: VersionedValue | None}} (None = delete).
+        Atomic with the savepoint write (reference ApplyUpdates)."""
+        puts: dict[bytes, bytes] = {}
+        deletes = []
+        for ns, kvs in batch.items():
+            for key, vv in kvs.items():
+                if vv is None:
+                    deletes.append(_state_key(ns, key))
+                else:
+                    puts[_state_key(ns, key)] = _encode_value(vv)
+        if height is not None:
+            puts[_SAVEPOINT_KEY] = height.pack()
+        self._db.write_batch(puts, deletes)
+
+    def savepoint(self) -> Height | None:
+        raw = self._db.get(_SAVEPOINT_KEY)
+        return None if raw is None else Height.unpack(raw)
+
+
+__all__ = ["Height", "VersionedValue", "VersionedDB"]

@@ -1,0 +1,216 @@
+"""Transaction management: simulation (rwset building) + MVCC validation.
+
+Reference surface: core/ledger/kvledger/txmgmt —
+  * rwsetutil: TxReadWriteSet build/parse (rwsetutil/rwset_builder.go)
+  * validation: validateAndPrepareBatch / validateKVRead / validateRangeQuery
+    (validation/validator.go:82-260)
+  * lockbased_txmgr: the simulator handed to the endorser.
+
+The MVCC pass itself is host work (string keys, variable shapes — not
+device-friendly); the TPU win upstream is that by the time blocks reach
+MVCC, all signature checks already ran as one batch.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from fabric_tpu.ledger.statedb import Height, VersionedDB, VersionedValue
+from fabric_tpu.protos.ledger.rwset import rwset_pb2
+from fabric_tpu.protos.ledger.rwset.kvrwset import kv_rwset_pb2
+from fabric_tpu.protos.peer import transaction_pb2
+
+VALID = transaction_pb2.VALID
+MVCC_READ_CONFLICT = transaction_pb2.MVCC_READ_CONFLICT
+PHANTOM_READ_CONFLICT = transaction_pb2.PHANTOM_READ_CONFLICT
+BAD_RWSET = transaction_pb2.BAD_RWSET
+
+
+def _version_proto(h: Height | None):
+    if h is None:
+        return None
+    return kv_rwset_pb2.Version(block_num=h.block_num, tx_num=h.tx_num)
+
+
+def _height_of(v: kv_rwset_pb2.Version | None) -> Height | None:
+    if v is None:
+        return None
+    return Height(v.block_num, v.tx_num)
+
+
+class TxSimulator:
+    """Collects a read-write set while chaincode reads/writes state
+    (reference TxSimulator, core/ledger/ledger_interface.go:270)."""
+
+    def __init__(self, db: VersionedDB):
+        self._db = db
+        self._reads: dict[tuple[str, str], Height | None] = {}
+        self._writes: dict[tuple[str, str], bytes | None] = {}
+        self._range_queries: list[kv_rwset_pb2.RangeQueryInfo] = []
+        self._done = False
+
+    def get_state(self, ns: str, key: str) -> bytes | None:
+        if (ns, key) in self._writes:
+            return self._writes[(ns, key)]
+        vv = self._db.get_state(ns, key)
+        self._reads.setdefault((ns, key), vv.version if vv else None)
+        return vv.value if vv else None
+
+    def set_state(self, ns: str, key: str, value: bytes) -> None:
+        self._writes[(ns, key)] = value
+
+    def delete_state(self, ns: str, key: str) -> None:
+        self._writes[(ns, key)] = None
+
+    def get_state_range(self, ns: str, start: str, end: str):
+        """Returns [(key, value)] and records the range query for phantom
+        detection at validation time."""
+        rqi = kv_rwset_pb2.RangeQueryInfo(start_key=start, end_key=end, itr_exhausted=True)
+        out = []
+        for key, vv in self._db.get_state_range(ns, start, end):
+            rqi.raw_reads.kv_reads.append(
+                kv_rwset_pb2.KVRead(key=key, version=_version_proto(vv.version))
+            )
+            out.append((key, vv.value))
+        self._range_queries.append((ns, rqi))
+        return out
+
+    def get_tx_simulation_results(self) -> bytes:
+        """Marshaled rwset.TxReadWriteSet (public data only for now)."""
+        self._done = True
+        by_ns: dict[str, kv_rwset_pb2.KVRWSet] = {}
+
+        def ns_set(ns: str) -> kv_rwset_pb2.KVRWSet:
+            return by_ns.setdefault(ns, kv_rwset_pb2.KVRWSet())
+
+        for (ns, key), ver in sorted(self._reads.items()):
+            ns_set(ns).reads.append(
+                kv_rwset_pb2.KVRead(key=key, version=_version_proto(ver))
+            )
+        for item in self._range_queries:
+            ns, rqi = item
+            ns_set(ns).range_queries_info.append(rqi)
+        for (ns, key), value in sorted(self._writes.items()):
+            ns_set(ns).writes.append(
+                kv_rwset_pb2.KVWrite(
+                    key=key, is_delete=value is None, value=value or b""
+                )
+            )
+        txrw = rwset_pb2.TxReadWriteSet(data_model=rwset_pb2.TxReadWriteSet.KV)
+        for ns in sorted(by_ns):
+            txrw.ns_rwset.append(
+                rwset_pb2.NsReadWriteSet(
+                    namespace=ns, rwset=by_ns[ns].SerializeToString()
+                )
+            )
+        return txrw.SerializeToString()
+
+
+@dataclasses.dataclass
+class _TxUpdates:
+    writes: dict[tuple[str, str], bytes | None]
+
+
+class MVCCValidator:
+    """Block-level MVCC validation building the state update batch
+    (reference validation/validator.go:82 validateAndPrepareBatch)."""
+
+    def __init__(self, db: VersionedDB):
+        self._db = db
+
+    def _committed_version(self, ns: str, key: str, updates: dict) -> Height | None:
+        if (ns, key) in updates:
+            return updates[(ns, key)]
+        return self._db.get_version(ns, key)
+
+    def validate_and_prepare(
+        self, block_num: int, rwsets: list[bytes | None], flags: list[int]
+    ) -> dict:
+        """rwsets[i]: marshaled TxReadWriteSet of tx i (None = not an
+        endorser tx or already invalid).  Mutates `flags` with MVCC codes;
+        returns the state update batch {ns: {key: VersionedValue|None}}.
+
+        Matches the reference's serial-in-commit-order semantics: a tx sees
+        conflicts against committed state AND the writes of earlier valid
+        txs in the same block."""
+        updated_versions: dict[tuple[str, str], Height] = {}
+        batch: dict[str, dict[str, VersionedValue | None]] = {}
+        for tx_num, raw in enumerate(rwsets):
+            if flags[tx_num] != VALID or raw is None:
+                continue
+            try:
+                txrw = rwset_pb2.TxReadWriteSet.FromString(raw)
+                parsed = [
+                    (ns.namespace, kv_rwset_pb2.KVRWSet.FromString(ns.rwset))
+                    for ns in txrw.ns_rwset
+                ]
+            except Exception:
+                flags[tx_num] = BAD_RWSET
+                continue
+            code = VALID
+            for ns, kvrw in parsed:
+                for read in kvrw.reads:
+                    want = _height_of(read.version) if read.HasField("version") else None
+                    have = self._committed_version(ns, read.key, updated_versions)
+                    if want != have:
+                        code = MVCC_READ_CONFLICT
+                        break
+                if code != VALID:
+                    break
+                for rqi in kvrw.range_queries_info:
+                    if not self._validate_range_query(ns, rqi, updated_versions):
+                        code = PHANTOM_READ_CONFLICT
+                        break
+                if code != VALID:
+                    break
+            flags[tx_num] = code
+            if code != VALID:
+                continue
+            h = Height(block_num, tx_num)
+            for ns, kvrw in parsed:
+                ns_batch = batch.setdefault(ns, {})
+                for w in kvrw.writes:
+                    updated_versions[(ns, w.key)] = h
+                    if w.is_delete:
+                        ns_batch[w.key] = None
+                        updated_versions[(ns, w.key)] = None  # type: ignore[assignment]
+                    else:
+                        ns_batch[w.key] = VersionedValue(w.value, h)
+        return batch
+
+    def _validate_range_query(self, ns: str, rqi, updated_versions) -> bool:
+        """Re-scan and compare against recorded raw reads (reference
+        validateRangeQuery; the Merkle-summary variant is not implemented —
+        simulators here always record raw reads)."""
+        if rqi.WhichOneof("reads_info") == "reads_merkle_hashes":
+            return False
+        current: list[tuple[str, Height | None]] = []
+        seen = set()
+        for key, vv in self._db.get_state_range(ns, rqi.start_key, rqi.end_key):
+            ver = updated_versions.get((ns, key), vv.version)
+            if ver is not None:
+                current.append((key, ver))
+                seen.add(key)
+        # keys created by earlier txs in this block inside the range are
+        # phantoms too
+        for (uns, ukey), uver in updated_versions.items():
+            if uns != ns or ukey in seen or uver is None:
+                continue
+            if rqi.start_key <= ukey and (not rqi.end_key or ukey < rqi.end_key):
+                current.append((ukey, uver))
+        current.sort()
+        recorded = [
+            (r.key, _height_of(r.version) if r.HasField("version") else None)
+            for r in rqi.raw_reads.kv_reads
+        ]
+        return current == recorded
+
+
+__all__ = [
+    "TxSimulator",
+    "MVCCValidator",
+    "VALID",
+    "MVCC_READ_CONFLICT",
+    "PHANTOM_READ_CONFLICT",
+    "BAD_RWSET",
+]

@@ -1,0 +1,240 @@
+"""Ledger stack tests: KV stores, block store + crash recovery, MVCC
+conflict semantics, simulator rwset round trip, ledger reopen recovery
+(reference test model: core/ledger/kvledger tests + blkstorage tests)."""
+
+import os
+import struct
+
+import pytest
+
+from fabric_tpu.ledger import (
+    BlockStore,
+    Height,
+    KVLedger,
+    LedgerProvider,
+    MemKVStore,
+    MVCCValidator,
+    NamedDB,
+    SqliteKVStore,
+    TxSimulator,
+    VersionedDB,
+    VersionedValue,
+)
+from fabric_tpu.ledger.txmgmt import MVCC_READ_CONFLICT, PHANTOM_READ_CONFLICT, VALID
+from fabric_tpu.protos.common import common_pb2
+from fabric_tpu import protoutil
+
+
+@pytest.mark.parametrize("mk", [MemKVStore, None])
+def test_kvstore_contract(tmp_path, mk):
+    store = mk() if mk else SqliteKVStore(str(tmp_path / "kv.sqlite"))
+    store.put(b"a", b"1")
+    store.write_batch({b"b": b"2", b"c": b"3"}, [])
+    assert store.get(b"b") == b"2"
+    assert [k for k, _ in store.iterate(b"a", b"c")] == [b"a", b"b"]
+    store.delete(b"b")
+    assert store.get(b"b") is None
+    assert [k for k, _ in store.iterate()] == [b"a", b"c"]
+    # prefixed views are disjoint
+    db1, db2 = NamedDB(store, "one"), NamedDB(store, "two")
+    db1.put(b"k", b"v1")
+    db2.put(b"k", b"v2")
+    assert db1.get(b"k") == b"v1" and db2.get(b"k") == b"v2"
+    assert [k for k, _ in db1.iterate()] == [b"k"]
+
+
+def _mkblock(num, prev_hash, payloads, channel="ch"):
+    envs = []
+    for i, p in enumerate(payloads):
+        chdr = protoutil.make_channel_header(
+            common_pb2.ENDORSER_TRANSACTION, channel, tx_id=f"tx-{num}-{i}"
+        )
+        shdr = protoutil.make_signature_header(b"creator", b"nonce%d" % i)
+        envs.append(
+            common_pb2.Envelope(
+                payload=protoutil.make_payload_bytes(chdr, shdr, p)
+            )
+        )
+    hdr = common_pb2.BlockHeader(number=num - 1) if num else None
+    blk = common_pb2.Block()
+    blk.header.number = num
+    blk.header.previous_hash = prev_hash
+    for env in envs:
+        blk.data.data.append(env.SerializeToString())
+    blk.header.data_hash = protoutil.block_data_hash(blk.data)
+    protoutil.init_block_metadata(blk)
+    protoutil.set_tx_filter(blk, bytearray(len(envs)))
+    return blk
+
+
+def test_blockstore_roundtrip_and_recovery(tmp_path):
+    d = str(tmp_path / "chains")
+    idx = SqliteKVStore(str(tmp_path / "idx.sqlite"))
+    bs = BlockStore(d, idx)
+    b0 = _mkblock(0, b"", [b"g"])
+    bs.add_block(b0)
+    b1 = _mkblock(1, protoutil.block_header_hash(b0.header), [b"x", b"y"])
+    bs.add_block(b1)
+    assert bs.height == 2
+    assert bs.get_block_by_number(1).header.number == 1
+    assert bs.get_block_by_hash(protoutil.block_header_hash(b1.header)).header.number == 1
+    assert bs.get_tx_loc("tx-1-1") == (1, 1)
+    assert bs.get_tx_by_id("tx-1-0") is not None
+
+    # simulate a torn write: append garbage partial record
+    files = sorted(os.listdir(d))
+    with open(os.path.join(d, files[-1]), "ab") as f:
+        f.write(struct.pack(">I", 9999) + b"partial")
+    bs2 = BlockStore(d, idx)
+    assert bs2.height == 2
+    assert bs2.get_block_by_number(1).header.number == 1
+    # can append after recovery
+    b2 = _mkblock(2, protoutil.block_header_hash(b1.header), [b"z"])
+    bs2.add_block(b2)
+    assert bs2.get_block_by_number(2) is not None
+
+    # recovery with a stale index (checkpoint behind the file)
+    idx2 = SqliteKVStore(str(tmp_path / "idx2.sqlite"))
+    bs3 = BlockStore(d, idx2)
+    assert bs3.height == 3
+    assert bs3.get_tx_loc("tx-2-0") == (2, 0)
+
+
+def test_statedb_versions():
+    db = VersionedDB(MemKVStore())
+    h1 = Height(1, 0)
+    db.apply_updates({"cc": {"a": VersionedValue(b"va", h1), "b": VersionedValue(b"vb", h1)}}, h1)
+    assert db.get_state("cc", "a").value == b"va"
+    assert db.get_version("cc", "b") == h1
+    assert db.savepoint() == h1
+    keys = [k for k, _ in db.get_state_range("cc", "a", "")]
+    assert keys == ["a", "b"]
+    db.apply_updates({"cc": {"a": None}}, Height(2, 0))
+    assert db.get_state("cc", "a") is None
+
+
+def _sim_rwset(db, reads=(), writes=(), ranges=()):
+    sim = TxSimulator(db)
+    for ns, k in reads:
+        sim.get_state(ns, k)
+    for ns, s, e in ranges:
+        sim.get_state_range(ns, s, e)
+    for ns, k, v in writes:
+        sim.set_state(ns, k, v)
+    return sim.get_tx_simulation_results()
+
+
+def test_mvcc_validation_semantics():
+    db = VersionedDB(MemKVStore())
+    mvcc = MVCCValidator(db)
+    h = Height(1, 0)
+    db.apply_updates({"cc": {"k": VersionedValue(b"v1", h)}}, h)
+
+    # tx0 reads k@h and writes k -> valid
+    # tx1 reads k@h again -> MVCC conflict with tx0's write in same block
+    # tx2 reads fresh key (absent) -> valid
+    rw0 = _sim_rwset(db, reads=[("cc", "k")], writes=[("cc", "k", b"v2")])
+    rw1 = _sim_rwset(db, reads=[("cc", "k")], writes=[("cc", "x", b"y")])
+    rw2 = _sim_rwset(db, reads=[("cc", "absent")], writes=[("cc", "n", b"1")])
+    flags = [VALID, VALID, VALID]
+    batch = mvcc.validate_and_prepare(2, [rw0, rw1, rw2], flags)
+    assert flags == [VALID, MVCC_READ_CONFLICT, VALID]
+    assert batch["cc"]["k"].value == b"v2"
+    assert batch["cc"]["k"].version == Height(2, 0)
+    assert "x" not in batch["cc"]  # invalid tx contributes no writes
+    db.apply_updates(batch, Height(2, 2))
+
+    # stale read from before block 2 now conflicts against committed state
+    flags = [VALID]
+    mvcc.validate_and_prepare(3, [rw1], flags)
+    assert flags == [MVCC_READ_CONFLICT]
+
+
+def test_mvcc_phantom_detection():
+    db = VersionedDB(MemKVStore())
+    mvcc = MVCCValidator(db)
+    h = Height(1, 0)
+    db.apply_updates(
+        {"cc": {"a1": VersionedValue(b"1", h), "a3": VersionedValue(b"3", h)}}, h
+    )
+    # tx0 range-scans [a1, a9); tx1 also scanned but tx0 inserts a2 first
+    rw0 = _sim_rwset(db, ranges=[("cc", "a1", "a9")], writes=[("cc", "a2", b"2")])
+    rw1 = _sim_rwset(db, ranges=[("cc", "a1", "a9")], writes=[("cc", "b", b"x")])
+    flags = [VALID, VALID]
+    mvcc.validate_and_prepare(2, [rw0, rw1], flags)
+    assert flags == [VALID, PHANTOM_READ_CONFLICT]
+
+
+def _endorsed_block(num, prev, rwsets, channel="ch"):
+    """Build a block of endorser txs whose ChaincodeAction.results are the
+    given rwset bytes."""
+    from fabric_tpu.protos.peer import (
+        proposal_pb2,
+        proposal_response_pb2,
+        transaction_pb2,
+    )
+
+    envs = []
+    for i, rw in enumerate(rwsets):
+        action = proposal_pb2.ChaincodeAction(results=rw)
+        prp = proposal_response_pb2.ProposalResponsePayload(
+            proposal_hash=b"\x00" * 32, extension=action.SerializeToString()
+        )
+        cap = transaction_pb2.ChaincodeActionPayload(
+            action=transaction_pb2.ChaincodeEndorsedAction(
+                proposal_response_payload=prp.SerializeToString()
+            )
+        )
+        tx = transaction_pb2.Transaction(
+            actions=[transaction_pb2.TransactionAction(payload=cap.SerializeToString())]
+        )
+        chdr = protoutil.make_channel_header(
+            common_pb2.ENDORSER_TRANSACTION, channel, tx_id=f"tx-{num}-{i}"
+        )
+        shdr = protoutil.make_signature_header(b"creator", b"nonce")
+        envs.append(
+            common_pb2.Envelope(
+                payload=protoutil.make_payload_bytes(chdr, shdr, tx.SerializeToString())
+            )
+        )
+    blk = common_pb2.Block()
+    blk.header.number = num
+    blk.header.previous_hash = prev
+    for env in envs:
+        blk.data.data.append(env.SerializeToString())
+    blk.header.data_hash = protoutil.block_data_hash(blk.data)
+    protoutil.init_block_metadata(blk)
+    protoutil.set_tx_filter(blk, bytearray(len(envs)))
+    return blk
+
+
+def test_kvledger_commit_query_history_and_recovery(tmp_path):
+    prov = LedgerProvider(str(tmp_path))
+    ledger = prov.open("ch")
+    db = VersionedDB(MemKVStore())  # scratch db for building rwsets
+    rw_g = _sim_rwset(db, writes=[("cc", "k", b"v0")])
+    b0 = _endorsed_block(0, b"", [rw_g])
+    ledger.commit(b0)
+    assert ledger.get_state("cc", "k") == b"v0"
+
+    sim = ledger.new_tx_simulator()
+    assert sim.get_state("cc", "k") == b"v0"
+    sim.set_state("cc", "k", b"v1")
+    sim.set_state("cc", "k2", b"w")
+    rw1 = sim.get_tx_simulation_results()
+    b1 = _endorsed_block(1, ledger._blocks.last_block_hash, [rw1])
+    ledger.commit(b1)
+    assert ledger.get_state("cc", "k") == b"v1"
+    assert ledger.get_tx_validation_code("tx-1-0") == VALID
+    assert ledger.tx_id_exists("tx-0-0")
+    assert not ledger.tx_id_exists("nope")
+    assert ledger.get_history_for_key("cc", "k") == [(0, 0), (1, 0)]
+
+    prov.close()
+    # reopen: block store + state recover from disk
+    prov2 = LedgerProvider(str(tmp_path))
+    led2 = prov2.open("ch")
+    assert led2.height == 2
+    assert led2.get_state("cc", "k") == b"v1"
+    assert led2.get_history_for_key("cc", "k2") == [(1, 0)]
+    prov2.close()
