@@ -1,0 +1,109 @@
+"""Single-process dev network: solo orderer + one committing peer.
+
+The minimum end-to-end slice (SURVEY.md §7 step 4): one "model running".
+Broadcast -> msgprocessor filters -> solo chain -> blockcutter ->
+blockwriter -> (in-process deliver) -> batched txvalidator -> MVCC ->
+kvledger commit.  Exercises every north-star metric on one chip.
+
+Multi-process deployment splits this same wiring across the gRPC services
+(AtomicBroadcast/Deliver), mirroring internal/peer/node/start.go serve()
+and orderer/common/server/main.go Main().
+"""
+
+from __future__ import annotations
+
+import queue
+
+from fabric_tpu.common.channelconfig import bundle_from_genesis
+from fabric_tpu.csp import factory as csp_factory
+from fabric_tpu.ledger import BlockStore, LedgerProvider
+from fabric_tpu.orderer.blockcutter import BlockCutter
+from fabric_tpu.orderer.blockwriter import BlockWriter
+from fabric_tpu.orderer.msgprocessor import (
+    Classification,
+    StandardChannelProcessor,
+)
+from fabric_tpu.orderer.solo import SoloChain
+from fabric_tpu.peer.committer import Committer
+from fabric_tpu.peer.endorser import Endorser
+from fabric_tpu.peer.txvalidator import TxValidator
+from fabric_tpu.protos.common import common_pb2
+
+
+class DevNode:
+    def __init__(
+        self,
+        genesis: common_pb2.Block,
+        root_dir: str | None = None,
+        csp=None,
+        peer_signer=None,
+        chaincodes: dict | None = None,
+        batch_timeout_s: float | None = None,
+    ):
+        self.csp = csp or csp_factory.get_default()
+        self.bundle = bundle_from_genesis(genesis, self.csp)
+        self.channel_id = self.bundle.channel_id
+
+        # peer side
+        self.provider = LedgerProvider(root_dir)
+        self.ledger = self.provider.create(genesis)
+        self.validator = TxValidator(
+            self.channel_id, self.ledger, self.bundle, self.csp
+        )
+        self.committer = Committer(self.validator, self.ledger)
+        self.endorser = (
+            Endorser(
+                self.channel_id, self.ledger, self.bundle, peer_signer,
+                chaincodes or {}, self.csp,
+            )
+            if peer_signer is not None
+            else None
+        )
+        self._commit_events: queue.Queue = queue.Queue()
+        self.committer.add_commit_listener(
+            lambda blk, flags: self._commit_events.put((blk.header.number, flags))
+        )
+
+        # orderer side
+        oc = self.bundle.orderer_config
+        self._orderer_store = BlockStore(None, name=f"orderer-{self.channel_id}")
+        self._orderer_store.add_block(genesis)
+        self.writer = BlockWriter(self._orderer_store)
+        cutter = BlockCutter.from_orderer_config(oc) if oc else BlockCutter()
+        self.processor = StandardChannelProcessor(self.channel_id, self.bundle, self.csp)
+        timeout = batch_timeout_s if batch_timeout_s is not None else (
+            oc.batch_timeout_s if oc else 2.0
+        )
+        self.chain = SoloChain(
+            cutter, self.writer, timeout, on_block=self._deliver_to_peer
+        )
+        self.chain.start()
+
+    # in-process deliver: orderer block -> fresh copy -> commit pipeline
+    def _deliver_to_peer(self, blk: common_pb2.Block) -> None:
+        copy = common_pb2.Block.FromString(blk.SerializeToString())
+        self.committer.store_block(copy)
+
+    # -- client surface ----------------------------------------------------
+
+    def broadcast(self, env: common_pb2.Envelope) -> None:
+        """AtomicBroadcast.Broadcast equivalent (orderer/common/broadcast)."""
+        kind = self.processor.classify(env)
+        if kind == Classification.NORMAL:
+            seq = self.processor.process_normal_msg(env)
+            self.chain.order(env, seq)
+        elif kind == Classification.CONFIG_UPDATE:
+            raise NotImplementedError("config updates land with the configtx engine")
+        else:
+            self.chain.configure(env, 0)
+
+    def wait_commit(self, timeout: float = 10.0):
+        """Block until the peer commits the next block; returns (num, flags)."""
+        return self._commit_events.get(timeout=timeout)
+
+    def shutdown(self) -> None:
+        self.chain.halt()
+        self.provider.close()
+
+
+__all__ = ["DevNode"]

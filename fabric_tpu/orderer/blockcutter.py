@@ -1,0 +1,58 @@
+"""Batching envelopes into blocks (reference
+orderer/common/blockcutter/blockcutter.go:69 Ordered / :127 Cut).
+
+Triggers: message count, preferred byte size, oversized-message isolation.
+Timeout-based cutting is the consenter's job (it calls `cut()` on timer),
+matching the reference's division of labor.
+"""
+
+from __future__ import annotations
+
+
+class BlockCutter:
+    def __init__(
+        self,
+        max_message_count: int = 500,
+        preferred_max_bytes: int = 2 * 1024 * 1024,
+        absolute_max_bytes: int = 10 * 1024 * 1024,
+    ):
+        self.max_message_count = max_message_count
+        self.preferred_max_bytes = preferred_max_bytes
+        self.absolute_max_bytes = absolute_max_bytes
+        self._pending: list[bytes] = []
+        self._pending_bytes = 0
+
+    @classmethod
+    def from_orderer_config(cls, oc) -> "BlockCutter":
+        return cls(oc.max_message_count, oc.preferred_max_bytes, oc.absolute_max_bytes)
+
+    def ordered(self, env_bytes: bytes) -> tuple[list[list[bytes]], bool]:
+        """Enqueue one message; returns (cut batches, pending remains)."""
+        batches: list[list[bytes]] = []
+        size = len(env_bytes)
+        if size > self.preferred_max_bytes:
+            # isolate oversized messages into their own block
+            if self._pending:
+                batches.append(self.cut())
+            batches.append([env_bytes])
+            return batches, False
+        if self._pending_bytes + size > self.preferred_max_bytes and self._pending:
+            batches.append(self.cut())
+        self._pending.append(env_bytes)
+        self._pending_bytes += size
+        if len(self._pending) >= self.max_message_count:
+            batches.append(self.cut())
+        return batches, bool(self._pending)
+
+    def cut(self) -> list[bytes]:
+        batch = self._pending
+        self._pending = []
+        self._pending_bytes = 0
+        return batch
+
+    @property
+    def pending(self) -> bool:
+        return bool(self._pending)
+
+
+__all__ = ["BlockCutter"]

@@ -1,0 +1,86 @@
+"""Block assembly + signing on the ordering node.
+
+Reference: orderer/common/multichannel/blockwriter.go (CreateNextBlock,
+WriteBlock: SIGNATURES metadata carrying OrdererBlockMetadata with the
+last-config index, signed by the orderer's identity).
+"""
+
+from __future__ import annotations
+
+from fabric_tpu.ledger.blkstorage import BlockStore
+from fabric_tpu.protos.common import common_pb2
+from fabric_tpu import protoutil
+
+
+class BlockWriter:
+    def __init__(self, store: BlockStore, signer=None, last_config_index: int = 0):
+        self._store = store
+        self._signer = signer  # SigningIdentity or None (dev)
+        self._last_config_index = last_config_index
+
+    @property
+    def height(self) -> int:
+        return self._store.height
+
+    def create_next_block(self, env_bytes_batch: list[bytes]) -> common_pb2.Block:
+        if self._store.height == 0:
+            prev_hash = b""
+            number = 0
+        else:
+            prev = self._store.get_block_by_number(self._store.height - 1)
+            prev_hash = protoutil.block_header_hash(prev.header)
+            number = prev.header.number + 1
+        blk = protoutil.new_block(number, prev_hash)
+        for raw in env_bytes_batch:
+            blk.data.data.append(raw)
+        blk.header.data_hash = protoutil.block_data_hash(blk.data)
+        return blk
+
+    def write_block(self, blk: common_pb2.Block, is_config: bool = False) -> None:
+        if is_config:
+            self._last_config_index = blk.header.number
+        obm = common_pb2.OrdererBlockMetadata()
+        obm.last_config.index = self._last_config_index
+        meta = common_pb2.Metadata(value=obm.SerializeToString())
+        if self._signer is not None:
+            shdr = protoutil.make_signature_header(
+                self._signer.serialize(), protoutil.random_nonce()
+            ).SerializeToString()
+            # signature covers metadata value || sig header || block header
+            msg = (
+                meta.value + shdr + protoutil.block_header_bytes(blk.header)
+            )
+            meta.signatures.append(
+                common_pb2.MetadataSignature(
+                    signature_header=shdr, signature=self._signer.sign(msg)
+                )
+            )
+        protoutil.init_block_metadata(blk)
+        blk.metadata.metadata[common_pb2.SIGNATURES] = meta.SerializeToString()
+        protoutil.set_tx_filter(blk, bytes(len(blk.data.data)))
+        self._store.add_block(blk)
+
+
+def verify_block_signature(blk: common_pb2.Block, policy, csp) -> bool:
+    """Deliver-client side check of the orderer block signature against the
+    channel's BlockValidation policy (reference
+    internal/pkg/peer/blocksprovider + orderer/common/cluster/util.go)."""
+    from fabric_tpu.protoutil import SignedData
+
+    try:
+        meta = common_pb2.Metadata.FromString(
+            blk.metadata.metadata[common_pb2.SIGNATURES]
+        )
+    except Exception:
+        return False
+    if not meta.signatures:
+        return False
+    signed = []
+    for ms in meta.signatures:
+        shdr = common_pb2.SignatureHeader.FromString(ms.signature_header)
+        msg = meta.value + ms.signature_header + protoutil.block_header_bytes(blk.header)
+        signed.append(SignedData(msg, shdr.creator, ms.signature))
+    return policy.evaluate_signed_data(signed, csp)
+
+
+__all__ = ["BlockWriter", "verify_block_signature"]

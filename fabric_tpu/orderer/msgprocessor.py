@@ -1,0 +1,86 @@
+"""Broadcast-side message processing: classification + filter pipeline.
+
+Reference: orderer/common/msgprocessor (standardchannel.go:100
+ProcessNormalMsg runs the rule set; sigfilter.go evaluates the channel
+Writers policy over the envelope signature; sizefilter.go enforces
+absolute_max_bytes; expiration.go rejects expired creator certs).
+"""
+
+from __future__ import annotations
+
+import datetime
+import enum
+
+from cryptography import x509
+
+from fabric_tpu.protos.common import common_pb2
+from fabric_tpu.protos.msp import identities_pb2
+from fabric_tpu.protoutil import SignedData
+
+
+class Classification(enum.Enum):
+    NORMAL = 0
+    CONFIG_UPDATE = 1
+    CONFIG = 2
+
+
+class MsgProcessorError(Exception):
+    pass
+
+
+class StandardChannelProcessor:
+    def __init__(self, channel_id: str, bundle, csp):
+        self.channel_id = channel_id
+        self._bundle = bundle
+        self._csp = csp
+
+    def classify(self, env: common_pb2.Envelope) -> Classification:
+        payload = common_pb2.Payload.FromString(env.payload)
+        chdr = common_pb2.ChannelHeader.FromString(payload.header.channel_header)
+        if chdr.type == common_pb2.CONFIG_UPDATE:
+            return Classification.CONFIG_UPDATE
+        if chdr.type == common_pb2.CONFIG:
+            return Classification.CONFIG
+        return Classification.NORMAL
+
+    def process_normal_msg(self, env: common_pb2.Envelope) -> int:
+        """Raises MsgProcessorError if rejected; returns the config sequence
+        the message was validated against (for revalidation downstream)."""
+        self._size_filter(env)
+        payload = common_pb2.Payload.FromString(env.payload)
+        chdr = common_pb2.ChannelHeader.FromString(payload.header.channel_header)
+        if chdr.channel_id != self.channel_id:
+            raise MsgProcessorError(
+                f"message is for channel {chdr.channel_id!r}, this is {self.channel_id!r}"
+            )
+        shdr = common_pb2.SignatureHeader.FromString(payload.header.signature_header)
+        self._expiration_filter(shdr.creator)
+        self._sig_filter(env, shdr)
+        return self._bundle.config.sequence
+
+    def _size_filter(self, env: common_pb2.Envelope) -> None:
+        oc = self._bundle.orderer_config
+        size = len(env.SerializeToString())
+        if oc and size > oc.absolute_max_bytes:
+            raise MsgProcessorError(
+                f"message size {size} exceeds absolute maximum {oc.absolute_max_bytes}"
+            )
+
+    def _expiration_filter(self, creator: bytes) -> None:
+        try:
+            sid = identities_pb2.SerializedIdentity.FromString(creator)
+            certs = x509.load_pem_x509_certificates(sid.id_bytes)
+        except Exception:
+            return  # sig filter will reject undeserializable creators
+        now = datetime.datetime.now(datetime.timezone.utc)
+        if certs and certs[0].not_valid_after_utc < now:
+            raise MsgProcessorError("creator certificate has expired")
+
+    def _sig_filter(self, env: common_pb2.Envelope, shdr) -> None:
+        policy = self._bundle.policy_manager.get_policy("/Channel/Writers")
+        sd = [SignedData(env.payload, shdr.creator, env.signature)]
+        if not policy.evaluate_signed_data(sd, self._csp):
+            raise MsgProcessorError("message did not satisfy the channel Writers policy")
+
+
+__all__ = ["StandardChannelProcessor", "MsgProcessorError", "Classification"]

@@ -1,0 +1,90 @@
+"""Solo consenter: single-node ordering for dev/test.
+
+Reference: orderer/consensus/solo/consensus.go (~200 LoC): a goroutine
+draining the submit channel through the blockcutter with a batch timer.
+Here: a daemon thread + queue.Queue; same cut triggers (count/bytes from
+the cutter, timeout from the timer).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+
+from fabric_tpu.orderer.blockcutter import BlockCutter
+from fabric_tpu.orderer.blockwriter import BlockWriter
+from fabric_tpu.protos.common import common_pb2
+
+
+class SoloChain:
+    def __init__(
+        self,
+        cutter: BlockCutter,
+        writer: BlockWriter,
+        batch_timeout_s: float = 2.0,
+        on_block=None,
+    ):
+        self._cutter = cutter
+        self._writer = writer
+        self._timeout = batch_timeout_s
+        self._on_block = on_block or (lambda blk: None)
+        self._q: queue.Queue = queue.Queue()
+        self._halted = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def halt(self) -> None:
+        self._halted.set()
+        self._q.put(None)
+        self._thread.join(timeout=5)
+
+    def wait_ready(self) -> None:
+        return
+
+    def order(self, env: common_pb2.Envelope, config_seq: int = 0) -> None:
+        if self._halted.is_set():
+            raise RuntimeError("chain is halted")
+        self._q.put(("normal", env.SerializeToString()))
+
+    def configure(self, env: common_pb2.Envelope, config_seq: int = 0) -> None:
+        if self._halted.is_set():
+            raise RuntimeError("chain is halted")
+        self._q.put(("config", env.SerializeToString()))
+
+    def _emit(self, batch: list[bytes], is_config: bool = False) -> None:
+        if not batch:
+            return
+        blk = self._writer.create_next_block(batch)
+        self._writer.write_block(blk, is_config=is_config)
+        self._on_block(blk)
+
+    def _run(self) -> None:
+        timer_armed = False
+        while not self._halted.is_set():
+            try:
+                item = self._q.get(timeout=self._timeout if timer_armed else None)
+            except queue.Empty:
+                # batch timer fired
+                self._emit(self._cutter.cut())
+                timer_armed = False
+                continue
+            if item is None:
+                break
+            kind, raw = item
+            if kind == "config":
+                # config messages are isolated into their own block
+                self._emit(self._cutter.cut())
+                self._emit([raw], is_config=True)
+                timer_armed = self._cutter.pending
+                continue
+            batches, pending = self._cutter.ordered(raw)
+            for batch in batches:
+                self._emit(batch)
+            timer_armed = pending
+        # drain on halt
+        self._emit(self._cutter.cut())
+
+
+__all__ = ["SoloChain"]

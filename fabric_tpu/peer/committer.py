@@ -1,0 +1,54 @@
+"""Commit orchestration: validate -> commit -> notify.
+
+Reference: gossip/privdata/coordinator.go:149 StoreBlock (txvalidator ->
+pvtdata assembly -> CommitLegacy) + core/committer/committer_impl.go.
+Private-data fetching slots in between validate and commit when the
+pvtdata subsystem lands.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class Committer:
+    def __init__(self, validator, ledger, metrics=None):
+        self._validator = validator
+        self._ledger = ledger
+        self._listeners: list = []
+        self._lock = threading.Lock()
+        self.metrics = metrics
+
+    def add_commit_listener(self, fn) -> None:
+        self._listeners.append(fn)
+
+    def store_block(self, block) -> list[int]:
+        """The per-block pipeline; returns final validation flags."""
+        t0 = time.perf_counter()
+        self._validator.validate(block)  # sets sig/policy flags
+        t_validate = time.perf_counter() - t0
+        with self._lock:
+            self._ledger.commit(block)  # MVCC + persist (updates flags again)
+        if self.metrics is not None:
+            self.metrics.observe(
+                "validate_duration", t_validate, channel=self._validator.channel_id
+            )
+            self.metrics.observe(
+                "commit_duration",
+                time.perf_counter() - t0,
+                channel=self._validator.channel_id,
+            )
+        from fabric_tpu import protoutil
+
+        flags = list(protoutil.tx_filter(block))
+        for fn in self._listeners:
+            fn(block, flags)
+        return flags
+
+    @property
+    def height(self) -> int:
+        return self._ledger.height
+
+
+__all__ = ["Committer"]

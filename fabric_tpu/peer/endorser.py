@@ -1,0 +1,81 @@
+"""Endorsement: simulate a proposal and sign the result.
+
+Reference: core/endorser/endorser.go (:296 ProcessProposal -> :250
+preProcess -> :178 SimulateProposal -> :106 callChaincode) +
+plugin_endorser.go (EndorseWithPlugin) + the builtin plugin
+(core/handlers/endorsement/builtin/default_endorsement.go:36).
+
+Chaincodes here are in-process callables (the system-chaincode execution
+model, core/scc/inprocstream.go); the external chaincode runtime plugs
+into the same `chaincodes` registry when it lands.
+"""
+
+from __future__ import annotations
+
+from fabric_tpu.protos.peer import chaincode_pb2, proposal_pb2, proposal_response_pb2
+from fabric_tpu import protoutil
+
+
+class EndorserError(Exception):
+    pass
+
+
+class Endorser:
+    def __init__(self, channel_id: str, ledger, bundle, signer, chaincodes: dict, csp):
+        """chaincodes: name -> fn(tx_simulator, args: list[bytes]) ->
+        (status:int, message:str, payload:bytes)."""
+        self.channel_id = channel_id
+        self._ledger = ledger
+        self._bundle = bundle
+        self._signer = signer
+        self._chaincodes = chaincodes
+        self._csp = csp
+
+    def process_proposal(
+        self, signed: proposal_pb2.SignedProposal
+    ) -> proposal_response_pb2.ProposalResponse:
+        # -- preProcess: structural checks + creator auth ------------------
+        up = protoutil.unpack_proposal(signed)
+        if up.channel_header.channel_id != self.channel_id:
+            raise EndorserError("wrong channel")
+        if not protoutil.check_tx_id(
+            up.channel_header.tx_id,
+            up.signature_header.nonce,
+            up.signature_header.creator,
+        ):
+            raise EndorserError("tx id does not bind to nonce+creator")
+        try:
+            creator = self._bundle.msp_manager.deserialize_identity(
+                up.signature_header.creator
+            )
+            self._bundle.msp_manager.validate(creator)
+        except Exception as exc:
+            raise EndorserError(f"creator identity invalid: {exc}") from exc
+        if not creator.verify(signed.proposal_bytes, signed.signature):
+            raise EndorserError("invalid creator signature on proposal")
+
+        # -- simulate ------------------------------------------------------
+        cc = self._chaincodes.get(up.chaincode_name)
+        if cc is None:
+            raise EndorserError(f"chaincode {up.chaincode_name!r} not installed")
+        sim = self._ledger.new_tx_simulator()
+        status, message, payload = cc(sim, list(up.input.args))
+        if status >= 400:
+            # simulation failure: no endorsement, return the error response
+            return proposal_response_pb2.ProposalResponse(
+                response=proposal_pb2.Response(status=status, message=message)
+            )
+        results = sim.get_tx_simulation_results()
+
+        # -- endorse (default endorsement plugin) --------------------------
+        return protoutil.create_proposal_response(
+            up.proposal,
+            results=results,
+            events=b"",
+            response=proposal_pb2.Response(status=status, message=message, payload=payload),
+            chaincode_id=chaincode_pb2.ChaincodeID(name=up.chaincode_name),
+            endorser_signer=self._signer,
+        )
+
+
+__all__ = ["Endorser", "EndorserError"]

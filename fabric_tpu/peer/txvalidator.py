@@ -1,0 +1,173 @@
+"""Block validation with whole-block batched signature verification.
+
+This is the north-star rework (BASELINE.json): the reference's
+txvalidator v20 (core/committer/txvalidator/v20/validator.go:180-265)
+validates each tx in its own goroutine, and every tx serially verifies
+1 creator signature + K endorsement signatures through per-identity
+`msp.Identity.Verify` calls.  Here validation is three phases:
+
+  1. **Collect** (host): per-tx syntactic checks (envelope/header shape,
+     channel id, tx-id binding, duplicate tx ids, proposal-hash binding —
+     reference core/common/validation/msgvalidation.go:26-330), identity
+     deserialization/validation, and endorsement-policy *preparation*
+     (fabric_tpu.policies two-phase protocol).  No crypto.
+  2. **Verify** (device): ONE `CSP.verify_batch` over every creator and
+     endorsement signature of the whole block.
+  3. **Finish** (host): creator mask -> BAD_CREATOR_SIGNATURE; policy
+     closures over the mask -> ENDORSEMENT_POLICY_FAILURE; MVCC runs later
+     in the ledger commit (kvledger).
+
+The endorsement-policy check is dispatched through a pluggable map like
+the reference's validation-plugin framework (core/handlers/validation);
+the builtin plugin evaluates the channel/chaincode endorsement policy.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from fabric_tpu.protos.common import common_pb2
+from fabric_tpu.protos.peer import (
+    proposal_pb2,
+    proposal_response_pb2,
+    transaction_pb2,
+)
+from fabric_tpu import protoutil
+from fabric_tpu.protoutil import SignedData
+
+V = transaction_pb2
+
+
+@dataclasses.dataclass
+class _TxWork:
+    """Per-tx deferred crypto: creator item index + policy pendings."""
+
+    creator_item: int | None = None
+    pendings: list = dataclasses.field(default_factory=list)  # (PendingEvaluation, slice)
+
+
+class TxValidator:
+    """Reference TxValidator.Validate equivalent; `Validate` mutates the
+    block's TRANSACTIONS_FILTER metadata like the reference does."""
+
+    def __init__(self, channel_id: str, ledger, bundle, csp, endorsement_policy=None):
+        """endorsement_policy: callable(chaincode_name) -> policy object
+        (two-phase protocol).  Defaults to the channel's
+        /Channel/Application/Endorsement policy — the v2.0 default when a
+        chaincode defines none (reference builtin v20 + lifecycle)."""
+        self.channel_id = channel_id
+        self._ledger = ledger
+        self._bundle = bundle
+        self._csp = csp
+        if endorsement_policy is None:
+            default_pol = bundle.policy_manager.get_policy("/Channel/Application/Endorsement")
+            endorsement_policy = lambda cc: default_pol  # noqa: E731
+        self._endorsement_policy = endorsement_policy
+
+    # -- phase 1: per-tx syntactic validation + collection ----------------
+
+    def _collect_tx(self, env_bytes: bytes, seen_txids: set, items: list, work: _TxWork) -> int:
+        try:
+            env = common_pb2.Envelope.FromString(env_bytes)
+            if not env.payload:
+                return V.NIL_ENVELOPE
+            payload = common_pb2.Payload.FromString(env.payload)
+            chdr = common_pb2.ChannelHeader.FromString(payload.header.channel_header)
+            shdr = common_pb2.SignatureHeader.FromString(payload.header.signature_header)
+        except Exception:
+            return V.BAD_PAYLOAD
+        if not shdr.creator or not shdr.nonce:
+            return V.BAD_COMMON_HEADER
+        if chdr.channel_id != self.channel_id:
+            return V.BAD_CHANNEL_HEADER
+        if chdr.epoch != 0:
+            return V.BAD_CHANNEL_HEADER
+
+        # creator must deserialize and be valid under a channel MSP
+        try:
+            creator = self._bundle.msp_manager.deserialize_identity(shdr.creator)
+            self._bundle.msp_manager.validate(creator)
+        except Exception:
+            return V.BAD_CREATOR_SIGNATURE
+        # creator signature over the payload bytes (checkSignatureFromCreator)
+        work.creator_item = len(items)
+        items.append(creator.verification_item(env.payload, env.signature))
+
+        if chdr.type == common_pb2.CONFIG:
+            # config txs are validated/applied by the channel config engine
+            return V.VALID
+        if chdr.type != common_pb2.ENDORSER_TRANSACTION:
+            return V.UNKNOWN_TX_TYPE
+
+        # tx-id binding + duplicate detection (CheckTxID + checkTxIdDupsLedger)
+        if not chdr.tx_id or not protoutil.check_tx_id(chdr.tx_id, shdr.nonce, shdr.creator):
+            return V.BAD_PROPOSAL_TXID
+        if chdr.tx_id in seen_txids or self._ledger.tx_id_exists(chdr.tx_id):
+            return V.DUPLICATE_TXID
+        seen_txids.add(chdr.tx_id)
+
+        try:
+            tx = transaction_pb2.Transaction.FromString(payload.data)
+            if not tx.actions:
+                return V.NIL_TXACTION
+            cap = transaction_pb2.ChaincodeActionPayload.FromString(tx.actions[0].payload)
+            prp_bytes = cap.action.proposal_response_payload
+            prp = proposal_response_pb2.ProposalResponsePayload.FromString(prp_bytes)
+            action = proposal_pb2.ChaincodeAction.FromString(prp.extension)
+        except Exception:
+            return V.BAD_PAYLOAD
+        # proposal-hash binding: endorsers signed over this exact proposal
+        want = protoutil.proposal_hash(
+            payload.header.channel_header,
+            payload.header.signature_header,
+            cap.chaincode_proposal_payload,
+        )
+        if prp.proposal_hash != want:
+            return V.BAD_RESPONSE_PAYLOAD
+        if not cap.action.endorsements:
+            return V.ENDORSEMENT_POLICY_FAILURE
+
+        # endorsement policy: each endorsement signs prp_bytes || endorser
+        signed = [
+            SignedData(prp_bytes + e.endorser, e.endorser, e.signature)
+            for e in cap.action.endorsements
+        ]
+        policy = self._endorsement_policy(action.chaincode_id.name)
+        pending = policy.prepare(signed)
+        start = len(items)
+        items.extend(pending.items)
+        work.pendings.append((pending, (start, len(items))))
+        return V.VALID
+
+    # -- the three-phase validate -----------------------------------------
+
+    def validate(self, block: common_pb2.Block) -> list[int]:
+        n = len(block.data.data)
+        flags = [V.NOT_VALIDATED] * n
+        works = [_TxWork() for _ in range(n)]
+        items: list = []
+        seen_txids: set[str] = set()
+
+        for i in range(n):
+            flags[i] = self._collect_tx(block.data.data[i], seen_txids, items, works[i])
+
+        # phase 2: one device call for the whole block
+        mask = self._csp.verify_batch(items) if items else []
+
+        # phase 3: apply per-tx results
+        for i in range(n):
+            if flags[i] != V.VALID:
+                continue
+            w = works[i]
+            if w.creator_item is not None and not mask[w.creator_item]:
+                flags[i] = V.BAD_CREATOR_SIGNATURE
+                continue
+            for pending, (start, end) in w.pendings:
+                if not pending.finish(mask[start:end]):
+                    flags[i] = V.ENDORSEMENT_POLICY_FAILURE
+                    break
+        protoutil.set_tx_filter(block, bytes(flags))
+        return flags
+
+
+__all__ = ["TxValidator"]
