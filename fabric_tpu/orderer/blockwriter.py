@@ -22,6 +22,10 @@ class BlockWriter:
     def height(self) -> int:
         return self._store.height
 
+    def last_block(self) -> common_pb2.Block | None:
+        h = self._store.height
+        return self._store.get_block_by_number(h - 1) if h else None
+
     def create_next_block(self, env_bytes_batch: list[bytes]) -> common_pb2.Block:
         if self._store.height == 0:
             prev_hash = b""

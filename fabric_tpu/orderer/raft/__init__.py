@@ -1,0 +1,14 @@
+from fabric_tpu.orderer.raft.raftcore import RaftNode, Ready, MemoryLog
+from fabric_tpu.orderer.raft.wal import WAL
+from fabric_tpu.orderer.raft.chain import RaftChain
+from fabric_tpu.orderer.raft.transport import InProcTransport, TCPTransport
+
+__all__ = [
+    "RaftNode",
+    "Ready",
+    "MemoryLog",
+    "WAL",
+    "RaftChain",
+    "InProcTransport",
+    "TCPTransport",
+]

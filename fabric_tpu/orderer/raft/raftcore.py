@@ -1,0 +1,535 @@
+"""Deterministic Raft state machine for the ordering service.
+
+Capability parity with the reference's consensus layer
+(orderer/consensus/etcdraft, which wraps the vendored go.etcd.io/etcd/raft
+library; node lifecycle in etcdraft/node.go, tick loop at
+etcdraft/node.go run()).  Built fresh rather than translated: a single
+`RaftNode` class exposing the etcd-style deterministic API —
+
+    tick()        advance logical clock (election / heartbeat timers)
+    step(msg)     feed one RaftMessage from a peer
+    propose(data) leader appends a normal entry
+    ready()       drain: messages to send, entries to persist, entries to
+                  apply, snapshot to install
+    advance()     acknowledge the last ready() was processed
+
+so consensus is fully unit-testable without threads, sockets, or clocks —
+the same property etcd/raft's Ready pattern provides, and the reason the
+reference can run three "nodes" in one test process.
+
+Implements: pre-vote (liveness under partitions, reference enables
+PreVote in etcdraft/node.go config), leader election with randomized
+timeouts, log replication with conflict back-off hints, commit-index
+advancement by quorum match, single-node conf changes (add/remove
+consenter), and snapshot install for lagging peers.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+
+from fabric_tpu.protos.orderer import raft_pb2 as rpb
+
+FOLLOWER, CANDIDATE, LEADER, PRE_CANDIDATE = range(4)
+_STATE_NAMES = {0: "follower", 1: "candidate", 2: "leader", 3: "pre-candidate"}
+
+
+class MemoryLog:
+    """In-memory raft log, offset by the last compaction snapshot.
+
+    entries[i] holds the entry at raft index `first_index + i`; index 0 is
+    the null sentinel before the log starts (term 0), matching the classic
+    formulation.
+    """
+
+    def __init__(self):
+        self.entries: list[rpb.Entry] = []
+        self.snap_index = 0  # log compacted up to and including this index
+        self.snap_term = 0
+
+    # -- index arithmetic --------------------------------------------------
+
+    @property
+    def first_index(self) -> int:
+        return self.snap_index + 1
+
+    @property
+    def last_index(self) -> int:
+        return self.snap_index + len(self.entries)
+
+    def term(self, index: int) -> int | None:
+        """Term of `index`, or None if compacted away / beyond the log."""
+        if index == self.snap_index:
+            return self.snap_term
+        if index < self.snap_index or index > self.last_index:
+            return None
+        return self.entries[index - self.first_index].term
+
+    def last_term(self) -> int:
+        return self.term(self.last_index) or 0
+
+    def slice(self, lo: int, hi: int | None = None) -> list[rpb.Entry]:
+        hi = self.last_index if hi is None else hi
+        if lo < self.first_index:
+            raise KeyError(f"slice({lo}) below first_index {self.first_index}")
+        return self.entries[lo - self.first_index : hi - self.first_index + 1]
+
+    # -- mutation ----------------------------------------------------------
+
+    def append(self, entries: list[rpb.Entry]) -> None:
+        self.entries.extend(entries)
+
+    def truncate_from(self, index: int) -> None:
+        """Drop entries at `index` and after (conflict resolution)."""
+        del self.entries[index - self.first_index :]
+
+    def compact(self, index: int) -> None:
+        """Discard entries up to and including `index` (snapshotted)."""
+        term = self.term(index)
+        if term is None:
+            return
+        del self.entries[: index - self.first_index + 1]
+        self.snap_index, self.snap_term = index, term
+
+    def reset_to_snapshot(self, index: int, term: int) -> None:
+        self.entries = []
+        self.snap_index, self.snap_term = index, term
+
+
+@dataclass
+class Ready:
+    messages: list = field(default_factory=list)       # RaftMessage to send
+    persist_entries: list = field(default_factory=list)  # append to WAL
+    hard_state: rpb.HardState | None = None            # persist if not None
+    committed: list = field(default_factory=list)      # apply to state machine
+    snapshot: rpb.Snapshot | None = None               # install (follower)
+    soft_leader: int | None = None                     # current leader id hint
+
+    def empty(self) -> bool:
+        return not (
+            self.messages
+            or self.persist_entries
+            or self.hard_state
+            or self.committed
+            or self.snapshot
+        )
+
+
+class RaftNode:
+    def __init__(
+        self,
+        node_id: int,
+        voters: set[int],
+        log: MemoryLog | None = None,
+        election_tick: int = 10,
+        heartbeat_tick: int = 1,
+        rng: random.Random | None = None,
+        term: int = 0,
+        voted_for: int = 0,
+        commit: int = 0,
+        applied: int | None = None,
+        max_batch_entries: int = 64,
+    ):
+        self.id = node_id
+        self.voters = set(voters)
+        self.log = log or MemoryLog()
+        self.term = term
+        self.voted_for = voted_for
+        self.commit = max(commit, self.log.snap_index)
+        self.applied = self.log.snap_index if applied is None else applied
+        self.state = FOLLOWER
+        self.leader = 0
+        self.election_tick = election_tick
+        self.heartbeat_tick = heartbeat_tick
+        self._rng = rng or random.Random()
+        self._elapsed = 0
+        self._timeout = self._rand_timeout()
+        self._max_batch = max_batch_entries
+        # leader bookkeeping
+        self.match: dict[int, int] = {}
+        self.next: dict[int, int] = {}
+        self._votes: dict[int, bool] = {}
+        # outputs accumulated between ready() calls
+        self._msgs: list[rpb.RaftMessage] = []
+        self._unpersisted: list[rpb.Entry] = []
+        self._pending_snapshot: rpb.Snapshot | None = None
+        self._hs_dirty = True  # persist initial hard state
+
+    # -- helpers -----------------------------------------------------------
+
+    def _rand_timeout(self) -> int:
+        return self.election_tick + self._rng.randrange(self.election_tick)
+
+    def _quorum(self) -> int:
+        return len(self.voters) // 2 + 1
+
+    def _msg(self, mtype, to, **kw) -> rpb.RaftMessage:
+        m = rpb.RaftMessage(type=mtype, to=to, term=self.term)
+        m.sender = self.id
+        for k, v in kw.items():
+            if k == "entries":
+                m.entries.extend(v)
+            elif k == "snapshot":
+                m.snapshot.CopyFrom(v)
+            else:
+                setattr(m, k, v)
+        return m
+
+    def _send(self, m: rpb.RaftMessage) -> None:
+        self._msgs.append(m)
+
+    def _become_follower(self, term: int, leader: int) -> None:
+        if term > self.term:
+            self.term, self.voted_for = term, 0
+            self._hs_dirty = True
+        self.state = FOLLOWER
+        self.leader = leader
+        self._elapsed = 0
+        self._timeout = self._rand_timeout()
+
+    def _become_leader(self) -> None:
+        self.state = LEADER
+        self.leader = self.id
+        self._elapsed = 0
+        self.match = {v: 0 for v in self.voters}
+        self.match[self.id] = self.log.last_index
+        self.next = {v: self.log.last_index + 1 for v in self.voters}
+        # A leader commits entries from prior terms only indirectly, by
+        # committing an entry of its own term (Raft §5.4.2): append a no-op.
+        self._append_as_leader([rpb.Entry(type=rpb.ENTRY_NORMAL, data=b"")])
+        self._broadcast_append()
+
+    # -- public API --------------------------------------------------------
+
+    @property
+    def is_leader(self) -> bool:
+        return self.state == LEADER
+
+    def tick(self) -> None:
+        self._elapsed += 1
+        if self.state == LEADER:
+            if self._elapsed >= self.heartbeat_tick:
+                self._elapsed = 0
+                self._broadcast_append()
+        elif self._elapsed >= self._timeout:
+            self._campaign(pre=True)
+
+    def propose(self, data: bytes, etype=rpb.ENTRY_NORMAL) -> bool:
+        if self.state != LEADER:
+            return False
+        self._append_as_leader([rpb.Entry(type=etype, data=data)])
+        self._broadcast_append()
+        return True
+
+    def propose_conf_change(self, cc: rpb.ConfChange) -> bool:
+        return self.propose(cc.SerializeToString(), rpb.ENTRY_CONF_CHANGE)
+
+    def apply_conf_change(self, cc: rpb.ConfChange) -> None:
+        """Caller invokes after committing a conf-change entry."""
+        nid = cc.consenter.id
+        if cc.action == rpb.ConfChange.ADD_NODE:
+            self.voters.add(nid)
+            if self.state == LEADER and nid not in self.next:
+                self.next[nid] = self.log.last_index + 1
+                self.match[nid] = 0
+        else:
+            self.voters.discard(nid)
+            self.next.pop(nid, None)
+            self.match.pop(nid, None)
+            if self.state == LEADER:
+                self._maybe_advance_commit()
+
+    def ready(self) -> Ready:
+        rd = Ready(soft_leader=self.leader or None)
+        rd.messages, self._msgs = self._msgs, []
+        rd.persist_entries, self._unpersisted = self._unpersisted, []
+        rd.snapshot, self._pending_snapshot = self._pending_snapshot, None
+        if self._hs_dirty:
+            rd.hard_state = rpb.HardState(
+                term=self.term, voted_for=self.voted_for, commit=self.commit
+            )
+            self._hs_dirty = False
+        if self.commit > self.applied:
+            lo = max(self.applied + 1, self.log.first_index)
+            if lo <= self.commit:
+                rd.committed = list(self.log.slice(lo, self.commit))
+            self.applied = self.commit
+        return rd
+
+    def advance(self) -> None:
+        return  # state already advanced eagerly; kept for API symmetry
+
+    # -- election ----------------------------------------------------------
+
+    def _campaign(self, pre: bool) -> None:
+        if self.id not in self.voters:
+            # removed node: never campaign
+            self._elapsed = 0
+            return
+        self._elapsed = 0
+        self._timeout = self._rand_timeout()
+        self._votes = {self.id: True}
+        if pre:
+            # Pre-vote: probe electability at term+1 WITHOUT bumping our term
+            self.state = PRE_CANDIDATE
+            if len(self.voters) == 1:
+                self._campaign(pre=False)
+                return
+            for v in self.voters - {self.id}:
+                m = self._msg(
+                    rpb.MSG_PRE_VOTE_REQUEST,
+                    v,
+                    last_log_index=self.log.last_index,
+                    last_log_term=self.log.last_term(),
+                )
+                m.term = self.term + 1
+                self._send(m)
+            return
+        self.state = CANDIDATE
+        self.term += 1
+        self.voted_for = self.id
+        self._hs_dirty = True
+        if len(self.voters) == 1:
+            self._become_leader()
+            return
+        for v in self.voters - {self.id}:
+            self._send(
+                self._msg(
+                    rpb.MSG_VOTE_REQUEST,
+                    v,
+                    last_log_index=self.log.last_index,
+                    last_log_term=self.log.last_term(),
+                )
+            )
+
+    def _log_up_to_date(self, m: rpb.RaftMessage) -> bool:
+        lt, li = self.log.last_term(), self.log.last_index
+        return (m.last_log_term, m.last_log_index) >= (lt, li)
+
+    # -- message handling --------------------------------------------------
+
+    def step(self, m: rpb.RaftMessage) -> None:
+        if m.term > self.term:
+            if m.type in (rpb.MSG_PRE_VOTE_REQUEST, rpb.MSG_PRE_VOTE_RESPONSE):
+                pass  # pre-vote traffic never perturbs term state
+            elif m.type in (rpb.MSG_APPEND, rpb.MSG_SNAPSHOT):
+                self._become_follower(m.term, m.sender)
+            else:
+                self._become_follower(m.term, 0)
+        elif m.term < self.term:
+            if m.type == rpb.MSG_APPEND:
+                # stale leader: tell it the current term
+                self._send(
+                    self._msg(rpb.MSG_APPEND_RESPONSE, m.sender, success=False)
+                )
+            return
+
+        handler = {
+            rpb.MSG_PRE_VOTE_REQUEST: self._on_pre_vote_request,
+            rpb.MSG_PRE_VOTE_RESPONSE: self._on_pre_vote_response,
+            rpb.MSG_VOTE_REQUEST: self._on_vote_request,
+            rpb.MSG_VOTE_RESPONSE: self._on_vote_response,
+            rpb.MSG_APPEND: self._on_append,
+            rpb.MSG_APPEND_RESPONSE: self._on_append_response,
+            rpb.MSG_SNAPSHOT: self._on_snapshot,
+        }[m.type]
+        handler(m)
+
+    def _on_pre_vote_request(self, m: rpb.RaftMessage) -> None:
+        # Grant iff we'd grant a real vote at that term: no current leader
+        # heard from recently, and candidate's log is up to date.
+        grant = (
+            m.term > self.term
+            and self._log_up_to_date(m)
+            and (self.leader == 0 or self._elapsed >= self.election_tick)
+        )
+        resp = self._msg(rpb.MSG_PRE_VOTE_RESPONSE, m.sender, vote_granted=grant)
+        resp.term = m.term
+        self._send(resp)
+
+    def _on_pre_vote_response(self, m: rpb.RaftMessage) -> None:
+        if self.state != PRE_CANDIDATE:
+            return
+        self._votes[m.sender] = m.vote_granted
+        if sum(self._votes.values()) >= self._quorum():
+            self._campaign(pre=False)
+
+    def _on_vote_request(self, m: rpb.RaftMessage) -> None:
+        can_vote = self.voted_for in (0, m.sender)
+        grant = can_vote and self._log_up_to_date(m)
+        if grant:
+            self.voted_for = m.sender
+            self._hs_dirty = True
+            self._elapsed = 0
+        self._send(self._msg(rpb.MSG_VOTE_RESPONSE, m.sender, vote_granted=grant))
+
+    def _on_vote_response(self, m: rpb.RaftMessage) -> None:
+        if self.state != CANDIDATE:
+            return
+        self._votes[m.sender] = m.vote_granted
+        if sum(self._votes.values()) >= self._quorum():
+            self._become_leader()
+        elif sum(1 for g in self._votes.values() if not g) >= self._quorum():
+            self._become_follower(self.term, 0)
+
+    # -- replication (follower side) ---------------------------------------
+
+    def _on_append(self, m: rpb.RaftMessage) -> None:
+        self._become_follower(m.term, m.sender)
+        prev_term = self.log.term(m.prev_log_index)
+        if prev_term is None or prev_term != m.prev_log_term:
+            self._send(
+                self._msg(
+                    rpb.MSG_APPEND_RESPONSE,
+                    m.sender,
+                    success=False,
+                    reject_hint=self.log.last_index,
+                )
+            )
+            return
+        new = list(m.entries)
+        # skip entries we already have; truncate on the first conflict
+        for i, e in enumerate(new):
+            t = self.log.term(e.index)
+            if t is None and e.index > self.log.last_index:
+                new = new[i:]
+                break
+            if t != e.term:
+                self.log.truncate_from(e.index)
+                # conflicting suffix was never committed; safe to discard
+                new = new[i:]
+                break
+        else:
+            new = []
+        if new:
+            self.log.append(new)
+            self._unpersisted.extend(new)
+        if m.leader_commit > self.commit:
+            self.commit = min(m.leader_commit, self.log.last_index)
+            self._hs_dirty = True
+        self._send(
+            self._msg(
+                rpb.MSG_APPEND_RESPONSE,
+                m.sender,
+                success=True,
+                match_index=m.prev_log_index + len(m.entries),
+            )
+        )
+
+    def _on_snapshot(self, m: rpb.RaftMessage) -> None:
+        self._become_follower(m.term, m.sender)
+        snap = m.snapshot
+        if snap.meta.index <= self.commit:
+            # stale snapshot; just ack our progress
+            self._send(
+                self._msg(
+                    rpb.MSG_APPEND_RESPONSE,
+                    m.sender,
+                    success=True,
+                    match_index=self.commit,
+                )
+            )
+            return
+        self.log.reset_to_snapshot(snap.meta.index, snap.meta.term)
+        self.commit = snap.meta.index
+        self.applied = snap.meta.index
+        self.voters = set(snap.meta.voters)
+        self._hs_dirty = True
+        self._pending_snapshot = snap
+        self._send(
+            self._msg(
+                rpb.MSG_APPEND_RESPONSE,
+                m.sender,
+                success=True,
+                match_index=snap.meta.index,
+            )
+        )
+
+    # -- replication (leader side) -----------------------------------------
+
+    def _append_as_leader(self, entries: list[rpb.Entry]) -> None:
+        base = self.log.last_index
+        for i, e in enumerate(entries):
+            e.index = base + 1 + i
+            e.term = self.term
+        self.log.append(entries)
+        self._unpersisted.extend(entries)
+        self.match[self.id] = self.log.last_index
+        if len(self.voters) == 1:
+            self._maybe_advance_commit()
+
+    def _send_append(self, to: int) -> None:
+        nxt = self.next[to]
+        prev = nxt - 1
+        prev_term = self.log.term(prev)
+        if prev_term is None:
+            # follower is behind our compaction point: needs a snapshot;
+            # the chain layer fills in application payload via snapshot_fn
+            snap = self._make_snapshot()
+            self._send(self._msg(rpb.MSG_SNAPSHOT, to, snapshot=snap))
+            return
+        entries = self.log.slice(nxt)[: self._max_batch]
+        self._send(
+            self._msg(
+                rpb.MSG_APPEND,
+                to,
+                prev_log_index=prev,
+                prev_log_term=prev_term,
+                entries=entries,
+                leader_commit=self.commit,
+            )
+        )
+
+    # chain layer sets this to fill application payload into snapshots
+    snapshot_payload_fn = None
+
+    def _make_snapshot(self) -> rpb.Snapshot:
+        snap = rpb.Snapshot()
+        snap.meta.index = self.log.snap_index
+        snap.meta.term = self.log.snap_term
+        snap.meta.voters.extend(sorted(self.voters))
+        fn = getattr(self, "snapshot_payload_fn", None)
+        if fn:
+            fn(snap)
+        return snap
+
+    def _broadcast_append(self) -> None:
+        for v in self.voters:
+            if v != self.id:
+                self._send_append(v)
+
+    def _on_append_response(self, m: rpb.RaftMessage) -> None:
+        if self.state != LEADER:
+            return
+        if not m.success:
+            # back off next index using the follower's hint and retry
+            self.next[m.sender] = max(1, min(self.next.get(m.sender, 1) - 1,
+                                            m.reject_hint + 1))
+            self._send_append(m.sender)
+            return
+        if m.sender not in self.match:
+            return  # not a voter (e.g. just removed)
+        if m.match_index > self.match[m.sender]:
+            self.match[m.sender] = m.match_index
+        self.next[m.sender] = max(self.next[m.sender], m.match_index + 1)
+        self._maybe_advance_commit()
+        if self.next[m.sender] <= self.log.last_index:
+            self._send_append(m.sender)  # keep streaming backlog
+
+    def _maybe_advance_commit(self) -> None:
+        matches = sorted(
+            (self.match.get(v, 0) for v in self.voters), reverse=True
+        )
+        candidate = matches[self._quorum() - 1]
+        # only commit entries from the current term directly (Raft §5.4.2)
+        if candidate > self.commit and self.log.term(candidate) == self.term:
+            self.commit = candidate
+            self._hs_dirty = True
+            self._broadcast_append()  # propagate new commit index promptly
+
+    def compact(self, index: int) -> None:
+        self.log.compact(index)
+
+
+__all__ = ["RaftNode", "MemoryLog", "Ready", "FOLLOWER", "CANDIDATE", "LEADER"]

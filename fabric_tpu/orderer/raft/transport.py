@@ -1,0 +1,208 @@
+"""Cluster communication: the orderer-to-orderer Step fabric.
+
+Capability parity with the reference's cluster comm
+(orderer/common/cluster/comm.go Step RPC over mutually-authenticated gRPC;
+rpc.go wraps consensus sends + submit forwarding).  Two transports behind
+one interface:
+
+  InProcTransport  — registry of nodes in one process, with per-link
+                     partition/drop controls for fault-injection tests
+                     (the role the reference's in-test network shims play).
+  TCPTransport     — length-prefixed StepRequest frames over localhost TCP
+                     for real multi-process deployments; per-peer sender
+                     threads with bounded queues (drop-on-overflow, raft
+                     tolerates loss) and automatic reconnect.
+"""
+
+from __future__ import annotations
+
+import queue
+import socket
+import struct
+import threading
+
+from fabric_tpu.protos.orderer import raft_pb2 as rpb
+
+_LEN = struct.Struct(">I")
+
+
+class InProcTransport:
+    """Shared by all in-process nodes: register(id, handler) then send."""
+
+    def __init__(self):
+        self._nodes: dict[int, callable] = {}
+        self._cut: set[tuple[int, int]] = set()
+        self._lock = threading.Lock()
+
+    def register(self, node_id: int, handler) -> None:
+        with self._lock:
+            self._nodes[node_id] = handler
+
+    def unregister(self, node_id: int) -> None:
+        with self._lock:
+            self._nodes.pop(node_id, None)
+
+    def partition(self, a: int, b: int) -> None:
+        with self._lock:
+            self._cut.add((a, b))
+            self._cut.add((b, a))
+
+    def heal(self, a: int | None = None, b: int | None = None) -> None:
+        with self._lock:
+            if a is None:
+                self._cut.clear()
+            else:
+                self._cut.discard((a, b))
+                self._cut.discard((b, a))
+
+    def send(self, frm: int, to: int, req: rpb.StepRequest) -> None:
+        with self._lock:
+            if (frm, to) in self._cut:
+                return
+            handler = self._nodes.get(to)
+        if handler is not None:
+            handler(req)
+
+
+class _PeerSender:
+    def __init__(self, addr: tuple[str, int]):
+        self.addr = addr
+        self.q: queue.Queue = queue.Queue(maxsize=4096)
+        self._sock: socket.socket | None = None
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._stop = threading.Event()
+        self._thread.start()
+
+    def send(self, data: bytes) -> None:
+        try:
+            self.q.put_nowait(data)
+        except queue.Full:
+            pass  # raft retransmits; dropping beats blocking consensus
+
+    def _connect(self) -> socket.socket | None:
+        try:
+            s = socket.create_connection(self.addr, timeout=2.0)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            return s
+        except OSError:
+            return None
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                data = self.q.get(timeout=0.5)
+            except queue.Empty:
+                continue
+            if self._sock is None:
+                self._sock = self._connect()
+                if self._sock is None:
+                    continue  # drop; peer down
+            try:
+                self._sock.sendall(_LEN.pack(len(data)) + data)
+            except OSError:
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+
+
+class TCPTransport:
+    """One listener per ordering node; senders keyed by node id."""
+
+    def __init__(self, node_id: int, listen_addr: tuple[str, int]):
+        self.node_id = node_id
+        self._handler = None
+        self._peers: dict[int, _PeerSender] = {}
+        self._lock = threading.Lock()
+        self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._server.bind(listen_addr)
+        self._server.listen(32)
+        self.addr = self._server.getsockname()
+        self._stop = threading.Event()
+        self._accept_thread = threading.Thread(target=self._accept, daemon=True)
+        self._accept_thread.start()
+
+    def set_handler(self, handler) -> None:
+        self._handler = handler
+
+    def set_peer(self, node_id: int, addr: tuple[str, int]) -> None:
+        with self._lock:
+            old = self._peers.get(node_id)
+            if old is not None and old.addr == tuple(addr):
+                return
+            if old is not None:
+                old.close()
+            self._peers[node_id] = _PeerSender(tuple(addr))
+
+    def remove_peer(self, node_id: int) -> None:
+        with self._lock:
+            s = self._peers.pop(node_id, None)
+        if s is not None:
+            s.close()
+
+    def send(self, frm: int, to: int, req: rpb.StepRequest) -> None:
+        with self._lock:
+            sender = self._peers.get(to)
+        if sender is not None:
+            sender.send(req.SerializeToString())
+
+    def _accept(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._server.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True
+            ).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        buf = b""
+        conn.settimeout(30.0)
+        try:
+            while not self._stop.is_set():
+                while len(buf) < _LEN.size:
+                    chunk = conn.recv(65536)
+                    if not chunk:
+                        return
+                    buf += chunk
+                (ln,) = _LEN.unpack_from(buf)
+                while len(buf) < _LEN.size + ln:
+                    chunk = conn.recv(65536)
+                    if not chunk:
+                        return
+                    buf += chunk
+                frame, buf = buf[_LEN.size : _LEN.size + ln], buf[_LEN.size + ln :]
+                if self._handler is not None:
+                    self._handler(rpb.StepRequest.FromString(frame))
+        except OSError:
+            return
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._server.close()
+        except OSError:
+            pass
+        with self._lock:
+            for s in self._peers.values():
+                s.close()
+            self._peers.clear()
+
+
+__all__ = ["InProcTransport", "TCPTransport"]

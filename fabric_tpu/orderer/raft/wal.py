@@ -1,0 +1,137 @@
+"""Raft write-ahead log + snapshot persistence.
+
+Capability parity with the reference's etcd WAL + snapshotter usage
+(orderer/consensus/etcdraft/storage.go:57-66 CreateStorage: replay WAL
+from the latest snapshot, hand entries to raft MemoryStorage).  Design is
+ours: one append-only file of CRC32-framed WALRecord protos (hard states,
+entries, snapshot markers), fsync'd per append batch, with torn-tail
+truncation on recovery — the same recovery contract the block store uses.
+
+A snapshot record both persists the application snapshot and marks the
+log position; on replay, entries at or below the latest snapshot index are
+discarded (compaction).  `maybe_rotate` rewrites the file from the latest
+snapshot forward once garbage dominates, bounding disk growth the way the
+reference's segment-file purge (storage.go PurgeSnapshots) does.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import zlib
+
+from fabric_tpu.orderer.raft.raftcore import MemoryLog
+from fabric_tpu.protos.orderer import raft_pb2 as rpb
+
+_HDR = struct.Struct(">II")  # length, crc32
+
+
+class WAL:
+    def __init__(self, dir_path: str):
+        self.dir = dir_path
+        os.makedirs(dir_path, exist_ok=True)
+        self.path = os.path.join(dir_path, "raft.wal")
+        self._f = None
+        self._garbage = 0  # bytes superseded by the latest snapshot
+
+    # -- recovery ----------------------------------------------------------
+
+    def load(self) -> tuple[rpb.HardState, MemoryLog, rpb.Snapshot | None]:
+        """Replay the WAL; returns (last hard state, reconstructed log,
+        latest application snapshot or None)."""
+        hs = rpb.HardState()
+        log = MemoryLog()
+        snap: rpb.Snapshot | None = None
+        entries: dict[int, rpb.Entry] = {}
+        good = 0
+        if os.path.exists(self.path):
+            with open(self.path, "rb") as f:
+                data = f.read()
+            off = 0
+            while off + _HDR.size <= len(data):
+                ln, crc = _HDR.unpack_from(data, off)
+                end = off + _HDR.size + ln
+                if end > len(data):
+                    break  # torn write
+                payload = data[off + _HDR.size : end]
+                if zlib.crc32(payload) != crc:
+                    break  # corrupt tail
+                rec = rpb.WALRecord.FromString(payload)
+                kind = rec.WhichOneof("payload")
+                if kind == "hard_state":
+                    hs = rec.hard_state
+                elif kind == "entry":
+                    entries[rec.entry.index] = rec.entry
+                elif kind == "snapshot":
+                    snap = rec.snapshot
+                off = end
+                good = off
+            if good < len(data):
+                with open(self.path, "r+b") as f:
+                    f.truncate(good)
+        if snap is not None:
+            log.reset_to_snapshot(snap.meta.index, snap.meta.term)
+        # stitch entries into a contiguous suffix above the snapshot
+        idx = log.snap_index + 1
+        chain: list[rpb.Entry] = []
+        while idx in entries:
+            chain.append(entries[idx])
+            idx += 1
+        log.append(chain)
+        self._f = open(self.path, "ab")
+        return hs, log, snap
+
+    def _open(self):
+        if self._f is None:
+            self._f = open(self.path, "ab")
+        return self._f
+
+    # -- append ------------------------------------------------------------
+
+    def _write(self, rec: rpb.WALRecord) -> None:
+        payload = rec.SerializeToString()
+        self._open().write(_HDR.pack(len(payload), zlib.crc32(payload)) + payload)
+
+    def save(self, hard_state: rpb.HardState | None, entries) -> None:
+        wrote = False
+        for e in entries:
+            self._write(rpb.WALRecord(entry=e))
+            wrote = True
+        if hard_state is not None:
+            self._write(rpb.WALRecord(hard_state=hard_state))
+            wrote = True
+        if wrote:
+            f = self._open()
+            f.flush()
+            os.fsync(f.fileno())
+
+    def save_snapshot(self, snap: rpb.Snapshot) -> None:
+        self._write(rpb.WALRecord(snapshot=snap))
+        f = self._open()
+        f.flush()
+        os.fsync(f.fileno())
+        self._garbage = f.tell()
+        self.maybe_rotate(snap)
+
+    def maybe_rotate(self, snap: rpb.Snapshot, keep_bytes: int = 4 << 20) -> None:
+        """Rewrite the WAL as [snapshot] once dead records dominate."""
+        if self._garbage < keep_bytes:
+            return
+        tmp = self.path + ".tmp"
+        payload = rpb.WALRecord(snapshot=snap).SerializeToString()
+        with open(tmp, "wb") as f:
+            f.write(_HDR.pack(len(payload), zlib.crc32(payload)) + payload)
+            f.flush()
+            os.fsync(f.fileno())
+        self._f.close()
+        os.replace(tmp, self.path)
+        self._f = open(self.path, "ab")
+        self._garbage = 0
+
+    def close(self) -> None:
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+
+__all__ = ["WAL"]

@@ -1,0 +1,361 @@
+"""Raft consensus tests: core protocol, WAL recovery, and the consenter
+chain on an in-process 3-node cluster (the reference tests etcdraft the
+same way — fake network, deterministic clocks; orderer/consensus/etcdraft
+chain_test.go)."""
+
+import os
+import threading
+import time
+
+import pytest
+
+from fabric_tpu.ledger.blkstorage import BlockStore
+from fabric_tpu.orderer.blockcutter import BlockCutter
+from fabric_tpu.orderer.blockwriter import BlockWriter
+from fabric_tpu.orderer.raft import (
+    InProcTransport,
+    MemoryLog,
+    RaftChain,
+    RaftNode,
+    WAL,
+)
+from fabric_tpu.orderer.raft.raftcore import LEADER
+from fabric_tpu.protos.common import common_pb2
+from fabric_tpu.protos.orderer import raft_pb2 as rpb
+from fabric_tpu import protoutil
+
+
+# ---------------------------------------------------------------------------
+# deterministic in-test cluster harness for the raw state machine
+# ---------------------------------------------------------------------------
+
+class Cluster:
+    def __init__(self, n: int, seed: int = 7):
+        import random
+
+        self.nodes = {
+            i: RaftNode(i, set(range(1, n + 1)), rng=random.Random(seed + i))
+            for i in range(1, n + 1)
+        }
+        self.dropped: set[int] = set()  # node ids cut off from the network
+        self.applied: dict[int, list[bytes]] = {i: [] for i in self.nodes}
+
+    def flush(self, rounds: int = 20) -> None:
+        """Deliver messages until quiescent."""
+        for _ in range(rounds):
+            moved = False
+            for nid, node in self.nodes.items():
+                rd = node.ready()
+                for e in rd.committed:
+                    if e.type == rpb.ENTRY_CONF_CHANGE:
+                        cc = rpb.ConfChange.FromString(e.data)
+                        node.apply_conf_change(cc)
+                    elif e.data:
+                        self.applied[nid].append(e.data)
+                for m in rd.messages:
+                    moved = True
+                    if nid in self.dropped or m.to in self.dropped:
+                        continue
+                    if m.to in self.nodes:
+                        self.nodes[m.to].step(m)
+            if not moved:
+                return
+
+    def tick_all(self, n: int = 1) -> None:
+        for _ in range(n):
+            for nid, node in self.nodes.items():
+                if nid not in self.dropped:
+                    node.tick()
+            self.flush()
+
+    def elect(self, max_ticks: int = 200) -> RaftNode:
+        for _ in range(max_ticks):
+            self.tick_all()
+            leaders = [
+                n
+                for i, n in self.nodes.items()
+                if n.state == LEADER and i not in self.dropped
+            ]
+            if leaders:
+                return leaders[0]
+        raise AssertionError("no leader elected")
+
+
+def test_single_node_self_elects_and_commits():
+    c = Cluster(1)
+    leader = c.elect()
+    assert leader.propose(b"tx1")
+    c.flush()
+    assert c.applied[leader.id] == [b"tx1"]
+
+
+def test_three_node_election_and_replication():
+    c = Cluster(3)
+    leader = c.elect()
+    for i in range(5):
+        assert leader.propose(b"tx%d" % i)
+    c.flush()
+    want = [b"tx%d" % i for i in range(5)]
+    for nid in c.nodes:
+        assert c.applied[nid] == want
+
+
+def test_leader_failure_reelection_preserves_log():
+    c = Cluster(3)
+    leader = c.elect()
+    leader.propose(b"before")
+    c.flush()
+    c.dropped.add(leader.id)
+    new_leader = c.elect()
+    assert new_leader.id != leader.id
+    new_leader.propose(b"after")
+    c.flush()
+    for nid in c.nodes:
+        if nid not in c.dropped:
+            assert c.applied[nid] == [b"before", b"after"]
+    # old leader rejoins and catches up
+    c.dropped.clear()
+    c.tick_all(5)
+    assert c.applied[leader.id] == [b"before", b"after"]
+
+
+def test_stale_leader_proposal_discarded_on_rejoin():
+    c = Cluster(3)
+    leader = c.elect()
+    leader.propose(b"committed")
+    c.flush()
+    # partition the leader, let it append an entry nobody sees
+    c.dropped.add(leader.id)
+    leader.propose(b"lost")
+    new_leader = c.elect()
+    new_leader.propose(b"won")
+    c.flush()
+    c.dropped.clear()
+    c.tick_all(10)
+    want = [b"committed", b"won"]
+    for nid in c.nodes:
+        assert c.applied[nid] == want, f"node {nid}"
+
+
+def test_conf_change_add_and_remove_node():
+    c = Cluster(3)
+    leader = c.elect()
+    cc = rpb.ConfChange(action=rpb.ConfChange.ADD_NODE)
+    cc.consenter.id = 4
+    assert leader.propose_conf_change(cc)
+    c.flush()
+    assert 4 in leader.voters
+    # quorum is now 3 of 4
+    cc2 = rpb.ConfChange(action=rpb.ConfChange.REMOVE_NODE)
+    cc2.consenter.id = 4
+    leader.propose_conf_change(cc2)
+    c.flush()
+    assert 4 not in leader.voters
+
+
+def test_quorum_loss_blocks_commit():
+    c = Cluster(3)
+    leader = c.elect()
+    c.dropped.update(set(c.nodes) - {leader.id})
+    leader.propose(b"stuck")
+    c.tick_all(5)
+    assert c.applied[leader.id] == []  # cannot commit without quorum
+
+
+# ---------------------------------------------------------------------------
+# WAL
+# ---------------------------------------------------------------------------
+
+def test_wal_roundtrip_and_torn_tail(tmp_path):
+    w = WAL(str(tmp_path))
+    hs, log, snap = w.load()
+    assert hs.term == 0 and log.last_index == 0 and snap is None
+    entries = [
+        rpb.Entry(index=1, term=1, data=b"a"),
+        rpb.Entry(index=2, term=1, data=b"b"),
+    ]
+    w.save(rpb.HardState(term=1, voted_for=2, commit=2), entries)
+    w.close()
+    # simulate a torn final write
+    path = os.path.join(str(tmp_path), "raft.wal")
+    with open(path, "ab") as f:
+        f.write(b"\x00\x00\x00\xffgarbage")
+    w2 = WAL(str(tmp_path))
+    hs2, log2, _ = w2.load()
+    assert hs2.term == 1 and hs2.voted_for == 2 and hs2.commit == 2
+    assert [e.data for e in log2.entries] == [b"a", b"b"]
+    w2.close()
+
+
+def test_wal_snapshot_compacts_replay(tmp_path):
+    w = WAL(str(tmp_path))
+    w.load()
+    w.save(None, [rpb.Entry(index=i, term=1, data=b"e%d" % i) for i in (1, 2, 3)])
+    snap = rpb.Snapshot()
+    snap.meta.index = 2
+    snap.meta.term = 1
+    snap.meta.voters.extend([1, 2, 3])
+    snap.block_number = 7
+    w.save_snapshot(snap)
+    w.close()
+    w2 = WAL(str(tmp_path))
+    hs, log, snap2 = w2.load()
+    assert snap2.block_number == 7
+    assert log.snap_index == 2
+    assert [e.data for e in log.entries] == [b"e3"]
+    w2.close()
+
+
+# ---------------------------------------------------------------------------
+# RaftChain: 3 ordering nodes, in-process transport, real block stores
+# ---------------------------------------------------------------------------
+
+def _mk_chain(nid, transport, tmp_path, consenters, genesis, **kw):
+    store = BlockStore(None, name=f"orderer{nid}")
+    store.add_block(genesis)
+    writer = BlockWriter(store)
+    delivered = []
+    chain = RaftChain(
+        "testchannel",
+        nid,
+        consenters,
+        BlockCutter(max_message_count=2),
+        writer,
+        transport,
+        wal_dir=str(tmp_path / f"wal{nid}"),
+        batch_timeout_s=0.2,
+        tick_interval_s=0.01,
+        on_block=delivered.append,
+        **kw,
+    )
+    transport.register(nid, chain.handle_step)
+    return chain, store, delivered
+
+
+def _genesis():
+    blk = protoutil.new_block(0, b"")
+    blk.data.data.append(b"genesis-config")
+    blk.header.data_hash = protoutil.block_data_hash(blk.data)
+    return blk
+
+
+def _env(data: bytes) -> common_pb2.Envelope:
+    return common_pb2.Envelope(payload=data)
+
+
+def _wait(pred, timeout=10.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timeout waiting for {msg}")
+
+
+@pytest.fixture
+def chain_cluster(tmp_path):
+    transport = InProcTransport()
+    consenters = [rpb.Consenter(id=i) for i in (1, 2, 3)]
+    genesis = _genesis()
+    chains = {}
+    for nid in (1, 2, 3):
+        chains[nid] = _mk_chain(nid, transport, tmp_path, consenters, genesis)
+    for c, _, _ in chains.values():
+        c.start()
+    yield transport, chains
+    for c, _, _ in chains.values():
+        if not c._halted.is_set():
+            c.halt()
+
+
+def _leader(chains, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        for nid, (c, _, _) in chains.items():
+            if c.is_leader:
+                return nid
+        time.sleep(0.02)
+    raise AssertionError("no chain leader")
+
+
+def test_chain_orders_and_replicates_blocks(chain_cluster):
+    transport, chains = chain_cluster
+    lead = _leader(chains)
+    leader_chain = chains[lead][0]
+    for i in range(4):
+        leader_chain.order(_env(b"tx-%d" % i))
+    # 4 txs, cutter max 2 -> blocks 1 and 2 on every node
+    for nid, (c, store, delivered) in chains.items():
+        _wait(lambda s=store: s.height == 3, msg=f"height 3 on node {nid}")
+    blk1 = chains[1][1].get_block_by_number(1)
+    assert list(blk1.data.data) == [
+        _env(b"tx-0").SerializeToString(),
+        _env(b"tx-1").SerializeToString(),
+    ]
+    # all stores identical
+    h1 = protoutil.block_header_hash(blk1.header)
+    for nid in (2, 3):
+        assert (
+            protoutil.block_header_hash(
+                chains[nid][1].get_block_by_number(1).header
+            )
+            == h1
+        )
+
+
+def test_chain_follower_forwards_to_leader(chain_cluster):
+    transport, chains = chain_cluster
+    lead = _leader(chains)
+    follower = next(nid for nid in chains if nid != lead)
+    chains[follower][0].order(_env(b"via-follower"))
+    chains[follower][0].order(_env(b"via-follower-2"))
+    for nid, (c, store, _) in chains.items():
+        _wait(lambda s=store: s.height == 2, msg=f"block on node {nid}")
+
+
+def test_chain_batch_timeout_cuts_partial_block(chain_cluster):
+    transport, chains = chain_cluster
+    lead = _leader(chains)
+    chains[lead][0].order(_env(b"lonely"))
+    _wait(lambda: chains[lead][1].height == 2, msg="timeout cut")
+
+
+def test_chain_restart_recovers_from_wal(tmp_path):
+    transport = InProcTransport()
+    consenters = [rpb.Consenter(id=1)]
+    genesis = _genesis()
+    chain, store, _ = _mk_chain(1, transport, tmp_path, consenters, genesis)
+    chain.start()
+    chain.order(_env(b"a"))
+    chain.order(_env(b"b"))
+    _wait(lambda: store.height == 2, msg="block before restart")
+    chain.halt()
+    transport.unregister(1)
+
+    # "restart": same WAL dir, fresh empty-but-genesis block store replays
+    # committed raft entries into the writer
+    store2 = BlockStore(None, name="orderer1-restarted")
+    store2.add_block(genesis)
+    writer2 = BlockWriter(store2)
+    chain2 = RaftChain(
+        "testchannel",
+        1,
+        consenters,
+        BlockCutter(max_message_count=2),
+        writer2,
+        transport,
+        wal_dir=str(tmp_path / "wal1"),
+        batch_timeout_s=0.2,
+        tick_interval_s=0.01,
+    )
+    transport.register(1, chain2.handle_step)
+    chain2.start()
+    _wait(lambda: store2.height == 2, msg="block replayed from WAL")
+    assert (
+        store2.get_block_by_number(1).SerializeToString()
+        == store.get_block_by_number(1).SerializeToString()
+    )
+    chain2.order(_env(b"c"))
+    chain2.order(_env(b"d"))
+    _wait(lambda: store2.height == 3, msg="new block after restart")
+    chain2.halt()
