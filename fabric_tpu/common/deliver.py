@@ -1,0 +1,176 @@
+"""Generic block-delivery service.
+
+Capability parity with the reference's common/deliver
+(deliver.go:157 Handle, :199 deliverBlocks): parse a signed SeekInfo
+envelope, policy-check the requester (re-evaluated when channel config
+changes, via the config sequence gate), then stream blocks from the
+channel's reader between the requested positions, optionally blocking
+until new blocks arrive (SeekInfo BLOCK_UNTIL_READY).
+
+Transport-agnostic: `deliver()` is a generator of (status, block) events,
+so the same engine backs the orderer's client Deliver, the peer's
+DeliverFiltered, and in-process consumption in tests.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from fabric_tpu.protos.common import common_pb2
+from fabric_tpu.protos.orderer import ab_pb2
+from fabric_tpu.protoutil import SignedData
+from fabric_tpu import protoutil
+
+
+class BlockNotifier:
+    """Height watcher: deliver streams block on it until the chain grows."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+
+    def notify(self) -> None:
+        with self._cond:
+            self._cond.notify_all()
+
+    def wait(self, timeout: float = 1.0) -> None:
+        with self._cond:
+            self._cond.wait(timeout)
+
+
+class DeliverError(Exception):
+    def __init__(self, status):
+        self.status = status
+        super().__init__(f"deliver error: status {status}")
+
+
+class DeliverService:
+    def __init__(
+        self,
+        chain_getter,
+        csp,
+        policy_path: str = "/Channel/Readers",
+        notifier: BlockNotifier | None = None,
+    ):
+        """chain_getter(channel_id) -> object with .store (BlockStore) and
+        .bundle (channel config Bundle), or None."""
+        self._get = chain_getter
+        self._csp = csp
+        self._policy_path = policy_path
+        self.notifier = notifier or BlockNotifier()
+        self._stopped = threading.Event()
+
+    def stop(self) -> None:
+        self._stopped.set()
+        self.notifier.notify()
+
+    # -- core --------------------------------------------------------------
+
+    def _check_access(self, env: common_pb2.Envelope, support) -> bool:
+        payload = common_pb2.Payload.FromString(env.payload)
+        shdr = common_pb2.SignatureHeader.FromString(payload.header.signature_header)
+        policy = support.bundle.policy_manager.get_policy(self._policy_path)
+        if policy is None:
+            return True
+        sd = [SignedData(env.payload, shdr.creator, env.signature)]
+        return policy.evaluate_signed_data(sd, self._csp)
+
+    @staticmethod
+    def _position(seek_pos: ab_pb2.SeekPosition, height: int) -> int | None:
+        kind = seek_pos.WhichOneof("Type")
+        if kind == "oldest":
+            return 0
+        if kind == "newest":
+            return max(height - 1, 0)
+        if kind == "specified":
+            return seek_pos.specified.number
+        return None
+
+    def deliver(self, env: common_pb2.Envelope):
+        """Yields ("block", Block) events then ("status", code).  Generator
+        returns after SeekInfo is exhausted or on error."""
+        chdr = protoutil.channel_header(env)
+        support = self._get(chdr.channel_id)
+        if support is None:
+            yield ("status", common_pb2.NOT_FOUND)
+            return
+        if not self._check_access(env, support):
+            yield ("status", common_pb2.FORBIDDEN)
+            return
+        payload = common_pb2.Payload.FromString(env.payload)
+        try:
+            seek = ab_pb2.SeekInfo.FromString(payload.data)
+        except Exception:
+            yield ("status", common_pb2.BAD_REQUEST)
+            return
+        store = support.store
+        start = self._position(seek.start, store.height)
+        stop = self._position(seek.stop, store.height)
+        if start is None or stop is None:
+            yield ("status", common_pb2.BAD_REQUEST)
+            return
+        if stop < start and seek.stop.WhichOneof("Type") == "specified":
+            yield ("status", common_pb2.BAD_REQUEST)
+            return
+        num = start
+        config_seq = support.bundle.config.sequence
+        while num <= stop:
+            if self._stopped.is_set():
+                yield ("status", common_pb2.SERVICE_UNAVAILABLE)
+                return
+            # config may have changed: re-check access (deliver.go:221)
+            if support.bundle.config.sequence != config_seq:
+                config_seq = support.bundle.config.sequence
+                if not self._check_access(env, support):
+                    yield ("status", common_pb2.FORBIDDEN)
+                    return
+            if num >= store.height:
+                if seek.behavior == ab_pb2.SeekInfo.FAIL_IF_NOT_READY:
+                    yield ("status", common_pb2.NOT_FOUND)
+                    return
+                self.notifier.wait(0.25)
+                continue
+            blk = store.get_block_by_number(num)
+            if blk is None:
+                yield ("status", common_pb2.NOT_FOUND)
+                return
+            yield ("block", blk)
+            num += 1
+        yield ("status", common_pb2.SUCCESS)
+
+
+def make_seek_info_envelope(
+    channel_id: str,
+    start: int | str,
+    stop: int | str,
+    signer=None,
+    behavior=ab_pb2.SeekInfo.BLOCK_UNTIL_READY,
+) -> common_pb2.Envelope:
+    """Build the signed DELIVER_SEEK_INFO envelope clients send."""
+    seek = ab_pb2.SeekInfo(behavior=behavior)
+    for field, val in (("start", start), ("stop", stop)):
+        pos = getattr(seek, field)
+        if val == "oldest":
+            pos.oldest.SetInParent()
+        elif val == "newest":
+            pos.newest.SetInParent()
+        else:
+            pos.specified.number = int(val)
+    chdr = protoutil.make_channel_header(
+        common_pb2.DELIVER_SEEK_INFO, channel_id=channel_id
+    )
+    creator = signer.serialize() if signer is not None else b""
+    shdr = protoutil.make_signature_header(creator, protoutil.random_nonce())
+    payload = common_pb2.Payload(data=seek.SerializeToString())
+    payload.header.channel_header = chdr.SerializeToString()
+    payload.header.signature_header = shdr.SerializeToString()
+    raw = payload.SerializeToString()
+    sig = signer.sign(raw) if signer is not None else b""
+    return common_pb2.Envelope(payload=raw, signature=sig)
+
+
+__all__ = [
+    "DeliverService",
+    "BlockNotifier",
+    "DeliverError",
+    "make_seek_info_envelope",
+]

@@ -1,0 +1,49 @@
+"""Client-facing Broadcast handler.
+
+Capability parity with the reference's orderer/common/broadcast
+(broadcast.go:66 Handle, :136 ProcessMessage): look up the channel,
+classify the message, run the channel's msgprocessor filters, then
+enqueue to the consenter (Order/Configure).  Returns a BroadcastResponse
+status per message, as the AtomicBroadcast.Broadcast stream does.
+"""
+
+from __future__ import annotations
+
+from fabric_tpu.orderer.msgprocessor import Classification, MsgProcessorError
+from fabric_tpu.protos.common import common_pb2
+
+
+class BroadcastHandler:
+    def __init__(self, registrar):
+        self._registrar = registrar
+
+    def process_message(self, env: common_pb2.Envelope) -> int:
+        """Returns a common_pb2.Status code (SUCCESS on enqueue)."""
+        try:
+            cs = self._registrar.broadcast_channel_support(env)
+        except KeyError:
+            return common_pb2.NOT_FOUND
+        except Exception:
+            return common_pb2.BAD_REQUEST
+        try:
+            kind = cs.processor.classify(env)
+            if kind == Classification.NORMAL:
+                seq = cs.processor.process_normal_msg(env)
+                cs.chain.wait_ready()
+                cs.chain.order(env, seq)
+            elif kind == Classification.CONFIG_UPDATE:
+                new_env, seq = cs.processor.process_config_update_msg(env)
+                cs.chain.wait_ready()
+                cs.chain.configure(new_env, seq)
+            else:
+                return common_pb2.BAD_REQUEST  # raw CONFIG not accepted here
+        except MsgProcessorError:
+            return common_pb2.FORBIDDEN
+        except NotImplementedError:
+            return common_pb2.NOT_IMPLEMENTED
+        except RuntimeError:
+            return common_pb2.SERVICE_UNAVAILABLE
+        return common_pb2.SUCCESS
+
+
+__all__ = ["BroadcastHandler"]

@@ -1,0 +1,197 @@
+"""Multichannel registrar: one ordering pipeline per channel.
+
+Capability parity with the reference's registrar
+(orderer/common/multichannel/registrar.go:134 NewRegistrar, :155
+Initialize, :248 BroadcastChannelSupport, :326 CreateChain):
+a registry mapping channel id -> ChainSupport, where ChainSupport binds
+the channel's ledger (block store), msgprocessor, blockwriter and
+consenter.  New channels are created from a genesis/config block; the
+consenter type is read from the channel config's ConsensusType value.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+from fabric_tpu.common.channelconfig import bundle_from_genesis
+from fabric_tpu.ledger.blkstorage import BlockStore
+from fabric_tpu.orderer.blockcutter import BlockCutter
+from fabric_tpu.orderer.blockwriter import BlockWriter
+from fabric_tpu.orderer.msgprocessor import StandardChannelProcessor
+from fabric_tpu.orderer.solo import SoloChain
+from fabric_tpu.protos.common import common_pb2
+from fabric_tpu.protos.orderer import raft_pb2 as rpb
+from fabric_tpu import protoutil
+
+
+class ChainSupport:
+    """Everything the broadcast/deliver handlers need for one channel."""
+
+    def __init__(self, channel_id, bundle, store, writer, processor, chain):
+        self.channel_id = channel_id
+        self.bundle = bundle
+        self.store = store
+        self.writer = writer
+        self.processor = processor
+        self.chain = chain
+
+    def halt(self) -> None:
+        self.chain.halt()
+
+
+class Registrar:
+    def __init__(
+        self,
+        root_dir: str | None,
+        csp,
+        signer=None,
+        node_id: int = 1,
+        transport=None,
+        consenter_overrides: dict | None = None,
+    ):
+        self.root_dir = root_dir
+        self.csp = csp
+        self.signer = signer
+        self.node_id = node_id
+        self.transport = transport
+        self._chains: dict[str, ChainSupport] = {}
+        self._lock = threading.Lock()
+        self._consenter_overrides = consenter_overrides or {}
+        self._on_block_hooks: list = []
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def startup(self, genesis_blocks: list[common_pb2.Block]) -> None:
+        for blk in genesis_blocks:
+            self.create_chain(blk)
+
+    def create_chain(self, genesis: common_pb2.Block) -> ChainSupport:
+        bundle = bundle_from_genesis(genesis, self.csp)
+        channel_id = bundle.channel_id
+        with self._lock:
+            if channel_id in self._chains:
+                return self._chains[channel_id]
+        store_dir = (
+            os.path.join(self.root_dir, "chains", channel_id)
+            if self.root_dir
+            else None
+        )
+        store = BlockStore(store_dir, name=f"orderer-{channel_id}")
+        if store.height == 0:
+            store.add_block(genesis)
+        writer = BlockWriter(store, signer=self.signer)
+        oc = bundle.orderer_config
+        cutter = BlockCutter.from_orderer_config(oc) if oc else BlockCutter()
+        processor = StandardChannelProcessor(channel_id, bundle, self.csp)
+        chain = self._build_consenter(channel_id, bundle, cutter, writer)
+        cs = ChainSupport(channel_id, bundle, store, writer, processor, chain)
+        with self._lock:
+            self._chains[channel_id] = cs
+        chain.start()
+        return cs
+
+    def _build_consenter(self, channel_id, bundle, cutter, writer):
+        oc = bundle.orderer_config
+        ctype = (oc.consensus_type if oc else "solo") or "solo"
+        ctype = self._consenter_overrides.get("type", ctype)
+        timeout = oc.batch_timeout_s if oc else 2.0
+        on_block = lambda blk: self._fan_out(channel_id, blk)
+        if ctype in ("raft", "etcdraft"):
+            from fabric_tpu.orderer.raft import RaftChain
+
+            meta = rpb.ConfigMetadata()
+            if oc and oc.consensus_metadata:
+                meta.ParseFromString(oc.consensus_metadata)
+            consenters = list(meta.consenters) or [rpb.Consenter(id=self.node_id)]
+            opts = meta.options
+            wal_dir = (
+                os.path.join(self.root_dir, "raft", channel_id)
+                if self.root_dir
+                else None
+            )
+            chain = RaftChain(
+                channel_id,
+                self.node_id,
+                consenters,
+                cutter,
+                writer,
+                self.transport,
+                wal_dir=wal_dir,
+                batch_timeout_s=timeout,
+                tick_interval_s=(opts.tick_interval_ms or 50) / 1000.0,
+                election_tick=opts.election_tick or 10,
+                heartbeat_tick=opts.heartbeat_tick or 1,
+                snapshot_interval_size=opts.snapshot_interval_size or (16 << 20),
+                on_block=on_block,
+            )
+            if self.transport is not None:
+                self.transport.register_channel(channel_id, chain.handle_step)
+            return chain
+        return SoloChain(cutter, writer, timeout, on_block=on_block)
+
+    # -- lookups (BroadcastChannelSupport / GetChain) ----------------------
+
+    def get_chain(self, channel_id: str) -> ChainSupport | None:
+        with self._lock:
+            return self._chains.get(channel_id)
+
+    def channel_list(self) -> list[str]:
+        with self._lock:
+            return sorted(self._chains)
+
+    def broadcast_channel_support(self, env: common_pb2.Envelope) -> ChainSupport:
+        chdr = protoutil.channel_header(env)
+        cs = self.get_chain(chdr.channel_id)
+        if cs is None:
+            raise KeyError(f"channel {chdr.channel_id!r} not found")
+        return cs
+
+    # -- block fan-out (deliver subscriptions) -----------------------------
+
+    def add_block_listener(self, hook) -> None:
+        """hook(channel_id, block) on every block written by any chain."""
+        self._on_block_hooks.append(hook)
+
+    def _fan_out(self, channel_id: str, blk: common_pb2.Block) -> None:
+        for hook in self._on_block_hooks:
+            hook(channel_id, blk)
+
+    def halt_all(self) -> None:
+        with self._lock:
+            chains = list(self._chains.values())
+        for cs in chains:
+            cs.halt()
+
+
+class ChannelStepRouter:
+    """Adapts a cluster transport to per-channel raft chains (the reference's
+    cluster service dispatches Step requests by channel —
+    orderer/common/cluster/service.go)."""
+
+    def __init__(self, transport):
+        self._transport = transport
+        self._handlers: dict[str, callable] = {}
+        if hasattr(transport, "set_handler"):
+            transport.set_handler(self._route)
+
+    def register_channel(self, channel_id: str, handler) -> None:
+        self._handlers[channel_id] = handler
+
+    def register(self, node_id: int, handler) -> None:
+        # in-proc transports register whole nodes; route per channel
+        self._transport.register(node_id, self._route)
+
+    def _route(self, req: rpb.StepRequest) -> None:
+        h = self._handlers.get(req.channel)
+        if h is not None:
+            h(req)
+
+    def send(self, frm: int, to: int, req: rpb.StepRequest) -> None:
+        self._transport.send(frm, to, req)
+
+    def set_peer(self, node_id: int, addr) -> None:
+        self._transport.set_peer(node_id, addr)
+
+
+__all__ = ["Registrar", "ChainSupport", "ChannelStepRouter"]

@@ -10,6 +10,7 @@ from fabric_tpu.protoutil.common import (
     SignedData,
     compute_tx_id,
     check_tx_id,
+    channel_header,
     make_channel_header,
     make_signature_header,
     make_payload_bytes,
@@ -46,6 +47,7 @@ __all__ = [
     "SignedData",
     "compute_tx_id",
     "check_tx_id",
+    "channel_header",
     "make_channel_header",
     "make_signature_header",
     "make_payload_bytes",

@@ -96,3 +96,10 @@ def unmarshal_channel_header(raw: bytes) -> common_pb2.ChannelHeader:
 
 def unmarshal_signature_header(raw: bytes) -> common_pb2.SignatureHeader:
     return common_pb2.SignatureHeader.FromString(raw)
+
+
+def channel_header(env: common_pb2.Envelope) -> common_pb2.ChannelHeader:
+    """Extract the ChannelHeader from an Envelope (reference
+    protoutil/commonutils.go ChannelHeader)."""
+    payload = common_pb2.Payload.FromString(env.payload)
+    return common_pb2.ChannelHeader.FromString(payload.header.channel_header)

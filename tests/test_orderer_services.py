@@ -1,0 +1,167 @@
+"""Registrar + broadcast + deliver service tests (reference
+orderer/common/multichannel, broadcast, common/deliver test strategy:
+in-process fakes, real block stores)."""
+
+import threading
+import time
+
+import pytest
+
+from fabric_tpu.common.deliver import DeliverService, make_seek_info_envelope
+from fabric_tpu.orderer.broadcast import BroadcastHandler
+from fabric_tpu.orderer.multichannel import Registrar
+from fabric_tpu.protos.common import common_pb2
+from fabric_tpu.protos.orderer import ab_pb2
+from fabric_tpu import protoutil
+
+from fabric_tpu.common import configtx_builder as ctx
+from fabric_tpu.msp import msp_config_from_ca
+
+from orgfix import make_org
+
+
+class _OrgSetup:
+    def __init__(self):
+        self.org1 = make_org("Org1MSP")
+        oorg = make_org("OrdererMSP")
+        app = ctx.application_group(
+            {"Org1": ctx.org_group("Org1MSP", msp_config_from_ca(self.org1.ca, "Org1MSP"))}
+        )
+        ordg = ctx.orderer_group(
+            {
+                "OrdererOrg": ctx.org_group(
+                    "OrdererMSP", msp_config_from_ca(oorg.ca, "OrdererMSP")
+                )
+            },
+            consensus_type="solo",
+            max_message_count=2,
+            batch_timeout="250ms",
+        )
+        self.channel_id = "testchannel"
+        self.genesis = ctx.genesis_block(
+            self.channel_id, ctx.channel_group(app, ordg)
+        )
+        self.csp = self.org1.csp
+        self.admin = self.org1.signer("admin", role_ou="admin")
+
+
+@pytest.fixture(scope="module")
+def org():
+    return _OrgSetup()
+
+
+@pytest.fixture
+def registrar(org, tmp_path):
+    reg = Registrar(str(tmp_path), org.csp)
+    reg.startup([org.genesis])
+    yield reg
+    reg.halt_all()
+
+
+def _tx_env(org, data: bytes) -> common_pb2.Envelope:
+    chdr = protoutil.make_channel_header(
+        common_pb2.ENDORSER_TRANSACTION, channel_id=org.channel_id
+    )
+    shdr = protoutil.make_signature_header(
+        org.admin.serialize(), protoutil.random_nonce()
+    )
+    payload = common_pb2.Payload(data=data)
+    payload.header.channel_header = chdr.SerializeToString()
+    payload.header.signature_header = shdr.SerializeToString()
+    raw = payload.SerializeToString()
+    return common_pb2.Envelope(payload=raw, signature=org.admin.sign(raw))
+
+
+def test_broadcast_orders_into_blocks(registrar, org):
+    h = BroadcastHandler(registrar)
+    cs = registrar.get_chain(org.channel_id)
+    notifier_fired = threading.Event()
+    registrar.add_block_listener(lambda ch, blk: notifier_fired.set())
+    for i in range(3):
+        assert h.process_message(_tx_env(org, b"d%d" % i)) == common_pb2.SUCCESS
+    deadline = time.monotonic() + 10
+    while cs.store.height < 2 and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert cs.store.height >= 2
+    assert notifier_fired.is_set()
+
+
+def test_broadcast_unknown_channel(registrar, org):
+    h = BroadcastHandler(registrar)
+    chdr = protoutil.make_channel_header(
+        common_pb2.ENDORSER_TRANSACTION, channel_id="no-such-channel"
+    )
+    payload = common_pb2.Payload(data=b"x")
+    payload.header.channel_header = chdr.SerializeToString()
+    env = common_pb2.Envelope(payload=payload.SerializeToString())
+    assert h.process_message(env) == common_pb2.NOT_FOUND
+
+
+def test_broadcast_rejects_unsigned(registrar, org):
+    h = BroadcastHandler(registrar)
+    chdr = protoutil.make_channel_header(
+        common_pb2.ENDORSER_TRANSACTION, channel_id=org.channel_id
+    )
+    shdr = protoutil.make_signature_header(b"not-an-identity", b"nonce")
+    payload = common_pb2.Payload(data=b"x")
+    payload.header.channel_header = chdr.SerializeToString()
+    payload.header.signature_header = shdr.SerializeToString()
+    env = common_pb2.Envelope(payload=payload.SerializeToString())
+    assert h.process_message(env) == common_pb2.FORBIDDEN
+
+
+def test_deliver_streams_existing_and_new_blocks(registrar, org):
+    h = BroadcastHandler(registrar)
+    svc = DeliverService(registrar.get_chain, org.csp)
+    registrar.add_block_listener(lambda ch, blk: svc.notifier.notify())
+    for i in range(3):
+        h.process_message(_tx_env(org, b"d%d" % i))
+    cs = registrar.get_chain(org.channel_id)
+    deadline = time.monotonic() + 10
+    while cs.store.height < 2 and time.monotonic() < deadline:
+        time.sleep(0.02)
+
+    env = make_seek_info_envelope(
+        org.channel_id, 0, cs.store.height - 1, signer=org.admin,
+        behavior=ab_pb2.SeekInfo.FAIL_IF_NOT_READY,
+    )
+    events = list(svc.deliver(env))
+    kinds = [k for k, _ in events]
+    assert kinds[-1] == "status" and events[-1][1] == common_pb2.SUCCESS
+    blocks = [b for k, b in events if k == "block"]
+    assert [b.header.number for b in blocks] == list(range(cs.store.height))
+    assert blocks[0].header.number == 0  # genesis
+
+
+def test_deliver_block_until_ready_waits(registrar, org):
+    svc = DeliverService(registrar.get_chain, org.csp)
+    registrar.add_block_listener(lambda ch, blk: svc.notifier.notify())
+    h = BroadcastHandler(registrar)
+    got: list = []
+
+    def consume():
+        env = make_seek_info_envelope(org.channel_id, 1, 1, signer=org.admin)
+        for kind, item in svc.deliver(env):
+            got.append((kind, item))
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    time.sleep(0.2)
+    assert not got  # waiting for block 1
+    for i in range(3):
+        h.process_message(_tx_env(org, b"w%d" % i))
+    t.join(timeout=10)
+    assert got and got[0][0] == "block" and got[0][1].header.number == 1
+
+
+def test_deliver_forbidden_without_signature(registrar, org):
+    svc = DeliverService(registrar.get_chain, org.csp)
+    env = make_seek_info_envelope(org.channel_id, 0, 0, signer=None)
+    events = list(svc.deliver(env))
+    assert events == [("status", common_pb2.FORBIDDEN)]
+
+
+def test_deliver_unknown_channel(registrar, org):
+    svc = DeliverService(registrar.get_chain, org.csp)
+    env = make_seek_info_envelope("ghost", 0, 0, signer=org.admin)
+    assert list(svc.deliver(env)) == [("status", common_pb2.NOT_FOUND)]
