@@ -1,0 +1,30 @@
+from fabric_tpu.gossip.comm import (
+    GossipComm,
+    InProcGossipComm,
+    InProcGossipNet,
+    MessageCryptoService,
+    SignerMCS,
+    TCPGossipComm,
+)
+from fabric_tpu.gossip.core import ChannelGossip, MessageStore
+from fabric_tpu.gossip.discovery import Discovery, DiscoveryCore
+from fabric_tpu.gossip.election import LeaderElection
+from fabric_tpu.gossip.service import GossipRunner, GossipService
+from fabric_tpu.gossip.state import StateProvider
+
+__all__ = [
+    "GossipComm",
+    "InProcGossipComm",
+    "InProcGossipNet",
+    "TCPGossipComm",
+    "MessageCryptoService",
+    "SignerMCS",
+    "ChannelGossip",
+    "MessageStore",
+    "Discovery",
+    "DiscoveryCore",
+    "LeaderElection",
+    "GossipService",
+    "GossipRunner",
+    "StateProvider",
+]

@@ -1,0 +1,319 @@
+"""Gossip comm: authenticated peer-to-peer message streams.
+
+Capability parity with the reference's gossip/comm
+(comm_impl.go:60 NewCommInstance — mTLS gRPC GossipStream, handshake
+binding the connection to a signed identity, connection store, demux to
+subscribers; conn.go send buffers; ack.go send-with-ack).  Two transports
+behind one interface, like the raft cluster comm:
+
+  InProcGossipNet — process-local registry with partition controls, the
+                    unit-test fabric (reference gossip/comm/mock role).
+  TCPGossipComm   — length-prefixed SignedGossipMessage frames over TCP
+                    with a ConnEstablish handshake on each new stream.
+
+Security note: signatures cover the serialized GossipMessage payload;
+verification is the receiver's job via the supplied MessageCryptoService
+(reference gossip/api/crypto.go), so discovery/election can reject
+forged alive/leadership claims.
+"""
+
+from __future__ import annotations
+
+import queue
+import socket
+import struct
+import threading
+
+from fabric_tpu.protos.gossip import message_pb2 as gpb
+
+_LEN = struct.Struct(">I")
+
+
+class ReceivedMessage:
+    """A deserialized, signature-checked inbound message + reply path."""
+
+    def __init__(self, msg: gpb.GossipMessage, sender_pki: bytes, respond):
+        self.msg = msg
+        self.sender_pki = sender_pki
+        self._respond = respond
+
+    def respond(self, msg: gpb.GossipMessage) -> None:
+        self._respond(msg)
+
+
+class MessageCryptoService:
+    """Pluggable crypto callbacks (reference gossip/api).  Default dev
+    implementation: identity bytes are the pki-id; signatures optional."""
+
+    def get_pki_id(self, identity: bytes) -> bytes:
+        import hashlib
+
+        return hashlib.sha256(identity).digest()[:16]
+
+    def sign(self, payload: bytes) -> bytes:
+        return b""
+
+    def verify(self, identity: bytes, signature: bytes, payload: bytes) -> bool:
+        return True
+
+
+class SignerMCS(MessageCryptoService):
+    """MSP-backed crypto service: sign with the node's signing identity,
+    verify against the sender's serialized identity via the deserializer."""
+
+    def __init__(self, signer, deserializer, csp):
+        self._signer = signer
+        self._deserializer = deserializer
+        self._csp = csp
+
+    def sign(self, payload: bytes) -> bytes:
+        return self._signer.sign(payload)
+
+    def verify(self, identity: bytes, signature: bytes, payload: bytes) -> bool:
+        try:
+            ident = self._deserializer.deserialize_identity(identity)
+            return ident.verify(payload, signature, self._csp)
+        except Exception:
+            return False
+
+
+class GossipComm:
+    """Common plumbing: wrap/sign outbound, verify/demux inbound."""
+
+    def __init__(self, self_identity: bytes, mcs: MessageCryptoService | None = None):
+        self.mcs = mcs or MessageCryptoService()
+        self.identity = self_identity
+        self.pki_id = self.mcs.get_pki_id(self_identity)
+        self._subscribers: list = []
+        self._known_identities: dict[bytes, bytes] = {
+            self.pki_id: self_identity
+        }
+        self._lock = threading.Lock()
+
+    def subscribe(self, handler) -> None:
+        """handler(ReceivedMessage)"""
+        self._subscribers.append(handler)
+
+    def learn_identity(self, identity: bytes) -> bytes:
+        pki = self.mcs.get_pki_id(identity)
+        with self._lock:
+            self._known_identities[pki] = identity
+        return pki
+
+    def identity_of(self, pki_id: bytes) -> bytes | None:
+        with self._lock:
+            return self._known_identities.get(pki_id)
+
+    def wrap(self, msg: gpb.GossipMessage) -> gpb.SignedGossipMessage:
+        payload = msg.SerializeToString()
+        return gpb.SignedGossipMessage(
+            payload=payload, signature=self.mcs.sign(payload)
+        )
+
+    def _dispatch(self, signed: gpb.SignedGossipMessage, sender_pki: bytes, respond):
+        msg = gpb.GossipMessage.FromString(signed.payload)
+        ident = self.identity_of(sender_pki)
+        if signed.signature and ident is not None:
+            if not self.mcs.verify(ident, signed.signature, signed.payload):
+                return  # forged
+        rm = ReceivedMessage(msg, sender_pki, respond)
+        for h in list(self._subscribers):
+            h(rm)
+
+
+class InProcGossipNet:
+    """Shared fabric connecting InProcGossipComm endpoints by endpoint name."""
+
+    def __init__(self):
+        self._peers: dict[str, "InProcGossipComm"] = {}
+        self._cut: set[frozenset] = set()
+        self._lock = threading.Lock()
+
+    def register(self, comm: "InProcGossipComm") -> None:
+        with self._lock:
+            self._peers[comm.endpoint] = comm
+
+    def unregister(self, endpoint: str) -> None:
+        with self._lock:
+            self._peers.pop(endpoint, None)
+
+    def partition(self, a: str, b: str) -> None:
+        with self._lock:
+            self._cut.add(frozenset((a, b)))
+
+    def heal(self) -> None:
+        with self._lock:
+            self._cut.clear()
+
+    def route(self, frm: "InProcGossipComm", to_endpoint: str, signed) -> None:
+        with self._lock:
+            if frozenset((frm.endpoint, to_endpoint)) in self._cut:
+                return
+            peer = self._peers.get(to_endpoint)
+        if peer is not None:
+            peer.receive_from(frm, signed)
+
+
+class InProcGossipComm(GossipComm):
+    def __init__(self, endpoint: str, net: InProcGossipNet, self_identity: bytes,
+                 mcs=None):
+        super().__init__(self_identity, mcs)
+        self.endpoint = endpoint
+        self._net = net
+        net.register(self)
+
+    def send(self, to_endpoint: str, msg: gpb.GossipMessage) -> None:
+        self._net.route(self, to_endpoint, self.wrap(msg))
+
+    def receive_from(self, frm: "InProcGossipComm", signed) -> None:
+        # first contact teaches us the peer's identity (handshake analogue)
+        self.learn_identity(frm.identity)
+        respond = lambda m: frm.receive_from(self, self.wrap(m))
+        self._dispatch(signed, frm.pki_id, respond)
+
+    def close(self) -> None:
+        self._net.unregister(self.endpoint)
+
+
+class TCPGossipComm(GossipComm):
+    """Real deployment transport: one listener; outbound connections cached
+    per endpoint; ConnEstablish handshake exchanges identities."""
+
+    def __init__(self, listen_addr: tuple[str, int], self_identity: bytes, mcs=None):
+        super().__init__(self_identity, mcs)
+        self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._server.bind(listen_addr)
+        self._server.listen(64)
+        self.addr = self._server.getsockname()
+        self.endpoint = f"{self.addr[0]}:{self.addr[1]}"
+        self._out: dict[str, queue.Queue] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        threading.Thread(target=self._accept, daemon=True).start()
+
+    # -- outbound ----------------------------------------------------------
+
+    def send(self, to_endpoint: str, msg: gpb.GossipMessage) -> None:
+        with self._lock:
+            q = self._out.get(to_endpoint)
+            if q is None:
+                q = queue.Queue(maxsize=1024)
+                self._out[to_endpoint] = q
+                threading.Thread(
+                    target=self._sender, args=(to_endpoint, q), daemon=True
+                ).start()
+        try:
+            q.put_nowait(self.wrap(msg).SerializeToString())
+        except queue.Full:
+            pass  # gossip is loss-tolerant
+
+    def _handshake_frame(self) -> bytes:
+        ce = gpb.ConnEstablish(pki_id=self.pki_id, identity=self.identity)
+        ce.signature = self.mcs.sign(self.pki_id)
+        raw = ce.SerializeToString()
+        return _LEN.pack(len(raw)) + raw
+
+    def _sender(self, endpoint: str, q: queue.Queue) -> None:
+        sock = None
+        while not self._stop.is_set():
+            try:
+                data = q.get(timeout=0.5)
+            except queue.Empty:
+                continue
+            for _ in range(2):  # one reconnect attempt per message
+                if sock is None:
+                    try:
+                        host, port = endpoint.rsplit(":", 1)
+                        sock = socket.create_connection((host, int(port)), timeout=2)
+                        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                        sock.sendall(self._handshake_frame())
+                    except OSError:
+                        sock = None
+                        break
+                try:
+                    sock.sendall(_LEN.pack(len(data)) + data)
+                    break
+                except OSError:
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+                    sock = None
+
+    # -- inbound -----------------------------------------------------------
+
+    def _accept(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._server.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,), daemon=True).start()
+
+    @staticmethod
+    def _read_frame(conn, buf: bytearray) -> bytes | None:
+        while len(buf) < _LEN.size:
+            chunk = conn.recv(65536)
+            if not chunk:
+                return None
+            buf.extend(chunk)
+        (ln,) = _LEN.unpack_from(bytes(buf[: _LEN.size]))
+        while len(buf) < _LEN.size + ln:
+            chunk = conn.recv(65536)
+            if not chunk:
+                return None
+            buf.extend(chunk)
+        frame = bytes(buf[_LEN.size : _LEN.size + ln])
+        del buf[: _LEN.size + ln]
+        return frame
+
+    def _serve(self, conn: socket.socket) -> None:
+        buf = bytearray()
+        conn.settimeout(60)
+        try:
+            frame = self._read_frame(conn, buf)
+            if frame is None:
+                return
+            ce = gpb.ConnEstablish.FromString(frame)
+            if self.mcs.get_pki_id(ce.identity) != ce.pki_id:
+                return  # identity/pki mismatch
+            if ce.signature and not self.mcs.verify(
+                ce.identity, ce.signature, ce.pki_id
+            ):
+                return
+            self.learn_identity(ce.identity)
+            sender_pki = ce.pki_id
+            respond = lambda m: None  # responses go via send() to endpoints
+            while not self._stop.is_set():
+                frame = self._read_frame(conn, buf)
+                if frame is None:
+                    return
+                self._dispatch(
+                    gpb.SignedGossipMessage.FromString(frame), sender_pki, respond
+                )
+        except OSError:
+            return
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._server.close()
+        except OSError:
+            pass
+
+
+__all__ = [
+    "GossipComm",
+    "InProcGossipNet",
+    "InProcGossipComm",
+    "TCPGossipComm",
+    "MessageCryptoService",
+    "SignerMCS",
+    "ReceivedMessage",
+]

@@ -1,0 +1,211 @@
+"""Gossip core: per-channel block dissemination via push + pull.
+
+Capability parity with the reference's gossip/gossip package
+(gossip_impl.go Node; channel/channel.go per-channel message store and
+state-info; pull/pullstore.go + algo/pull.go hello/digest/request/
+response anti-entropy engine; batcher.go push emitter).  Deterministic
+tick-driven core like discovery: `tick()` runs one push round and one
+pull round; tests drive it synchronously.
+
+Push: newly added blocks are forwarded to `fanout` random channel peers.
+Pull: each round, pick a random peer, send hello; peer answers with the
+digests (block seq nums) it holds; we request what we miss; peer responds
+with the blocks.  StateInfo messages advertise ledger height so peers
+know who is ahead (used by state transfer).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+
+from fabric_tpu.protos.gossip import message_pb2 as gpb
+
+
+class MessageStore:
+    """Bounded per-channel store of data messages keyed by seq num
+    (reference gossip/gossip/msgstore with TTL; we bound by count)."""
+
+    def __init__(self, capacity: int = 200):
+        self._cap = capacity
+        self._by_seq: dict[int, bytes] = {}
+        self._lock = threading.Lock()
+
+    def add(self, seq: int, block_bytes: bytes) -> bool:
+        with self._lock:
+            if seq in self._by_seq:
+                return False
+            self._by_seq[seq] = block_bytes
+            while len(self._by_seq) > self._cap:
+                del self._by_seq[min(self._by_seq)]
+            return True
+
+    def digests(self) -> list[int]:
+        with self._lock:
+            return sorted(self._by_seq)
+
+    def get(self, seq: int) -> bytes | None:
+        with self._lock:
+            return self._by_seq.get(seq)
+
+
+class ChannelGossip:
+    def __init__(
+        self,
+        channel_id: str,
+        comm,
+        membership,  # callable -> list of alive peer endpoints in channel
+        fanout: int = 3,
+        store_capacity: int = 200,
+        on_block=None,
+        rng: random.Random | None = None,
+    ):
+        self.channel_id = channel_id
+        self._chan_bytes = channel_id.encode()
+        self._comm = comm
+        self._membership = membership
+        self._fanout = fanout
+        self.store = MessageStore(store_capacity)
+        self._on_block = on_block or (lambda seq, blk: None)
+        self._rng = rng or random.Random()
+        self._nonce = 0
+        self._pending_pulls: dict[int, str] = {}
+        self._heights: dict[bytes, int] = {}  # peer pki -> advertised height
+        self._height_eps: dict[bytes, str] = {}
+        self._lock = threading.Lock()
+        self.ledger_height = lambda: 0  # wired by the state layer
+        comm.subscribe(self._handle)
+
+    # -- outbound ----------------------------------------------------------
+
+    def _targets(self, k: int | None = None) -> list[str]:
+        peers = list(self._membership())
+        self._rng.shuffle(peers)
+        return peers[: (k or self._fanout)]
+
+    def add_block(self, seq: int, block_bytes: bytes, push: bool = True) -> None:
+        """Called by the delivery pipeline when a block arrives (from the
+        orderer or from a peer). Stores, hands to state layer, pushes."""
+        if not self.store.add(seq, block_bytes):
+            return
+        self._on_block(seq, block_bytes)
+        if push:
+            msg = self._data_msg(seq, block_bytes)
+            for ep in self._targets():
+                self._comm.send(ep, msg)
+
+    def _data_msg(self, seq: int, block_bytes: bytes) -> gpb.GossipMessage:
+        m = gpb.GossipMessage(
+            channel=self._chan_bytes, tag=gpb.GossipMessage.CHAN_AND_ORG
+        )
+        m.data_msg.seq_num = seq
+        m.data_msg.block = block_bytes
+        return m
+
+    def advertise_state(self) -> None:
+        m = gpb.GossipMessage(channel=self._chan_bytes, tag=gpb.GossipMessage.CHAN_ONLY)
+        m.state_info.ledger_height = self.ledger_height()
+        m.state_info.pki_id = self._comm.pki_id
+        for ep in self._targets(len(self._membership())):
+            self._comm.send(ep, m)
+
+    def tick(self) -> None:
+        """One pull round + state advertisement."""
+        targets = self._targets(1)
+        if targets:
+            self._nonce += 1
+            hello = gpb.GossipMessage(channel=self._chan_bytes)
+            hello.hello.nonce = self._nonce
+            hello.hello.msg_type = gpb.PULL_BLOCK_MSG
+            with self._lock:
+                self._pending_pulls[self._nonce] = targets[0]
+                # bound pending table
+                while len(self._pending_pulls) > 32:
+                    del self._pending_pulls[min(self._pending_pulls)]
+            self._comm.send(targets[0], hello)
+        self.advertise_state()
+
+    # -- peers ahead of us (state transfer support) ------------------------
+
+    def best_peer_height(self) -> tuple[str | None, int]:
+        with self._lock:
+            if not self._heights:
+                return None, 0
+            pki = max(self._heights, key=lambda k: self._heights[k])
+            return self._height_eps.get(pki), self._heights[pki]
+
+    # -- inbound -----------------------------------------------------------
+
+    def _handle(self, rm) -> None:
+        msg = rm.msg
+        if bytes(msg.channel) != self._chan_bytes:
+            return
+        kind = msg.WhichOneof("content")
+        if kind == "data_msg":
+            self.add_block(msg.data_msg.seq_num, bytes(msg.data_msg.block))
+        elif kind == "hello":
+            resp = gpb.GossipMessage(channel=self._chan_bytes)
+            resp.data_dig.nonce = msg.hello.nonce
+            resp.data_dig.msg_type = gpb.PULL_BLOCK_MSG
+            for seq in self.store.digests():
+                resp.data_dig.digests.append(str(seq).encode())
+            ep = self._endpoint_for(rm.sender_pki)
+            if ep:
+                self._comm.send(ep, resp)
+        elif kind == "data_dig":
+            with self._lock:
+                target = self._pending_pulls.pop(msg.data_dig.nonce, None)
+            if target is None:
+                return
+            have = set(self.store.digests())
+            want = [
+                d
+                for d in msg.data_dig.digests
+                if int(d) not in have
+            ]
+            if not want:
+                return
+            req = gpb.GossipMessage(channel=self._chan_bytes)
+            req.data_req.nonce = msg.data_dig.nonce
+            req.data_req.msg_type = gpb.PULL_BLOCK_MSG
+            req.data_req.digests.extend(want)
+            self._comm.send(target, req)
+        elif kind == "data_req":
+            resp = gpb.GossipMessage(channel=self._chan_bytes)
+            resp.data_update.nonce = msg.data_req.nonce
+            resp.data_update.msg_type = gpb.PULL_BLOCK_MSG
+            for d in msg.data_req.digests:
+                blk = self.store.get(int(d))
+                if blk is not None:
+                    inner = self._data_msg(int(d), blk)
+                    resp.data_update.data.append(self._comm.wrap(inner))
+            ep = self._endpoint_for(rm.sender_pki)
+            if ep:
+                self._comm.send(ep, resp)
+        elif kind == "data_update":
+            for signed in msg.data_update.data:
+                inner = gpb.GossipMessage.FromString(signed.payload)
+                if inner.WhichOneof("content") == "data_msg":
+                    self.add_block(
+                        inner.data_msg.seq_num, bytes(inner.data_msg.block),
+                        push=False,
+                    )
+        elif kind == "state_info":
+            with self._lock:
+                self._heights[bytes(msg.state_info.pki_id)] = (
+                    msg.state_info.ledger_height
+                )
+                ep = self._endpoint_for(bytes(msg.state_info.pki_id))
+                if ep:
+                    self._height_eps[bytes(msg.state_info.pki_id)] = ep
+
+    # endpoint lookup is injected by the node wiring (discovery knows it)
+    endpoint_lookup = None
+
+    def _endpoint_for(self, pki_id: bytes) -> str | None:
+        if self.endpoint_lookup is not None:
+            return self.endpoint_lookup(pki_id)
+        return None
+
+
+__all__ = ["ChannelGossip", "MessageStore"]

@@ -1,0 +1,182 @@
+"""Gossip discovery: SWIM-ish membership with signed alive messages.
+
+Capability parity with the reference's gossip/discovery
+(discovery_impl.go: periodic alive broadcast, expiration-based dead-peer
+detection, membership request/response synchronization, resurrection via
+higher incarnation numbers).  Deterministic core + thread driver: the
+`DiscoveryCore` advances on explicit `tick()` calls so unit tests run
+without clocks, mirroring how our raft core is tested.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from fabric_tpu.protos.gossip import message_pb2 as gpb
+
+
+class PeerState:
+    __slots__ = ("endpoint", "pki_id", "inc", "seq", "last_seen_tick", "alive")
+
+    def __init__(self, endpoint, pki_id, inc, seq, tick):
+        self.endpoint = endpoint
+        self.pki_id = pki_id
+        self.inc = inc
+        self.seq = seq
+        self.last_seen_tick = tick
+        self.alive = True
+
+
+class DiscoveryCore:
+    def __init__(
+        self,
+        comm,
+        bootstrap: list[str],
+        alive_interval_ticks: int = 1,
+        expiration_ticks: int = 5,
+        on_membership_change=None,
+    ):
+        self._comm = comm
+        self.endpoint = comm.endpoint
+        self.pki_id = comm.pki_id
+        self._bootstrap = [e for e in bootstrap if e != comm.endpoint]
+        self._alive_every = alive_interval_ticks
+        self._expire_after = expiration_ticks
+        self._peers: dict[bytes, PeerState] = {}
+        self._inc = int(time.time() * 1000)  # incarnation: process start
+        self._seq = 0
+        self._tick = 0
+        self._lock = threading.Lock()
+        self._on_change = on_membership_change or (lambda: None)
+        comm.subscribe(self._handle)
+
+    # -- views -------------------------------------------------------------
+
+    def alive_peers(self) -> list[PeerState]:
+        with self._lock:
+            return [p for p in self._peers.values() if p.alive]
+
+    def dead_peers(self) -> list[PeerState]:
+        with self._lock:
+            return [p for p in self._peers.values() if not p.alive]
+
+    def endpoint_of(self, pki_id: bytes) -> str | None:
+        with self._lock:
+            p = self._peers.get(pki_id)
+            return p.endpoint if p else None
+
+    # -- protocol ----------------------------------------------------------
+
+    def _self_alive(self) -> gpb.GossipMessage:
+        self._seq += 1
+        m = gpb.GossipMessage(tag=gpb.GossipMessage.EMPTY)
+        m.alive_msg.membership.endpoint = self.endpoint
+        m.alive_msg.membership.pki_id = self.pki_id
+        m.alive_msg.membership.identity = self._comm.identity
+        m.alive_msg.inc_number = self._inc
+        m.alive_msg.seq_num = self._seq
+        return m
+
+    def tick(self) -> None:
+        """One logical time step: broadcast alive, expire silent peers."""
+        self._tick += 1
+        if self._tick % self._alive_every == 0:
+            alive = self._self_alive()
+            targets = {p.endpoint for p in self.alive_peers()}
+            targets.update(self._bootstrap)
+            for ep in targets:
+                self._comm.send(ep, alive)
+            # also solicit membership from bootstrap when we know no one
+            if not self._peers:
+                req = gpb.GossipMessage(tag=gpb.GossipMessage.EMPTY)
+                req.mem_req.self_information.CopyFrom(alive.alive_msg)
+                for ep in self._bootstrap:
+                    self._comm.send(ep, req)
+        changed = False
+        with self._lock:
+            for p in self._peers.values():
+                if p.alive and self._tick - p.last_seen_tick > self._expire_after:
+                    p.alive = False
+                    changed = True
+        if changed:
+            self._on_change()
+
+    def _learn(self, am: gpb.AliveMessage) -> bool:
+        """Returns True if membership changed."""
+        pki = bytes(am.membership.pki_id)
+        if pki == self.pki_id:
+            return False
+        if am.membership.identity:
+            self._comm.learn_identity(bytes(am.membership.identity))
+        with self._lock:
+            cur = self._peers.get(pki)
+            if cur is None:
+                self._peers[pki] = PeerState(
+                    am.membership.endpoint, pki, am.inc_number, am.seq_num, self._tick
+                )
+                return True
+            if (am.inc_number, am.seq_num) <= (cur.inc, cur.seq):
+                return False  # stale
+            cur.inc, cur.seq = am.inc_number, am.seq_num
+            cur.endpoint = am.membership.endpoint or cur.endpoint
+            cur.last_seen_tick = self._tick
+            resurrection = not cur.alive
+            cur.alive = True
+            return resurrection
+
+    def _handle(self, rm) -> None:
+        msg = rm.msg
+        kind = msg.WhichOneof("content")
+        if kind == "alive_msg":
+            if self._learn(msg.alive_msg):
+                self._on_change()
+        elif kind == "mem_req":
+            if self._learn(msg.mem_req.self_information):
+                self._on_change()
+            resp = gpb.GossipMessage(tag=gpb.GossipMessage.EMPTY)
+            with self._lock:
+                peers = list(self._peers.values())
+            me = self._self_alive()
+            resp.mem_res.alive.append(me.alive_msg)
+            for p in peers:
+                am = gpb.AliveMessage(inc_number=p.inc, seq_num=p.seq)
+                am.membership.endpoint = p.endpoint
+                am.membership.pki_id = p.pki_id
+                ident = self._comm.identity_of(p.pki_id)
+                if ident:
+                    am.membership.identity = ident
+                (resp.mem_res.alive if p.alive else resp.mem_res.dead).append(am)
+            ep = msg.mem_req.self_information.membership.endpoint
+            if ep:
+                self._comm.send(ep, resp)
+        elif kind == "mem_res":
+            changed = False
+            for am in msg.mem_res.alive:
+                changed |= self._learn(am)
+            if changed:
+                self._on_change()
+
+
+class Discovery:
+    """Thread driver around DiscoveryCore (production mode)."""
+
+    def __init__(self, core: DiscoveryCore, tick_interval_s: float = 1.0):
+        self.core = core
+        self._interval = tick_interval_s
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=3)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._interval):
+            self.core.tick()
+
+
+__all__ = ["DiscoveryCore", "Discovery", "PeerState"]

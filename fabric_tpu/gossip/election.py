@@ -1,0 +1,118 @@
+"""Per-channel leader election over gossip.
+
+Capability parity with the reference's gossip/election
+(election.go:147 LeaderElectionService: peers propose themselves, the
+smallest PKI-ID wins, the leader periodically re-declares, followers
+re-elect when declarations stop).  The elected peer runs the channel's
+deliver client (pulls blocks from the orderer for the whole org) —
+gossip/service wiring in the reference.
+
+Tick-driven core: each tick the node (a) expires a silent leader,
+(b) declares itself leader if it believes it should lead, (c) otherwise
+proposes.  Convergence: all nodes apply "smallest pki-id among proposals
+seen this round wins".
+"""
+
+from __future__ import annotations
+
+import threading
+
+from fabric_tpu.protos.gossip import message_pb2 as gpb
+
+
+class LeaderElection:
+    def __init__(
+        self,
+        channel_id: str,
+        comm,
+        membership,  # callable -> list[str] endpoints in channel
+        on_leadership_change=None,  # callback(is_leader: bool)
+        leader_timeout_ticks: int = 5,
+    ):
+        self.channel_id = channel_id
+        self._chan = channel_id.encode()
+        self._comm = comm
+        self._membership = membership
+        self._on_change = on_leadership_change or (lambda is_leader: None)
+        self._timeout = leader_timeout_ticks
+        self._tick = 0
+        self._seq = 0
+        self._leader: bytes | None = None
+        self._leader_seen_tick = 0
+        self._proposals: dict[bytes, int] = {}  # pki -> last tick seen
+        self._lock = threading.Lock()
+        self.is_leader = False
+        comm.subscribe(self._handle)
+
+    def _broadcast(self, declaration: bool) -> None:
+        self._seq += 1
+        m = gpb.GossipMessage(channel=self._chan, tag=gpb.GossipMessage.CHAN_ONLY)
+        m.leadership_msg.pki_id = self._comm.pki_id
+        m.leadership_msg.seq_num = self._seq
+        m.leadership_msg.is_declaration = declaration
+        for ep in self._membership():
+            self._comm.send(ep, m)
+
+    def tick(self) -> None:
+        self._tick += 1
+        with self._lock:
+            leader_expired = (
+                self._leader is not None
+                and self._leader != self._comm.pki_id
+                and self._tick - self._leader_seen_tick > self._timeout
+            )
+            if leader_expired:
+                self._leader = None
+            # drop stale proposals
+            self._proposals = {
+                p: t
+                for p, t in self._proposals.items()
+                if self._tick - t <= self._timeout
+            }
+            candidates = set(self._proposals) | {self._comm.pki_id}
+            if self._leader is not None and not leader_expired:
+                should_lead = self._leader == self._comm.pki_id
+            else:
+                should_lead = min(candidates) == self._comm.pki_id
+        if should_lead:
+            with self._lock:
+                self._leader = self._comm.pki_id
+                self._leader_seen_tick = self._tick
+            self._broadcast(declaration=True)
+            self._set_leader(True)
+        else:
+            self._broadcast(declaration=False)
+            self._set_leader(False)
+
+    def _set_leader(self, val: bool) -> None:
+        if val != self.is_leader:
+            self.is_leader = val
+            self._on_change(val)
+
+    def leader(self) -> bytes | None:
+        with self._lock:
+            return self._leader
+
+    def _handle(self, rm) -> None:
+        msg = rm.msg
+        if (
+            bytes(msg.channel) != self._chan
+            or msg.WhichOneof("content") != "leadership_msg"
+        ):
+            return
+        lm = msg.leadership_msg
+        pki = bytes(lm.pki_id)
+        with self._lock:
+            self._proposals[pki] = self._tick
+            if lm.is_declaration:
+                # yield to a declared leader with smaller pki-id; contest
+                # (by continuing to declare) otherwise
+                if self._leader is None or pki <= self._leader:
+                    self._leader = pki
+                    self._leader_seen_tick = self._tick
+                relinquish = pki < self._comm.pki_id
+        if lm.is_declaration and relinquish:
+            self._set_leader(False)
+
+
+__all__ = ["LeaderElection"]

@@ -1,0 +1,113 @@
+"""Gossip service glue: one node's gossip stack, joined per channel.
+
+Capability parity with the reference's gossip/service
+(gossip_service.go:162 New, :205 InitializeChannel): binds comm +
+discovery once per node, then per channel wires ChannelGossip + leader
+election + state provider, and (when elected) runs the deliver client
+that pulls blocks from the orderer into the channel.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from fabric_tpu.gossip.core import ChannelGossip
+from fabric_tpu.gossip.discovery import DiscoveryCore
+from fabric_tpu.gossip.election import LeaderElection
+from fabric_tpu.gossip.state import StateProvider
+
+
+class ChannelHandle:
+    def __init__(self, gossip, election, state):
+        self.gossip = gossip
+        self.election = election
+        self.state = state
+
+    def tick(self) -> None:
+        self.gossip.tick()
+        self.election.tick()
+        self.state.tick()
+
+
+class GossipService:
+    def __init__(
+        self,
+        comm,
+        bootstrap: list[str],
+        alive_expiration_ticks: int = 5,
+    ):
+        self._comm = comm
+        self.discovery = DiscoveryCore(
+            comm, bootstrap, expiration_ticks=alive_expiration_ticks
+        )
+        self._channels: dict[str, ChannelHandle] = {}
+        self._lock = threading.Lock()
+        self._deliver_starters: dict[str, tuple] = {}
+
+    @property
+    def endpoint(self) -> str:
+        return self._comm.endpoint
+
+    def join_channel(
+        self,
+        channel_id: str,
+        committer,
+        deliver_client=None,  # object with .start()/.stop(), run by the leader
+        fanout: int = 3,
+    ) -> ChannelHandle:
+        membership = lambda: [p.endpoint for p in self.discovery.alive_peers()]
+        gossip = ChannelGossip(channel_id, self._comm, membership, fanout=fanout)
+        gossip.endpoint_lookup = self.discovery.endpoint_of
+        state = StateProvider(channel_id, gossip, committer, self._comm)
+
+        def on_leadership(is_leader: bool) -> None:
+            if deliver_client is None:
+                return
+            if is_leader:
+                deliver_client.start()
+            else:
+                deliver_client.stop()
+
+        election = LeaderElection(
+            channel_id, self._comm, membership, on_leadership_change=on_leadership
+        )
+        handle = ChannelHandle(gossip, election, state)
+        with self._lock:
+            self._channels[channel_id] = handle
+        return handle
+
+    def channel(self, channel_id: str) -> ChannelHandle | None:
+        with self._lock:
+            return self._channels.get(channel_id)
+
+    def tick(self) -> None:
+        """One logical round for the whole node: discovery + all channels."""
+        self.discovery.tick()
+        with self._lock:
+            handles = list(self._channels.values())
+        for h in handles:
+            h.tick()
+
+
+class GossipRunner:
+    """Thread driver for production: ticks a GossipService on an interval."""
+
+    def __init__(self, service: GossipService, tick_interval_s: float = 1.0):
+        self._svc = service
+        self._interval = tick_interval_s
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=3)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._interval):
+            self._svc.tick()
+
+
+__all__ = ["GossipService", "GossipRunner", "ChannelHandle"]

@@ -1,0 +1,136 @@
+"""Gossip state transfer: ordered block delivery into the commit pipeline.
+
+Capability parity with the reference's gossip/state
+(state.go:189 NewGossipStateProvider, :547 deliverPayloads, :591
+antiAntropy, :750 AddPayload, :781 commitBlock): blocks arrive out of
+order from gossip push/pull or in order from the deliver client; a
+payload buffer holds them; a delivery loop commits strictly sequentially;
+anti-entropy asks peers that advertise greater height for the missing
+range (RemoteStateRequest/Response).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from fabric_tpu.protos.common import common_pb2
+from fabric_tpu.protos.gossip import message_pb2 as gpb
+
+
+class PayloadBuffer:
+    def __init__(self):
+        self._by_seq: dict[int, bytes] = {}
+        self._lock = threading.Lock()
+
+    def push(self, seq: int, block_bytes: bytes) -> None:
+        with self._lock:
+            self._by_seq.setdefault(seq, block_bytes)
+
+    def pop(self, seq: int) -> bytes | None:
+        with self._lock:
+            return self._by_seq.pop(seq, None)
+
+    def __contains__(self, seq: int) -> bool:
+        with self._lock:
+            return seq in self._by_seq
+
+
+class StateProvider:
+    def __init__(
+        self,
+        channel_id: str,
+        channel_gossip,  # ChannelGossip
+        committer,       # object with .store_block(Block) and .height
+        comm,
+        max_batch: int = 10,
+    ):
+        self.channel_id = channel_id
+        self._chan = channel_id.encode()
+        self._gossip = channel_gossip
+        self._committer = committer
+        self._comm = comm
+        self._buffer = PayloadBuffer()
+        self._max_batch = max_batch
+        self._commit_lock = threading.Lock()
+        channel_gossip.ledger_height = lambda: self._committer.height
+        # blocks arriving via gossip land here
+        self._gossip._on_block = self._on_gossip_block
+        comm.subscribe(self._handle)
+
+    # -- ingestion ---------------------------------------------------------
+
+    def add_payload(self, seq: int, block_bytes: bytes, from_orderer: bool = False) -> None:
+        """AddPayload: deliver-client (ordered) or gossip (unordered)."""
+        if seq < self._committer.height:
+            return  # already committed
+        self._buffer.push(seq, block_bytes)
+        if from_orderer:
+            # teach the gossip layer so it disseminates to org peers
+            self._gossip.add_block(seq, block_bytes)
+        self._drain()
+
+    def _on_gossip_block(self, seq: int, block_bytes: bytes) -> None:
+        if seq < self._committer.height:
+            return
+        self._buffer.push(seq, block_bytes)
+        self._drain()
+
+    # -- ordered commit ----------------------------------------------------
+
+    def _drain(self) -> None:
+        with self._commit_lock:
+            while True:
+                nxt = self._committer.height
+                raw = self._buffer.pop(nxt)
+                if raw is None:
+                    return
+                blk = common_pb2.Block.FromString(raw)
+                self._committer.store_block(blk)
+
+    # -- anti-entropy ------------------------------------------------------
+
+    def tick(self) -> None:
+        """Request the missing range from the best-known peer if we lag."""
+        ep, their_height = self._gossip.best_peer_height()
+        my_height = self._committer.height
+        if ep is None or their_height <= my_height:
+            return
+        req = gpb.GossipMessage(channel=self._chan)
+        req.state_request.start_seq_num = my_height
+        req.state_request.end_seq_num = min(
+            their_height - 1, my_height + self._max_batch - 1
+        )
+        self._comm.send(ep, req)
+
+    def _handle(self, rm) -> None:
+        msg = rm.msg
+        if bytes(msg.channel) != self._chan:
+            return
+        kind = msg.WhichOneof("content")
+        if kind == "state_request":
+            resp = gpb.GossipMessage(channel=self._chan)
+            lo = msg.state_request.start_seq_num
+            hi = msg.state_request.end_seq_num
+            for seq in range(lo, hi + 1):
+                raw = self._gossip.store.get(seq) or self._read_committed(seq)
+                if raw is None:
+                    break
+                dm = resp.state_response.payloads.add()
+                dm.seq_num = seq
+                dm.block = raw
+            ep = self._gossip._endpoint_for(rm.sender_pki)
+            if ep and resp.state_response.payloads:
+                self._comm.send(ep, resp)
+        elif kind == "state_response":
+            for dm in msg.state_response.payloads:
+                self.add_payload(dm.seq_num, bytes(dm.block))
+
+    def _read_committed(self, seq: int) -> bytes | None:
+        reader = getattr(self._committer, "get_block_by_number", None)
+        if reader is None:
+            return None
+        blk = reader(seq)
+        return blk.SerializeToString() if blk is not None else None
+
+
+__all__ = ["StateProvider", "PayloadBuffer"]

@@ -1,0 +1,93 @@
+"""Deliver client: pull blocks from the ordering service into the peer.
+
+Capability parity with the reference's deliver service
+(core/deliverservice/deliveryclient.go:108 + internal/pkg/peer/
+blocksprovider/blocksprovider.go:113 DeliverBlocks): a loop that connects
+to an orderer endpoint (shuffled, with exponential backoff on failure),
+sends a signed SeekInfo from the peer's current height, verifies each
+received block's orderer signature against the channel's block-validation
+policy, and hands it to the provided sink (gossip state provider on the
+leader peer).  `endpoints` are callables yielding deliver iterators so the
+same client drives in-process orderers (tests) and socket transports.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+
+from fabric_tpu.orderer.blockwriter import verify_block_signature
+from fabric_tpu.protos.common import common_pb2
+
+
+class DeliverClient:
+    def __init__(
+        self,
+        channel_id: str,
+        endpoints,   # list of callables: start_num -> iterator of Block
+        height_fn,   # () -> int, current committed height
+        sink,        # callable(seq, block_bytes) — e.g. StateProvider.add_payload
+        bundle=None,  # channel config for block signature verification
+        csp=None,
+        max_backoff_s: float = 10.0,
+    ):
+        self.channel_id = channel_id
+        self._endpoints = list(endpoints)
+        self._height = height_fn
+        self._sink = sink
+        self._bundle = bundle
+        self._csp = csp
+        self._max_backoff = max_backoff_s
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._lock = threading.Lock()
+
+    def start(self) -> None:
+        with self._lock:
+            if self._thread is not None and self._thread.is_alive():
+                return
+            self._stop.clear()
+            self._thread = threading.Thread(target=self._run, daemon=True)
+            self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=3)
+
+    def _verify(self, blk: common_pb2.Block) -> bool:
+        if self._bundle is None:
+            return True
+        policy = self._bundle.policy_manager.get_policy(
+            "/Channel/Orderer/BlockValidation"
+        )
+        if policy is None:
+            return True
+        return verify_block_signature(blk, policy, self._csp)
+
+    def _run(self) -> None:
+        backoff = 0.1
+        endpoints = self._endpoints[:]
+        random.shuffle(endpoints)
+        idx = 0
+        while not self._stop.is_set():
+            connect = endpoints[idx % len(endpoints)]
+            idx += 1
+            try:
+                for blk in connect(self._height()):
+                    if self._stop.is_set():
+                        return
+                    if not self._verify(blk):
+                        break  # bad orderer: switch endpoints
+                    self._sink(blk.header.number, blk.SerializeToString())
+                    backoff = 0.1
+            except Exception:
+                pass
+            if self._stop.wait(backoff):
+                return
+            backoff = min(backoff * 2, self._max_backoff)
+
+
+__all__ = ["DeliverClient"]

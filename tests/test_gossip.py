@@ -1,0 +1,183 @@
+"""Gossip layer tests: discovery membership, push/pull dissemination,
+leader election, state transfer, deliver-client failover.  All on the
+in-process net with synchronous ticks (the reference unit-tests gossip
+the same way: mocked comm, deterministic rounds)."""
+
+import threading
+
+from fabric_tpu.gossip import (
+    GossipService,
+    InProcGossipComm,
+    InProcGossipNet,
+)
+from fabric_tpu.peer.deliverclient import DeliverClient
+from fabric_tpu.protos.common import common_pb2
+from fabric_tpu import protoutil
+
+
+def make_node(net, name: str) -> GossipService:
+    comm = InProcGossipComm(name, net, identity_bytes(name))
+    return GossipService(comm, bootstrap=["n0"])
+
+
+def identity_bytes(name: str) -> bytes:
+    return b"identity-" + name.encode()
+
+
+class FakeCommitter:
+    """Stands in for the commit pipeline (store_block/height/reader)."""
+
+    def __init__(self):
+        self.blocks: dict[int, common_pb2.Block] = {}
+        self.lock = threading.Lock()
+
+    @property
+    def height(self) -> int:
+        with self.lock:
+            return max(self.blocks) + 1 if self.blocks else 0
+
+    def store_block(self, blk: common_pb2.Block) -> None:
+        with self.lock:
+            self.blocks[blk.header.number] = blk
+
+    def get_block_by_number(self, n: int):
+        with self.lock:
+            return self.blocks.get(n)
+
+
+def _block(num: int) -> bytes:
+    blk = protoutil.new_block(num, b"prev")
+    blk.data.data.append(b"tx-%d" % num)
+    return blk.SerializeToString()
+
+
+def _mesh(n: int):
+    net = InProcGossipNet()
+    nodes = [make_node(net, f"n{i}") for i in range(n)]
+    for _ in range(4):  # converge membership
+        for node in nodes:
+            node.tick()
+    return net, nodes
+
+
+def test_discovery_membership_converges():
+    _, nodes = _mesh(4)
+    for node in nodes:
+        assert len(node.discovery.alive_peers()) == 3
+
+
+def test_discovery_detects_death():
+    net, nodes = _mesh(3)
+    dead = nodes[2]
+    net.unregister(dead.endpoint)
+    for _ in range(10):
+        nodes[0].tick()
+        nodes[1].tick()
+    alive0 = {p.endpoint for p in nodes[0].discovery.alive_peers()}
+    assert dead.endpoint not in alive0
+    assert dead.endpoint in {p.endpoint for p in nodes[0].discovery.dead_peers()}
+
+
+def test_push_dissemination_reaches_all_members():
+    _, nodes = _mesh(4)
+    committers = [FakeCommitter() for _ in nodes]
+    handles = [
+        node.join_channel("ch", c) for node, c in zip(nodes, committers)
+    ]
+    # seed committed genesis so sequencing starts at block 0
+    for c in committers:
+        pass
+    handles[0].state.add_payload(0, _block(0), from_orderer=True)
+    # push fanout is 3 on a 3-peer membership: direct flood
+    for c in committers:
+        assert c.height == 1, "push should reach every peer"
+
+
+def test_pull_repairs_partitioned_peer():
+    net, nodes = _mesh(3)
+    committers = [FakeCommitter() for _ in nodes]
+    handles = [node.join_channel("ch", c) for node, c in zip(nodes, committers)]
+    # cut n2 off from n0 and n1
+    net.partition("n0", "n2")
+    net.partition("n1", "n2")
+    handles[0].state.add_payload(0, _block(0), from_orderer=True)
+    assert committers[2].height == 0
+    net.heal()
+    for _ in range(6):
+        for node in nodes:
+            node.tick()
+    assert committers[2].height == 1, "pull anti-entropy should repair the gap"
+
+
+def test_election_converges_to_single_leader_and_fails_over():
+    net, nodes = _mesh(3)
+    committers = [FakeCommitter() for _ in nodes]
+    handles = [node.join_channel("ch", c) for node, c in zip(nodes, committers)]
+    for _ in range(6):
+        for node in nodes:
+            node.tick()
+    leaders = [i for i, h in enumerate(handles) if h.election.is_leader]
+    assert len(leaders) == 1, f"want one leader, got {leaders}"
+    # kill the leader; remaining nodes elect a new one
+    dead = leaders[0]
+    net.unregister(nodes[dead].endpoint)
+    survivors = [i for i in range(3) if i != dead]
+    for _ in range(14):
+        for i in survivors:
+            nodes[i].tick()
+    new_leaders = [i for i in survivors if handles[i].election.is_leader]
+    assert len(new_leaders) == 1
+    assert new_leaders[0] != dead
+
+
+def test_state_provider_orders_out_of_order_payloads():
+    net = InProcGossipNet()
+    node = make_node(net, "n0")
+    committer = FakeCommitter()
+    h = node.join_channel("ch", committer)
+    h.state.add_payload(2, _block(2))
+    h.state.add_payload(1, _block(1))
+    assert committer.height == 0  # waiting for 0
+    h.state.add_payload(0, _block(0))
+    assert committer.height == 3  # drained in order
+
+
+def test_state_anti_entropy_catches_up_lagging_peer():
+    net, nodes = _mesh(2)
+    committers = [FakeCommitter() for _ in nodes]
+    handles = [node.join_channel("ch", c) for node, c in zip(nodes, committers)]
+    net.partition("n0", "n1")
+    for i in range(5):
+        handles[0].state.add_payload(i, _block(i), from_orderer=True)
+    assert committers[0].height == 5 and committers[1].height == 0
+    net.heal()
+    for _ in range(10):
+        for node in nodes:
+            node.tick()
+    assert committers[1].height == 5
+
+
+def test_deliver_client_failover_and_sink():
+    got = []
+    height = lambda: len(got)
+
+    def bad_endpoint(start):
+        raise ConnectionError("orderer down")
+
+    def good_endpoint(start):
+        for i in range(start, 3):
+            blk = common_pb2.Block.FromString(_block(i))
+            yield blk
+
+    done = threading.Event()
+
+    def sink(seq, raw):
+        got.append(seq)
+        if len(got) == 3:
+            done.set()
+
+    dc = DeliverClient("ch", [bad_endpoint, good_endpoint], height, sink)
+    dc.start()
+    assert done.wait(5), f"expected 3 blocks, got {got}"
+    dc.stop()
+    assert got == [0, 1, 2]
