@@ -1,0 +1,10 @@
+from fabric_tpu.chaincode.shim import Chaincode, ChaincodeStub, shim_main
+from fabric_tpu.chaincode.support import ChaincodeSupport, InProcStream
+
+__all__ = [
+    "Chaincode",
+    "ChaincodeStub",
+    "shim_main",
+    "ChaincodeSupport",
+    "InProcStream",
+]

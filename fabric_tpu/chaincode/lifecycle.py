@@ -1,0 +1,280 @@
+"""Chaincode lifecycle: the `_lifecycle` system chaincode.
+
+Capability parity with the reference's core/chaincode/lifecycle
+(lifecycle.go InstallChaincode/ApproveChaincodeDefinitionForOrg/
+CheckCommitReadiness/CommitChaincodeDefinition; scc.go argument
+dispatch; persistence/ package store).  Model:
+
+- Install: store the package (.tar.gz bytes) on disk keyed by
+  package-id = "<label>:<sha256>" (persistence/chaincode_package.go).
+- Approve: org-scoped approval recorded in the org's implicit namespace —
+  state key "approvals/<name>/<sequence>/<mspid>" holding the hash of the
+  marshaled definition, the same agreement-by-hash scheme the reference
+  implements with implicit private collections.
+- CheckCommitReadiness: compare each org's stored approval hash against
+  the proposed definition.
+- Commit: requires approvals satisfying the channel's
+  LifecycleEndorsement rule (MAJORITY of application orgs here, the
+  reference default); writes "chaincodes/<name>" -> ChaincodeDefinition.
+
+The committed definition (with its validation_parameter endorsement
+policy) is what the txvalidator's VSCC reads via DefinitionProvider
+(reference deployedcc_infoprovider.go).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+
+from fabric_tpu.chaincode.shim import Chaincode, ChaincodeStub, error, success
+from fabric_tpu.protos.peer import lifecycle_pb2 as lc
+
+NAMESPACE = "_lifecycle"
+
+
+class PackageStore:
+    """On-disk chaincode package store (core/chaincode/persistence)."""
+
+    def __init__(self, dir_path: str):
+        self.dir = dir_path
+        os.makedirs(dir_path, exist_ok=True)
+
+    @staticmethod
+    def package_id(label: str, package_bytes: bytes) -> str:
+        return f"{label}:{hashlib.sha256(package_bytes).hexdigest()}"
+
+    def _path(self, package_id: str) -> str:
+        # content hash names the file; labels live in the index
+        return os.path.join(self.dir, package_id.rsplit(":", 1)[1] + ".tar.gz")
+
+    def _index_path(self) -> str:
+        return os.path.join(self.dir, "index.json")
+
+    def _read_index(self) -> dict:
+        try:
+            with open(self._index_path()) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return {}
+
+    def save(self, label: str, package_bytes: bytes) -> str:
+        pid = self.package_id(label, package_bytes)
+        path = self._path(pid)
+        if not os.path.exists(path):
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(package_bytes)
+            os.replace(tmp, path)
+        idx = self._read_index()
+        if pid not in idx:
+            idx[pid] = label
+            tmp = self._index_path() + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(idx, f)
+            os.replace(tmp, self._index_path())
+        return pid
+
+    def load(self, package_id: str) -> bytes | None:
+        if package_id not in self._read_index():
+            return None
+        try:
+            with open(self._path(package_id), "rb") as f:
+                return f.read()
+        except OSError:
+            return None
+
+    def list(self) -> list[tuple[str, str]]:
+        """[(package_id, label)]"""
+        return sorted(self._read_index().items())
+
+
+def _definition_hash(d: lc.ChaincodeDefinition) -> bytes:
+    return hashlib.sha256(d.SerializeToString()).digest()
+
+
+def _approval_key(name: str, sequence: int, mspid: str) -> str:
+    return f"approvals/{name}/{sequence}/{mspid}"
+
+
+def _definition_key(name: str) -> str:
+    return f"chaincodes/{name}"
+
+
+class LifecycleSCC(Chaincode):
+    def __init__(self, package_store: PackageStore, org_lister=None):
+        """org_lister() -> list of application-org MSP IDs on the channel
+        (for MAJORITY commit readiness)."""
+        self._store = package_store
+        self._org_lister = org_lister or (lambda: [])
+
+    # -- dispatch ----------------------------------------------------------
+
+    def invoke(self, stub: ChaincodeStub):
+        fn, params = stub.get_function_and_parameters()
+        handler = {
+            "InstallChaincode": self._install,
+            "QueryInstalledChaincodes": self._query_installed,
+            "GetInstalledChaincodePackage": self._get_package,
+            "ApproveChaincodeDefinitionForMyOrg": self._approve,
+            "CheckCommitReadiness": self._check_readiness,
+            "CommitChaincodeDefinition": self._commit,
+            "QueryChaincodeDefinition": self._query_definition,
+            "QueryChaincodeDefinitions": self._query_definitions,
+        }.get(fn)
+        if handler is None:
+            return error(f"unknown lifecycle function {fn!r}")
+        try:
+            return handler(stub, params[0] if params else b"")
+        except Exception as exc:
+            return error(str(exc))
+
+    # -- install (node-local, no channel state) ----------------------------
+
+    def _install(self, stub, raw):
+        args = lc.InstallChaincodeArgs.FromString(raw)
+        pkg = bytes(args.chaincode_install_package)
+        label = self._package_label(pkg)
+        pid = self._store.save(label, pkg)
+        res = lc.InstallChaincodeResult(package_id=pid, label=label)
+        return success(res.SerializeToString())
+
+    @staticmethod
+    def _package_label(pkg: bytes) -> str:
+        """Packages are tar.gz with a metadata.json holding the label
+        (persistence/chaincode_package.go ParseChaincodePackage); fall back
+        to a content hash prefix for opaque blobs."""
+        import gzip
+        import io
+        import tarfile
+
+        try:
+            with tarfile.open(fileobj=io.BytesIO(pkg), mode="r:gz") as tf:
+                for m in tf.getmembers():
+                    if os.path.basename(m.name) == "metadata.json":
+                        meta = json.loads(tf.extractfile(m).read())
+                        return meta.get("label", "unlabeled")
+        except (tarfile.TarError, gzip.BadGzipFile, OSError, ValueError):
+            pass
+        return "pkg-" + hashlib.sha256(pkg).hexdigest()[:12]
+
+    def _query_installed(self, stub, raw):
+        res = lc.QueryInstalledChaincodesResult()
+        for pid, label in self._store.list():
+            ic = res.installed_chaincodes.add()
+            ic.package_id = pid
+            ic.label = label
+        return success(res.SerializeToString())
+
+    def _get_package(self, stub, raw):
+        pid = raw.decode()
+        pkg = self._store.load(pid)
+        if pkg is None:
+            return error(f"package {pid!r} not installed", status=404)
+        return success(pkg)
+
+    # -- approvals / commit (channel state) --------------------------------
+
+    def _approve(self, stub, raw):
+        args = lc.ApproveChaincodeDefinitionForMyOrgArgs.FromString(raw)
+        d = args.definition
+        mspid = stub.creator_mspid()
+        if not mspid:
+            return error("cannot determine approving org")
+        committed = self._load_definition(stub, d.name)
+        expected_seq = (committed.sequence + 1) if committed else 1
+        if d.sequence > expected_seq:
+            return error(
+                f"requested sequence {d.sequence}, next committable is {expected_seq}"
+            )
+        stub.put_state(
+            _approval_key(d.name, d.sequence, mspid), _definition_hash(d)
+        )
+        return success(
+            lc.ApproveChaincodeDefinitionForMyOrgResult().SerializeToString()
+        )
+
+    def _approvals_for(self, stub, d: lc.ChaincodeDefinition) -> dict[str, bool]:
+        want = _definition_hash(d)
+        out = {}
+        for mspid in self._org_lister():
+            got = stub.get_state(_approval_key(d.name, d.sequence, mspid))
+            out[mspid] = bool(got) and got == want
+        return out
+
+    def _check_readiness(self, stub, raw):
+        args = lc.CheckCommitReadinessArgs.FromString(raw)
+        res = lc.CheckCommitReadinessResult()
+        for mspid, ok in sorted(self._approvals_for(stub, args.definition).items()):
+            res.approvals[mspid] = ok
+        return success(res.SerializeToString())
+
+    def _commit(self, stub, raw):
+        args = lc.CommitChaincodeDefinitionArgs.FromString(raw)
+        d = args.definition
+        committed = self._load_definition(stub, d.name)
+        expected_seq = (committed.sequence + 1) if committed else 1
+        if d.sequence != expected_seq:
+            return error(
+                f"requested sequence {d.sequence}, next committable is {expected_seq}"
+            )
+        approvals = self._approvals_for(stub, d)
+        yes = sum(approvals.values())
+        if not approvals or yes * 2 <= len(approvals):
+            return error(
+                f"chaincode definition not agreed to by majority: {approvals}"
+            )
+        stub.put_state(_definition_key(d.name), d.SerializeToString())
+        stub.set_event("CommitChaincodeDefinition", d.name.encode())
+        return success(lc.CommitChaincodeDefinitionResult().SerializeToString())
+
+    def _load_definition(self, stub, name: str) -> lc.ChaincodeDefinition | None:
+        raw = stub.get_state(_definition_key(name))
+        if not raw:
+            return None
+        return lc.ChaincodeDefinition.FromString(raw)
+
+    def _query_definition(self, stub, raw):
+        args = lc.QueryChaincodeDefinitionArgs.FromString(raw)
+        d = self._load_definition(stub, args.name)
+        if d is None:
+            return error(f"namespace {args.name} is not defined", status=404)
+        res = lc.QueryChaincodeDefinitionResult()
+        res.definition.CopyFrom(d)
+        for mspid, ok in sorted(self._approvals_for(stub, d).items()):
+            res.approvals[mspid] = ok
+        return success(res.SerializeToString())
+
+    def _query_definitions(self, stub, raw):
+        res = lc.QueryChaincodeDefinitionsResult()
+        for key, value in stub.get_state_by_range("chaincodes/", "chaincodes0"):
+            info = res.chaincode_definitions.add()
+            info.name = key.split("/", 1)[1]
+            info.definition.ParseFromString(value)
+        return success(res.SerializeToString())
+
+
+class DefinitionProvider:
+    """Reads committed chaincode definitions straight from the state DB —
+    the validator-side seam (reference lifecycle/deployedcc_infoprovider.go
+    ValidationInfo): returns the endorsement policy for a namespace."""
+
+    def __init__(self, ledger):
+        self._ledger = ledger
+
+    def definition(self, name: str) -> lc.ChaincodeDefinition | None:
+        sim = self._ledger.new_query_executor()
+        raw = sim.get_state(NAMESPACE, _definition_key(name))
+        if not raw:
+            return None
+        return lc.ChaincodeDefinition.FromString(raw)
+
+    def validation_info(self, name: str) -> tuple[str, bytes] | None:
+        d = self.definition(name)
+        if d is None:
+            return None
+        return (d.validation_plugin or "vscc", bytes(d.validation_parameter))
+
+
+__all__ = ["LifecycleSCC", "PackageStore", "DefinitionProvider", "NAMESPACE"]

@@ -1,0 +1,101 @@
+"""System chaincodes: qscc (ledger queries) and cscc (channel config).
+
+Capability parity with the reference's core/scc:
+- qscc (core/scc/qscc/query.go): GetChainInfo, GetBlockByNumber,
+  GetBlockByHash, GetTransactionByID, GetBlockByTxID.
+- cscc (core/scc/cscc/configure.go): GetChannels, GetConfigBlock,
+  JoinChain (join is node-admin surface; wired by the peer node).
+
+Both run in-process through the same shim/support machinery as user
+chaincodes (core/scc/inprocstream.go), but query the ledger directly via
+the registry handed in at construction rather than through state
+callbacks — matching the reference, where SCCs hold peer resources.
+"""
+
+from __future__ import annotations
+
+from fabric_tpu.chaincode.shim import Chaincode, error, success
+from fabric_tpu.protos.common import common_pb2, ledger_pb2
+
+
+class QSCC(Chaincode):
+    def __init__(self, ledger_getter):
+        """ledger_getter(channel_id) -> ledger with .block_store"""
+        self._ledger = ledger_getter
+
+    def invoke(self, stub):
+        fn, params = stub.get_function_and_parameters()
+        if not params:
+            return error("qscc: missing channel argument")
+        channel_id = params[0].decode()
+        ledger = self._ledger(channel_id)
+        if ledger is None:
+            return error(f"qscc: channel {channel_id!r} not found", status=404)
+        store = ledger.block_store
+        try:
+            if fn == "GetChainInfo":
+                info = ledger_pb2.BlockchainInfo()
+                info.height = store.height
+                last = store.get_block_by_number(store.height - 1)
+                if last is not None:
+                    from fabric_tpu import protoutil
+
+                    info.current_block_hash = protoutil.block_header_hash(last.header)
+                    info.previous_block_hash = bytes(last.header.previous_hash)
+                return success(info.SerializeToString())
+            if fn == "GetBlockByNumber":
+                blk = store.get_block_by_number(int(params[1]))
+                if blk is None:
+                    return error("block not found", status=404)
+                return success(blk.SerializeToString())
+            if fn == "GetBlockByHash":
+                blk = store.get_block_by_hash(params[1])
+                if blk is None:
+                    return error("block not found", status=404)
+                return success(blk.SerializeToString())
+            if fn == "GetTransactionByID":
+                env = store.get_tx_by_id(params[1].decode())
+                if env is None:
+                    return error("transaction not found", status=404)
+                return success(env.SerializeToString())
+            if fn == "GetBlockByTxID":
+                loc = store.get_tx_loc(params[1].decode())
+                if loc is None:
+                    return error("transaction not found", status=404)
+                blk = store.get_block_by_number(loc[0])
+                return success(blk.SerializeToString())
+        except (ValueError, IndexError) as exc:
+            return error(f"qscc: bad arguments: {exc}")
+        return error(f"qscc: unknown function {fn!r}")
+
+
+class CSCC(Chaincode):
+    def __init__(self, channel_lister, config_block_getter, joiner=None):
+        self._channels = channel_lister          # () -> list[str]
+        self._config_block = config_block_getter  # (channel) -> Block | None
+        self._join = joiner                       # (genesis Block) -> None
+
+    def invoke(self, stub):
+        fn, params = stub.get_function_and_parameters()
+        if fn == "GetChannels":
+            from fabric_tpu.protos.peer import configuration_pb2 as peer_cfg
+
+            resp = peer_cfg.ChannelQueryResponse()
+            for ch in self._channels():
+                resp.channels.add().channel_id = ch
+            return success(resp.SerializeToString())
+        if fn == "GetConfigBlock":
+            blk = self._config_block(params[0].decode())
+            if blk is None:
+                return error("channel not found", status=404)
+            return success(blk.SerializeToString())
+        if fn == "JoinChain":
+            if self._join is None:
+                return error("join not supported on this node")
+            blk = common_pb2.Block.FromString(params[0])
+            self._join(blk)
+            return success()
+        return error(f"cscc: unknown function {fn!r}")
+
+
+__all__ = ["QSCC", "CSCC"]

@@ -1,0 +1,267 @@
+"""Chaincode shim: the library a chaincode process links against.
+
+Capability parity with the reference's shim side of the ChaincodeSupport
+stream (the fabric-chaincode-go shim; peer-side counterpart in
+core/chaincode/handler.go): REGISTER handshake, then for each inbound
+TRANSACTION/INIT the shim builds a ChaincodeStub bound to the stream and
+invokes the user chaincode; GetState/PutState/... block on RESPONSE
+messages from the peer, matched by txid.
+
+The stream abstraction is a pair of callables (send, recv) over
+length-prefixed frames, so the same shim runs over an in-process duplex
+queue (system chaincodes, tests) or a TCP socket from a separate OS
+process (`shim_main`, the external-chaincode path — our environment has
+no docker, mirroring the reference's externalbuilder mode).
+"""
+
+from __future__ import annotations
+
+import queue
+import socket
+import struct
+import threading
+
+from fabric_tpu.protos.peer import chaincode_shim_pb2 as shim_pb
+from fabric_tpu.protos.peer import chaincode_pb2, proposal_pb2
+
+_LEN = struct.Struct(">I")
+M = shim_pb.ChaincodeMessage
+
+
+class ChaincodeError(Exception):
+    pass
+
+
+class Chaincode:
+    """User chaincode interface: subclass and implement init/invoke."""
+
+    def init(self, stub: "ChaincodeStub") -> proposal_pb2.Response:
+        return success()
+
+    def invoke(self, stub: "ChaincodeStub") -> proposal_pb2.Response:
+        raise NotImplementedError
+
+
+def success(payload: bytes = b"", message: str = "") -> proposal_pb2.Response:
+    return proposal_pb2.Response(status=200, message=message, payload=payload)
+
+
+def error(message: str, status: int = 500) -> proposal_pb2.Response:
+    return proposal_pb2.Response(status=status, message=message)
+
+
+class ChaincodeStub:
+    def __init__(self, handler: "ShimHandler", msg: M):
+        self._handler = handler
+        self.txid = msg.txid
+        self.channel_id = msg.channel_id
+        inp = chaincode_pb2.ChaincodeInput.FromString(msg.payload)
+        self.args = list(inp.args)
+        self._proposal_bytes = bytes(msg.proposal)
+        self._event: bytes = b""
+
+    # -- args --------------------------------------------------------------
+
+    def get_args(self) -> list[bytes]:
+        return self.args
+
+    def get_function_and_parameters(self) -> tuple[str, list[bytes]]:
+        if not self.args:
+            return "", []
+        return self.args[0].decode(), self.args[1:]
+
+    # -- identity ----------------------------------------------------------
+
+    def get_creator(self) -> bytes:
+        """Serialized identity of the proposal submitter (GetCreator)."""
+        if not self._proposal_bytes:
+            return b""
+        from fabric_tpu.protos.common import common_pb2
+
+        sp = proposal_pb2.SignedProposal.FromString(self._proposal_bytes)
+        prop = proposal_pb2.Proposal.FromString(sp.proposal_bytes)
+        hdr = common_pb2.Header.FromString(prop.header)
+        shdr = common_pb2.SignatureHeader.FromString(hdr.signature_header)
+        return bytes(shdr.creator)
+
+    def creator_mspid(self) -> str:
+        creator = self.get_creator()
+        if not creator:
+            return ""
+        from fabric_tpu.protos.msp import identities_pb2
+
+        return identities_pb2.SerializedIdentity.FromString(creator).mspid
+
+    # -- state -------------------------------------------------------------
+
+    def _call(self, mtype, payload: bytes) -> M:
+        resp = self._handler.call_peer(
+            M(type=mtype, payload=payload, txid=self.txid, channel_id=self.channel_id)
+        )
+        if resp.type == M.ERROR:
+            raise ChaincodeError(resp.payload.decode("utf-8", "replace"))
+        return resp
+
+    def get_state(self, key: str, collection: str = "") -> bytes:
+        g = shim_pb.GetState(key=key, collection=collection)
+        return self._call(M.GET_STATE, g.SerializeToString()).payload
+
+    def put_state(self, key: str, value: bytes, collection: str = "") -> None:
+        p = shim_pb.PutState(key=key, value=value, collection=collection)
+        self._call(M.PUT_STATE, p.SerializeToString())
+
+    def del_state(self, key: str, collection: str = "") -> None:
+        d = shim_pb.DelState(key=key, collection=collection)
+        self._call(M.DEL_STATE, d.SerializeToString())
+
+    def get_state_by_range(self, start: str, end: str, collection: str = ""):
+        """Yields (key, value) pairs."""
+        g = shim_pb.GetStateByRange(
+            start_key=start, end_key=end, collection=collection
+        )
+        resp = self._call(M.GET_STATE_BY_RANGE, g.SerializeToString())
+        qr = shim_pb.QueryResponse.FromString(resp.payload)
+        while True:
+            for rb in qr.results:
+                kv = shim_pb.KV.FromString(rb.result_bytes)
+                yield kv.key, kv.value
+            if not qr.has_more:
+                return
+            nxt = shim_pb.QueryStateNext(id=qr.id)
+            resp = self._call(M.QUERY_STATE_NEXT, nxt.SerializeToString())
+            qr = shim_pb.QueryResponse.FromString(resp.payload)
+
+    def get_private_data_hash(self, collection: str, key: str) -> bytes:
+        g = shim_pb.GetState(key=key, collection=collection)
+        return self._call(M.GET_PRIVATE_DATA_HASH, g.SerializeToString()).payload
+
+    def invoke_chaincode(self, name: str, args: list[bytes], channel: str = ""):
+        spec = chaincode_pb2.ChaincodeSpec()
+        spec.chaincode_id.name = name if not channel else f"{name}/{channel}"
+        spec.input.args.extend(args)
+        resp = self._call(M.INVOKE_CHAINCODE, spec.SerializeToString())
+        return proposal_pb2.Response.FromString(resp.payload)
+
+    def set_event(self, name: str, payload: bytes) -> None:
+        from fabric_tpu.protos.peer import chaincode_event_pb2
+
+        ev = chaincode_event_pb2.ChaincodeEvent(
+            chaincode_id="", tx_id=self.txid, event_name=name, payload=payload
+        )
+        self._event = ev.SerializeToString()
+
+
+class ShimHandler:
+    """Drives one chaincode over one stream."""
+
+    def __init__(self, cc: Chaincode, name: str, send, recv):
+        self._cc = cc
+        self.name = name
+        self._send_raw = send
+        self._recv = recv
+        self._responses: dict[str, queue.Queue] = {}
+        self._lock = threading.Lock()
+
+    def _send(self, msg: M) -> None:
+        self._send_raw(msg.SerializeToString())
+
+    def call_peer(self, msg: M) -> M:
+        q: queue.Queue = queue.Queue(maxsize=1)
+        with self._lock:
+            self._responses[msg.txid] = q
+        self._send(msg)
+        resp = q.get(timeout=30)
+        with self._lock:
+            self._responses.pop(msg.txid, None)
+        return resp
+
+    def run(self) -> None:
+        reg = chaincode_pb2.ChaincodeID(name=self.name)
+        self._send(M(type=M.REGISTER, payload=reg.SerializeToString()))
+        while True:
+            raw = self._recv()
+            if raw is None:
+                return
+            msg = M.FromString(raw)
+            if msg.type in (M.REGISTERED, M.READY, M.KEEPALIVE):
+                continue
+            if msg.type in (M.RESPONSE, M.ERROR):
+                with self._lock:
+                    q = self._responses.get(msg.txid)
+                if q is not None:
+                    q.put(msg)
+                continue
+            if msg.type in (M.TRANSACTION, M.INIT):
+                threading.Thread(
+                    target=self._execute, args=(msg,), daemon=True
+                ).start()
+
+    def _execute(self, msg: M) -> None:
+        try:
+            stub = ChaincodeStub(self, msg)
+            if msg.type == M.INIT:
+                resp = self._cc.init(stub)
+            else:
+                resp = self._cc.invoke(stub)
+            self._send(
+                M(
+                    type=M.COMPLETED,
+                    payload=resp.SerializeToString(),
+                    txid=msg.txid,
+                    channel_id=msg.channel_id,
+                    chaincode_event=stub._event,
+                )
+            )
+        except Exception as exc:  # chaincode panic -> ERROR (handler.go)
+            self._send(
+                M(
+                    type=M.ERROR,
+                    payload=str(exc).encode(),
+                    txid=msg.txid,
+                    channel_id=msg.channel_id,
+                )
+            )
+
+
+def shim_main(cc: Chaincode, name: str, peer_address: str) -> None:
+    """External chaincode entry: connect to the peer's chaincode listener
+    (CORE_PEER_ADDRESS equivalent) and serve forever."""
+    host, port = peer_address.rsplit(":", 1)
+    sock = socket.create_connection((host, int(port)))
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    lock = threading.Lock()
+
+    def send(data: bytes) -> None:
+        with lock:
+            sock.sendall(_LEN.pack(len(data)) + data)
+
+    buf = bytearray()
+
+    def recv() -> bytes | None:
+        while len(buf) < _LEN.size:
+            chunk = sock.recv(65536)
+            if not chunk:
+                return None
+            buf.extend(chunk)
+        (ln,) = _LEN.unpack_from(bytes(buf[:4]))
+        while len(buf) < _LEN.size + ln:
+            chunk = sock.recv(65536)
+            if not chunk:
+                return None
+            buf.extend(chunk)
+        frame = bytes(buf[4 : 4 + ln])
+        del buf[: 4 + ln]
+        return frame
+
+    ShimHandler(cc, name, send, recv).run()
+
+
+__all__ = [
+    "Chaincode",
+    "ChaincodeStub",
+    "ChaincodeError",
+    "ShimHandler",
+    "shim_main",
+    "success",
+    "error",
+]

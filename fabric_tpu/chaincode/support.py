@@ -1,0 +1,387 @@
+"""Peer-side chaincode runtime.
+
+Capability parity with the reference's core/chaincode
+(chaincode_support.go:79 Launch / :129 Register / :154 Execute;
+handler.go:355 ProcessStream, :147 handleMessage state machine, :594+
+HandleGetState/HandlePutState/...; transaction_context.go registry):
+
+- `ChaincodeSupport.register_stream` serves one chaincode connection:
+  REGISTER -> REGISTERED -> READY handshake, then routes ledger callbacks
+  against the per-tx TxSimulator and replies RESPONSE/ERROR.
+- `execute` dispatches a TRANSACTION to a registered chaincode and waits
+  for COMPLETED/ERROR with a timeout.
+- `InProcStream` runs a shim-side handler in-process over queue pipes
+  (reference core/scc/inprocstream.go, the system-chaincode path).
+- `TCPChaincodeListener` accepts external chaincode processes (reference
+  externalbuilder run mode — docker-free, like our TPU hosts).
+
+Range queries paginate through the tx context's open iterators
+(QUERY_STATE_NEXT/CLOSE), matching handler.go's queryResponseGenerator.
+"""
+
+from __future__ import annotations
+
+import queue
+import socket
+import struct
+import threading
+
+from fabric_tpu.protos.peer import chaincode_pb2, chaincode_shim_pb2 as shim_pb
+from fabric_tpu.protos.peer import proposal_pb2
+
+_LEN = struct.Struct(">I")
+M = shim_pb.ChaincodeMessage
+_RANGE_PAGE = 100
+
+
+class ChaincodeExecuteError(Exception):
+    pass
+
+
+class TxContext:
+    def __init__(self, simulator, channel_id: str, txid: str):
+        self.simulator = simulator
+        self.channel_id = channel_id
+        self.txid = txid
+        self.iterators: dict[str, object] = {}
+        self._iter_seq = 0
+        self.event: bytes = b""
+        self.response_q: queue.Queue = queue.Queue(maxsize=1)
+
+    def new_iterator_id(self) -> str:
+        self._iter_seq += 1
+        return f"it{self._iter_seq}"
+
+
+class _CCHandle:
+    """One registered chaincode stream."""
+
+    def __init__(self, name: str, send):
+        self.name = name
+        self.send = send
+
+
+class ChaincodeSupport:
+    def __init__(self, invoke_timeout_s: float = 30.0):
+        self._ccs: dict[str, _CCHandle] = {}
+        self._contexts: dict[tuple[str, str], TxContext] = {}
+        self._namespaces: dict[tuple[str, str], str] = {}
+        self._lock = threading.Lock()
+        self._timeout = invoke_timeout_s
+        self.cc2cc_allowed = True
+
+    # -- registration (one per stream) -------------------------------------
+
+    def register_stream(self, send, recv) -> None:
+        """Serve one chaincode connection until EOF.  `send(bytes)`,
+        `recv() -> bytes | None`.  Replies to ledger callbacks go back on
+        this same stream (handler.go serialSendAsync)."""
+        name: str | None = None
+        try:
+            while True:
+                raw = recv()
+                if raw is None:
+                    return
+                msg = M.FromString(raw)
+                if msg.type == M.REGISTER:
+                    cid = chaincode_pb2.ChaincodeID.FromString(msg.payload)
+                    name = cid.name
+                    with self._lock:
+                        self._ccs[name] = _CCHandle(
+                            name, lambda m: send(m.SerializeToString())
+                        )
+                    send(M(type=M.REGISTERED).SerializeToString())
+                    send(M(type=M.READY).SerializeToString())
+                    continue
+                ctx = self._ctx(msg)
+                if ctx is None:
+                    continue  # unknown tx: drop (reference logs + ERROR)
+                try:
+                    out = self._dispatch(msg, ctx)
+                except Exception as exc:
+                    out = self._error(msg, str(exc))
+                if out is not None:
+                    send(out.SerializeToString())
+        finally:
+            if name is not None:
+                with self._lock:
+                    self._ccs.pop(name, None)
+
+    def registered(self, name: str) -> bool:
+        with self._lock:
+            return name in self._ccs
+
+    # -- execution (peer -> chaincode) -------------------------------------
+
+    def execute(
+        self,
+        name: str,
+        channel_id: str,
+        txid: str,
+        simulator,
+        args: list[bytes],
+        is_init: bool = False,
+        signed_proposal_bytes: bytes = b"",
+        namespace: str | None = None,
+    ) -> tuple[proposal_pb2.Response, bytes]:
+        """Returns (Response, chaincode_event_bytes).  State access inside
+        the tx is namespaced to the chaincode name (handler.go uses the
+        chaincode name as the rwset namespace)."""
+        with self._lock:
+            cc = self._ccs.get(name)
+        if cc is None:
+            raise ChaincodeExecuteError(f"chaincode {name!r} not registered")
+        ctx = TxContext(simulator, channel_id, txid)
+        key = (channel_id, txid)
+        with self._lock:
+            if key in self._contexts:
+                raise ChaincodeExecuteError(f"duplicate tx context {key}")
+            self._contexts[key] = ctx
+            self._namespaces[key] = namespace if namespace is not None else name
+        try:
+            inp = chaincode_pb2.ChaincodeInput(args=args)
+            cc.send(
+                M(
+                    type=M.INIT if is_init else M.TRANSACTION,
+                    payload=inp.SerializeToString(),
+                    txid=txid,
+                    channel_id=channel_id,
+                    proposal=signed_proposal_bytes,
+                )
+            )
+            try:
+                msg = ctx.response_q.get(timeout=self._timeout)
+            except queue.Empty:
+                raise ChaincodeExecuteError(
+                    f"chaincode {name!r} timed out after {self._timeout}s"
+                ) from None
+            if msg.type == M.ERROR:
+                raise ChaincodeExecuteError(msg.payload.decode("utf-8", "replace"))
+            resp = proposal_pb2.Response.FromString(msg.payload)
+            return resp, bytes(msg.chaincode_event)
+        finally:
+            with self._lock:
+                self._contexts.pop(key, None)
+                self._namespaces.pop(key, None)
+
+    # -- ledger callbacks (chaincode -> peer) ------------------------------
+
+    def _ctx(self, msg: M) -> TxContext | None:
+        with self._lock:
+            return self._contexts.get((msg.channel_id, msg.txid))
+
+    def _reply(self, msg: M, payload: bytes = b"") -> M:
+        return M(
+            type=M.RESPONSE, payload=payload, txid=msg.txid, channel_id=msg.channel_id
+        )
+
+    def _error(self, msg: M, text: str) -> M:
+        return M(
+            type=M.ERROR, payload=text.encode(), txid=msg.txid,
+            channel_id=msg.channel_id,
+        )
+
+    def _dispatch(self, msg: M, ctx: TxContext) -> M:
+        sim = ctx.simulator
+        ns = self._tx_namespace(ctx)
+        if msg.type == M.GET_STATE:
+            g = shim_pb.GetState.FromString(msg.payload)
+            if g.collection:
+                val = sim.get_private_data(ns, g.collection, g.key)
+            else:
+                val = sim.get_state(ns, g.key)
+            return self._reply(msg, val or b"")
+        if msg.type == M.PUT_STATE:
+            p = shim_pb.PutState.FromString(msg.payload)
+            if p.collection:
+                sim.set_private_data(ns, p.collection, p.key, p.value)
+            else:
+                sim.set_state(ns, p.key, p.value)
+            return self._reply(msg)
+        if msg.type == M.DEL_STATE:
+            d = shim_pb.DelState.FromString(msg.payload)
+            if d.collection:
+                sim.delete_private_data(ns, d.collection, d.key)
+            else:
+                sim.delete_state(ns, d.key)
+            return self._reply(msg)
+        if msg.type == M.GET_PRIVATE_DATA_HASH:
+            g = shim_pb.GetState.FromString(msg.payload)
+            val = sim.get_private_data_hash(ns, g.collection, g.key)
+            return self._reply(msg, val or b"")
+        if msg.type == M.GET_STATE_BY_RANGE:
+            g = shim_pb.GetStateByRange.FromString(msg.payload)
+            it = iter(sim.get_state_range(ns, g.start_key, g.end_key))
+            iid = ctx.new_iterator_id()
+            ctx.iterators[iid] = it
+            return self._reply(msg, self._page(ctx, iid).SerializeToString())
+        if msg.type == M.QUERY_STATE_NEXT:
+            qn = shim_pb.QueryStateNext.FromString(msg.payload)
+            if qn.id not in ctx.iterators:
+                return self._error(msg, f"unknown iterator {qn.id}")
+            return self._reply(msg, self._page(ctx, qn.id).SerializeToString())
+        if msg.type == M.QUERY_STATE_CLOSE:
+            qc = shim_pb.QueryStateClose.FromString(msg.payload)
+            ctx.iterators.pop(qc.id, None)
+            return self._reply(msg)
+        if msg.type == M.INVOKE_CHAINCODE:
+            return self._handle_cc2cc(msg, ctx)
+        if msg.type in (M.COMPLETED, M.ERROR):
+            ctx.event = bytes(msg.chaincode_event)
+            ctx.response_q.put(msg)
+            return None  # no reply
+        return self._error(msg, f"unexpected message type {msg.type}")
+
+    def _tx_namespace(self, ctx: TxContext) -> str:
+        return self._namespaces.get((ctx.channel_id, ctx.txid), "")
+
+    def set_tx_namespace(self, channel_id: str, txid: str, ns: str) -> None:
+        self._namespaces[(channel_id, txid)] = ns
+
+    def _page(self, ctx: TxContext, iid: str) -> shim_pb.QueryResponse:
+        it = ctx.iterators[iid]
+        qr = shim_pb.QueryResponse(id=iid)
+        for _ in range(_RANGE_PAGE):
+            try:
+                key, value = next(it)
+            except StopIteration:
+                ctx.iterators.pop(iid, None)
+                qr.has_more = False
+                return qr
+            kv = shim_pb.KV(key=key, value=value)
+            qr.results.add().result_bytes = kv.SerializeToString()
+        qr.has_more = True
+        return qr
+
+    def _handle_cc2cc(self, msg: M, ctx: TxContext) -> M:
+        if not self.cc2cc_allowed:
+            return self._error(msg, "chaincode-to-chaincode disabled")
+        spec = chaincode_pb2.ChaincodeSpec.FromString(msg.payload)
+        target = spec.chaincode_id.name.split("/", 1)[0]
+        sub_txid = f"{msg.txid}-cc2cc-{target}"
+        try:
+            resp, _ = self.execute(
+                target,
+                ctx.channel_id,
+                sub_txid,
+                ctx.simulator,  # same simulator: one atomic rwset
+                list(spec.input.args),
+            )
+        except ChaincodeExecuteError as exc:
+            return self._error(msg, str(exc))
+        return self._reply(msg, resp.SerializeToString())
+
+
+# ---------------------------------------------------------------------------
+# streams
+# ---------------------------------------------------------------------------
+
+class InProcStream:
+    """Duplex queue pipe binding a shim-side handler to ChaincodeSupport in
+    one process (system chaincodes; unit tests)."""
+
+    def __init__(self, support: ChaincodeSupport, cc, name: str):
+        from fabric_tpu.chaincode.shim import ShimHandler
+
+        self._to_peer: queue.Queue = queue.Queue()
+        self._to_cc: queue.Queue = queue.Queue()
+        self._support = support
+        peer_send = self._to_cc.put
+        peer_recv = lambda: self._to_peer.get()
+        self._shim = ShimHandler(
+            cc, name, send=self._to_peer.put, recv=lambda: self._to_cc.get()
+        )
+        self._threads = [
+            threading.Thread(
+                target=self._serve_peer_side, args=(peer_send, peer_recv),
+                daemon=True,
+            ),
+            threading.Thread(target=self._shim.run, daemon=True),
+        ]
+
+    def _serve_peer_side(self, send, recv) -> None:
+        self._support.register_stream(send, recv)
+
+    def start(self) -> None:
+        for t in self._threads:
+            t.start()
+
+    def wait_registered(self, support: ChaincodeSupport, name: str, timeout=5.0):
+        import time
+
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if support.registered(name):
+                return
+            time.sleep(0.01)
+        raise TimeoutError(f"chaincode {name} did not register")
+
+
+class TCPChaincodeListener:
+    """Accepts external chaincode processes (peer's chaincode listener)."""
+
+    def __init__(self, support: ChaincodeSupport, listen_addr=("127.0.0.1", 0)):
+        self._support = support
+        self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._server.bind(listen_addr)
+        self._server.listen(16)
+        self.addr = self._server.getsockname()
+        self._stop = threading.Event()
+        threading.Thread(target=self._accept, daemon=True).start()
+
+    def _accept(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._server.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,), daemon=True).start()
+
+    def _serve(self, conn: socket.socket) -> None:
+        lock = threading.Lock()
+        buf = bytearray()
+
+        def send(data: bytes) -> None:
+            with lock:
+                conn.sendall(_LEN.pack(len(data)) + data)
+
+        def recv() -> bytes | None:
+            while len(buf) < _LEN.size:
+                chunk = conn.recv(65536)
+                if not chunk:
+                    return None
+                buf.extend(chunk)
+            (ln,) = _LEN.unpack_from(bytes(buf[:4]))
+            while len(buf) < _LEN.size + ln:
+                chunk = conn.recv(65536)
+                if not chunk:
+                    return None
+                buf.extend(chunk)
+            frame = bytes(buf[4 : 4 + ln])
+            del buf[: 4 + ln]
+            return frame
+
+        try:
+            self._support.register_stream(send, recv)
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._server.close()
+        except OSError:
+            pass
+
+
+__all__ = [
+    "ChaincodeSupport",
+    "InProcStream",
+    "TCPChaincodeListener",
+    "ChaincodeExecuteError",
+    "TxContext",
+]

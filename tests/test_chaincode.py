@@ -1,0 +1,177 @@
+"""Chaincode runtime tests: shim <-> support stream state machine,
+in-process and external-process execution, range queries, cc2cc, error
+paths (reference core/chaincode/chaincode_support_test.go strategy:
+real handler + in-proc streams)."""
+
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+from fabric_tpu.chaincode import Chaincode, ChaincodeSupport, InProcStream
+from fabric_tpu.chaincode.shim import error, success
+from fabric_tpu.chaincode.support import ChaincodeExecuteError, TCPChaincodeListener
+from fabric_tpu.ledger.kvstore import MemKVStore
+from fabric_tpu.ledger.statedb import Height, VersionedDB, VersionedValue
+from fabric_tpu.ledger.txmgmt import TxSimulator
+
+
+class KVChaincode(Chaincode):
+    def invoke(self, stub):
+        fn, params = stub.get_function_and_parameters()
+        if fn == "put":
+            stub.put_state(params[0].decode(), params[1])
+            return success()
+        if fn == "get":
+            return success(stub.get_state(params[0].decode()))
+        if fn == "del":
+            stub.del_state(params[0].decode())
+            return success()
+        if fn == "range":
+            items = [
+                f"{k}={v.decode()}"
+                for k, v in stub.get_state_by_range(
+                    params[0].decode(), params[1].decode()
+                )
+            ]
+            return success(",".join(items).encode())
+        if fn == "call":  # cc2cc
+            resp = stub.invoke_chaincode(params[0].decode(), list(params[1:]))
+            return resp
+        if fn == "boom":
+            raise RuntimeError("chaincode exploded")
+        if fn == "event":
+            stub.set_event("my-event", b"event-payload")
+            return success()
+        return error(f"unknown function {fn!r}")
+
+
+@pytest.fixture
+def support():
+    return ChaincodeSupport(invoke_timeout_s=5.0)
+
+
+@pytest.fixture
+def sim():
+    return TxSimulator(VersionedDB(MemKVStore()))
+
+
+def _launch(support, name="kvcc", cc=None):
+    stream = InProcStream(support, cc or KVChaincode(), name)
+    stream.start()
+    stream.wait_registered(support, name)
+    return stream
+
+
+def test_execute_put_get(support, sim):
+    _launch(support)
+    resp, _ = support.execute("kvcc", "ch", "tx1", sim, [b"put", b"k1", b"v1"])
+    assert resp.status == 200
+    resp, _ = support.execute("kvcc", "ch", "tx2", sim, [b"get", b"k1"])
+    # within the same simulator, reads see prior writes
+    assert resp.status == 200 and resp.payload == b"v1"
+    # rwset namespaced to the chaincode name
+    results = sim.get_tx_simulation_results()
+    assert b"kvcc" in results
+
+
+def test_execute_unregistered_chaincode(support, sim):
+    with pytest.raises(ChaincodeExecuteError, match="not registered"):
+        support.execute("ghost", "ch", "tx1", sim, [b"get", b"x"])
+
+
+def test_chaincode_exception_becomes_error(support, sim):
+    _launch(support)
+    with pytest.raises(ChaincodeExecuteError, match="exploded"):
+        support.execute("kvcc", "ch", "tx1", sim, [b"boom"])
+
+
+def test_range_query_pagination(support):
+    db = VersionedDB(MemKVStore())
+    db.apply_updates(
+        {
+            "kvcc": {
+                f"k{i:04d}": VersionedValue(b"v%d" % i, Height(1, i), b"")
+                for i in range(250)  # 2.5 pages at page size 100
+            }
+        },
+        Height(1, 249),
+    )
+    sim = TxSimulator(db)
+    _launch(support)
+    resp, _ = support.execute(
+        "kvcc", "ch", "tx-range", sim, [b"range", b"k0000", b"k0250"]
+    )
+    assert resp.status == 200
+    items = resp.payload.decode().split(",")
+    assert len(items) == 250
+    assert items[0] == "k0000=v0" and items[-1] == "k0249=v249"
+
+
+def test_cc2cc_shares_simulator(support, sim):
+    _launch(support, "kvcc")
+    _launch(support, "othercc")
+    resp, _ = support.execute(
+        "kvcc", "ch", "tx1", sim, [b"call", b"othercc", b"put", b"shared", b"yes"]
+    )
+    assert resp.status == 200
+    results = sim.get_tx_simulation_results()
+    assert b"othercc" in results  # write landed in callee's namespace
+
+
+def test_chaincode_event_propagates(support, sim):
+    _launch(support)
+    resp, event = support.execute("kvcc", "ch", "tx-ev", sim, [b"event"])
+    assert resp.status == 200
+    from fabric_tpu.protos.peer import chaincode_event_pb2
+
+    ev = chaincode_event_pb2.ChaincodeEvent.FromString(event)
+    assert ev.event_name == "my-event" and ev.payload == b"event-payload"
+
+
+EXTERNAL_CC = textwrap.dedent(
+    """
+    import sys
+    sys.path.insert(0, %r)
+    from fabric_tpu.chaincode.shim import Chaincode, shim_main, success
+
+    class Echo(Chaincode):
+        def invoke(self, stub):
+            stub.put_state("echo", b"-".join(stub.args))
+            return success(b"-".join(stub.args))
+
+    shim_main(Echo(), "echocc", sys.argv[1])
+    """
+)
+
+
+def test_external_process_chaincode(support, sim, tmp_path):
+    """The externalbuilder path: chaincode as a separate OS process
+    connecting back over TCP (reference core/container/externalbuilder)."""
+    import os
+
+    listener = TCPChaincodeListener(support)
+    script = tmp_path / "echo_cc.py"
+    script.write_text(EXTERNAL_CC % os.getcwd())
+    proc = subprocess.Popen(
+        [sys.executable, str(script), f"127.0.0.1:{listener.addr[1]}"],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+    )
+    try:
+        deadline = time.monotonic() + 10
+        while not support.registered("echocc"):
+            if time.monotonic() > deadline:
+                raise AssertionError(
+                    "external chaincode did not register: "
+                    + proc.stderr.peek().decode("utf-8", "replace")
+                )
+            time.sleep(0.05)
+        resp, _ = support.execute("echocc", "ch", "xtx", sim, [b"a", b"b"])
+        assert resp.status == 200 and resp.payload == b"a-b"
+        assert sim.get_state("echocc", "echo") == b"a-b"
+    finally:
+        proc.kill()
+        listener.close()

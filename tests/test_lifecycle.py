@@ -1,0 +1,244 @@
+"""_lifecycle SCC + qscc/cscc tests (reference
+core/chaincode/lifecycle/*_test.go strategy: drive the SCC through the
+chaincode machinery with real state)."""
+
+import hashlib
+import io
+import json
+import tarfile
+
+import pytest
+
+from fabric_tpu.chaincode import ChaincodeSupport, InProcStream
+from fabric_tpu.chaincode.lifecycle import (
+    DefinitionProvider,
+    LifecycleSCC,
+    NAMESPACE,
+    PackageStore,
+)
+from fabric_tpu.chaincode.scc import CSCC, QSCC
+from fabric_tpu.ledger.kvstore import MemKVStore
+from fabric_tpu.ledger.statedb import VersionedDB
+from fabric_tpu.ledger.txmgmt import TxSimulator
+from fabric_tpu.protos.peer import lifecycle_pb2 as lc
+from fabric_tpu.protos.peer import proposal_pb2
+from fabric_tpu.protos.common import common_pb2
+from fabric_tpu.protos.msp import identities_pb2
+
+
+def make_package(label: str) -> bytes:
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w:gz") as tf:
+        meta = json.dumps({"label": label, "type": "python"}).encode()
+        info = tarfile.TarInfo("metadata.json")
+        info.size = len(meta)
+        tf.addfile(info, io.BytesIO(meta))
+        code = b"print('hi')"
+        info2 = tarfile.TarInfo("src/main.py")
+        info2.size = len(code)
+        tf.addfile(info2, io.BytesIO(code))
+    return buf.getvalue()
+
+
+def proposal_for(mspid: str) -> bytes:
+    sid = identities_pb2.SerializedIdentity(mspid=mspid, id_bytes=b"cert")
+    shdr = common_pb2.SignatureHeader(creator=sid.SerializeToString())
+    hdr = common_pb2.Header(signature_header=shdr.SerializeToString())
+    prop = proposal_pb2.Proposal(header=hdr.SerializeToString())
+    sp = proposal_pb2.SignedProposal(proposal_bytes=prop.SerializeToString())
+    return sp.SerializeToString()
+
+
+@pytest.fixture
+def world(tmp_path):
+    support = ChaincodeSupport(invoke_timeout_s=5.0)
+    store = PackageStore(str(tmp_path / "packages"))
+    scc = LifecycleSCC(store, org_lister=lambda: ["Org1MSP", "Org2MSP"])
+    stream = InProcStream(support, scc, NAMESPACE)
+    stream.start()
+    stream.wait_registered(support, NAMESPACE)
+    db = VersionedDB(MemKVStore())
+    return support, db
+
+
+def call(support, db, fn: str, arg: bytes, mspid="Org1MSP", txid="tx"):
+    sim = TxSimulator(db)
+    resp, _ = support.execute(
+        NAMESPACE, "ch", f"{txid}-{fn}-{mspid}", sim,
+        [fn.encode(), arg],
+        signed_proposal_bytes=proposal_for(mspid),
+    )
+    # commit the lifecycle writes so later calls observe them
+    from fabric_tpu.ledger.statedb import Height, VersionedValue
+    from fabric_tpu.protos.ledger.rwset import rwset_pb2
+    from fabric_tpu.protos.ledger.rwset.kvrwset import kv_rwset_pb2
+
+    txrw = rwset_pb2.TxReadWriteSet.FromString(sim.get_tx_simulation_results())
+    batch = {}
+    for ns in txrw.ns_rwset:
+        kv = kv_rwset_pb2.KVRWSet.FromString(ns.rwset)
+        for w in kv.writes:
+            batch.setdefault(ns.namespace, {})[w.key] = (
+                None if w.is_delete else VersionedValue(w.value, Height(1, 1), b"")
+            )
+    if batch:
+        db.apply_updates(batch, Height(1, 1))
+    return resp
+
+
+def _definition(name="mycc", sequence=1, policy=b"policy-bytes"):
+    d = lc.ChaincodeDefinition(
+        sequence=sequence, name=name, version="1.0",
+        validation_parameter=policy,
+    )
+    return d
+
+
+def test_install_and_query(world):
+    support, db = world
+    pkg = make_package("mycc_1.0")
+    args = lc.InstallChaincodeArgs(chaincode_install_package=pkg)
+    resp = call(support, db, "InstallChaincode", args.SerializeToString())
+    assert resp.status == 200
+    res = lc.InstallChaincodeResult.FromString(resp.payload)
+    assert res.label == "mycc_1.0"
+    assert res.package_id == f"mycc_1.0:{hashlib.sha256(pkg).hexdigest()}"
+
+    resp = call(support, db, "QueryInstalledChaincodes", b"")
+    installed = lc.QueryInstalledChaincodesResult.FromString(resp.payload)
+    assert [ic.label for ic in installed.installed_chaincodes] == ["mycc_1.0"]
+
+    resp = call(support, db, "GetInstalledChaincodePackage", res.package_id.encode())
+    assert resp.status == 200 and resp.payload == pkg
+
+
+def test_approve_checkreadiness_commit_flow(world):
+    support, db = world
+    d = _definition()
+    approve = lc.ApproveChaincodeDefinitionForMyOrgArgs()
+    approve.definition.CopyFrom(d)
+
+    # only Org1 approves: not ready, commit refused
+    resp = call(support, db, "ApproveChaincodeDefinitionForMyOrg",
+                approve.SerializeToString(), mspid="Org1MSP")
+    assert resp.status == 200
+    chk = lc.CheckCommitReadinessArgs()
+    chk.definition.CopyFrom(d)
+    resp = call(support, db, "CheckCommitReadiness", chk.SerializeToString())
+    ready = lc.CheckCommitReadinessResult.FromString(resp.payload)
+    assert dict(ready.approvals) == {"Org1MSP": True, "Org2MSP": False}
+    commit = lc.CommitChaincodeDefinitionArgs()
+    commit.definition.CopyFrom(d)
+    resp = call(support, db, "CommitChaincodeDefinition", commit.SerializeToString())
+    assert resp.status == 500 and "majority" in resp.message
+
+    # Org2 approves the SAME definition: commit passes
+    resp = call(support, db, "ApproveChaincodeDefinitionForMyOrg",
+                approve.SerializeToString(), mspid="Org2MSP")
+    assert resp.status == 200
+    resp = call(support, db, "CommitChaincodeDefinition", commit.SerializeToString())
+    assert resp.status == 200
+
+    # query it back
+    q = lc.QueryChaincodeDefinitionArgs(name="mycc")
+    resp = call(support, db, "QueryChaincodeDefinition", q.SerializeToString())
+    got = lc.QueryChaincodeDefinitionResult.FromString(resp.payload)
+    assert got.definition.version == "1.0"
+    assert got.definition.validation_parameter == b"policy-bytes"
+
+    # sequence must advance by exactly one
+    d3 = _definition(sequence=3)
+    approve3 = lc.ApproveChaincodeDefinitionForMyOrgArgs()
+    approve3.definition.CopyFrom(d3)
+    resp = call(support, db, "ApproveChaincodeDefinitionForMyOrg",
+                approve3.SerializeToString())
+    assert resp.status == 500 and "sequence" in resp.message
+
+
+def test_approval_hash_mismatch_not_ready(world):
+    support, db = world
+    d1 = _definition(policy=b"policy-A")
+    d2 = _definition(policy=b"policy-B")
+    for mspid, d in (("Org1MSP", d1), ("Org2MSP", d2)):
+        a = lc.ApproveChaincodeDefinitionForMyOrgArgs()
+        a.definition.CopyFrom(d)
+        call(support, db, "ApproveChaincodeDefinitionForMyOrg",
+             a.SerializeToString(), mspid=mspid)
+    chk = lc.CheckCommitReadinessArgs()
+    chk.definition.CopyFrom(d1)
+    resp = call(support, db, "CheckCommitReadiness", chk.SerializeToString())
+    ready = lc.CheckCommitReadinessResult.FromString(resp.payload)
+    # Org2 approved different params -> its approval doesn't count for d1
+    assert dict(ready.approvals) == {"Org1MSP": True, "Org2MSP": False}
+
+
+def test_definition_provider_reads_committed_state(world):
+    support, db = world
+    d = _definition()
+    for mspid in ("Org1MSP", "Org2MSP"):
+        a = lc.ApproveChaincodeDefinitionForMyOrgArgs()
+        a.definition.CopyFrom(d)
+        call(support, db, "ApproveChaincodeDefinitionForMyOrg",
+             a.SerializeToString(), mspid=mspid)
+    commit = lc.CommitChaincodeDefinitionArgs()
+    commit.definition.CopyFrom(d)
+    call(support, db, "CommitChaincodeDefinition", commit.SerializeToString())
+
+    class FakeLedger:
+        def new_query_executor(self):
+            return TxSimulator(db)
+
+    dp = DefinitionProvider(FakeLedger())
+    assert dp.definition("mycc").version == "1.0"
+    assert dp.validation_info("mycc") == ("vscc", b"policy-bytes")
+    assert dp.definition("ghost") is None
+
+
+def test_qscc_queries(tmp_path):
+    from fabric_tpu.ledger.blkstorage import BlockStore
+    from fabric_tpu import protoutil
+
+    support = ChaincodeSupport(invoke_timeout_s=5.0)
+    store = BlockStore(None, name="qscc-test")
+    genesis = protoutil.new_block(0, b"")
+    genesis.data.data.append(b"cfg")
+    genesis.header.data_hash = protoutil.block_data_hash(genesis.data)
+    store.add_block(genesis)
+
+    class FakeLedger:
+        block_store = store
+
+    qscc = QSCC(lambda ch: FakeLedger() if ch == "ch" else None)
+    stream = InProcStream(support, qscc, "qscc")
+    stream.start()
+    stream.wait_registered(support, "qscc")
+    sim = TxSimulator(VersionedDB(MemKVStore()))
+
+    resp, _ = support.execute("qscc", "ch", "q1", sim, [b"GetChainInfo", b"ch"])
+    from fabric_tpu.protos.common import ledger_pb2
+
+    info = ledger_pb2.BlockchainInfo.FromString(resp.payload)
+    assert info.height == 1
+
+    resp, _ = support.execute(
+        "qscc", "ch", "q2", sim, [b"GetBlockByNumber", b"ch", b"0"]
+    )
+    blk = common_pb2.Block.FromString(resp.payload)
+    assert blk.header.number == 0
+
+    resp, _ = support.execute("qscc", "ch", "q3", sim, [b"GetChainInfo", b"ghost"])
+    assert resp.status == 404
+
+
+def test_cscc_channels_and_config(tmp_path):
+    support = ChaincodeSupport(invoke_timeout_s=5.0)
+    cscc = CSCC(lambda: ["ch1", "ch2"], lambda ch: None)
+    stream = InProcStream(support, cscc, "cscc")
+    stream.start()
+    stream.wait_registered(support, "cscc")
+    sim = TxSimulator(VersionedDB(MemKVStore()))
+    resp, _ = support.execute("cscc", "", "c1", sim, [b"GetChannels"])
+    from fabric_tpu.protos.peer import configuration_pb2
+
+    chans = configuration_pb2.ChannelQueryResponse.FromString(resp.payload)
+    assert [c.channel_id for c in chans.channels] == ["ch1", "ch2"]
