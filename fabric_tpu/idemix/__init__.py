@@ -1,0 +1,44 @@
+"""Idemix: anonymous credentials (reference /root/reference/idemix/*.go).
+
+The reference implements the CDL credential scheme on the FP256BN pairing
+curve via the fabric-amcl library (idemix/util.go, signature.go:243,
+credential.go:37).  This package is a ground-up reimplementation of the same
+capability surface on BN254 (a standard Barreto-Naehrig curve of the same
+256-bit/BN security class, chosen for its widely published, testable
+parameters):
+
+- bn254:       field towers Fp/Fp2/Fp6/Fp12, G1/G2, optimal-ate pairing
+- issuer:      issuer key generation with proof of well-formedness
+               (reference idemix/issuerkey.go)
+- credrequest: blinded credential request (idemix/credrequest.go)
+- credential:  BBS+-style credential issuance/verification
+               (idemix/credential.go)
+- signature:   presentation proof with selective disclosure + pseudonym
+               (idemix/signature.go) and batched verification (the BN256
+               batch-verify baseline configuration)
+- nymsignature: pseudonym-only signatures (idemix/nymsignature.go)
+- weakbb:      weak Boneh-Boyen signatures (idemix/weakbb.go)
+- revocation:  epoch CRI signing/verification (idemix/revocation.go)
+"""
+
+from fabric_tpu.idemix.bn254 import (  # noqa: F401
+    GROUP_ORDER,
+    G1,
+    G2,
+    g1_gen,
+    g2_gen,
+    pairing,
+    rand_zr,
+)
+from fabric_tpu.idemix.issuer import IssuerKey, IssuerPublicKey  # noqa: F401
+from fabric_tpu.idemix.credential import (  # noqa: F401
+    Credential,
+    CredRequest,
+    new_credential,
+    new_cred_request,
+)
+from fabric_tpu.idemix.signature import Signature, new_signature  # noqa: F401
+from fabric_tpu.idemix.nymsignature import (  # noqa: F401
+    NymSignature,
+    new_nym_signature,
+)

@@ -1,0 +1,158 @@
+"""Credential request + issuance (reference idemix/credrequest.go,
+idemix/credential.go).
+
+Flow (as in the reference):
+
+1. User picks secret key sk, computes Nym = HSk^sk and a Schnorr PoK of sk
+   bound to an issuer nonce (credrequest.go NewCredRequest/Check).
+2. Issuer picks (e, s), forms
+
+       B = g1 * Nym * HRand^s * prod_i HAttrs_i^{m_i}
+       A = B^{1/(e + x)}
+
+   and returns (A, B, e, s, attrs) (credential.go NewCredential).
+3. User verifies the credential against the issuer public key with the
+   pairing identity e(A, g2^e * W) == e(B, g2) (credential.go Ver).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from fabric_tpu.idemix import bn254 as bn
+from fabric_tpu.idemix.issuer import IssuerKey, IssuerPublicKey
+
+
+@dataclasses.dataclass
+class CredRequest:
+    nym: tuple  # HSk^sk
+    issuer_nonce: bytes
+    proof_c: int
+    proof_s: int
+
+    def check(self, ipk: IssuerPublicKey) -> None:
+        """Verify the PoK of sk (reference credrequest.go Check)."""
+        if self.nym is None or not bn.g1_is_on_curve(self.nym):
+            raise ValueError("cred request: bad nym")
+        t = bn.g1_add(
+            bn.g1_mul(ipk.h_sk, self.proof_s),
+            bn.g1_mul(self.nym, (-self.proof_c) % bn.R),
+        )
+        c = bn.hash_to_zr(
+            b"idemix-credrequest",
+            bn.g1_to_bytes(t),
+            bn.g1_to_bytes(self.nym),
+            self.issuer_nonce,
+            ipk.hash(),
+        )
+        if c != self.proof_c:
+            raise ValueError("cred request: proof of knowledge fails")
+
+
+def new_cred_request(
+    sk: int, issuer_nonce: bytes, ipk: IssuerPublicKey, rng=None
+) -> CredRequest:
+    nym = bn.g1_mul(ipk.h_sk, sk)
+    rho = bn.rand_zr(rng)
+    t = bn.g1_mul(ipk.h_sk, rho)
+    c = bn.hash_to_zr(
+        b"idemix-credrequest",
+        bn.g1_to_bytes(t),
+        bn.g1_to_bytes(nym),
+        issuer_nonce,
+        ipk.hash(),
+    )
+    s = (rho + c * sk) % bn.R
+    return CredRequest(nym=nym, issuer_nonce=issuer_nonce, proof_c=c, proof_s=s)
+
+
+@dataclasses.dataclass
+class Credential:
+    a: tuple  # G1
+    b: tuple  # G1
+    e: int
+    s: int
+    attrs: list[int]  # attribute values as scalars
+
+    def to_bytes(self) -> bytes:
+        import json
+
+        return json.dumps(
+            {
+                "a": bn.g1_to_bytes(self.a).hex(),
+                "b": bn.g1_to_bytes(self.b).hex(),
+                "e": self.e,
+                "s": self.s,
+                "attrs": self.attrs,
+            },
+            sort_keys=True,
+        ).encode()
+
+    @classmethod
+    def from_bytes(cls, raw: bytes) -> "Credential":
+        import json
+
+        d = json.loads(raw)
+        return cls(
+            a=bn.g1_from_bytes(bytes.fromhex(d["a"])),
+            b=bn.g1_from_bytes(bytes.fromhex(d["b"])),
+            e=int(d["e"]),
+            s=int(d["s"]),
+            attrs=[int(x) for x in d["attrs"]],
+        )
+
+    def ver(self, sk: int, ipk: IssuerPublicKey) -> None:
+        """User-side credential validation (reference credential.go Ver):
+        recompute B from sk/attrs and check the pairing identity."""
+        if len(self.attrs) != len(ipk.attr_names):
+            raise ValueError("credential: attribute count mismatch")
+        if self.a is None:
+            raise ValueError("credential: A is identity")
+        b = bn.G1_GEN
+        b = bn.g1_add(b, bn.g1_mul(ipk.h_sk, sk))
+        b = bn.g1_add(b, bn.g1_mul(ipk.h_rand, self.s))
+        for base, m in zip(ipk.h_attrs, self.attrs):
+            b = bn.g1_add(b, bn.g1_mul(base, m))
+        if b != self.b:
+            raise ValueError("credential: B does not match attributes")
+        # e(A, g2^e * W) == e(B, g2)
+        lhs_g2 = bn.g2_add(bn.g2_mul(bn.G2_GEN, self.e), ipk.w)
+        ok = bn.multi_pairing(
+            [(self.a, lhs_g2), (bn.g1_neg(self.b), bn.G2_GEN)]
+        )
+        if ok != bn.FP12_ONE:
+            raise ValueError("credential: pairing check fails")
+
+
+def new_credential(
+    key: IssuerKey,
+    req: CredRequest,
+    attrs: list[int],
+    rng=None,
+) -> Credential:
+    """Issue a credential over the requested nym (reference
+    credential.go NewCredential)."""
+    ipk = key.ipk
+    req.check(ipk)
+    if len(attrs) != len(ipk.attr_names):
+        raise ValueError("attribute count mismatch")
+    e = bn.rand_zr(rng)
+    s = bn.rand_zr(rng)
+    b = bn.G1_GEN
+    b = bn.g1_add(b, req.nym)
+    b = bn.g1_add(b, bn.g1_mul(ipk.h_rand, s))
+    for base, m in zip(ipk.h_attrs, attrs):
+        b = bn.g1_add(b, bn.g1_mul(base, m))
+    exp = pow((e + key.isk) % bn.R, -1, bn.R)
+    a = bn.g1_mul(b, exp)
+    return Credential(a=a, b=b, e=e, s=s, attrs=list(attrs))
+
+
+def attribute_to_scalar(value: bytes | str | int) -> int:
+    """Encode an attribute value as a Zr scalar (reference encodes OU/role/
+    enrollment-id attributes via HashModOrder, msp/idemixmsp.go)."""
+    if isinstance(value, int):
+        return value % bn.R
+    if isinstance(value, str):
+        value = value.encode()
+    return bn.hash_to_zr(b"idemix-attr", value)

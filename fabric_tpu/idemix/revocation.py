@@ -1,0 +1,100 @@
+"""Credential revocation information (reference idemix/revocation.go).
+
+The reference supports pluggable revocation algorithms; this snapshot's
+default — and only implemented — algorithm is ALG_NO_REVOCATION
+(revocation.go RevocationAlgorithm): the CRI (credential revocation
+information) is an epoch counter plus an epoch key, signed by the
+revocation authority with ECDSA.  Verifiers check the CRI signature and
+epoch freshness; unrevoked-ness proofs are vacuous under NO_REVOCATION.
+The weak-BB primitives (weakbb.py) are in place for signature-based
+revocation algorithms.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+
+from cryptography.hazmat.primitives import hashes
+from cryptography.hazmat.primitives.asymmetric import ec
+from cryptography.exceptions import InvalidSignature
+
+from fabric_tpu.idemix import bn254 as bn
+
+ALG_NO_REVOCATION = 0
+
+
+def generate_long_term_revocation_key() -> ec.EllipticCurvePrivateKey:
+    """Reference uses ECDSA over P-384 for the revocation authority
+    (revocation.go GenerateLongTermRevocationKey)."""
+    return ec.generate_private_key(ec.SECP384R1())
+
+
+@dataclasses.dataclass
+class CredentialRevocationInformation:
+    epoch: int
+    revocation_alg: int
+    epoch_pk: bytes  # serialized G2 point (epoch key)
+    epoch_pk_sig: bytes  # RA signature over (epoch, alg, epoch_pk)
+
+    def to_bytes(self) -> bytes:
+        return json.dumps(
+            {
+                "epoch": self.epoch,
+                "alg": self.revocation_alg,
+                "epoch_pk": self.epoch_pk.hex(),
+                "sig": self.epoch_pk_sig.hex(),
+            }
+        ).encode()
+
+    @classmethod
+    def from_bytes(cls, raw: bytes) -> "CredentialRevocationInformation":
+        d = json.loads(raw)
+        return cls(
+            epoch=d["epoch"],
+            revocation_alg=d["alg"],
+            epoch_pk=bytes.fromhex(d["epoch_pk"]),
+            epoch_pk_sig=bytes.fromhex(d["sig"]),
+        )
+
+
+def _cri_digest_material(epoch: int, alg: int, epoch_pk: bytes) -> bytes:
+    return b"idemix-cri" + epoch.to_bytes(8, "big") + bytes([alg]) + epoch_pk
+
+
+def create_cri(
+    ra_key: ec.EllipticCurvePrivateKey,
+    epoch: int,
+    alg: int = ALG_NO_REVOCATION,
+    rng=None,
+) -> CredentialRevocationInformation:
+    """Reference revocation.go CreateCRI."""
+    if alg != ALG_NO_REVOCATION:
+        raise NotImplementedError("only ALG_NO_REVOCATION is supported")
+    epoch_sk = bn.rand_zr(rng)
+    epoch_pk = bn.g2_to_bytes(bn.g2_mul(bn.G2_GEN, epoch_sk))
+    sig = ra_key.sign(
+        _cri_digest_material(epoch, alg, epoch_pk), ec.ECDSA(hashes.SHA256())
+    )
+    return CredentialRevocationInformation(
+        epoch=epoch, revocation_alg=alg, epoch_pk=epoch_pk, epoch_pk_sig=sig
+    )
+
+
+def verify_epoch_pk(
+    ra_pub: ec.EllipticCurvePublicKey,
+    cri: CredentialRevocationInformation,
+) -> bool:
+    """Reference revocation.go VerifyEpochPK."""
+    try:
+        ra_pub.verify(
+            cri.epoch_pk_sig,
+            _cri_digest_material(
+                cri.epoch, cri.revocation_alg, cri.epoch_pk
+            ),
+            ec.ECDSA(hashes.SHA256()),
+        )
+        bn.g2_from_bytes(cri.epoch_pk)
+        return True
+    except (InvalidSignature, ValueError):
+        return False

@@ -1,0 +1,76 @@
+"""Generalized multi-base Schnorr proofs over G1.
+
+Every zero-knowledge proof in idemix (issuer well-formedness,
+credential-request PoK, presentation proof, nym signature — reference
+idemix/{issuerkey,credrequest,signature,nymsignature}.go) is an AND
+composition of discrete-log representations Y = prod_j G_j^{x_j}.  Rather
+than hand-rolling each commitment/response pair as the reference does, the
+relations are expressed declaratively and this module runs the sigma
+protocol: commitments T = prod G^rho, challenge c = H(...), responses
+z_j = rho_j + c x_j, and the verifier identity prod G^z == T * Y^c.
+
+Secrets shared between relations (e.g. the user secret key appearing in
+both the credential relation and the pseudonym relation) reuse one rho and
+one response, which is exactly what binds them.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Sequence
+
+from fabric_tpu.idemix import bn254 as bn
+
+
+@dataclasses.dataclass(frozen=True)
+class Relation:
+    """Y = prod_j bases[j] ^ secrets[names[j]] over G1."""
+
+    target: tuple  # Y, a G1 point
+    bases: Sequence[tuple]  # G_j
+    names: Sequence[str]  # secret name per base (shared names share rho/z)
+
+
+def _commitment(rel: Relation, rho: dict[str, int]):
+    t = None
+    for base, name in zip(rel.bases, rel.names):
+        t = bn.g1_add(t, bn.g1_mul(base, rho[name]))
+    return t
+
+
+def prove(
+    relations: Sequence[Relation],
+    secrets: dict[str, int],
+    challenge_fn: Callable[[Sequence[tuple]], int],
+    rng=None,
+) -> tuple[int, dict[str, int]]:
+    """Run the prover; returns (challenge, responses-by-name).
+
+    challenge_fn receives the list of commitment points T_i (same order as
+    relations) and must hash them together with the statement and message.
+    """
+    rho = {name: bn.rand_zr(rng) for name in secrets}
+    commitments = [_commitment(rel, rho) for rel in relations]
+    c = challenge_fn(commitments)
+    responses = {
+        name: (rho[name] + c * x) % bn.R for name, x in secrets.items()
+    }
+    return c, responses
+
+
+def recompute_commitments(
+    relations: Sequence[Relation],
+    challenge: int,
+    responses: dict[str, int],
+) -> list[tuple]:
+    """Verifier side: T_i = prod G^z * Y^{-c}; feed into the same
+    challenge_fn and compare challenges."""
+    out = []
+    for rel in relations:
+        t = bn.g1_mul(rel.target, (-challenge) % bn.R)
+        for base, name in zip(rel.bases, rel.names):
+            if name not in responses:
+                raise ValueError(f"missing response for secret {name!r}")
+            t = bn.g1_add(t, bn.g1_mul(base, responses[name]))
+        out.append(t)
+    return out

@@ -1,0 +1,322 @@
+"""Idemix presentation signatures (reference idemix/signature.go).
+
+A signature proves, in zero knowledge: "I hold a credential (A, B, e, s)
+from this issuer over attributes (m_1..m_L) and secret key sk; I disclose
+the attributes in D and hide the rest; Nym is a pseudonym bound to the same
+sk" — and signs a message via Fiat-Shamir.
+
+Construction (re-derived from the CDL scheme the reference implements; see
+signature.go NewSignature for the reference's randomization with
+r1/r2/r3 and the same APrime/ABar/BPrime triple):
+
+    r1 <- Zr*, r3 = 1/r1, r2 <- Zr
+    APrime = A^r1
+    ABar   = B^r1 * APrime^{-e}        # equals APrime^x
+    BPrime = B^r1 * HRand^{-r2}
+    s'     = s - r2 * r3
+
+which gives the verifier-checkable identities
+
+    e(APrime, W) == e(ABar, g2)                       (pairing check)
+    ABar * BPrime^{-1} == APrime^{-e} * HRand^{r2}    (relation 1)
+    g1^{-1} * prod_{i in D} HAttrs_i^{-m_i}
+        == HSk^{sk} * HRand^{s'} * prod_{i in H} HAttrs_i^{m_i}
+           * BPrime^{-r3}                             (relation 2)
+    Nym == HSk^{sk} * HRand^{r_nym}                   (relation 3)
+
+Relations 1-3 are proven with the generalized Schnorr engine
+(fabric_tpu/idemix/schnorr.py); sk is shared between relations 2 and 3,
+binding the pseudonym to the credential.
+
+Batched verification (`verify_batch`): all N pairing checks against one
+issuer key collapse — with random weights t_i — into TWO pairings:
+
+    e(sum_i t_i * APrime_i, W) * e(-sum_i t_i * ABar_i, g2) == 1
+
+This is the BN256 batch-verify baseline configuration (BASELINE.md): the
+reference spends two FP256BN.Ate calls per signature
+(signature.go:290-291); the batch spends two per *block*.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from fabric_tpu.idemix import bn254 as bn
+from fabric_tpu.idemix import schnorr
+from fabric_tpu.idemix.credential import Credential
+from fabric_tpu.idemix.issuer import IssuerPublicKey
+
+
+@dataclasses.dataclass
+class Signature:
+    a_prime: tuple
+    a_bar: tuple
+    b_prime: tuple
+    nym: tuple
+    challenge: int
+    responses: dict[str, int]
+    disclosure: list[bool]
+    disclosed_attrs: dict[int, int]  # index -> scalar value
+    nonce: bytes
+
+    def to_bytes(self) -> bytes:
+        import json
+
+        return json.dumps(
+            {
+                "a_prime": bn.g1_to_bytes(self.a_prime).hex(),
+                "a_bar": bn.g1_to_bytes(self.a_bar).hex(),
+                "b_prime": bn.g1_to_bytes(self.b_prime).hex(),
+                "nym": bn.g1_to_bytes(self.nym).hex(),
+                "challenge": self.challenge,
+                "responses": self.responses,
+                "disclosure": self.disclosure,
+                "disclosed_attrs": {
+                    str(k): v for k, v in self.disclosed_attrs.items()
+                },
+                "nonce": self.nonce.hex(),
+            },
+            sort_keys=True,
+        ).encode()
+
+    @classmethod
+    def from_bytes(cls, raw: bytes) -> "Signature":
+        import json
+
+        d = json.loads(raw)
+        return cls(
+            a_prime=bn.g1_from_bytes(bytes.fromhex(d["a_prime"])),
+            a_bar=bn.g1_from_bytes(bytes.fromhex(d["a_bar"])),
+            b_prime=bn.g1_from_bytes(bytes.fromhex(d["b_prime"])),
+            nym=bn.g1_from_bytes(bytes.fromhex(d["nym"])),
+            challenge=int(d["challenge"]),
+            responses={k: int(v) for k, v in d["responses"].items()},
+            disclosure=[bool(b) for b in d["disclosure"]],
+            disclosed_attrs={
+                int(k): int(v) for k, v in d["disclosed_attrs"].items()
+            },
+            nonce=bytes.fromhex(d["nonce"]),
+        )
+
+
+def _relations(
+    ipk: IssuerPublicKey,
+    a_prime,
+    a_bar,
+    b_prime,
+    nym,
+    disclosure: list[bool],
+    disclosed_attrs: dict[int, int],
+) -> list[schnorr.Relation]:
+    hidden = [i for i, d in enumerate(disclosure) if not d]
+    y1 = bn.g1_add(a_bar, bn.g1_neg(b_prime))
+    rel1 = schnorr.Relation(
+        target=y1, bases=[a_prime, ipk.h_rand], names=["neg_e", "r2"]
+    )
+    y2 = bn.g1_neg(bn.G1_GEN)
+    for i, d in enumerate(disclosure):
+        if d:
+            y2 = bn.g1_add(
+                y2,
+                bn.g1_mul(ipk.h_attrs[i], (-disclosed_attrs[i]) % bn.R),
+            )
+    rel2 = schnorr.Relation(
+        target=y2,
+        bases=[ipk.h_sk, ipk.h_rand, *[ipk.h_attrs[i] for i in hidden],
+               b_prime],
+        names=["sk", "sprime", *[f"m_{i}" for i in hidden], "neg_r3"],
+    )
+    rel3 = schnorr.Relation(
+        target=nym, bases=[ipk.h_sk, ipk.h_rand], names=["sk", "r_nym"]
+    )
+    return [rel1, rel2, rel3]
+
+
+def _challenge_bytes(
+    ipk: IssuerPublicKey,
+    commitments,
+    a_prime,
+    a_bar,
+    b_prime,
+    nym,
+    disclosure,
+    disclosed_attrs,
+    msg: bytes,
+    nonce: bytes,
+) -> int:
+    chunks = [b"idemix-signature"]
+    chunks += [bn.g1_to_bytes(t) for t in commitments]
+    chunks += [
+        bn.g1_to_bytes(a_prime),
+        bn.g1_to_bytes(a_bar),
+        bn.g1_to_bytes(b_prime),
+        bn.g1_to_bytes(nym),
+        ipk.hash(),
+        bytes(disclosure),
+        b"".join(
+            i.to_bytes(4, "big") + v.to_bytes(32, "big")
+            for i, v in sorted(disclosed_attrs.items())
+        ),
+        msg,
+        nonce,
+    ]
+    return bn.hash_to_zr(*chunks)
+
+
+def make_nym(sk: int, ipk: IssuerPublicKey, rng=None) -> tuple[tuple, int]:
+    """(Nym, r_nym) — a fresh pseudonym commitment to sk (reference
+    idemix/util.go MakeNym)."""
+    r_nym = bn.rand_zr(rng)
+    nym = bn.g1_add(bn.g1_mul(ipk.h_sk, sk), bn.g1_mul(ipk.h_rand, r_nym))
+    return nym, r_nym
+
+
+def new_signature(
+    cred: Credential,
+    sk: int,
+    ipk: IssuerPublicKey,
+    msg: bytes,
+    disclosure: list[bool] | None = None,
+    nonce: bytes = b"",
+    nym: tuple | None = None,
+    r_nym: int | None = None,
+    rng=None,
+) -> Signature:
+    n_attrs = len(ipk.attr_names)
+    if disclosure is None:
+        disclosure = [False] * n_attrs
+    if len(disclosure) != n_attrs or len(cred.attrs) != n_attrs:
+        raise ValueError("disclosure/attribute length mismatch")
+    if (nym is None) != (r_nym is None):
+        raise ValueError("nym and r_nym must be supplied together")
+
+    r1 = bn.rand_zr(rng)
+    r2 = bn.rand_zr(rng)
+    r3 = pow(r1, -1, bn.R)
+    if nym is None:
+        nym, r_nym = make_nym(sk, ipk, rng)
+
+    a_prime = bn.g1_mul(cred.a, r1)
+    b_r1 = bn.g1_mul(cred.b, r1)
+    a_bar = bn.g1_add(b_r1, bn.g1_mul(a_prime, (-cred.e) % bn.R))
+    b_prime = bn.g1_add(b_r1, bn.g1_mul(ipk.h_rand, (-r2) % bn.R))
+    sprime = (cred.s - r2 * r3) % bn.R
+
+    disclosed_attrs = {
+        i: cred.attrs[i] for i, d in enumerate(disclosure) if d
+    }
+    hidden = [i for i, d in enumerate(disclosure) if not d]
+    secrets = {
+        "neg_e": (-cred.e) % bn.R,
+        "r2": r2,
+        "sk": sk,
+        "sprime": sprime,
+        "neg_r3": (-r3) % bn.R,
+        "r_nym": r_nym,
+    }
+    for i in hidden:
+        secrets[f"m_{i}"] = cred.attrs[i]
+
+    rels = _relations(
+        ipk, a_prime, a_bar, b_prime, nym, disclosure, disclosed_attrs
+    )
+    c, responses = schnorr.prove(
+        rels,
+        secrets,
+        lambda ts: _challenge_bytes(
+            ipk, ts, a_prime, a_bar, b_prime, nym, disclosure,
+            disclosed_attrs, msg, nonce,
+        ),
+        rng=rng,
+    )
+    return Signature(
+        a_prime=a_prime,
+        a_bar=a_bar,
+        b_prime=b_prime,
+        nym=nym,
+        challenge=c,
+        responses=responses,
+        disclosure=list(disclosure),
+        disclosed_attrs=disclosed_attrs,
+        nonce=nonce,
+    )
+
+
+def _check_schnorr(sig: Signature, ipk: IssuerPublicKey, msg: bytes) -> bool:
+    """The host-side (non-pairing) part of verification.  Every field of
+    `sig` is attacker-controlled: any malformed content (missing
+    responses, out-of-range disclosed attrs, wrong shapes) must yield
+    False, never an exception."""
+    try:
+        if sig.a_prime is None:
+            return False
+        for pt in (sig.a_prime, sig.a_bar, sig.b_prime, sig.nym):
+            if pt is None or not bn.g1_is_on_curve(pt):
+                return False
+        rels = _relations(
+            ipk, sig.a_prime, sig.a_bar, sig.b_prime, sig.nym,
+            sig.disclosure, sig.disclosed_attrs,
+        )
+        commitments = schnorr.recompute_commitments(
+            rels, sig.challenge, sig.responses
+        )
+        c = _challenge_bytes(
+            ipk, commitments, sig.a_prime, sig.a_bar, sig.b_prime, sig.nym,
+            sig.disclosure, sig.disclosed_attrs, msg, sig.nonce,
+        )
+        return c == sig.challenge
+    except (ValueError, IndexError, KeyError, TypeError, OverflowError,
+            AttributeError):
+        return False
+
+
+def verify(sig: Signature, ipk: IssuerPublicKey, msg: bytes) -> bool:
+    """Single-signature verification (reference signature.go Ver: Schnorr
+    recomputation then two Ate pairings at :290-291)."""
+    if not _check_schnorr(sig, ipk, msg):
+        return False
+    check = bn.multi_pairing(
+        [(sig.a_prime, ipk.w), (bn.g1_neg(sig.a_bar), bn.G2_GEN)]
+    )
+    return check == bn.FP12_ONE
+
+
+def verify_batch(
+    sigs: list[Signature],
+    ipk: IssuerPublicKey,
+    msgs: list[bytes],
+    rng=None,
+) -> list[bool]:
+    """Batched verification against one issuer key.
+
+    Per-item Schnorr checks run first (cheap, host); surviving items enter
+    the combined two-pairing check with random weights.  If the combined
+    check fails, fall back to per-item pairing checks so the result is a
+    per-signature mask — matching the CSP batch-verify contract
+    (fabric_tpu/csp/api.py: policy evaluation tolerates invalid items).
+    """
+    ok = [
+        _check_schnorr(s, ipk, m) for s, m in zip(sigs, msgs)
+    ]
+    live = [i for i, v in enumerate(ok) if v]
+    if not live:
+        return ok
+    acc_ap = None
+    acc_ab = None
+    for i in live:
+        t = bn.rand_zr(rng)
+        acc_ap = bn.g1_add(acc_ap, bn.g1_mul(sigs[i].a_prime, t))
+        acc_ab = bn.g1_add(acc_ab, bn.g1_mul(sigs[i].a_bar, t))
+    combined = bn.multi_pairing(
+        [(acc_ap, ipk.w), (bn.g1_neg(acc_ab), bn.G2_GEN)]
+    )
+    if combined == bn.FP12_ONE:
+        return ok
+    # Rare path: at least one forged pairing — isolate per item.
+    for i in live:
+        check = bn.multi_pairing(
+            [(sigs[i].a_prime, ipk.w), (bn.g1_neg(sigs[i].a_bar), bn.G2_GEN)]
+        )
+        ok[i] = check == bn.FP12_ONE
+    return ok

@@ -1,0 +1,30 @@
+"""Weak Boneh-Boyen signatures (reference idemix/weakbb.go).
+
+Used by the idemix revocation machinery: sig = g1^{1/(x+m)}, verified by
+e(sig, W * g2^m) == e(g1, g2).  "Weak" because the message must be chosen
+independently of the key (exactly the revocation-handle use case).
+"""
+
+from __future__ import annotations
+
+from fabric_tpu.idemix import bn254 as bn
+
+
+def wbb_key_gen(rng=None) -> tuple[int, tuple]:
+    sk = bn.rand_zr(rng)
+    return sk, bn.g2_mul(bn.G2_GEN, sk)
+
+
+def wbb_sign(sk: int, m: int) -> tuple:
+    exp = pow((sk + m) % bn.R, -1, bn.R)
+    return bn.g1_mul(bn.G1_GEN, exp)
+
+
+def wbb_verify(pk: tuple, sig: tuple, m: int) -> bool:
+    if sig is None or not bn.g1_is_on_curve(sig):
+        return False
+    lhs_g2 = bn.g2_add(pk, bn.g2_mul(bn.G2_GEN, m))
+    check = bn.multi_pairing(
+        [(sig, lhs_g2), (bn.g1_neg(bn.G1_GEN), bn.G2_GEN)]
+    )
+    return check == bn.FP12_ONE

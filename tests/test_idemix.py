@@ -1,0 +1,194 @@
+"""Idemix tests: pairing math, credential lifecycle, signatures.
+
+Mirrors the reference's idemix test coverage (idemix/idemix_test.go):
+issuer key check, cred request check, credential ver, signature
+sign/verify with selective disclosure, nym signatures, weak-BB, CRI.
+"""
+
+import random
+
+import pytest
+
+from fabric_tpu.idemix import bn254 as bn
+from fabric_tpu.idemix import nymsignature, revocation, signature, weakbb
+from fabric_tpu.idemix.credential import (
+    attribute_to_scalar,
+    new_cred_request,
+    new_credential,
+)
+from fabric_tpu.idemix.issuer import IssuerKey
+
+RNG = random.Random(42)
+
+ATTRS = ["OU", "Role", "EnrollmentID", "RevocationHandle"]
+
+
+@pytest.fixture(scope="module")
+def issuer():
+    return IssuerKey.generate(ATTRS, rng=RNG)
+
+
+@pytest.fixture(scope="module")
+def user(issuer):
+    sk = bn.rand_zr(RNG)
+    req = new_cred_request(sk, b"nonce-1", issuer.ipk, rng=RNG)
+    attrs = [
+        attribute_to_scalar("org1"),
+        attribute_to_scalar(2),
+        attribute_to_scalar("alice"),
+        attribute_to_scalar(100),
+    ]
+    cred = new_credential(issuer, req, attrs, rng=RNG)
+    cred.ver(sk, issuer.ipk)
+    return sk, cred
+
+
+class TestPairing:
+    def test_bilinearity(self):
+        a, b = 1234567, 987654321
+        e = bn.pairing(bn.G1_GEN, bn.G2_GEN)
+        assert e != bn.FP12_ONE
+        lhs = bn.pairing(bn.g1_mul(bn.G1_GEN, a), bn.g2_mul(bn.G2_GEN, b))
+        assert lhs == bn.fp12_pow(e, a * b % bn.R)
+
+    def test_gt_order(self):
+        e = bn.pairing(bn.G1_GEN, bn.G2_GEN)
+        assert bn.fp12_pow(e, bn.R) == bn.FP12_ONE
+
+    def test_multi_pairing_cancellation(self):
+        mp = bn.multi_pairing(
+            [(bn.G1_GEN, bn.G2_GEN), (bn.g1_neg(bn.G1_GEN), bn.G2_GEN)]
+        )
+        assert mp == bn.FP12_ONE
+
+    def test_serialization_roundtrip(self):
+        p = bn.g1_mul(bn.G1_GEN, 77)
+        q = bn.g2_mul(bn.G2_GEN, 99)
+        assert bn.g1_from_bytes(bn.g1_to_bytes(p)) == p
+        assert bn.g2_from_bytes(bn.g2_to_bytes(q)) == q
+        with pytest.raises(ValueError):
+            bn.g1_from_bytes(b"\x01" * 64)  # not on curve
+
+
+class TestIssuerAndCredential:
+    def test_issuer_key_check(self, issuer):
+        issuer.ipk.check()
+
+    def test_issuer_key_tamper(self, issuer):
+        import copy
+
+        bad = copy.deepcopy(issuer.ipk)
+        bad.w = bn.g2_mul(bn.G2_GEN, 123)
+        with pytest.raises(ValueError):
+            bad.check()
+
+    def test_cred_request_bad_proof(self, issuer):
+        sk = bn.rand_zr(RNG)
+        req = new_cred_request(sk, b"n", issuer.ipk, rng=RNG)
+        req.proof_s = (req.proof_s + 1) % bn.R
+        with pytest.raises(ValueError):
+            req.check(issuer.ipk)
+
+    def test_credential_wrong_sk(self, issuer, user):
+        _, cred = user
+        with pytest.raises(ValueError):
+            cred.ver(bn.rand_zr(RNG), issuer.ipk)
+
+    def test_credential_attr_mismatch(self, issuer, user):
+        sk, cred = user
+        import copy
+
+        bad = copy.deepcopy(cred)
+        bad.attrs[0] = attribute_to_scalar("org2")
+        with pytest.raises(ValueError):
+            bad.ver(sk, issuer.ipk)
+
+
+class TestSignature:
+    def test_sign_verify_no_disclosure(self, issuer, user):
+        sk, cred = user
+        sig = signature.new_signature(
+            cred, sk, issuer.ipk, b"msg", rng=RNG
+        )
+        assert signature.verify(sig, issuer.ipk, b"msg")
+        assert not signature.verify(sig, issuer.ipk, b"other msg")
+
+    def test_sign_verify_selective_disclosure(self, issuer, user):
+        sk, cred = user
+        disclosure = [True, True, False, False]
+        sig = signature.new_signature(
+            cred, sk, issuer.ipk, b"msg", disclosure=disclosure, rng=RNG
+        )
+        assert sig.disclosed_attrs == {
+            0: cred.attrs[0], 1: cred.attrs[1]
+        }
+        assert signature.verify(sig, issuer.ipk, b"msg")
+        # Lying about a disclosed attribute fails.
+        sig.disclosed_attrs[0] = attribute_to_scalar("org2")
+        assert not signature.verify(sig, issuer.ipk, b"msg")
+
+    def test_tampered_pairing_component(self, issuer, user):
+        sk, cred = user
+        sig = signature.new_signature(cred, sk, issuer.ipk, b"m", rng=RNG)
+        # Replacing ABar with a consistent-looking but wrong point must
+        # fail the pairing check even if we can't fake the Schnorr part.
+        sig.a_bar = bn.g1_mul(bn.G1_GEN, 5)
+        assert not signature.verify(sig, issuer.ipk, b"m")
+
+    def test_batch_verify(self, issuer, user):
+        sk, cred = user
+        msgs = [b"m%d" % i for i in range(4)]
+        sigs = [
+            signature.new_signature(cred, sk, issuer.ipk, m, rng=RNG)
+            for m in msgs
+        ]
+        assert signature.verify_batch(sigs, issuer.ipk, msgs, rng=RNG) == [
+            True
+        ] * 4
+        # Corrupt one: batch falls back and isolates it.
+        sigs[2].a_bar = bn.g1_mul(bn.G1_GEN, 9)
+        assert signature.verify_batch(sigs, issuer.ipk, msgs, rng=RNG) == [
+            True, True, False, True,
+        ]
+        # Corrupt another at the Schnorr level.
+        sigs[0].challenge = (sigs[0].challenge + 1) % bn.R
+        assert signature.verify_batch(sigs, issuer.ipk, msgs, rng=RNG) == [
+            False, True, False, True,
+        ]
+
+
+class TestNymSignature:
+    def test_roundtrip(self, issuer):
+        sk = bn.rand_zr(RNG)
+        r_nym = bn.rand_zr(RNG)
+        nym = bn.g1_add(
+            bn.g1_mul(issuer.ipk.h_sk, sk),
+            bn.g1_mul(issuer.ipk.h_rand, r_nym),
+        )
+        sig = nymsignature.new_nym_signature(
+            sk, nym, r_nym, issuer.ipk, b"hello", rng=RNG
+        )
+        assert nymsignature.verify_nym(sig, nym, issuer.ipk, b"hello")
+        assert not nymsignature.verify_nym(sig, nym, issuer.ipk, b"bye")
+        sig.z_sk = (sig.z_sk + 1) % bn.R
+        assert not nymsignature.verify_nym(sig, nym, issuer.ipk, b"hello")
+
+
+class TestWeakBB:
+    def test_roundtrip(self):
+        sk, pk = weakbb.wbb_key_gen(rng=RNG)
+        m = bn.rand_zr(RNG)
+        sig = weakbb.wbb_sign(sk, m)
+        assert weakbb.wbb_verify(pk, sig, m)
+        assert not weakbb.wbb_verify(pk, sig, (m + 1) % bn.R)
+
+
+class TestRevocation:
+    def test_cri(self):
+        ra = revocation.generate_long_term_revocation_key()
+        cri = revocation.create_cri(ra, epoch=7, rng=RNG)
+        raw = cri.to_bytes()
+        back = revocation.CredentialRevocationInformation.from_bytes(raw)
+        assert revocation.verify_epoch_pk(ra.public_key(), back)
+        back.epoch = 8
+        assert not revocation.verify_epoch_pk(ra.public_key(), back)
