@@ -159,7 +159,9 @@ class ShimHandler:
         self.name = name
         self._send_raw = send
         self._recv = recv
-        self._responses: dict[str, queue.Queue] = {}
+        # Response routing keyed by (channel_id, txid): the peer allows the
+        # same txid live on different channels concurrently.
+        self._responses: dict[tuple[str, str], queue.Queue] = {}
         self._lock = threading.Lock()
 
     def _send(self, msg: M) -> None:
@@ -167,13 +169,19 @@ class ShimHandler:
 
     def call_peer(self, msg: M) -> M:
         q: queue.Queue = queue.Queue(maxsize=1)
+        key = (msg.channel_id, msg.txid)
         with self._lock:
-            self._responses[msg.txid] = q
-        self._send(msg)
-        resp = q.get(timeout=30)
-        with self._lock:
-            self._responses.pop(msg.txid, None)
-        return resp
+            if key in self._responses:
+                raise ChaincodeError(
+                    f"concurrent peer call for tx {key} on one stub"
+                )
+            self._responses[key] = q
+        try:
+            self._send(msg)
+            return q.get(timeout=30)
+        finally:
+            with self._lock:
+                self._responses.pop(key, None)
 
     def run(self) -> None:
         reg = chaincode_pb2.ChaincodeID(name=self.name)
@@ -187,7 +195,7 @@ class ShimHandler:
                 continue
             if msg.type in (M.RESPONSE, M.ERROR):
                 with self._lock:
-                    q = self._responses.get(msg.txid)
+                    q = self._responses.get((msg.channel_id, msg.txid))
                 if q is not None:
                     q.put(msg)
                 continue

@@ -77,6 +77,7 @@ class ChaincodeSupport:
         `recv() -> bytes | None`.  Replies to ledger callbacks go back on
         this same stream (handler.go serialSendAsync)."""
         name: str | None = None
+        handle: _CCHandle | None = None
         try:
             while True:
                 raw = recv()
@@ -85,27 +86,61 @@ class ChaincodeSupport:
                 msg = M.FromString(raw)
                 if msg.type == M.REGISTER:
                     cid = chaincode_pb2.ChaincodeID.FromString(msg.payload)
-                    name = cid.name
                     with self._lock:
-                        self._ccs[name] = _CCHandle(
-                            name, lambda m: send(m.SerializeToString())
+                        if cid.name in self._ccs:
+                            # Duplicate registration is rejected, matching
+                            # the reference (handler.go handleRegister).
+                            dup = True
+                        else:
+                            dup = False
+                            name = cid.name
+                            handle = _CCHandle(
+                                name, lambda m: send(m.SerializeToString())
+                            )
+                            self._ccs[name] = handle
+                    if dup:
+                        send(
+                            M(
+                                type=M.ERROR,
+                                payload=b"duplicate registered name "
+                                + cid.name.encode(),
+                            ).SerializeToString()
                         )
+                        return
                     send(M(type=M.REGISTERED).SerializeToString())
                     send(M(type=M.READY).SerializeToString())
                     continue
-                ctx = self._ctx(msg)
-                if ctx is None:
-                    continue  # unknown tx: drop (reference logs + ERROR)
-                try:
-                    out = self._dispatch(msg, ctx)
-                except Exception as exc:
-                    out = self._error(msg, str(exc))
-                if out is not None:
-                    send(out.SerializeToString())
+                if msg.type in (M.COMPLETED, M.ERROR):
+                    # Tx completion: deliver inline (non-blocking).
+                    ctx = self._ctx(msg)
+                    if ctx is not None:
+                        self._dispatch(msg, ctx)
+                    continue
+                # Ledger callbacks run off the read loop so a blocking
+                # cc2cc (INVOKE_CHAINCODE -> execute) can't deadlock the
+                # stream that must also deliver its COMPLETED (the
+                # reference runs handleMessage in per-tx goroutines,
+                # handler.go:355).
+                threading.Thread(
+                    target=self._dispatch_async, args=(msg, send), daemon=True
+                ).start()
         finally:
             if name is not None:
                 with self._lock:
-                    self._ccs.pop(name, None)
+                    # Only deregister if this stream's handle is current.
+                    if self._ccs.get(name) is handle:
+                        self._ccs.pop(name, None)
+
+    def _dispatch_async(self, msg: M, send) -> None:
+        ctx = self._ctx(msg)
+        if ctx is None:
+            return  # unknown tx: drop (reference logs + ERROR)
+        try:
+            out = self._dispatch(msg, ctx)
+        except Exception as exc:
+            out = self._error(msg, str(exc))
+        if out is not None:
+            send(out.SerializeToString())
 
     def registered(self, name: str) -> bool:
         with self._lock:
@@ -211,7 +246,14 @@ class ChaincodeSupport:
             return self._reply(msg, val or b"")
         if msg.type == M.GET_STATE_BY_RANGE:
             g = shim_pb.GetStateByRange.FromString(msg.payload)
-            it = iter(sim.get_state_range(ns, g.start_key, g.end_key))
+            if g.collection:
+                it = iter(
+                    sim.get_private_data_range(
+                        ns, g.collection, g.start_key, g.end_key
+                    )
+                )
+            else:
+                it = iter(sim.get_state_range(ns, g.start_key, g.end_key))
             iid = ctx.new_iterator_id()
             ctx.iterators[iid] = it
             return self._reply(msg, self._page(ctx, iid).SerializeToString())

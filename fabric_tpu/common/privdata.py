@@ -1,0 +1,203 @@
+"""Collection access policies (reference core/common/privdata/).
+
+Parses `CollectionConfigPackage` (simplecollection.go SimpleCollection)
+and answers the two questions the private-data flows ask:
+
+- `is_member(serialized_identity)` — does this identity's org belong to
+  the collection (member_orgs_policy satisfied)?  Gates read access
+  (member_only_read) and distribution eligibility.
+- accessors for required/maximum peer counts and BTL, consumed by the
+  distributor and the pvtdata store's expiry policy.
+
+The reference evaluates membership by running the signature policy over a
+self-signed SignedData probe (simplecollection.go Setup/AccessFilter); we
+evaluate the policy's principal tree against the deserialized identity
+directly — same outcome, no fake signature round-trip.
+"""
+
+from __future__ import annotations
+
+from fabric_tpu.protos.peer import collection_pb2
+from fabric_tpu.protos.common import policies_pb2
+
+
+class NoSuchCollectionError(Exception):
+    pass
+
+
+class SimpleCollection:
+    def __init__(
+        self, conf: collection_pb2.StaticCollectionConfig, deserializer
+    ):
+        self._conf = conf
+        self._deserializer = deserializer
+        pol = conf.member_orgs_policy
+        if pol.WhichOneof("payload") != "signature_policy":
+            raise ValueError(
+                f"collection {conf.name!r}: missing member_orgs_policy"
+            )
+        self._envelope = pol.signature_policy
+
+    @property
+    def name(self) -> str:
+        return self._conf.name
+
+    @property
+    def required_peer_count(self) -> int:
+        return self._conf.required_peer_count
+
+    @property
+    def maximum_peer_count(self) -> int:
+        return self._conf.maximum_peer_count
+
+    @property
+    def block_to_live(self) -> int:
+        return self._conf.block_to_live
+
+    @property
+    def member_only_read(self) -> bool:
+        return self._conf.member_only_read
+
+    @property
+    def member_only_write(self) -> bool:
+        return self._conf.member_only_write
+
+    def member_orgs(self) -> list[str]:
+        """MSP IDs named by the member policy's principals."""
+        from fabric_tpu.protos.msp import msp_principal_pb2
+
+        out = []
+        for p in self._envelope.identities:
+            if (
+                p.principal_classification
+                == msp_principal_pb2.MSPPrincipal.ROLE
+            ):
+                role = msp_principal_pb2.MSPRole.FromString(p.principal)
+                out.append(role.msp_identifier)
+        return out
+
+    def is_member(self, serialized_identity: bytes) -> bool:
+        """Whether the identity satisfies any principal of the member-orgs
+        policy (reference AccessFilter)."""
+        try:
+            ident = self._deserializer.deserialize_identity(
+                serialized_identity
+            )
+        except Exception:
+            return False
+        for principal in self._envelope.identities:
+            try:
+                self._deserializer.satisfies_principal(ident, principal)
+                return True
+            except Exception:
+                continue
+        return False
+
+
+class CollectionStore:
+    """Per-channel collection registry fed from committed chaincode
+    definitions (reference core/common/privdata/store.go retrieving from
+    the lifecycle metadata)."""
+
+    def __init__(self, deserializer):
+        self._deserializer = deserializer
+        self._packages: dict[str, collection_pb2.CollectionConfigPackage] = {}
+
+    def set_collections(self, chaincode: str, package_bytes: bytes) -> None:
+        """Install/refresh a chaincode's CollectionConfigPackage (called on
+        lifecycle commit)."""
+        if not package_bytes:
+            self._packages.pop(chaincode, None)
+            return
+        self._packages[chaincode] = (
+            collection_pb2.CollectionConfigPackage.FromString(package_bytes)
+        )
+
+    def collection(self, chaincode: str, name: str) -> SimpleCollection:
+        pkg = self._packages.get(chaincode)
+        if pkg is not None:
+            for conf in pkg.config:
+                if (
+                    conf.WhichOneof("payload") == "static_collection_config"
+                    and conf.static_collection_config.name == name
+                ):
+                    return SimpleCollection(
+                        conf.static_collection_config, self._deserializer
+                    )
+        raise NoSuchCollectionError(f"{chaincode}/{name}")
+
+    def collections_of(self, chaincode: str) -> list[SimpleCollection]:
+        pkg = self._packages.get(chaincode)
+        if pkg is None:
+            return []
+        return [
+            SimpleCollection(
+                c.static_collection_config, self._deserializer
+            )
+            for c in pkg.config
+            if c.WhichOneof("payload") == "static_collection_config"
+        ]
+
+    def btl_policy(self):
+        """(ns, coll) -> blocks-to-live callback for PvtDataStore."""
+
+        def btl(ns: str, coll: str) -> int:
+            try:
+                return self.collection(ns, coll).block_to_live
+            except NoSuchCollectionError:
+                return 0
+
+        return btl
+
+    def is_eligible(
+        self, chaincode: str, coll: str, serialized_identity: bytes
+    ) -> bool:
+        try:
+            return self.collection(chaincode, coll).is_member(
+                serialized_identity
+            )
+        except NoSuchCollectionError:
+            return False
+
+
+def static_collection(
+    name: str,
+    member_mspids: list[str],
+    required_peer_count: int = 0,
+    maximum_peer_count: int = 1,
+    block_to_live: int = 0,
+    member_only_read: bool = True,
+    member_only_write: bool = True,
+) -> collection_pb2.CollectionConfig:
+    """Convenience builder (tests + configtxgen-style tooling)."""
+    from fabric_tpu.policies.signature_policy import signed_by_any_member
+
+    conf = collection_pb2.CollectionConfig()
+    sc = conf.static_collection_config
+    sc.name = name
+    sc.member_orgs_policy.signature_policy.CopyFrom(
+        signed_by_any_member(member_mspids)
+    )
+    sc.required_peer_count = required_peer_count
+    sc.maximum_peer_count = maximum_peer_count
+    sc.block_to_live = block_to_live
+    sc.member_only_read = member_only_read
+    sc.member_only_write = member_only_write
+    return conf
+
+
+def collection_package(
+    *configs: collection_pb2.CollectionConfig,
+) -> collection_pb2.CollectionConfigPackage:
+    pkg = collection_pb2.CollectionConfigPackage()
+    pkg.config.extend(configs)
+    return pkg
+
+
+__all__ = [
+    "CollectionStore",
+    "SimpleCollection",
+    "NoSuchCollectionError",
+    "static_collection",
+    "collection_package",
+]

@@ -14,7 +14,14 @@ from fabric_tpu.ledger.blkstorage import BlockStore
 from fabric_tpu.ledger.history import HistoryDB
 from fabric_tpu.ledger.kvstore import KVStore, MemKVStore, open_kvstore
 from fabric_tpu.ledger.statedb import Height, VersionedDB
-from fabric_tpu.ledger.txmgmt import MVCCValidator, TxSimulator, VALID
+from fabric_tpu.ledger.txmgmt import (
+    MVCCValidator,
+    TxSimulator,
+    VALID,
+    hash_ns,
+    key_hash,
+    pvt_ns,
+)
 from fabric_tpu.protos.common import common_pb2
 from fabric_tpu.protos.ledger.rwset import rwset_pb2
 from fabric_tpu.protos.ledger.rwset.kvrwset import kv_rwset_pb2
@@ -59,15 +66,30 @@ def _history_writes(rwsets: list[bytes | None], flags: list[int]):
 
 class KVLedger:
     """One channel's ledger (reference ledger.PeerLedger,
-    core/ledger/ledger_interface.go:142)."""
+    core/ledger/ledger_interface.go:142).  Owns the block store, state DB,
+    history DB, and the private-data store — the reference's kvledger also
+    commits block + pvtdata together (kv_ledger.go commitToPvtAndBlockStore)
+    so that restart recovery can replay cleartext private writes."""
 
-    def __init__(self, ledger_id: str, block_store: BlockStore, kv: KVStore):
+    def __init__(
+        self,
+        ledger_id: str,
+        block_store: BlockStore,
+        kv: KVStore,
+        btl_policy=None,
+    ):
+        from fabric_tpu.ledger.pvtdatastorage import PvtDataStore
+
         self.ledger_id = ledger_id
         self._blocks = block_store
         self._state = VersionedDB(kv, f"statedb/{ledger_id}")
         self._history = HistoryDB(kv, f"historydb/{ledger_id}")
         self._mvcc = MVCCValidator(self._state)
+        self.pvt_store = PvtDataStore(kv, ledger_id, btl_policy=btl_policy)
         self._recover()
+
+    def set_btl_policy(self, btl_policy) -> None:
+        self.pvt_store._btl = btl_policy or (lambda ns, coll: 0)
 
     # -- recovery (reference recoverDBs / syncStateAndHistoryDBWithBlockstore)
 
@@ -77,14 +99,20 @@ class KVLedger:
         first = 0 if sp is None else sp.block_num + 1
         for num in range(first, height):
             block = self._blocks.get_block_by_number(num)
-            self._apply_state_updates(block)
+            self._apply_state_updates(
+                block, self.pvt_store.get_pvt_data_by_block(num)
+            )
 
-    def _apply_state_updates(self, block: common_pb2.Block) -> None:
+    def _apply_state_updates(
+        self, block: common_pb2.Block, pvt_data: dict[int, bytes] | None = None
+    ) -> None:
         flags = list(protoutil.tx_filter(block))
         rwsets = extract_rwsets(block)
         # replay trusts the recorded validation flags; MVCC re-application
         # is deterministic because only VALID txs contribute writes
-        batch = self._mvcc.validate_and_prepare(block.header.number, rwsets, flags)
+        batch = self._mvcc.validate_and_prepare(
+            block.header.number, rwsets, flags, pvt_data
+        )
         self._state.apply_updates(batch, Height(block.header.number, len(flags)))
         self._history.commit(
             block.header.number, _history_writes(rwsets, flags)
@@ -92,19 +120,71 @@ class KVLedger:
 
     # -- commit path (reference kv_ledger.go:447 CommitLegacy) -------------
 
-    def commit(self, block: common_pb2.Block) -> None:
-        """MVCC-validate (updating the tx filter), persist block, apply
-        state + history.  Signature/policy flags must already be set by the
-        txvalidator; this adds the MVCC codes."""
+    def commit(
+        self,
+        block: common_pb2.Block,
+        pvt_data: dict[int, bytes] | None = None,
+        missing_pvt: list[tuple[int, str, str]] | None = None,
+    ) -> None:
+        """MVCC-validate (updating the tx filter), persist block + private
+        data, apply state + history.  Signature/policy flags must already
+        be set by the txvalidator; this adds the MVCC codes.  pvt_data maps
+        tx index -> marshaled TxPvtReadWriteSet (cleartext private writes
+        this peer is eligible for); missing_pvt records eligible-but-absent
+        collections for the reconciler."""
         flags = list(protoutil.tx_filter(block))
         rwsets = extract_rwsets(block)
-        batch = self._mvcc.validate_and_prepare(block.header.number, rwsets, flags)
+        batch = self._mvcc.validate_and_prepare(
+            block.header.number, rwsets, flags, pvt_data
+        )
         protoutil.set_tx_filter(block, flags)
         self._blocks.add_block(block)
+        # Pvt store before state so recovery-after-crash can replay the
+        # cleartext writes (state savepoint is the recovery watermark).
+        self.pvt_store.commit(
+            block.header.number, pvt_data or {}, missing_pvt
+        )
         self._state.apply_updates(batch, Height(block.header.number, len(flags)))
         self._history.commit(
             block.header.number, _history_writes(rwsets, flags)
         )
+
+    def commit_old_pvt_data(
+        self, block_num: int, tx_num: int, pvt_bytes: bytes
+    ) -> None:
+        """Apply reconciled private data from an old block (reference
+        CommitPvtDataOfOldBlocks): persist in the pvt store and update the
+        private state for keys whose hashed version still points at
+        (block_num, tx_num) — anything newer means the value is stale and
+        only the store copy is kept."""
+        from fabric_tpu.ledger.txmgmt import key_hash as _kh
+        from fabric_tpu.protos.ledger.rwset import rwset_pb2 as _rw
+        from fabric_tpu.protos.ledger.rwset.kvrwset import (
+            kv_rwset_pb2 as _kvrw,
+        )
+
+        self.pvt_store.resolve_missing(block_num, tx_num, pvt_bytes)
+        h = Height(block_num, tx_num)
+        batch: dict[str, dict] = {}
+        txpvt = _rw.TxPvtReadWriteSet.FromString(pvt_bytes)
+        for nsp in txpvt.ns_pvt_rwset:
+            for cp in nsp.collection_pvt_rwset:
+                hns = hash_ns(nsp.namespace, cp.collection_name)
+                pns = pvt_ns(nsp.namespace, cp.collection_name)
+                kvrw = _kvrw.KVRWSet.FromString(cp.rwset)
+                for w in kvrw.writes:
+                    hv = self._state.get_version(
+                        hns, _kh(w.key).hex()
+                    )
+                    if hv != h:
+                        continue  # stale: overwritten since
+                    from fabric_tpu.ledger.statedb import VersionedValue
+
+                    batch.setdefault(pns, {})[w.key] = (
+                        None if w.is_delete else VersionedValue(w.value, h)
+                    )
+        if batch:
+            self._state.apply_updates(batch, None)
 
     # -- queries -----------------------------------------------------------
 
@@ -133,16 +213,59 @@ class KVLedger:
     def new_tx_simulator(self) -> TxSimulator:
         return TxSimulator(self._state)
 
+    def new_query_executor(self) -> "QueryExecutor":
+        """Read-only executor (reference ledger.QueryExecutor,
+        core/ledger/ledger_interface.go:214)."""
+        return QueryExecutor(self._state)
+
+    def get_state(self, ns: str, key: str) -> bytes | None:
+        return self.new_query_executor().get_state(ns, key)
+
+    def get_state_range(self, ns: str, start: str, end: str):
+        return self.new_query_executor().get_state_range(ns, start, end)
+
+    def get_private_data(self, ns: str, coll: str, key: str) -> bytes | None:
+        return self.new_query_executor().get_private_data(ns, coll, key)
+
+    def get_private_data_hash(self, ns: str, coll: str, key: str):
+        return self.new_query_executor().get_private_data_hash(ns, coll, key)
+
+    def get_history_for_key(self, ns: str, key: str):
+        return self._history.get_history_for_key(ns, key)
+
+
+class QueryExecutor:
+    """Read-only state access handed to SCCs/endorser queries (reference
+    QueryExecutor ledger_interface.go:214: GetState/GetStateRange/
+    GetPrivateData*).  No read recording — never part of a transaction."""
+
+    def __init__(self, state: VersionedDB):
+        self._state = state
+
     def get_state(self, ns: str, key: str) -> bytes | None:
         vv = self._state.get_state(ns, key)
         return vv.value if vv else None
+
+    def get_state_multiple(self, ns: str, keys) -> list[bytes | None]:
+        return [
+            vv.value if vv else None
+            for vv in self._state.get_state_multiple(ns, keys)
+        ]
 
     def get_state_range(self, ns: str, start: str, end: str):
         for key, vv in self._state.get_state_range(ns, start, end):
             yield key, vv.value
 
-    def get_history_for_key(self, ns: str, key: str):
-        return self._history.get_history_for_key(ns, key)
+    def get_private_data(self, ns: str, coll: str, key: str) -> bytes | None:
+        vv = self._state.get_state(pvt_ns(ns, coll), key)
+        return vv.value if vv else None
+
+    def get_private_data_hash(self, ns: str, coll: str, key: str):
+        vv = self._state.get_state(hash_ns(ns, coll), key_hash(key).hex())
+        return vv.value if vv else None
+
+    def done(self) -> None:
+        pass
 
 
 class LedgerProvider:
@@ -186,4 +309,4 @@ class LedgerProvider:
         self._kv.close()
 
 
-__all__ = ["KVLedger", "LedgerProvider", "extract_rwsets"]
+__all__ = ["KVLedger", "LedgerProvider", "QueryExecutor", "extract_rwsets"]

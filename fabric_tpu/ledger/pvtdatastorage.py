@@ -1,0 +1,222 @@
+"""Private-data store: durable per-block private write sets.
+
+Reference: core/ledger/pvtdatastorage/store.go + kv_encoding.go — stores
+the cleartext TxPvtReadWriteSets committed with each block, tracks
+collections this peer was eligible for but did not receive ("missing
+data", fed to the reconciler), and expires data per-collection after its
+block-to-live (BTL) via an expiry index consulted on every commit.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+
+from fabric_tpu.ledger.kvstore import KVStore, NamedDB
+from fabric_tpu.protos.ledger.rwset import rwset_pb2
+
+_DATA = b"d"  # d<block:16x><tx:8x> -> TxPvtReadWriteSet
+_MISS = b"m"  # m<block:16x><tx:8x> -> json [[ns, coll], ...]
+_EXP = b"x"   # x<expiry:16x><block:16x> -> json [[tx, ns, coll], ...]
+
+
+def _dkey(block: int, tx: int) -> bytes:
+    return _DATA + b"%016x%08x" % (block, tx)
+
+
+def _mkey(block: int, tx: int) -> bytes:
+    return _MISS + b"%016x%08x" % (block, tx)
+
+
+def _xkey(expiry: int, block: int) -> bytes:
+    return _EXP + b"%016x%016x" % (expiry, block)
+
+
+class PvtDataStore:
+    def __init__(self, kv: KVStore, ledger_id: str, btl_policy=None):
+        """btl_policy(ns, coll) -> int blocks-to-live (0 = forever);
+        defaults to keep-forever (reference pvtdatapolicy.BTLPolicy)."""
+        self._db = NamedDB(kv, f"pvtdata/{ledger_id}")
+        self._btl = btl_policy or (lambda ns, coll: 0)
+        self._lock = threading.Lock()
+
+    # -- commit ------------------------------------------------------------
+
+    def commit(
+        self,
+        block_num: int,
+        pvt_data: dict[int, bytes],
+        missing: list[tuple[int, str, str]] | None = None,
+    ) -> None:
+        """Persist the block's private data ({tx_num: TxPvtReadWriteSet
+        bytes}) and missing-data records [(tx_num, ns, coll)]; then purge
+        whatever expired at this height (reference store.go Commit +
+        purgeExpiredData)."""
+        puts: dict[bytes, bytes] = {}
+        expiry_adds: dict[int, list[tuple[int, str, str]]] = {}
+        for tx_num in sorted(pvt_data):
+            raw = pvt_data[tx_num]
+            puts[_dkey(block_num, tx_num)] = raw
+            for ns, coll in self._collections_of(raw):
+                btl = self._btl(ns, coll)
+                if btl:
+                    expiry_adds.setdefault(block_num + btl + 1, []).append(
+                        (tx_num, ns, coll)
+                    )
+        by_tx: dict[int, list[tuple[str, str]]] = {}
+        for tx_num, ns, coll in missing or []:
+            by_tx.setdefault(tx_num, []).append((ns, coll))
+        for tx_num, pairs in by_tx.items():
+            puts[_mkey(block_num, tx_num)] = json.dumps(pairs).encode()
+        with self._lock:
+            for exp, entries in expiry_adds.items():
+                key = _xkey(exp, block_num)
+                prior = self._db.get(key)
+                if prior:
+                    entries = json.loads(prior) + [list(e) for e in entries]
+                puts[key] = json.dumps(
+                    [list(e) for e in entries]
+                ).encode()
+            self._db.write_batch(puts)
+            self._purge_expired(block_num)
+
+    def _collections_of(self, raw: bytes):
+        try:
+            txpvt = rwset_pb2.TxPvtReadWriteSet.FromString(raw)
+        except Exception:
+            return
+        for nsp in txpvt.ns_pvt_rwset:
+            for cp in nsp.collection_pvt_rwset:
+                yield nsp.namespace, cp.collection_name
+
+    def _purge_expired(self, current_block: int) -> None:
+        """Drop collection rwsets whose BTL elapsed (lock held)."""
+        deletes: list[bytes] = []
+        rewrites: dict[bytes, bytes] = {}
+        end = _xkey(current_block + 1, 0)
+        for key, value in self._db.iterate(_EXP, end):
+            block = int(key[len(_EXP) + 16 :], 16)
+            expired = {(t, n, c) for t, n, c in json.loads(value)}
+            deletes.append(key)
+            by_tx: dict[int, set[tuple[str, str]]] = {}
+            for t, n, c in expired:
+                by_tx.setdefault(t, set()).add((n, c))
+            for tx_num, colls in by_tx.items():
+                dkey = _dkey(block, tx_num)
+                raw = rewrites.get(dkey) or self._db.get(dkey)
+                if raw is None:
+                    continue
+                try:
+                    txpvt = rwset_pb2.TxPvtReadWriteSet.FromString(raw)
+                except Exception:
+                    continue
+                new = rwset_pb2.TxPvtReadWriteSet(data_model=txpvt.data_model)
+                for nsp in txpvt.ns_pvt_rwset:
+                    keep = [
+                        cp
+                        for cp in nsp.collection_pvt_rwset
+                        if (nsp.namespace, cp.collection_name) not in colls
+                    ]
+                    if keep:
+                        nn = new.ns_pvt_rwset.add()
+                        nn.namespace = nsp.namespace
+                        nn.collection_pvt_rwset.extend(keep)
+                if new.ns_pvt_rwset:
+                    rewrites[dkey] = new.SerializeToString()
+                else:
+                    rewrites.pop(dkey, None)
+                    deletes.append(dkey)
+        if deletes or rewrites:
+            self._db.write_batch(rewrites, deletes)
+
+    # -- queries -----------------------------------------------------------
+
+    def get_pvt_data_by_block(self, block_num: int) -> dict[int, bytes]:
+        """{tx_num: TxPvtReadWriteSet bytes} (reference
+        GetPvtDataByBlockNum)."""
+        prefix = _DATA + b"%016x" % block_num
+        out = {}
+        with self._lock:
+            for key, value in self._db.iterate(prefix, prefix + b"\xff"):
+                out[int(key[len(prefix):], 16)] = value
+        return out
+
+    def get_missing(
+        self, max_blocks: int | None = None
+    ) -> list[tuple[int, int, str, str]]:
+        """[(block, tx, ns, coll)] eligible-but-missing entries, oldest
+        first (the reconciler's work list; reference
+        GetMissingPvtDataInfoForMostRecentBlocks)."""
+        out = []
+        blocks_seen: set[int] = set()
+        with self._lock:
+            for key, value in self._db.iterate(_MISS, _MISS + b"\xff"):
+                block = int(key[1:17], 16)
+                if max_blocks is not None:
+                    blocks_seen.add(block)
+                    if len(blocks_seen) > max_blocks:
+                        break
+                tx = int(key[17:25], 16)
+                for ns, coll in json.loads(value):
+                    out.append((block, tx, ns, coll))
+        return out
+
+    def resolve_missing(
+        self, block_num: int, tx_num: int, pvt_bytes: bytes
+    ) -> None:
+        """Reconciler delivered previously-missing data: merge it in and
+        clear the missing record (reference CommitPvtDataOfOldBlocks)."""
+        with self._lock:
+            dkey = _dkey(block_num, tx_num)
+            existing = self._db.get(dkey)
+            if existing:
+                merged = rwset_pb2.TxPvtReadWriteSet.FromString(existing)
+                incoming = rwset_pb2.TxPvtReadWriteSet.FromString(pvt_bytes)
+                have = {
+                    (nsp.namespace, cp.collection_name)
+                    for nsp in merged.ns_pvt_rwset
+                    for cp in nsp.collection_pvt_rwset
+                }
+                for nsp in incoming.ns_pvt_rwset:
+                    add = [
+                        cp
+                        for cp in nsp.collection_pvt_rwset
+                        if (nsp.namespace, cp.collection_name) not in have
+                    ]
+                    if not add:
+                        continue
+                    tgt = None
+                    for m in merged.ns_pvt_rwset:
+                        if m.namespace == nsp.namespace:
+                            tgt = m
+                            break
+                    if tgt is None:
+                        tgt = merged.ns_pvt_rwset.add()
+                        tgt.namespace = nsp.namespace
+                    tgt.collection_pvt_rwset.extend(add)
+                pvt_bytes = merged.SerializeToString()
+            delivered = {
+                (nsp.namespace, cp.collection_name)
+                for nsp in rwset_pb2.TxPvtReadWriteSet.FromString(
+                    pvt_bytes
+                ).ns_pvt_rwset
+                for cp in nsp.collection_pvt_rwset
+            }
+            puts = {dkey: pvt_bytes}
+            deletes = []
+            mkey = _mkey(block_num, tx_num)
+            mraw = self._db.get(mkey)
+            if mraw:
+                remaining = [
+                    (ns, coll)
+                    for ns, coll in json.loads(mraw)
+                    if (ns, coll) not in delivered
+                ]
+                if remaining:
+                    puts[mkey] = json.dumps(remaining).encode()
+                else:
+                    deletes.append(mkey)
+            self._db.write_batch(puts, deletes)
+
+
+__all__ = ["PvtDataStore"]

@@ -14,6 +14,7 @@ MVCC, all signature checks already ran as one batch.
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 
 from fabric_tpu.ledger.statedb import Height, VersionedDB, VersionedValue
 from fabric_tpu.protos.ledger.rwset import rwset_pb2
@@ -24,6 +25,26 @@ VALID = transaction_pb2.VALID
 MVCC_READ_CONFLICT = transaction_pb2.MVCC_READ_CONFLICT
 PHANTOM_READ_CONFLICT = transaction_pb2.PHANTOM_READ_CONFLICT
 BAD_RWSET = transaction_pb2.BAD_RWSET
+
+
+# Private collections live in the same VersionedDB under derived namespaces
+# (the reference keeps a composite public/hashed/private DB,
+# core/ledger/kvledger/txmgmt/privacyenabledstate/db.go; we derive
+# sub-namespaces instead — '\x00' can't appear in chaincode names).
+def pvt_ns(ns: str, coll: str) -> str:
+    return f"{ns}\x00pvt\x00{coll}"
+
+
+def hash_ns(ns: str, coll: str) -> str:
+    return f"{ns}\x00hash\x00{coll}"
+
+
+def key_hash(key: str) -> bytes:
+    return hashlib.sha256(key.encode()).digest()
+
+
+def value_hash(value: bytes) -> bytes:
+    return hashlib.sha256(value).digest()
 
 
 def _version_proto(h: Height | None):
@@ -47,6 +68,13 @@ class TxSimulator:
         self._reads: dict[tuple[str, str], Height | None] = {}
         self._writes: dict[tuple[str, str], bytes | None] = {}
         self._range_queries: list[kv_rwset_pb2.RangeQueryInfo] = []
+        # Private data (reference TxSimulator Get/Set/DeletePrivateData,
+        # ledger_interface.go:270): reads are recorded against the *hashed*
+        # key-space (what committers without the collection validate), and
+        # writes split into a hashed write (public) + the cleartext write
+        # (distributed separately via the transient store / gossip).
+        self._pvt_reads: dict[tuple[str, str, str], Height | None] = {}
+        self._pvt_writes: dict[tuple[str, str, str], bytes | None] = {}
         self._done = False
 
     def get_state(self, ns: str, key: str) -> bytes | None:
@@ -62,6 +90,38 @@ class TxSimulator:
     def delete_state(self, ns: str, key: str) -> None:
         self._writes[(ns, key)] = None
 
+    def get_private_data(self, ns: str, coll: str, key: str) -> bytes | None:
+        if (ns, coll, key) in self._pvt_writes:
+            return self._pvt_writes[(ns, coll, key)]
+        # The hashed key-space is keyed by hex(sha256(key)) — the version
+        # recorded here is what committers outside the collection validate.
+        hv = self._db.get_state(hash_ns(ns, coll), key_hash(key).hex())
+        self._pvt_reads.setdefault(
+            (ns, coll, key), hv.version if hv else None
+        )
+        vv = self._db.get_state(pvt_ns(ns, coll), key)
+        return vv.value if vv else None
+
+    def set_private_data(self, ns: str, coll: str, key: str, value: bytes):
+        self._pvt_writes[(ns, coll, key)] = value
+
+    def delete_private_data(self, ns: str, coll: str, key: str) -> None:
+        self._pvt_writes[(ns, coll, key)] = None
+
+    def get_private_data_hash(self, ns: str, coll: str, key: str):
+        """Hash-only read: allowed even for peers outside the collection
+        (reference GetPrivateDataHash); does NOT record a read."""
+        vv = self._db.get_state(hash_ns(ns, coll), key_hash(key).hex())
+        return vv.value if vv else None
+
+    def get_private_data_range(self, ns: str, coll: str, start: str, end: str):
+        """[(key, value)] over the private key-space.  Like the reference,
+        private range queries record no phantom-protection info."""
+        return [
+            (key, vv.value)
+            for key, vv in self._db.get_state_range(pvt_ns(ns, coll), start, end)
+        ]
+
     def get_state_range(self, ns: str, start: str, end: str):
         """Returns [(key, value)] and records the range query for phantom
         detection at validation time."""
@@ -75,8 +135,25 @@ class TxSimulator:
         self._range_queries.append((ns, rqi))
         return out
 
+    def _pvt_collection_rwsets(self) -> dict[str, dict[str, bytes]]:
+        """{ns: {coll: serialized private KVRWSet}} for namespaces with
+        private writes."""
+        per_coll: dict[tuple[str, str], kv_rwset_pb2.KVRWSet] = {}
+        for (ns, coll, key), value in sorted(self._pvt_writes.items()):
+            per_coll.setdefault((ns, coll), kv_rwset_pb2.KVRWSet()).writes.append(
+                kv_rwset_pb2.KVWrite(
+                    key=key, is_delete=value is None, value=value or b""
+                )
+            )
+        out: dict[str, dict[str, bytes]] = {}
+        for (ns, coll), kvrw in per_coll.items():
+            out.setdefault(ns, {})[coll] = kvrw.SerializeToString()
+        return out
+
     def get_tx_simulation_results(self) -> bytes:
-        """Marshaled rwset.TxReadWriteSet (public data only for now)."""
+        """Marshaled rwset.TxReadWriteSet: public reads/writes plus, per
+        collection touched, the hashed rwset + hash of the private rwset
+        (reference rwsetutil/rwset_builder.go GetTxSimulationResults)."""
         self._done = True
         by_ns: dict[str, kv_rwset_pb2.KVRWSet] = {}
 
@@ -96,14 +173,76 @@ class TxSimulator:
                     key=key, is_delete=value is None, value=value or b""
                 )
             )
-        txrw = rwset_pb2.TxReadWriteSet(data_model=rwset_pb2.TxReadWriteSet.KV)
-        for ns in sorted(by_ns):
-            txrw.ns_rwset.append(
-                rwset_pb2.NsReadWriteSet(
-                    namespace=ns, rwset=by_ns[ns].SerializeToString()
+
+        # Hashed r/w sets per (ns, collection).
+        hashed: dict[tuple[str, str], kv_rwset_pb2.HashedRWSet] = {}
+
+        def coll_set(ns: str, coll: str) -> kv_rwset_pb2.HashedRWSet:
+            return hashed.setdefault((ns, coll), kv_rwset_pb2.HashedRWSet())
+
+        for (ns, coll, key), ver in sorted(self._pvt_reads.items()):
+            coll_set(ns, coll).hashed_reads.append(
+                kv_rwset_pb2.KVReadHash(
+                    key_hash=key_hash(key), version=_version_proto(ver)
                 )
             )
+        for (ns, coll, key), value in sorted(self._pvt_writes.items()):
+            coll_set(ns, coll).hashed_writes.append(
+                kv_rwset_pb2.KVWriteHash(
+                    key_hash=key_hash(key),
+                    is_delete=value is None,
+                    value_hash=value_hash(value) if value is not None else b"",
+                )
+            )
+
+        pvt = self._pvt_collection_rwsets()
+        namespaces = sorted(
+            set(by_ns) | {ns for ns, _ in hashed}
+        )
+        txrw = rwset_pb2.TxReadWriteSet(data_model=rwset_pb2.TxReadWriteSet.KV)
+        for ns in namespaces:
+            nsrw = rwset_pb2.NsReadWriteSet(
+                namespace=ns,
+                rwset=by_ns.get(ns, kv_rwset_pb2.KVRWSet()).SerializeToString(),
+            )
+            for (hns, coll), hrw in sorted(hashed.items()):
+                if hns != ns:
+                    continue
+                pvt_bytes = pvt.get(ns, {}).get(coll)
+                nsrw.collection_hashed_rwset.append(
+                    rwset_pb2.CollectionHashedReadWriteSet(
+                        collection_name=coll,
+                        hashed_rwset=hrw.SerializeToString(),
+                        pvt_rwset_hash=(
+                            hashlib.sha256(pvt_bytes).digest()
+                            if pvt_bytes is not None
+                            else b""
+                        ),
+                    )
+                )
+            txrw.ns_rwset.append(nsrw)
         return txrw.SerializeToString()
+
+    def get_pvt_simulation_results(self) -> bytes | None:
+        """Marshaled rwset.TxPvtReadWriteSet with the cleartext private
+        writes, or None if the tx touched no collections.  Never embedded
+        in the transaction — distributed via transient store + gossip."""
+        pvt = self._pvt_collection_rwsets()
+        if not pvt:
+            return None
+        txpvt = rwset_pb2.TxPvtReadWriteSet(
+            data_model=rwset_pb2.TxReadWriteSet.KV
+        )
+        for ns in sorted(pvt):
+            nsp = rwset_pb2.NsPvtReadWriteSet(namespace=ns)
+            for coll in sorted(pvt[ns]):
+                nsp.collection_pvt_rwset.append(
+                    rwset_pb2.CollectionPvtReadWriteSet(
+                        collection_name=coll, rwset=pvt[ns][coll]
+                    )
+                )
+            txpvt.ns_pvt_rwset.append(nsp)
+        return txpvt.SerializeToString()
 
 
 @dataclasses.dataclass
@@ -124,15 +263,26 @@ class MVCCValidator:
         return self._db.get_version(ns, key)
 
     def validate_and_prepare(
-        self, block_num: int, rwsets: list[bytes | None], flags: list[int]
+        self,
+        block_num: int,
+        rwsets: list[bytes | None],
+        flags: list[int],
+        pvt_data: dict[int, bytes] | None = None,
     ) -> dict:
         """rwsets[i]: marshaled TxReadWriteSet of tx i (None = not an
         endorser tx or already invalid).  Mutates `flags` with MVCC codes;
         returns the state update batch {ns: {key: VersionedValue|None}}.
 
+        pvt_data maps tx_num -> marshaled TxPvtReadWriteSet for txs whose
+        cleartext private writes this peer holds; cleartext writes apply
+        only when their hash matches the endorsed pvt_rwset_hash (reference
+        coordinator verifies hashes before commit,
+        gossip/privdata/coordinator.go).
+
         Matches the reference's serial-in-commit-order semantics: a tx sees
         conflicts against committed state AND the writes of earlier valid
         txs in the same block."""
+        pvt_data = pvt_data or {}
         updated_versions: dict[tuple[str, str], Height] = {}
         batch: dict[str, dict[str, VersionedValue | None]] = {}
         for tx_num, raw in enumerate(rwsets):
@@ -141,14 +291,27 @@ class MVCCValidator:
             try:
                 txrw = rwset_pb2.TxReadWriteSet.FromString(raw)
                 parsed = [
-                    (ns.namespace, kv_rwset_pb2.KVRWSet.FromString(ns.rwset))
-                    for ns in txrw.ns_rwset
+                    (
+                        nsrw.namespace,
+                        kv_rwset_pb2.KVRWSet.FromString(nsrw.rwset),
+                        [
+                            (
+                                ch.collection_name,
+                                kv_rwset_pb2.HashedRWSet.FromString(
+                                    ch.hashed_rwset
+                                ),
+                                bytes(ch.pvt_rwset_hash),
+                            )
+                            for ch in nsrw.collection_hashed_rwset
+                        ],
+                    )
+                    for nsrw in txrw.ns_rwset
                 ]
             except Exception:
                 flags[tx_num] = BAD_RWSET
                 continue
             code = VALID
-            for ns, kvrw in parsed:
+            for ns, kvrw, colls in parsed:
                 for read in kvrw.reads:
                     want = _height_of(read.version) if read.HasField("version") else None
                     have = self._committed_version(ns, read.key, updated_versions)
@@ -163,11 +326,30 @@ class MVCCValidator:
                         break
                 if code != VALID:
                     break
+                for coll, hrw, _ in colls:
+                    hns = hash_ns(ns, coll)
+                    for hread in hrw.hashed_reads:
+                        want = (
+                            _height_of(hread.version)
+                            if hread.HasField("version")
+                            else None
+                        )
+                        have = self._committed_version(
+                            hns, bytes(hread.key_hash).hex(), updated_versions
+                        )
+                        if want != have:
+                            code = MVCC_READ_CONFLICT
+                            break
+                    if code != VALID:
+                        break
+                if code != VALID:
+                    break
             flags[tx_num] = code
             if code != VALID:
                 continue
             h = Height(block_num, tx_num)
-            for ns, kvrw in parsed:
+            pvt_by_coll = self._parse_pvt(pvt_data.get(tx_num))
+            for ns, kvrw, colls in parsed:
                 ns_batch = batch.setdefault(ns, {})
                 for w in kvrw.writes:
                     updated_versions[(ns, w.key)] = h
@@ -176,7 +358,57 @@ class MVCCValidator:
                         updated_versions[(ns, w.key)] = None  # type: ignore[assignment]
                     else:
                         ns_batch[w.key] = VersionedValue(w.value, h)
+                for coll, hrw, expected_hash in colls:
+                    hns = hash_ns(ns, coll)
+                    h_batch = batch.setdefault(hns, {})
+                    for hw in hrw.hashed_writes:
+                        hkey = bytes(hw.key_hash).hex()
+                        if hw.is_delete:
+                            h_batch[hkey] = None
+                            updated_versions[(hns, hkey)] = None  # type: ignore[assignment]
+                        else:
+                            h_batch[hkey] = VersionedValue(
+                                bytes(hw.value_hash), h
+                            )
+                            updated_versions[(hns, hkey)] = h
+                    # Cleartext private writes, if supplied and authentic.
+                    # An empty endorsed hash means NO cleartext rwset was
+                    # endorsed (read-only collection access) — any supply
+                    # is forged and must be rejected, not waved through.
+                    clear = pvt_by_coll.get((ns, coll))
+                    if clear is None:
+                        continue
+                    raw_kvrw, clear_kvrw = clear
+                    if (
+                        not expected_hash
+                        or hashlib.sha256(raw_kvrw).digest() != expected_hash
+                    ):
+                        continue  # bogus supply: treat as missing
+                    p_batch = batch.setdefault(pvt_ns(ns, coll), {})
+                    for w in clear_kvrw.writes:
+                        if w.is_delete:
+                            p_batch[w.key] = None
+                        else:
+                            p_batch[w.key] = VersionedValue(w.value, h)
         return batch
+
+    @staticmethod
+    def _parse_pvt(raw: bytes | None):
+        """{(ns, coll): (raw_kvrwset_bytes, parsed KVRWSet)}"""
+        out: dict[tuple[str, str], tuple[bytes, kv_rwset_pb2.KVRWSet]] = {}
+        if not raw:
+            return out
+        try:
+            txpvt = rwset_pb2.TxPvtReadWriteSet.FromString(raw)
+            for nsp in txpvt.ns_pvt_rwset:
+                for cp in nsp.collection_pvt_rwset:
+                    out[(nsp.namespace, cp.collection_name)] = (
+                        bytes(cp.rwset),
+                        kv_rwset_pb2.KVRWSet.FromString(cp.rwset),
+                    )
+        except Exception:
+            return {}
+        return out
 
     def _validate_range_query(self, ns: str, rqi, updated_versions) -> bool:
         """Re-scan and compare against recorded raw reads (reference
@@ -213,4 +445,8 @@ __all__ = [
     "MVCC_READ_CONFLICT",
     "PHANTOM_READ_CONFLICT",
     "BAD_RWSET",
+    "pvt_ns",
+    "hash_ns",
+    "key_hash",
+    "value_hash",
 ]

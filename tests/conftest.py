@@ -17,3 +17,18 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# The env var alone is NOT enough here: the ambient TPU-tunnel harness
+# installs a sitecustomize that calls jax.config.update("jax_platforms",
+# "axon,cpu") at interpreter start, which takes priority over JAX_PLATFORMS.
+# Without the explicit update below, "hermetic" tests silently run their
+# kernels through the TPU tunnel (slow remote compiles, hangs when the
+# tunnel misbehaves).  A later config.update wins as long as backends are
+# not initialized yet.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+if jax._src.xla_bridge.backends_are_initialized():  # pragma: no cover
+    from jax.extend.backend import clear_backends
+
+    clear_backends()
