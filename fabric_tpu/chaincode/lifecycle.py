@@ -276,5 +276,21 @@ class DefinitionProvider:
             return None
         return (d.validation_plugin or "vscc", bytes(d.validation_parameter))
 
+    def collection_config(self, name: str, collection: str):
+        """The StaticCollectionConfig of one collection, or None
+        (reference deployedcc_infoprovider.go AllCollectionsConfigPkg +
+        v20.go CollectionValidationInfo)."""
+        from fabric_tpu.protos.peer import collection_pb2
+
+        d = self.definition(name)
+        if d is None or not d.collections:
+            return None
+        pkg = collection_pb2.CollectionConfigPackage.FromString(d.collections)
+        for c in pkg.config:
+            sc = c.static_collection_config
+            if c.HasField("static_collection_config") and sc.name == collection:
+                return sc
+        return None
+
 
 __all__ = ["LifecycleSCC", "PackageStore", "DefinitionProvider", "NAMESPACE"]

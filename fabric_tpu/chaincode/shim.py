@@ -135,6 +135,41 @@ class ChaincodeStub:
         g = shim_pb.GetState(key=key, collection=collection)
         return self._call(M.GET_PRIVATE_DATA_HASH, g.SerializeToString()).payload
 
+    # -- state metadata / key-level endorsement ----------------------------
+
+    def get_state_metadata(
+        self, key: str, collection: str = ""
+    ) -> dict[str, bytes]:
+        g = shim_pb.GetStateMetadata(key=key, collection=collection)
+        resp = self._call(M.GET_STATE_METADATA, g.SerializeToString())
+        res = shim_pb.StateMetadataResult.FromString(resp.payload)
+        return {e.metakey: bytes(e.value) for e in res.entries}
+
+    def put_state_metadata(
+        self, key: str, metakey: str, value: bytes, collection: str = ""
+    ) -> None:
+        p = shim_pb.PutStateMetadata(key=key, collection=collection)
+        p.metadata.metakey = metakey
+        p.metadata.value = value
+        self._call(M.PUT_STATE_METADATA, p.SerializeToString())
+
+    def set_state_validation_parameter(
+        self, key: str, policy_bytes: bytes, collection: str = ""
+    ) -> None:
+        """Attach a key-level endorsement policy (reference shim
+        SetStateValidationParameter; build policies with
+        fabric_tpu.chaincode.statebased)."""
+        self.put_state_metadata(
+            key, "VALIDATION_PARAMETER", policy_bytes, collection
+        )
+
+    def get_state_validation_parameter(
+        self, key: str, collection: str = ""
+    ) -> bytes:
+        return self.get_state_metadata(key, collection).get(
+            "VALIDATION_PARAMETER", b""
+        )
+
     def invoke_chaincode(self, name: str, args: list[bytes], channel: str = ""):
         spec = chaincode_pb2.ChaincodeSpec()
         spec.chaincode_id.name = name if not channel else f"{name}/{channel}"

@@ -1,0 +1,64 @@
+"""Key-level endorsement policy builder for chaincode authors.
+
+Reference: the chaincode-shim `pkg/statebased` KeyEndorsementPolicy —
+build/modify a SignaturePolicyEnvelope listing org principals, serialize
+it, and attach it to a key via
+`stub.set_state_validation_parameter(key, policy_bytes)`.
+"""
+
+from __future__ import annotations
+
+from fabric_tpu.protos.common import policies_pb2
+from fabric_tpu.protos.msp import msp_principal_pb2
+
+ROLE_MEMBER = msp_principal_pb2.MSPRole.MEMBER
+ROLE_PEER = msp_principal_pb2.MSPRole.PEER
+
+
+class KeyEndorsementPolicy:
+    """N-of-N over a set of org principals (reference statebased
+    policy.go: AddOrgs/DelOrgs/ListOrgs/Policy)."""
+
+    def __init__(self, policy_bytes: bytes = b""):
+        self._orgs: dict[str, int] = {}
+        if policy_bytes:
+            env = policies_pb2.SignaturePolicyEnvelope.FromString(
+                policy_bytes
+            )
+            for p in env.identities:
+                role = msp_principal_pb2.MSPRole.FromString(p.principal)
+                self._orgs[role.msp_identifier] = role.role
+
+    def add_orgs(self, role: int, *mspids: str) -> None:
+        for mspid in mspids:
+            self._orgs[mspid] = role
+
+    def del_orgs(self, *mspids: str) -> None:
+        for mspid in mspids:
+            self._orgs.pop(mspid, None)
+
+    def list_orgs(self) -> list[str]:
+        return sorted(self._orgs)
+
+    def policy(self) -> bytes:
+        """Serialized SignaturePolicyEnvelope requiring a signature from
+        EVERY listed org."""
+        env = policies_pb2.SignaturePolicyEnvelope(version=0)
+        rules = []
+        for i, mspid in enumerate(sorted(self._orgs)):
+            p = env.identities.add()
+            p.principal_classification = (
+                msp_principal_pb2.MSPPrincipal.ROLE
+            )
+            p.principal = msp_principal_pb2.MSPRole(
+                msp_identifier=mspid, role=self._orgs[mspid]
+            ).SerializeToString()
+            rule = policies_pb2.SignaturePolicy()
+            rule.signed_by = i
+            rules.append(rule)
+        env.rule.n_out_of.n = len(rules)
+        env.rule.n_out_of.rules.extend(rules)
+        return env.SerializeToString()
+
+
+__all__ = ["KeyEndorsementPolicy", "ROLE_MEMBER", "ROLE_PEER"]

@@ -240,6 +240,23 @@ class ChaincodeSupport:
             else:
                 sim.delete_state(ns, d.key)
             return self._reply(msg)
+        if msg.type == M.GET_STATE_METADATA:
+            from fabric_tpu.ledger.txmgmt import encode_metadata
+
+            g = shim_pb.GetStateMetadata.FromString(msg.payload)
+            if g.collection:
+                entries = sim.get_private_data_metadata(ns, g.collection, g.key)
+            else:
+                entries = sim.get_state_metadata(ns, g.key)
+            return self._reply(msg, encode_metadata(entries))
+        if msg.type == M.PUT_STATE_METADATA:
+            p = shim_pb.PutStateMetadata.FromString(msg.payload)
+            entry = {p.metadata.metakey: bytes(p.metadata.value)}
+            if p.collection:
+                sim.set_private_data_metadata(ns, p.collection, p.key, entry)
+            else:
+                sim.set_state_metadata(ns, p.key, entry)
+            return self._reply(msg)
         if msg.type == M.GET_PRIVATE_DATA_HASH:
             g = shim_pb.GetState.FromString(msg.payload)
             val = sim.get_private_data_hash(ns, g.collection, g.key)

@@ -1,0 +1,134 @@
+"""Capability feature-gating (reference common/capabilities/).
+
+Channels declare required capabilities in their config (Capabilities
+config values at channel/orderer/application level); a node that does not
+implement a required capability must refuse to process the channel
+(reference registry.go Supported).  This build implements the V2_0
+semantics throughout (new lifecycle, v20 validation), and accepts the
+V1_x names for config compatibility.
+"""
+
+from __future__ import annotations
+
+from fabric_tpu.protos.common import configuration_pb2
+
+CHANNEL_V2_0 = "V2_0"
+CHANNEL_V1_4_3 = "V1_4_3"
+CHANNEL_V1_4_2 = "V1_4_2"
+CHANNEL_V1_3 = "V1_3"
+CHANNEL_V1_1 = "V1_1"
+
+APPLICATION_V2_0 = "V2_0"
+APPLICATION_V1_4_2 = "V1_4_2"
+APPLICATION_V1_3 = "V1_3"
+APPLICATION_V1_2 = "V1_2"
+APPLICATION_V1_1 = "V1_1"
+
+ORDERER_V2_0 = "V2_0"
+ORDERER_V1_4_2 = "V1_4_2"
+ORDERER_V1_1 = "V1_1"
+
+
+class UnsupportedCapabilityError(Exception):
+    pass
+
+
+class _Registry:
+    def __init__(self, kind: str, known: set[str], caps: dict[str, bool]):
+        self._kind = kind
+        self._known = known
+        self._required = {c for c, req in caps.items() if req}
+
+    def supported(self) -> None:
+        """Raise if the channel requires a capability this node lacks
+        (reference registry.go Supported)."""
+        unknown = self._required - self._known
+        if unknown:
+            raise UnsupportedCapabilityError(
+                f"{self._kind} capabilities not supported: {sorted(unknown)}"
+            )
+
+    def required(self) -> set[str]:
+        return set(self._required)
+
+    def _has(self, cap: str) -> bool:
+        return cap in self._required
+
+
+class ChannelCapabilities(_Registry):
+    def __init__(self, caps: dict[str, bool]):
+        super().__init__(
+            "channel",
+            {CHANNEL_V1_1, CHANNEL_V1_3, CHANNEL_V1_4_2, CHANNEL_V1_4_3,
+             CHANNEL_V2_0},
+            caps,
+        )
+
+    @property
+    def consensus_type_migration(self) -> bool:
+        return self._has(CHANNEL_V1_4_2) or self._has(CHANNEL_V2_0)
+
+
+class ApplicationCapabilities(_Registry):
+    def __init__(self, caps: dict[str, bool]):
+        super().__init__(
+            "application",
+            {APPLICATION_V1_1, APPLICATION_V1_2, APPLICATION_V1_3,
+             APPLICATION_V1_4_2, APPLICATION_V2_0},
+            caps,
+        )
+
+    @property
+    def lifecycle_v20(self) -> bool:
+        """New chaincode lifecycle (_lifecycle SCC) in force."""
+        return self._has(APPLICATION_V2_0)
+
+    @property
+    def key_level_endorsement(self) -> bool:
+        return self._has(APPLICATION_V1_3) or self._has(APPLICATION_V2_0)
+
+    @property
+    def private_channel_data(self) -> bool:
+        return True  # always on in this build (reference gates on V1_1)
+
+    @property
+    def storage_pvt_data_experimental(self) -> bool:
+        return self._has(APPLICATION_V2_0)
+
+
+class OrdererCapabilities(_Registry):
+    def __init__(self, caps: dict[str, bool]):
+        super().__init__(
+            "orderer",
+            {ORDERER_V1_1, ORDERER_V1_4_2, ORDERER_V2_0},
+            caps,
+        )
+
+    @property
+    def use_channel_creation_policy_as_admins(self) -> bool:
+        return self._has(ORDERER_V2_0)
+
+
+def capabilities_value(names: list[str]) -> configuration_pb2.Capabilities:
+    caps = configuration_pb2.Capabilities()
+    for n in names:
+        caps.capabilities[n].SetInParent()
+    return caps
+
+
+def parse_capabilities(raw: bytes) -> dict[str, bool]:
+    caps = configuration_pb2.Capabilities.FromString(raw)
+    return {name: True for name in caps.capabilities}
+
+
+__all__ = [
+    "ChannelCapabilities",
+    "ApplicationCapabilities",
+    "OrdererCapabilities",
+    "UnsupportedCapabilityError",
+    "capabilities_value",
+    "parse_capabilities",
+    "CHANNEL_V2_0",
+    "APPLICATION_V2_0",
+    "ORDERER_V2_0",
+]

@@ -1,0 +1,389 @@
+"""Deterministic config-transaction engine.
+
+Reference: common/configtx — validator.go:103 NewValidatorImpl /
+:133 ProposeConfigUpdate, update.go (read/write-set verification and
+policy gathering), compare.go (element equality), and the
+configtxlator-side delta computation (internal/configtxlator/update).
+
+Semantics (mirroring the reference):
+
+- The channel config is a versioned tree (ConfigGroup / ConfigValue /
+  ConfigPolicy, each with a version and a mod_policy).
+- A ConfigUpdate carries a read_set and a write_set.  Every element in
+  the read_set must exist at exactly the stated version (stale reads are
+  rejected).  Elements in the write_set at their current version are
+  carried through unchanged; an element whose version is bumped by
+  exactly one is a modification and requires its CURRENT mod_policy to
+  be satisfied by the update's signatures (for brand-new elements the
+  enclosing group's mod_policy gates the change).
+- The proposed config is the current tree with the write_set applied,
+  at sequence+1.
+"""
+
+from __future__ import annotations
+
+from fabric_tpu.protos.common import common_pb2, configtx_pb2
+from fabric_tpu.protoutil.common import SignedData
+
+
+class ConfigtxError(Exception):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# element comparison helpers
+# ---------------------------------------------------------------------------
+
+
+def _values_equal(a: configtx_pb2.ConfigValue, b: configtx_pb2.ConfigValue):
+    return a.value == b.value and a.mod_policy == b.mod_policy
+
+
+def _policies_equal(a: configtx_pb2.ConfigPolicy, b: configtx_pb2.ConfigPolicy):
+    return (
+        a.policy.SerializeToString() == b.policy.SerializeToString()
+        and a.mod_policy == b.mod_policy
+    )
+
+
+def _group_shallow_equal(a: configtx_pb2.ConfigGroup, b: configtx_pb2.ConfigGroup):
+    return (
+        a.mod_policy == b.mod_policy
+        and set(a.groups) == set(b.groups)
+        and set(a.values) == set(b.values)
+        and set(a.policies) == set(b.policies)
+    )
+
+
+# ---------------------------------------------------------------------------
+# validator
+# ---------------------------------------------------------------------------
+
+
+class ConfigtxValidator:
+    """Per-channel config state machine (reference ValidatorImpl)."""
+
+    def __init__(
+        self,
+        channel_id: str,
+        config: configtx_pb2.Config,
+        policy_manager=None,
+        csp=None,
+    ):
+        if not channel_id:
+            raise ConfigtxError("empty channel id")
+        self.channel_id = channel_id
+        self.config = config
+        self._pm = policy_manager
+        self._csp = csp
+
+    @property
+    def sequence(self) -> int:
+        return self.config.sequence
+
+    # -- entry point -------------------------------------------------------
+
+    def propose_config_update(
+        self, update_env: configtx_pb2.ConfigUpdateEnvelope
+    ) -> configtx_pb2.ConfigEnvelope:
+        """Validate a signed update against the current config and return
+        the resulting ConfigEnvelope (reference ProposeConfigUpdate)."""
+        update = configtx_pb2.ConfigUpdate.FromString(
+            update_env.config_update
+        )
+        if update.channel_id != self.channel_id:
+            raise ConfigtxError(
+                f"update for channel {update.channel_id!r}, "
+                f"validator is {self.channel_id!r}"
+            )
+        current = self.config.channel_group
+        self._verify_read_set(current, update.read_set, path="Channel")
+        signed_data = self._signed_data(update_env)
+        new_group = configtx_pb2.ConfigGroup()
+        new_group.CopyFrom(current)
+        self._apply_write_set(
+            new_group, current, update.write_set, signed_data,
+            path="Channel", parent_mod_policy=current.mod_policy,
+        )
+        result = configtx_pb2.Config(sequence=self.config.sequence + 1)
+        result.channel_group.CopyFrom(new_group)
+        return configtx_pb2.ConfigEnvelope(config=result)
+
+    def commit(self, env: configtx_pb2.ConfigEnvelope) -> None:
+        """Adopt a validated config (after ordering)."""
+        if env.config.sequence != self.config.sequence + 1:
+            raise ConfigtxError(
+                f"out-of-order config sequence {env.config.sequence}"
+            )
+        self.config = env.config
+
+    # -- read set ----------------------------------------------------------
+
+    def _verify_read_set(self, current, read_set, path: str) -> None:
+        if read_set.version != current.version:
+            raise ConfigtxError(
+                f"read_set {path}: version {read_set.version} != current "
+                f"{current.version}"
+            )
+        for name, g in read_set.groups.items():
+            if name not in current.groups:
+                raise ConfigtxError(f"read_set group {path}/{name} not found")
+            self._verify_read_set(
+                current.groups[name], g, f"{path}/{name}"
+            )
+        for name, v in read_set.values.items():
+            if name not in current.values:
+                raise ConfigtxError(f"read_set value {path}/{name} not found")
+            if current.values[name].version != v.version:
+                raise ConfigtxError(
+                    f"read_set value {path}/{name}: stale version"
+                )
+        for name, p in read_set.policies.items():
+            if name not in current.policies:
+                raise ConfigtxError(
+                    f"read_set policy {path}/{name} not found"
+                )
+            if current.policies[name].version != p.version:
+                raise ConfigtxError(
+                    f"read_set policy {path}/{name}: stale version"
+                )
+
+    # -- write set ---------------------------------------------------------
+
+    def _check_policy(self, mod_policy: str, path: str, signed_data) -> None:
+        if self._pm is None:
+            return  # unwired (tests/tools): policy gating disabled
+        if not mod_policy:
+            raise ConfigtxError(f"{path}: empty mod_policy rejects changes")
+        pol = self._pm.get_policy(
+            mod_policy if mod_policy.startswith("/")
+            else self._relative(path, mod_policy)
+        )
+        if not pol.evaluate_signed_data(signed_data, self._csp):
+            raise ConfigtxError(
+                f"{path}: mod_policy {mod_policy!r} not satisfied"
+            )
+
+    @staticmethod
+    def _relative(path: str, mod_policy: str) -> str:
+        # mod_policy names resolve relative to the element's enclosing
+        # group; path is "Channel[/seg...]" and the manager tree is rooted
+        # at Channel.
+        segs = path.split("/")[1:]  # drop leading "Channel"
+        return "/".join(segs[:-1] + [mod_policy]) if len(segs) > 0 else mod_policy
+
+    def _apply_write_set(
+        self, target, current, write, signed_data, path, parent_mod_policy
+    ) -> None:
+        """Recursively apply `write` over `target` (a copy of `current`),
+        enforcing version arithmetic and mod policies."""
+        if write.version == current.version + 1:
+            # group itself modified (membership / mod_policy change)
+            self._check_policy(
+                current.mod_policy or parent_mod_policy, path, signed_data
+            )
+            target.version = write.version
+            target.mod_policy = write.mod_policy or current.mod_policy
+            # element removal: anything absent from the write set goes
+            for name in list(target.groups):
+                if name not in write.groups:
+                    del target.groups[name]
+            for name in list(target.values):
+                if name not in write.values:
+                    del target.values[name]
+            for name in list(target.policies):
+                if name not in write.policies:
+                    del target.policies[name]
+        elif write.version != current.version:
+            raise ConfigtxError(
+                f"write_set {path}: version {write.version} not in "
+                f"{{{current.version}, {current.version + 1}}}"
+            )
+
+        for name, wv in write.values.items():
+            cur = current.values.get(name)
+            p = f"{path}/{name}"
+            if cur is None:
+                if wv.version != 0:
+                    raise ConfigtxError(f"new value {p} must be version 0")
+                self._check_policy(
+                    current.mod_policy or parent_mod_policy, p, signed_data
+                )
+                target.values[name].CopyFrom(wv)
+            elif wv.version == cur.version:
+                if not _values_equal(wv, cur):
+                    raise ConfigtxError(
+                        f"value {p} changed without version bump"
+                    )
+            elif wv.version == cur.version + 1:
+                self._check_policy(cur.mod_policy, p, signed_data)
+                target.values[name].CopyFrom(wv)
+            else:
+                raise ConfigtxError(f"value {p}: bad version {wv.version}")
+
+        for name, wp in write.policies.items():
+            cur = current.policies.get(name)
+            p = f"{path}/{name}"
+            if cur is None:
+                if wp.version != 0:
+                    raise ConfigtxError(f"new policy {p} must be version 0")
+                self._check_policy(
+                    current.mod_policy or parent_mod_policy, p, signed_data
+                )
+                target.policies[name].CopyFrom(wp)
+            elif wp.version == cur.version:
+                if not _policies_equal(wp, cur):
+                    raise ConfigtxError(
+                        f"policy {p} changed without version bump"
+                    )
+            elif wp.version == cur.version + 1:
+                self._check_policy(cur.mod_policy, p, signed_data)
+                target.policies[name].CopyFrom(wp)
+            else:
+                raise ConfigtxError(f"policy {p}: bad version {wp.version}")
+
+        for name, wg in write.groups.items():
+            cur = current.groups.get(name)
+            p = f"{path}/{name}"
+            if cur is None:
+                if wg.version != 0:
+                    raise ConfigtxError(f"new group {p} must be version 0")
+                self._check_policy(
+                    current.mod_policy or parent_mod_policy, p, signed_data
+                )
+                target.groups[name].CopyFrom(wg)
+            else:
+                self._apply_write_set(
+                    target.groups[name], cur, wg, signed_data, p,
+                    current.mod_policy or parent_mod_policy,
+                )
+
+    # -- signatures --------------------------------------------------------
+
+    def _signed_data(self, update_env) -> list[SignedData]:
+        out = []
+        for cs in update_env.signatures:
+            shdr = common_pb2.SignatureHeader.FromString(cs.signature_header)
+            out.append(
+                SignedData(
+                    data=bytes(cs.signature_header)
+                    + bytes(update_env.config_update),
+                    identity=bytes(shdr.creator),
+                    signature=bytes(cs.signature),
+                )
+            )
+        return out
+
+
+# ---------------------------------------------------------------------------
+# delta computation (configtxlator's compute-update)
+# ---------------------------------------------------------------------------
+
+
+def compute_update(
+    channel_id: str,
+    original: configtx_pb2.Config,
+    updated: configtx_pb2.Config,
+) -> configtx_pb2.ConfigUpdate:
+    """Minimal ConfigUpdate turning `original` into `updated` (reference
+    internal/configtxlator/update/update.go Compute)."""
+    read, write, changed = _compute_group_delta(
+        original.channel_group, updated.channel_group
+    )
+    if not changed:
+        raise ConfigtxError("no differences between original and updated")
+    upd = configtx_pb2.ConfigUpdate(channel_id=channel_id)
+    upd.read_set.CopyFrom(read)
+    upd.write_set.CopyFrom(write)
+    return upd
+
+
+def _compute_group_delta(orig, new):
+    """Returns (read_group, write_group, changed)."""
+    read = configtx_pb2.ConfigGroup(version=orig.version)
+    write = configtx_pb2.ConfigGroup(
+        version=orig.version, mod_policy=orig.mod_policy
+    )
+    members_changed = (
+        set(orig.groups) != set(new.groups)
+        or set(orig.values) != set(new.values)
+        or set(orig.policies) != set(new.policies)
+        or orig.mod_policy != new.mod_policy
+    )
+    changed = members_changed
+
+    for name, ov in orig.values.items():
+        nv = new.values.get(name)
+        if nv is None:
+            changed = True
+            continue
+        if not _values_equal(ov, nv):
+            changed = True
+            w = write.values[name]
+            w.CopyFrom(nv)
+            w.version = ov.version + 1
+    for name, nv in new.values.items():
+        if name not in orig.values:
+            changed = True
+            w = write.values[name]
+            w.CopyFrom(nv)
+            w.version = 0
+        elif _values_equal(orig.values[name], nv):
+            # unchanged: carried in the write set at current version
+            w = write.values[name]
+            w.CopyFrom(nv)
+            w.version = orig.values[name].version
+
+    for name, op in orig.policies.items():
+        np = new.policies.get(name)
+        if np is None:
+            changed = True
+        elif not _policies_equal(op, np):
+            changed = True
+            w = write.policies[name]
+            w.CopyFrom(np)
+            w.version = op.version + 1
+    for name, np in new.policies.items():
+        if name not in orig.policies:
+            changed = True
+            w = write.policies[name]
+            w.CopyFrom(np)
+            w.version = 0
+        elif _policies_equal(orig.policies[name], np):
+            w = write.policies[name]
+            w.CopyFrom(np)
+            w.version = orig.policies[name].version
+
+    for name, og in orig.groups.items():
+        ng = new.groups.get(name)
+        if ng is None:
+            changed = True
+            continue
+        sub_read, sub_write, sub_changed = _compute_group_delta(og, ng)
+        if sub_changed:
+            changed = True
+            write.groups[name].CopyFrom(sub_write)
+            # the read set references the group at its current version
+            read.groups[name].version = og.version
+        else:
+            write.groups[name].version = og.version
+    for name, ng in new.groups.items():
+        if name not in orig.groups:
+            changed = True
+            g = write.groups[name]
+            g.CopyFrom(ng)
+            g.version = 0
+
+    if members_changed:
+        write.version = orig.version + 1
+        write.mod_policy = new.mod_policy or orig.mod_policy
+        # re-add unchanged members so removal semantics don't fire
+        for name, ov in orig.values.items():
+            if name in new.values and name not in write.values:
+                w = write.values[name]
+                w.CopyFrom(new.values[name])
+                w.version = ov.version
+    return read, write, changed
+
+
+__all__ = ["ConfigtxValidator", "ConfigtxError", "compute_update"]

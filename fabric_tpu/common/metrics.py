@@ -1,0 +1,333 @@
+"""Metrics provider SPI + prometheus-text / statsd-line / disabled impls.
+
+Reference: common/metrics — provider SPI (provider.go: Counter/Gauge/
+Histogram created from *Opts, each supporting With(label pairs)),
+prometheus provider (prometheus/provider.go:20-48), statsd provider
+(statsd/provider.go with go-kit), disabled provider, and the gendoc
+metric catalog.  The operations server (fabric_tpu/common/operations.py)
+scrapes `PrometheusRegistry.expose()` for its /metrics endpoint.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import threading
+from typing import Sequence
+
+
+@dataclasses.dataclass(frozen=True)
+class CounterOpts:
+    namespace: str = ""
+    subsystem: str = ""
+    name: str = ""
+    help: str = ""
+    label_names: tuple[str, ...] = ()
+    statsd_format: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
+class GaugeOpts:
+    namespace: str = ""
+    subsystem: str = ""
+    name: str = ""
+    help: str = ""
+    label_names: tuple[str, ...] = ()
+    statsd_format: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
+class HistogramOpts:
+    namespace: str = ""
+    subsystem: str = ""
+    name: str = ""
+    help: str = ""
+    label_names: tuple[str, ...] = ()
+    buckets: tuple[float, ...] = (
+        0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10,
+    )
+    statsd_format: str = ""
+
+
+def _fqname(opts) -> str:
+    return "_".join(p for p in (opts.namespace, opts.subsystem, opts.name) if p)
+
+
+def _label_key(
+    label_names: Sequence[str], label_values: Sequence[str]
+) -> tuple[tuple[str, str], ...]:
+    if len(label_values) % 2 == 0 and not label_names:
+        # With("name", "value", ...) pairs form
+        it = iter(label_values)
+        return tuple(sorted(zip(it, it)))
+    raise ValueError("labels must be alternating name/value pairs")
+
+
+class _Metric:
+    """Base: holds per-labelset series."""
+
+    def __init__(self, opts, registry):
+        self.opts = opts
+        self.name = _fqname(opts)
+        self._series: dict[tuple, float] = {}
+        self._lock = threading.Lock()
+        self._labels: tuple[tuple[str, str], ...] = ()
+        if registry is not None:
+            registry._register(self)
+
+    def with_labels(self, *pairs: str) -> "_Metric":
+        c = type(self).__new__(type(self))
+        c.opts = self.opts
+        c.name = self.name
+        c._series = self._series
+        c._lock = self._lock
+        it = iter(pairs)
+        c._labels = tuple(sorted(self._labels + tuple(zip(it, it))))
+        return c
+
+    # go-kit naming
+    With = with_labels
+
+
+class Counter(_Metric):
+    def add(self, delta: float = 1.0) -> None:
+        with self._lock:
+            self._series[self._labels] = (
+                self._series.get(self._labels, 0.0) + delta
+            )
+
+
+class Gauge(_Metric):
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._series[self._labels] = value
+
+    def add(self, delta: float) -> None:
+        with self._lock:
+            self._series[self._labels] = (
+                self._series.get(self._labels, 0.0) + delta
+            )
+
+
+class Histogram(_Metric):
+    def __init__(self, opts, registry):
+        super().__init__(opts, registry)
+        self._obs: dict[tuple, list] = {}
+
+    def with_labels(self, *pairs: str) -> "Histogram":
+        c = super().with_labels(*pairs)
+        c._obs = self._obs
+        return c
+
+    With = with_labels
+
+    def observe(self, value: float) -> None:
+        with self._lock:
+            rec = self._obs.setdefault(
+                self._labels, [0, 0.0, [0] * len(self.opts.buckets)]
+            )
+            rec[0] += 1
+            rec[1] += value
+            for i, b in enumerate(self.opts.buckets):
+                if value <= b:
+                    rec[2][i] += 1
+
+
+class PrometheusRegistry:
+    """Collects metrics and renders the prometheus text format for the
+    operations endpoint."""
+
+    def __init__(self):
+        self._metrics: list[_Metric] = []
+        self._lock = threading.Lock()
+
+    def _register(self, m: _Metric) -> None:
+        with self._lock:
+            self._metrics.append(m)
+
+    @staticmethod
+    def _fmt_labels(labels) -> str:
+        if not labels:
+            return ""
+        inner = ",".join(f'{k}="{v}"' for k, v in labels)
+        return "{" + inner + "}"
+
+    def expose(self) -> str:
+        lines: list[str] = []
+        with self._lock:
+            metrics = list(self._metrics)
+        for m in metrics:
+            kind = (
+                "counter" if isinstance(m, Counter)
+                else "histogram" if isinstance(m, Histogram)
+                else "gauge"
+            )
+            if m.opts.help:
+                lines.append(f"# HELP {m.name} {m.opts.help}")
+            lines.append(f"# TYPE {m.name} {kind}")
+            if isinstance(m, Histogram):
+                for labels, (count, total, buckets) in sorted(
+                    m._obs.items()
+                ):
+                    cum = 0
+                    for b, n in zip(m.opts.buckets, buckets):
+                        cum += n
+                        lb = dict(labels)
+                        lb["le"] = (
+                            f"{b:g}" if not math.isinf(b) else "+Inf"
+                        )
+                        lines.append(
+                            f"{m.name}_bucket"
+                            f"{self._fmt_labels(sorted(lb.items()))} {cum}"
+                        )
+                    inf = dict(labels)
+                    inf["le"] = "+Inf"
+                    lines.append(
+                        f"{m.name}_bucket"
+                        f"{self._fmt_labels(sorted(inf.items()))} {count}"
+                    )
+                    lines.append(
+                        f"{m.name}_sum{self._fmt_labels(labels)} {total:g}"
+                    )
+                    lines.append(
+                        f"{m.name}_count{self._fmt_labels(labels)} {count}"
+                    )
+            else:
+                for labels, v in sorted(m._series.items()):
+                    lines.append(
+                        f"{m.name}{self._fmt_labels(labels)} {v:g}"
+                    )
+        return "\n".join(lines) + "\n"
+
+
+class PrometheusProvider:
+    """Reference prometheus/provider.go: NewCounter/NewGauge/NewHistogram."""
+
+    def __init__(self, registry: PrometheusRegistry | None = None):
+        self.registry = registry or PrometheusRegistry()
+
+    def new_counter(self, opts: CounterOpts) -> Counter:
+        return Counter(opts, self.registry)
+
+    def new_gauge(self, opts: GaugeOpts) -> Gauge:
+        return Gauge(opts, self.registry)
+
+    def new_histogram(self, opts: HistogramOpts) -> Histogram:
+        return Histogram(opts, self.registry)
+
+
+class StatsdProvider:
+    """Emits statsd lines through a supplied `send(line: str)` callable
+    (reference statsd/provider.go; the gokit statsd emitter is replaced by
+    the callable so tests/deployments choose the socket)."""
+
+    def __init__(self, send, prefix: str = ""):
+        self._send = send
+        self._prefix = prefix
+
+    def _name(self, opts, labels=()) -> str:
+        base = _fqname(opts)
+        if self._prefix:
+            base = f"{self._prefix}.{base}"
+        fmt = opts.statsd_format
+        if fmt:
+            for k, v in labels:
+                fmt = fmt.replace("%{" + k + "}", v)
+            return f"{base}.{fmt}" if fmt else base
+        if labels:
+            base += "." + ".".join(v for _, v in labels)
+        return base.replace("_", ".")
+
+    def new_counter(self, opts: CounterOpts):
+        return _StatsdCounter(self, opts)
+
+    def new_gauge(self, opts: GaugeOpts):
+        return _StatsdGauge(self, opts)
+
+    def new_histogram(self, opts: HistogramOpts):
+        return _StatsdHistogram(self, opts)
+
+
+class _StatsdMetric:
+    def __init__(self, provider, opts, labels=()):
+        self._p = provider
+        self.opts = opts
+        self._labels = labels
+
+    def with_labels(self, *pairs):
+        it = iter(pairs)
+        return type(self)(
+            self._p, self.opts, self._labels + tuple(zip(it, it))
+        )
+
+    With = with_labels
+
+
+class _StatsdCounter(_StatsdMetric):
+    def add(self, delta: float = 1.0) -> None:
+        self._p._send(
+            f"{self._p._name(self.opts, self._labels)}:{delta:g}|c"
+        )
+
+
+class _StatsdGauge(_StatsdMetric):
+    def set(self, value: float) -> None:
+        self._p._send(
+            f"{self._p._name(self.opts, self._labels)}:{value:g}|g"
+        )
+
+    def add(self, delta: float) -> None:
+        sign = "+" if delta >= 0 else ""
+        self._p._send(
+            f"{self._p._name(self.opts, self._labels)}:{sign}{delta:g}|g"
+        )
+
+
+class _StatsdHistogram(_StatsdMetric):
+    def observe(self, value: float) -> None:
+        self._p._send(
+            f"{self._p._name(self.opts, self._labels)}:{value:g}|ms"
+        )
+
+
+class DisabledProvider:
+    """No-op provider (reference disabled/provider.go)."""
+
+    def new_counter(self, opts):
+        return _Noop()
+
+    def new_gauge(self, opts):
+        return _Noop()
+
+    def new_histogram(self, opts):
+        return _Noop()
+
+
+class _Noop:
+    def with_labels(self, *p):
+        return self
+
+    With = with_labels
+
+    def add(self, *_):
+        pass
+
+    def set(self, *_):
+        pass
+
+    def observe(self, *_):
+        pass
+
+
+__all__ = [
+    "CounterOpts",
+    "GaugeOpts",
+    "HistogramOpts",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "PrometheusProvider",
+    "PrometheusRegistry",
+    "StatsdProvider",
+    "DisabledProvider",
+]

@@ -1,0 +1,142 @@
+"""Operations HTTP endpoint: /metrics, /healthz, /version, /logspec.
+
+Reference: core/operations/system.go:75-265 — an HTTP server exposing
+prometheus metrics, health checks with registered checkers, the build
+version, and GET/PUT of the runtime log spec (flogging httpadmin).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from fabric_tpu.common import flogging
+from fabric_tpu.common.metrics import (
+    DisabledProvider,
+    PrometheusProvider,
+    StatsdProvider,
+)
+
+VERSION = "0.1.0"
+
+
+class System:
+    """Reference operations.System: owns the metrics provider + server."""
+
+    def __init__(
+        self,
+        listen_address: tuple[str, int] = ("127.0.0.1", 0),
+        provider: str = "prometheus",
+        version: str = VERSION,
+        statsd_send=None,
+    ):
+        self.version = version
+        self._checkers: dict[str, object] = {}
+        self._lock = threading.Lock()
+        if provider == "prometheus":
+            self.metrics_provider = PrometheusProvider()
+            self._registry = self.metrics_provider.registry
+        elif provider == "statsd":
+            self.metrics_provider = StatsdProvider(
+                statsd_send or (lambda line: None)
+            )
+            self._registry = None
+        else:
+            self.metrics_provider = DisabledProvider()
+            self._registry = None
+        system = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # quiet
+                pass
+
+            def _reply(self, code: int, body: bytes, ctype="application/json"):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/metrics":
+                    if system._registry is None:
+                        self._reply(404, b"metrics provider is not prometheus")
+                        return
+                    self._reply(
+                        200,
+                        system._registry.expose().encode(),
+                        "text/plain; version=0.0.4",
+                    )
+                elif self.path == "/healthz":
+                    status, body = system.health()
+                    self._reply(200 if status else 503, json.dumps(body).encode())
+                elif self.path == "/version":
+                    self._reply(
+                        200, json.dumps({"Version": system.version}).encode()
+                    )
+                elif self.path == "/logspec":
+                    self._reply(
+                        200, json.dumps({"spec": flogging.spec()}).encode()
+                    )
+                else:
+                    self._reply(404, b"not found", "text/plain")
+
+            def do_PUT(self):
+                if self.path != "/logspec":
+                    self._reply(404, b"not found", "text/plain")
+                    return
+                length = int(self.headers.get("Content-Length", "0"))
+                try:
+                    payload = json.loads(self.rfile.read(length) or b"{}")
+                    flogging.activate_spec(payload.get("spec", ""))
+                except (ValueError, flogging.LogSpecError) as exc:
+                    self._reply(400, json.dumps({"error": str(exc)}).encode())
+                    return
+                self._reply(204, b"")
+
+            do_POST = do_PUT
+
+        self._server = ThreadingHTTPServer(listen_address, Handler)
+        self._thread: threading.Thread | None = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    @property
+    def addr(self) -> tuple[str, int]:
+        return self._server.server_address
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+    # -- health ------------------------------------------------------------
+
+    def register_checker(self, component: str, checker) -> None:
+        """checker() raises or returns False when unhealthy (reference
+        healthz registered checkers, e.g. couchdb/docker)."""
+        with self._lock:
+            self._checkers[component] = checker
+
+    def health(self) -> tuple[bool, dict]:
+        failed = []
+        with self._lock:
+            checkers = dict(self._checkers)
+        for name, check in checkers.items():
+            try:
+                if check() is False:
+                    failed.append(name)
+            except Exception as exc:
+                failed.append(f"{name}: {exc}")
+        if failed:
+            return False, {"status": "Service Unavailable", "failed_checks": failed}
+        return True, {"status": "OK"}
+
+
+__all__ = ["System", "VERSION"]

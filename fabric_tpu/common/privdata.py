@@ -168,8 +168,11 @@ def static_collection(
     block_to_live: int = 0,
     member_only_read: bool = True,
     member_only_write: bool = True,
+    endorsement_policy=None,
 ) -> collection_pb2.CollectionConfig:
-    """Convenience builder (tests + configtxgen-style tooling)."""
+    """Convenience builder (tests + configtxgen-style tooling);
+    `endorsement_policy` is an optional SignaturePolicyEnvelope gating
+    writes to the collection's keys (StaticCollectionConfig field 8)."""
     from fabric_tpu.policies.signature_policy import signed_by_any_member
 
     conf = collection_pb2.CollectionConfig()
@@ -178,6 +181,8 @@ def static_collection(
     sc.member_orgs_policy.signature_policy.CopyFrom(
         signed_by_any_member(member_mspids)
     )
+    if endorsement_policy is not None:
+        sc.endorsement_policy.signature_policy.CopyFrom(endorsement_policy)
     sc.required_peer_count = required_peer_count
     sc.maximum_peer_count = maximum_peer_count
     sc.block_to_live = block_to_live

@@ -1,0 +1,11 @@
+"""Service discovery (reference discovery/): clients ask a peer for
+channel config, peer membership, and endorsement descriptors (minimal
+endorser sets satisfying a chaincode's endorsement policy)."""
+
+from fabric_tpu.discovery.inquire import satisfaction_sets  # noqa: F401
+from fabric_tpu.discovery.endorsement import (  # noqa: F401
+    PeerInfo,
+    compute_descriptor,
+)
+from fabric_tpu.discovery.service import DiscoveryService  # noqa: F401
+from fabric_tpu.discovery.client import DiscoveryClient  # noqa: F401

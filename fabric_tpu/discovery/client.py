@@ -1,0 +1,86 @@
+"""Discovery client (reference discovery/client/client.go + the `discover`
+CLI's plumbing, cmd/common): build signed requests, parse responses,
+and pick endorsers from a descriptor."""
+
+from __future__ import annotations
+
+import random
+
+from fabric_tpu.protos.discovery import protocol_pb2 as dpb
+
+
+class DiscoveryClient:
+    def __init__(self, signer, send):
+        """signer: object with serialize() and sign(bytes); send:
+        callable(SignedRequest) -> Response (in-proc or network
+        transport)."""
+        self._signer = signer
+        self._send = send
+
+    # -- request building ---------------------------------------------------
+
+    def _request(self, queries: list[dpb.Query]) -> dpb.SignedRequest:
+        req = dpb.Request()
+        req.authentication.client_identity = self._signer.serialize()
+        req.queries.extend(queries)
+        payload = req.SerializeToString()
+        return dpb.SignedRequest(
+            payload=payload, signature=self._signer.sign(payload)
+        )
+
+    def config(self, channel: str) -> dpb.ConfigResult:
+        q = dpb.Query(channel=channel)
+        q.config_query.SetInParent()
+        r = self._one(q)
+        return r.config_result
+
+    def peers(self, channel: str) -> list[dpb.Peer]:
+        q = dpb.Query(channel=channel)
+        q.peer_query.SetInParent()
+        r = self._one(q)
+        return [
+            p
+            for org in r.members.peers_by_org.values()
+            for p in org.peers
+        ]
+
+    def endorsers(
+        self, channel: str, chaincode: str,
+        collections: list[str] | None = None,
+    ) -> dpb.EndorsementDescriptor:
+        q = dpb.Query(channel=channel)
+        call = q.cc_query.interests.add().chaincodes.add()
+        call.name = chaincode
+        call.collection_names.extend(collections or [])
+        r = self._one(q)
+        return r.cc_query_res.content[0]
+
+    def _one(self, q: dpb.Query) -> dpb.QueryResult:
+        res = self._send(self._request([q]))
+        r = res.results[0]
+        if r.WhichOneof("result") == "error":
+            raise RuntimeError(r.error.content)
+        return r
+
+
+def select_endorsers(
+    desc: dpb.EndorsementDescriptor, rng: random.Random | None = None
+) -> list[dpb.Peer]:
+    """Pick concrete endorsers for one (random) layout — highest ledger
+    height first within each group (the reference's default exclusion/
+    priority selector)."""
+    rng = rng or random.Random()
+    layout = desc.layouts[rng.randrange(len(desc.layouts))]
+    chosen: list[dpb.Peer] = []
+    for group, quantity in sorted(layout.quantities_by_group.items()):
+        peers = sorted(
+            desc.endorsers_by_groups[group].peers,
+            key=lambda p: -p.ledger_height,
+        )
+        if len(peers) < quantity:
+            raise RuntimeError(f"group {group}: not enough peers")
+        chosen.extend(peers[:quantity])
+    return chosen
+
+
+__all__ = ["DiscoveryClient", "select_endorsers"]

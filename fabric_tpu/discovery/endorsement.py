@@ -1,0 +1,101 @@
+"""Endorsement-descriptor computation.
+
+Reference: discovery/endorsement/endorsement.go:164 (endorsementAnalyzer)
+and :424-470 — build a bipartite principal<->peer mapping, enumerate the
+policy's principal satisfaction sets (inquire), intersect with live
+membership, and emit layouts: per satisfaction set, how many endorsements
+are needed from each principal-group of peers.
+
+Collection filtering: when the call touches collections, a peer must be a
+member of EVERY named collection to endorse (reference
+principalsFromCollectionConfig)."""
+
+from __future__ import annotations
+
+import dataclasses
+
+from fabric_tpu.discovery.inquire import satisfaction_sets
+from fabric_tpu.protos.discovery import protocol_pb2 as dpb
+
+
+@dataclasses.dataclass
+class PeerInfo:
+    endpoint: str
+    identity: bytes  # serialized identity
+    mspid: str
+    ledger_height: int = 0
+    chaincodes: tuple[str, ...] = ()
+
+
+def _peers_for_principal(principal, peers, deserializer):
+    """Endpoints of peers whose identity satisfies the principal."""
+    out = []
+    for p in peers:
+        try:
+            ident = deserializer.deserialize_identity(p.identity)
+            deserializer.satisfies_principal(ident, principal)
+        except Exception:
+            continue
+        out.append(p)
+    return out
+
+
+def compute_descriptor(
+    chaincode: str,
+    policy_envelope,
+    peers: list[PeerInfo],
+    deserializer,
+    collection_filter=None,  # callable(peer) -> bool, pre-filters peers
+) -> dpb.EndorsementDescriptor:
+    """Build the EndorsementDescriptor (groups + layouts) or raise
+    ValueError when no layout is satisfiable by live peers."""
+    if collection_filter is not None:
+        peers = [p for p in peers if collection_filter(p)]
+    principals = list(policy_envelope.identities)
+    sets = satisfaction_sets(policy_envelope)
+    if not sets:
+        raise ValueError(f"policy of {chaincode} has no satisfaction sets")
+
+    # group per principal index: Gk -> peers satisfying principal k
+    group_peers: dict[int, list[PeerInfo]] = {
+        k: _peers_for_principal(principals[k], peers, deserializer)
+        for k in range(len(principals))
+    }
+
+    desc = dpb.EndorsementDescriptor(chaincode=chaincode)
+    used_groups: set[int] = set()
+    n_layouts = 0
+    for s in sets:
+        # quantity per principal in this satisfaction set
+        quantities: dict[int, int] = {}
+        for idx in s:
+            quantities[idx] = quantities.get(idx, 0) + 1
+        # feasible only if each group has enough live peers
+        if any(
+            len(group_peers.get(idx, [])) < q
+            for idx, q in quantities.items()
+        ):
+            continue
+        layout = desc.layouts.add()
+        for idx, q in quantities.items():
+            layout.quantities_by_group[f"G{idx}"] = q
+            used_groups.add(idx)
+        n_layouts += 1
+    if n_layouts == 0:
+        raise ValueError(
+            f"no endorsement layout of {chaincode} is satisfiable by the "
+            "current membership"
+        )
+    for idx in sorted(used_groups):
+        grp = desc.endorsers_by_groups[f"G{idx}"]
+        for p in group_peers[idx]:
+            grp.peers.add(
+                identity=p.identity,
+                endpoint=p.endpoint,
+                ledger_height=p.ledger_height,
+                chaincodes=list(p.chaincodes),
+            )
+    return desc
+
+
+__all__ = ["PeerInfo", "compute_descriptor"]

@@ -1,0 +1,59 @@
+"""Signature-policy introspection: enumerate the principal combinations
+that satisfy a policy.
+
+Reference: common/policies/inquire — converts a SignaturePolicyEnvelope
+into "principal sets" consumed by the discovery endorsement computation
+(discovery/endorsement/endorsement.go:424-470).
+
+A satisfaction set is a multiset of principal indices (into
+envelope.identities); the policy passes when, for some set, each listed
+principal signs.  The enumeration walks the NOutOf tree and combines
+children; output is capped to avoid combinatorial blowup on adversarial
+policies (the reference caps layouts similarly).
+"""
+
+from __future__ import annotations
+
+import itertools
+
+from fabric_tpu.protos.common import policies_pb2
+
+MAX_SETS = 1024
+
+
+def satisfaction_sets(
+    envelope: policies_pb2.SignaturePolicyEnvelope,
+) -> list[tuple[int, ...]]:
+    """All minimal principal-index combinations satisfying the policy,
+    each sorted; globally capped at MAX_SETS."""
+    sets = _walk(envelope.rule)
+    uniq = sorted({tuple(sorted(s)) for s in sets})
+    return uniq[:MAX_SETS]
+
+
+def _walk(rule: policies_pb2.SignaturePolicy) -> list[tuple[int, ...]]:
+    which = rule.WhichOneof("Type")
+    if which == "signed_by":
+        return [(rule.signed_by,)]
+    if which != "n_out_of":
+        return []
+    n = rule.n_out_of.n
+    children = [_walk(r) for r in rule.n_out_of.rules]
+    if n <= 0:
+        return [()]
+    if n > len(children):
+        return []
+    out: list[tuple[int, ...]] = []
+    for combo in itertools.combinations(range(len(children)), n):
+        # cartesian product of the chosen children's sets
+        for pick in itertools.product(*(children[i] for i in combo)):
+            merged: tuple[int, ...] = tuple(
+                idx for s in pick for idx in s
+            )
+            out.append(merged)
+            if len(out) >= MAX_SETS * 4:
+                return out
+    return out
+
+
+__all__ = ["satisfaction_sets", "MAX_SETS"]

@@ -1,0 +1,171 @@
+"""Discovery service (reference discovery/service.go:67-135).
+
+Processes a SignedRequest: authenticates the caller (valid channel
+identity + channel Readers ACL), then answers each query:
+
+- ConfigQuery: channel MSP configs + orderer endpoints
+- PeerMembershipQuery: live peers by org
+- ChaincodeQuery: endorsement descriptors per interest
+- LocalPeerQuery: channel-less membership
+
+Results are memoized per (identity, request-shape) through a small auth
+cache like the reference's (discovery/authcache.go).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+
+from fabric_tpu.discovery.endorsement import PeerInfo, compute_descriptor
+from fabric_tpu.protos.discovery import protocol_pb2 as dpb
+from fabric_tpu.protoutil.common import SignedData
+
+
+class DiscoveryError(Exception):
+    pass
+
+
+class DiscoverySupport:
+    """Everything the service needs from the peer, injected (reference
+    discovery/support/).  Callables keep the service decoupled:
+
+    - channels() -> list[str]
+    - bundle(channel) -> channelconfig Bundle (msp_manager, policy_manager)
+    - peers(channel) -> list[PeerInfo]
+    - msp_configs(channel) -> {mspid: serialized MSPConfig}
+    - orderer_endpoints(channel) -> {mspid: [(host, port)]}
+    - chaincode_policy(channel, cc_name) -> SignaturePolicyEnvelope | None
+    - collection_filter(channel, cc, collections) -> callable(PeerInfo)->bool
+    - acl_check(channel, signed_data) raises on denial
+    """
+
+    def __init__(self, **kw):
+        self.__dict__.update(kw)
+
+
+class DiscoveryService:
+    def __init__(self, support: DiscoverySupport, csp,
+                 auth_cache_size: int = 1000):
+        self._support = support
+        self._csp = csp
+        self._auth_cache: dict[bytes, bool] = {}
+        self._lock = threading.Lock()
+        self._cache_size = auth_cache_size
+
+    # -- authentication ------------------------------------------------------
+
+    def _authenticate(self, signed: dpb.SignedRequest,
+                      req: dpb.Request, channel: str) -> None:
+        ident_bytes = bytes(req.authentication.client_identity)
+        if not ident_bytes:
+            raise DiscoveryError("access denied: no client identity")
+        key = hashlib.sha256(
+            channel.encode() + b"\x00" + ident_bytes + b"\x00"
+            + bytes(signed.signature) + bytes(signed.payload)
+        ).digest()
+        with self._lock:
+            cached = self._auth_cache.get(key)
+        if cached is True:
+            return
+        if cached is False:
+            raise DiscoveryError("access denied")
+        ok = False
+        try:
+            bundle = self._support.bundle(channel)
+            ident = bundle.msp_manager.deserialize_identity(ident_bytes)
+            bundle.msp_manager.validate(ident)
+            sd = SignedData(
+                data=bytes(signed.payload),
+                identity=ident_bytes,
+                signature=bytes(signed.signature),
+            )
+            self._support.acl_check(channel, sd)
+            ok = True
+        except Exception as exc:
+            raise DiscoveryError(f"access denied: {exc}") from exc
+        finally:
+            with self._lock:
+                if len(self._auth_cache) >= self._cache_size:
+                    self._auth_cache.clear()
+                self._auth_cache[key] = ok
+
+    # -- processing ----------------------------------------------------------
+
+    def process(self, signed: dpb.SignedRequest) -> dpb.Response:
+        res = dpb.Response()
+        try:
+            req = dpb.Request.FromString(signed.payload)
+        except Exception:
+            r = res.results.add()
+            r.error.content = "malformed request"
+            return res
+        for q in req.queries:
+            out = res.results.add()
+            try:
+                which = q.WhichOneof("query")
+                if which in ("config_query", "peer_query", "cc_query"):
+                    if q.channel not in self._support.channels():
+                        raise DiscoveryError(
+                            f"access denied: unknown channel {q.channel!r}"
+                        )
+                    self._authenticate(signed, req, q.channel)
+                if which == "config_query":
+                    self._config(q.channel, out)
+                elif which == "peer_query":
+                    self._members(q.channel, out)
+                elif which == "cc_query":
+                    self._endorsers(q.channel, q.cc_query, out)
+                elif which == "local_peers":
+                    self._members("", out)
+                else:
+                    raise DiscoveryError("unknown query type")
+            except Exception as exc:
+                out.error.content = str(exc)
+        return res
+
+    def _config(self, channel: str, out) -> None:
+        for mspid, conf in self._support.msp_configs(channel).items():
+            out.config_result.msps[mspid] = conf
+        for mspid, eps in self._support.orderer_endpoints(channel).items():
+            entry = out.config_result.orderers[mspid]
+            for host, port in eps:
+                entry.endpoint.add(host=host, port=port)
+
+    def _members(self, channel: str, out) -> None:
+        for p in self._support.peers(channel):
+            out.members.peers_by_org[p.mspid].peers.add(
+                identity=p.identity,
+                endpoint=p.endpoint,
+                ledger_height=p.ledger_height,
+                chaincodes=list(p.chaincodes),
+            )
+
+    def _endorsers(self, channel: str, cc_query, out) -> None:
+        bundle = self._support.bundle(channel)
+        peers = self._support.peers(channel)
+        for interest in cc_query.interests:
+            if not interest.chaincodes:
+                raise DiscoveryError("empty chaincode interest")
+            # Multi-chaincode interests (cc2cc) require satisfying every
+            # called chaincode's policy; descriptor per call like the
+            # reference.
+            for call in interest.chaincodes:
+                pol = self._support.chaincode_policy(channel, call.name)
+                if pol is None:
+                    raise DiscoveryError(
+                        f"no endorsement policy for {call.name!r}"
+                    )
+                cfilter = None
+                if call.collection_names:
+                    cfilter = self._support.collection_filter(
+                        channel, call.name, list(call.collection_names)
+                    )
+                desc = compute_descriptor(
+                    call.name, pol, peers, bundle.msp_manager,
+                    collection_filter=cfilter,
+                )
+                out.cc_query_res.content.append(desc)
+
+
+__all__ = ["DiscoveryService", "DiscoverySupport", "DiscoveryError", "PeerInfo"]

@@ -230,6 +230,9 @@ class KVLedger:
     def get_private_data_hash(self, ns: str, coll: str, key: str):
         return self.new_query_executor().get_private_data_hash(ns, coll, key)
 
+    def get_state_metadata(self, ns: str, key: str) -> dict[str, bytes]:
+        return self.new_query_executor().get_state_metadata(ns, key)
+
     def get_history_for_key(self, ns: str, key: str):
         return self._history.get_history_for_key(ns, key)
 
@@ -263,6 +266,14 @@ class QueryExecutor:
     def get_private_data_hash(self, ns: str, coll: str, key: str):
         vv = self._state.get_state(hash_ns(ns, coll), key_hash(key).hex())
         return vv.value if vv else None
+
+    def get_state_metadata(self, ns: str, key: str) -> dict[str, bytes]:
+        """Decoded metadata entries of a key, matching the simulator's
+        get_state_metadata; `ns` may be a derived hashed namespace."""
+        from fabric_tpu.ledger.txmgmt import decode_metadata
+
+        vv = self._state.get_state(ns, key)
+        return decode_metadata(vv.metadata) if vv else {}
 
     def done(self) -> None:
         pass

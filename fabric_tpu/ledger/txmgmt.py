@@ -47,6 +47,30 @@ def value_hash(value: bytes) -> bytes:
     return hashlib.sha256(value).digest()
 
 
+# State metadata is a named-entry map; the key-level endorsement policy
+# lives under the entry name VALIDATION_PARAMETER (reference
+# core/ledger/kvledger/txmgmt/statemetadata + pkg/statebased).
+VALIDATION_PARAMETER = "VALIDATION_PARAMETER"
+
+
+def encode_metadata(entries: dict[str, bytes]) -> bytes:
+    from fabric_tpu.protos.peer import chaincode_shim_pb2 as _shim
+
+    res = _shim.StateMetadataResult()
+    for name in sorted(entries):
+        res.entries.add(metakey=name, value=entries[name])
+    return res.SerializeToString()
+
+
+def decode_metadata(raw: bytes) -> dict[str, bytes]:
+    from fabric_tpu.protos.peer import chaincode_shim_pb2 as _shim
+
+    if not raw:
+        return {}
+    res = _shim.StateMetadataResult.FromString(raw)
+    return {e.metakey: bytes(e.value) for e in res.entries}
+
+
 def _version_proto(h: Height | None):
     if h is None:
         return None
@@ -75,6 +99,13 @@ class TxSimulator:
         # (distributed separately via the transient store / gossip).
         self._pvt_reads: dict[tuple[str, str, str], Height | None] = {}
         self._pvt_writes: dict[tuple[str, str, str], bytes | None] = {}
+        # Metadata writes: full-entry-map replacement per key (reference
+        # SetStateMetadata semantics are per-entry; we merge at write time
+        # against the committed map so the rwset carries the final map).
+        self._meta_writes: dict[tuple[str, str], dict[str, bytes]] = {}
+        self._pvt_meta_writes: dict[
+            tuple[str, str, str], dict[str, bytes]
+        ] = {}
         self._done = False
 
     def get_state(self, ns: str, key: str) -> bytes | None:
@@ -89,6 +120,44 @@ class TxSimulator:
 
     def delete_state(self, ns: str, key: str) -> None:
         self._writes[(ns, key)] = None
+
+    def get_state_metadata(self, ns: str, key: str) -> dict[str, bytes]:
+        """Committed metadata entries of a key (reference
+        GetStateMetadata); records NO read — metadata is validated by the
+        key-level validator, not MVCC."""
+        if (ns, key) in self._meta_writes:
+            return dict(self._meta_writes[(ns, key)])
+        vv = self._db.get_state(ns, key)
+        return decode_metadata(vv.metadata) if vv else {}
+
+    def set_state_metadata(
+        self, ns: str, key: str, entries: dict[str, bytes]
+    ) -> None:
+        """Merge entries into the key's metadata (reference
+        SetStateMetadata is per-entry upsert)."""
+        cur = self.get_state_metadata(ns, key)
+        cur.update(entries)
+        self._meta_writes[(ns, key)] = cur
+
+    def delete_state_metadata(self, ns: str, key: str, name: str) -> None:
+        cur = self.get_state_metadata(ns, key)
+        cur.pop(name, None)
+        self._meta_writes[(ns, key)] = cur
+
+    def get_private_data_metadata(
+        self, ns: str, coll: str, key: str
+    ) -> dict[str, bytes]:
+        if (ns, coll, key) in self._pvt_meta_writes:
+            return dict(self._pvt_meta_writes[(ns, coll, key)])
+        vv = self._db.get_state(hash_ns(ns, coll), key_hash(key).hex())
+        return decode_metadata(vv.metadata) if vv else {}
+
+    def set_private_data_metadata(
+        self, ns: str, coll: str, key: str, entries: dict[str, bytes]
+    ) -> None:
+        cur = self.get_private_data_metadata(ns, coll, key)
+        cur.update(entries)
+        self._pvt_meta_writes[(ns, coll, key)] = cur
 
     def get_private_data(self, ns: str, coll: str, key: str) -> bytes | None:
         if (ns, coll, key) in self._pvt_writes:
@@ -173,6 +242,11 @@ class TxSimulator:
                     key=key, is_delete=value is None, value=value or b""
                 )
             )
+        for (ns, key), entries in sorted(self._meta_writes.items()):
+            mw = kv_rwset_pb2.KVMetadataWrite(key=key)
+            for name in sorted(entries):
+                mw.entries.add(name=name, value=entries[name])
+            ns_set(ns).metadata_writes.append(mw)
 
         # Hashed r/w sets per (ns, collection).
         hashed: dict[tuple[str, str], kv_rwset_pb2.HashedRWSet] = {}
@@ -194,6 +268,13 @@ class TxSimulator:
                     value_hash=value_hash(value) if value is not None else b"",
                 )
             )
+        for (ns, coll, key), entries in sorted(
+            self._pvt_meta_writes.items()
+        ):
+            mw = kv_rwset_pb2.KVMetadataWriteHash(key_hash=key_hash(key))
+            for name in sorted(entries):
+                mw.entries.add(name=name, value=entries[name])
+            coll_set(ns, coll).metadata_writes.append(mw)
 
         pvt = self._pvt_collection_rwsets()
         namespaces = sorted(
@@ -357,7 +438,19 @@ class MVCCValidator:
                         ns_batch[w.key] = None
                         updated_versions[(ns, w.key)] = None  # type: ignore[assignment]
                     else:
-                        ns_batch[w.key] = VersionedValue(w.value, h)
+                        # A value-only write RETAINS existing metadata
+                        # (key-level endorsement policies survive plain
+                        # puts — reference tx_ops metadata merge).
+                        ns_batch[w.key] = VersionedValue(
+                            w.value, h,
+                            self._existing_metadata(ns, w.key, ns_batch),
+                        )
+                for mw in kvrw.metadata_writes:
+                    self._apply_metadata_write(
+                        ns, mw.key,
+                        {e.name: bytes(e.value) for e in mw.entries},
+                        ns_batch, updated_versions, h,
+                    )
                 for coll, hrw, expected_hash in colls:
                     hns = hash_ns(ns, coll)
                     h_batch = batch.setdefault(hns, {})
@@ -368,9 +461,16 @@ class MVCCValidator:
                             updated_versions[(hns, hkey)] = None  # type: ignore[assignment]
                         else:
                             h_batch[hkey] = VersionedValue(
-                                bytes(hw.value_hash), h
+                                bytes(hw.value_hash), h,
+                                self._existing_metadata(hns, hkey, h_batch),
                             )
                             updated_versions[(hns, hkey)] = h
+                    for mw in hrw.metadata_writes:
+                        self._apply_metadata_write(
+                            hns, bytes(mw.key_hash).hex(),
+                            {e.name: bytes(e.value) for e in mw.entries},
+                            h_batch, updated_versions, h,
+                        )
                     # Cleartext private writes, if supplied and authentic.
                     # An empty endorsed hash means NO cleartext rwset was
                     # endorsed (read-only collection access) — any supply
@@ -391,6 +491,34 @@ class MVCCValidator:
                         else:
                             p_batch[w.key] = VersionedValue(w.value, h)
         return batch
+
+    def _existing_metadata(self, ns: str, key: str, ns_batch: dict) -> bytes:
+        """Current metadata of a key: in-block overlay first, then
+        committed state; empty for new/deleted keys."""
+        if key in ns_batch:
+            base = ns_batch[key]
+            return base.metadata if base is not None else b""
+        vv = self._db.get_state(ns, key)
+        return vv.metadata if vv is not None else b""
+
+    def _apply_metadata_write(
+        self, ns: str, key: str, entries: dict[str, bytes],
+        ns_batch: dict, updated_versions: dict, h: Height,
+    ) -> None:
+        """Replace a key's metadata map, keeping its value; a metadata
+        write on a non-existent/deleted key is a no-op (reference
+        statemetadata semantics)."""
+        if key in ns_batch:
+            base = ns_batch[key]
+            if base is None:
+                return
+            ns_batch[key] = VersionedValue(base.value, h, encode_metadata(entries))
+        else:
+            vv = self._db.get_state(ns, key)
+            if vv is None:
+                return
+            ns_batch[key] = VersionedValue(vv.value, h, encode_metadata(entries))
+        updated_versions[(ns, key)] = h
 
     @staticmethod
     def _parse_pvt(raw: bytes | None):
@@ -449,4 +577,7 @@ __all__ = [
     "hash_ns",
     "key_hash",
     "value_hash",
+    "VALIDATION_PARAMETER",
+    "encode_metadata",
+    "decode_metadata",
 ]

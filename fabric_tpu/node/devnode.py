@@ -39,6 +39,7 @@ class DevNode:
         peer_signer=None,
         chaincodes: dict | None = None,
         batch_timeout_s: float | None = None,
+        definition_provider=None,
     ):
         self.csp = csp or csp_factory.get_default()
         self.bundle = bundle_from_genesis(genesis, self.csp)
@@ -48,7 +49,8 @@ class DevNode:
         self.provider = LedgerProvider(root_dir)
         self.ledger = self.provider.create(genesis)
         self.validator = TxValidator(
-            self.channel_id, self.ledger, self.bundle, self.csp
+            self.channel_id, self.ledger, self.bundle, self.csp,
+            definition_provider=definition_provider,
         )
         self.committer = Committer(self.validator, self.ledger)
         self.endorser = (

@@ -1,0 +1,120 @@
+"""ACL management: resource name -> policy evaluation at API entry.
+
+Reference: core/aclmgmt — aclmgmt.go:15 ACLProvider, resources.go (the
+resource-name catalog), defaultaclprovider.go (defaults mapping each
+resource to /Channel/Application/{Readers,Writers,Admins}, with local
+MSP fallbacks for channel-less resources), resourceprovider.go (config
+overrides via the channel's ACLs config value).
+
+`check_acl(resource, channel_policy_manager, signed_data)` raises
+ACLError when the policy is not satisfied.
+"""
+
+from __future__ import annotations
+
+from fabric_tpu.protos.peer import configuration_pb2 as peer_configuration_pb2
+
+
+class ACLError(Exception):
+    pass
+
+
+# Resource names (reference resources.go).
+LSCC_GET_CC_DATA = "lscc/GetChaincodeData"
+LSCC_GET_CHAINCODES = "lscc/GetInstantiatedChaincodes"
+QSCC_GET_CHAIN_INFO = "qscc/GetChainInfo"
+QSCC_GET_BLOCK_BY_NUMBER = "qscc/GetBlockByNumber"
+QSCC_GET_BLOCK_BY_HASH = "qscc/GetBlockByHash"
+QSCC_GET_TX_BY_ID = "qscc/GetTransactionByID"
+QSCC_GET_BLOCK_BY_TX_ID = "qscc/GetBlockByTxID"
+CSCC_GET_CONFIG_BLOCK = "cscc/GetConfigBlock"
+CSCC_GET_CHANNEL_CONFIG = "cscc/GetChannelConfig"
+CSCC_JOIN_CHAIN = "cscc/JoinChain"
+CSCC_GET_CHANNELS = "cscc/GetChannels"
+LIFECYCLE_INSTALL = "_lifecycle/InstallChaincode"
+LIFECYCLE_QUERY_INSTALLED = "_lifecycle/QueryInstalledChaincodes"
+LIFECYCLE_APPROVE = "_lifecycle/ApproveChaincodeDefinitionForMyOrg"
+LIFECYCLE_COMMIT = "_lifecycle/CommitChaincodeDefinition"
+LIFECYCLE_CHECK_READINESS = "_lifecycle/CheckCommitReadiness"
+LIFECYCLE_QUERY_COMMITTED = "_lifecycle/QueryChaincodeDefinition"
+PEER_PROPOSE = "peer/Propose"
+PEER_CC2CC = "peer/ChaincodeToChaincode"
+EVENT_BLOCK = "event/Block"
+EVENT_FILTERED_BLOCK = "event/FilteredBlock"
+GOSSIP_PRIVATE_DATA = "gossip/PrivateData"
+
+_READERS = "/Channel/Application/Readers"
+_WRITERS = "/Channel/Application/Writers"
+_ADMINS = "/Channel/Application/Admins"
+
+DEFAULT_POLICIES: dict[str, str] = {
+    LSCC_GET_CC_DATA: _READERS,
+    LSCC_GET_CHAINCODES: _READERS,
+    QSCC_GET_CHAIN_INFO: _READERS,
+    QSCC_GET_BLOCK_BY_NUMBER: _READERS,
+    QSCC_GET_BLOCK_BY_HASH: _READERS,
+    QSCC_GET_TX_BY_ID: _READERS,
+    QSCC_GET_BLOCK_BY_TX_ID: _READERS,
+    CSCC_GET_CONFIG_BLOCK: _READERS,
+    CSCC_GET_CHANNEL_CONFIG: _READERS,
+    CSCC_GET_CHANNELS: _READERS,  # channel-less in practice
+    CSCC_JOIN_CHAIN: _ADMINS,  # local admin in the reference
+    LIFECYCLE_INSTALL: _ADMINS,
+    LIFECYCLE_QUERY_INSTALLED: _ADMINS,
+    LIFECYCLE_APPROVE: _WRITERS,
+    LIFECYCLE_COMMIT: _WRITERS,
+    LIFECYCLE_CHECK_READINESS: _WRITERS,
+    LIFECYCLE_QUERY_COMMITTED: _READERS,
+    PEER_PROPOSE: _WRITERS,
+    PEER_CC2CC: _WRITERS,
+    EVENT_BLOCK: _READERS,
+    EVENT_FILTERED_BLOCK: _READERS,
+    GOSSIP_PRIVATE_DATA: _READERS,
+}
+
+
+class ACLProvider:
+    """Evaluates resource ACLs against a channel's policy manager, with
+    per-channel overrides from the ACLs config value (reference
+    resourceprovider.go wrapping defaultaclprovider.go)."""
+
+    def __init__(self, overrides: dict[str, str] | None = None,
+                 csp=None):
+        self._overrides = dict(overrides or {})
+        self._csp = csp
+
+    @classmethod
+    def from_acls_config(cls, raw: bytes, csp=None) -> "ACLProvider":
+        """Parse a peer.ACLs config value (peer/configuration.proto)."""
+        acls = peer_configuration_pb2.ACLs.FromString(raw)
+        return cls(
+            {name: a.policy_ref for name, a in acls.acls.items()}, csp=csp
+        )
+
+    def policy_ref(self, resource: str) -> str:
+        ref = self._overrides.get(resource) or DEFAULT_POLICIES.get(resource)
+        if ref is None:
+            raise ACLError(f"no ACL policy for resource {resource!r}")
+        return ref
+
+    def check_acl(
+        self, resource: str, policy_manager, signed_data
+    ) -> None:
+        """Raise ACLError unless the resource's policy passes (reference
+        aclmgmt CheckACL)."""
+        ref = self.policy_ref(resource)
+        pol = policy_manager.get_policy(ref)
+        if not pol.evaluate_signed_data(
+            signed_data if isinstance(signed_data, list) else [signed_data],
+            self._csp,
+        ):
+            raise ACLError(
+                f"access denied: resource {resource!r} requires {ref!r}"
+            )
+
+
+__all__ = [
+    "ACLProvider",
+    "ACLError",
+    "DEFAULT_POLICIES",
+]

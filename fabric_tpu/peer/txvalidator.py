@@ -26,8 +26,16 @@ from __future__ import annotations
 
 import dataclasses
 
+from fabric_tpu.peer.validation_plugins import (
+    IllegalWritesetError,
+    PluginRegistry,
+    PolicyProvider,
+    ValidationContext,
+    parse_footprint,
+)
 from fabric_tpu.protos.common import common_pb2
 from fabric_tpu.protos.peer import (
+    chaincode_event_pb2,
     proposal_pb2,
     proposal_response_pb2,
     transaction_pb2,
@@ -40,29 +48,62 @@ V = transaction_pb2
 
 @dataclasses.dataclass
 class _TxWork:
-    """Per-tx deferred crypto: creator item index + policy pendings."""
+    """Per-tx deferred crypto: creator item index + per-namespace plugin
+    pendings, plus the state-metadata footprint for key-level
+    endorsement conflict detection."""
 
     creator_item: int | None = None
-    pendings: list = dataclasses.field(default_factory=list)  # (PendingEvaluation, slice)
+    pendings: list = dataclasses.field(default_factory=list)
+    # [(PendingValidation, (start, end))] — one per written namespace
+    touched_keys: frozenset = frozenset()  # {(ns_or_hashns, key)}
+    meta_keys: frozenset = frozenset()
+    # keys whose VALIDATION_PARAMETER this tx rewrites; once the tx is
+    # VALID, later in-block txs touching them are invalidated
 
 
 class TxValidator:
     """Reference TxValidator.Validate equivalent; `Validate` mutates the
-    block's TRANSACTIONS_FILTER metadata like the reference does."""
+    block's TRANSACTIONS_FILTER metadata like the reference does.
 
-    def __init__(self, channel_id: str, ledger, bundle, csp, endorsement_policy=None):
-        """endorsement_policy: callable(chaincode_name) -> policy object
-        (two-phase protocol).  Defaults to the channel's
-        /Channel/Application/Endorsement policy — the v2.0 default when a
-        chaincode defines none (reference builtin v20 + lifecycle)."""
+    Endorsement checking dispatches through the validation-plugin
+    registry once per written namespace (reference plugindispatcher
+    dispatcher.go:190 validates *each* written namespace against its own
+    chaincode's plugin and policy); the builtin plugin implements
+    chaincode-level, collection-level, and key-level (state-based)
+    endorsement.  A tx touching a key whose VALIDATION_PARAMETER an
+    earlier VALID tx in the same block rewrote is invalidated, exactly
+    like the reference's ValidationParameterUpdatedError
+    (statebased/vpmanagerimpl.go:219, validator_keylevel.go:45)."""
+
+    def __init__(
+        self,
+        channel_id: str,
+        ledger,
+        bundle,
+        csp,
+        definition_provider=None,
+        plugin_registry: PluginRegistry | None = None,
+    ):
         self.channel_id = channel_id
         self._ledger = ledger
         self._bundle = bundle
         self._csp = csp
-        if endorsement_policy is None:
-            default_pol = bundle.policy_manager.get_policy("/Channel/Application/Endorsement")
-            endorsement_policy = lambda cc: default_pol  # noqa: E731
-        self._endorsement_policy = endorsement_policy
+        self._definitions = definition_provider
+        self._registry = plugin_registry or PluginRegistry()
+        self._policy_provider = PolicyProvider(
+            bundle.policy_manager, bundle.msp_manager, definition_provider
+        )
+
+    def _committed_metadata(self, ns: str, key: str) -> dict[str, bytes]:
+        return self._ledger.get_state_metadata(ns, key)
+
+    def _plugin_for(self, namespace: str):
+        name = "vscc"
+        if self._definitions is not None:
+            info = self._definitions.validation_info(namespace)
+            if info is not None:
+                name = info[0] or "vscc"
+        return self._registry.plugin(name)
 
     # -- phase 1: per-tx syntactic validation + collection ----------------
 
@@ -127,16 +168,70 @@ class TxValidator:
         if not cap.action.endorsements:
             return V.ENDORSEMENT_POLICY_FAILURE
 
+        # chaincode-id consistency: header extension vs ChaincodeAction
+        # (reference dispatcher.go:129-157)
+        try:
+            hdr_ext = proposal_pb2.ChaincodeHeaderExtension.FromString(
+                chdr.extension
+            )
+        except Exception:
+            return V.BAD_HEADER_EXTENSION
+        cc_id = hdr_ext.chaincode_id.name
+        if not cc_id:
+            return V.INVALID_CHAINCODE
+        if action.chaincode_id.name != cc_id:
+            return V.INVALID_CHAINCODE
+        # a chaincode event must name the invoked chaincode
+        # (dispatcher.go:161-169)
+        if action.events:
+            try:
+                ev = chaincode_event_pb2.ChaincodeEvent.FromString(
+                    action.events
+                )
+            except Exception:
+                return V.INVALID_OTHER_REASON
+            if ev.chaincode_id != cc_id:
+                return V.INVALID_OTHER_REASON
+
         # endorsement policy: each endorsement signs prp_bytes || endorser
         signed = [
             SignedData(prp_bytes + e.endorser, e.endorser, e.signature)
             for e in cap.action.endorsements
         ]
-        policy = self._endorsement_policy(action.chaincode_id.name)
-        pending = policy.prepare(signed)
-        start = len(items)
-        items.extend(pending.items)
-        work.pendings.append((pending, (start, len(items))))
+        try:
+            footprint = parse_footprint(bytes(action.results))
+        except IllegalWritesetError:
+            return V.ILLEGAL_WRITESET
+        except Exception:
+            return V.BAD_RWSET
+
+        # validate EACH written namespace against its own chaincode's
+        # plugin + policy (dispatcher.go:158-218 wrNamespace loop)
+        namespaces = [cc_id] + [
+            ns
+            for ns, entry in footprint.per_ns.items()
+            if entry["writes"] and ns != cc_id
+        ]
+        for ns in namespaces:
+            ctx = ValidationContext(
+                channel_id=self.channel_id,
+                namespace=ns,
+                tx_pos=-1,
+                endorsements=signed,
+                rwset_bytes=bytes(action.results),
+                policy_provider=self._policy_provider,
+                state_metadata=self._committed_metadata,
+                footprint=footprint,
+            )
+            try:
+                pending = self._plugin_for(ns).prepare(ctx)
+            except Exception:
+                return V.INVALID_OTHER_REASON
+            start = len(items)
+            items.extend(pending.items)
+            work.pendings.append((pending, (start, len(items))))
+        work.touched_keys = footprint.touched
+        work.meta_keys = frozenset(footprint.meta_writes)
         return V.VALID
 
     # -- the three-phase validate -----------------------------------------
@@ -154,7 +249,16 @@ class TxValidator:
         # phase 2: one device call for the whole block
         mask = self._csp.verify_batch(items) if items else []
 
-        # phase 3: apply per-tx results
+        # phase 3: in-order finish.  All policy evaluations read the
+        # COMMITTED (pre-block) metadata — the reference does the same,
+        # since GetValidationParameterForKey fetches from the ledger
+        # before the block lands (vpmanagerimpl.go:293-340).  The only
+        # in-block interaction: a tx touching a key whose
+        # VALIDATION_PARAMETER an earlier VALID tx rewrote is invalidated
+        # (ValidationParameterUpdatedError -> policyErr ->
+        # ENDORSEMENT_POLICY_FAILURE, never re-evaluated under the new
+        # policy).
+        updated: set[tuple[str, str]] = set()
         for i in range(n):
             if flags[i] != V.VALID:
                 continue
@@ -162,10 +266,17 @@ class TxValidator:
             if w.creator_item is not None and not mask[w.creator_item]:
                 flags[i] = V.BAD_CREATOR_SIGNATURE
                 continue
-            for pending, (start, end) in w.pendings:
-                if not pending.finish(mask[start:end]):
-                    flags[i] = V.ENDORSEMENT_POLICY_FAILURE
-                    break
+            if w.touched_keys & updated:
+                flags[i] = V.ENDORSEMENT_POLICY_FAILURE
+                continue
+            ok = all(
+                p.finish(mask[start:end]) for p, (start, end) in w.pendings
+            )
+            if not ok:
+                flags[i] = V.ENDORSEMENT_POLICY_FAILURE
+                continue
+            updated.update(w.meta_keys)
+
         protoutil.set_tx_filter(block, bytes(flags))
         return flags
 

@@ -1,0 +1,334 @@
+"""Pluggable transaction-validation framework + builtin v2.0 plugin with
+key-level (state-based) endorsement.
+
+Reference surface:
+  core/handlers/validation/api/**        — the Validate(block, ns, txPos,
+                                           actionPos, ctx) plugin SPI
+  core/committer/txvalidator/plugin/     — plugin name -> factory mapping
+  core/handlers/validation/builtin/v20/  — the default "vscc" plugin
+  core/committer/txvalidator/v20/plugindispatcher/dispatcher.go:158-218
+                                         — per-written-namespace dispatch
+  core/common/validation/statebased/     — key-level endorsement
+                                           (validator_keylevel.go:36-141,
+                                           evaluator v20.go:105-150)
+
+TPU-first twist: the reference plugin verifies endorsement signatures
+inline; here a plugin's `prepare` returns a `PendingValidation` whose
+`items` join the block-wide `verify_batch` device call and whose
+`finish(mask)` applies the policy combinatorics on the host — the same
+two-phase split the signature-policy engine uses (SURVEY.md §7 step 3).
+
+Key-level policy semantics (reference baseEvaluator.checkSBAndCCEP):
+every key the tx writes (value or metadata, public or collection) is
+checked against its key-level VALIDATION_PARAMETER when one is set; an
+unparseable parameter fails the tx.  Keys without one fall back to the
+collection-level endorsement policy (collection writes, when the
+collection defines one) and otherwise to the chaincode-level policy,
+each such fallback policy evaluated at most once.  A tx that writes
+nothing in the namespace is still checked against the chaincode policy
+(FAB-9473, CheckCCEPIfNoEPChecked).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Sequence
+
+from fabric_tpu.ledger.txmgmt import VALIDATION_PARAMETER, hash_ns
+from fabric_tpu.policies.signature_policy import SignaturePolicy
+from fabric_tpu.protos.ledger.rwset import rwset_pb2
+from fabric_tpu.protos.ledger.rwset.kvrwset import kv_rwset_pb2
+from fabric_tpu.protos.common import policies_pb2
+from fabric_tpu.protos.peer import collection_pb2
+from fabric_tpu.protoutil import SignedData
+
+
+class IllegalWritesetError(Exception):
+    """Duplicate namespace in the tx rwset (reference dispatcher.go:174
+    -> TxValidationCode_ILLEGAL_WRITESET)."""
+
+
+@dataclasses.dataclass
+class RwsetFootprint:
+    """One parse of a TxReadWriteSet, shared between the validator's
+    ordering logic and the plugins (avoids re-decoding per phase)."""
+
+    touched: frozenset  # {(ns_or_hashns, key)} the tx writes or re-metas
+    meta_writes: dict  # {(ns_or_hashns, key): {entry: value}}
+    per_ns: dict  # ns -> {"pub": [key], "meta": [key],
+    #                      "coll": [(coll, hashns, hkey)],
+    #                      "coll_meta": [(coll, hashns, hkey)],
+    #                      "writes": bool}
+
+
+def parse_footprint(rwset_bytes: bytes | None) -> RwsetFootprint:
+    touched: set[tuple[str, str]] = set()
+    meta: dict[tuple[str, str], dict[str, bytes]] = {}
+    per_ns: dict[str, dict] = {}
+    if rwset_bytes:
+        txrw = rwset_pb2.TxReadWriteSet.FromString(rwset_bytes)
+        for nsrw in txrw.ns_rwset:
+            if nsrw.namespace in per_ns:
+                raise IllegalWritesetError(
+                    f"duplicate namespace {nsrw.namespace!r} in txRWSet"
+                )
+            entry = per_ns[nsrw.namespace] = {
+                "pub": [], "meta": [], "coll": [], "coll_meta": [],
+                "writes": False,
+            }
+            seen_colls: set[str] = set()
+            kvrw = kv_rwset_pb2.KVRWSet.FromString(nsrw.rwset)
+            for w in kvrw.writes:
+                touched.add((nsrw.namespace, w.key))
+                entry["pub"].append(w.key)
+                entry["writes"] = True
+            for mw in kvrw.metadata_writes:
+                touched.add((nsrw.namespace, mw.key))
+                entry["meta"].append(mw.key)
+                entry["writes"] = True
+                meta[(nsrw.namespace, mw.key)] = {
+                    e.name: bytes(e.value) for e in mw.entries
+                }
+            for ch in nsrw.collection_hashed_rwset:
+                if ch.collection_name in seen_colls:
+                    raise IllegalWritesetError(
+                        f"duplicate collection {ch.collection_name!r} in "
+                        f"namespace {nsrw.namespace!r}"
+                    )
+                seen_colls.add(ch.collection_name)
+                hns = hash_ns(nsrw.namespace, ch.collection_name)
+                hrw = kv_rwset_pb2.HashedRWSet.FromString(ch.hashed_rwset)
+                for hw in hrw.hashed_writes:
+                    hkey = bytes(hw.key_hash).hex()
+                    touched.add((hns, hkey))
+                    entry["coll"].append((ch.collection_name, hns, hkey))
+                    entry["writes"] = True
+                for mw in hrw.metadata_writes:
+                    hkey = bytes(mw.key_hash).hex()
+                    touched.add((hns, hkey))
+                    entry["coll_meta"].append(
+                        (ch.collection_name, hns, hkey)
+                    )
+                    entry["writes"] = True
+                    meta[(hns, hkey)] = {
+                        e.name: bytes(e.value) for e in mw.entries
+                    }
+    return RwsetFootprint(frozenset(touched), meta, per_ns)
+
+
+@dataclasses.dataclass
+class ValidationContext:
+    """Everything a plugin may consult for one (tx, namespace) action."""
+
+    channel_id: str
+    namespace: str
+    tx_pos: int
+    endorsements: list[SignedData]
+    rwset_bytes: bytes | None
+    policy_provider: "PolicyProvider"
+    state_metadata: Callable[[str, str], dict[str, bytes]]
+    # (ns_or_hashns, key) -> committed metadata entries
+    footprint: RwsetFootprint | None = None
+
+
+class PendingValidation:
+    """Two-phase result: `items` join the block batch; `finish(mask)`
+    returns True when the action validates."""
+
+    def __init__(self, pendings: list, items: list):
+        self._pendings = pendings  # [(PendingEvaluation, (start, end))]
+        self.items = items
+
+    def finish(self, mask: Sequence[bool]) -> bool:
+        return all(
+            p.finish(mask[start:end]) for p, (start, end) in self._pendings
+        )
+
+
+class _FailPending(PendingValidation):
+    def __init__(self):
+        super().__init__([], [])
+
+    def finish(self, mask) -> bool:
+        return False
+
+
+class PolicyProvider:
+    """Resolves policy references for a channel: inline signature
+    policies, channel-policy references, and the per-chaincode default
+    (reference plugindispatcher/plugin_validator.go policy fetching)."""
+
+    def __init__(self, policy_manager, deserializer, definition_provider=None):
+        self._pm = policy_manager
+        self._deserializer = deserializer
+        self._definitions = definition_provider
+
+    def default_policy(self):
+        return self._pm.get_policy("/Channel/Application/Endorsement")
+
+    def chaincode_policy(self, namespace: str):
+        """The chaincode-level endorsement policy from the committed
+        definition's validation parameter, else the channel default."""
+        if self._definitions is not None:
+            info = self._definitions.validation_info(namespace)
+            if info is not None:
+                _, param = info
+                pol = self.from_application_policy_bytes(param)
+                if pol is not None:
+                    return pol
+        return self.default_policy()
+
+    def collection_policy(self, namespace: str, collection: str):
+        """The collection-level endorsement policy from the committed
+        definition's collection config, or None when the collection
+        defines none (reference v20.go fetchCollEP +
+        CollectionValidationInfo)."""
+        if self._definitions is None:
+            return None
+        getter = getattr(self._definitions, "collection_config", None)
+        if getter is None:
+            return None
+        conf = getter(namespace, collection)
+        if conf is None or not conf.HasField("endorsement_policy"):
+            return None
+        return self.from_application_policy_bytes(
+            conf.endorsement_policy.SerializeToString()
+        )
+
+    def from_application_policy_bytes(self, raw: bytes):
+        """Parse an ApplicationPolicy (inline signature policy or channel
+        policy reference) — the chaincode-level validation parameter
+        encoding; None when empty/unparseable."""
+        if not raw:
+            return None
+        try:
+            ap = collection_pb2.ApplicationPolicy.FromString(raw)
+            which = ap.WhichOneof("type")
+            if which == "signature_policy":
+                return SignaturePolicy(
+                    ap.signature_policy, self._deserializer
+                )
+            if which == "channel_config_policy_reference":
+                return self._pm.get_policy(
+                    ap.channel_config_policy_reference
+                )
+        except Exception:
+            pass
+        return None
+
+    def from_signature_policy_bytes(self, raw: bytes):
+        """Parse a bare SignaturePolicyEnvelope — the KEY-LEVEL
+        (state-based) policy encoding, distinct from ApplicationPolicy
+        (the two are not wire-distinguishable, so each context uses its
+        own parser, as in the reference)."""
+        if not raw:
+            return None
+        try:
+            env = policies_pb2.SignaturePolicyEnvelope.FromString(raw)
+            if env.rule.ByteSize() or env.identities:
+                return SignaturePolicy(env, self._deserializer)
+        except Exception:
+            pass
+        return None
+
+
+class BuiltinV20Plugin:
+    """The default endorsement-policy plugin ("vscc"), key-level aware.
+    Evaluates the single namespace in `ctx.namespace`; the validator
+    dispatches one prepare per written namespace, as the reference
+    dispatcher does."""
+
+    def prepare(self, ctx: ValidationContext) -> PendingValidation:
+        try:
+            fp = ctx.footprint or parse_footprint(ctx.rwset_bytes)
+        except Exception:
+            return _FailPending()
+        entry = fp.per_ns.get(
+            ctx.namespace,
+            {"pub": [], "meta": [], "coll": [], "coll_meta": [],
+             "writes": False},
+        )
+        # Dedupe: a key counted once even when both written and
+        # metadata-written; identical key-level policies evaluated once.
+        pub_keys = set(entry["pub"]) | set(entry["meta"])
+        coll_keys = set(entry["coll"]) | set(entry["coll_meta"])
+
+        policies_by_bytes: dict[bytes, object] = {}
+        fallbacks: dict[str, object] = {}  # "" = ccEP, else collection
+
+        def resolve_fallback(coll: str) -> None:
+            """Mirrors CheckCCEPIfNotChecked: cache the collection policy
+            when the collection defines one, else the chaincode policy
+            (each evaluated at most once)."""
+            if coll and coll not in fallbacks:
+                fallbacks[coll] = ctx.policy_provider.collection_policy(
+                    ctx.namespace, coll
+                )
+            if coll and fallbacks.get(coll) is not None:
+                return
+            if "" not in fallbacks:
+                fallbacks[""] = ctx.policy_provider.chaincode_policy(
+                    ctx.namespace
+                )
+
+        for coll, ns, key in (
+            [("", ctx.namespace, k) for k in sorted(pub_keys)]
+            + sorted(coll_keys)
+        ):
+            raw = ctx.state_metadata(ns, key).get(VALIDATION_PARAMETER)
+            if not raw:
+                resolve_fallback(coll)
+                continue
+            if raw not in policies_by_bytes:
+                pol = ctx.policy_provider.from_signature_policy_bytes(raw)
+                if pol is None:
+                    # unmarshalable key-level policy invalidates the tx
+                    # (reference policyErr on Evaluate of broken vp)
+                    return _FailPending()
+                policies_by_bytes[raw] = pol
+
+        policies = list(policies_by_bytes.values())
+        policies.extend(p for p in fallbacks.values() if p is not None)
+        if not entry["writes"] and not policies:
+            # no writes at all: the chaincode policy must still hold
+            policies.append(
+                ctx.policy_provider.chaincode_policy(ctx.namespace)
+            )
+
+        items: list = []
+        pendings = []
+        for pol in policies:
+            pending = pol.prepare(ctx.endorsements)
+            start = len(items)
+            items.extend(pending.items)
+            pendings.append((pending, (start, len(items))))
+        return PendingValidation(pendings, items)
+
+
+class PluginRegistry:
+    """Maps validation-plugin names from chaincode definitions to plugin
+    instances (reference txvalidator/plugin/plugin.go MapBasedMapper)."""
+
+    def __init__(self):
+        self._plugins: dict[str, object] = {"vscc": BuiltinV20Plugin()}
+
+    def register(self, name: str, plugin) -> None:
+        self._plugins[name] = plugin
+
+    def plugin(self, name: str):
+        p = self._plugins.get(name or "vscc")
+        if p is None:
+            raise KeyError(f"validation plugin {name!r} not registered")
+        return p
+
+
+__all__ = [
+    "ValidationContext",
+    "RwsetFootprint",
+    "IllegalWritesetError",
+    "parse_footprint",
+    "PendingValidation",
+    "PolicyProvider",
+    "BuiltinV20Plugin",
+    "PluginRegistry",
+]

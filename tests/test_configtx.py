@@ -1,0 +1,202 @@
+"""Configtx validator, capabilities, and ACL provider tests
+(reference common/configtx/validator_test.go + update_test.go patterns,
+common/capabilities, core/aclmgmt)."""
+
+import pytest
+
+from fabric_tpu.common.capabilities import (
+    ApplicationCapabilities,
+    ChannelCapabilities,
+    UnsupportedCapabilityError,
+    capabilities_value,
+    parse_capabilities,
+)
+from fabric_tpu.common.configtx import (
+    ConfigtxError,
+    ConfigtxValidator,
+    compute_update,
+)
+from fabric_tpu.peer.aclmgmt import ACLError, ACLProvider, PEER_PROPOSE
+from fabric_tpu.protos.common import configtx_pb2
+
+
+def _base_config() -> configtx_pb2.Config:
+    cfg = configtx_pb2.Config(sequence=3)
+    ch = cfg.channel_group
+    ch.mod_policy = "Admins"
+    ch.version = 0
+    app = ch.groups["Application"]
+    app.mod_policy = "Admins"
+    app.version = 1
+    v = app.values["BatchSize"]
+    v.value = b"100"
+    v.version = 2
+    v.mod_policy = "Admins"
+    p = app.policies["Writers"]
+    p.policy.type = 1
+    p.mod_policy = "Admins"
+    return cfg
+
+
+def _update_env(update: configtx_pb2.ConfigUpdate):
+    return configtx_pb2.ConfigUpdateEnvelope(
+        config_update=update.SerializeToString()
+    )
+
+
+class _AllowPolicy:
+    def __init__(self, allow=True):
+        self.allow = allow
+        self.calls = []
+
+    def evaluate_signed_data(self, signed_data, csp):
+        return self.allow
+
+
+class _PM:
+    def __init__(self, allow=True):
+        self.policy = _AllowPolicy(allow)
+        self.requested = []
+
+    def get_policy(self, name):
+        self.requested.append(name)
+        return self.policy
+
+
+class TestConfigtxValidator:
+    def test_value_update_happy_path(self):
+        cfg = _base_config()
+        pm = _PM(allow=True)
+        val = ConfigtxValidator("ch", cfg, policy_manager=pm)
+        upd = configtx_pb2.ConfigUpdate(channel_id="ch")
+        upd.read_set.groups["Application"].version = 1
+        w = upd.write_set.groups["Application"]
+        w.version = 1
+        nv = w.values["BatchSize"]
+        nv.value = b"200"
+        nv.version = 3
+        nv.mod_policy = "Admins"
+        env = val.propose_config_update(_update_env(upd))
+        assert env.config.sequence == 4
+        assert (
+            env.config.channel_group.groups["Application"]
+            .values["BatchSize"].value == b"200"
+        )
+        # untouched element carried through
+        assert "Writers" in env.config.channel_group.groups[
+            "Application"
+        ].policies
+        val.commit(env)
+        assert val.sequence == 4
+
+    def test_stale_read_set_rejected(self):
+        val = ConfigtxValidator("ch", _base_config(), policy_manager=_PM())
+        upd = configtx_pb2.ConfigUpdate(channel_id="ch")
+        upd.read_set.groups["Application"].version = 7  # stale
+        with pytest.raises(ConfigtxError, match="read_set"):
+            val.propose_config_update(_update_env(upd))
+
+    def test_wrong_channel_rejected(self):
+        val = ConfigtxValidator("ch", _base_config(), policy_manager=_PM())
+        upd = configtx_pb2.ConfigUpdate(channel_id="other")
+        with pytest.raises(ConfigtxError, match="channel"):
+            val.propose_config_update(_update_env(upd))
+
+    def test_mod_policy_denial(self):
+        val = ConfigtxValidator(
+            "ch", _base_config(), policy_manager=_PM(allow=False)
+        )
+        upd = configtx_pb2.ConfigUpdate(channel_id="ch")
+        w = upd.write_set.groups["Application"]
+        w.version = 1
+        nv = w.values["BatchSize"]
+        nv.value = b"999"
+        nv.version = 3
+        nv.mod_policy = "Admins"
+        with pytest.raises(ConfigtxError, match="not satisfied"):
+            val.propose_config_update(_update_env(upd))
+
+    def test_change_without_version_bump_rejected(self):
+        val = ConfigtxValidator("ch", _base_config(), policy_manager=_PM())
+        upd = configtx_pb2.ConfigUpdate(channel_id="ch")
+        w = upd.write_set.groups["Application"]
+        w.version = 1
+        nv = w.values["BatchSize"]
+        nv.value = b"changed-silently"
+        nv.version = 2  # same version, different content
+        nv.mod_policy = "Admins"
+        with pytest.raises(ConfigtxError, match="without version bump"):
+            val.propose_config_update(_update_env(upd))
+
+    def test_skip_version_rejected(self):
+        val = ConfigtxValidator("ch", _base_config(), policy_manager=_PM())
+        upd = configtx_pb2.ConfigUpdate(channel_id="ch")
+        w = upd.write_set.groups["Application"]
+        w.version = 1
+        nv = w.values["BatchSize"]
+        nv.value = b"x"
+        nv.version = 5
+        with pytest.raises(ConfigtxError, match="bad version"):
+            val.propose_config_update(_update_env(upd))
+
+    def test_out_of_order_commit_rejected(self):
+        val = ConfigtxValidator("ch", _base_config(), policy_manager=_PM())
+        env = configtx_pb2.ConfigEnvelope()
+        env.config.sequence = 99
+        with pytest.raises(ConfigtxError, match="out-of-order"):
+            val.commit(env)
+
+
+class TestComputeUpdate:
+    def test_roundtrip_through_validator(self):
+        """compute_update's output must be accepted by the validator."""
+        original = _base_config()
+        updated = configtx_pb2.Config()
+        updated.CopyFrom(original)
+        updated.channel_group.groups["Application"].values[
+            "BatchSize"
+        ].value = b"512"
+        upd = compute_update("ch", original, updated)
+        val = ConfigtxValidator("ch", original, policy_manager=_PM())
+        env = val.propose_config_update(_update_env(upd))
+        assert (
+            env.config.channel_group.groups["Application"]
+            .values["BatchSize"].value == b"512"
+        )
+
+    def test_no_diff_raises(self):
+        cfg = _base_config()
+        with pytest.raises(ConfigtxError, match="no differences"):
+            compute_update("ch", cfg, cfg)
+
+
+class TestCapabilities:
+    def test_roundtrip_and_supported(self):
+        raw = capabilities_value(["V2_0"]).SerializeToString()
+        caps = ApplicationCapabilities(parse_capabilities(raw))
+        caps.supported()
+        assert caps.lifecycle_v20
+        assert caps.key_level_endorsement
+
+    def test_unknown_capability_rejected(self):
+        caps = ChannelCapabilities({"V9_9": True})
+        with pytest.raises(UnsupportedCapabilityError):
+            caps.supported()
+
+
+class TestACLProvider:
+    def test_default_mapping_and_denial(self):
+        acl = ACLProvider()
+        pm = _PM(allow=True)
+        acl.check_acl(PEER_PROPOSE, pm, [])
+        assert pm.requested == ["/Channel/Application/Writers"]
+        with pytest.raises(ACLError):
+            ACLProvider().check_acl(PEER_PROPOSE, _PM(allow=False), [])
+        with pytest.raises(ACLError, match="no ACL policy"):
+            ACLProvider().check_acl("bogus/Thing", pm, [])
+
+    def test_overrides(self):
+        acl = ACLProvider({PEER_PROPOSE: "/Channel/Application/Admins"})
+        pm = _PM()
+        acl.check_acl(PEER_PROPOSE, pm, [])
+        assert pm.requested == ["/Channel/Application/Admins"]

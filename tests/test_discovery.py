@@ -1,0 +1,192 @@
+"""Discovery service tests (reference discovery/service_test.go +
+discovery/endorsement/endorsement_test.go coverage model): config and
+membership queries, endorsement layouts against live membership,
+collection filtering, auth denial."""
+
+import pytest
+
+from fabric_tpu.common import configtx_builder as ctx
+from fabric_tpu.discovery import (
+    DiscoveryClient,
+    DiscoveryService,
+    PeerInfo,
+    satisfaction_sets,
+)
+from fabric_tpu.discovery.client import select_endorsers
+from fabric_tpu.discovery.service import DiscoverySupport
+from fabric_tpu.common.channelconfig import bundle_from_genesis
+from fabric_tpu.msp import msp_config_from_ca
+from fabric_tpu.policies.signature_policy import (
+    n_out_of,
+    signed_by,
+    signed_by_any_member,
+    signed_by_msp_role,
+)
+from fabric_tpu.protos.common import policies_pb2
+from fabric_tpu.protos.msp import msp_principal_pb2
+
+from orgfix import make_org
+
+
+class TestInquire:
+    def test_satisfaction_sets(self):
+        # OutOf(2, A, B, C) -> {A,B} {A,C} {B,C}
+        env = policies_pb2.SignaturePolicyEnvelope(version=0)
+        env.rule.CopyFrom(
+            n_out_of(2, [signed_by(0), signed_by(1), signed_by(2)])
+        )
+        for i in range(3):
+            env.identities.add()
+        assert satisfaction_sets(env) == [(0, 1), (0, 2), (1, 2)]
+
+    def test_nested(self):
+        # AND(A, OR(B, C)) -> {A,B} {A,C}
+        env = policies_pb2.SignaturePolicyEnvelope(version=0)
+        env.rule.CopyFrom(
+            n_out_of(
+                2,
+                [signed_by(0), n_out_of(1, [signed_by(1), signed_by(2)])],
+            )
+        )
+        for i in range(3):
+            env.identities.add()
+        assert satisfaction_sets(env) == [(0, 1), (0, 2)]
+
+
+@pytest.fixture(scope="module")
+def world():
+    org1 = make_org("Org1MSP")
+    org2 = make_org("Org2MSP")
+    oorg = make_org("OrdererMSP")
+    conf1 = msp_config_from_ca(org1.ca, "Org1MSP")
+    conf2 = msp_config_from_ca(org2.ca, "Org2MSP")
+    app = ctx.application_group(
+        {
+            "Org1": ctx.org_group("Org1MSP", conf1),
+            "Org2": ctx.org_group("Org2MSP", conf2),
+        }
+    )
+    ordg = ctx.orderer_group(
+        {"OrdererOrg": ctx.org_group("OrdererMSP", msp_config_from_ca(oorg.ca, "OrdererMSP"))},
+        consensus_type="solo",
+    )
+    genesis = ctx.genesis_block("dchannel", ctx.channel_group(app, ordg))
+    bundle = bundle_from_genesis(genesis, org1.csp)
+
+    p1 = org1.signer("peer0.org1", role_ou="peer")
+    p2 = org1.signer("peer1.org1", role_ou="peer")
+    p3 = org2.signer("peer0.org2", role_ou="peer")
+    peers = [
+        PeerInfo("p1:7051", p1.serialize(), "Org1MSP", 10, ("mycc",)),
+        PeerInfo("p2:7051", p2.serialize(), "Org1MSP", 12, ("mycc",)),
+        PeerInfo("p3:7051", p3.serialize(), "Org2MSP", 11, ("mycc",)),
+    ]
+
+    policies = {
+        "mycc": signed_by_msp_role(
+            "Org1MSP", msp_principal_pb2.MSPRole.MEMBER
+        ),  # Org1 only
+        "andcc": _and_policy(),
+    }
+
+    def collection_filter(channel, cc, colls):
+        # collA restricted to Org2
+        if "collA" in colls:
+            return lambda p: p.mspid == "Org2MSP"
+        return lambda p: True
+
+    support = DiscoverySupport(
+        channels=lambda: ["dchannel"],
+        bundle=lambda ch: bundle,
+        peers=lambda ch: peers,
+        msp_configs=lambda ch: {
+            "Org1MSP": conf1.SerializeToString(),
+            "Org2MSP": conf2.SerializeToString(),
+        },
+        orderer_endpoints=lambda ch: {"OrdererMSP": [("orderer0", 7050)]},
+        chaincode_policy=lambda ch, cc: policies.get(cc),
+        collection_filter=collection_filter,
+        acl_check=lambda ch, sd: None,
+    )
+    service = DiscoveryService(support, org1.csp)
+    client_signer = org1.signer("user1", role_ou="client")
+    client = DiscoveryClient(client_signer, service.process)
+    return service, client, org1
+
+
+def _and_policy():
+    env = policies_pb2.SignaturePolicyEnvelope(version=0)
+    e1 = signed_by_msp_role("Org1MSP", msp_principal_pb2.MSPRole.MEMBER)
+    e2 = signed_by_msp_role("Org2MSP", msp_principal_pb2.MSPRole.MEMBER)
+    env.identities.extend([e1.identities[0], e2.identities[0]])
+    env.rule.CopyFrom(n_out_of(2, [signed_by(0), signed_by(1)]))
+    return env
+
+
+def test_config_query(world):
+    _, client, _ = world
+    conf = client.config("dchannel")
+    assert set(conf.msps) == {"Org1MSP", "Org2MSP"}
+    assert conf.orderers["OrdererMSP"].endpoint[0].host == "orderer0"
+
+
+def test_membership_query(world):
+    _, client, _ = world
+    peers = client.peers("dchannel")
+    assert len(peers) == 3
+    assert {p.endpoint for p in peers} == {"p1:7051", "p2:7051", "p3:7051"}
+
+
+def test_endorsement_descriptor_single_org(world):
+    _, client, _ = world
+    desc = client.endorsers("dchannel", "mycc")
+    assert len(desc.layouts) == 1
+    (group, qty), = desc.layouts[0].quantities_by_group.items()
+    assert qty == 1
+    eps = {p.endpoint for p in desc.endorsers_by_groups[group].peers}
+    assert eps == {"p1:7051", "p2:7051"}  # only Org1 peers
+    chosen = select_endorsers(desc)
+    assert len(chosen) == 1
+    assert chosen[0].endpoint == "p2:7051"  # highest ledger height
+
+
+def test_endorsement_descriptor_and_policy(world):
+    _, client, _ = world
+    desc = client.endorsers("dchannel", "andcc")
+    assert len(desc.layouts) == 1
+    assert sorted(desc.layouts[0].quantities_by_group.values()) == [1, 1]
+    chosen = select_endorsers(desc)
+    assert len(chosen) == 2
+    assert {p.endpoint for p in chosen} & {"p1:7051", "p2:7051"}
+    assert "p3:7051" in {p.endpoint for p in chosen}
+
+
+def test_collection_filtering(world):
+    _, client, _ = world
+    # collA restricts to Org2 peers; mycc's policy needs Org1 -> no layout
+    with pytest.raises(RuntimeError, match="no endorsement layout"):
+        client.endorsers("dchannel", "mycc", collections=["collA"])
+
+
+def test_unknown_chaincode(world):
+    _, client, _ = world
+    with pytest.raises(RuntimeError, match="no endorsement policy"):
+        client.endorsers("dchannel", "nope")
+
+
+def test_unknown_channel_denied(world):
+    _, client, _ = world
+    from fabric_tpu.protos.discovery import protocol_pb2 as dpb
+
+    q = dpb.Query(channel="nochannel")
+    q.config_query.SetInParent()
+    with pytest.raises(RuntimeError, match="access denied"):
+        client._one(q)
+
+
+def test_foreign_identity_denied(world):
+    service, _, _ = world
+    evil = make_org("EvilMSP").signer("mallory", role_ou="client")
+    client = DiscoveryClient(evil, service.process)
+    with pytest.raises(RuntimeError, match="access denied"):
+        client.config("dchannel")

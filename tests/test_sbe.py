@@ -1,0 +1,387 @@
+"""State-based (key-level) endorsement tests.
+
+Reference coverage model: integration/sbe/sbe_test.go — set a key-level
+policy via SetStateValidationParameter, then writes to that key require
+the key's policy instead of the chaincode-level policy; changing the
+policy is itself gated by the current policy.
+"""
+
+import pytest
+
+from fabric_tpu.chaincode.statebased import KeyEndorsementPolicy, ROLE_MEMBER
+from fabric_tpu.common import configtx_builder as ctx
+from fabric_tpu.msp import msp_config_from_ca
+from fabric_tpu.node.devnode import DevNode
+from fabric_tpu.peer.endorser import Endorser
+from fabric_tpu.protos.peer import proposal_pb2, transaction_pb2
+from fabric_tpu import protoutil
+
+from orgfix import make_org
+
+V = transaction_pb2
+
+
+def sbecc(sim, args):
+    """Chaincode exercising key-level endorsement."""
+    op = args[0]
+    if op == b"put":
+        sim.set_state("sbecc", args[1].decode(), args[2])
+        return 200, "", b""
+    if op == b"setpol":  # attach a key-level policy
+        pol = KeyEndorsementPolicy()
+        pol.add_orgs(ROLE_MEMBER, *[m.decode() for m in args[2:]])
+        sim.set_state_metadata(
+            "sbecc", args[1].decode(),
+            {"VALIDATION_PARAMETER": pol.policy()},
+        )
+        return 200, "", b""
+    if op == b"rawpol":  # write raw (possibly broken) policy bytes
+        sim.set_state_metadata(
+            "sbecc", args[1].decode(),
+            {"VALIDATION_PARAMETER": args[2]},
+        )
+        return 200, "", b""
+    return 500, f"unknown op {op!r}", b""
+
+
+@pytest.fixture(scope="module")
+def net():
+    org1 = make_org("Org1MSP")
+    org2 = make_org("Org2MSP")
+    oorg = make_org("OrdererMSP")
+    app = ctx.application_group(
+        {
+            "Org1": ctx.org_group("Org1MSP", msp_config_from_ca(org1.ca, "Org1MSP")),
+            "Org2": ctx.org_group("Org2MSP", msp_config_from_ca(org2.ca, "Org2MSP")),
+        }
+    )
+    ordg = ctx.orderer_group(
+        {"OrdererOrg": ctx.org_group("OrdererMSP", msp_config_from_ca(oorg.ca, "OrdererMSP"))},
+        consensus_type="solo",
+        max_message_count=10,
+    )
+    genesis = ctx.genesis_block("sbechannel", ctx.channel_group(app, ordg))
+    peer1 = org1.signer("peer0.org1", role_ou="peer")
+    peer2 = org2.signer("peer0.org2", role_ou="peer")
+    node = DevNode(
+        genesis,
+        csp=org1.csp,
+        peer_signer=peer1,
+        chaincodes={"sbecc": sbecc},
+        batch_timeout_s=0.25,
+    )
+    endorser2 = Endorser(
+        node.channel_id, node.ledger, node.bundle, peer2, {"sbecc": sbecc},
+        node.csp,
+    )
+    client = org1.signer("user1", role_ou="client")
+    yield node, endorser2, client
+    node.shutdown()
+
+
+def _endorse(node, endorser2, client, args, endorsers):
+    prop, txid = protoutil.create_chaincode_proposal(
+        client.serialize(), node.channel_id, "sbecc", args
+    )
+    signed = proposal_pb2.SignedProposal(
+        proposal_bytes=prop.SerializeToString(),
+        signature=client.sign(prop.SerializeToString()),
+    )
+    responses = []
+    if "org1" in endorsers:
+        responses.append(node.endorser.process_proposal(signed))
+    if "org2" in endorsers:
+        responses.append(endorser2.process_proposal(signed))
+    return protoutil.create_signed_tx(prop, client, responses), txid
+
+
+def _commit_one(node, env):
+    node.broadcast(env)
+    _, flags = node.wait_commit()
+    return flags
+
+
+def test_key_level_policy_overrides_chaincode_policy(net):
+    node, endorser2, client = net
+    # seed the key under the default (MAJORITY both-orgs) policy
+    env, _ = _endorse(node, endorser2, client, [b"put", b"k", b"v0"],
+                      ("org1", "org2"))
+    assert _commit_one(node, env) == [V.VALID]
+
+    # attach a key-level policy: Org2 only (needs both orgs to pass the
+    # current default policy on the metadata write)
+    env, _ = _endorse(
+        node, endorser2, client, [b"setpol", b"k", b"Org2MSP"],
+        ("org1", "org2"),
+    )
+    assert _commit_one(node, env) == [V.VALID]
+
+    # now an Org2-only endorsement suffices for this key (the chaincode
+    # default MAJORITY policy would have rejected a single endorsement)
+    env, _ = _endorse(node, endorser2, client, [b"put", b"k", b"v1"],
+                      ("org2",))
+    assert _commit_one(node, env) == [V.VALID]
+    assert node.ledger.get_state("sbecc", "k") == b"v1"
+
+    # ...and an Org1-only endorsement is rejected by the key's policy
+    env, _ = _endorse(node, endorser2, client, [b"put", b"k", b"v2"],
+                      ("org1",))
+    assert _commit_one(node, env) == [V.ENDORSEMENT_POLICY_FAILURE]
+    assert node.ledger.get_state("sbecc", "k") == b"v1"
+
+    # metadata RETENTION: the value-only write of v1 must not have erased
+    # the key's policy — a second Org2-only write still passes (it would
+    # fail the chaincode-level MAJORITY policy if the policy were gone)
+    env, _ = _endorse(node, endorser2, client, [b"put", b"k", b"v3"],
+                      ("org2",))
+    assert _commit_one(node, env) == [V.VALID]
+    assert node.ledger.get_state("sbecc", "k") == b"v3"
+
+    # keys WITHOUT a key-level policy still use the chaincode policy
+    env, _ = _endorse(node, endorser2, client, [b"put", b"other", b"x"],
+                      ("org2",))
+    assert _commit_one(node, env) == [V.ENDORSEMENT_POLICY_FAILURE]
+
+
+def test_same_block_policy_change_gates_later_tx(net):
+    node, endorser2, client = net
+    # seed key "q" and give it an Org1-only policy
+    env, _ = _endorse(node, endorser2, client, [b"put", b"q", b"0"],
+                      ("org1", "org2"))
+    assert _commit_one(node, env) == [V.VALID]
+    env, _ = _endorse(
+        node, endorser2, client, [b"setpol", b"q", b"Org1MSP"],
+        ("org1", "org2"),
+    )
+    # in the SAME block: a write endorsed by Org2 only — must fail once
+    # the new Org1-only policy lands (in-block overlay ordering)
+    env2, _ = _endorse(node, endorser2, client, [b"put", b"q", b"1"],
+                       ("org2",))
+    node.broadcast(env)
+    node.broadcast(env2)
+    _, flags = node.wait_commit()
+    if len(flags) == 1:  # raced into two blocks
+        _, flags2 = node.wait_commit()
+        flags = flags + flags2
+    assert flags == [V.VALID, V.ENDORSEMENT_POLICY_FAILURE]
+    # an Org1-only write now passes
+    env, _ = _endorse(node, endorser2, client, [b"put", b"q", b"2"],
+                      ("org1",))
+    assert _commit_one(node, env) == [V.VALID]
+    assert node.ledger.get_state("sbecc", "q") == b"2"
+
+
+def _raw_block(node, envs):
+    """Hand-build a block so multi-tx ordering is deterministic (the
+    batch timeout can otherwise split broadcasts across blocks)."""
+    from fabric_tpu.protos.common import common_pb2
+
+    blk = common_pb2.Block()
+    blk.header.number = 1
+    blk.data.data.extend(e.SerializeToString() for e in envs)
+    while len(blk.metadata.metadata) < 3:
+        blk.metadata.metadata.append(b"")
+    return blk
+
+
+def test_inblock_conflict_invalidates_even_when_new_policy_satisfied(net):
+    """Reference vpmanagerimpl.go:219 ValidationParameterUpdatedError: a
+    tx touching a key whose VALIDATION_PARAMETER an earlier VALID tx in
+    the block rewrote is invalid, even if its endorsements satisfy both
+    the old and the new policy."""
+    node, endorser2, client = net
+    env, _ = _endorse(node, endorser2, client, [b"put", b"w", b"0"],
+                      ("org1", "org2"))
+    assert _commit_one(node, env) == [V.VALID]
+
+    env1, _ = _endorse(node, endorser2, client,
+                       [b"setpol", b"w", b"Org2MSP"], ("org1", "org2"))
+    # endorsed by BOTH orgs: satisfies MAJORITY (old) and Org2-only (new)
+    env2, _ = _endorse(node, endorser2, client, [b"put", b"w", b"1"],
+                       ("org1", "org2"))
+    flags = node.validator.validate(_raw_block(node, [env1, env2]))
+    assert flags == [V.VALID, V.ENDORSEMENT_POLICY_FAILURE]
+
+    # order matters: the put BEFORE the setpol is untouched by the rule
+    env3, _ = _endorse(node, endorser2, client, [b"put", b"w2", b"x"],
+                       ("org1", "org2"))
+    env4, _ = _endorse(node, endorser2, client,
+                       [b"setpol", b"w2", b"Org1MSP"], ("org1", "org2"))
+    flags = node.validator.validate(_raw_block(node, [env3, env4]))
+    assert flags == [V.VALID, V.VALID]
+
+
+def test_conflict_with_invalid_first_tx_does_not_gate(net):
+    """An INVALID metadata write introduces no dependency
+    (waitForValidationResults only errors when the dep tx validated)."""
+    node, endorser2, client = net
+    env, _ = _endorse(node, endorser2, client, [b"put", b"z", b"0"],
+                      ("org1", "org2"))
+    assert _commit_one(node, env) == [V.VALID]
+    # setpol endorsed by org1 only -> fails MAJORITY -> invalid
+    env1, _ = _endorse(node, endorser2, client,
+                       [b"setpol", b"z", b"Org1MSP"], ("org1",))
+    env2, _ = _endorse(node, endorser2, client, [b"put", b"z", b"1"],
+                       ("org1", "org2"))
+    flags = node.validator.validate(_raw_block(node, [env1, env2]))
+    assert flags == [V.ENDORSEMENT_POLICY_FAILURE, V.VALID]
+
+
+def test_unparseable_key_policy_invalidates_writes(net):
+    """A key whose committed VALIDATION_PARAMETER does not unmarshal
+    invalidates txs writing the key (reference policyErr on a broken
+    vp), rather than silently falling back to the chaincode policy."""
+    node, endorser2, client = net
+    env, _ = _endorse(node, endorser2, client, [b"put", b"bad", b"0"],
+                      ("org1", "org2"))
+    assert _commit_one(node, env) == [V.VALID]
+    # the metadata write itself is gated by the PRE-write policy
+    # (chaincode MAJORITY), so it commits fine
+    env, _ = _endorse(node, endorser2, client,
+                      [b"rawpol", b"bad", b"\x08"], ("org1", "org2"))
+    assert _commit_one(node, env) == [V.VALID]
+    env, _ = _endorse(node, endorser2, client, [b"put", b"bad", b"v"],
+                      ("org1", "org2"))
+    assert _commit_one(node, env) == [V.ENDORSEMENT_POLICY_FAILURE]
+
+
+@pytest.fixture(scope="module")
+def ccnet():
+    """Network with committed chaincode definitions: cc1 (Org1-only EP,
+    collection collA with an Org2-only collection EP) and cc2
+    (Org2-only EP)."""
+    from fabric_tpu.common.privdata import (
+        collection_package,
+        static_collection,
+    )
+    from fabric_tpu.policies.signature_policy import signed_by_msp_role
+    from fabric_tpu.protos.msp import msp_principal_pb2
+    from fabric_tpu.protos.peer import collection_pb2
+
+    org1 = make_org("Org1MSP")
+    org2 = make_org("Org2MSP")
+    oorg = make_org("OrdererMSP")
+    app = ctx.application_group(
+        {
+            "Org1": ctx.org_group("Org1MSP", msp_config_from_ca(org1.ca, "Org1MSP")),
+            "Org2": ctx.org_group("Org2MSP", msp_config_from_ca(org2.ca, "Org2MSP")),
+        }
+    )
+    ordg = ctx.orderer_group(
+        {"OrdererOrg": ctx.org_group("OrdererMSP", msp_config_from_ca(oorg.ca, "OrdererMSP"))},
+        consensus_type="solo",
+        max_message_count=10,
+    )
+    genesis = ctx.genesis_block("ccchannel", ctx.channel_group(app, ordg))
+
+    def role(mspid):
+        return signed_by_msp_role(mspid, msp_principal_pb2.MSPRole.MEMBER)
+
+    def app_policy_bytes(env):
+        ap = collection_pb2.ApplicationPolicy()
+        ap.signature_policy.CopyFrom(env)
+        return ap.SerializeToString()
+
+    colls = collection_package(
+        static_collection("collA", ["Org1MSP", "Org2MSP"],
+                          endorsement_policy=role("Org2MSP"))
+    )
+
+    class Defs:
+        _params = {
+            "cc1": app_policy_bytes(role("Org1MSP")),
+            "cc2": app_policy_bytes(role("Org2MSP")),
+        }
+
+        def validation_info(self, name):
+            p = self._params.get(name)
+            return ("vscc", p) if p is not None else None
+
+        def collection_config(self, name, coll):
+            if name != "cc1":
+                return None
+            for c in colls.config:
+                if c.static_collection_config.name == coll:
+                    return c.static_collection_config
+            return None
+
+    def cc1(sim, args):
+        op = args[0]
+        if op == b"own":
+            sim.set_state("cc1", args[1].decode(), args[2])
+        elif op == b"xns":  # cross-namespace write (cc2cc)
+            sim.set_state("cc1", args[1].decode(), args[2])
+            sim.set_state("cc2", args[1].decode(), args[2])
+        elif op == b"pvt":  # collection write
+            sim.set_private_data("cc1", "collA", args[1].decode(), args[2])
+        else:
+            return 500, f"unknown op {args[0]!r}", b""
+        return 200, "", b""
+
+    peer1 = org1.signer("peer0.org1", role_ou="peer")
+    peer2 = org2.signer("peer0.org2", role_ou="peer")
+    node = DevNode(
+        genesis,
+        csp=org1.csp,
+        peer_signer=peer1,
+        chaincodes={"cc1": cc1},
+        batch_timeout_s=0.25,
+        definition_provider=Defs(),
+    )
+    endorser2 = Endorser(
+        node.channel_id, node.ledger, node.bundle, peer2, {"cc1": cc1},
+        node.csp,
+    )
+    client = org1.signer("user1", role_ou="client")
+    yield node, endorser2, client
+    node.shutdown()
+
+
+def _cc1_tx(node, endorser2, client, args, endorsers):
+    prop, _ = protoutil.create_chaincode_proposal(
+        client.serialize(), node.channel_id, "cc1", args
+    )
+    signed = proposal_pb2.SignedProposal(
+        proposal_bytes=prop.SerializeToString(),
+        signature=client.sign(prop.SerializeToString()),
+    )
+    responses = []
+    if "org1" in endorsers:
+        responses.append(node.endorser.process_proposal(signed))
+    if "org2" in endorsers:
+        responses.append(endorser2.process_proposal(signed))
+    env = protoutil.create_signed_tx(prop, client, responses)
+    node.broadcast(env)
+    _, flags = node.wait_commit()
+    return flags
+
+
+def test_cc2cc_write_gated_by_target_namespace_policy(ccnet):
+    """A tx whose rwset spans namespaces is validated against EACH
+    written namespace's endorsement policy (dispatcher.go:190)."""
+    node, endorser2, client = ccnet
+    # own-namespace write: Org1's endorsement suffices (cc1 EP)
+    assert _cc1_tx(node, endorser2, client, [b"own", b"a", b"1"],
+                   ("org1",)) == [V.VALID]
+    # cross-namespace write endorsed by Org1 only: cc2's Org2-only EP fails
+    assert _cc1_tx(node, endorser2, client, [b"xns", b"b", b"1"],
+                   ("org1",)) == [V.ENDORSEMENT_POLICY_FAILURE]
+    assert node.ledger.get_state("cc2", "b") is None
+    # endorsed by both orgs: both namespace policies pass
+    assert _cc1_tx(node, endorser2, client, [b"xns", b"b", b"2"],
+                   ("org1", "org2")) == [V.VALID]
+    assert node.ledger.get_state("cc2", "b") == b"2"
+
+
+def test_collection_level_endorsement_policy(ccnet):
+    """Collection writes without key-level policies are gated by the
+    collection EP when one is defined, INSTEAD of the chaincode EP
+    (v20.go CheckCCEPIfNotChecked)."""
+    node, endorser2, client = ccnet
+    # Org1 satisfies cc1's chaincode EP but NOT collA's Org2-only EP
+    assert _cc1_tx(node, endorser2, client, [b"pvt", b"p", b"1"],
+                   ("org1",)) == [V.ENDORSEMENT_POLICY_FAILURE]
+    # Org2 alone satisfies the collection EP (which replaces the cc EP
+    # for collection keys)
+    assert _cc1_tx(node, endorser2, client, [b"pvt", b"p", b"2"],
+                   ("org2",)) == [V.VALID]
