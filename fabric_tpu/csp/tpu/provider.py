@@ -34,7 +34,7 @@ from fabric_tpu.csp.api import (
 )
 from fabric_tpu.csp.sw import SWCSP
 
-_BATCH_BUCKETS = (32, 128, 512, 2048, 8192)
+_BATCH_BUCKETS = (32, 128, 512, 2048, 8192, 32768)
 _HASH_BUCKETS = (32, 128, 512, 2048, 8192)
 
 
@@ -100,7 +100,7 @@ class TPUCSP(CSP):
     def verify_batch(self, items: Sequence[VerifyBatchItem]) -> list[bool]:
         if len(items) < self._min_device_batch:
             return self._sw.verify_batch(items)
-        from fabric_tpu.csp.tpu import ec
+        from fabric_tpu.csp.tpu import pallas_ec
 
         tuples = []
         for it in items:
@@ -110,19 +110,45 @@ class TPUCSP(CSP):
             try:
                 r, s = api.unmarshal_ecdsa_signature(it.signature)
             except ValueError:
-                r, s = -1, -1  # prepare_batch marks the lane invalid
+                r, s = -1, -1  # prepare marks the lane invalid
             tuples.append((key.x, key.y, it.digest, r, s))
 
-        n = len(tuples)
-        bsz = _bucket(n, _BATCH_BUCKETS)
-        results: list[bool] = []
-        for off in range(0, n, bsz):
-            chunk = tuples[off : off + bsz]
-            pad = bsz - len(chunk)
-            chunk = chunk + [(api.P256_GX, api.P256_GY, b"", -1, -1)] * pad
-            prep = ec.prepare_batch(chunk)
-            mask = np.asarray(ec.verify_prepared(**prep))
-            results.extend(bool(v) for v in mask[: bsz - pad])
+        import jax
+
+        def chunks():
+            bsz = _bucket(len(tuples), _BATCH_BUCKETS)
+            for off in range(0, len(tuples), bsz):
+                chunk = tuples[off : off + bsz]
+                keep = len(chunk)
+                chunk = chunk + [
+                    (api.P256_GX, api.P256_GY, b"", -1, -1)
+                ] * (bsz - keep)
+                yield chunk, keep
+
+        if jax.default_backend() != "tpu":
+            # The fused kernel is TPU-only (Mosaic); other backends get
+            # the portable XLA kernel (interpreted Pallas would be
+            # orders of magnitude slower on CPU test runs).
+            from fabric_tpu.csp.tpu import ec
+
+            results: list[bool] = []
+            for chunk, keep in chunks():
+                prep = ec.prepare_batch(chunk)
+                mask = np.asarray(ec.verify_prepared(**prep))
+                results.extend(bool(v) for v in mask[:keep])
+            return results
+
+        # Chunked pipeline over the fused Pallas kernel: every chunk is
+        # dispatched (host prep + async device call) before any result is
+        # collected, so host packing and the host->device hop of chunk
+        # k+1 overlap chunk k's device time.
+        pending = []
+        for chunk, keep in chunks():
+            packed = pallas_ec.prepare_packed(chunk)
+            pending.append((pallas_ec.verify_packed(packed), keep))
+        results = []
+        for collect, keep in pending:
+            results.extend(bool(v) for v in collect()[:keep])
         return results
 
 

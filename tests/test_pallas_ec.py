@@ -1,0 +1,92 @@
+"""Pallas fused verify kernel parity (interpret mode on the CPU mesh).
+
+The fused kernel must agree bit-for-bit with the XLA kernel (ec.py) and
+the OpenSSL oracle on valid, tampered, and precheck-failed lanes —
+per-item failure semantics (SURVEY.md §7 hard part 4).  Batches stay
+small: interpreted Pallas executes the grid in Python.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from fabric_tpu.csp import SWCSP, api
+from fabric_tpu.csp.tpu import ec, pallas_ec
+
+
+def _sig_batch(n, rng):
+    csp = SWCSP()
+    items = []
+    for i in range(n):
+        key = csp.key_gen()
+        digest = csp.hash(b"pallas-%d" % i)
+        r, s = api.unmarshal_ecdsa_signature(csp.sign(key, digest))
+        pub = key.public_key()
+        items.append((pub.x, pub.y, digest, r, s))
+    return items
+
+
+def test_solinas_reduction_parity():
+    """The signed Solinas matrix + bias reproduces v mod p for random
+    products up to the 2^514 operand-invariant bound."""
+    c = pallas_ec._consts()
+    solmat = c["solmat"].astype(np.int64)
+    bias = c["bias"][:, 0].astype(np.int64)
+    r512 = c["r512"][:, 0].astype(np.int64)
+    from fabric_tpu.csp.tpu.limbs import int_to_limbs
+
+    rng = random.Random(7)
+    for _ in range(50):
+        v = rng.randrange(0, 1 << 514)
+        cols = int_to_limbs(v, 34).astype(np.int64)
+        acc = solmat @ cols + bias[:16]
+        assert (acc >= 0).all() and (acc < 1 << 24).all()
+        acc = acc + cols[32] * r512
+        full = np.concatenate([acc, bias[16:]])
+        got = sum(int(full[i]) << (16 * i) for i in range(17))
+        assert got % api.P256_P == v % api.P256_P
+        assert got < (1 << (16 * 17))
+
+
+def test_kernel_parity_valid_and_tampered():
+    rng = random.Random(3)
+    items = _sig_batch(6, rng)
+    # lane 1: tampered digest; lane 3: high-S (precheck fail);
+    # lane 4: r out of range
+    items[1] = items[1][:2] + (SWCSP().hash(b"other"),) + items[1][3:]
+    x, y, d, r, s = items[3]
+    items[3] = (x, y, d, r, api.P256_N - 1)  # high-S
+    x, y, d, r, s = items[4]
+    items[4] = (x, y, d, api.P256_N, s)
+    prep = ec.prepare_batch(items)
+    keys = ("qx", "qy", "d1", "d2", "cand0", "cand1", "cand1_ok", "valid")
+    ref = np.asarray(ec.verify_kernel(**{k: prep[k] for k in keys}))
+    got = pallas_ec.verify_prepared(**{k: prep[k] for k in keys})
+    assert (ref == got).all()
+    assert list(got) == [True, False, True, False, False, True]
+
+
+def test_prepare_packed_matches_prepare_batch():
+    rng = random.Random(5)
+    items = _sig_batch(4, rng)
+    items.append((api.P256_GX, api.P256_GY, b"", -1, -1))  # invalid lane
+    packed = pallas_ec.prepare_packed(items)
+    prep = ec.prepare_batch(items)
+    # words repack of the reference prep must equal the fast path
+    assert (packed["qx"] == pallas_ec._pack_words(prep["qx"])).all()
+    assert (packed["qy"] == pallas_ec._pack_words(prep["qy"])).all()
+    assert (packed["d1"] == pallas_ec._pack_digits(prep["d1"])).all()
+    assert (packed["d2"] == pallas_ec._pack_digits(prep["d2"])).all()
+    assert (packed["cand0"] == pallas_ec._pack_words(prep["cand0"])).all()
+    assert (packed["cand1"] == pallas_ec._pack_words(prep["cand1"])).all()
+    assert (packed["cand1_ok"] == prep["cand1_ok"]).all()
+    assert (packed["valid"] == prep["valid"]).all()
+
+
+def test_verify_packed_roundtrip():
+    rng = random.Random(11)
+    items = _sig_batch(3, rng)
+    packed = pallas_ec.prepare_packed(items)
+    collect = pallas_ec.verify_packed(packed)
+    assert list(collect()) == [True, True, True]
