@@ -102,20 +102,25 @@ class TPUCSP(CSP):
             return self._sw.verify_batch(items)
         from fabric_tpu.csp.tpu import pallas_ec
 
-        tuples = []
-        for it in items:
-            key = it.key
-            if isinstance(key, ECDSAP256PrivateKey):
-                key = key.public_key()
-            try:
-                r, s = api.unmarshal_ecdsa_signature(it.signature)
-            except ValueError:
-                r, s = -1, -1  # prepare marks the lane invalid
-            tuples.append((key.x, key.y, it.digest, r, s))
-
         import jax
 
+        def make_tuples():
+            # Python-side DER parse — only for the fallback paths; the
+            # native marshaller parses DER itself.
+            tuples = []
+            for it in items:
+                key = it.key
+                if isinstance(key, ECDSAP256PrivateKey):
+                    key = key.public_key()
+                try:
+                    r, s = api.unmarshal_ecdsa_signature(it.signature)
+                except ValueError:
+                    r, s = -1, -1  # prepare marks the lane invalid
+                tuples.append((key.x, key.y, it.digest, r, s))
+            return tuples
+
         def chunks():
+            tuples = make_tuples()
             bsz = _bucket(len(tuples), _BATCH_BUCKETS)
             for off in range(0, len(tuples), bsz):
                 chunk = tuples[off : off + bsz]
@@ -141,15 +146,71 @@ class TPUCSP(CSP):
         # Chunked pipeline over the fused Pallas kernel: every chunk is
         # dispatched (host prep + async device call) before any result is
         # collected, so host packing and the host->device hop of chunk
-        # k+1 overlap chunk k's device time.
+        # k+1 overlap chunk k's device time.  Host prep runs in the C++
+        # marshaller when available (DER + prechecks + batch inversion +
+        # packing in one pass), else the numpy path.
+        packed_all = self._marshal_native(items)
         pending = []
-        for chunk, keep in chunks():
-            packed = pallas_ec.prepare_packed(chunk)
-            pending.append((pallas_ec.verify_packed(packed), keep))
+        if packed_all is not None:
+            n = len(items)
+            bsz = _bucket(n, _BATCH_BUCKETS)
+            for off in range(0, n, bsz):
+                sl = {
+                    k: (v[:, off:off + bsz] if v.ndim == 2
+                        else v[off:off + bsz])
+                    for k, v in packed_all.items()
+                }
+                keep = sl["valid"].shape[0]
+                if keep < bsz:
+                    # zero-pad (valid=False lanes) to the bucket size so
+                    # every chunk reuses the same compiled kernel shape
+                    sl = {
+                        k: np.concatenate(
+                            [v, np.zeros(
+                                v.shape[:-1] + (bsz - keep,), v.dtype
+                            )],
+                            axis=-1,
+                        )
+                        for k, v in sl.items()
+                    }
+                pending.append((pallas_ec.verify_packed(sl), keep))
+        else:
+            for chunk, keep in chunks():
+                packed = pallas_ec.prepare_packed(chunk)
+                pending.append((pallas_ec.verify_packed(packed), keep))
         results = []
         for collect, keep in pending:
             results.extend(bool(v) for v in collect()[:keep])
         return results
+
+    @staticmethod
+    def _marshal_native(items) -> dict | None:
+        from fabric_tpu import native
+
+        if not native.available():
+            return None
+        xs, ys, digs, sigs, offs = [], [], [], [], [0]
+        bad_digest = []
+        for i, it in enumerate(items):
+            key = it.key
+            if isinstance(key, ECDSAP256PrivateKey):
+                key = key.public_key()
+            xs.append(key.x.to_bytes(32, "big"))
+            ys.append(key.y.to_bytes(32, "big"))
+            if len(it.digest) == 32:
+                digs.append(it.digest)
+            else:
+                digs.append(b"\0" * 32)
+                bad_digest.append(i)
+            sigs.append(it.signature)
+            offs.append(offs[-1] + len(it.signature))
+        packed = native.marshal_batch(
+            b"".join(xs), b"".join(ys), b"".join(digs), b"".join(sigs),
+            np.asarray(offs, np.int32),
+        )
+        if packed is not None and bad_digest:
+            packed["valid"][bad_digest] = False
+        return packed
 
 
 __all__ = ["TPUCSP"]

@@ -1,0 +1,106 @@
+"""Native (C++) host-side components, loaded via ctypes.
+
+`marshal_batch` is the batch signature marshaller feeding the TPU verify
+kernel (SURVEY.md §7 native-components policy).  The shared library is
+compiled on first use with the system g++ and cached next to the source;
+callers fall back to the pure-Python path when no compiler is available.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "marshal.cc")
+_LIB = os.path.join(_DIR, "libfabricmarshal.so")
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _load():
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        try:
+            if not os.path.exists(_LIB) or (
+                os.path.getmtime(_LIB) < os.path.getmtime(_SRC)
+            ):
+                subprocess.run(
+                    ["g++", "-O3", "-shared", "-fPIC", "-o", _LIB, _SRC],
+                    check=True,
+                    capture_output=True,
+                )
+            lib = ctypes.CDLL(_LIB)
+            fn = lib.fabric_marshal_batch
+            fn.restype = ctypes.c_int
+            fn.argtypes = [
+                ctypes.c_int,
+                ctypes.c_char_p,  # xs
+                ctypes.c_char_p,  # ys
+                ctypes.c_char_p,  # digests
+                ctypes.c_char_p,  # sigs
+                np.ctypeslib.ndpointer(np.int32, flags="C"),
+                np.ctypeslib.ndpointer(np.uint32, flags="C"),  # qx
+                np.ctypeslib.ndpointer(np.uint32, flags="C"),  # qy
+                np.ctypeslib.ndpointer(np.uint32, flags="C"),  # d1
+                np.ctypeslib.ndpointer(np.uint32, flags="C"),  # d2
+                np.ctypeslib.ndpointer(np.uint32, flags="C"),  # c0
+                np.ctypeslib.ndpointer(np.uint32, flags="C"),  # c1
+                np.ctypeslib.ndpointer(np.uint8, flags="C"),   # c1ok
+                np.ctypeslib.ndpointer(np.uint8, flags="C"),   # valid
+            ]
+            _lib = lib
+        except Exception:
+            _lib = None
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def marshal_batch(xs: bytes, ys: bytes, digests: bytes, sigs: bytes,
+                  sig_off: np.ndarray) -> dict | None:
+    """One pass: DER parse + prechecks + batch inversion + packing.
+    Inputs: concatenated 32-byte big-endian x/y/digest buffers and
+    concatenated DER signatures with (n+1,) int32 offsets.  Returns the
+    packed dict fabric_tpu.csp.tpu.pallas_ec.verify_packed consumes, or
+    None when the native library is unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    n = len(sig_off) - 1
+    qx = np.empty((8, n), np.uint32)
+    qy = np.empty((8, n), np.uint32)
+    d1 = np.empty((8, n), np.uint32)
+    d2 = np.empty((8, n), np.uint32)
+    c0 = np.empty((8, n), np.uint32)
+    c1 = np.empty((8, n), np.uint32)
+    c1ok = np.empty(n, np.uint8)
+    valid = np.empty(n, np.uint8)
+    lib.fabric_marshal_batch(
+        n, xs, ys, digests, sigs, np.ascontiguousarray(sig_off, np.int32),
+        qx, qy, d1, d2, c0, c1, c1ok, valid,
+    )
+    return {
+        "qx": qx,
+        "qy": qy,
+        "d1": d1,
+        "d2": d2,
+        "cand0": c0,
+        "cand1": c1,
+        "cand1_ok": c1ok.astype(bool),
+        "valid": valid.astype(bool),
+    }
+
+
+__all__ = ["available", "marshal_batch"]
