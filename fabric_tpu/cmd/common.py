@@ -1,0 +1,78 @@
+"""Shared CLI plumbing (reference cmd/common + internal/peer/common):
+MSP-dir signer loading, endpoint parsing, proposal/transaction helpers."""
+
+from __future__ import annotations
+
+import os
+
+from fabric_tpu import protoutil
+from fabric_tpu.comm import RPCClient
+from fabric_tpu.csp import SWCSP
+from fabric_tpu.msp.identity import SigningIdentity
+from fabric_tpu.protos.peer import proposal_pb2, proposal_response_pb2
+
+
+def parse_endpoint(s: str, default_host: str = "127.0.0.1") -> tuple[str, int]:
+    host, _, port = s.rpartition(":")
+    return (host or default_host, int(port))
+
+
+def load_signer(msp_dir: str, mspid: str, csp=None) -> SigningIdentity:
+    """Load the signing identity from an MSP directory's signcerts +
+    keystore (reference msp/configbuilder.go GetLocalMspConfig)."""
+    csp = csp or SWCSP()
+
+    def first(sub):
+        d = os.path.join(msp_dir, sub)
+        names = sorted(os.listdir(d))
+        with open(os.path.join(d, names[0]), "rb") as f:
+            return f.read()
+
+    return SigningIdentity.from_pem(
+        mspid, first("signcerts"), first("keystore"), csp
+    )
+
+
+def endorse(
+    peer_endpoints: list[tuple[str, int]],
+    signer: SigningIdentity,
+    channel_id: str,
+    cc_name: str,
+    args: list[bytes],
+):
+    """Send a signed proposal to each peer; returns (proposal, responses)."""
+    prop, _txid = protoutil.create_chaincode_proposal(
+        signer.serialize(), channel_id, cc_name, args
+    )
+    signed = proposal_pb2.SignedProposal(
+        proposal_bytes=prop.SerializeToString(),
+        signature=signer.sign(prop.SerializeToString()),
+    )
+    responses = []
+    for ep in peer_endpoints:
+        raw = RPCClient(*ep).call(
+            "endorser.ProcessProposal", signed.SerializeToString()
+        )
+        responses.append(
+            proposal_response_pb2.ProposalResponse.FromString(raw)
+        )
+    return prop, responses
+
+
+def submit(
+    orderer_endpoint: tuple[str, int],
+    signer: SigningIdentity,
+    prop,
+    responses,
+) -> int:
+    """Assemble the signed transaction and broadcast it; returns status."""
+    from fabric_tpu.protos.orderer import ab_pb2
+
+    env = protoutil.create_signed_tx(prop, signer, responses)
+    raw = RPCClient(*orderer_endpoint).call(
+        "ab.Broadcast", env.SerializeToString()
+    )
+    return ab_pb2.BroadcastResponse.FromString(raw).status
+
+
+__all__ = ["parse_endpoint", "load_signer", "endorse", "submit"]

@@ -1,0 +1,91 @@
+"""configtxlator: proto <-> JSON translation + config update computation
+(reference internal/configtxlator + cmd/configtxlator).
+
+    configtxlator proto_decode --type common.Block --input b.pb [--output j]
+    configtxlator proto_encode --type common.Config --input j.json --output p
+    configtxlator compute_update --channel_id ch --original a.pb \
+        --updated b.pb --output update.pb
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from google.protobuf import json_format
+
+from fabric_tpu.protos.common import common_pb2, configtx_pb2, policies_pb2
+from fabric_tpu.protos.msp import msp_config_pb2
+from fabric_tpu.protos.orderer import ab_pb2
+
+_TYPES = {
+    "common.Block": common_pb2.Block,
+    "common.Envelope": common_pb2.Envelope,
+    "common.Payload": common_pb2.Payload,
+    "common.Config": configtx_pb2.Config,
+    "common.ConfigEnvelope": configtx_pb2.ConfigEnvelope,
+    "common.ConfigUpdate": configtx_pb2.ConfigUpdate,
+    "common.ConfigUpdateEnvelope": configtx_pb2.ConfigUpdateEnvelope,
+    "common.Policy": policies_pb2.Policy,
+    "common.SignaturePolicyEnvelope": policies_pb2.SignaturePolicyEnvelope,
+    "msp.MSPConfig": msp_config_pb2.MSPConfig,
+    "msp.FabricMSPConfig": msp_config_pb2.FabricMSPConfig,
+    "orderer.SeekInfo": ab_pb2.SeekInfo,
+}
+
+
+def _read(path):
+    if path in (None, "-"):
+        return sys.stdin.buffer.read()
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def _write(path, data: bytes):
+    if path in (None, "-"):
+        sys.stdout.buffer.write(data)
+    else:
+        with open(path, "wb") as f:
+            f.write(data)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="configtxlator")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    for name in ("proto_decode", "proto_encode"):
+        p = sub.add_parser(name)
+        p.add_argument("--type", required=True, choices=sorted(_TYPES))
+        p.add_argument("--input", default="-")
+        p.add_argument("--output", default="-")
+    cu = sub.add_parser("compute_update")
+    cu.add_argument("--channel_id", required=True)
+    cu.add_argument("--original", required=True)
+    cu.add_argument("--updated", required=True)
+    cu.add_argument("--output", default="-")
+    args = ap.parse_args(argv)
+
+    if args.cmd == "proto_decode":
+        msg = _TYPES[args.type].FromString(_read(args.input))
+        out = json_format.MessageToJson(
+            msg, preserving_proto_field_name=True
+        )
+        _write(args.output, out.encode("utf-8"))
+        return 0
+    if args.cmd == "proto_encode":
+        msg = json_format.Parse(
+            _read(args.input).decode("utf-8"), _TYPES[args.type]()
+        )
+        _write(args.output, msg.SerializeToString())
+        return 0
+
+    from fabric_tpu.common.configtx import compute_update
+
+    original = configtx_pb2.Config.FromString(_read(args.original))
+    updated = configtx_pb2.Config.FromString(_read(args.updated))
+    upd = compute_update(args.channel_id, original, updated)
+    _write(args.output, upd.SerializeToString())
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

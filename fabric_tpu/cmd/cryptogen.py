@@ -1,0 +1,100 @@
+"""cryptogen: generate a network's MSP material from crypto-config.yaml
+(reference internal/cryptogen + cmd/cryptogen).
+
+Config schema (subset of the reference's):
+
+    OrdererOrgs:
+      - Name: Orderer
+        Domain: example.com
+        Specs: [{Hostname: orderer}]
+    PeerOrgs:
+      - Name: Org1
+        Domain: org1.example.com
+        Template: {Count: 2}
+        Users: {Count: 1}
+
+Output layout mirrors the reference:
+  <out>/ordererOrganizations/<domain>/{msp, orderers/<host>.<domain>/msp}
+  <out>/peerOrganizations/<domain>/{msp, peers/..., users/Admin@<domain>/msp}
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import yaml
+
+from fabric_tpu.common.crypto import CA
+from fabric_tpu.msp.config import write_msp_dir
+
+
+def _emit_node(base: str, ca: CA, name: str, ou: str, node_ous: bool = True):
+    pair = ca.issue(name, ous=[ou])
+    d = os.path.join(base, "msp")
+    write_msp_dir(
+        d, ca, node_ous=node_ous,
+        signer_cert_pem=pair.cert_pem, signer_key_pem=pair.key_pem,
+    )
+    return pair
+
+
+def _gen_org(out_root: str, kind: str, org: dict) -> None:
+    domain = org["Domain"]
+    base = os.path.join(out_root, f"{kind}Organizations", domain)
+    ca = CA(f"ca.{domain}", domain)
+    # org-level MSP (verification material only)
+    write_msp_dir(os.path.join(base, "msp"), ca, node_ous=True)
+    os.makedirs(os.path.join(base, "ca"), exist_ok=True)
+    from cryptography.hazmat.primitives import serialization
+
+    with open(os.path.join(base, "ca", f"ca.{domain}-cert.pem"), "wb") as f:
+        f.write(ca.cert_pem)
+    with open(os.path.join(base, "ca", "priv_sk"), "wb") as f:
+        f.write(
+            ca.key.private_bytes(
+                serialization.Encoding.PEM,
+                serialization.PrivateFormat.PKCS8,
+                serialization.NoEncryption(),
+            )
+        )
+
+    node_kind = "orderers" if kind == "orderer" else "peers"
+    node_ou = "orderer" if kind == "orderer" else "peer"
+    hosts = [s["Hostname"] for s in org.get("Specs", [])]
+    count = (org.get("Template") or {}).get("Count", 0)
+    hosts += [f"peer{i}" for i in range(count)]
+    for host in hosts:
+        fqdn = f"{host}.{domain}"
+        _emit_node(
+            os.path.join(base, node_kind, fqdn), ca, fqdn, node_ou
+        )
+    # admin + users
+    _emit_node(os.path.join(base, "users", f"Admin@{domain}"), ca,
+               f"Admin@{domain}", "admin")
+    for i in range(1, (org.get("Users") or {}).get("Count", 0) + 1):
+        _emit_node(os.path.join(base, "users", f"User{i}@{domain}"), ca,
+                   f"User{i}@{domain}", "client")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="cryptogen")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    gen = sub.add_parser("generate")
+    gen.add_argument("--config", required=True)
+    gen.add_argument("--output", default="crypto-config")
+    args = ap.parse_args(argv)
+
+    with open(args.config) as f:
+        conf = yaml.safe_load(f) or {}
+    for org in conf.get("OrdererOrgs") or []:
+        _gen_org(args.output, "orderer", org)
+    for org in conf.get("PeerOrgs") or []:
+        _gen_org(args.output, "peer", org)
+    print(f"crypto material written to {args.output}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

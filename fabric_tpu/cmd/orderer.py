@@ -1,0 +1,54 @@
+"""orderer daemon CLI (reference cmd/orderer + orderer/common/server):
+
+    orderer --listen 127.0.0.1:7050 --root /var/orderer \
+        --genesis sys.block [--mspid OrdererMSP --msp-dir .../msp]
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import threading
+
+from fabric_tpu.cmd.common import load_signer, parse_endpoint
+from fabric_tpu.csp import SWCSP
+from fabric_tpu.node.orderer_node import OrdererNode
+from fabric_tpu.protos.common import common_pb2
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="orderer")
+    ap.add_argument("--listen", default="127.0.0.1:0")
+    ap.add_argument("--root", default=None)
+    ap.add_argument("--genesis", action="append", default=[])
+    ap.add_argument("--mspid")
+    ap.add_argument("--msp-dir")
+    args = ap.parse_args(argv)
+
+    blocks = []
+    for path in args.genesis:
+        with open(path, "rb") as f:
+            blocks.append(common_pb2.Block.FromString(f.read()))
+    signer = (
+        load_signer(args.msp_dir, args.mspid)
+        if args.msp_dir and args.mspid
+        else None
+    )
+    host, port = parse_endpoint(args.listen)
+    node = OrdererNode(
+        args.root, SWCSP(), signer=signer, host=host, port=port,
+        genesis_blocks=blocks,
+    )
+    node.start()
+    print(f"orderer listening on {node.addr[0]}:{node.addr[1]}", flush=True)
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    stop.wait()
+    node.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

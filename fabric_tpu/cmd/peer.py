@@ -1,0 +1,205 @@
+"""peer CLI (reference cmd/peer + internal/peer/**): node daemon, channel
+ops, chaincode invoke/query, lifecycle commands.
+
+    peer node start --listen :7051 --root /var/peer --mspid Org1MSP \
+        --msp-dir .../peers/peer0.org1/msp --orderer 127.0.0.1:7050 \
+        --chaincode mycc=my_pkg.chaincodes:MyCC
+    peer channel join --block ch.block --peer :7051
+    peer channel list --peer :7051
+    peer channel fetch newest out.block -c ch --peer :7051 --mspid ... \
+        --msp-dir ...
+    peer chaincode invoke -C ch -n mycc -a put -a k -a v --peer :7051 \
+        --orderer :7050 --mspid ... --msp-dir ...
+    peer chaincode query  -C ch -n mycc -a get -a k --peer :7051 ...
+    peer lifecycle queryinstalled/querycommitted/...
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import threading
+
+from fabric_tpu.cmd.common import endorse, load_signer, parse_endpoint, submit
+from fabric_tpu.comm import RPCClient
+from fabric_tpu.protos.common import common_pb2
+from fabric_tpu.protos.orderer import ab_pb2
+from fabric_tpu.protos.peer import configuration_pb2 as peer_cfg
+
+
+def _signer(args):
+    return load_signer(args.msp_dir, args.mspid)
+
+
+def cmd_node_start(args) -> int:
+    from fabric_tpu.csp import SWCSP
+    from fabric_tpu.node.peer_node import PeerNode
+
+    host, port = parse_endpoint(args.listen)
+    node = PeerNode(
+        args.root,
+        SWCSP(),
+        load_signer(args.msp_dir, args.mspid),
+        host=host,
+        port=port,
+        chaincode_specs=args.chaincode,
+        orderer_endpoints=[parse_endpoint(o) for o in args.orderer],
+    )
+    node.start()
+    print(f"peer listening on {node.addr[0]}:{node.addr[1]}", flush=True)
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    stop.wait()
+    node.stop()
+    return 0
+
+
+def cmd_channel_join(args) -> int:
+    with open(args.block, "rb") as f:
+        raw = f.read()
+    out = RPCClient(*parse_endpoint(args.peer)).call("admin.JoinChannel", raw)
+    print(f"joined channel {out.decode()}")
+    return 0
+
+
+def cmd_channel_list(args) -> int:
+    raw = RPCClient(*parse_endpoint(args.peer)).call("admin.Channels")
+    resp = peer_cfg.ChannelQueryResponse.FromString(raw)
+    for ch in resp.channels:
+        print(ch.channel_id)
+    return 0
+
+
+def cmd_channel_getinfo(args) -> int:
+    raw = RPCClient(*parse_endpoint(args.peer)).call(
+        "admin.Height", args.channel.encode()
+    )
+    print(f"height: {raw.decode()}")
+    return 0
+
+
+def cmd_channel_fetch(args) -> int:
+    from fabric_tpu.common.deliver import make_seek_info_envelope
+
+    if not args.peer and not args.orderer:
+        print("channel fetch requires --peer or --orderer", file=sys.stderr)
+        return 2
+    signer = _signer(args) if args.msp_dir else None
+    pos = args.position
+    start = stop = pos if pos in ("newest", "oldest") else int(pos)
+    env = make_seek_info_envelope(args.channel, start, stop, signer=signer)
+    target = args.peer or args.orderer
+    method = "deliver.Deliver" if args.peer else "ab.Deliver"
+    blk = None
+    for raw in RPCClient(*parse_endpoint(target)).stream(
+        method, env.SerializeToString()
+    ):
+        resp = ab_pb2.DeliverResponse.FromString(raw)
+        if resp.WhichOneof("Type") == "block":
+            blk = resp.block
+    if blk is None:
+        print("no block received", file=sys.stderr)
+        return 1
+    with open(args.out, "wb") as f:
+        f.write(blk.SerializeToString())
+    print(f"wrote block {blk.header.number} to {args.out}")
+    return 0
+
+
+def _cc_args(args) -> list[bytes]:
+    return [a.encode("utf-8") for a in args.arg or []]
+
+
+def cmd_chaincode_invoke(args) -> int:
+    signer = _signer(args)
+    peers = [parse_endpoint(p) for p in args.peer]
+    prop, responses = endorse(
+        peers, signer, args.channel, args.name, _cc_args(args)
+    )
+    for r in responses:
+        # same success range create_signed_tx enforces (2xx/3xx)
+        if not (200 <= r.response.status < 400):
+            print(f"endorsement failed: {r.response.message}",
+                  file=sys.stderr)
+            return 1
+    status = submit(parse_endpoint(args.orderer), signer, prop, responses)
+    ok = status == common_pb2.SUCCESS
+    print("committed" if ok else f"broadcast status {status}")
+    return 0 if ok else 1
+
+
+def cmd_chaincode_query(args) -> int:
+    signer = _signer(args)
+    _, responses = endorse(
+        [parse_endpoint(args.peer[0])], signer, args.channel, args.name,
+        _cc_args(args),
+    )
+    r = responses[0]
+    if not (200 <= r.response.status < 400):
+        print(f"query failed: {r.response.message}", file=sys.stderr)
+        return 1
+    sys.stdout.buffer.write(r.response.payload)
+    sys.stdout.write("\n")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="peer")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    node = sub.add_parser("node").add_subparsers(dest="sub", required=True)
+    start = node.add_parser("start")
+    start.add_argument("--listen", default="127.0.0.1:0")
+    start.add_argument("--root", default=None)
+    start.add_argument("--mspid", required=True)
+    start.add_argument("--msp-dir", required=True)
+    start.add_argument("--orderer", action="append", default=[])
+    start.add_argument("--chaincode", action="append", default=[])
+    start.set_defaults(fn=cmd_node_start)
+
+    chan = sub.add_parser("channel").add_subparsers(dest="sub", required=True)
+    join = chan.add_parser("join")
+    join.add_argument("--block", required=True)
+    join.add_argument("--peer", required=True)
+    join.set_defaults(fn=cmd_channel_join)
+    lst = chan.add_parser("list")
+    lst.add_argument("--peer", required=True)
+    lst.set_defaults(fn=cmd_channel_list)
+    info = chan.add_parser("getinfo")
+    info.add_argument("-c", "--channel", required=True)
+    info.add_argument("--peer", required=True)
+    info.set_defaults(fn=cmd_channel_getinfo)
+    fetch = chan.add_parser("fetch")
+    fetch.add_argument("position")  # newest | oldest | block number
+    fetch.add_argument("out")
+    fetch.add_argument("-c", "--channel", required=True)
+    fetch.add_argument("--peer")
+    fetch.add_argument("--orderer")
+    fetch.add_argument("--mspid")
+    fetch.add_argument("--msp-dir")
+    fetch.set_defaults(fn=cmd_channel_fetch)
+
+    cc = sub.add_parser("chaincode").add_subparsers(dest="sub", required=True)
+    for name, fn, needs_orderer in (
+        ("invoke", cmd_chaincode_invoke, True),
+        ("query", cmd_chaincode_query, False),
+    ):
+        p = cc.add_parser(name)
+        p.add_argument("-C", "--channel", required=True)
+        p.add_argument("-n", "--name", required=True)
+        p.add_argument("-a", "--arg", action="append", default=[])
+        p.add_argument("--peer", action="append", required=True)
+        if needs_orderer:
+            p.add_argument("--orderer", required=True)
+        p.add_argument("--mspid", required=True)
+        p.add_argument("--msp-dir", required=True)
+        p.set_defaults(fn=fn)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

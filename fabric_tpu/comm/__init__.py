@@ -1,0 +1,10 @@
+"""Process-level communication substrate (reference internal/pkg/comm):
+framed TCP RPC with unary and server-streaming calls, used by the peer
+and orderer daemons and their CLI clients."""
+
+from fabric_tpu.comm.rpc import (  # noqa: F401
+    RPCClient,
+    RPCError,
+    RPCServer,
+    Stream,
+)

@@ -1,0 +1,202 @@
+"""Minimal framed RPC over TCP.
+
+The reference's universal substrate is gRPC over mutual TLS
+(internal/pkg/comm/server.go, client.go).  This is the same
+architectural role with a deliberately small wire format:
+
+    frame   := uint32_be length | payload
+    request := uint8 method_len | method_utf8 | body
+    reply   := uint8 kind | body      kind: 0 DATA, 1 END, 2 ERR
+
+A handler returns bytes (unary: one DATA + END), an iterator of bytes
+(server streaming: DATA per item + END), or raises (ERR with message).
+Authentication rides in the payloads themselves (signed envelopes /
+SignedProposals, exactly as the reference checks creator signatures at
+the application layer on top of TLS).
+"""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import struct
+import threading
+
+KIND_DATA = 0
+KIND_END = 1
+KIND_ERR = 2
+
+_MAX_FRAME = 100 * 1024 * 1024  # reference default max message size
+
+
+class RPCError(Exception):
+    pass
+
+
+def _read_exact(sock, n: int) -> bytes | None:
+    buf = b""
+    while len(buf) < n:
+        got = sock.recv(n - len(buf))
+        if not got:
+            return None
+        buf += got
+    return buf
+
+
+def read_frame(sock) -> bytes | None:
+    hdr = _read_exact(sock, 4)
+    if hdr is None:
+        return None
+    (ln,) = struct.unpack(">I", hdr)
+    if ln > _MAX_FRAME:
+        raise RPCError(f"frame too large: {ln}")
+    return _read_exact(sock, ln)
+
+
+def write_frame(sock, payload: bytes) -> None:
+    sock.sendall(struct.pack(">I", len(payload)) + payload)
+
+
+class Stream:
+    """Server-side handle for bidirectional-ish methods: the handler may
+    read further client frames (e.g. a deliver SeekInfo stream) and send
+    DATA frames incrementally."""
+
+    def __init__(self, sock):
+        self._sock = sock
+
+    def send(self, body: bytes) -> None:
+        write_frame(self._sock, bytes([KIND_DATA]) + body)
+
+    def recv(self) -> bytes | None:
+        return read_frame(self._sock)
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        server: RPCServer = self.server.rpc  # type: ignore[attr-defined]
+        sock = self.request
+        try:
+            frame = read_frame(sock)
+            if frame is None or not frame:
+                return
+            mlen = frame[0]
+            method = frame[1:1 + mlen].decode("utf-8")
+            body = frame[1 + mlen:]
+            fn = server.methods.get(method)
+            if fn is None:
+                write_frame(
+                    sock, bytes([KIND_ERR]) + f"no method {method}".encode()
+                )
+                return
+            try:
+                out = fn(body, Stream(sock))
+            except Exception as exc:  # noqa: BLE001 — error surface to client
+                try:
+                    write_frame(
+                        sock, bytes([KIND_ERR]) + str(exc).encode("utf-8")
+                    )
+                except OSError:
+                    pass
+                return
+            if out is None:
+                write_frame(sock, bytes([KIND_END]))
+            elif isinstance(out, (bytes, bytearray)):
+                write_frame(sock, bytes([KIND_DATA]) + bytes(out))
+                write_frame(sock, bytes([KIND_END]))
+            else:  # iterator of bytes — generators raise lazily, so the
+                # iteration needs the same ERR surface as the call itself
+                try:
+                    for item in out:
+                        write_frame(sock, bytes([KIND_DATA]) + item)
+                except Exception as exc:  # noqa: BLE001
+                    write_frame(
+                        sock, bytes([KIND_ERR]) + str(exc).encode("utf-8")
+                    )
+                    return
+                write_frame(sock, bytes([KIND_END]))
+        except (ConnectionError, OSError):
+            pass
+
+
+class _ThreadingServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class RPCServer:
+    """method name -> handler(body: bytes, stream: Stream)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.methods: dict = {}
+        self._srv = _ThreadingServer((host, port), _Handler)
+        self._srv.rpc = self  # type: ignore[attr-defined]
+        self._thread: threading.Thread | None = None
+
+    @property
+    def addr(self) -> tuple[str, int]:
+        return self._srv.server_address[:2]
+
+    def register(self, method: str, fn) -> None:
+        self.methods[method] = fn
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._srv.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
+class RPCClient:
+    def __init__(self, host: str, port: int, timeout: float = 10.0):
+        self._addr = (host, port)
+        self._timeout = timeout
+
+    def _connect(self, method: str, body: bytes):
+        sock = socket.create_connection(self._addr, timeout=self._timeout)
+        m = method.encode("utf-8")
+        write_frame(sock, bytes([len(m)]) + m + body)
+        return sock
+
+    def call(self, method: str, body: bytes = b"") -> bytes:
+        """Unary call: returns the single DATA body (b"" when END-only)."""
+        sock = self._connect(method, body)
+        try:
+            data = b""
+            while True:
+                frame = read_frame(sock)
+                if frame is None:
+                    raise RPCError("connection closed mid-reply")
+                kind, rest = frame[0], frame[1:]
+                if kind == KIND_ERR:
+                    raise RPCError(rest.decode("utf-8", "replace"))
+                if kind == KIND_END:
+                    return data
+                data = rest
+        finally:
+            sock.close()
+
+    def stream(self, method: str, body: bytes = b""):
+        """Server-streaming call: yields DATA bodies until END."""
+        sock = self._connect(method, body)
+        try:
+            while True:
+                frame = read_frame(sock)
+                if frame is None:
+                    raise RPCError("connection closed mid-stream")
+                kind, rest = frame[0], frame[1:]
+                if kind == KIND_ERR:
+                    raise RPCError(rest.decode("utf-8", "replace"))
+                if kind == KIND_END:
+                    return
+                yield rest
+        finally:
+            sock.close()
+
+
+__all__ = ["RPCServer", "RPCClient", "RPCError", "Stream", "read_frame",
+           "write_frame"]

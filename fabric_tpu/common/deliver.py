@@ -138,6 +138,20 @@ class DeliverService:
         yield ("status", common_pb2.SUCCESS)
 
 
+def deliver_response_frames(service: "DeliverService", env_bytes: bytes):
+    """RPC adapter shared by the peer and orderer daemons: parse the
+    request envelope, run the deliver generator, and yield serialized
+    DeliverResponse frames."""
+    env = common_pb2.Envelope.FromString(env_bytes)
+    for kind, value in service.deliver(env):
+        resp = ab_pb2.DeliverResponse()
+        if kind == "block":
+            resp.block.CopyFrom(value)
+        else:
+            resp.status = value
+        yield resp.SerializeToString()
+
+
 def make_seek_info_envelope(
     channel_id: str,
     start: int | str,

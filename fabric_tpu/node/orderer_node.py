@@ -1,0 +1,105 @@
+"""Orderer daemon: AtomicBroadcast over the framed RPC transport.
+
+Reference: orderer/common/server/main.go Main() assembles localconfig,
+the multichannel registrar, and the Broadcast/Deliver gRPC handlers
+(server.go:159,177); channel participation (join/remove without a system
+channel, channelparticipation/restapi.go) is exposed as admin RPCs.
+
+RPC surface:
+  ab.Broadcast        Envelope -> BroadcastResponse
+  ab.Deliver          signed SeekInfo Envelope -> stream DeliverResponse
+  participation.Join  genesis Block -> channel id (join without system
+                      channel)
+  participation.List  "" -> ChannelQueryResponse (channel ids)
+"""
+
+from __future__ import annotations
+
+from fabric_tpu.comm import RPCServer
+from fabric_tpu.common.deliver import BlockNotifier, DeliverService
+from fabric_tpu.orderer.broadcast import BroadcastHandler
+from fabric_tpu.orderer.multichannel import Registrar
+from fabric_tpu.protos.common import common_pb2
+from fabric_tpu.protos.orderer import ab_pb2
+from fabric_tpu.protos.peer import configuration_pb2 as peer_cfg
+
+
+class OrdererNode:
+    def __init__(
+        self,
+        root_dir: str | None,
+        csp,
+        signer=None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        genesis_blocks: list | None = None,
+        consenter_overrides: dict | None = None,
+        node_id: int = 1,
+        transport=None,
+    ):
+        self.registrar = Registrar(
+            root_dir,
+            csp,
+            signer=signer,
+            node_id=node_id,
+            transport=transport,
+            consenter_overrides=consenter_overrides,
+        )
+        self._csp = csp
+        notifier = BlockNotifier()
+        self.deliver = DeliverService(
+            self.registrar.get_chain,
+            csp,
+            policy_path="/Channel/Readers",
+            notifier=notifier,
+        )
+        self.registrar.add_block_listener(
+            lambda ch, blk: notifier.notify()
+        )
+        self.broadcast = BroadcastHandler(self.registrar)
+        if genesis_blocks:
+            self.registrar.startup(genesis_blocks)
+
+        self.rpc = RPCServer(host, port)
+        self.rpc.register("ab.Broadcast", self._broadcast)
+        self.rpc.register("ab.Deliver", self._deliver)
+        self.rpc.register("participation.Join", self._join)
+        self.rpc.register("participation.List", self._list)
+
+    @property
+    def addr(self):
+        return self.rpc.addr
+
+    def start(self) -> None:
+        self.rpc.start()
+
+    def stop(self) -> None:
+        self.rpc.stop()
+        self.deliver.stop()
+        self.registrar.halt_all()
+
+    # -- handlers ----------------------------------------------------------
+
+    def _broadcast(self, body: bytes, stream) -> bytes:
+        env = common_pb2.Envelope.FromString(body)
+        status = self.broadcast.process_message(env)
+        return ab_pb2.BroadcastResponse(status=status).SerializeToString()
+
+    def _deliver(self, body: bytes, stream):
+        from fabric_tpu.common.deliver import deliver_response_frames
+
+        return deliver_response_frames(self.deliver, body)
+
+    def _join(self, body: bytes, stream) -> bytes:
+        blk = common_pb2.Block.FromString(body)
+        cs = self.registrar.create_chain(blk)
+        return cs.channel_id.encode("utf-8")
+
+    def _list(self, body: bytes, stream) -> bytes:
+        resp = peer_cfg.ChannelQueryResponse()
+        for ch in self.registrar.channel_list():
+            resp.channels.add().channel_id = ch
+        return resp.SerializeToString()
+
+
+__all__ = ["OrdererNode"]

@@ -1,0 +1,363 @@
+"""Peer daemon: endorsement + commit pipeline behind the RPC transport.
+
+Reference: internal/peer/node/start.go serve() assembles the peer object
+graph — gRPC endorser (core/endorser/endorser.go:296), deliver-to-client
+events (core/peer/deliverevents.go), chaincode runtime, SCCs, per-channel
+txvalidator/committer, and the deliver client pulling blocks from the
+ordering service (internal/pkg/peer/blocksprovider).
+
+RPC surface:
+  endorser.ProcessProposal  SignedProposal -> ProposalResponse
+  deliver.Deliver           signed SeekInfo Envelope -> stream
+                            DeliverResponse (the peer's committed blocks)
+  admin.JoinChannel         genesis Block -> channel id (cscc JoinChain)
+  admin.Channels            "" -> ChannelQueryResponse
+  admin.Height              channel id -> ascii int
+
+User chaincodes are supplied as "name=module.path:attr" specs (external
+builder role) or injected callables; every chaincode — user and system
+(qscc/cscc/_lifecycle) — runs through the shim stream runtime.
+"""
+
+from __future__ import annotations
+
+import importlib
+import itertools
+import os
+import threading
+
+from fabric_tpu.chaincode import ChaincodeSupport, InProcStream
+from fabric_tpu.chaincode.lifecycle import (
+    DefinitionProvider,
+    LifecycleSCC,
+    PackageStore,
+)
+from fabric_tpu.chaincode.scc import CSCC, QSCC
+from fabric_tpu.comm import RPCServer
+from fabric_tpu.common.channelconfig import bundle_from_genesis
+from fabric_tpu.common.deliver import BlockNotifier, DeliverService
+from fabric_tpu.ledger import LedgerProvider
+from fabric_tpu.peer.committer import Committer
+from fabric_tpu.peer.deliverclient import DeliverClient
+from fabric_tpu.peer.endorser import Endorser
+from fabric_tpu.peer.txvalidator import TxValidator
+from fabric_tpu.protos.common import common_pb2
+from fabric_tpu.protos.orderer import ab_pb2
+from fabric_tpu.protos.peer import configuration_pb2 as peer_cfg
+from fabric_tpu.protos.peer import proposal_pb2
+
+
+class _Channel:
+    """Per-channel resources (reference core/peer/peer.go channel map)."""
+
+    def __init__(self, node: "PeerNode", genesis: common_pb2.Block):
+        self.bundle = bundle_from_genesis(genesis, node.csp)
+        self.channel_id = self.bundle.channel_id
+        # create() is idempotent: it opens an existing ledger and only
+        # commits the genesis block when the chain is empty
+        self.ledger = node.provider.create(genesis)
+        self.definitions = DefinitionProvider(self.ledger)
+        self.validator = TxValidator(
+            self.channel_id, self.ledger, self.bundle, node.csp,
+            definition_provider=self.definitions,
+        )
+        self.committer = Committer(self.validator, self.ledger)
+        self.notifier = BlockNotifier()
+        self.committer.add_commit_listener(
+            lambda *a, **k: self.notifier.notify()
+        )
+        self.endorser = Endorser(
+            self.channel_id, self.ledger, self.bundle, node.signer,
+            node.chaincodes, node.csp,
+        )
+        self._lock = threading.Lock()
+        self.deliver_client: DeliverClient | None = None
+        if node.orderer_endpoints:
+            self.deliver_client = DeliverClient(
+                self.channel_id,
+                [
+                    _orderer_deliver_fn(ep, self.channel_id, node.signer)
+                    for ep in node.orderer_endpoints
+                ],
+                height_fn=lambda: self.ledger.height,
+                sink=self._receive_block,
+                bundle=self.bundle,
+                csp=node.csp,
+            )
+            self.deliver_client.start()
+
+    @property
+    def store(self):  # DeliverService support surface (.height,
+        # .get_block_by_number) — the ledger exposes both
+        return self.ledger
+
+    def _receive_block(self, seq: int, block_bytes: bytes) -> None:
+        blk = common_pb2.Block.FromString(block_bytes)
+        with self._lock:
+            if blk.header.number == self.ledger.height:
+                self.committer.store_block(blk)
+
+    def stop(self) -> None:
+        if self.deliver_client is not None:
+            self.deliver_client.stop()
+
+
+def _orderer_deliver_fn(endpoint: tuple[str, int], channel_id: str, signer):
+    """start_num -> iterator of Block, over the orderer's ab.Deliver."""
+    from fabric_tpu.comm import RPCClient
+    from fabric_tpu.common.deliver import make_seek_info_envelope
+
+    def connect(start_num: int):
+        client = RPCClient(*endpoint, timeout=30.0)
+        env = make_seek_info_envelope(
+            channel_id, start_num, 0x7FFFFFFFFFFFFFFF, signer=signer
+        )
+        for raw in client.stream("ab.Deliver", env.SerializeToString()):
+            resp = ab_pb2.DeliverResponse.FromString(raw)
+            if resp.WhichOneof("Type") == "block":
+                yield resp.block
+            else:
+                return
+
+    return connect
+
+
+class PeerNode:
+    def __init__(
+        self,
+        root_dir: str | None,
+        csp,
+        signer,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        chaincode_specs: list[str] | None = None,
+        chaincodes: dict | None = None,
+        orderer_endpoints: list[tuple[str, int]] | None = None,
+    ):
+        self.csp = csp
+        self.signer = signer
+        self.provider = LedgerProvider(root_dir)
+        self.orderer_endpoints = orderer_endpoints or []
+        self.channels: dict[str, _Channel] = {}
+        self._lock = threading.Lock()
+
+        # chaincode runtime: everything goes through the shim stream FSM
+        self.support = ChaincodeSupport()
+        if root_dir is None:
+            import tempfile
+
+            root_dir = tempfile.mkdtemp(prefix="fabric-peer-")
+        self.package_store = PackageStore(os.path.join(root_dir, "chaincodes"))
+        self._txid = itertools.count()
+        self.chaincodes: dict = {}
+        self._launch_scc("qscc", QSCC(self._ledger_of))
+        self._launch_scc(
+            "cscc",
+            CSCC(self.channel_list, self._config_block, self.join_channel),
+        )
+        self._launch_scc(
+            "_lifecycle",
+            LifecycleSCC(self.package_store, org_lister=self._app_orgs),
+        )
+        for spec in chaincode_specs or []:
+            name, _, target = spec.partition("=")
+            mod, _, attr = target.partition(":")
+            obj = getattr(importlib.import_module(mod), attr)
+            self.install_chaincode(name, obj() if isinstance(obj, type) else obj)
+        for name, cc in (chaincodes or {}).items():
+            self.install_chaincode(name, cc)
+
+        self.deliver = DeliverService(
+            lambda ch: self.channels.get(ch), csp,
+            policy_path="/Channel/Application/Readers",
+        )
+        # ledgermgmt-style recovery: reopen every channel this peer had
+        # joined (reference ledgermgmt.NewLedgerMgr opens all ledger ids;
+        # internal/peer/node/start.go re-initializes each channel)
+        if os.path.isdir(root_dir):
+            for entry in sorted(os.listdir(root_dir)):
+                if not os.path.isdir(os.path.join(root_dir, entry, "chains")):
+                    continue
+                ledger = self.provider.open(entry)
+                genesis = ledger.get_block_by_number(0)
+                if genesis is not None:
+                    self.join_channel(genesis)
+
+        self.rpc = RPCServer(host, port)
+        self.rpc.register("endorser.ProcessProposal", self._process_proposal)
+        self.rpc.register("deliver.Deliver", self._deliver)
+        self.rpc.register("discovery.Process", self._discovery)
+        self.rpc.register("admin.JoinChannel", self._admin_join)
+        self.rpc.register("admin.Channels", self._admin_channels)
+        self.rpc.register("admin.Height", self._admin_height)
+
+    # -- chaincode wiring --------------------------------------------------
+
+    def _launch_scc(self, name: str, cc) -> None:
+        stream = InProcStream(self.support, cc, name)
+        stream.start()
+        stream.wait_registered(self.support, name)
+        self.chaincodes[name] = self._shim_adapter(name)
+
+    def install_chaincode(self, name: str, cc) -> None:
+        """Register a user chaincode (shim Chaincode instance or plain
+        callable(sim, args))."""
+        if callable(cc) and not hasattr(cc, "invoke"):
+            self.chaincodes[name] = cc
+            return
+        self._launch_scc(name, cc)
+
+    def _shim_adapter(self, name: str):
+        def run(sim, args):
+            txid = f"{name}-{next(self._txid)}"
+            resp, _ev = self.support.execute(name, "", txid, sim, args)
+            return resp.status, resp.message, resp.payload
+
+        return run
+
+    # -- channel management ------------------------------------------------
+
+    def join_channel(self, genesis: common_pb2.Block) -> str:
+        bundle = bundle_from_genesis(genesis, self.csp)
+        with self._lock:
+            if bundle.channel_id in self.channels:
+                return bundle.channel_id
+            ch = _Channel(self, genesis)
+            self.channels[ch.channel_id] = ch
+            ch.notifier = self.deliver.notifier
+            return ch.channel_id
+
+    def channel_list(self) -> list[str]:
+        return sorted(self.channels)
+
+    def _ledger_of(self, channel_id: str):
+        ch = self.channels.get(channel_id)
+        return ch.ledger if ch else None
+
+    def _config_block(self, channel_id: str):
+        ch = self.channels.get(channel_id)
+        return ch.store.get_block_by_number(0) if ch else None
+
+    def _app_orgs(self) -> list[str]:
+        for ch in self.channels.values():
+            app = ch.bundle.application_config
+            if app is not None:
+                return sorted(o.mspid for o in app.orgs.values())
+        return []
+
+    # -- RPC handlers ------------------------------------------------------
+
+    def _process_proposal(self, body: bytes, stream) -> bytes:
+        signed = proposal_pb2.SignedProposal.FromString(body)
+        prop = proposal_pb2.Proposal.FromString(signed.proposal_bytes)
+        hdr = common_pb2.Header.FromString(prop.header)
+        chdr = common_pb2.ChannelHeader.FromString(hdr.channel_header)
+        ch = self.channels.get(chdr.channel_id)
+        if ch is None:
+            raise KeyError(f"channel {chdr.channel_id!r} not joined")
+        resp = ch.endorser.process_proposal(signed)
+        return resp.SerializeToString()
+
+    def _deliver(self, body: bytes, stream):
+        from fabric_tpu.common.deliver import deliver_response_frames
+
+        return deliver_response_frames(self.deliver, body)
+
+    def _admin_join(self, body: bytes, stream) -> bytes:
+        blk = common_pb2.Block.FromString(body)
+        return self.join_channel(blk).encode("utf-8")
+
+    def _admin_channels(self, body: bytes, stream) -> bytes:
+        resp = peer_cfg.ChannelQueryResponse()
+        for ch in self.channel_list():
+            resp.channels.add().channel_id = ch
+        return resp.SerializeToString()
+
+    def _admin_height(self, body: bytes, stream) -> bytes:
+        ch = self.channels.get(body.decode("utf-8"))
+        return str(ch.ledger.height if ch else 0).encode()
+
+    def _discovery(self, body: bytes, stream) -> bytes:
+        from fabric_tpu.discovery import PeerInfo
+        from fabric_tpu.discovery.service import (
+            DiscoveryService,
+            DiscoverySupport,
+        )
+        from fabric_tpu.protos.discovery import protocol_pb2 as dpb
+
+        def peers(channel):
+            chn = self.channels.get(channel)
+            if chn is None:
+                return []
+            host, port = self.addr
+            return [
+                PeerInfo(
+                    f"{host}:{port}",
+                    self.signer.serialize(),
+                    self.signer.mspid,
+                    chn.ledger.height,
+                    tuple(
+                        n for n in self.chaincodes
+                        if not n.startswith("_") and n not in ("qscc", "cscc")
+                    ),
+                )
+            ]
+
+        def cc_policy(channel, cc):
+            chn = self.channels.get(channel)
+            if chn is None or cc not in self.chaincodes:
+                return None
+            info = chn.definitions.validation_info(cc)
+            if info is not None and info[1]:
+                # committed definition: its validation parameter IS the
+                # endorsement policy (inline signature policies resolve
+                # directly; channel-policy references fall through to
+                # the member fallback)
+                from fabric_tpu.protos.peer import collection_pb2
+
+                try:
+                    ap = collection_pb2.ApplicationPolicy.FromString(info[1])
+                    if ap.WhichOneof("type") == "signature_policy":
+                        return ap.signature_policy
+                except Exception:
+                    pass
+            # installed but not (yet) defined: any channel member
+            from fabric_tpu.policies.signature_policy import (
+                signed_by_any_member,
+            )
+
+            app = chn.bundle.application_config
+            orgs = [o.mspid for o in app.orgs.values()] if app else []
+            return signed_by_any_member(sorted(orgs))
+
+        support = DiscoverySupport(
+            channels=self.channel_list,
+            bundle=lambda ch: self.channels[ch].bundle,
+            peers=peers,
+            msp_configs=lambda ch: {},
+            orderer_endpoints=lambda ch: {},
+            chaincode_policy=cc_policy,
+            collection_filter=lambda ch, cc, colls: (lambda p: True),
+            acl_check=lambda ch, sd: None,
+        )
+        svc = DiscoveryService(support, self.csp)
+        req = dpb.SignedRequest.FromString(body)
+        return svc.process(req).SerializeToString()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    @property
+    def addr(self):
+        return self.rpc.addr
+
+    def start(self) -> None:
+        self.rpc.start()
+
+    def stop(self) -> None:
+        self.rpc.stop()
+        self.deliver.stop()
+        for ch in self.channels.values():
+            ch.stop()
+
+
+__all__ = ["PeerNode"]

@@ -97,7 +97,10 @@ def create_proposal_response(
     sig = endorser_signer.sign(prp + endorser)
     return proposal_response_pb2.ProposalResponse(
         version=1,
-        response=proposal_pb2.Response(status=200),
+        # the chaincode's response rides on the outer message too, so
+        # clients see query payloads (reference endorser.go sets
+        # pResp.Response = res after CreateProposalResponse)
+        response=response,
         payload=prp,
         endorsement=proposal_response_pb2.Endorsement(endorser=endorser, signature=sig),
     )
