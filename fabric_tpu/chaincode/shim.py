@@ -114,13 +114,10 @@ class ChaincodeStub:
         d = shim_pb.DelState(key=key, collection=collection)
         self._call(M.DEL_STATE, d.SerializeToString())
 
-    def get_state_by_range(self, start: str, end: str, collection: str = ""):
-        """Yields (key, value) pairs."""
-        g = shim_pb.GetStateByRange(
-            start_key=start, end_key=end, collection=collection
-        )
-        resp = self._call(M.GET_STATE_BY_RANGE, g.SerializeToString())
-        qr = shim_pb.QueryResponse.FromString(resp.payload)
+    def _paged_results(self, first_resp):
+        """Drain a QueryResponse (+ QUERY_STATE_NEXT pages) into
+        (key, value) pairs — shared by range and rich queries."""
+        qr = shim_pb.QueryResponse.FromString(first_resp.payload)
         while True:
             for rb in qr.results:
                 kv = shim_pb.KV.FromString(rb.result_bytes)
@@ -130,6 +127,21 @@ class ChaincodeStub:
             nxt = shim_pb.QueryStateNext(id=qr.id)
             resp = self._call(M.QUERY_STATE_NEXT, nxt.SerializeToString())
             qr = shim_pb.QueryResponse.FromString(resp.payload)
+
+    def get_state_by_range(self, start: str, end: str, collection: str = ""):
+        """Yields (key, value) pairs."""
+        g = shim_pb.GetStateByRange(
+            start_key=start, end_key=end, collection=collection
+        )
+        resp = self._call(M.GET_STATE_BY_RANGE, g.SerializeToString())
+        yield from self._paged_results(resp)
+
+    def get_query_result(self, query: str, collection: str = ""):
+        """Rich JSON-selector query (reference shim GetQueryResult,
+        CouchDB state backend).  Yields (key, value) pairs."""
+        g = shim_pb.GetQueryResult(query=query, collection=collection)
+        resp = self._call(M.GET_QUERY_RESULT, g.SerializeToString())
+        yield from self._paged_results(resp)
 
     def get_private_data_hash(self, collection: str, key: str) -> bytes:
         g = shim_pb.GetState(key=key, collection=collection)

@@ -274,6 +274,17 @@ class ChaincodeSupport:
             iid = ctx.new_iterator_id()
             ctx.iterators[iid] = it
             return self._reply(msg, self._page(ctx, iid).SerializeToString())
+        if msg.type == M.GET_QUERY_RESULT:
+            g = shim_pb.GetQueryResult.FromString(msg.payload)
+            if g.collection:
+                rows = sim.get_private_data_query_result(
+                    ns, g.collection, g.query
+                )
+            else:
+                rows = sim.get_query_result(ns, g.query)
+            iid = ctx.new_iterator_id()
+            ctx.iterators[iid] = iter(rows)
+            return self._reply(msg, self._page(ctx, iid).SerializeToString())
         if msg.type == M.QUERY_STATE_NEXT:
             qn = shim_pb.QueryStateNext.FromString(msg.payload)
             if qn.id not in ctx.iterators:

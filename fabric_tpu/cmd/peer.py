@@ -56,6 +56,32 @@ def cmd_node_start(args) -> int:
     return 0
 
 
+def cmd_node_rebuild_dbs(args) -> int:
+    from fabric_tpu.ledger import admin
+
+    ids = admin.rebuild_dbs(args.root, args.channel)
+    for lid in ids:
+        h = admin.verify_rebuild(args.root, lid)
+        print(f"rebuilt state/history DBs for {lid} (height {h})")
+    return 0
+
+
+def cmd_node_rollback(args) -> int:
+    from fabric_tpu.ledger import admin
+
+    h = admin.rollback(args.root, args.channel, args.block_number)
+    print(f"rolled back {args.channel} to height {h}")
+    return 0
+
+
+def cmd_node_reset(args) -> int:
+    from fabric_tpu.ledger import admin
+
+    for lid, h in admin.reset(args.root).items():
+        print(f"reset {lid} to height {h}")
+    return 0
+
+
 def cmd_channel_join(args) -> int:
     with open(args.block, "rb") as f:
         raw = f.read()
@@ -158,6 +184,20 @@ def main(argv=None) -> int:
     start.add_argument("--orderer", action="append", default=[])
     start.add_argument("--chaincode", action="append", default=[])
     start.set_defaults(fn=cmd_node_start)
+    # offline repair ops (reference internal/peer/node/{reset,rollback,
+    # rebuild_dbs}.go) — run against a STOPPED peer's storage root
+    rb = node.add_parser("rebuild-dbs")
+    rb.add_argument("--root", required=True)
+    rb.add_argument("-c", "--channel", default=None)
+    rb.set_defaults(fn=cmd_node_rebuild_dbs)
+    ro = node.add_parser("rollback")
+    ro.add_argument("--root", required=True)
+    ro.add_argument("-c", "--channel", required=True)
+    ro.add_argument("-b", "--block-number", type=int, required=True)
+    ro.set_defaults(fn=cmd_node_rollback)
+    rs = node.add_parser("reset")
+    rs.add_argument("--root", required=True)
+    rs.set_defaults(fn=cmd_node_reset)
 
     chan = sub.add_parser("channel").add_subparsers(dest="sub", required=True)
     join = chan.add_parser("join")

@@ -65,7 +65,11 @@ class Bundle:
             if top not in group.groups:
                 continue
             self._collect_msps(group.groups[top], msps, csp)
-        self.msp_manager = MSPManager(msps)
+        # wrapped in the memoizing cache (reference msp/cache); safe for
+        # the bundle's lifetime since config changes build a new bundle
+        from fabric_tpu.msp.cache import CachedMSP
+
+        self.msp_manager = CachedMSP(MSPManager(msps))
         self.policy_manager: Manager = manager_from_config_group(
             "Channel", group, self.msp_manager
         )

@@ -152,6 +152,80 @@ def deliver_response_frames(service: "DeliverService", env_bytes: bytes):
         yield resp.SerializeToString()
 
 
+def filter_block(blk: common_pb2.Block):
+    """Block -> FilteredBlock (reference core/peer/deliverevents.go
+    DeliverFiltered + blockEvent conversion): txid, header type,
+    validation code, and chaincode events — no payloads, no rwsets."""
+    from fabric_tpu.protos.peer import (
+        chaincode_event_pb2,
+        events_pb2,
+        proposal_pb2,
+        proposal_response_pb2,
+        transaction_pb2,
+    )
+
+    flags = list(protoutil.tx_filter(blk))
+    out = events_pb2.FilteredBlock(number=blk.header.number)
+    for i, env_bytes in enumerate(blk.data.data):
+        ftx = out.filtered_transactions.add()
+        try:
+            env = common_pb2.Envelope.FromString(env_bytes)
+            payload = common_pb2.Payload.FromString(env.payload)
+            chdr = common_pb2.ChannelHeader.FromString(
+                payload.header.channel_header
+            )
+        except Exception:
+            continue
+        out.channel_id = chdr.channel_id
+        ftx.txid = chdr.tx_id
+        ftx.type = chdr.type
+        if i < len(flags):
+            ftx.tx_validation_code = flags[i]
+        if chdr.type != common_pb2.ENDORSER_TRANSACTION:
+            continue
+        try:
+            tx = transaction_pb2.Transaction.FromString(payload.data)
+        except Exception:
+            continue
+        actions = ftx.transaction_actions
+        for act in tx.actions:
+            # per-action isolation: one malformed action still yields an
+            # (eventless) entry so subscribers see the right action count
+            fca = actions.chaincode_actions.add()
+            try:
+                cap = transaction_pb2.ChaincodeActionPayload.FromString(
+                    act.payload
+                )
+                prp = proposal_response_pb2.ProposalResponsePayload.FromString(
+                    cap.action.proposal_response_payload
+                )
+                ca = proposal_pb2.ChaincodeAction.FromString(prp.extension)
+                if ca.events:
+                    ev = chaincode_event_pb2.ChaincodeEvent.FromString(
+                        ca.events
+                    )
+                    ev.payload = b""  # filtered: event payloads stripped
+                    fca.chaincode_event.CopyFrom(ev)
+            except Exception:
+                continue
+    return out
+
+
+def deliver_filtered_frames(service: "DeliverService", env_bytes: bytes):
+    """Filtered variant of deliver_response_frames (peer
+    DeliverFiltered service)."""
+    from fabric_tpu.protos.peer import events_pb2
+
+    env = common_pb2.Envelope.FromString(env_bytes)
+    for kind, value in service.deliver(env):
+        resp = events_pb2.DeliverResponse()
+        if kind == "block":
+            resp.filtered_block.CopyFrom(filter_block(value))
+        else:
+            resp.status = value
+        yield resp.SerializeToString()
+
+
 def make_seek_info_envelope(
     channel_id: str,
     start: int | str,

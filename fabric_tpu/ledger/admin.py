@@ -1,0 +1,135 @@
+"""Offline ledger repair operations (reference internal/peer/node/
+{reset,rollback,rebuild_dbs}.go + core/ledger/kvledger rollback/reset):
+run against a stopped peer's storage root, like the reference CLIs.
+
+- rebuild_dbs: drop the derived DBs (state/history); they are replayed
+  from the block store on next open (kvledger recovery).
+- rollback: truncate a channel's chain to a target block, then rebuild
+  the derived DBs.
+- reset: rollback every channel to its genesis block.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+
+from fabric_tpu.ledger.blkstorage import BlockStore
+from fabric_tpu.ledger.kvstore import open_kvstore
+from fabric_tpu.ledger.kvledger import LedgerProvider
+
+
+def _wipe_prefix(kv, prefix: bytes) -> None:
+    p = bytearray(prefix)
+    while p and p[-1] == 0xFF:
+        p.pop()
+    end = None
+    if p:
+        p[-1] += 1
+        end = bytes(p)
+    keys = [k for k, _ in kv.iterate(prefix, end)]
+    kv.write_batch({}, deletes=keys)
+
+
+def _derived_prefixes(ledger_id: str) -> list[bytes]:
+    return [
+        f"statedb/{ledger_id}".encode() + b"\x00\xff",
+        f"historydb/{ledger_id}".encode() + b"\x00\xff",
+    ]
+
+
+def _index_prefix(ledger_id: str) -> bytes:
+    return f"blkindex/{ledger_id}".encode() + b"\x00\xff"
+
+
+def _open_kv(root_dir: str):
+    return open_kvstore(os.path.join(root_dir, "index.sqlite"))
+
+
+def list_channels(root_dir: str) -> list[str]:
+    return sorted(
+        e for e in os.listdir(root_dir)
+        if os.path.isdir(os.path.join(root_dir, e, "chains"))
+    )
+
+
+def rebuild_dbs(root_dir: str, ledger_id: str | None = None) -> list[str]:
+    """Drop state/history DBs for one (or every) channel; next open
+    replays them from blocks (reference rebuild-dbs + RebuildDBs)."""
+    ids = [ledger_id] if ledger_id else list_channels(root_dir)
+    kv = _open_kv(root_dir)
+    try:
+        for lid in ids:
+            for p in _derived_prefixes(lid):
+                _wipe_prefix(kv, p)
+    finally:
+        kv.close()
+    return ids
+
+
+def rollback(root_dir: str, ledger_id: str, target_block: int) -> int:
+    """Truncate the channel's chain so `target_block` is the last block,
+    then drop the derived DBs for replay (reference peer node rollback +
+    kvledger/rollback.go).  Returns the new height."""
+    kv = _open_kv(root_dir)
+    try:
+        chains_dir = os.path.join(root_dir, ledger_id, "chains")
+        store = BlockStore(chains_dir, kv, name=ledger_id)
+        if store.height == 0:
+            raise ValueError(f"channel {ledger_id!r} has no blocks")
+        if target_block >= store.height:
+            raise ValueError(
+                f"target block {target_block} >= height {store.height}"
+            )
+        # stream retained blocks through a sidecar chain dir so memory
+        # stays O(1) even on long chains, then swap directories
+        tmp_dir = chains_dir + ".rollback"
+        if os.path.isdir(tmp_dir):
+            shutil.rmtree(tmp_dir)
+        tmp_name = f"{ledger_id}.rollback"
+        _wipe_prefix(kv, _index_prefix(tmp_name))
+        store2 = BlockStore(tmp_dir, kv, name=tmp_name)
+        for n in range(target_block + 1):
+            store2.add_block(store.get_block_by_number(n))
+        _wipe_prefix(kv, _index_prefix(ledger_id))
+        _wipe_prefix(kv, _index_prefix(tmp_name))
+        shutil.rmtree(chains_dir)
+        os.rename(tmp_dir, chains_dir)
+        # reindex under the real name from the swapped files
+        store3 = BlockStore(chains_dir, kv, name=ledger_id)
+        for p in _derived_prefixes(ledger_id):
+            _wipe_prefix(kv, p)
+        return store3.height
+    finally:
+        kv.close()
+
+
+def reset(root_dir: str) -> dict[str, int]:
+    """Roll every channel back to its genesis block (reference peer node
+    reset)."""
+    out = {}
+    for lid in list_channels(root_dir):
+        kv = _open_kv(root_dir)
+        try:
+            store = BlockStore(
+                os.path.join(root_dir, lid, "chains"), kv, name=lid
+            )
+            height = store.height
+        finally:
+            kv.close()
+        out[lid] = rollback(root_dir, lid, 0) if height > 1 else height
+    return out
+
+
+def verify_rebuild(root_dir: str, ledger_id: str) -> int:
+    """Open the ledger (triggering recovery replay) and return its
+    height — the post-repair sanity check."""
+    provider = LedgerProvider(root_dir)
+    try:
+        return provider.open(ledger_id).height
+    finally:
+        provider.close()
+
+
+__all__ = ["rebuild_dbs", "rollback", "reset", "list_channels",
+           "verify_rebuild"]

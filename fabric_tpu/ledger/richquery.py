@@ -1,0 +1,113 @@
+"""Rich (JSON selector) state queries — the CouchDB-backend capability
+(reference core/ledger/kvledger/txmgmt/statedb/statecouchdb with its
+Mango selector queries, surfaced to chaincode as GetQueryResult).
+
+The state backend here is ordered-KV, so selectors run as a scan with
+document matching — semantically the reference's behavior on an
+unindexed CouchDB field.  Supported selector subset: implicit equality,
+$eq $ne $gt $gte $lt $lte $in $nin $exists, dotted field paths, $and /
+$or combinators, and an optional "limit".
+
+As in the reference, rich-query results are NOT protected by MVCC
+phantom detection (statecouchdb documents this caveat); only range
+queries get hash-based phantom checks.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Iterable
+
+
+def _field(doc, path: str):
+    cur = doc
+    for part in path.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return None, False
+        cur = cur[part]
+    return cur, True
+
+
+def _cmp_ok(a, b, op: str) -> bool:
+    try:
+        if op == "$gt":
+            return a > b
+        if op == "$gte":
+            return a >= b
+        if op == "$lt":
+            return a < b
+        if op == "$lte":
+            return a <= b
+    except TypeError:
+        return False
+    return False
+
+
+def _match_cond(value, present: bool, cond) -> bool:
+    if not isinstance(cond, dict):
+        return present and value == cond
+    for op, operand in cond.items():
+        if op == "$eq":
+            if not (present and value == operand):
+                return False
+        elif op == "$ne":
+            if present and value == operand:
+                return False
+        elif op in ("$gt", "$gte", "$lt", "$lte"):
+            if not (present and _cmp_ok(value, operand, op)):
+                return False
+        elif op == "$in":
+            if not (present and value in operand):
+                return False
+        elif op == "$nin":
+            if present and value in operand:
+                return False
+        elif op == "$exists":
+            if bool(operand) != present:
+                return False
+        else:
+            raise ValueError(f"unsupported operator {op!r}")
+    return True
+
+
+def match_selector(doc, selector: dict) -> bool:
+    for key, cond in selector.items():
+        if key == "$and":
+            if not all(match_selector(doc, s) for s in cond):
+                return False
+        elif key == "$or":
+            if not any(match_selector(doc, s) for s in cond):
+                return False
+        else:
+            value, present = _field(doc, key)
+            if not _match_cond(value, present, cond):
+                return False
+    return True
+
+
+def execute_query(
+    pairs: Iterable[tuple[str, bytes]], query: str
+) -> list[tuple[str, bytes]]:
+    """Filter (key, value) pairs by a JSON selector query string."""
+    q = json.loads(query)
+    selector = q.get("selector", {}) if isinstance(q, dict) else {}
+    limit = q.get("limit") if isinstance(q, dict) else None
+    if limit is not None:
+        if not isinstance(limit, int) or isinstance(limit, bool) or limit < 0:
+            raise ValueError(f"invalid limit {limit!r}")
+    out = []
+    for key, value in pairs:
+        if limit is not None and len(out) >= limit:
+            break
+        try:
+            doc = json.loads(value.decode("utf-8"))
+        except Exception:
+            continue  # non-JSON values never match (couchdb attachments)
+        if not isinstance(doc, dict):
+            continue
+        if match_selector(doc, selector):
+            out.append((key, value))
+    return out
+
+
+__all__ = ["match_selector", "execute_query"]

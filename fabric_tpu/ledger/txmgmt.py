@@ -191,6 +191,35 @@ class TxSimulator:
             for key, vv in self._db.get_state_range(pvt_ns(ns, coll), start, end)
         ]
 
+    def get_query_result(self, ns: str, query: str):
+        """Rich JSON-selector query (reference GetQueryResult via the
+        CouchDB backend).  Every RETURNED key is recorded in the read set
+        for MVCC version checks (reference queryHelper adds each result
+        to the rwset); only phantoms go unprotected, matching the
+        reference's couchdb caveat."""
+        from fabric_tpu.ledger.richquery import execute_query
+
+        versions = {}
+
+        def pairs():
+            for key, vv in self._db.get_state_range(ns, "", ""):
+                versions[key] = vv.version
+                yield key, vv.value
+
+        out = execute_query(pairs(), query)
+        for key, _ in out:
+            self._reads.setdefault((ns, key), versions[key])
+        return out
+
+    def get_private_data_query_result(self, ns: str, coll: str, query: str):
+        from fabric_tpu.ledger.richquery import execute_query
+
+        pairs = (
+            (key, vv.value)
+            for key, vv in self._db.get_state_range(pvt_ns(ns, coll), "", "")
+        )
+        return execute_query(pairs, query)
+
     def get_state_range(self, ns: str, start: str, end: str):
         """Returns [(key, value)] and records the range query for phantom
         detection at validation time."""

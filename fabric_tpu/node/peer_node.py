@@ -186,6 +186,7 @@ class PeerNode:
         self.rpc = RPCServer(host, port)
         self.rpc.register("endorser.ProcessProposal", self._process_proposal)
         self.rpc.register("deliver.Deliver", self._deliver)
+        self.rpc.register("deliver.DeliverFiltered", self._deliver_filtered)
         self.rpc.register("discovery.Process", self._discovery)
         self.rpc.register("admin.JoinChannel", self._admin_join)
         self.rpc.register("admin.Channels", self._admin_channels)
@@ -262,6 +263,11 @@ class PeerNode:
         from fabric_tpu.common.deliver import deliver_response_frames
 
         return deliver_response_frames(self.deliver, body)
+
+    def _deliver_filtered(self, body: bytes, stream):
+        from fabric_tpu.common.deliver import deliver_filtered_frames
+
+        return deliver_filtered_frames(self.deliver, body)
 
     def _admin_join(self, body: bytes, stream) -> bytes:
         blk = common_pb2.Block.FromString(body)

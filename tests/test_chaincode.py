@@ -175,3 +175,39 @@ def test_external_process_chaincode(support, sim, tmp_path):
     finally:
         proc.kill()
         listener.close()
+
+
+def test_rich_query_via_shim(support, sim):
+    """GetQueryResult: JSON selector over namespace state (reference shim
+    GetQueryResult backed by the CouchDB-style rich query engine)."""
+    import json
+
+    from fabric_tpu.ledger.statedb import Height, VersionedValue
+
+    class RichCC(Chaincode):
+        def invoke(self, stub):
+            op, params = stub.get_function_and_parameters()
+            if op == "query":
+                rows = list(stub.get_query_result(params[0].decode()))
+                return success(json.dumps([k for k, _ in rows]).encode())
+            return error("bad op")
+
+    # rich queries read COMMITTED state only (the reference's couchdb
+    # semantics: a tx's own pending writes are not visible to queries)
+    sim._db.apply_updates(
+        {
+            "richcc": {
+                f"doc{i}": VersionedValue(
+                    json.dumps({"type": "t%d" % (i % 2), "n": i}).encode(),
+                    Height(1, i),
+                )
+                for i in range(4)
+            }
+        },
+        Height(1, 4),
+    )
+    _launch(support, "richcc", RichCC())
+    q = json.dumps({"selector": {"type": "t1", "n": {"$gt": 1}}}).encode()
+    resp, _ = support.execute("richcc", "ch", "rq2", sim, [b"query", q])
+    assert resp.status == 200
+    assert json.loads(resp.payload) == ["doc3"]

@@ -1,0 +1,200 @@
+"""Ledger repair ops (peer node rebuild-dbs / rollback / reset), rich
+JSON-selector queries, filtered-block deliver, and the caching MSP."""
+
+import json
+
+import pytest
+
+from fabric_tpu.ledger import LedgerProvider
+from fabric_tpu.ledger import admin
+from fabric_tpu.ledger.richquery import execute_query, match_selector
+
+
+# -- rich queries ----------------------------------------------------------
+
+
+class TestRichQuery:
+    def test_selectors(self):
+        doc = {"color": "red", "size": 5, "owner": {"org": "Org1"}}
+        assert match_selector(doc, {"color": "red"})
+        assert not match_selector(doc, {"color": "blue"})
+        assert match_selector(doc, {"size": {"$gt": 3, "$lte": 5}})
+        assert match_selector(doc, {"owner.org": "Org1"})
+        assert match_selector(doc, {"color": {"$in": ["red", "blue"]}})
+        assert match_selector(doc, {"weight": {"$exists": False}})
+        assert not match_selector(doc, {"size": {"$ne": 5}})
+        assert match_selector(
+            doc, {"$or": [{"color": "blue"}, {"size": {"$gte": 5}}]}
+        )
+
+    def test_execute_query_scan(self):
+        pairs = [
+            ("a1", json.dumps({"t": "car", "price": 10}).encode()),
+            ("a2", json.dumps({"t": "car", "price": 30}).encode()),
+            ("a3", json.dumps({"t": "boat", "price": 30}).encode()),
+            ("a4", b"not-json"),
+        ]
+        q = json.dumps({"selector": {"t": "car", "price": {"$gt": 5}}})
+        assert [k for k, _ in execute_query(pairs, q)] == ["a1", "a2"]
+        q = json.dumps({"selector": {"price": {"$gte": 10}}, "limit": 2})
+        assert len(execute_query(pairs, q)) == 2
+
+    def test_simulator_get_query_result(self):
+        from fabric_tpu.ledger.kvstore import MemKVStore
+        from fabric_tpu.ledger.statedb import Height, VersionedDB, VersionedValue
+        from fabric_tpu.ledger.txmgmt import TxSimulator
+
+        db = VersionedDB(MemKVStore())
+        db.apply_updates(
+            {
+                "cc": {
+                    "m1": VersionedValue(
+                        json.dumps({"make": "tesla"}).encode(), Height(1, 0)
+                    ),
+                    "m2": VersionedValue(
+                        json.dumps({"make": "ford"}).encode(), Height(1, 1)
+                    ),
+                }
+            },
+            Height(1, 2),
+        )
+        sim = TxSimulator(db)
+        rows = sim.get_query_result(
+            "cc", json.dumps({"selector": {"make": "tesla"}})
+        )
+        assert [k for k, _ in rows] == ["m1"]
+
+
+# -- repair ops ------------------------------------------------------------
+
+
+def _make_chain(tmp_path, n_blocks=3):
+    """A committed chain via the devnode-free path: genesis + n blocks."""
+    from orgfix import make_org
+    from fabric_tpu.common import configtx_builder as ctx
+    from fabric_tpu.msp import msp_config_from_ca
+    from fabric_tpu.node.devnode import DevNode
+
+    org = make_org("Org1MSP")
+    oorg = make_org("OrdererMSP")
+    app = ctx.application_group(
+        {"Org1": ctx.org_group("Org1MSP", msp_config_from_ca(org.ca, "Org1MSP"))}
+    )
+    ordg = ctx.orderer_group(
+        {"O": ctx.org_group("OrdererMSP", msp_config_from_ca(oorg.ca, "OrdererMSP"))},
+        consensus_type="solo",
+        max_message_count=1,
+    )
+    genesis = ctx.genesis_block("repairch", ctx.channel_group(app, ordg))
+    peer = org.signer("peer0", role_ou="peer")
+    client = org.signer("user", role_ou="client")
+
+    def kv(sim, args):
+        sim.set_state("kv", args[0].decode(), args[1])
+        return 200, "", b""
+
+    node = DevNode(
+        genesis, root_dir=str(tmp_path), csp=org.csp, peer_signer=peer,
+        chaincodes={"kv": kv}, batch_timeout_s=0.05,
+    )
+    from fabric_tpu import protoutil
+    from fabric_tpu.protos.peer import proposal_pb2
+
+    for i in range(n_blocks):
+        prop, _ = protoutil.create_chaincode_proposal(
+            client.serialize(), "repairch", "kv",
+            [b"k%d" % i, b"v%d" % i],
+        )
+        signed = proposal_pb2.SignedProposal(
+            proposal_bytes=prop.SerializeToString(),
+            signature=client.sign(prop.SerializeToString()),
+        )
+        resp = node.endorser.process_proposal(signed)
+        env = protoutil.create_signed_tx(prop, client, [resp])
+        node.broadcast(env)
+        node.wait_commit()
+    node.shutdown()
+    node.provider.close()
+    return "repairch"
+
+
+def test_rebuild_dbs_replays_state(tmp_path):
+    lid = _make_chain(tmp_path, 3)
+    assert admin.rebuild_dbs(str(tmp_path)) == [lid]
+    provider = LedgerProvider(str(tmp_path))
+    ledger = provider.open(lid)
+    assert ledger.height == 4
+    assert ledger.get_state("kv", "k2") == b"v2"
+    provider.close()
+
+
+def test_rollback_truncates_and_replays(tmp_path):
+    lid = _make_chain(tmp_path, 3)
+    assert admin.rollback(str(tmp_path), lid, 2) == 3
+    provider = LedgerProvider(str(tmp_path))
+    ledger = provider.open(lid)
+    assert ledger.height == 3
+    assert ledger.get_state("kv", "k1") == b"v1"
+    assert ledger.get_state("kv", "k2") is None  # rolled off
+    provider.close()
+
+
+def test_reset_to_genesis(tmp_path):
+    lid = _make_chain(tmp_path, 2)
+    assert admin.reset(str(tmp_path)) == {lid: 1}
+    provider = LedgerProvider(str(tmp_path))
+    ledger = provider.open(lid)
+    assert ledger.height == 1
+    assert ledger.get_state("kv", "k0") is None
+    provider.close()
+
+
+# -- filtered blocks -------------------------------------------------------
+
+
+def test_filter_block(tmp_path):
+    lid = _make_chain(tmp_path, 1)
+    provider = LedgerProvider(str(tmp_path))
+    ledger = provider.open(lid)
+    blk = ledger.get_block_by_number(1)
+    from fabric_tpu.common.deliver import filter_block
+    from fabric_tpu.protos.peer import transaction_pb2 as V
+
+    fb = filter_block(blk)
+    assert fb.number == 1 and fb.channel_id == "repairch"
+    assert len(fb.filtered_transactions) == 1
+    ftx = fb.filtered_transactions[0]
+    assert ftx.txid and ftx.tx_validation_code == V.VALID
+    # no payloads/rwsets travel in a filtered block
+    assert len(fb.SerializeToString()) < len(blk.SerializeToString()) / 4
+    provider.close()
+
+
+# -- MSP cache -------------------------------------------------------------
+
+def test_cached_msp_memoizes():
+    from orgfix import make_org
+    from fabric_tpu.msp.cache import CachedMSP
+
+    org = make_org("Org1MSP")
+    signer = org.signer("peer0")
+    raw = signer.serialize()
+
+    calls = {"de": 0, "val": 0}
+
+    class Spy:
+        def deserialize_identity(self, s):
+            calls["de"] += 1
+            return org.msp.deserialize_identity(s)
+
+        def validate(self, ident):
+            calls["val"] += 1
+            return org.msp.validate(ident)
+
+    cached = CachedMSP(Spy())
+    i1 = cached.deserialize_identity(raw)
+    i2 = cached.deserialize_identity(raw)
+    assert calls["de"] == 1 and i1 is i2
+    cached.validate(i1)
+    cached.validate(i2)
+    assert calls["val"] == 1
