@@ -1,0 +1,216 @@
+"""Kafka consenter (legacy CFT path; reference orderer/consensus/kafka).
+
+The reference orders a channel by publishing wrapped messages to one
+Kafka topic partition and replaying the partition in offset order
+(chain.go processMessagesToBlocks): REGULAR messages feed the block
+cutter, a TIME-TO-CUT message (posted when the batch timer fires) cuts
+the pending batch so every orderer cuts at the same offset, and CONNECT
+probes establish liveness.  The partition is the ordering oracle — the
+consenter itself is deterministic replay.
+
+`Partition` is the broker seam: the in-process implementation stands in
+for a Kafka topic partition exactly the way integration/nwo stands up
+Kafka in a container; a real broker client can implement the same
+append/consume surface.  Deprecated in the reference in favor of Raft —
+kept for capability parity.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+
+from fabric_tpu.orderer.blockcutter import BlockCutter
+from fabric_tpu.orderer.blockwriter import BlockWriter
+
+
+class Partition:
+    """An append-only, offset-addressed message log (one topic
+    partition).  Thread-safe; consumers poll from any offset."""
+
+    def __init__(self):
+        self._log: list[bytes] = []
+        self._cond = threading.Condition()
+
+    def append(self, msg: bytes) -> int:
+        with self._cond:
+            self._log.append(msg)
+            self._cond.notify_all()
+            return len(self._log) - 1
+
+    def get(self, offset: int, timeout: float = 0.25) -> bytes | None:
+        with self._cond:
+            if offset >= len(self._log):
+                self._cond.wait(timeout)
+            if offset < len(self._log):
+                return self._log[offset]
+            return None
+
+
+class InProcBroker:
+    """Partition registry keyed by channel (the dev/test 'cluster').
+    Pass ONE broker instance to every replica of a network — there is
+    deliberately no process-global default, so unrelated registrars in
+    one process can never cross-consume each other's channels."""
+
+    def __init__(self):
+        self._parts: dict[str, Partition] = {}
+        self._lock = threading.Lock()
+
+    def partition(self, channel_id: str) -> Partition:
+        with self._lock:
+            return self._parts.setdefault(channel_id, Partition())
+
+
+def _wrap(kind: str, payload: bytes = b"", block_number: int = 0) -> bytes:
+    return json.dumps(
+        {
+            "type": kind,
+            "payload": payload.hex(),
+            "block_number": block_number,
+        }
+    ).encode()
+
+
+_ORDERER_METADATA_INDEX = 3  # common.BlockMetadataIndex.ORDERER
+
+
+def _persisted_offset(last_block) -> int:
+    """Offset after the last consumed message, from block metadata."""
+    if last_block is None:
+        return 0
+    md = last_block.metadata.metadata
+    if len(md) > _ORDERER_METADATA_INDEX and md[_ORDERER_METADATA_INDEX]:
+        try:
+            return json.loads(md[_ORDERER_METADATA_INDEX])["next_offset"]
+        except Exception:
+            return 0
+    return 0
+
+
+class KafkaChain:
+    """Consenter replaying a partition in offset order (reference
+    kafka/chain.go).  Multiple orderers on the same partition write
+    identical chains."""
+
+    def __init__(
+        self,
+        channel_id: str,
+        cutter: BlockCutter,
+        writer: BlockWriter,
+        broker: InProcBroker,
+        batch_timeout_s: float = 2.0,
+        on_block=None,
+        start_offset: int | None = None,
+    ):
+        if broker is None:
+            raise ValueError("kafka consenter requires a broker")
+        self._partition = broker.partition(channel_id)
+        self._cutter = cutter
+        self._writer = writer
+        self._timeout = batch_timeout_s
+        self._on_block = on_block or (lambda blk: None)
+        # resume from the offset persisted in the last block's ORDERER
+        # metadata (reference: lastOffsetPersisted in Kafka metadata),
+        # so a restart over an existing ledger does not replay txs
+        if start_offset is None:
+            start_offset = _persisted_offset(writer.last_block())
+        self._offset = start_offset
+        self._halted = threading.Event()
+        self._timer: threading.Timer | None = None
+        # the block number the next TIME-TO-CUT refers to; replicas on
+        # the same partition starting from the same height agree
+        self._pending_block = writer.height
+        self._lock = threading.Lock()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    # -- consensus SPI -----------------------------------------------------
+
+    def start(self) -> None:
+        self._partition.append(_wrap("connect"))
+        self._thread.start()
+
+    def halt(self) -> None:
+        self._halted.set()
+        self._thread.join(timeout=5)
+        self._cancel_timer()
+
+    def wait_ready(self) -> None:
+        return
+
+    def order(self, env, config_seq: int = 0) -> None:
+        if self._halted.is_set():
+            raise RuntimeError("chain is halted")
+        self._partition.append(_wrap("normal", env.SerializeToString()))
+
+    def configure(self, env, config_seq: int = 0) -> None:
+        if self._halted.is_set():
+            raise RuntimeError("chain is halted")
+        self._partition.append(_wrap("config", env.SerializeToString()))
+
+    # -- partition replay --------------------------------------------------
+
+    def _arm_timer(self) -> None:
+        with self._lock:
+            if self._timer is None:
+                block_number = self._pending_block
+                self._timer = threading.Timer(
+                    self._timeout,
+                    lambda: self._partition.append(
+                        _wrap("timetocut", block_number=block_number)
+                    ),
+                )
+                self._timer.daemon = True
+                self._timer.start()
+
+    def _cancel_timer(self) -> None:
+        with self._lock:
+            if self._timer is not None:
+                self._timer.cancel()
+                self._timer = None
+
+    def _emit(self, batch: list[bytes], is_config: bool = False) -> None:
+        if not batch:
+            return
+        blk = self._writer.create_next_block(batch)
+        while len(blk.metadata.metadata) <= _ORDERER_METADATA_INDEX:
+            blk.metadata.metadata.append(b"")
+        blk.metadata.metadata[_ORDERER_METADATA_INDEX] = json.dumps(
+            {"next_offset": self._offset}
+        ).encode()
+        self._writer.write_block(blk, is_config=is_config)
+        self._pending_block += 1
+        self._on_block(blk)
+
+    def _run(self) -> None:
+        while not self._halted.is_set():
+            raw = self._partition.get(self._offset)
+            if raw is None:
+                continue
+            self._offset += 1
+            msg = json.loads(raw)
+            kind = msg["type"]
+            if kind == "connect":
+                continue
+            if kind == "timetocut":
+                # every replica cuts at the same offset; stale TTCs (for
+                # an already-cut block) are ignored (chain.go:TTC check)
+                if msg["block_number"] == self._pending_block:
+                    self._cancel_timer()
+                    self._emit(self._cutter.cut())
+                continue
+            payload = bytes.fromhex(msg["payload"])
+            if kind == "config":
+                self._cancel_timer()
+                self._emit(self._cutter.cut())
+                self._emit([payload], is_config=True)
+                continue
+            batches, pending = self._cutter.ordered(payload)
+            for batch in batches:
+                self._cancel_timer()
+                self._emit(batch)
+            if pending:
+                self._arm_timer()
+
+
+__all__ = ["KafkaChain", "InProcBroker", "Partition"]

@@ -66,7 +66,12 @@ class Registrar:
         for blk in genesis_blocks:
             self.create_chain(blk)
 
-    def create_chain(self, genesis: common_pb2.Block) -> ChainSupport:
+    def create_chain(
+        self, genesis: common_pb2.Block, extra_blocks=None
+    ) -> ChainSupport:
+        """extra_blocks: pre-verified blocks 1..N to seed after genesis
+        (cluster onboarding) — appended BEFORE the consenter starts so
+        nothing races the block numbering."""
         bundle = bundle_from_genesis(genesis, self.csp)
         channel_id = bundle.channel_id
         with self._lock:
@@ -80,6 +85,9 @@ class Registrar:
         store = BlockStore(store_dir, name=f"orderer-{channel_id}")
         if store.height == 0:
             store.add_block(genesis)
+        for blk in extra_blocks or []:
+            if blk.header.number == store.height:
+                store.add_block(blk)
         writer = BlockWriter(store, signer=self.signer)
         oc = bundle.orderer_config
         cutter = BlockCutter.from_orderer_config(oc) if oc else BlockCutter()
@@ -128,6 +136,27 @@ class Registrar:
             if self.transport is not None:
                 self.transport.register_channel(channel_id, chain.handle_step)
             return chain
+        if ctype == "kafka":
+            from fabric_tpu.orderer.kafka import KafkaChain
+
+            broker = self._consenter_overrides.get("broker")
+            if broker is None:
+                raise ValueError(
+                    "kafka consensus requires a broker in "
+                    "consenter_overrides (InProcBroker or a client with "
+                    "the same partition surface)"
+                )
+            return KafkaChain(
+                channel_id,
+                cutter,
+                writer,
+                broker=broker,
+                batch_timeout_s=timeout,
+                on_block=on_block,
+                start_offset=self._consenter_overrides.get(
+                    "kafka_start_offset"
+                ),
+            )
         return SoloChain(cutter, writer, timeout, on_block=on_block)
 
     # -- lookups (BroadcastChannelSupport / GetChain) ----------------------

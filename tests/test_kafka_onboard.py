@@ -1,0 +1,173 @@
+"""Kafka legacy consenter (partition replay determinism, time-to-cut)
+and orderer cluster onboarding (pull + verify an existing chain)."""
+
+import json
+import time
+
+import pytest
+
+from orgfix import make_org
+from fabric_tpu.common import configtx_builder as ctx
+from fabric_tpu.csp import SWCSP
+from fabric_tpu.msp import msp_config_from_ca
+from fabric_tpu.protos.common import common_pb2
+from fabric_tpu import protoutil
+
+
+def _genesis(channel="kafkach", consensus="kafka", max_msgs=3):
+    org = make_org("Org1MSP")
+    oorg = make_org("OrdererMSP")
+    app = ctx.application_group(
+        {"Org1": ctx.org_group("Org1MSP", msp_config_from_ca(org.ca, "Org1MSP"))}
+    )
+    ordg = ctx.orderer_group(
+        {"O": ctx.org_group("OrdererMSP", msp_config_from_ca(oorg.ca, "OrdererMSP"))},
+        consensus_type=consensus,
+        max_message_count=max_msgs,
+        batch_timeout="150ms",
+    )
+    blk = ctx.genesis_block(channel, ctx.channel_group(app, ordg))
+    return blk, org, oorg
+
+
+def _env(org, channel, n):
+    client = org.signer(f"user{n}", role_ou="client")
+    chdr = protoutil.make_channel_header(
+        common_pb2.ENDORSER_TRANSACTION, channel_id=channel
+    )
+    shdr = protoutil.make_signature_header(
+        client.serialize(), protoutil.random_nonce()
+    )
+    payload = protoutil.make_payload_bytes(chdr, shdr, b"tx-%d" % n)
+    return common_pb2.Envelope(payload=payload, signature=client.sign(payload))
+
+
+def _wait_height(store, want, timeout=5.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if store.height >= want:
+            return
+        time.sleep(0.05)
+    raise TimeoutError(f"height {store.height} never reached {want}")
+
+
+class TestKafkaConsenter:
+    def test_two_replicas_write_identical_chains(self):
+        from fabric_tpu.orderer.kafka import InProcBroker
+        from fabric_tpu.orderer.multichannel import Registrar
+
+        genesis, org, _ = _genesis()
+        broker = InProcBroker()
+        csp = SWCSP()
+        regs = [
+            Registrar(None, csp, consenter_overrides={"broker": broker})
+            for _ in range(2)
+        ]
+        chains = [r.create_chain(genesis) for r in regs]
+        # submit through replica 0 only; both replay the same partition
+        for i in range(7):
+            chains[0].chain.order(_env(org, "kafkach", i))
+        for cs in chains:
+            _wait_height(cs.store, 3)  # genesis + 2 full batches (3+3)
+        # time-to-cut flushes the trailing partial batch everywhere
+        for cs in chains:
+            _wait_height(cs.store, 4)
+        a = [chains[0].store.get_block_by_number(n).SerializeToString()
+             for n in range(4)]
+        b = [chains[1].store.get_block_by_number(n).SerializeToString()
+             for n in range(4)]
+        assert a == b
+        for r in regs:
+            r.halt_all()
+
+    def test_config_isolated_in_own_block(self):
+        from fabric_tpu.orderer.kafka import InProcBroker
+        from fabric_tpu.orderer.multichannel import Registrar
+
+        genesis, org, _ = _genesis(max_msgs=10)
+        reg = Registrar(
+            None, SWCSP(), consenter_overrides={"broker": InProcBroker()}
+        )
+        cs = reg.create_chain(genesis)
+        cs.chain.order(_env(org, "kafkach", 0))
+        cs.chain.configure(_env(org, "kafkach", 1))
+        _wait_height(cs.store, 3)
+        assert len(cs.store.get_block_by_number(1).data.data) == 1
+        assert len(cs.store.get_block_by_number(2).data.data) == 1
+        reg.halt_all()
+
+
+class TestKafkaRestart:
+    def test_restart_resumes_from_persisted_offset(self, tmp_path):
+        from fabric_tpu.orderer.kafka import InProcBroker
+        from fabric_tpu.orderer.multichannel import Registrar
+
+        genesis, org, _ = _genesis(max_msgs=2)
+        broker = InProcBroker()
+        reg = Registrar(
+            str(tmp_path), SWCSP(),
+            consenter_overrides={"broker": broker},
+        )
+        cs = reg.create_chain(genesis)
+        for i in range(4):
+            cs.chain.order(_env(org, "kafkach", i))
+        _wait_height(cs.store, 3)
+        reg.halt_all()
+
+        # restart over the same ledger + retained partition: the offset
+        # persisted in ORDERER block metadata prevents tx replay
+        reg2 = Registrar(
+            str(tmp_path), SWCSP(),
+            consenter_overrides={"broker": broker},
+        )
+        cs2 = reg2.create_chain(genesis)
+        assert cs2.store.height == 3
+        time.sleep(0.5)  # give a buggy replay time to manifest
+        assert cs2.store.height == 3  # nothing re-committed
+        cs2.chain.order(_env(org, "kafkach", 9))
+        cs2.chain.order(_env(org, "kafkach", 10))
+        _wait_height(cs2.store, 4)
+        assert len(cs2.store.get_block_by_number(3).data.data) == 2
+        reg2.halt_all()
+
+
+class TestOnboarding:
+    def test_orderer_pulls_existing_chain(self, tmp_path):
+        from fabric_tpu.comm import RPCClient
+        from fabric_tpu.node.orderer_node import OrdererNode
+
+        genesis, org, oorg = _genesis(consensus="solo", max_msgs=1)
+        osigner = oorg.signer("orderer0", role_ou="orderer")
+        src = OrdererNode(
+            str(tmp_path / "src"), org.csp, signer=osigner,
+            genesis_blocks=[genesis],
+        )
+        src.start()
+        # grow the source chain
+        cs = src.registrar.get_chain("kafkach")
+        for i in range(3):
+            cs.chain.order(_env(org, "kafkach", i))
+        _wait_height(cs.store, 4)
+
+        dst = OrdererNode(
+            str(tmp_path / "dst"), org.csp, signer=osigner,
+        )
+        dst.start()
+        out = RPCClient(*dst.addr).call(
+            "participation.Onboard",
+            json.dumps(
+                {"channel": "kafkach",
+                 "from": f"{src.addr[0]}:{src.addr[1]}",
+                 "genesis": genesis.SerializeToString().hex()}
+            ).encode(),
+        )
+        res = json.loads(out)
+        assert res == {"channel": "kafkach", "height": 4}
+        dcs = dst.registrar.get_chain("kafkach")
+        for n in range(4):
+            assert (
+                dcs.store.get_block_by_number(n).SerializeToString()
+                == cs.store.get_block_by_number(n).SerializeToString()
+            )
+        src.stop()
+        dst.stop()
