@@ -33,9 +33,11 @@ def _signer(args):
 
 
 def cmd_node_start(args) -> int:
+    from fabric_tpu.common.diag import install_signal_handler
     from fabric_tpu.csp import SWCSP
     from fabric_tpu.node.peer_node import PeerNode
 
+    install_signal_handler()  # SIGUSR1 -> thread dump (common/diag)
     host, port = parse_endpoint(args.listen)
     node = PeerNode(
         args.root,
@@ -45,6 +47,7 @@ def cmd_node_start(args) -> int:
         port=port,
         chaincode_specs=args.chaincode,
         orderer_endpoints=[parse_endpoint(o) for o in args.orderer],
+        operations_port=args.operations_port,
     )
     node.start()
     print(f"peer listening on {node.addr[0]}:{node.addr[1]}", flush=True)
@@ -183,6 +186,7 @@ def main(argv=None) -> int:
     start.add_argument("--msp-dir", required=True)
     start.add_argument("--orderer", action="append", default=[])
     start.add_argument("--chaincode", action="append", default=[])
+    start.add_argument("--operations-port", type=int, default=None)
     start.set_defaults(fn=cmd_node_start)
     # offline repair ops (reference internal/peer/node/{reset,rollback,
     # rebuild_dbs}.go) — run against a STOPPED peer's storage root

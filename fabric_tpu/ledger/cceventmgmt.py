@@ -1,0 +1,62 @@
+"""Chaincode lifecycle event management (reference
+core/ledger/cceventmgmt): listeners — state-db index builders, the
+lifecycle cache — are notified when a chaincode definition is committed
+to a channel or a package matching a committed definition is installed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaincodeDefinitionEvent:
+    channel_id: str
+    name: str
+    version: str
+    sequence: int
+
+
+class ChaincodeEventMgr:
+    """Singleton-style registry (reference cceventmgmt.GetMgr): the
+    committer calls `handle_definition_committed` after a block carrying
+    a _lifecycle commit lands; install flows call `handle_installed`."""
+
+    def __init__(self):
+        self._listeners: dict[str, list] = {}
+        self._global: list = []
+        self._lock = threading.Lock()
+
+    def register(self, channel_id: str | None, listener) -> None:
+        """listener(event) -> None; channel_id None = all channels."""
+        with self._lock:
+            if channel_id is None:
+                self._global.append(listener)
+            else:
+                self._listeners.setdefault(channel_id, []).append(listener)
+
+    def _fire(self, event: ChaincodeDefinitionEvent) -> None:
+        with self._lock:
+            targets = list(self._global) + list(
+                self._listeners.get(event.channel_id, [])
+            )
+        for fn in targets:
+            try:
+                fn(event)
+            except Exception:
+                pass  # listener errors never poison the commit path
+
+    def handle_definition_committed(
+        self, channel_id: str, name: str, version: str, sequence: int
+    ) -> None:
+        self._fire(
+            ChaincodeDefinitionEvent(channel_id, name, version, sequence)
+        )
+
+    def handle_installed(self, channel_id: str, name: str,
+                         version: str) -> None:
+        self._fire(ChaincodeDefinitionEvent(channel_id, name, version, 0))
+
+
+__all__ = ["ChaincodeEventMgr", "ChaincodeDefinitionEvent"]

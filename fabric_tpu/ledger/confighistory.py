@@ -1,0 +1,60 @@
+"""Config history store (reference core/ledger/confighistory): tracks
+each chaincode's collection-config package by committing block number so
+deliver-time private-data decisions and the reconciler can ask "what was
+the collection config for namespace X as of block N".
+"""
+
+from __future__ import annotations
+
+import struct
+
+from fabric_tpu.ledger.kvstore import KVStore, NamedDB
+
+
+def _key(ns: str, block_num: int) -> bytes:
+    # descending block order under each namespace: the FIRST entry with
+    # key >= (ns, ~block) is the most recent config at or below block
+    return ns.encode() + b"\x00" + struct.pack(">Q", 0xFFFFFFFFFFFFFFFF - block_num)
+
+
+class ConfigHistoryRetriever:
+    def __init__(self, db: NamedDB):
+        self._db = db
+
+    def most_recent_below(
+        self, ns: str, block_num: int
+    ) -> tuple[int, bytes] | None:
+        """Most recent collection config committed at a block STRICTLY
+        below `block_num` (reference MostRecentCollectionConfigBelow).
+        Returns (committing_block, serialized config) or None."""
+        start = _key(ns, block_num - 1)
+        end = ns.encode() + b"\x01"
+        for k, v in self._db.iterate(start, end):
+            inv = struct.unpack(">Q", k[len(ns) + 1:])[0]
+            return (0xFFFFFFFFFFFFFFFF - inv, v)
+        return None
+
+
+class ConfigHistoryMgr:
+    """Writer + retriever (reference confighistory.Mgr): call
+    `handle_commit` with any namespaces whose collection config changed
+    in the committed block."""
+
+    def __init__(self, kv: KVStore, ledger_id: str):
+        self._db = NamedDB(kv, f"confighistory/{ledger_id}")
+
+    def handle_commit(
+        self, block_num: int, configs: dict[str, bytes]
+    ) -> None:
+        """configs: {namespace: serialized CollectionConfigPackage}."""
+        puts = {
+            _key(ns, block_num): raw for ns, raw in configs.items()
+        }
+        if puts:
+            self._db.write_batch(puts)
+
+    def retriever(self) -> ConfigHistoryRetriever:
+        return ConfigHistoryRetriever(self._db)
+
+
+__all__ = ["ConfigHistoryMgr", "ConfigHistoryRetriever"]

@@ -133,6 +133,7 @@ class PeerNode:
         chaincode_specs: list[str] | None = None,
         chaincodes: dict | None = None,
         orderer_endpoints: list[tuple[str, int]] | None = None,
+        operations_port: int | None = None,
     ):
         self.csp = csp
         self.signer = signer
@@ -182,6 +183,20 @@ class PeerNode:
                 genesis = ledger.get_block_by_number(0)
                 if genesis is not None:
                     self.join_channel(genesis)
+
+        # operations endpoint: /metrics /healthz /version /logspec
+        # (reference core/operations wired in start.go serve())
+        self.operations = None
+        if operations_port is not None:
+            from fabric_tpu.common.operations import System
+
+            self.operations = System(("127.0.0.1", operations_port))
+            self.operations.register_checker(
+                "ledgers",
+                lambda: None if all(
+                    ch.ledger.height > 0 for ch in self.channels.values()
+                ) else "empty ledger",
+            )
 
         self.rpc = RPCServer(host, port)
         self.rpc.register("endorser.ProcessProposal", self._process_proposal)
@@ -358,10 +373,14 @@ class PeerNode:
 
     def start(self) -> None:
         self.rpc.start()
+        if self.operations is not None:
+            self.operations.start()
 
     def stop(self) -> None:
         self.rpc.stop()
         self.deliver.stop()
+        if self.operations is not None:
+            self.operations.stop()
         for ch in self.channels.values():
             ch.stop()
 

@@ -1,0 +1,55 @@
+"""Auxiliary subsystems: weighted semaphore, thread-dump diagnostics,
+config history store, chaincode event manager."""
+
+import io
+import struct
+
+from fabric_tpu.common.diag import dump_threads
+from fabric_tpu.common.semaphore import Semaphore
+from fabric_tpu.ledger.cceventmgmt import ChaincodeEventMgr
+from fabric_tpu.ledger.confighistory import ConfigHistoryMgr
+from fabric_tpu.ledger.kvstore import MemKVStore
+
+
+def test_semaphore_limits_concurrency():
+    sem = Semaphore(2)
+    assert sem.try_acquire() and sem.try_acquire()
+    assert not sem.try_acquire()
+    sem.release()
+    with sem:
+        assert not sem.try_acquire()
+    assert sem.try_acquire()
+
+
+def test_thread_dump_lists_main_thread():
+    buf = io.StringIO()
+    text = dump_threads(buf)
+    assert "MainThread" in text
+    assert "test_thread_dump_lists_main_thread" in text
+
+
+def test_confighistory_most_recent_below():
+    mgr = ConfigHistoryMgr(MemKVStore(), "ch")
+    mgr.handle_commit(5, {"cc1": b"cfg@5"})
+    mgr.handle_commit(12, {"cc1": b"cfg@12", "cc2": b"other@12"})
+    r = mgr.retriever()
+    assert r.most_recent_below("cc1", 6) == (5, b"cfg@5")
+    assert r.most_recent_below("cc1", 5) is None
+    assert r.most_recent_below("cc1", 100) == (12, b"cfg@12")
+    assert r.most_recent_below("cc2", 13) == (12, b"other@12")
+    assert r.most_recent_below("cc3", 100) is None
+
+
+def test_cceventmgmt_dispatch_and_isolation():
+    mgr = ChaincodeEventMgr()
+    got = []
+    mgr.register("ch1", got.append)
+    mgr.register(None, lambda e: got.append(("global", e.name)))
+    mgr.register("ch1", lambda e: 1 / 0)  # broken listener is isolated
+    mgr.handle_definition_committed("ch1", "mycc", "1.0", 3)
+    mgr.handle_definition_committed("ch2", "othercc", "1.0", 1)
+    names = [e.name if hasattr(e, "name") else e for e in got]
+    assert ("global", "mycc") in got and ("global", "othercc") in got
+    assert any(getattr(e, "channel_id", None) == "ch1" for e in got)
+    assert not any(getattr(e, "channel_id", None) == "ch2" for e in got
+                   if hasattr(e, "channel_id"))
