@@ -48,10 +48,16 @@ class Identity:
         self.ous = cert_ous(cert)
 
     def serialize(self) -> bytes:
-        return identities_pb2.SerializedIdentity(
-            mspid=self.mspid,
-            id_bytes=self.cert.public_bytes(serialization.Encoding.PEM),
-        ).SerializeToString()
+        # memoized: the hot path (policy evaluation, cache keys) calls
+        # this per endorsement and certs are immutable
+        cached = getattr(self, "_serialized", None)
+        if cached is None:
+            cached = identities_pb2.SerializedIdentity(
+                mspid=self.mspid,
+                id_bytes=self.cert.public_bytes(serialization.Encoding.PEM),
+            ).SerializeToString()
+            self._serialized = cached
+        return cached
 
     def expires_at(self):
         return self.cert.not_valid_after_utc

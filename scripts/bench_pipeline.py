@@ -1,0 +1,126 @@
+"""Block-validation pipeline benchmark (BASELINE.md configs #3/#4):
+committed tx/s and per-block validate latency for 1000-tx blocks at
+1-of-1 and 3-of-5 endorsement, TPU batched verify vs host sw verify.
+
+Prints one JSON line per configuration (bench.py stays the single-line
+headline; this is the measurement matrix).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+
+
+def _build_world(n_orgs: int):
+    import sys
+
+    sys.path.insert(0, "tests")
+    from orgfix import make_org
+
+    from fabric_tpu.common import configtx_builder as ctx
+    from fabric_tpu.msp import msp_config_from_ca
+
+    orgs = [make_org(f"Org{i+1}MSP") for i in range(n_orgs)]
+    oorg = make_org("OrdererMSP")
+    app = ctx.application_group(
+        {
+            f"Org{i+1}": ctx.org_group(
+                o.mspid, msp_config_from_ca(o.ca, o.mspid)
+            )
+            for i, o in enumerate(orgs)
+        }
+    )
+    ordg = ctx.orderer_group(
+        {"O": ctx.org_group("OrdererMSP", msp_config_from_ca(oorg.ca, "OrdererMSP"))},
+        consensus_type="solo",
+    )
+    genesis = ctx.genesis_block("benchch", ctx.channel_group(app, ordg))
+    return orgs, genesis
+
+
+def _make_block(orgs, genesis, csp, n_txs: int, endorsers: int):
+    """A block of endorsed txs (each endorsed by `endorsers` orgs)."""
+    from fabric_tpu import protoutil
+    from fabric_tpu.common.channelconfig import bundle_from_genesis
+    from fabric_tpu.ledger import LedgerProvider
+    from fabric_tpu.peer.endorser import Endorser
+    from fabric_tpu.protos.common import common_pb2
+    from fabric_tpu.protos.peer import proposal_pb2
+
+    provider = LedgerProvider(None)
+    ledger = provider.create(genesis)
+    bundle = bundle_from_genesis(genesis, csp)
+
+    def cc(sim, args):
+        sim.set_state("benchcc", args[0].decode(), args[1])
+        return 200, "", b""
+
+    ends = [
+        Endorser("benchch", ledger, bundle,
+                 o.signer(f"peer{i}", role_ou="peer"), {"benchcc": cc}, csp)
+        for i, o in enumerate(orgs[:endorsers])
+    ]
+    client = orgs[0].signer("client", role_ou="client")
+    envs = []
+    for i in range(n_txs):
+        prop, _ = protoutil.create_chaincode_proposal(
+            client.serialize(), "benchch", "benchcc",
+            [b"k%d" % i, b"v%d" % i],
+        )
+        signed = proposal_pb2.SignedProposal(
+            proposal_bytes=prop.SerializeToString(),
+            signature=client.sign(prop.SerializeToString()),
+        )
+        resps = [e.process_proposal(signed) for e in ends]
+        envs.append(protoutil.create_signed_tx(prop, client, resps))
+    blk = common_pb2.Block()
+    blk.header.number = 1
+    blk.data.data.extend(e.SerializeToString() for e in envs)
+    while len(blk.metadata.metadata) < 3:
+        blk.metadata.metadata.append(b"")
+    return ledger, bundle, blk
+
+
+def bench_config(name: str, n_orgs: int, endorsers: int, n_txs: int,
+                 repeats: int = 3):
+    from fabric_tpu.csp import SWCSP
+    from fabric_tpu.csp.tpu.provider import TPUCSP
+    from fabric_tpu.peer.txvalidator import TxValidator
+    from fabric_tpu.protos.common import common_pb2
+
+    sw = SWCSP()
+    orgs, genesis = _build_world(n_orgs)
+    ledger, bundle, blk = _make_block(orgs, genesis, sw, n_txs, endorsers)
+
+    out = {"config": name, "txs": n_txs, "endorsements_per_tx": endorsers}
+    for label, csp in (("sw", sw), ("tpu", TPUCSP(min_device_batch=1))):
+        validator = TxValidator("benchch", ledger, bundle, csp)
+        best = float("inf")
+        for _ in range(repeats):
+            b = common_pb2.Block()
+            b.CopyFrom(blk)
+            t0 = time.perf_counter()
+            flags = validator.validate(b)
+            best = min(best, time.perf_counter() - t0)
+            assert all(f == 0 for f in flags), "txs must validate"
+        out[f"{label}_block_validate_s"] = round(best, 4)
+        out[f"{label}_committed_tx_s"] = round(n_txs / best, 1)
+    out["speedup"] = round(
+        out["tpu_committed_tx_s"] / out["sw_committed_tx_s"], 2
+    )
+    print(json.dumps(out))
+
+
+def main():
+    bench_config("1000tx_1of1", 1, 1, 1000)
+    bench_config("1000tx_3of5", 5, 3, 1000)
+
+
+if __name__ == "__main__":
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    main()
