@@ -18,7 +18,8 @@ Kernel-specific design (everything else mirrors `ec.py` exactly):
   the generic fold-table multiplies of `limbs.Mod` — no multiplications
   in the reduction at all.  Negative terms are absorbed by a relaxed
   multiple-of-p bias constant whose every limb dominates the worst-case
-  per-limb negative sum (the `sub_c` trick from limbs.py, scaled by 4).
+  per-limb negative sum (the `sub_c` trick from limbs.py, scaled by 8
+  so it still dominates for coarse — limbs <= 2^16 + 2^6 — input).
   Operands carry the lazy invariant value < 2^257, so the product has
   one word beyond the 512-bit Solinas range; its (tiny) top limb is
   folded with one extra multiply by 2^512 mod p.
@@ -53,7 +54,7 @@ from fabric_tpu.csp.tpu.limbs import (
     int_to_limbs,
 )
 
-BLK = 128  # lanes (signatures) per grid block
+BLK = 256  # lanes (signatures) per grid block (measured best vs 128/512/1024)
 NWINDOWS = ec.NWINDOWS
 TABLE = ec.TABLE
 
@@ -120,24 +121,28 @@ def _consts():
     p = P256_P
     # Signed Solinas matrix: output limb k accumulates product limb i
     # with net weight solmat[k, i].  Weights are small (|sum per row|
-    # <= 11) and the product limbs are canonical (< 2^16) when applied,
-    # so the f32 contraction is exact (< 2^24).
+    # <= 11) and the product limbs are coarse (<= 2^16 + 2^6 after one
+    # carry pass; the contraction is linear in the limb vector so
+    # canonicality is not required), so the f32 contraction stays exact
+    # (|sum| < 2^21 << 2^24).
     solmat = np.zeros((NLIMBS, 2 * WIDE), np.float32)
     for words, w in _S_TERMS:
         for k, i in enumerate(_term_limb_indices(words)):
             if i >= 0:
                 solmat[k, i] += w
 
-    # bias: 4 * (ceil(2^259/p) * p), in relaxed limbs every one of which
-    # >= 4*MASK (dominates the worst per-limb negative sum of the 4
-    # subtracted terms); value is a multiple of p so it vanishes mod p.
+    # bias: 8 * (ceil(2^259/p) * p), in relaxed limbs every one of which
+    # >= 8*2^16 - 8 (dominates the worst per-limb negative sum of the 4
+    # subtracted terms even for coarse — limbs <= 2^16 + 2^6 — input:
+    # 4*(2^16+2^6) < 8*MASK); value is a multiple of p so it vanishes
+    # mod p.
     c = (1 << 259) // p + 1
-    e = int_to_limbs(4 * c * p, WIDE).astype(np.int64)
+    e = int_to_limbs(8 * c * p, WIDE).astype(np.int64)
     r = e.copy()
-    r[0] += 4 << LIMB_BITS
-    r[1:NLIMBS] += 4 * MASK
-    r[NLIMBS] -= 4
-    assert (r[:NLIMBS] >= 4 * MASK).all() and r[NLIMBS] >= 4
+    r[0] += 8 << LIMB_BITS
+    r[1:NLIMBS] += 8 * MASK
+    r[NLIMBS] -= 8
+    assert (r[:NLIMBS] >= 8 * MASK).all() and r[NLIMBS] >= 8
     bias = r.astype(np.uint32)[:, None]  # (17, 1)
 
     # fold rows: 2^256 mod p and 2^512 mod p (canonical 16 limbs)
@@ -154,6 +159,8 @@ def _consts():
     sub_c = s.astype(np.uint32)[:, None]  # (17, 1)
 
     p_limbs = int_to_limbs(p, WIDE)[:, None]  # (17, 1)
+    from fabric_tpu.csp.api import P256_N
+    n_limbs = int_to_limbs(P256_N, WIDE)[:, None]  # (17, 1)
 
     gx, gy, ginf = ec.g_table()  # (16, 17), (16, 17), (16,)
     return dict(
@@ -163,6 +170,7 @@ def _consts():
         r512=r512,
         sub_c=sub_c,
         p_limbs=p_limbs,
+        n_limbs=n_limbs,
         gx=gx[:, :, None].astype(np.uint32),  # (16, 17, 1)
         gy=gy[:, :, None].astype(np.uint32),
         ginf=ginf.astype(np.uint32)[:, None],  # (16, 1)
@@ -188,6 +196,20 @@ def _shift_up(a, d: int):
         return a
     pad = [(d, 0)] + [(0, 0)] * (a.ndim - 1)
     return jnp.pad(a[: a.shape[0] - d] if d < a.shape[0] else a[:0], pad)
+
+
+def _coarse(v, width: int):
+    """One carry pass: limbs < 2**31 in, limbs <= 2**16 + (carry bound)
+    out.  Value-preserving; does NOT canonicalize (use _resolve for that).
+    Cheap replacement for _resolve wherever the consumer only needs
+    bounded — not canonical — limbs (the Solinas contraction is linear in
+    the limb vector, so bounded limbs suffice for exactness)."""
+    if v.shape[0] < width:
+        pad = [(0, width - v.shape[0])] + [(0, 0)] * (v.ndim - 1)
+        v = jnp.pad(v, pad)
+    one = jnp.uint32(LIMB_BITS)
+    m = jnp.uint32(MASK)
+    return (v & m) + _shift_up(v >> one, 1)
 
 
 def _resolve(v, width: int):
@@ -219,26 +241,60 @@ class FpP256:
     Constants arrive as kernel inputs (Pallas kernels cannot capture
     array constants)."""
 
-    def __init__(self, solmat, bias, r256, r512, rshift, sub_c,
-                 p_limbs):
+    def __init__(self, solmat, bias, r256, r512, sub_c, p_limbs):
         self.solmat = solmat
         self.bias = bias
         self.r256 = r256
         self.r512 = r512
-        self.rshift = rshift
         self.sub_c = sub_c
         self.p_limbs = p_limbs
+        # 2p in canonical limbs (2*p_i is even, one coarse pass exact)
+        self.p2_limbs = _coarse(p_limbs * jnp.uint32(2), WIDE)
 
     def _minifold(self, v):
         """17-limb value with small top limb -> invariant element."""
         acc = v[:NLIMBS] + v[NLIMBS:NLIMBS + 1] * self.r256
         return _resolve(acc, WIDE)
 
+    def _fold_resolve(self, s):
+        """Coarse 17-row value (limbs <= 2^16 + 2^8, top limb <= 2^9) ->
+        canonical invariant element (17 rows, value < 2^257).
+
+        Folds the top limb through r256 = 2^256 mod p, then resolves
+        carries on 16 ALIGNED rows (two (8, lane) tiles, 4 Kogge-Stone
+        steps) instead of 17 (three tiles, 5 steps) — this tail runs at
+        the end of every field op, so the tile alignment matters more
+        than anything inside the op.  Bound chain: r256's nonzero limbs
+        sit at positions <= 13, so t[15] < 2^17 and the coarse carry out
+        of limb 15 is {0,1}; t's value is < 2^257, so coarse-carry-out +
+        KS-carry-out <= 1 and their sum IS the output's 17th limb."""
+        t = s[:NLIMBS] + s[NLIMBS:NLIMBS + 1] * self.r256  # 16 rows, < 2^26
+        one = jnp.uint32(LIMB_BITS)
+        m = jnp.uint32(MASK)
+        c = t >> one
+        v = (t & m) + _shift_up(c, 1)  # limbs < 2^17
+        cout = c[NLIMBS - 1:NLIMBS]  # {0,1} by the t[15] bound
+        g = v >> one  # {0,1}
+        lo = v & m
+        pp = (lo == m).astype(jnp.uint32)
+        d = 1
+        while d < NLIMBS:
+            g = g | (pp & _shift_up(g, d))
+            pp = pp & _shift_up(pp, d)
+            d *= 2
+        res = (lo + _shift_up(g, 1)) & m
+        return jnp.concatenate([res, cout + g[NLIMBS - 1:NLIMBS]], axis=0)
+
     def add(self, a, b):
-        return self._minifold(_resolve(a + b, WIDE))
+        # a + b < 2^258: after one coarse pass limbs <= 2^16 and (value
+        # argument: limb16 * 2^256 <= value) the top limb is <= 3, so the
+        # r256 fold stays far below u32.
+        return self._fold_resolve(_coarse(a + b, WIDE))
 
     def sub(self, a, b):
-        return self._minifold(_resolve(a + (self.sub_c - b), WIDE))
+        # a + (C - b) with C = sub_c (relaxed multiple of p, limbwise
+        # dominant): limbs < 2^18, value < 2^260 -> coarse top limb <= 15.
+        return self._fold_resolve(_coarse(a + (self.sub_c - b), WIDE))
 
     def mul(self, a, b):
         # Schoolbook product with pure-VPU column accumulation: the
@@ -265,11 +321,21 @@ class FpP256:
                 parts[k] + parts[k + 1] if k + 1 < len(parts) else parts[k]
                 for k in range(0, len(parts), 2)
             ]
-        cols = _resolve(parts[0], 2 * WIDE)  # canonical 34-limb product
-        # Solinas recombination of the 512-bit range (limbs 0..31): one
-        # small signed f32 MXU contraction (measured faster than the
-        # equivalent pad+add chain on the VPU), negatives absorbed by
-        # the bias constant (a relaxed multiple of p dominating them)
+        cols = _coarse(parts[0], 2 * WIDE)  # bounded 34-limb product
+        return self._reduce_cols(cols)
+
+    def _reduce_cols(self, cols):
+        """Coarse 34-limb product (limbs <= 2^16 + 2^6) -> invariant
+        element (< 2^257).
+
+        Solinas recombination of the 512-bit range (limbs 0..31): one
+        small signed f32 MXU contraction (measured faster than the
+        equivalent pad+add chain on the VPU), negatives absorbed by the
+        bias constant (a relaxed multiple of p dominating them).  The
+        contraction is linear in the limb vector, so coarse — not
+        canonical — limbs suffice: |sum| <= 12 * 2^16.1 + bias < 2^21,
+        exact in f32 (< 2^24).  Limb 32 is <= 2^6.2 by the value bound
+        (product < 2^514), so the 2^512-fold fits u32."""
         signed = jnp.dot(
             self.solmat,
             _u2f(cols),
@@ -277,25 +343,21 @@ class FpP256:
             precision=jax.lax.Precision.HIGHEST,
         )
         acc = _f2u(signed + _u2f(self.bias[:NLIMBS]))
-        # limb 32 (the only word past 2^512; <= 3 by the invariant)
         acc = acc + cols[32:33] * self.r512
         top = jnp.broadcast_to(self.bias[NLIMBS:], (1, acc.shape[-1]))
         acc = jnp.concatenate([acc, top], axis=0)
-        v = _resolve(acc, WIDE)
-        return self._minifold(v)
+        # acc limbs < 2^23, value < 2^263 -> coarse top limb <= 2^7.
+        return self._fold_resolve(_coarse(acc, WIDE))
 
     def sqr(self, a):
         return self.mul(a, a)
 
     def mul_const(self, a, k: int):
+        # a*k limbs < 2^24; one coarse pass leaves the top limb <= 2^9
+        # (a16 <= 1 so a16*k <= 256, plus a sub-2^8 carry) — no carry out
+        # of limb 16, so width 17 suffices and the r256 fold fits u32.
         assert 0 < k <= 256
-        v = _resolve(a * jnp.uint32(k), WIDE + 1)
-        acc = (
-            v[:NLIMBS]
-            + v[NLIMBS:NLIMBS + 1] * self.r256
-            + v[NLIMBS + 1:NLIMBS + 2] * self.rshift
-        )
-        return self._minifold(_resolve(acc, WIDE))
+        return self._fold_resolve(_coarse(a * jnp.uint32(k), WIDE))
 
     def canon(self, a):
         v = self._minifold(a)
@@ -304,17 +366,17 @@ class FpP256:
         return v
 
     def is_zero(self, a):
-        # int32 0/1 flag via mismatch count, no i1 vectors (Mosaic
-        # reduces i1 via i8 and cannot truncate back)
-        n = jnp.sum(
-            (self.canon(a) != 0).astype(jnp.int32), axis=0, keepdims=True
-        )
+        # An invariant element (canonical limbs, value < 2^257 < 3p) is
+        # 0 mod p iff it equals 0, p, or 2p exactly — three limbwise
+        # compares instead of canon's four carry networks.  int32 0/1
+        # flag via mismatch counts, no i1 vectors (Mosaic reduces i1 via
+        # i8 and cannot truncate back).
+
+        def mism(c):
+            return jnp.sum((a != c).astype(jnp.int32), axis=0, keepdims=True)
+
+        n = mism(jnp.zeros_like(a)) * mism(self.p_limbs) * mism(self.p2_limbs)
         return (n == 0).astype(jnp.int32)
-
-
-@functools.lru_cache(maxsize=None)
-def _shifted_r_np() -> np.ndarray:
-    return int_to_limbs((1 << (256 + LIMB_BITS)) % P256_P, NLIMBS)[:, None]
 
 
 def _cond_sub(a, b_const):
@@ -479,13 +541,13 @@ def _unpack_words(wref):
     return jnp.concatenate(rows, axis=0)
 
 
-def _kernel(qx_ref, qy_ref, d1_ref, d2_ref, c0_ref, c1_ref, flags_ref,
+def _kernel(qx_ref, qy_ref, d1_ref, d2_ref, c0_ref, flags_ref,
             solmat_ref, bias_ref, r256_ref, r512_ref,
-            rshift_ref, subc_ref, plimbs_ref, gx_ref, gy_ref,
+            subc_ref, plimbs_ref, nlimbs_ref, gx_ref, gy_ref,
             out_ref, tabx, taby, tabz, tabinf):
     fp = FpP256(
         solmat_ref[:], bias_ref[:], r256_ref[:],
-        r512_ref[:], rshift_ref[:], subc_ref[:], plimbs_ref[:],
+        r512_ref[:], subc_ref[:], plimbs_ref[:],
     )
     blk = qx_ref.shape[-1]
     qx = _unpack_words(qx_ref)
@@ -572,8 +634,13 @@ def _kernel(qx_ref, qy_ref, d1_ref, d2_ref, c0_ref, c1_ref, flags_ref,
         )
         return (n == 0).astype(jnp.int32)
 
-    m0 = matches(_unpack_words(c0_ref))
-    m1 = matches(_unpack_words(c1_ref))
+    cand0 = _unpack_words(c0_ref)
+    m0 = matches(cand0)
+    # cand1 = r + n, built on-device (saves a 32B/sig host transfer);
+    # only consulted when the host flagged r + n < p, so the unreduced
+    # value (< 2^257, canonicalized below) is safe to feed fp.mul.
+    cand1 = fp._fold_resolve(_coarse(cand0 + nlimbs_ref[:], WIDE))
+    m1 = matches(cand1)
     cand1_ok = flags_ref[0:1].astype(jnp.int32)
     valid = flags_ref[1:2].astype(jnp.int32)
     ok = jnp.minimum(m0 + m1 * cand1_ok, 1) * (1 - jnp.minimum(inf, 1)) * valid
@@ -601,15 +668,14 @@ def _build_call(nblocks: int, blk: int, interpret: bool):
             lane_spec(8),      # d1 (8 window digits per word)
             lane_spec(8),      # d2
             lane_spec(8),      # cand0
-            lane_spec(8),      # cand1
             lane_spec(2),      # flags: [cand1_ok; valid]
             const_spec((NLIMBS, 2 * WIDE)),           # solmat
             const_spec((WIDE, 1)),                    # bias
             const_spec((NLIMBS, 1)),                  # r256
             const_spec((NLIMBS, 1)),                  # r512
-            const_spec((NLIMBS, 1)),                  # rshift
             const_spec((WIDE, 1)),                    # sub_c
             const_spec((WIDE, 1)),                    # p_limbs
+            const_spec((WIDE, 1)),                    # n_limbs (group order)
             const_spec((TABLE, WIDE)),                # gx
             const_spec((TABLE, WIDE)),                # gy
         ],
@@ -679,14 +745,13 @@ def prepare_packed(items) -> dict:
     u1b = bytearray(32 * n)
     u2b = bytearray(32 * n)
     c0b = bytearray(32 * n)
-    c1b = bytearray(32 * n)
     for i in range(n - 1, -1, -1):
         it = items[i]
         w = inv * prefix[i] % P256_N
         inv = inv * svals[i] % P256_N
         o = 32 * i
         if not valid[i]:
-            x, y, u1, u2, c0, c1v = P256_GX, P256_GY, 1, 1, 1, 1
+            x, y, u1, u2, c0 = P256_GX, P256_GY, 1, 1, 1
         else:
             x, y = it[0], it[1]
             r = it[3]
@@ -694,18 +759,13 @@ def prepare_packed(items) -> dict:
             u1 = e * w % P256_N
             u2 = r * w % P256_N
             c0 = r
-            rpn = r + P256_N
-            if rpn < P256_P:
-                c1v = rpn
+            if r + P256_N < P256_P:
                 c1_ok[i] = True
-            else:
-                c1v = 1
         xb[o:o + 32] = x.to_bytes(32, "little")
         yb[o:o + 32] = y.to_bytes(32, "little")
         u1b[o:o + 32] = u1.to_bytes(32, "little")
         u2b[o:o + 32] = u2.to_bytes(32, "little")
         c0b[o:o + 32] = c0.to_bytes(32, "little")
-        c1b[o:o + 32] = c1v.to_bytes(32, "little")
 
     def words(buf):  # (B, 32) LE bytes -> (8, B) u32 words
         return np.ascontiguousarray(
@@ -729,7 +789,6 @@ def prepare_packed(items) -> dict:
         "d1": digits_packed(u1b),
         "d2": digits_packed(u2b),
         "cand0": words(c0b),
-        "cand1": words(c1b),
         "cand1_ok": c1_ok,
         "valid": valid,
     }
@@ -766,15 +825,14 @@ def verify_packed(packed: dict, blk: int = BLK,
         padlanes(packed["d1"]),
         padlanes(packed["d2"]),
         padlanes(packed["cand0"]),
-        padlanes(packed["cand1"]),
         padlanes(flags),
         c["solmat"],
         c["bias"],
         c["r256"],
         c["r512"],
-        _shifted_r_np(),
         c["sub_c"],
         c["p_limbs"],
+        c["n_limbs"],
         c["gx"][:, :, 0],
         c["gy"][:, :, 0],
     ]
@@ -830,15 +888,14 @@ def prepack(prep: dict, blk: int = BLK) -> tuple[list, int]:
         _pack_digits(padded(prep["d1"])),
         _pack_digits(padded(prep["d2"])),
         _pack_words(padded(prep["cand0"])),
-        _pack_words(padded(prep["cand1"])),
         flags,
         c["solmat"],
         c["bias"],
         c["r256"],
         c["r512"],
-        _shifted_r_np(),
         c["sub_c"],
         c["p_limbs"],
+        c["n_limbs"],
         c["gx"][:, :, 0],
         c["gy"][:, :, 0],
     ]

@@ -34,7 +34,8 @@ from fabric_tpu.csp.api import (
 )
 from fabric_tpu.csp.sw import SWCSP
 
-_BATCH_BUCKETS = (32, 128, 512, 2048, 8192, 32768)
+_BATCH_BUCKETS = (32, 128, 512, 2048, 8192, 32768)  # single dispatch for
+# big batches: per-call transport overhead beats any chunk-pipelining win
 _HASH_BUCKETS = (32, 128, 512, 2048, 8192)
 
 

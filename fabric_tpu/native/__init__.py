@@ -54,7 +54,6 @@ def _load():
                 np.ctypeslib.ndpointer(np.uint32, flags="C"),  # d1
                 np.ctypeslib.ndpointer(np.uint32, flags="C"),  # d2
                 np.ctypeslib.ndpointer(np.uint32, flags="C"),  # c0
-                np.ctypeslib.ndpointer(np.uint32, flags="C"),  # c1
                 np.ctypeslib.ndpointer(np.uint8, flags="C"),   # c1ok
                 np.ctypeslib.ndpointer(np.uint8, flags="C"),   # valid
             ]
@@ -84,12 +83,11 @@ def marshal_batch(xs: bytes, ys: bytes, digests: bytes, sigs: bytes,
     d1 = np.empty((8, n), np.uint32)
     d2 = np.empty((8, n), np.uint32)
     c0 = np.empty((8, n), np.uint32)
-    c1 = np.empty((8, n), np.uint32)
     c1ok = np.empty(n, np.uint8)
     valid = np.empty(n, np.uint8)
     lib.fabric_marshal_batch(
         n, xs, ys, digests, sigs, np.ascontiguousarray(sig_off, np.int32),
-        qx, qy, d1, d2, c0, c1, c1ok, valid,
+        qx, qy, d1, d2, c0, c1ok, valid,
     )
     return {
         "qx": qx,
@@ -97,7 +95,8 @@ def marshal_batch(xs: bytes, ys: bytes, digests: bytes, sigs: bytes,
         "d1": d1,
         "d2": d2,
         "cand0": c0,
-        "cand1": c1,
+        # c1 (r+n words) is no longer shipped: the kernel rebuilds cand1
+        # on-device from cand0; only the admissibility flag travels.
         "cand1_ok": c1ok.astype(bool),
         "valid": valid.astype(bool),
     }

@@ -245,11 +245,12 @@ extern "C" {
 
 // All output arrays are (8, n) row-major u32 except c1ok/valid ((n,) u8).
 // xs/ys/digests: n*32 bytes big-endian.  sigs: concatenated DER with
-// sig_off (n+1 int32 offsets).
+// sig_off (n+1 int32 offsets).  (r+n words are NOT emitted: the device
+// kernel rebuilds cand1 from c0; only the c1ok admissibility flag is.)
 int fabric_marshal_batch(int n, const u8* xs, const u8* ys,
                          const u8* digests, const u8* sigs,
                          const int32_t* sig_off, u32* qx, u32* qy, u32* d1,
-                         u32* d2, u32* c0, u32* c1, u8* c1ok, u8* valid) {
+                         u32* d2, u32* c0, u8* c1ok, u8* valid) {
   if (n <= 0) return 0;
   U256* svals = new U256[n];
   U256* rvals = new U256[n];
@@ -293,7 +294,6 @@ int fabric_marshal_batch(int n, const u8* xs, const u8* ys,
       put_digits(one, d1, n, i);
       put_digits(one, d2, n, i);
       put_words(one, c0, n, i);
-      put_words(one, c1, n, i);
       c1ok[i] = 0;
       continue;
     }
@@ -309,13 +309,7 @@ int fabric_marshal_batch(int n, const u8* xs, const u8* ys,
     put_words(rvals[i], c0, n, i);
     U256 rpn;
     u64 carry = add_carry(rvals[i], N, &rpn);
-    if (!carry && cmp(rpn, P) < 0) {
-      put_words(rpn, c1, n, i);
-      c1ok[i] = 1;
-    } else {
-      put_words(one, c1, n, i);
-      c1ok[i] = 0;
-    }
+    c1ok[i] = (!carry && cmp(rpn, P) < 0) ? 1 : 0;
   }
 
   delete[] svals;

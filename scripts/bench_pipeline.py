@@ -9,6 +9,7 @@ headline; this is the measurement matrix).
 from __future__ import annotations
 
 import json
+import os
 import time
 
 
@@ -16,7 +17,10 @@ import time
 def _build_world(n_orgs: int):
     import sys
 
-    sys.path.insert(0, "tests")
+    sys.path.insert(
+        0,
+        os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tests"),
+    )
     from orgfix import make_org
 
     from fabric_tpu.common import configtx_builder as ctx

@@ -1,0 +1,54 @@
+"""Sweep device chunk size for the batched-verify e2e path."""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from fabric_tpu.csp import SWCSP
+from fabric_tpu.csp import api
+from fabric_tpu.csp.tpu import pallas_ec
+
+
+def main():
+    n = 32768
+    csp = SWCSP()
+    keys = [csp.key_gen() for _ in range(64)]
+    tuples = []
+    for i in range(n):
+        key = keys[i % 64]
+        d = csp.hash(b"sweep-%d" % i)
+        r, s = api.unmarshal_ecdsa_signature(csp.sign(key, d))
+        pub = key.public_key()
+        tuples.append((pub.x, pub.y, d, r, s))
+    packed = pallas_ec.prepare_packed(tuples)
+
+    for chunk in (32768, 16384, 8192, 4096):
+        def run():
+            pending = []
+            for off in range(0, n, chunk):
+                sl = {
+                    k: (v[:, off:off + chunk] if v.ndim == 2 else v[off:off + chunk])
+                    for k, v in packed.items()
+                }
+                pending.append(pallas_ec.verify_packed(sl))
+            out = []
+            for c in pending:
+                out.append(c())
+            return np.concatenate(out)
+
+        ok = run()  # warm-up/compile
+        assert ok.all()
+        best = min(
+            (lambda t0: (run(), time.perf_counter() - t0)[1])(time.perf_counter())
+            for _ in range(3)
+        )
+        print(f"chunk={chunk:6d}: {best*1e3:7.1f} ms  ({n/best:8.0f}/s)")
+
+
+if __name__ == "__main__":
+    main()

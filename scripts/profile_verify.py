@@ -1,0 +1,107 @@
+"""Breakdown of batched-verify time: host prep vs transfer vs device kernel.
+
+Usage: python scripts/profile_verify.py [N] [BLK]
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from fabric_tpu.csp import SWCSP, VerifyBatchItem
+from fabric_tpu.csp.tpu import pallas_ec
+from fabric_tpu.csp.tpu.provider import TPUCSP
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 32768
+    blk = int(sys.argv[2]) if len(sys.argv) > 2 else pallas_ec.BLK
+    csp = SWCSP()
+    keys = [csp.key_gen() for _ in range(64)]
+    items = []
+    tuples = []
+    for i in range(n):
+        key = keys[i % 64]
+        d = csp.hash(b"profile-%d" % i)
+        sig = csp.sign(key, d)
+        items.append(VerifyBatchItem(key.public_key(), d, sig))
+        from fabric_tpu.csp import api
+        r, s = api.unmarshal_ecdsa_signature(sig)
+        pub = key.public_key()
+        tuples.append((pub.x, pub.y, d, r, s))
+
+    # host prep (numpy path)
+    t0 = time.perf_counter()
+    packed = pallas_ec.prepare_packed(tuples)
+    t_prep = time.perf_counter() - t0
+
+    # native marshal path
+    tcsp = TPUCSP()
+    t0 = time.perf_counter()
+    pn = tcsp._marshal_native(items)
+    t_native = time.perf_counter() - t0 if pn is not None else float("nan")
+
+    # device: warm-up compile, then time the full call (transfer + kernel)
+    collect = pallas_ec.verify_packed(packed, blk=blk)
+    ok = collect()
+    assert ok.all(), "verify failed"
+    import jax
+
+    t_e2e = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        collect = pallas_ec.verify_packed(packed, blk=blk)
+        collect()
+        t_e2e.append(time.perf_counter() - t0)
+    t_e2e = min(t_e2e)
+
+    # device-resident: pre-place inputs on device, time kernel only
+    nb = -(-n // blk)
+    pad = nb * blk - n
+
+    def padlanes(a):
+        if pad:
+            a = np.concatenate([a, np.zeros(a.shape[:-1] + (pad,), a.dtype)], axis=-1)
+        return a
+
+    flags = np.stack([
+        np.asarray(packed["cand1_ok"], np.uint32),
+        np.asarray(packed["valid"], np.uint32),
+    ])
+    c = pallas_ec._consts()
+    inputs = [
+        padlanes(packed["qx"]), padlanes(packed["qy"]),
+        padlanes(packed["d1"]), padlanes(packed["d2"]),
+        padlanes(packed["cand0"]),
+        padlanes(flags),
+        c["solmat"], c["bias"], c["r256"], c["r512"],
+        c["sub_c"], c["p_limbs"], c["n_limbs"],
+        c["gx"][:, :, 0], c["gy"][:, :, 0],
+    ]
+    dev_inputs = [jax.device_put(x) for x in inputs]
+    call = pallas_ec._build_call(nb, blk, False)
+    out = call(*dev_inputs)
+    out.block_until_ready()
+    t_dev = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = call(*dev_inputs)
+        out.block_until_ready()
+        t_dev.append(time.perf_counter() - t0)
+    t_dev = min(t_dev)
+
+    nbytes = sum(x.nbytes for x in inputs[:7])
+    print(f"N={n} BLK={blk}")
+    print(f"host prep (numpy):    {t_prep*1e3:8.1f} ms  ({n/t_prep:9.0f}/s)")
+    print(f"host prep (native):   {t_native*1e3:8.1f} ms")
+    print(f"transfer bytes:       {nbytes/1e6:8.2f} MB")
+    print(f"e2e (xfer+kernel):    {t_e2e*1e3:8.1f} ms  ({n/t_e2e:9.0f}/s)")
+    print(f"device-resident:      {t_dev*1e3:8.1f} ms  ({n/t_dev:9.0f}/s)")
+
+
+if __name__ == "__main__":
+    main()

@@ -79,7 +79,8 @@ def test_prepare_packed_matches_prepare_batch():
     assert (packed["d1"] == pallas_ec._pack_digits(prep["d1"])).all()
     assert (packed["d2"] == pallas_ec._pack_digits(prep["d2"])).all()
     assert (packed["cand0"] == pallas_ec._pack_words(prep["cand0"])).all()
-    assert (packed["cand1"] == pallas_ec._pack_words(prep["cand1"])).all()
+    # cand1 words are no longer packed: the kernel derives r+n on-device
+    assert "cand1" not in packed
     assert (packed["cand1_ok"] == prep["cand1_ok"]).all()
     assert (packed["valid"] == prep["valid"]).all()
 
@@ -90,3 +91,50 @@ def test_verify_packed_roundtrip():
     packed = pallas_ec.prepare_packed(items)
     collect = pallas_ec.verify_packed(packed)
     assert list(collect()) == [True, True, True]
+
+
+def test_cand1_branch_r_plus_n():
+    """Exercise the x(R) in [n, p) corner: r = x(R) - n, so acceptance
+    must go through the on-device cand1 = r + n reconstruction (the m1
+    branch), which random signatures hit with probability ~2^-29.
+
+    Construction: find a curve point R with x(R) >= n; use Q = R as the
+    public key with digest == n (e = 0 mod n) and s = r, so
+    u1*G + u2*Q = 0*G + 1*Q = R and the signature (r, s) is valid."""
+    p, n = api.P256_P, api.P256_N
+    b = 0x5AC635D8AA3A93E7B3EBBD55769886BC651D06B0CC53B0F63BCE3C3E27D2604B
+    x = n
+    while True:
+        x += 1
+        t = (pow(x, 3, p) - 3 * x + b) % p
+        y = pow(t, (p + 1) // 4, p)
+        if y * y % p == t:
+            break
+    r = x - n
+    s = r  # u2 = r * s^-1 = 1; r is tiny, so low-S holds
+    assert 0 < s <= n // 2
+    digest = n.to_bytes(32, "big")  # e = 0 mod n
+    rng = random.Random(99)
+    items = _sig_batch(2, rng) + [(x, y, digest, r, s)]
+    prep = ec.prepare_batch(items)
+    assert list(prep["cand1_ok"]) == [False, False, True]
+    keys = ("qx", "qy", "d1", "d2", "cand0", "cand1", "cand1_ok", "valid")
+    ref = np.asarray(ec.verify_kernel(**{k: prep[k] for k in keys}))
+    got = pallas_ec.verify_prepared(**{k: prep[k] for k in keys})
+    assert (ref == got).all()
+    assert list(got) == [True, True, True]
+    # sw (OpenSSL) oracle agrees the crafted signature is valid
+    from fabric_tpu.csp.api import marshal_ecdsa_signature
+
+    sw = SWCSP()
+    pub = sw.key_import(
+        b"\x04" + x.to_bytes(32, "big") + y.to_bytes(32, "big")
+    )
+    assert sw.verify(pub, marshal_ecdsa_signature(r, s), digest)
+    # and a tampered r (cand1_ok but wrong x) is rejected
+    bad = items[:2] + [(x, y, digest, r + 1, s)]
+    prep_bad = ec.prepare_batch(bad)
+    got_bad = pallas_ec.verify_prepared(
+        **{k: prep_bad[k] for k in keys}
+    )
+    assert list(got_bad) == [True, True, False]
