@@ -233,6 +233,17 @@ class CSP(abc.ABC):
     @abc.abstractmethod
     def verify_batch(self, items: Sequence[VerifyBatchItem]) -> list[bool]: ...
 
+    def verify_batch_async(self, items: Sequence[VerifyBatchItem]):
+        """Dispatch a batch verify and return a zero-arg collector.
+
+        Device providers override this to return BEFORE the device
+        finishes, so callers can overlap host work for the next batch
+        with the device's current one (the block-pipeline mode of the
+        txvalidator).  The default computes eagerly — correct for host
+        providers, which have nothing to overlap."""
+        result = self.verify_batch(items)
+        return lambda: result
+
 
 __all__ = [
     "CSP",

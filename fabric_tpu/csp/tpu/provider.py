@@ -34,8 +34,9 @@ from fabric_tpu.csp.api import (
 )
 from fabric_tpu.csp.sw import SWCSP
 
-_BATCH_BUCKETS = (32, 128, 512, 2048, 8192, 32768)  # single dispatch for
-# big batches: per-call transport overhead beats any chunk-pipelining win
+_BATCH_BUCKETS = (32, 128, 512, 2048, 4096, 8192, 32768)  # single dispatch
+# for big batches: per-call transport overhead beats chunk-pipelining wins
+# (4096 matters: a 1000-tx block at 3-of-5 is 4000 sigs)
 _HASH_BUCKETS = (32, 128, 512, 2048, 8192)
 
 
@@ -99,8 +100,17 @@ class TPUCSP(CSP):
         return self._sw.verify(key, signature, digest)
 
     def verify_batch(self, items: Sequence[VerifyBatchItem]) -> list[bool]:
+        return self.verify_batch_async(items)()
+
+    def verify_batch_async(self, items: Sequence[VerifyBatchItem]):
+        """Dispatch host prep + device call(s), return the collector.
+
+        The device executes asynchronously after dispatch, so the caller
+        can run the next block's collect phase while this one verifies
+        (txvalidator.validate_pipeline)."""
         if len(items) < self._min_device_batch:
-            return self._sw.verify_batch(items)
+            result = self._sw.verify_batch(items)
+            return lambda: result
         from fabric_tpu.csp.tpu import pallas_ec
 
         import jax
@@ -134,15 +144,25 @@ class TPUCSP(CSP):
         if jax.default_backend() != "tpu":
             # The fused kernel is TPU-only (Mosaic); other backends get
             # the portable XLA kernel (interpreted Pallas would be
-            # orders of magnitude slower on CPU test runs).
+            # orders of magnitude slower on CPU test runs).  Dispatch is
+            # async here too (JAX queues the computation); only the
+            # np.asarray conversion blocks, and it lives in the
+            # collector so pipelined callers keep their overlap.
             from fabric_tpu.csp.tpu import ec
 
-            results: list[bool] = []
-            for chunk, keep in chunks():
-                prep = ec.prepare_batch(chunk)
-                mask = np.asarray(ec.verify_prepared(**prep))
-                results.extend(bool(v) for v in mask[:keep])
-            return results
+            dispatched = [
+                (ec.verify_prepared(**ec.prepare_batch(chunk)), keep)
+                for chunk, keep in chunks()
+            ]
+
+            def collect_xla():
+                results: list[bool] = []
+                for out, keep in dispatched:
+                    mask = np.asarray(out)
+                    results.extend(bool(v) for v in mask[:keep])
+                return results
+
+            return collect_xla
 
         # Chunked pipeline over the fused Pallas kernel: every chunk is
         # dispatched (host prep + async device call) before any result is
@@ -179,10 +199,13 @@ class TPUCSP(CSP):
             for chunk, keep in chunks():
                 packed = pallas_ec.prepare_packed(chunk)
                 pending.append((pallas_ec.verify_packed(packed), keep))
-        results = []
-        for collect, keep in pending:
-            results.extend(bool(v) for v in collect()[:keep])
-        return results
+        def collect_all():
+            results = []
+            for collect, keep in pending:
+                results.extend(bool(v) for v in collect()[:keep])
+            return results
+
+        return collect_all
 
     @staticmethod
     def _marshal_native(items) -> dict | None:

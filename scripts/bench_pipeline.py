@@ -44,8 +44,10 @@ def _build_world(n_orgs: int):
     return orgs, genesis
 
 
-def _make_block(orgs, genesis, csp, n_txs: int, endorsers: int):
-    """A block of endorsed txs (each endorsed by `endorsers` orgs)."""
+def _make_blocks(orgs, genesis, csp, n_txs: int, endorsers: int,
+                 n_blocks: int = 1):
+    """`n_blocks` blocks of distinct endorsed txs (each endorsed by
+    `endorsers` orgs)."""
     from fabric_tpu import protoutil
     from fabric_tpu.common.channelconfig import bundle_from_genesis
     from fabric_tpu.ledger import LedgerProvider
@@ -67,24 +69,27 @@ def _make_block(orgs, genesis, csp, n_txs: int, endorsers: int):
         for i, o in enumerate(orgs[:endorsers])
     ]
     client = orgs[0].signer("client", role_ou="client")
-    envs = []
-    for i in range(n_txs):
-        prop, _ = protoutil.create_chaincode_proposal(
-            client.serialize(), "benchch", "benchcc",
-            [b"k%d" % i, b"v%d" % i],
-        )
-        signed = proposal_pb2.SignedProposal(
-            proposal_bytes=prop.SerializeToString(),
-            signature=client.sign(prop.SerializeToString()),
-        )
-        resps = [e.process_proposal(signed) for e in ends]
-        envs.append(protoutil.create_signed_tx(prop, client, resps))
-    blk = common_pb2.Block()
-    blk.header.number = 1
-    blk.data.data.extend(e.SerializeToString() for e in envs)
-    while len(blk.metadata.metadata) < 3:
-        blk.metadata.metadata.append(b"")
-    return ledger, bundle, blk
+    blocks = []
+    for bno in range(n_blocks):
+        envs = []
+        for i in range(n_txs):
+            prop, _ = protoutil.create_chaincode_proposal(
+                client.serialize(), "benchch", "benchcc",
+                [b"k%d-%d" % (bno, i), b"v%d" % i],
+            )
+            signed = proposal_pb2.SignedProposal(
+                proposal_bytes=prop.SerializeToString(),
+                signature=client.sign(prop.SerializeToString()),
+            )
+            resps = [e.process_proposal(signed) for e in ends]
+            envs.append(protoutil.create_signed_tx(prop, client, resps))
+        blk = common_pb2.Block()
+        blk.header.number = 1 + bno
+        blk.data.data.extend(e.SerializeToString() for e in envs)
+        while len(blk.metadata.metadata) < 3:
+            blk.metadata.metadata.append(b"")
+        blocks.append(blk)
+    return ledger, bundle, blocks
 
 
 def bench_config(name: str, n_orgs: int, endorsers: int, n_txs: int,
@@ -95,24 +100,50 @@ def bench_config(name: str, n_orgs: int, endorsers: int, n_txs: int,
     from fabric_tpu.protos.common import common_pb2
 
     sw = SWCSP()
+    n_blocks = 4
     orgs, genesis = _build_world(n_orgs)
-    ledger, bundle, blk = _make_block(orgs, genesis, sw, n_txs, endorsers)
+    ledger, bundle, blocks = _make_blocks(
+        orgs, genesis, sw, n_txs, endorsers, n_blocks
+    )
+
+    def copies(k):
+        out = []
+        for j in range(k):
+            b = common_pb2.Block()
+            b.CopyFrom(blocks[j % n_blocks])
+            out.append(b)
+        return out
 
     out = {"config": name, "txs": n_txs, "endorsements_per_tx": endorsers}
     for label, csp in (("sw", sw), ("tpu", TPUCSP(min_device_batch=1))):
         validator = TxValidator("benchch", ledger, bundle, csp)
         best = float("inf")
         for _ in range(repeats):
-            b = common_pb2.Block()
-            b.CopyFrom(blk)
+            (b,) = copies(1)
             t0 = time.perf_counter()
             flags = validator.validate(b)
             best = min(best, time.perf_counter() - t0)
             assert all(f == 0 for f in flags), "txs must validate"
         out[f"{label}_block_validate_s"] = round(best, 4)
         out[f"{label}_committed_tx_s"] = round(n_txs / best, 1)
+        # steady-state throughput: a stream of distinct blocks through
+        # the pipelined validator (collect(k+1) overlaps device
+        # verify(k)); fresh validator per run so the pipeline's
+        # duplicate-txid window starts empty.
+        stream_best = float("inf")
+        for _ in range(repeats):
+            v2 = TxValidator("benchch", ledger, bundle, csp)
+            bs = copies(n_blocks)
+            t0 = time.perf_counter()
+            for flags in v2.validate_pipeline(iter(bs), depth=3):
+                assert all(f == 0 for f in flags)
+            stream_best = min(stream_best, time.perf_counter() - t0)
+        out[f"{label}_pipelined_tx_s"] = round(n_blocks * n_txs / stream_best, 1)
     out["speedup"] = round(
         out["tpu_committed_tx_s"] / out["sw_committed_tx_s"], 2
+    )
+    out["pipelined_speedup"] = round(
+        out["tpu_pipelined_tx_s"] / out["sw_pipelined_tx_s"], 2
     )
     print(json.dumps(out))
 

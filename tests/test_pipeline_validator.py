@@ -1,0 +1,120 @@
+"""validate_pipeline: ordered flags identical to sequential validate,
+with duplicate-txid detection spanning in-flight blocks."""
+
+from __future__ import annotations
+
+import pytest
+
+from orgfix import make_org
+
+from fabric_tpu import protoutil
+from fabric_tpu.common import configtx_builder as ctx
+from fabric_tpu.common.channelconfig import bundle_from_genesis
+from fabric_tpu.ledger import LedgerProvider
+from fabric_tpu.msp import msp_config_from_ca
+from fabric_tpu.peer.endorser import Endorser
+from fabric_tpu.peer.txvalidator import TxValidator
+from fabric_tpu.protos.common import common_pb2
+from fabric_tpu.protos.peer import proposal_pb2, transaction_pb2
+
+V = transaction_pb2
+
+
+def _cc(sim, args):
+    sim.set_state("pipecc", args[0].decode(), args[1])
+    return 200, "", b""
+
+
+@pytest.fixture(scope="module")
+def world():
+    org = make_org("Org1MSP")
+    oorg = make_org("OrdererMSP")
+    app = ctx.application_group(
+        {"Org1": ctx.org_group("Org1MSP", msp_config_from_ca(org.ca, "Org1MSP"))}
+    )
+    ordg = ctx.orderer_group(
+        {"O": ctx.org_group("OrdererMSP", msp_config_from_ca(oorg.ca, "OrdererMSP"))},
+        consensus_type="solo",
+    )
+    genesis = ctx.genesis_block("pipech", ctx.channel_group(app, ordg))
+    provider = LedgerProvider(None)
+    ledger = provider.create(genesis)
+    bundle = bundle_from_genesis(genesis, org.csp)
+    endorser = Endorser(
+        "pipech", ledger, bundle, org.signer("peer0", role_ou="peer"),
+        {"pipecc": _cc}, org.csp,
+    )
+    client = org.signer("user1", role_ou="client")
+    return org, ledger, bundle, endorser, client
+
+
+def _tx(endorser, client, key: bytes, val: bytes):
+    prop, txid = protoutil.create_chaincode_proposal(
+        client.serialize(), "pipech", "pipecc", [key, val]
+    )
+    signed = proposal_pb2.SignedProposal(
+        proposal_bytes=prop.SerializeToString(),
+        signature=client.sign(prop.SerializeToString()),
+    )
+    resp = endorser.process_proposal(signed)
+    return protoutil.create_signed_tx(prop, client, [resp])
+
+
+def _block(num: int, envs) -> common_pb2.Block:
+    blk = common_pb2.Block()
+    blk.header.number = num
+    blk.data.data.extend(e.SerializeToString() for e in envs)
+    while len(blk.metadata.metadata) < 3:
+        blk.metadata.metadata.append(b"")
+    return blk
+
+
+def test_pipeline_matches_sequential(world):
+    org, ledger, bundle, endorser, client = world
+    blocks = []
+    for b in range(3):
+        envs = []
+        for i in range(4):
+            env = _tx(endorser, client, b"k%d-%d" % (b, i), b"v")
+            if i == 2:  # tamper one creator signature per block
+                env = common_pb2.Envelope(
+                    payload=env.payload, signature=env.signature[:-2] + b"xx"
+                )
+            envs.append(env)
+        blocks.append(_block(b + 1, envs))
+
+    def copies():
+        out = []
+        for blk in blocks:
+            c = common_pb2.Block()
+            c.CopyFrom(blk)
+            out.append(c)
+        return out
+
+    seq = [
+        TxValidator("pipech", ledger, bundle, org.csp).validate(b)
+        for b in copies()
+    ]
+    piped = list(
+        TxValidator("pipech", ledger, bundle, org.csp).validate_pipeline(
+            copies(), depth=2
+        )
+    )
+    assert piped == seq
+    for flags in piped:
+        assert flags[2] == V.BAD_CREATOR_SIGNATURE
+        assert [flags[0], flags[1], flags[3]] == [V.VALID] * 3
+
+
+def test_pipeline_catches_cross_block_duplicate_txid(world):
+    org, ledger, bundle, endorser, client = world
+    env = _tx(endorser, client, b"dupkey", b"v")
+    b1 = _block(10, [env])
+    b2 = _block(11, [env])  # same envelope (same txid) in the next block
+    piped = list(
+        TxValidator("pipech", ledger, bundle, org.csp).validate_pipeline(
+            [b1, b2], depth=2
+        )
+    )
+    assert piped[0] == [V.VALID]
+    assert piped[1] == [V.DUPLICATE_TXID]
