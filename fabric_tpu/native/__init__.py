@@ -16,7 +16,7 @@ import threading
 import numpy as np
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
-_SRC = os.path.join(_DIR, "marshal.cc")
+_SRCS = [os.path.join(_DIR, "marshal.cc"), os.path.join(_DIR, "collect.cc")]
 _LIB = os.path.join(_DIR, "libfabricmarshal.so")
 
 _lock = threading.Lock()
@@ -31,11 +31,12 @@ def _load():
             return _lib
         _tried = True
         try:
-            if not os.path.exists(_LIB) or (
-                os.path.getmtime(_LIB) < os.path.getmtime(_SRC)
+            if not os.path.exists(_LIB) or any(
+                os.path.getmtime(_LIB) < os.path.getmtime(src)
+                for src in _SRCS
             ):
                 subprocess.run(
-                    ["g++", "-O3", "-shared", "-fPIC", "-o", _LIB, _SRC],
+                    ["g++", "-O3", "-shared", "-fPIC", "-o", _LIB] + _SRCS,
                     check=True,
                     capture_output=True,
                 )
@@ -57,6 +58,20 @@ def _load():
                 np.ctypeslib.ndpointer(np.uint8, flags="C"),   # c1ok
                 np.ctypeslib.ndpointer(np.uint8, flags="C"),   # valid
             ]
+            i64p = np.ctypeslib.ndpointer(np.int64, flags="C")
+            i32p = np.ctypeslib.ndpointer(np.int32, flags="C")
+            u8p = np.ctypeslib.ndpointer(np.uint8, flags="C")
+            cb = lib.fabric_collect_block
+            cb.restype = ctypes.c_int
+            cb.argtypes = (
+                [ctypes.c_int, ctypes.c_char_p, i64p, ctypes.c_char_p,
+                 ctypes.c_int]
+                + [i32p, i32p]                    # status, type
+                + [i64p, i32p] * 2 + [u8p]        # creator, sig, payload_digest
+                + [i64p, i32p] * 4                # txid, prp, rwset, ccid
+                + [i32p, i32p, ctypes.c_int]      # endo_start/count, max
+                + [i64p, i32p] * 2 + [u8p]        # endorser, esig, edigest
+            )
             _lib = lib
         except Exception:
             _lib = None
@@ -102,4 +117,66 @@ def marshal_batch(xs: bytes, ys: bytes, digests: bytes, sigs: bytes,
     }
 
 
-__all__ = ["available", "marshal_batch"]
+def collect_block(env_bytes: bytes, env_off: np.ndarray,
+                  channel_id: bytes) -> dict | None:
+    """Native block-collect pass: walk every envelope's wire format,
+    run the syntactic checks, and emit per-tx offsets + SHA-256 digests
+    (see collect.cc).  Returns None when the library is unavailable.
+
+    Output dict of numpy arrays; offsets index into env_bytes.  status
+    uses collect.cc's codes: 0 endorser-tx ok, 1 config-tx ok, negative
+    = error/fallback (mapped to TxValidationCode by the caller)."""
+    lib = _load()
+    if lib is None:
+        return None
+    n = len(env_off) - 1
+    out = {
+        "status": np.empty(n, np.int32),
+        "type": np.empty(n, np.int32),
+        "creator_off": np.zeros(n, np.int64),
+        "creator_len": np.zeros(n, np.int32),
+        "sig_off": np.zeros(n, np.int64),
+        "sig_len": np.zeros(n, np.int32),
+        "payload_digest": np.zeros(32 * n, np.uint8),
+        "txid_off": np.zeros(n, np.int64),
+        "txid_len": np.zeros(n, np.int32),
+        "prp_off": np.zeros(n, np.int64),
+        "prp_len": np.zeros(n, np.int32),
+        "rwset_off": np.zeros(n, np.int64),
+        "rwset_len": np.zeros(n, np.int32),
+        "ccid_off": np.zeros(n, np.int64),
+        "ccid_len": np.zeros(n, np.int32),
+        "endo_start": np.zeros(n, np.int32),
+        "endo_count": np.zeros(n, np.int32),
+    }
+    max_endos = max(64, 8 * n)  # >= 8 endorsements/tx before a retry
+    while True:
+        endos = {
+            "e_endorser_off": np.zeros(max_endos, np.int64),
+            "e_endorser_len": np.zeros(max_endos, np.int32),
+            "e_sig_off": np.zeros(max_endos, np.int64),
+            "e_sig_len": np.zeros(max_endos, np.int32),
+            "e_digest": np.zeros(32 * max_endos, np.uint8),
+        }
+        rc = lib.fabric_collect_block(
+            n, env_bytes, np.ascontiguousarray(env_off, np.int64),
+            channel_id, len(channel_id),
+            out["status"], out["type"],
+            out["creator_off"], out["creator_len"],
+            out["sig_off"], out["sig_len"], out["payload_digest"],
+            out["txid_off"], out["txid_len"],
+            out["prp_off"], out["prp_len"],
+            out["rwset_off"], out["rwset_len"],
+            out["ccid_off"], out["ccid_len"],
+            out["endo_start"], out["endo_count"], max_endos,
+            endos["e_endorser_off"], endos["e_endorser_len"],
+            endos["e_sig_off"], endos["e_sig_len"], endos["e_digest"],
+        )
+        if rc >= 0:
+            out.update(endos)
+            out["n_endos"] = rc
+            return out
+        max_endos *= 4  # undersized endorsement arrays: retry larger
+
+
+__all__ = ["available", "marshal_batch", "collect_block"]

@@ -1,0 +1,478 @@
+// Native block-collect pass for the txvalidator (SURVEY.md §7 native
+// components policy; the "move the collect phase into the C++
+// marshaller" step recorded in BASELINE.md).
+//
+// Walks the protobuf wire format of every envelope in a block —
+// Envelope / Payload / Header / ChannelHeader / SignatureHeader /
+// Transaction / ChaincodeActionPayload / ChaincodeEndorsedAction /
+// ProposalResponsePayload / ChaincodeAction — performing the syntactic
+// checks of core/common/validation/msgvalidation.go:26-330 (reference
+// file:line) and emitting, per tx, the offsets and SHA-256 digests the
+// Python control plane needs to finish validation without touching a
+// single protobuf object on the hot path.
+//
+// Field numbers mirror fabric-protos-go (verified against the generated
+// *_pb2 descriptors): Envelope{payload=1,signature=2},
+// Payload{header=1,data=2}, Header{channel_header=1,signature_header=2},
+// ChannelHeader{type=1,channel_id=4,tx_id=5,epoch=6,extension=7},
+// SignatureHeader{creator=1,nonce=2}, Transaction{actions=1},
+// TransactionAction{payload=2}, ChaincodeActionPayload{ccpp=1,action=2},
+// ChaincodeEndorsedAction{prp=1,endorsements=2},
+// Endorsement{endorser=1,signature=2},
+// ProposalResponsePayload{proposal_hash=1,extension=2},
+// ChaincodeAction{results=1,events=2,chaincode_id=4},
+// ChaincodeHeaderExtension{chaincode_id=2}, ChaincodeID{name=2},
+// ChaincodeEvent{chaincode_id=1}.
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+
+typedef uint8_t u8;
+typedef uint32_t u32;
+typedef uint64_t u64;
+typedef int32_t i32;
+typedef int64_t i64;
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// SHA-256 (FIPS 180-4), scalar host implementation for digest outputs.
+// ---------------------------------------------------------------------------
+
+struct Sha256 {
+  u32 h[8];
+  u8 buf[64];
+  u64 len = 0;
+  int fill = 0;
+  Sha256() {
+    static const u32 init[8] = {0x6a09e667, 0xbb67ae85, 0x3c6ef372,
+                                0xa54ff53a, 0x510e527f, 0x9b05688c,
+                                0x1f83d9ab, 0x5be0cd19};
+    memcpy(h, init, sizeof(h));
+  }
+  static u32 rotr(u32 x, int n) { return (x >> n) | (x << (32 - n)); }
+  void block(const u8* p) {
+    static const u32 K[64] = {
+        0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b,
+        0x59f111f1, 0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01,
+        0x243185be, 0x550c7dc3, 0x72be5d74, 0x80deb1fe, 0x9bdc06a7,
+        0xc19bf174, 0xe49b69c1, 0xefbe4786, 0x0fc19dc6, 0x240ca1cc,
+        0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da, 0x983e5152,
+        0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+        0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc,
+        0x53380d13, 0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85,
+        0xa2bfe8a1, 0xa81a664b, 0xc24b8b70, 0xc76c51a3, 0xd192e819,
+        0xd6990624, 0xf40e3585, 0x106aa070, 0x19a4c116, 0x1e376c08,
+        0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a, 0x5b9cca4f,
+        0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+        0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2};
+    u32 w[64];
+    for (int i = 0; i < 16; ++i)
+      w[i] = (u32(p[4 * i]) << 24) | (u32(p[4 * i + 1]) << 16) |
+             (u32(p[4 * i + 2]) << 8) | u32(p[4 * i + 3]);
+    for (int i = 16; i < 64; ++i) {
+      u32 s0 = rotr(w[i - 15], 7) ^ rotr(w[i - 15], 18) ^ (w[i - 15] >> 3);
+      u32 s1 = rotr(w[i - 2], 17) ^ rotr(w[i - 2], 19) ^ (w[i - 2] >> 10);
+      w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+    }
+    u32 a = h[0], b = h[1], c = h[2], d = h[3], e = h[4], f = h[5],
+        g = h[6], hh = h[7];
+    for (int i = 0; i < 64; ++i) {
+      u32 S1 = rotr(e, 6) ^ rotr(e, 11) ^ rotr(e, 25);
+      u32 ch = (e & f) ^ (~e & g);
+      u32 t1 = hh + S1 + ch + K[i] + w[i];
+      u32 S0 = rotr(a, 2) ^ rotr(a, 13) ^ rotr(a, 22);
+      u32 mj = (a & b) ^ (a & c) ^ (b & c);
+      u32 t2 = S0 + mj;
+      hh = g; g = f; f = e; e = d + t1;
+      d = c; c = b; b = a; a = t1 + t2;
+    }
+    h[0] += a; h[1] += b; h[2] += c; h[3] += d;
+    h[4] += e; h[5] += f; h[6] += g; h[7] += hh;
+  }
+  void update(const u8* p, size_t n) {
+    len += n;
+    if (fill) {
+      while (n && fill < 64) { buf[fill++] = *p++; --n; }
+      if (fill == 64) { block(buf); fill = 0; }
+    }
+    while (n >= 64) { block(p); p += 64; n -= 64; }
+    while (n) { buf[fill++] = *p++; --n; }
+  }
+  void final(u8* out) {
+    u64 bits = len * 8;
+    u8 pad = 0x80;
+    update(&pad, 1);
+    u8 z = 0;
+    while (fill != 56) update(&z, 1);
+    u8 lb[8];
+    for (int i = 0; i < 8; ++i) lb[i] = u8(bits >> (56 - 8 * i));
+    update(lb, 8);
+    for (int i = 0; i < 8; ++i) {
+      out[4 * i] = u8(h[i] >> 24);
+      out[4 * i + 1] = u8(h[i] >> 16);
+      out[4 * i + 2] = u8(h[i] >> 8);
+      out[4 * i + 3] = u8(h[i]);
+    }
+  }
+};
+
+void sha256(const u8* p, size_t n, u8* out) {
+  Sha256 s;
+  s.update(p, n);
+  s.final(out);
+}
+
+// ---------------------------------------------------------------------------
+// Protobuf wire walker.
+// ---------------------------------------------------------------------------
+
+struct Slice {
+  const u8* p = nullptr;
+  size_t n = 0;
+  bool set = false;
+};
+
+bool read_varint(const u8*& p, const u8* end, u64* v) {
+  u64 out = 0;
+  int shift = 0;
+  while (p < end && shift < 64) {
+    u8 b = *p++;
+    out |= u64(b & 0x7f) << shift;
+    if (!(b & 0x80)) { *v = out; return true; }
+    shift += 7;
+  }
+  return false;
+}
+
+// Scan a message, filling `fields[num] = last occurrence` for
+// length-delimited fields and `varints[num]` for varint fields
+// (numbers above `maxf` are skipped).  Returns false on malformed wire.
+bool scan(const u8* p, size_t n, int maxf, Slice* fields, u64* varints) {
+  const u8* end = p + n;
+  while (p < end) {
+    u64 tag;
+    if (!read_varint(p, end, &tag)) return false;
+    int num = int(tag >> 3);
+    int wt = int(tag & 7);
+    if (wt == 0) {
+      u64 v;
+      if (!read_varint(p, end, &v)) return false;
+      if (num <= maxf && varints) varints[num] = v;
+    } else if (wt == 2) {
+      u64 l;
+      if (!read_varint(p, end, &l)) return false;
+      if (l > size_t(end - p)) return false;
+      if (num <= maxf && fields) {
+        fields[num].p = p;
+        fields[num].n = size_t(l);
+        fields[num].set = true;
+      }
+      p += l;
+    } else if (wt == 5) {
+      if (end - p < 4) return false;
+      p += 4;
+    } else if (wt == 1) {
+      if (end - p < 8) return false;
+      p += 8;
+    } else {
+      return false;
+    }
+  }
+  return true;
+}
+
+const char HEX[] = "0123456789abcdef";
+
+// Status codes (mapped to TxValidationCode in Python glue).
+enum {
+  OK_ENDORSER = 0,
+  OK_CONFIG = 1,
+  E_NIL_ENVELOPE = -1,
+  E_BAD_PAYLOAD = -2,
+  E_BAD_COMMON_HEADER = -3,
+  E_BAD_CHANNEL_HEADER = -4,
+  E_BAD_PROPOSAL_TXID = -5,
+  E_BAD_RESPONSE_PAYLOAD = -6,
+  E_NO_ENDORSEMENTS = -7,
+  E_UNKNOWN_TX_TYPE = -8,
+  E_BAD_HEADER_EXTENSION = -9,
+  E_INVALID_CHAINCODE = -10,
+  E_INVALID_OTHER = -11,
+  E_PY_FALLBACK = -12,
+  E_NIL_TXACTION = -13,
+};
+
+}  // namespace
+
+extern "C" {
+
+// Per-tx arrays sized n; endorsement arrays sized max_endos.  All
+// offsets are relative to `envs`.  Returns the total endorsement count,
+// or -1 when max_endos was exceeded (caller re-runs with more room).
+int fabric_collect_block(
+    int n, const u8* envs, const i64* env_off, const u8* channel_id,
+    int channel_id_len, i32* status, i32* type_out, i64* creator_off,
+    i32* creator_len, i64* sig_off, i32* sig_len, u8* payload_digest,
+    i64* txid_off, i32* txid_len, i64* prp_off, i32* prp_len,
+    i64* rwset_off, i32* rwset_len, i64* ccid_off, i32* ccid_len,
+    i32* endo_start, i32* endo_count, int max_endos, i64* e_endorser_off,
+    i32* e_endorser_len, i64* e_sig_off, i32* e_sig_len, u8* e_digest) {
+  int ne = 0;
+  for (int i = 0; i < n; ++i) {
+    status[i] = E_BAD_PAYLOAD;
+    type_out[i] = -1;
+    creator_len[i] = sig_len[i] = txid_len[i] = 0;
+    prp_len[i] = rwset_len[i] = ccid_len[i] = 0;
+    endo_start[i] = ne;
+    endo_count[i] = 0;
+    const u8* env = envs + env_off[i];
+    size_t env_n = size_t(env_off[i + 1] - env_off[i]);
+
+    Slice ef[3];
+    if (!scan(env, env_n, 2, ef, nullptr)) continue;
+    if (!ef[1].set || ef[1].n == 0) { status[i] = E_NIL_ENVELOPE; continue; }
+    const Slice payload = ef[1];
+    // creator signature over the payload bytes
+    sig_off[i] = ef[2].set ? (ef[2].p - envs) : 0;
+    sig_len[i] = ef[2].set ? i32(ef[2].n) : 0;
+    sha256(payload.p, payload.n, payload_digest + 32 * i);
+
+    Slice pf[3];
+    if (!scan(payload.p, payload.n, 2, pf, nullptr)) continue;
+    if (!pf[1].set) continue;
+    Slice hf[3];
+    if (!scan(pf[1].p, pf[1].n, 2, hf, nullptr)) continue;
+    if (!hf[1].set || !hf[2].set) continue;
+    const Slice chdr = hf[1], shdr = hf[2];
+    Slice cf[8];
+    u64 cv[8] = {0};
+    if (!scan(chdr.p, chdr.n, 7, cf, cv)) continue;
+    Slice sf[3];
+    if (!scan(shdr.p, shdr.n, 2, sf, nullptr)) continue;
+
+    const Slice creator = sf[1], nonce = sf[2];
+    if (!creator.set || creator.n == 0 || !nonce.set || nonce.n == 0) {
+      status[i] = E_BAD_COMMON_HEADER;
+      continue;
+    }
+    // channel id match + epoch == 0
+    if (!cf[4].set || cf[4].n != size_t(channel_id_len) ||
+        memcmp(cf[4].p, channel_id, channel_id_len) != 0 || cv[6] != 0) {
+      status[i] = E_BAD_CHANNEL_HEADER;
+      continue;
+    }
+    creator_off[i] = creator.p - envs;
+    creator_len[i] = i32(creator.n);
+    type_out[i] = i32(cv[1]);
+
+    if (cv[1] == 1 /* CONFIG */) { status[i] = OK_CONFIG; continue; }
+    if (cv[1] != 3 /* ENDORSER_TRANSACTION */) {
+      status[i] = E_UNKNOWN_TX_TYPE;
+      continue;
+    }
+
+    // tx-id binding: hex(sha256(nonce || creator)) == chdr.tx_id
+    {
+      if (!cf[5].set || cf[5].n != 64) { status[i] = E_BAD_PROPOSAL_TXID; continue; }
+      Sha256 s;
+      s.update(nonce.p, nonce.n);
+      s.update(creator.p, creator.n);
+      u8 d[32];
+      s.final(d);
+      char hex[64];
+      for (int k = 0; k < 32; ++k) {
+        hex[2 * k] = HEX[d[k] >> 4];
+        hex[2 * k + 1] = HEX[d[k] & 0xf];
+      }
+      if (memcmp(hex, cf[5].p, 64) != 0) { status[i] = E_BAD_PROPOSAL_TXID; continue; }
+      txid_off[i] = cf[5].p - envs;
+      txid_len[i] = 64;
+    }
+
+    // Transaction -> FIRST action (python validates tx.actions[0];
+    // scan() keeps the last occurrence, so walk manually)
+    if (!pf[2].set) { status[i] = E_NIL_TXACTION; continue; }
+    Slice action0;
+    {
+      const u8* p = pf[2].p;
+      const u8* end = p + pf[2].n;
+      bool bad = false;
+      while (p < end && !action0.set) {
+        u64 tag;
+        if (!read_varint(p, end, &tag)) { bad = true; break; }
+        int wt = int(tag & 7);
+        if (wt == 2) {
+          u64 l;
+          if (!read_varint(p, end, &l) || l > size_t(end - p)) { bad = true; break; }
+          if (int(tag >> 3) == 1) { action0.p = p; action0.n = size_t(l); action0.set = true; }
+          p += l;
+        } else if (wt == 0) {
+          u64 v;
+          if (!read_varint(p, end, &v)) { bad = true; break; }
+        } else if (wt == 5) { if (end - p < 4) { bad = true; break; } p += 4; }
+        else if (wt == 1) { if (end - p < 8) { bad = true; break; } p += 8; }
+        else { bad = true; break; }
+      }
+      if (bad) continue;
+      if (!action0.set) { status[i] = E_NIL_TXACTION; continue; }
+    }
+    Slice taf[3];
+    if (!scan(action0.p, action0.n, 2, taf, nullptr)) continue;
+    if (!taf[2].set) continue;
+    Slice capf[3];
+    if (!scan(taf[2].p, taf[2].n, 2, capf, nullptr)) continue;
+    if (!capf[2].set) continue;
+    const Slice ccpp = capf[1];
+    Slice eaf[3];
+    if (!scan(capf[2].p, capf[2].n, 2, eaf, nullptr)) continue;
+    if (!eaf[1].set) continue;
+    const Slice prp = eaf[1];
+    Slice prpf[3];
+    if (!scan(prp.p, prp.n, 2, prpf, nullptr)) continue;
+    if (!prpf[1].set || !prpf[2].set) continue;
+
+    // proposal-hash binding: sha256(chdr || shdr || ccpp-without-
+    // TransientMap).  The filtered ccpp must match python's
+    // reserialization (ClearField + SerializeToString): true when the
+    // wire holds fields in canonical order with no duplicates — checked
+    // below; anything else falls back to the Python path.
+    {
+      Sha256 s;
+      s.update(chdr.p, chdr.n);
+      s.update(shdr.p, shdr.n);
+      bool canonical = true;
+      if (ccpp.set && ccpp.n) {
+        const u8* p = ccpp.p;
+        const u8* end = ccpp.p + ccpp.n;
+        int last_num = 0;
+        while (p < end) {
+          const u8* field_start = p;
+          u64 tag;
+          if (!read_varint(p, end, &tag)) { canonical = false; break; }
+          int num = int(tag >> 3);
+          int wt = int(tag & 7);
+          if (wt != 2 || num <= last_num) { canonical = false; break; }
+          last_num = num;
+          u64 l;
+          if (!read_varint(p, end, &l) || l > size_t(end - p)) {
+            canonical = false;
+            break;
+          }
+          p += l;
+          if (num == 1) s.update(field_start, p - field_start);
+          // num == 2 (TransientMap) is dropped; other fields unknown ->
+          // python would preserve them, we cannot: fall back.
+          if (num > 2) { canonical = false; break; }
+        }
+      }
+      if (!canonical) { status[i] = E_PY_FALLBACK; continue; }
+      u8 want[32];
+      s.final(want);
+      if (prpf[1].n != 32 || memcmp(prpf[1].p, want, 32) != 0) {
+        status[i] = E_BAD_RESPONSE_PAYLOAD;
+        continue;
+      }
+    }
+
+    // endorsements FIRST (python checks cap.action.endorsements right
+    // after the proposal-hash binding, before any chaincode-id checks):
+    // every occurrence of field 2 in ChaincodeEndorsedAction.  A missing
+    // endorser field stays in the batch (empty identity -> python's
+    // dummy-item lane -> policy failure at finish), matching the python
+    // path's per-endorsement tolerance.
+    {
+      const u8* p = capf[2].p;
+      const u8* end = p + capf[2].n;
+      int count = 0;
+      bool ok = true;
+      while (p < end) {
+        u64 tag;
+        if (!read_varint(p, end, &tag)) { ok = false; break; }
+        int num = int(tag >> 3);
+        int wt = int(tag & 7);
+        if (wt != 2) { ok = false; break; }
+        u64 l;
+        if (!read_varint(p, end, &l) || l > size_t(end - p)) { ok = false; break; }
+        const u8* body = p;
+        p += l;
+        if (num != 2) continue;
+        if (ne >= max_endos) return -1;
+        Slice endo[3];
+        if (!scan(body, size_t(l), 2, endo, nullptr)) { ok = false; break; }
+        e_endorser_off[ne] = endo[1].set ? (endo[1].p - envs) : 0;
+        e_endorser_len[ne] = endo[1].set ? i32(endo[1].n) : 0;
+        e_sig_off[ne] = endo[2].set ? (endo[2].p - envs) : 0;
+        e_sig_len[ne] = endo[2].set ? i32(endo[2].n) : 0;
+        // digest of (prp_bytes || endorser): what each endorsement signs
+        Sha256 es;
+        es.update(prp.p, prp.n);
+        if (endo[1].set) es.update(endo[1].p, endo[1].n);
+        es.final(e_digest + 32 * size_t(ne));
+        ++ne;
+        ++count;
+      }
+      if (!ok) { status[i] = E_BAD_PAYLOAD; endo_count[i] = 0; continue; }
+      if (count == 0) { status[i] = E_NO_ENDORSEMENTS; continue; }
+      endo_count[i] = count;
+    }
+
+    // ChaincodeAction: results, events, chaincode_id
+    Slice af[5];
+    if (!scan(prpf[2].p, prpf[2].n, 4, af, nullptr)) { endo_count[i] = 0; continue; }
+    // header-extension chaincode id.  A MISSING extension parses as an
+    // empty message in python (cc_id == "" -> INVALID_CHAINCODE);
+    // BAD_HEADER_EXTENSION is only for extension bytes that fail to
+    // parse.
+    Slice hef[3];
+    if (cf[7].set && !scan(cf[7].p, cf[7].n, 2, hef, nullptr)) {
+      status[i] = E_BAD_HEADER_EXTENSION;
+      endo_count[i] = 0;
+      continue;
+    }
+    Slice hccf[3];
+    if (hef[2].set && !scan(hef[2].p, hef[2].n, 2, hccf, nullptr)) {
+      status[i] = E_BAD_HEADER_EXTENSION;
+      endo_count[i] = 0;
+      continue;
+    }
+    if (!hccf[2].set || hccf[2].n == 0) {
+      status[i] = E_INVALID_CHAINCODE;
+      endo_count[i] = 0;
+      continue;
+    }
+    const Slice ccid = hccf[2];
+    {
+      Slice accf[3];
+      if (!af[4].set || !scan(af[4].p, af[4].n, 2, accf, nullptr) ||
+          !accf[2].set || accf[2].n != ccid.n ||
+          memcmp(accf[2].p, ccid.p, ccid.n) != 0) {
+        status[i] = E_INVALID_CHAINCODE;
+        endo_count[i] = 0;
+        continue;
+      }
+    }
+    if (af[2].set && af[2].n) {  // chaincode event must name the chaincode
+      Slice evf[2];
+      if (!scan(af[2].p, af[2].n, 1, evf, nullptr) || !evf[1].set ||
+          evf[1].n != ccid.n || memcmp(evf[1].p, ccid.p, ccid.n) != 0) {
+        status[i] = E_INVALID_OTHER;
+        endo_count[i] = 0;
+        continue;
+      }
+    }
+    ccid_off[i] = ccid.p - envs;
+    ccid_len[i] = i32(ccid.n);
+    if (af[1].set) {
+      rwset_off[i] = af[1].p - envs;
+      rwset_len[i] = i32(af[1].n);
+    }
+    prp_off[i] = prp.p - envs;
+    prp_len[i] = i32(prp.n);
+    status[i] = OK_ENDORSER;
+  }
+  return ne;
+}
+
+}  // extern "C"

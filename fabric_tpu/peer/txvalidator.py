@@ -46,6 +46,34 @@ from fabric_tpu.protoutil import SignedData
 V = transaction_pb2
 
 
+class _ItemSink:
+    """Global verify-item collector with structural dedup.
+
+    An implicit-meta policy (e.g. MAJORITY Endorsement over N orgs)
+    prepares every sub-policy against the same endorsement set; without
+    interning, each sub-policy re-verifies the same (key, digest, sig)
+    triples — the reference pays exactly that cost in repeated
+    identity.Verify calls (common/policies/policy.go:365 per
+    EvaluateSignedData).  Here identical triples collapse to ONE device
+    lane and every pending keeps index lists into the shared mask."""
+
+    def __init__(self):
+        self.items: list = []
+        self._index: dict = {}
+
+    def add(self, item) -> int:
+        k = (item.key.x, item.key.y, item.digest, item.signature)
+        i = self._index.get(k)
+        if i is None:
+            i = len(self.items)
+            self._index[k] = i
+            self.items.append(item)
+        return i
+
+    def add_many(self, items) -> list[int]:
+        return [self.add(it) for it in items]
+
+
 @dataclasses.dataclass
 class _TxWork:
     """Per-tx deferred crypto: creator item index + per-namespace plugin
@@ -54,7 +82,7 @@ class _TxWork:
 
     creator_item: int | None = None
     pendings: list = dataclasses.field(default_factory=list)
-    # [(PendingValidation, (start, end))] — one per written namespace
+    # [(PendingValidation, [item index, ...])] — one per written namespace
     touched_keys: frozenset = frozenset()  # {(ns_or_hashns, key)}
     meta_keys: frozenset = frozenset()
     # keys whose VALIDATION_PARAMETER this tx rewrites; once the tx is
@@ -107,7 +135,7 @@ class TxValidator:
 
     # -- phase 1: per-tx syntactic validation + collection ----------------
 
-    def _collect_tx(self, env_bytes: bytes, seen_txids: set, items: list, work: _TxWork) -> int:
+    def _collect_tx(self, env_bytes: bytes, seen_txids: set, sink: _ItemSink, work: _TxWork) -> int:
         try:
             env = common_pb2.Envelope.FromString(env_bytes)
             if not env.payload:
@@ -131,8 +159,9 @@ class TxValidator:
         except Exception:
             return V.BAD_CREATOR_SIGNATURE
         # creator signature over the payload bytes (checkSignatureFromCreator)
-        work.creator_item = len(items)
-        items.append(creator.verification_item(env.payload, env.signature))
+        work.creator_item = sink.add(
+            creator.verification_item(env.payload, env.signature)
+        )
 
         if chdr.type == common_pb2.CONFIG:
             # config txs are validated/applied by the channel config engine
@@ -198,41 +227,9 @@ class TxValidator:
             SignedData(prp_bytes + e.endorser, e.endorser, e.signature)
             for e in cap.action.endorsements
         ]
-        try:
-            footprint = parse_footprint(bytes(action.results))
-        except IllegalWritesetError:
-            return V.ILLEGAL_WRITESET
-        except Exception:
-            return V.BAD_RWSET
-
-        # validate EACH written namespace against its own chaincode's
-        # plugin + policy (dispatcher.go:158-218 wrNamespace loop)
-        namespaces = [cc_id] + [
-            ns
-            for ns, entry in footprint.per_ns.items()
-            if entry["writes"] and ns != cc_id
-        ]
-        for ns in namespaces:
-            ctx = ValidationContext(
-                channel_id=self.channel_id,
-                namespace=ns,
-                tx_pos=-1,
-                endorsements=signed,
-                rwset_bytes=bytes(action.results),
-                policy_provider=self._policy_provider,
-                state_metadata=self._committed_metadata,
-                footprint=footprint,
-            )
-            try:
-                pending = self._plugin_for(ns).prepare(ctx)
-            except Exception:
-                return V.INVALID_OTHER_REASON
-            start = len(items)
-            items.extend(pending.items)
-            work.pendings.append((pending, (start, len(items))))
-        work.touched_keys = footprint.touched
-        work.meta_keys = frozenset(footprint.meta_writes)
-        return V.VALID
+        return self._prepare_namespaces(
+            work, signed, cc_id, bytes(action.results), sink
+        )
 
     # -- the three-phase validate -----------------------------------------
 
@@ -282,15 +279,179 @@ class TxValidator:
         n = len(block.data.data)
         flags = [V.NOT_VALIDATED] * n
         works = [_TxWork() for _ in range(n)]
-        items: list = []
+        sink = _ItemSink()
 
-        for i in range(n):
-            flags[i] = self._collect_tx(block.data.data[i], seen_txids, items, works[i])
+        native = self._collect_native(block, seen_txids, sink, works, flags)
+        if not native:
+            for i in range(n):
+                flags[i] = self._collect_tx(
+                    block.data.data[i], seen_txids, sink, works[i]
+                )
 
         collect = (
-            self._csp.verify_batch_async(items) if items else (lambda: [])
+            self._csp.verify_batch_async(sink.items)
+            if sink.items
+            else (lambda: [])
         )
         return block, flags, works, collect
+
+    # C++ status codes (collect.cc) -> TxValidationCode, for the stages
+    # BEFORE creator validation (parse/header failures).
+    _NATIVE_EARLY = {
+        -1: V.NIL_ENVELOPE,
+        -2: V.BAD_PAYLOAD,
+        -3: V.BAD_COMMON_HEADER,
+        -4: V.BAD_CHANNEL_HEADER,
+    }
+    # ... and for the stages AFTER it (the glue re-runs the creator
+    # check first, preserving the reference's flag precedence).
+    _NATIVE_LATE = {
+        -5: V.BAD_PROPOSAL_TXID,
+        -6: V.BAD_RESPONSE_PAYLOAD,
+        -7: V.ENDORSEMENT_POLICY_FAILURE,
+        -8: V.UNKNOWN_TX_TYPE,
+        -9: V.BAD_HEADER_EXTENSION,
+        -10: V.INVALID_CHAINCODE,
+        -11: V.INVALID_OTHER_REASON,
+        -13: V.NIL_TXACTION,
+    }
+
+    def _collect_native(self, block, seen_txids, sink: _ItemSink, works, flags) -> bool:
+        """Native-assisted collect: one C++ pass walks every envelope's
+        wire format (syntactic checks + SHA-256 digests, collect.cc),
+        then this glue does only identity/policy work per tx.  Returns
+        False when the native library is unavailable (caller runs the
+        pure-Python path); individual txs the C++ pass cannot decide
+        (status -12) fall back to Python per tx."""
+        from fabric_tpu import native
+        from fabric_tpu.csp.api import VerifyBatchItem
+
+        if not native.available():
+            return False
+        data = block.data.data
+        offs = [0]
+        for d in data:
+            offs.append(offs[-1] + len(d))
+        import numpy as np
+
+        buf = b"".join(data)
+        co = native.collect_block(
+            buf, np.asarray(offs, np.int64), self.channel_id.encode()
+        )
+        if co is None:
+            return False
+        digs = bytes(co["payload_digest"])
+        edigs = bytes(co["e_digest"])
+
+        def sl(off, ln):
+            return buf[off:off + ln]
+
+        for i in range(len(data)):
+            st = int(co["status"][i])
+            if st == -12:  # python fallback for this tx
+                flags[i] = self._collect_tx(data[i], seen_txids, sink, works[i])
+                continue
+            if st in self._NATIVE_EARLY and not (
+                st == -2 and co["creator_len"][i]
+            ):
+                # st == -2 with a creator present is a DEEP parse failure
+                # (tx/cap/prp wire) — those flow through the creator and
+                # dup-txid stages below, matching the reference's order.
+                flags[i] = self._NATIVE_EARLY[st]
+                continue
+            # creator deserialize + validate (reference flag precedence:
+            # BAD_CREATOR_SIGNATURE wins over later-stage failures)
+            creator_bytes = sl(int(co["creator_off"][i]), int(co["creator_len"][i]))
+            try:
+                creator = self._bundle.msp_manager.deserialize_identity(
+                    creator_bytes
+                )
+                self._bundle.msp_manager.validate(creator)
+            except Exception:
+                flags[i] = V.BAD_CREATOR_SIGNATURE
+                continue
+            w = works[i]
+            w.creator_item = sink.add(
+                VerifyBatchItem(
+                    creator.public_key,
+                    digs[32 * i:32 * i + 32],
+                    sl(int(co["sig_off"][i]), int(co["sig_len"][i])),
+                )
+            )
+            if st == 1:  # CONFIG tx: creator signature only
+                flags[i] = V.VALID
+                continue
+            if st in (-8, -5):  # checks that precede the dup-txid stage
+                flags[i] = self._NATIVE_LATE[st]
+                continue
+
+            # dup-txid stage: the txid registers even when a LATER check
+            # fails (the reference adds to the dedup set right here too)
+            txid = sl(int(co["txid_off"][i]), int(co["txid_len"][i])).decode()
+            if txid in seen_txids or self._ledger.tx_id_exists(txid):
+                flags[i] = V.DUPLICATE_TXID
+                continue
+            seen_txids.add(txid)
+
+            if st in self._NATIVE_LATE:  # post-dup-stage failures
+                flags[i] = self._NATIVE_LATE[st]
+                continue
+            if st == -2:  # deep parse failure (tx/cap/prp wire)
+                flags[i] = V.BAD_PAYLOAD
+                continue
+
+            prp_bytes = sl(int(co["prp_off"][i]), int(co["prp_len"][i]))
+            cc_id = sl(int(co["ccid_off"][i]), int(co["ccid_len"][i])).decode()
+            rwset_bytes = sl(int(co["rwset_off"][i]), int(co["rwset_len"][i]))
+            es, ec = int(co["endo_start"][i]), int(co["endo_count"][i])
+            signed = [
+                SignedData(
+                    b"",
+                    sl(int(co["e_endorser_off"][k]), int(co["e_endorser_len"][k])),
+                    sl(int(co["e_sig_off"][k]), int(co["e_sig_len"][k])),
+                    digest=edigs[32 * k:32 * k + 32],
+                )
+                for k in range(es, es + ec)
+            ]
+            flags[i] = self._prepare_namespaces(
+                w, signed, cc_id, rwset_bytes, sink
+            )
+        return True
+
+    def _prepare_namespaces(self, w, signed, cc_id, rwset_bytes, sink: _ItemSink) -> int:
+        """Shared tail of collect: rwset footprint + per-written-namespace
+        plugin prepare (dispatcher.go:158-218 wrNamespace loop)."""
+        try:
+            footprint = parse_footprint(rwset_bytes)
+        except IllegalWritesetError:
+            return V.ILLEGAL_WRITESET
+        except Exception:
+            return V.BAD_RWSET
+
+        namespaces = [cc_id] + [
+            ns
+            for ns, entry in footprint.per_ns.items()
+            if entry["writes"] and ns != cc_id
+        ]
+        for ns in namespaces:
+            ctx = ValidationContext(
+                channel_id=self.channel_id,
+                namespace=ns,
+                tx_pos=-1,
+                endorsements=signed,
+                rwset_bytes=rwset_bytes,
+                policy_provider=self._policy_provider,
+                state_metadata=self._committed_metadata,
+                footprint=footprint,
+            )
+            try:
+                pending = self._plugin_for(ns).prepare(ctx)
+            except Exception:
+                return V.INVALID_OTHER_REASON
+            w.pendings.append((pending, sink.add_many(pending.items)))
+        w.touched_keys = footprint.touched
+        w.meta_keys = frozenset(footprint.meta_writes)
+        return V.VALID
 
     def _finish_block(self, block, flags, works, collect) -> list[int]:
         n = len(flags)
@@ -317,7 +478,7 @@ class TxValidator:
                 flags[i] = V.ENDORSEMENT_POLICY_FAILURE
                 continue
             ok = all(
-                p.finish(mask[start:end]) for p, (start, end) in w.pendings
+                p.finish([mask[j] for j in idxs]) for p, idxs in w.pendings
             )
             if not ok:
                 flags[i] = V.ENDORSEMENT_POLICY_FAILURE

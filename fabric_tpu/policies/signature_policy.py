@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import dataclasses
 
+from fabric_tpu.csp.api import VerifyBatchItem
 from fabric_tpu.protos.common import policies_pb2
 from fabric_tpu.protoutil import SignedData
 
@@ -128,6 +129,10 @@ class SignaturePolicy:
                 # keep lane alignment; a lane that cannot deserialize can
                 # never verify.  Use an unsatisfiable dummy item.
                 items.append(_dummy_item())
+            elif sd.digest is not None:
+                items.append(
+                    VerifyBatchItem(ident.public_key, sd.digest, sd.signature)
+                )
             else:
                 items.append(ident.verification_item(sd.data, sd.signature))
         return PendingEvaluation(items, self._closure, idents)

@@ -14,11 +14,17 @@ from fabric_tpu.protos.common import common_pb2
 @dataclasses.dataclass(frozen=True)
 class SignedData:
     """A (message, identity, signature) triple — the unit fed to policy
-    evaluation and batch verification (reference protoutil/signeddata.go)."""
+    evaluation and batch verification (reference protoutil/signeddata.go).
+
+    `digest`, when set, is the precomputed SHA-256 of `data` (the native
+    block-collect pass hashes while walking the wire format); verifiers
+    use it instead of re-hashing.  `data` may then be b"" — nothing
+    downstream of policy prepare reads it."""
 
     data: bytes
     identity: bytes  # marshaled msp.SerializedIdentity
     signature: bytes
+    digest: bytes | None = None
 
 
 def random_nonce(n: int = 24) -> bytes:
