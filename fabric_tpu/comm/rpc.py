@@ -137,8 +137,49 @@ class RPCServer:
     def addr(self) -> tuple[str, int]:
         return self._srv.server_address[:2]
 
-    def register(self, method: str, fn) -> None:
-        self.methods[method] = fn
+    def register(self, method: str, fn, limiter=None) -> None:
+        """Register a handler; `limiter` (a common.semaphore.Semaphore)
+        caps concurrent invocations of this method — the reference's
+        per-service gRPC concurrency limiters
+        (internal/peer/node/grpc_limiters.go): excess calls fail fast
+        with a resource-exhausted error rather than queueing."""
+        if limiter is None:
+            self.methods[method] = fn
+            return
+
+        def limited(body, stream):
+            if not limiter.try_acquire():
+                raise RuntimeError(
+                    f"{method}: too many requests, try again later"
+                )
+            released = [False]
+
+            def release_once():
+                if not released[0]:
+                    released[0] = True
+                    limiter.release()
+
+            try:
+                out = fn(body, stream)
+            except BaseException:
+                release_once()
+                raise
+            if out is None or isinstance(out, (bytes, bytearray)):
+                release_once()
+                return out
+
+            # Streaming handler: it returned a lazy iterator, so the
+            # permit must span the whole stream (the reference's deliver
+            # limiter caps concurrent STREAMS, not handler dispatches).
+            def held():
+                try:
+                    yield from out
+                finally:
+                    release_once()
+
+            return held()
+
+        self.methods[method] = limited
 
     def start(self) -> None:
         self._thread = threading.Thread(

@@ -1,0 +1,37 @@
+"""Internal bookkeeping provider (reference
+core/ledger/kvledger/bookkeeping/provider.go).
+
+Ledger-internal components (pvt-data expiry schedules, metadata hints,
+snapshot bookkeeping) need durable key-value namespaces that are NOT
+part of channel state.  The reference hands each category a leveldb
+handle namespaced by ledger id + category; here each category is a
+`NamespacedKV` view over the ledger's shared KVStore under the
+"bookkeeping/<ledger>/<category>" prefix.
+"""
+
+from __future__ import annotations
+
+from fabric_tpu.ledger.kvstore import KVStore, NamedDB
+
+# reference bookkeeping.Category values
+PVT_DATA_EXPIRY = "pvtdata-expiry"
+METADATA_PRESENCE = "metadata-presence"
+SNAPSHOT_REQUEST = "snapshot-request"
+
+
+class BookkeepingProvider:
+    """Per-ledger, per-category durable namespaces."""
+
+    def __init__(self, store: KVStore):
+        self._store = store
+
+    def get_kv(self, ledger_id: str, category: str) -> NamedDB:
+        return NamedDB(self._store, f"bookkeeping/{ledger_id}/{category}")
+
+
+__all__ = [
+    "BookkeepingProvider",
+    "PVT_DATA_EXPIRY",
+    "METADATA_PRESENCE",
+    "SNAPSHOT_REQUEST",
+]

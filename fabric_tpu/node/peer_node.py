@@ -32,7 +32,9 @@ from fabric_tpu.chaincode.lifecycle import (
     LifecycleSCC,
     PackageStore,
 )
+from fabric_tpu.chaincode.lscc import LSCC
 from fabric_tpu.chaincode.scc import CSCC, QSCC
+from fabric_tpu.common.semaphore import Semaphore
 from fabric_tpu.comm import RPCServer
 from fabric_tpu.common.channelconfig import bundle_from_genesis
 from fabric_tpu.common.deliver import BlockNotifier, DeliverService
@@ -160,6 +162,7 @@ class PeerNode:
             "_lifecycle",
             LifecycleSCC(self.package_store, org_lister=self._app_orgs),
         )
+        self._launch_scc("lscc", LSCC(self.package_store))
         for spec in chaincode_specs or []:
             name, _, target = spec.partition("=")
             mod, _, attr = target.partition(":")
@@ -199,9 +202,20 @@ class PeerNode:
             )
 
         self.rpc = RPCServer(host, port)
-        self.rpc.register("endorser.ProcessProposal", self._process_proposal)
-        self.rpc.register("deliver.Deliver", self._deliver)
-        self.rpc.register("deliver.DeliverFiltered", self._deliver_filtered)
+        # per-service concurrency limiters (reference
+        # internal/peer/node/grpc_limiters.go; defaults from
+        # sampleconfig/core.yaml peer.limits.concurrency)
+        endorser_sem = Semaphore(2500)
+        deliver_sem = Semaphore(2500)
+        self.rpc.register(
+            "endorser.ProcessProposal", self._process_proposal,
+            limiter=endorser_sem,
+        )
+        self.rpc.register("deliver.Deliver", self._deliver, limiter=deliver_sem)
+        self.rpc.register(
+            "deliver.DeliverFiltered", self._deliver_filtered,
+            limiter=deliver_sem,
+        )
         self.rpc.register("discovery.Process", self._discovery)
         self.rpc.register("admin.JoinChannel", self._admin_join)
         self.rpc.register("admin.Channels", self._admin_channels)

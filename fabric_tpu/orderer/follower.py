@@ -1,0 +1,132 @@
+"""Follower and inactive chains (reference orderer/consensus/follower +
+orderer/consensus/inactive).
+
+A node listed in a channel's config but NOT in its consenter set runs a
+`FollowerChain`: it pulls blocks from the cluster (the onboarding
+BlockPuller) and appends them to the local ledger until a config block
+adds the node to the consenter set — then it halts so the registrar can
+start the real consenter chain (reference follower_chain.go:15-31, a
+skeleton in the snapshot; the pull loop matches
+orderer/common/cluster/replication.go semantics).
+
+`InactiveChain` is the placeholder registered for channels this node
+tracks but does not serve: every `order`/`configure` fails with
+NotServiced until activation (reference inactive/inactive_chain.go).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from fabric_tpu.protos.common import common_pb2
+
+
+class NotServicedError(Exception):
+    """Raised for submissions to a channel this node does not service."""
+
+
+class InactiveChain:
+    """Reference inactive.Chain: errors until the chain is activated."""
+
+    def __init__(self, channel_id: str):
+        self.channel_id = channel_id
+
+    def start(self) -> None:
+        pass
+
+    def halt(self) -> None:
+        pass
+
+    def wait_ready(self) -> None:
+        raise NotServicedError(f"channel {self.channel_id!r} is not serviced")
+
+    def order(self, env: common_pb2.Envelope, config_seq: int = 0) -> None:
+        raise NotServicedError(f"channel {self.channel_id!r} is not serviced")
+
+    def configure(self, env: common_pb2.Envelope, config_seq: int = 0) -> None:
+        raise NotServicedError(f"channel {self.channel_id!r} is not serviced")
+
+    def errored(self):
+        return NotServicedError(self.channel_id)
+
+
+class FollowerChain:
+    """Pull blocks while outside the consenter set; signal when joined.
+
+    puller: callable(height:int) -> Block | None — fetch the block at
+        `height` from some cluster member (cluster onboarding transport).
+    writer: callable(Block) -> None — append to the local ledger.
+    in_consenter_set: callable(Block) -> bool — config-block predicate;
+        when True the follower stops and `joined` is set so the
+        registrar can switch to a consenter chain.
+    """
+
+    def __init__(self, channel_id: str, height, puller, writer,
+                 in_consenter_set, poll_interval_s: float = 0.2):
+        self.channel_id = channel_id
+        self._height = height
+        self._puller = puller
+        self._writer = writer
+        self._in_set = in_consenter_set
+        self._poll = poll_interval_s
+        self._stop = threading.Event()
+        self.joined = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # consensus SPI: a follower accepts no submissions
+    def wait_ready(self) -> None:
+        raise NotServicedError(
+            f"channel {self.channel_id!r}: this node is a follower"
+        )
+
+    order = InactiveChain.order
+    configure = InactiveChain.configure
+
+    def errored(self):
+        return None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run, name=f"follower-{self.channel_id}", daemon=True
+        )
+        self._thread.start()
+
+    def halt(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+    @property
+    def height(self) -> int:
+        return self._height
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            blk = None
+            try:
+                blk = self._puller(self._height)
+            except Exception:
+                blk = None  # transient pull failure: retry after poll
+            if blk is None:
+                self._stop.wait(self._poll)
+                continue
+            self._writer(blk)
+            self._height += 1
+            if self._is_config(blk) and self._in_set(blk):
+                self.joined.set()
+                return
+
+    @staticmethod
+    def _is_config(blk: common_pb2.Block) -> bool:
+        try:
+            env = common_pb2.Envelope.FromString(blk.data.data[0])
+            payload = common_pb2.Payload.FromString(env.payload)
+            chdr = common_pb2.ChannelHeader.FromString(
+                payload.header.channel_header
+            )
+            return chdr.type == common_pb2.CONFIG
+        except Exception:
+            return False
+
+
+__all__ = ["FollowerChain", "InactiveChain", "NotServicedError"]

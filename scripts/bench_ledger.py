@@ -1,0 +1,144 @@
+"""Ledger throughput harness (reference core/ledger/kvledger/benchmark:
+BenchmarkInsertTxs / BenchmarkReadWriteTxs, driven by
+scripts/runbenchmarks.sh).
+
+Short-circuits chaincode exactly like the reference harness: drives the
+TxSimulator + block commit directly — no endorsement, no crypto — to
+measure the storage stack (MVCC validate + block store + state DB +
+history DB) in isolation.
+
+    python scripts/bench_ledger.py [--txs 10000] [--batch 100] \
+        [--keys 4] [--value-size 64] [--disk]
+
+Prints one JSON line per experiment.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _mk_ledger(disk: bool):
+    sys.path.insert(
+        0,
+        os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tests"),
+    )
+    from orgfix import make_org
+
+    from fabric_tpu.common import configtx_builder as ctx
+    from fabric_tpu.ledger import LedgerProvider
+    from fabric_tpu.msp import msp_config_from_ca
+
+    org = make_org("Org1MSP")
+    oorg = make_org("OrdererMSP")
+    app = ctx.application_group(
+        {"Org1": ctx.org_group("Org1MSP", msp_config_from_ca(org.ca, "Org1MSP"))}
+    )
+    ordg = ctx.orderer_group(
+        {"O": ctx.org_group("OrdererMSP", msp_config_from_ca(oorg.ca, "OrdererMSP"))},
+        consensus_type="solo",
+    )
+    genesis = ctx.genesis_block("benchledger", ctx.channel_group(app, ordg))
+    root = tempfile.mkdtemp(prefix="bench-ledger-") if disk else None
+    return LedgerProvider(root).create(genesis)
+
+
+def _env_for(txid: str, rwset: bytes, channel: str) -> bytes:
+    """Minimal unsigned endorser-tx envelope carrying one rwset (the
+    reference harness also skips endorsement/signatures)."""
+    from fabric_tpu import protoutil
+    from fabric_tpu.protos.common import common_pb2
+    from fabric_tpu.protos.peer import (
+        proposal_pb2,
+        proposal_response_pb2,
+        transaction_pb2,
+    )
+
+    action = proposal_pb2.ChaincodeAction(results=rwset)
+    prp = proposal_response_pb2.ProposalResponsePayload(
+        extension=action.SerializeToString()
+    )
+    cap = transaction_pb2.ChaincodeActionPayload()
+    cap.action.proposal_response_payload = prp.SerializeToString()
+    tx = transaction_pb2.Transaction()
+    tx.actions.add(payload=cap.SerializeToString())
+    chdr = protoutil.make_channel_header(
+        common_pb2.ENDORSER_TRANSACTION, channel, tx_id=txid
+    )
+    shdr = protoutil.make_signature_header(b"bench-creator", txid.encode())
+    return common_pb2.Envelope(
+        payload=protoutil.make_payload_bytes(chdr, shdr, tx.SerializeToString())
+    ).SerializeToString()
+
+
+def _block_of(ledger, num, writes, n_keys, vsize, read=False):
+    """Simulate `len(writes)` txs -> one block, reference-harness style
+    (pre-validated write sets, no signatures)."""
+    from fabric_tpu import protoutil
+    from fabric_tpu.protos.common import common_pb2
+
+    blk = common_pb2.Block()
+    blk.header.number = num
+    for txid, keybase in writes:
+        sim = ledger.new_tx_simulator()
+        for k in range(n_keys):
+            key = f"{keybase}-{k}"
+            if read:
+                sim.get_state("benchcc", key)
+            sim.set_state("benchcc", key, os.urandom(vsize))
+        blk.data.data.append(
+            _env_for(txid, sim.get_tx_simulation_results(), "benchledger")
+        )
+    protoutil.init_block_metadata(blk)
+    protoutil.set_tx_filter(blk, bytearray(len(writes)))
+    return blk
+
+
+def run_experiment(name, ledger, n_txs, batch, n_keys, vsize, read):
+    t0 = time.perf_counter()
+    height = ledger.height
+    for off in range(0, n_txs, batch):
+        writes = [
+            (f"{name}-tx{off + i}", f"{name}-key{(off + i) % (n_txs // 2 or 1)}")
+            for i in range(min(batch, n_txs - off))
+        ]
+        blk = _block_of(ledger, height, writes, n_keys, vsize, read)
+        ledger.commit(blk)
+        height += 1
+    dt = time.perf_counter() - t0
+    print(json.dumps({
+        "experiment": name,
+        "txs": n_txs,
+        "batch": batch,
+        "keys_per_tx": n_keys,
+        "value_size": vsize,
+        "seconds": round(dt, 3),
+        "tx_per_s": round(n_txs / dt, 1),
+    }))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--txs", type=int, default=10000)
+    ap.add_argument("--batch", type=int, default=100)
+    ap.add_argument("--keys", type=int, default=4)
+    ap.add_argument("--value-size", type=int, default=64)
+    ap.add_argument("--disk", action="store_true",
+                    help="sqlite-backed stores instead of in-memory")
+    args = ap.parse_args()
+    ledger = _mk_ledger(args.disk)
+    run_experiment("insert", ledger, args.txs, args.batch, args.keys,
+                   args.value_size, read=False)
+    run_experiment("readwrite", ledger, args.txs, args.batch, args.keys,
+                   args.value_size, read=True)
+
+
+if __name__ == "__main__":
+    main()

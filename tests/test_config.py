@@ -1,0 +1,66 @@
+"""Config loading: YAML + env overrides + decode hooks (the reference's
+viperutil/config_util.go behaviors)."""
+
+import os
+
+from fabric_tpu.common.config import (
+    Config,
+    parse_bytesize,
+    parse_duration,
+    resolve_file_ref,
+)
+
+
+def test_yaml_env_precedence(tmp_path, monkeypatch):
+    (tmp_path / "core.yaml").write_text(
+        "peer:\n  listenAddress: 1.2.3.4:7051\n  validatorPoolSize: 8\n"
+    )
+    monkeypatch.setenv("FABRIC_CFG_PATH", str(tmp_path))
+    cfg = Config.load("core", "CORE")
+    assert cfg.get("peer.listenAddress") == "1.2.3.4:7051"
+    assert cfg.get_int("peer.validatorPoolSize") == 8
+    # env override wins (viper CORE_PEER_LISTENADDRESS)
+    monkeypatch.setenv("CORE_PEER_LISTENADDRESS", "9.9.9.9:1")
+    cfg = Config.load("core", "CORE")
+    assert cfg.get("peer.listenAddress") == "9.9.9.9:1"
+    # case-insensitive dotted lookup
+    assert cfg.get("PEER.VALIDATORPOOLSIZE") == 8
+    # missing -> default
+    assert cfg.get("peer.nope", 42) == 42
+
+
+def test_decode_hooks(tmp_path):
+    assert parse_bytesize("100 MB") == 100 << 20
+    assert parse_bytesize("16k") == 16384
+    assert parse_bytesize(512) == 512
+    assert parse_duration("250ms") == 0.25
+    assert parse_duration("2m") == 120.0
+    assert parse_duration(1.5) == 1.5
+    pem = tmp_path / "cert.pem"
+    pem.write_bytes(b"PEMDATA")
+    assert resolve_file_ref(f"file:{pem}") == b"PEMDATA"
+    assert resolve_file_ref("plain-value") == "plain-value"
+
+
+def test_typed_getters(tmp_path, monkeypatch):
+    (tmp_path / "orderer.yaml").write_text(
+        "general:\n  tickInterval: 500ms\nconsensus:\n"
+        "  snapshotIntervalSize: 16 MB\ndebug:\n  enabled: 'yes'\n"
+    )
+    monkeypatch.setenv("FABRIC_CFG_PATH", str(tmp_path))
+    cfg = Config.load("orderer", "ORDERER")
+    assert cfg.get_duration("general.tickInterval") == 0.5
+    assert cfg.get_bytesize("consensus.snapshotIntervalSize") == 16 << 20
+    assert cfg.get_bool("debug.enabled") is True
+
+
+def test_sampleconfig_parses():
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    core = Config.load("core", "CORE",
+                       os.path.join(root, "sampleconfig", "core.yaml"))
+    assert core.get("bccsp.default") == "TPU"
+    assert core.get_int("peer.limits.concurrency.endorserService") == 2500
+    orderer = Config.load("orderer", "ORDERER",
+                          os.path.join(root, "sampleconfig", "orderer.yaml"))
+    assert orderer.get_int("general.listenPort") == 7050
+    assert orderer.get_duration("consensus.tickInterval") == 0.5

@@ -1,0 +1,269 @@
+"""Follower/inactive chains, bookkeeping provider, external builders,
+and RPC concurrency limiters (SURVEY.md §2 inventory gap batch)."""
+
+from __future__ import annotations
+
+import os
+import stat
+import threading
+import time
+
+import pytest
+
+from fabric_tpu import protoutil
+from fabric_tpu.chaincode.externalbuilder import (
+    BuildError,
+    BuilderRegistry,
+    ExternalBuilder,
+)
+from fabric_tpu.common.semaphore import Semaphore
+from fabric_tpu.comm import RPCClient, RPCServer
+from fabric_tpu.ledger.bookkeeping import (
+    PVT_DATA_EXPIRY,
+    BookkeepingProvider,
+)
+from fabric_tpu.ledger.kvstore import MemKVStore
+from fabric_tpu.orderer.follower import (
+    FollowerChain,
+    InactiveChain,
+    NotServicedError,
+)
+from fabric_tpu.protos.common import common_pb2
+
+
+# -- follower / inactive ---------------------------------------------------
+
+
+def _config_block(num: int, channel: str = "fch") -> common_pb2.Block:
+    chdr = protoutil.make_channel_header(common_pb2.CONFIG, channel)
+    shdr = protoutil.make_signature_header(b"orderer", b"n%d" % num)
+    env = common_pb2.Envelope(
+        payload=protoutil.make_payload_bytes(chdr, shdr, b"cfg")
+    )
+    blk = common_pb2.Block()
+    blk.header.number = num
+    blk.data.data.append(env.SerializeToString())
+    return blk
+
+
+def _normal_block(num: int) -> common_pb2.Block:
+    chdr = protoutil.make_channel_header(
+        common_pb2.ENDORSER_TRANSACTION, "fch", tx_id=f"t{num}"
+    )
+    shdr = protoutil.make_signature_header(b"c", b"n%d" % num)
+    env = common_pb2.Envelope(
+        payload=protoutil.make_payload_bytes(chdr, shdr, b"tx")
+    )
+    blk = common_pb2.Block()
+    blk.header.number = num
+    blk.data.data.append(env.SerializeToString())
+    return blk
+
+
+def test_inactive_chain_not_serviced():
+    ch = InactiveChain("quiet")
+    with pytest.raises(NotServicedError):
+        ch.order(common_pb2.Envelope())
+    with pytest.raises(NotServicedError):
+        ch.configure(common_pb2.Envelope())
+    with pytest.raises(NotServicedError):
+        ch.wait_ready()
+    assert isinstance(ch.errored(), NotServicedError)
+
+
+def test_follower_pulls_until_joined():
+    # remote chain: 2 normal blocks, then a config block that adds us
+    chain = [_normal_block(0), _normal_block(1), _config_block(2)]
+    local: list[common_pb2.Block] = []
+
+    def puller(height):
+        return chain[height] if height < len(chain) else None
+
+    f = FollowerChain(
+        "fch", height=0, puller=puller, writer=local.append,
+        in_consenter_set=lambda blk: blk.header.number == 2,
+        poll_interval_s=0.01,
+    )
+    with pytest.raises(NotServicedError):
+        f.order(common_pb2.Envelope())
+    f.start()
+    assert f.joined.wait(timeout=5.0), "follower never joined"
+    f.halt()
+    assert [b.header.number for b in local] == [0, 1, 2]
+    assert f.height == 3
+
+
+def test_follower_halt_while_waiting():
+    f = FollowerChain(
+        "fch", height=0, puller=lambda h: None, writer=lambda b: None,
+        in_consenter_set=lambda b: False, poll_interval_s=0.01,
+    )
+    f.start()
+    time.sleep(0.05)
+    f.halt()
+    assert not f.joined.is_set()
+
+
+# -- bookkeeping -----------------------------------------------------------
+
+
+def test_bookkeeping_namespaces_disjoint():
+    prov = BookkeepingProvider(MemKVStore())
+    a = prov.get_kv("ch1", PVT_DATA_EXPIRY)
+    b = prov.get_kv("ch2", PVT_DATA_EXPIRY)
+    c = prov.get_kv("ch1", "other")
+    a.put(b"k", b"va")
+    b.put(b"k", b"vb")
+    c.put(b"k", b"vc")
+    assert a.get(b"k") == b"va"
+    assert b.get(b"k") == b"vb"
+    assert c.get(b"k") == b"vc"
+    assert [k for k, _ in a.iterate()] == [b"k"]
+
+
+# -- external builders -----------------------------------------------------
+
+
+def _make_builder(tmp_path, name: str, detect_ok: bool) -> ExternalBuilder:
+    d = tmp_path / name / "bin"
+    os.makedirs(d)
+
+    def script(tool: str, body: str):
+        p = d / tool
+        p.write_text("#!/bin/sh\n" + body)
+        p.chmod(p.stat().st_mode | stat.S_IXUSR)
+
+    script("detect", "exit 0" if detect_ok else "exit 1")
+    script("build", 'cp -r "$1"/. "$3"/ && echo built > "$3"/marker\nexit 0')
+    script("release", "exit 0")
+    script("run", 'cat "$2"/chaincode.json > "$1"/launched\nexit 0')
+    return ExternalBuilder(str(tmp_path / name))
+
+
+def _package() -> bytes:
+    import io
+    import json as _json
+    import tarfile
+
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w:gz") as tf:
+        meta = _json.dumps({"label": "extcc_1.0", "type": "external"}).encode()
+        ti = tarfile.TarInfo("metadata.json")
+        ti.size = len(meta)
+        tf.addfile(ti, io.BytesIO(meta))
+        code = b"#!/bin/sh\necho hi\n"
+        ti2 = tarfile.TarInfo("main.sh")
+        ti2.size = len(code)
+        tf.addfile(ti2, io.BytesIO(code))
+    return buf.getvalue()
+
+
+def test_builder_detection_order_and_run(tmp_path):
+    nope = _make_builder(tmp_path, "nope", detect_ok=False)
+    yes = _make_builder(tmp_path, "yes", detect_ok=True)
+    reg = BuilderRegistry([nope, yes], str(tmp_path / "bld"))
+    builder, out = reg.build("extcc:aa11", _package())
+    assert builder is yes
+    assert os.path.exists(os.path.join(out, "marker"))
+    assert os.path.exists(os.path.join(out, "main.sh"))
+    # cached: same object back
+    assert reg.build("extcc:aa11", _package())[1] == out
+    proc = reg.run("extcc:aa11", _package(), "extcc:aa11", "127.0.0.1:7052")
+    proc.wait(timeout=10)
+    with open(os.path.join(out, "launched")) as f:
+        meta = f.read()
+    assert "extcc:aa11" in meta and "127.0.0.1:7052" in meta
+
+
+def test_builder_none_detects(tmp_path):
+    nope = _make_builder(tmp_path, "nope", detect_ok=False)
+    reg = BuilderRegistry([nope], str(tmp_path / "bld"))
+    with pytest.raises(BuildError):
+        reg.build("extcc:bb22", _package())
+
+
+# -- RPC concurrency limiter ----------------------------------------------
+
+
+def test_rpc_limiter_rejects_excess():
+    srv = RPCServer()
+    gate = threading.Event()
+    entered = threading.Event()
+
+    def slow(body, stream):
+        entered.set()
+        gate.wait(timeout=10)
+        return b"done"
+
+    srv.register("svc.Slow", slow, limiter=Semaphore(1))
+    srv.start()
+    host, port = srv.addr
+    try:
+        results = {}
+
+        def first():
+            results["first"] = RPCClient(host, port, timeout=15).call(
+                "svc.Slow", b""
+            )
+
+        t = threading.Thread(target=first)
+        t.start()
+        assert entered.wait(timeout=5)
+        # second concurrent call fails fast (resource exhausted)
+        with pytest.raises(Exception, match="too many requests"):
+            RPCClient(host, port, timeout=5).call("svc.Slow", b"")
+        gate.set()
+        t.join(timeout=10)
+        assert results["first"] == b"done"
+        # permit released: next call succeeds
+        assert RPCClient(host, port, timeout=5).call("svc.Slow", b"") == b"done"
+    finally:
+        gate.set()
+        srv.stop()
+
+
+def test_rpc_limiter_spans_streams():
+    """A streaming handler's permit must be held until the stream is
+    fully consumed (deliver caps concurrent STREAMS, not dispatches)."""
+    srv = RPCServer()
+    gate = threading.Event()
+    sem = Semaphore(1)
+
+    def streamer(body, stream):
+        def gen():
+            yield b"one"
+            gate.wait(timeout=10)
+            yield b"two"
+        return gen()
+
+    srv.register("svc.Stream", streamer, limiter=sem)
+    srv.start()
+    host, port = srv.addr
+    try:
+        out = []
+
+        def consume():
+            for frame in RPCClient(host, port, timeout=15).stream(
+                "svc.Stream", b""
+            ):
+                out.append(frame)
+
+        t = threading.Thread(target=consume)
+        t.start()
+        for _ in range(100):
+            if out:
+                break
+            time.sleep(0.01)
+        assert out == [b"one"]
+        # stream still open -> permit still held -> second call rejected
+        with pytest.raises(Exception, match="too many requests"):
+            RPCClient(host, port, timeout=5).call("svc.Stream", b"")
+        gate.set()
+        t.join(timeout=10)
+        assert out == [b"one", b"two"]
+        # permit released after exhaustion
+        assert sem.try_acquire()
+        sem.release()
+    finally:
+        gate.set()
+        srv.stop()

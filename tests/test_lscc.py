@@ -1,0 +1,125 @@
+"""lscc (legacy lifecycle SCC) tests — install/deploy/upgrade/query
+surface parity with reference core/scc/lscc/lscc.go."""
+
+import hashlib
+
+import pytest
+
+from fabric_tpu.chaincode import ChaincodeSupport, InProcStream
+from fabric_tpu.chaincode.lifecycle import PackageStore
+from fabric_tpu.chaincode.lscc import LSCC, LegacyDefinitionProvider, NAMESPACE
+from fabric_tpu.ledger.kvstore import MemKVStore
+from fabric_tpu.ledger.statedb import Height, VersionedDB, VersionedValue
+from fabric_tpu.ledger.txmgmt import TxSimulator
+from fabric_tpu.protos.peer import chaincode_pb2, query_pb2
+from fabric_tpu.protos.ledger.rwset import rwset_pb2
+from fabric_tpu.protos.ledger.rwset.kvrwset import kv_rwset_pb2
+
+
+def make_cds(name: str, version: str) -> bytes:
+    return chaincode_pb2.ChaincodeDeploymentSpec(
+        chaincode_spec=chaincode_pb2.ChaincodeSpec(
+            chaincode_id=chaincode_pb2.ChaincodeID(name=name, version=version),
+        ),
+        code_package=b"legacy-code",
+    ).SerializeToString()
+
+
+@pytest.fixture
+def world(tmp_path):
+    support = ChaincodeSupport(invoke_timeout_s=5.0)
+    store = PackageStore(str(tmp_path / "packages"))
+    scc = LSCC(store)
+    stream = InProcStream(support, scc, NAMESPACE)
+    stream.start()
+    stream.wait_registered(support, NAMESPACE)
+    db = VersionedDB(MemKVStore())
+    return support, db
+
+
+def call(support, db, args, txid="tx"):
+    sim = TxSimulator(db)
+    resp, _ = support.execute(
+        NAMESPACE, "ch", f"{txid}-{args[0].decode()}", sim, args
+    )
+    txrw = rwset_pb2.TxReadWriteSet.FromString(sim.get_tx_simulation_results())
+    batch = {}
+    for ns in txrw.ns_rwset:
+        kv = kv_rwset_pb2.KVRWSet.FromString(ns.rwset)
+        for w in kv.writes:
+            batch.setdefault(ns.namespace, {})[w.key] = (
+                None if w.is_delete else VersionedValue(w.value, Height(1, 1), b"")
+            )
+    if batch:
+        db.apply_updates(batch, Height(1, 1))
+    return resp
+
+
+def test_install_deploy_query(world):
+    support, db = world
+    cds = make_cds("legcc", "1.0")
+    assert call(support, db, [b"install", cds]).status == 200
+
+    resp = call(support, db, [b"getinstalledchaincodes"])
+    installed = query_pb2.ChaincodeQueryResponse.FromString(resp.payload)
+    assert [(c.name, c.version) for c in installed.chaincodes] == [("legcc", "1.0")]
+
+    resp = call(support, db, [b"deploy", b"ch", cds, b"policy-bytes"])
+    assert resp.status == 200
+    data = query_pb2.ChaincodeData.FromString(resp.payload)
+    assert (data.name, data.version, data.escc, data.vscc) == (
+        "legcc", "1.0", "escc", "vscc"
+    )
+    assert data.id == hashlib.sha256(cds).digest()
+
+    # duplicate deploy refused; upgrade of a missing chaincode refused
+    assert call(support, db, [b"deploy", b"ch", cds, b""]).status != 200
+    other = make_cds("nope", "1.0")
+    assert call(support, db, [b"upgrade", b"ch", other, b""]).status != 200
+
+    # upgrade bumps version
+    cds2 = make_cds("legcc", "2.0")
+    resp = call(support, db, [b"upgrade", b"ch", cds2, b"p2"])
+    assert resp.status == 200
+
+    resp = call(support, db, [b"getccdata", b"ch", b"legcc"])
+    data = query_pb2.ChaincodeData.FromString(resp.payload)
+    assert data.version == "2.0" and data.policy == b"p2"
+
+    resp = call(support, db, [b"getid", b"ch", b"legcc"])
+    assert resp.payload == hashlib.sha256(cds2).digest()
+
+    resp = call(support, db, [b"getchaincodes"])
+    allcc = query_pb2.ChaincodeQueryResponse.FromString(resp.payload)
+    assert [(c.name, c.version) for c in allcc.chaincodes] == [("legcc", "2.0")]
+
+    # getdepspec needs the (installed) package for the committed version
+    assert call(support, db, [b"install", cds2]).status == 200
+    resp = call(support, db, [b"getdepspec", b"ch", b"legcc"])
+    assert resp.status == 200 and resp.payload == cds2
+
+
+def test_name_version_rules(world):
+    support, db = world
+    bad = make_cds("9bad", "1.0")
+    assert call(support, db, [b"install", bad]).status != 200
+    bad2 = make_cds("okname", "sp ace")
+    assert call(support, db, [b"deploy", b"ch", bad2, b""]).status != 200
+
+
+def test_legacy_definition_provider(world):
+    support, db = world
+    cds = make_cds("provcc", "1.0")
+    call(support, db, [b"deploy", b"ch", cds, b"the-policy"])
+
+    class _Ledger:
+        def new_query_executor(self):
+            class _QE:
+                def get_state(self, ns, key):
+                    vv = db.get_state(ns, key)
+                    return vv.value if vv else None
+            return _QE()
+
+    prov = LegacyDefinitionProvider(_Ledger())
+    assert prov.validation_info("provcc") == ("vscc", b"the-policy")
+    assert prov.validation_info("missing") is None
