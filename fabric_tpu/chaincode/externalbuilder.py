@@ -26,7 +26,6 @@ import os
 import shutil
 import subprocess
 import tarfile
-import tempfile
 
 
 class BuildError(Exception):
@@ -100,31 +99,33 @@ class BuilderRegistry:
 
     @staticmethod
     def _explode(package_bytes: bytes, dest: str) -> tuple[str, str]:
-        """Unpack a .tar.gz chaincode package into src + metadata dirs."""
+        """Unpack a .tar.gz chaincode package into src + metadata dirs.
+        Members under a leading "src/" (the platforms.package_chaincode
+        layout) are flattened into the src dir; flat members land there
+        directly."""
+        import io
+
         src = os.path.join(dest, "src")
         meta = os.path.join(dest, "metadata")
         os.makedirs(src, exist_ok=True)
         os.makedirs(meta, exist_ok=True)
-        with tempfile.NamedTemporaryFile(suffix=".tgz", delete=False) as f:
-            f.write(package_bytes)
-            tmp = f.name
-        try:
-            with tarfile.open(tmp, "r:gz") as tf:
-                for m in tf.getmembers():
-                    if not m.isfile():
-                        continue
-                    name = os.path.normpath(m.name)
-                    if name.startswith(("..", "/")):
-                        raise BuildError(f"unsafe path in package: {m.name}")
-                    if name == "metadata.json":
-                        out = os.path.join(meta, "metadata.json")
-                    else:
-                        out = os.path.join(src, name)
-                    os.makedirs(os.path.dirname(out), exist_ok=True)
-                    with tf.extractfile(m) as fsrc, open(out, "wb") as fdst:
-                        shutil.copyfileobj(fsrc, fdst)
-        finally:
-            os.unlink(tmp)
+        with tarfile.open(fileobj=io.BytesIO(package_bytes), mode="r:gz") as tf:
+            for m in tf.getmembers():
+                if not m.isfile():
+                    continue
+                name = os.path.normpath(m.name)
+                if name.startswith(("..", "/")):
+                    raise BuildError(f"unsafe path in package: {m.name}")
+                if name == "metadata.json":
+                    out = os.path.join(meta, "metadata.json")
+                else:
+                    rel = name.split(os.sep, 1)[1] if (
+                        name.startswith("src" + os.sep)
+                    ) else name
+                    out = os.path.join(src, rel)
+                os.makedirs(os.path.dirname(out), exist_ok=True)
+                with tf.extractfile(m) as fsrc, open(out, "wb") as fdst:
+                    shutil.copyfileobj(fsrc, fdst)
         return src, meta
 
     def build(self, package_id: str, package_bytes: bytes) -> tuple[ExternalBuilder, str]:

@@ -162,6 +162,8 @@ class LifecycleSCC(Chaincode):
     def _query_installed(self, stub, raw):
         res = lc.QueryInstalledChaincodesResult()
         for pid, label in self._store.list():
+            if label.startswith("cds:"):
+                continue  # legacy lscc package (CDS bytes, not .tar.gz)
             ic = res.installed_chaincodes.add()
             ic.package_id = pid
             ic.label = label

@@ -7,6 +7,7 @@
 from __future__ import annotations
 
 import argparse
+import os
 import signal
 import sys
 import threading
@@ -18,16 +19,30 @@ from fabric_tpu.protos.common import common_pb2
 
 
 def main(argv=None) -> int:
+    from fabric_tpu.common.config import Config
+
+    # orderer.yaml (FABRIC_CFG_PATH) + ORDERER_* env supply defaults the
+    # flags can override (viper precedence)
+    cfg = Config.load("orderer", "ORDERER")
+    cfg_listen = "%s:%s" % (
+        cfg.get("general.listenAddress", "127.0.0.1"),
+        cfg.get_int("general.listenPort", 0),
+    )
     ap = argparse.ArgumentParser(prog="orderer")
-    ap.add_argument("--listen", default="127.0.0.1:0")
-    ap.add_argument("--root", default=None)
+    ap.add_argument("--listen", default=cfg_listen)
+    ap.add_argument("--root", default=cfg.get("fileLedger.location"))
     ap.add_argument("--genesis", action="append", default=[])
-    ap.add_argument("--mspid")
+    ap.add_argument("--mspid", default=cfg.get("general.localMspId"))
     ap.add_argument("--msp-dir")
     args = ap.parse_args(argv)
 
     blocks = []
-    for path in args.genesis:
+    genesis_paths = list(args.genesis)
+    if not genesis_paths and cfg.get("general.bootstrapMethod") == "file":
+        bf = cfg.get("general.bootstrapFile")
+        if bf and os.path.exists(bf):
+            genesis_paths.append(bf)
+    for path in genesis_paths:
         with open(path, "rb") as f:
             blocks.append(common_pb2.Block.FromString(f.read()))
     signer = (

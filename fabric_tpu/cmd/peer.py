@@ -33,11 +33,15 @@ def _signer(args):
 
 
 def cmd_node_start(args) -> int:
+    from fabric_tpu.common.config import Config
     from fabric_tpu.common.diag import install_signal_handler
     from fabric_tpu.csp import SWCSP
     from fabric_tpu.node.peer_node import PeerNode
 
     install_signal_handler()  # SIGUSR1 -> thread dump (common/diag)
+    # core.yaml (FABRIC_CFG_PATH) + CORE_* env supply defaults the flags
+    # can override (viper precedence)
+    cfg = Config.load("core", "CORE")
     host, port = parse_endpoint(args.listen)
     node = PeerNode(
         args.root,
@@ -48,6 +52,12 @@ def cmd_node_start(args) -> int:
         chaincode_specs=args.chaincode,
         orderer_endpoints=[parse_endpoint(o) for o in args.orderer],
         operations_port=args.operations_port,
+        endorser_concurrency=cfg.get_int(
+            "peer.limits.concurrency.endorserService", 2500
+        ),
+        deliver_concurrency=cfg.get_int(
+            "peer.limits.concurrency.deliverService", 2500
+        ),
     )
     node.start()
     print(f"peer listening on {node.addr[0]}:{node.addr[1]}", flush=True)
@@ -174,6 +184,132 @@ def cmd_chaincode_query(args) -> int:
     return 0
 
 
+def _lifecycle_call(args, fn_name: str, payload: bytes, channel: str = ""):
+    """Endorse a _lifecycle invocation on the given peers; raises on a
+    non-2xx endorsement (same guard as chaincode invoke/query)."""
+    peers = [parse_endpoint(p) for p in args.peer]
+    prop, resps = endorse(
+        peers, _signer(args), channel or getattr(args, "channel", ""),
+        "_lifecycle", [fn_name.encode(), payload],
+    )
+    for r in resps:
+        if not (200 <= r.response.status < 400):
+            raise SystemExit(
+                f"{fn_name} failed ({r.response.status}): {r.response.message}"
+            )
+    return prop, resps
+
+
+def cmd_lifecycle_package(args) -> int:
+    from fabric_tpu.chaincode.platforms import package_chaincode
+
+    pkg = package_chaincode(args.path, args.label, args.lang)
+    with open(args.output, "wb") as f:
+        f.write(pkg)
+    print(f"wrote {args.output} ({len(pkg)} bytes, label {args.label})")
+    return 0
+
+
+def cmd_lifecycle_install(args) -> int:
+    from fabric_tpu.protos.peer import lifecycle_pb2 as lcpb
+
+    with open(args.package, "rb") as f:
+        pkg = f.read()
+    req = lcpb.InstallChaincodeArgs(chaincode_install_package=pkg)
+    _, resps = _lifecycle_call(args, "InstallChaincode", req.SerializeToString())
+    res = lcpb.InstallChaincodeResult.FromString(resps[0].response.payload)
+    print(f"installed {res.package_id} (label {res.label})")
+    return 0
+
+
+def cmd_lifecycle_queryinstalled(args) -> int:
+    from fabric_tpu.protos.peer import lifecycle_pb2 as lcpb
+
+    _, resps = _lifecycle_call(args, "QueryInstalledChaincodes", b"")
+    res = lcpb.QueryInstalledChaincodesResult.FromString(
+        resps[0].response.payload
+    )
+    for ic in res.installed_chaincodes:
+        print(f"{ic.package_id}\t{ic.label}")
+    return 0
+
+
+def _definition_from(args):
+    from fabric_tpu.protos.peer import lifecycle_pb2 as lcpb
+
+    return lcpb.ChaincodeDefinition(
+        sequence=args.sequence, name=args.name, version=args.version,
+    )
+
+
+def cmd_lifecycle_approve(args) -> int:
+    from fabric_tpu.protos.peer import lifecycle_pb2 as lcpb
+
+    req = lcpb.ApproveChaincodeDefinitionForMyOrgArgs(
+        definition=_definition_from(args)
+    )
+    if args.package_id:
+        req.source.local_package.package_id = args.package_id
+    prop, resps = _lifecycle_call(
+        args, "ApproveChaincodeDefinitionForMyOrg", req.SerializeToString()
+    )
+    status = submit(parse_endpoint(args.orderer), _signer(args), prop, resps)
+    print(f"approval submitted: {status}")
+    return 0 if status == 200 else 1
+
+
+def cmd_lifecycle_checkreadiness(args) -> int:
+    from fabric_tpu.protos.peer import lifecycle_pb2 as lcpb
+
+    req = lcpb.CheckCommitReadinessArgs(definition=_definition_from(args))
+    _, resps = _lifecycle_call(
+        args, "CheckCommitReadiness", req.SerializeToString()
+    )
+    res = lcpb.CheckCommitReadinessResult.FromString(resps[0].response.payload)
+    for org, approved in sorted(res.approvals.items()):
+        print(f"{org}: {approved}")
+    return 0
+
+
+def cmd_lifecycle_commit(args) -> int:
+    from fabric_tpu.protos.peer import lifecycle_pb2 as lcpb
+
+    req = lcpb.CommitChaincodeDefinitionArgs(definition=_definition_from(args))
+    prop, resps = _lifecycle_call(
+        args, "CommitChaincodeDefinition", req.SerializeToString()
+    )
+    status = submit(parse_endpoint(args.orderer), _signer(args), prop, resps)
+    print(f"commit submitted: {status}")
+    return 0 if status == 200 else 1
+
+
+def cmd_lifecycle_querycommitted(args) -> int:
+    from fabric_tpu.protos.peer import lifecycle_pb2 as lcpb
+
+    if args.name:
+        req = lcpb.QueryChaincodeDefinitionArgs(name=args.name)
+        _, resps = _lifecycle_call(
+            args, "QueryChaincodeDefinition", req.SerializeToString()
+        )
+        res = lcpb.QueryChaincodeDefinitionResult.FromString(
+            resps[0].response.payload
+        )
+        d = res.definition
+        print(f"{d.name} v{d.version} seq {d.sequence}")
+    else:
+        req = lcpb.QueryChaincodeDefinitionsArgs()
+        _, resps = _lifecycle_call(
+            args, "QueryChaincodeDefinitions", req.SerializeToString()
+        )
+        res = lcpb.QueryChaincodeDefinitionsResult.FromString(
+            resps[0].response.payload
+        )
+        for info in res.chaincode_definitions:
+            d = info.definition
+            print(f"{info.name} v{d.version} seq {d.sequence}")
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="peer")
     sub = ap.add_subparsers(dest="cmd", required=True)
@@ -239,6 +375,42 @@ def main(argv=None) -> int:
             p.add_argument("--orderer", required=True)
         p.add_argument("--mspid", required=True)
         p.add_argument("--msp-dir", required=True)
+        p.set_defaults(fn=fn)
+
+    lc = sub.add_parser("lifecycle").add_subparsers(dest="sub", required=True)
+    lcc = lc.add_parser("chaincode").add_subparsers(dest="op", required=True)
+    pkg = lcc.add_parser("package")
+    pkg.add_argument("output")
+    pkg.add_argument("--path", required=True)
+    pkg.add_argument("--label", required=True)
+    pkg.add_argument("--lang", default="python")
+    pkg.set_defaults(fn=cmd_lifecycle_package)
+    for name, fn in (
+        ("install", cmd_lifecycle_install),
+        ("queryinstalled", cmd_lifecycle_queryinstalled),
+        ("approveformyorg", cmd_lifecycle_approve),
+        ("checkcommitreadiness", cmd_lifecycle_checkreadiness),
+        ("commit", cmd_lifecycle_commit),
+        ("querycommitted", cmd_lifecycle_querycommitted),
+    ):
+        p = lcc.add_parser(name)
+        p.add_argument("--peer", action="append", required=True)
+        p.add_argument("--mspid", required=True)
+        p.add_argument("--msp-dir", required=True)
+        if name == "install":
+            p.add_argument("package")
+        if name in ("approveformyorg", "checkcommitreadiness", "commit",
+                    "querycommitted", "queryinstalled", "install"):
+            p.add_argument("-C", "--channel", default="")
+        if name in ("approveformyorg", "checkcommitreadiness", "commit"):
+            p.add_argument("-n", "--name", required=True)
+            p.add_argument("-v", "--version", required=True)
+            p.add_argument("--sequence", type=int, required=True)
+            p.add_argument("--package-id", default="")
+        if name == "querycommitted":
+            p.add_argument("-n", "--name", default="")
+        if name in ("approveformyorg", "commit"):
+            p.add_argument("--orderer", required=True)
         p.set_defaults(fn=fn)
 
     args = ap.parse_args(argv)

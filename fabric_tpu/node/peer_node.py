@@ -136,6 +136,8 @@ class PeerNode:
         chaincodes: dict | None = None,
         orderer_endpoints: list[tuple[str, int]] | None = None,
         operations_port: int | None = None,
+        endorser_concurrency: int = 2500,
+        deliver_concurrency: int = 2500,
     ):
         self.csp = csp
         self.signer = signer
@@ -203,10 +205,10 @@ class PeerNode:
 
         self.rpc = RPCServer(host, port)
         # per-service concurrency limiters (reference
-        # internal/peer/node/grpc_limiters.go; defaults from
-        # sampleconfig/core.yaml peer.limits.concurrency)
-        endorser_sem = Semaphore(2500)
-        deliver_sem = Semaphore(2500)
+        # internal/peer/node/grpc_limiters.go; values from core.yaml
+        # peer.limits.concurrency via the CLI, defaults 2500)
+        endorser_sem = Semaphore(endorser_concurrency)
+        deliver_sem = Semaphore(deliver_concurrency)
         self.rpc.register(
             "endorser.ProcessProposal", self._process_proposal,
             limiter=endorser_sem,
@@ -277,16 +279,85 @@ class PeerNode:
 
     # -- RPC handlers ------------------------------------------------------
 
+    # node-scoped SCC functions servable WITHOUT a channel (the
+    # reference endorser routes channel-less proposals to lscc install /
+    # _lifecycle InstallChaincode the same way)
+    _CHANNELLESS = {
+        "_lifecycle": {
+            "InstallChaincode", "QueryInstalledChaincodes",
+            "GetInstalledChaincodePackage",
+        },
+        "lscc": {"install", "getinstalledchaincodes"},
+    }
+
     def _process_proposal(self, body: bytes, stream) -> bytes:
         signed = proposal_pb2.SignedProposal.FromString(body)
         prop = proposal_pb2.Proposal.FromString(signed.proposal_bytes)
         hdr = common_pb2.Header.FromString(prop.header)
         chdr = common_pb2.ChannelHeader.FromString(hdr.channel_header)
+        if not chdr.channel_id:
+            return self._process_channelless(signed)
         ch = self.channels.get(chdr.channel_id)
         if ch is None:
             raise KeyError(f"channel {chdr.channel_id!r} not joined")
         resp = ch.endorser.process_proposal(signed)
         return resp.SerializeToString()
+
+    def _process_channelless(self, signed) -> bytes:
+        """Channel-less proposal: node-scoped SCC ops only, executed
+        against a throwaway simulator (these functions read/write no
+        channel state)."""
+        from fabric_tpu import protoutil
+        from fabric_tpu.ledger.kvstore import MemKVStore
+        from fabric_tpu.ledger.statedb import VersionedDB
+        from fabric_tpu.ledger.txmgmt import TxSimulator
+        from fabric_tpu.protos.peer import (
+            chaincode_pb2,
+            proposal_response_pb2,
+        )
+
+        up = protoutil.unpack_proposal(signed)
+        allowed = self._CHANNELLESS.get(up.chaincode_name, set())
+        fn = up.input.args[0].decode() if up.input.args else ""
+        if fn not in allowed:
+            raise KeyError(
+                f"{up.chaincode_name}.{fn!r} requires a channel"
+            )
+        # creator signature check against the embedded cert (no channel
+        # MSP exists here; org admin-ship is the deployment's transport
+        # concern, as with the reference's channel-less Endorser path)
+        from cryptography import x509 as _x509
+
+        from fabric_tpu.msp.identity import Identity
+        from fabric_tpu.protos.msp import identities_pb2
+
+        sid = identities_pb2.SerializedIdentity.FromString(
+            up.signature_header.creator
+        )
+        creator = Identity(
+            sid.mspid, _x509.load_pem_x509_certificate(sid.id_bytes), self.csp
+        )
+        if not creator.verify(signed.proposal_bytes, signed.signature):
+            raise PermissionError("invalid creator signature on proposal")
+        cc = self.chaincodes.get(up.chaincode_name)
+        if cc is None:
+            raise KeyError(f"chaincode {up.chaincode_name!r} not installed")
+        sim = TxSimulator(VersionedDB(MemKVStore()))
+        status, message, payload = cc(sim, list(up.input.args))
+        if status >= 400:
+            return proposal_response_pb2.ProposalResponse(
+                response=proposal_pb2.Response(status=status, message=message)
+            ).SerializeToString()
+        return protoutil.create_proposal_response(
+            up.proposal,
+            results=b"",
+            events=b"",
+            response=proposal_pb2.Response(
+                status=status, message=message, payload=payload
+            ),
+            chaincode_id=chaincode_pb2.ChaincodeID(name=up.chaincode_name),
+            endorser_signer=self.signer,
+        ).SerializeToString()
 
     def _deliver(self, body: bytes, stream):
         from fabric_tpu.common.deliver import deliver_response_frames

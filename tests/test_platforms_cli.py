@@ -1,0 +1,129 @@
+"""Chaincode platforms packager + peer lifecycle CLI package/install +
+RPC instrumentation."""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from fabric_tpu.chaincode.platforms import (
+    PlatformError,
+    package_chaincode,
+    parse_package,
+)
+
+
+def test_package_roundtrip(tmp_path):
+    src = tmp_path / "cc"
+    os.makedirs(src / "lib")
+    (src / "main.py").write_text("def invoke(stub): pass\n")
+    (src / "lib" / "util.py").write_text("X = 1\n")
+    pkg = package_chaincode(str(src), "mycc_1.0", "python")
+    meta, files = parse_package(pkg)
+    assert meta["label"] == "mycc_1.0" and meta["type"] == "python"
+    assert set(files) == {"main.py", os.path.join("lib", "util.py")}
+
+
+def test_package_validation(tmp_path):
+    src = tmp_path / "empty"
+    os.makedirs(src)
+    (src / "README.txt").write_text("no code")
+    with pytest.raises(PlatformError):
+        package_chaincode(str(src), "x_1", "python")
+    with pytest.raises(PlatformError):
+        package_chaincode(str(src), "bad label", "external")
+    with pytest.raises(PlatformError):
+        package_chaincode(str(src), "x_1", "golang")
+
+
+def test_external_platform_connection_json(tmp_path):
+    src = tmp_path / "ext"
+    os.makedirs(src)
+    (src / "connection.json").write_text('{"address": "127.0.0.1:9999"}')
+    pkg = package_chaincode(str(src), "ext_1", "external")
+    meta, files = parse_package(pkg)
+    assert meta["type"] == "external"
+    (src / "connection.json").write_text("not-json")
+    with pytest.raises(PlatformError):
+        package_chaincode(str(src), "ext_1", "external")
+
+
+def test_cli_package(tmp_path):
+    from fabric_tpu.cmd.peer import main
+
+    src = tmp_path / "cc"
+    os.makedirs(src)
+    (src / "main.py").write_text("pass\n")
+    out = str(tmp_path / "cc.tar.gz")
+    rc = main([
+        "lifecycle", "chaincode", "package", out,
+        "--path", str(src), "--label", "clicc_1.0",
+    ])
+    assert rc == 0
+    meta, files = parse_package(open(out, "rb").read())
+    assert meta["label"] == "clicc_1.0" and "main.py" in files
+
+
+def test_rpc_instrumentation():
+    from fabric_tpu.common.metrics import PrometheusProvider
+    from fabric_tpu.comm import RPCClient, RPCServer
+    from fabric_tpu.comm.instrument import instrument
+
+    provider = PrometheusProvider()
+    srv = RPCServer()
+    srv.register("a.Early", lambda body, stream: b"early")
+    instrument(srv, provider)
+    srv.register("a.Late", lambda body, stream: b"late")
+    srv.start()
+    host, port = srv.addr
+    try:
+        assert RPCClient(host, port).call("a.Early") == b"early"
+        assert RPCClient(host, port).call("a.Late") == b"late"
+        text = provider.registry.expose()
+        assert 'rpc_server_requests_completed' in text
+        assert 'method="a.Early"' in text and 'method="a.Late"' in text
+        assert "rpc_server_request_duration" in text
+    finally:
+        srv.stop()
+
+
+def test_channelless_lifecycle_install(tmp_path):
+    """`peer lifecycle chaincode install` with no -C flag goes through
+    the peer's channel-less proposal path (node-scoped SCC ops)."""
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from orgfix import make_org
+
+    from fabric_tpu.chaincode.platforms import package_chaincode
+    from fabric_tpu.cmd.common import endorse
+    from fabric_tpu.node.peer_node import PeerNode
+    from fabric_tpu.protos.peer import lifecycle_pb2 as lcpb
+
+    org = make_org("Org1MSP")
+    node = PeerNode(str(tmp_path / "peer"), org.csp,
+                    org.signer("peer0", role_ou="peer"))
+    node.start()
+    try:
+        src = tmp_path / "cc"
+        os.makedirs(src)
+        (src / "main.py").write_text("pass\n")
+        pkg = package_chaincode(str(src), "clesscc_1.0")
+        req = lcpb.InstallChaincodeArgs(chaincode_install_package=pkg)
+        client = org.signer("admin", role_ou="admin")
+        _, resps = endorse(
+            [node.addr], client, "", "_lifecycle",
+            [b"InstallChaincode", req.SerializeToString()],
+        )
+        assert resps[0].response.status == 200
+        res = lcpb.InstallChaincodeResult.FromString(resps[0].response.payload)
+        assert res.label == "clesscc_1.0"
+        # and a channel-REQUIRING op on no channel is refused
+        with pytest.raises(Exception):
+            endorse(
+                [node.addr], client, "", "_lifecycle",
+                [b"CommitChaincodeDefinition", b""],
+            )
+    finally:
+        node.stop()
