@@ -305,7 +305,24 @@ def g1_neg(p1):
     return (p1[0], -p1[1] % P)
 
 
-def g1_mul(p1, k: int):
+def _native():
+    """The native BN254 module, or None (memoized availability gate).
+    Real native-layer errors propagate — only absence falls back."""
+    global _NATIVE
+    if _NATIVE is _UNSET:
+        from fabric_tpu import native
+
+        _NATIVE = native if native.available() else None
+    return _NATIVE
+
+
+_UNSET = object()
+_NATIVE = _UNSET
+
+
+def _g1_mul_py(p1, k: int):
+    """Pure-Python double-and-add — the parity oracle for the native
+    backend (tests/test_bn254_native.py) and the no-compiler fallback."""
     k %= R
     out = None
     add = p1
@@ -314,6 +331,46 @@ def g1_mul(p1, k: int):
             out = g1_add(out, add)
         add = g1_add(add, add)
         k >>= 1
+    return out
+
+
+def g1_mul(p1, k: int):
+    if p1 is None:
+        return None
+    nat = _native()
+    if nat is not None:
+        return nat.bn254_mul_many([p1], [k])[0]
+    return _g1_mul_py(p1, k)
+
+
+def g1_mul_many(points, scalars):
+    """Independent scalars[i]*points[i] with one shared field inversion
+    (native batch path; issuance/setup fan-out)."""
+    nat = _native()
+    if nat is not None:
+        return nat.bn254_mul_many(points, scalars)
+    return [
+        _g1_mul_py(p, k) if p is not None else None
+        for p, k in zip(points, scalars)
+    ]
+
+
+def g1_msm(terms):
+    """sum of scalar*point over G1: [(point|None, scalar)] -> point|None.
+
+    The verification hot path (Schnorr commitment recomputation, RLC
+    accumulation in batched verify) — served by the native Montgomery
+    implementation (native/bn254.cc) when available, else the affine
+    Python ladder.  The reference does the same per-base loop in AMCL
+    (fabric-amcl G1mul + add)."""
+    nat = _native()
+    if nat is not None:
+        return nat.bn254_msm([t[0] for t in terms], [t[1] for t in terms])
+    out = None
+    for pt, k in terms:
+        if pt is None:
+            continue
+        out = g1_add(out, _g1_mul_py(pt, k))
     return out
 
 

@@ -32,10 +32,9 @@ class Relation:
 
 
 def _commitment(rel: Relation, rho: dict[str, int]):
-    t = None
-    for base, name in zip(rel.bases, rel.names):
-        t = bn.g1_add(t, bn.g1_mul(base, rho[name]))
-    return t
+    return bn.g1_msm(
+        [(base, rho[name]) for base, name in zip(rel.bases, rel.names)]
+    )
 
 
 def prove(
@@ -67,10 +66,14 @@ def recompute_commitments(
     challenge_fn and compare challenges."""
     out = []
     for rel in relations:
-        t = bn.g1_mul(rel.target, (-challenge) % bn.R)
-        for base, name in zip(rel.bases, rel.names):
+        for name in rel.names:
             if name not in responses:
                 raise ValueError(f"missing response for secret {name!r}")
-            t = bn.g1_add(t, bn.g1_mul(base, responses[name]))
-        out.append(t)
+        out.append(bn.g1_msm(
+            [(rel.target, (-challenge) % bn.R)]
+            + [
+                (base, responses[name])
+                for base, name in zip(rel.bases, rel.names)
+            ]
+        ))
     return out

@@ -302,12 +302,9 @@ def verify_batch(
     live = [i for i, v in enumerate(ok) if v]
     if not live:
         return ok
-    acc_ap = None
-    acc_ab = None
-    for i in live:
-        t = bn.rand_zr(rng)
-        acc_ap = bn.g1_add(acc_ap, bn.g1_mul(sigs[i].a_prime, t))
-        acc_ab = bn.g1_add(acc_ab, bn.g1_mul(sigs[i].a_bar, t))
+    weights = {i: bn.rand_zr(rng) for i in live}
+    acc_ap = bn.g1_msm([(sigs[i].a_prime, weights[i]) for i in live])
+    acc_ab = bn.g1_msm([(sigs[i].a_bar, weights[i]) for i in live])
     combined = bn.multi_pairing(
         [(acc_ap, ipk.w), (bn.g1_neg(acc_ab), bn.G2_GEN)]
     )

@@ -16,7 +16,8 @@ import threading
 import numpy as np
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
-_SRCS = [os.path.join(_DIR, "marshal.cc"), os.path.join(_DIR, "collect.cc")]
+_SRCS = [os.path.join(_DIR, "marshal.cc"), os.path.join(_DIR, "collect.cc"),
+         os.path.join(_DIR, "bn254.cc")]
 _LIB = os.path.join(_DIR, "libfabricmarshal.so")
 
 _lock = threading.Lock()
@@ -72,6 +73,12 @@ def _load():
                 + [i32p, i32p, ctypes.c_int]      # endo_start/count, max
                 + [i64p, i32p] * 2 + [u8p]        # endorser, esig, edigest
             )
+            msm = lib.bn254_g1_msm
+            msm.restype = ctypes.c_int
+            msm.argtypes = [ctypes.c_int] + [ctypes.c_char_p] * 3 + [u8p, u8p]
+            mm = lib.bn254_g1_mul_many
+            mm.restype = ctypes.c_int
+            mm.argtypes = [ctypes.c_int] + [ctypes.c_char_p] * 3 + [u8p] * 3
             _lib = lib
         except Exception:
             _lib = None
@@ -179,4 +186,72 @@ def collect_block(env_bytes: bytes, env_off: np.ndarray,
         max_endos *= 4  # undersized endorsement arrays: retry larger
 
 
-__all__ = ["available", "marshal_batch", "collect_block"]
+def bn254_msm(points, scalars) -> tuple[int, int] | None:
+    """sum_i scalars[i] * points[i] over BN254 G1 (affine int coords;
+    None encodes a point at infinity, on input and output).  Raises
+    RuntimeError when the native library is unavailable — gate on
+    available() (idemix.bn254._native does)."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    n = len(points)
+    xs = bytearray(32 * n)
+    ys = bytearray(32 * n)
+    ss = bytearray(32 * n)
+    for i, (pt, k) in enumerate(zip(points, scalars)):
+        if pt is None:
+            continue  # (0,0) = infinity
+        xs[32 * i:32 * i + 32] = pt[0].to_bytes(32, "big")
+        ys[32 * i:32 * i + 32] = pt[1].to_bytes(32, "big")
+        ss[32 * i:32 * i + 32] = (k % _BN254_R).to_bytes(32, "big")
+    ox = np.zeros(32, np.uint8)
+    oy = np.zeros(32, np.uint8)
+    rc = lib.bn254_g1_msm(n, bytes(xs), bytes(ys), bytes(ss), ox, oy)
+    if rc:
+        return None
+    return (
+        int.from_bytes(ox.tobytes(), "big"),
+        int.from_bytes(oy.tobytes(), "big"),
+    )
+
+
+def bn254_mul_many(points, scalars) -> list[tuple[int, int] | None]:
+    """Independent scalars[i] * points[i]; one shared field inversion.
+    Raises RuntimeError when the native library is unavailable."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    n = len(points)
+    xs = bytearray(32 * n)
+    ys = bytearray(32 * n)
+    ss = bytearray(32 * n)
+    for i, (pt, k) in enumerate(zip(points, scalars)):
+        if pt is None:
+            continue
+        xs[32 * i:32 * i + 32] = pt[0].to_bytes(32, "big")
+        ys[32 * i:32 * i + 32] = pt[1].to_bytes(32, "big")
+        ss[32 * i:32 * i + 32] = (k % _BN254_R).to_bytes(32, "big")
+    ox = np.zeros(32 * n, np.uint8)
+    oy = np.zeros(32 * n, np.uint8)
+    inf = np.zeros(n, np.uint8)
+    lib.bn254_g1_mul_many(n, bytes(xs), bytes(ys), bytes(ss), ox, oy, inf)
+    out: list = []
+    b_ox, b_oy = ox.tobytes(), oy.tobytes()
+    for i in range(n):
+        if inf[i]:
+            out.append(None)
+        else:
+            out.append((
+                int.from_bytes(b_ox[32 * i:32 * i + 32], "big"),
+                int.from_bytes(b_oy[32 * i:32 * i + 32], "big"),
+            ))
+    return out
+
+
+_BN254_R = 0x30644e72e131a029b85045b68181585d2833e84879b9709143e1f593f0000001
+
+
+__all__ = [
+    "available", "marshal_batch", "collect_block", "bn254_msm",
+    "bn254_mul_many",
+]

@@ -1,0 +1,404 @@
+// Native BN254 (alt-bn128) G1 arithmetic for the idemix data plane.
+//
+// The reference's idemix math runs on pure-Go AMCL (fabric-amcl,
+// SURVEY.md §2.1); the TPU build's Python bn254.py is the portable
+// fallback and THIS file is the hot path: Montgomery Fp (4x64 limbs,
+// __int128 products), Jacobian G1 (a = 0, y^2 = x^3 + 3), 4-bit
+// windowed scalar multiplication, and batch APIs with one shared
+// Montgomery inversion for the affine outputs.  Used by the Schnorr
+// commitment recomputation in idemix signature verification
+// (signature.go:243-relations equivalent) and the RLC accumulation in
+// batched verification — the per-item cost that dominates once the
+// pairings amortize to two per batch.
+//
+// All point/scalar I/O is 32-byte big-endian affine coordinates.
+
+#include <cstdint>
+#include <cstring>
+
+typedef uint8_t u8;
+typedef uint64_t u64;
+typedef unsigned __int128 u128;
+
+namespace {
+
+// BN254 prime and Montgomery constants (little-endian 64-bit limbs).
+static const u64 PRIME[4] = {0x3c208c16d87cfd47ULL, 0x97816a916871ca8dULL,
+                             0xb85045b68181585dULL, 0x30644e72e131a029ULL};
+static const u64 N0INV = 0x87d20782e4866389ULL;  // -P^-1 mod 2^64
+static const u64 R2[4] = {0xf32cfc5b538afa89ULL, 0xb5e71911d44501fbULL,
+                          0x47ab1eff0a417ff6ULL, 0x06d89f71cab8351fULL};
+static const u64 ONE_M[4] = {0xd35d438dc58f0d9dULL, 0x0a78eb28f5c70b3dULL,
+                             0x666ea36f7879462cULL, 0x0e0a77c19a07df2fULL};
+
+struct Fp {
+  u64 v[4];
+};
+
+inline bool is_zero(const Fp& a) {
+  return (a.v[0] | a.v[1] | a.v[2] | a.v[3]) == 0;
+}
+
+inline int cmp_p(const u64* a) {
+  for (int i = 3; i >= 0; --i) {
+    if (a[i] != PRIME[i]) return a[i] < PRIME[i] ? -1 : 1;
+  }
+  return 0;
+}
+
+inline void sub_p(u64* a) {  // a -= P (caller ensures a >= P)
+  u128 borrow = 0;
+  for (int i = 0; i < 4; ++i) {
+    u128 d = (u128)a[i] - PRIME[i] - (u64)borrow;
+    a[i] = (u64)d;
+    borrow = (d >> 64) ? 1 : 0;
+  }
+}
+
+inline void fp_add(const Fp& a, const Fp& b, Fp* out) {
+  u128 carry = 0;
+  u64 t[4];
+  for (int i = 0; i < 4; ++i) {
+    u128 s = (u128)a.v[i] + b.v[i] + (u64)carry;
+    t[i] = (u64)s;
+    carry = s >> 64;
+  }
+  if (carry || cmp_p(t) >= 0) sub_p(t);
+  memcpy(out->v, t, sizeof(t));
+}
+
+inline void fp_sub(const Fp& a, const Fp& b, Fp* out) {
+  u128 borrow = 0;
+  u64 t[4];
+  for (int i = 0; i < 4; ++i) {
+    u128 d = (u128)a.v[i] - b.v[i] - (u64)borrow;
+    t[i] = (u64)d;
+    borrow = (d >> 64) ? 1 : 0;
+  }
+  if (borrow) {  // += P
+    u128 carry = 0;
+    for (int i = 0; i < 4; ++i) {
+      u128 s = (u128)t[i] + PRIME[i] + (u64)carry;
+      t[i] = (u64)s;
+      carry = s >> 64;
+    }
+  }
+  memcpy(out->v, t, sizeof(t));
+}
+
+inline void fp_dbl(const Fp& a, Fp* out) { fp_add(a, a, out); }
+
+// Montgomery CIOS multiplication: out = a*b*R^-1 mod P.
+void fp_mul(const Fp& a, const Fp& b, Fp* out) {
+  u64 t[5] = {0, 0, 0, 0, 0};
+  for (int i = 0; i < 4; ++i) {
+    // t += a[i] * b
+    u128 carry = 0;
+    for (int j = 0; j < 4; ++j) {
+      u128 s = (u128)a.v[i] * b.v[j] + t[j] + (u64)carry;
+      t[j] = (u64)s;
+      carry = s >> 64;
+    }
+    u64 t4 = t[4] + (u64)carry;
+    // m = t[0] * n0inv; t += m * P; t >>= 64
+    u64 m = t[0] * N0INV;
+    carry = ((u128)m * PRIME[0] + t[0]) >> 64;
+    for (int j = 1; j < 4; ++j) {
+      u128 s = (u128)m * PRIME[j] + t[j] + (u64)carry;
+      t[j - 1] = (u64)s;
+      carry = s >> 64;
+    }
+    u128 s = (u128)t4 + (u64)carry;
+    t[3] = (u64)s;
+    t[4] = (u64)(s >> 64);
+  }
+  if (t[4] || cmp_p(t) >= 0) sub_p(t);
+  memcpy(out->v, t, 4 * sizeof(u64));
+}
+
+inline void fp_sqr(const Fp& a, Fp* out) { fp_mul(a, a, out); }
+
+void to_mont(const Fp& a, Fp* out) {
+  Fp r2;
+  memcpy(r2.v, R2, sizeof(R2));
+  fp_mul(a, r2, out);
+}
+
+void from_mont(const Fp& a, Fp* out) {
+  Fp one = {{1, 0, 0, 0}};
+  fp_mul(a, one, out);
+}
+
+// Montgomery inversion via Fermat: a^(P-2).  ~380 muls; used once per
+// batch thanks to the shared batch-inversion trick.
+void fp_inv(const Fp& a, Fp* out) {
+  // exponent P-2, big-endian bit scan
+  u64 e[4];
+  memcpy(e, PRIME, sizeof(e));
+  // subtract 2
+  if (e[0] >= 2) {
+    e[0] -= 2;
+  } else {
+    e[0] = e[0] - 2;  // wraps; borrow
+    int i = 1;
+    while (e[i] == 0) e[i++] -= 1;
+    e[i] -= 1;
+  }
+  Fp result;
+  memcpy(result.v, ONE_M, sizeof(ONE_M));
+  bool started = false;
+  for (int limb = 3; limb >= 0; --limb) {
+    for (int bit = 63; bit >= 0; --bit) {
+      if (started) fp_sqr(result, &result);
+      if ((e[limb] >> bit) & 1) {
+        if (!started) {
+          result = a;
+          started = true;
+        } else {
+          fp_mul(result, a, &result);
+        }
+      }
+    }
+  }
+  *out = result;
+}
+
+// ---------------------------------------------------------------------------
+// G1 Jacobian (Montgomery-domain coordinates).
+// ---------------------------------------------------------------------------
+
+struct G1 {
+  Fp x, y, z;
+  bool inf;
+};
+
+void g1_dbl(const G1& p, G1* out) {
+  if (p.inf || is_zero(p.y)) {
+    out->inf = true;
+    return;
+  }
+  // dbl-2009-l (a = 0): A=X^2 B=Y^2 C=B^2 D=2((X+B)^2-A-C) E=3A F=E^2
+  Fp A, B, C, D, E, F, t;
+  fp_sqr(p.x, &A);
+  fp_sqr(p.y, &B);
+  fp_sqr(B, &C);
+  fp_add(p.x, B, &t);
+  fp_sqr(t, &t);
+  fp_sub(t, A, &t);
+  fp_sub(t, C, &t);
+  fp_dbl(t, &D);
+  fp_dbl(A, &E);
+  fp_add(E, A, &E);
+  fp_sqr(E, &F);
+  G1 r;
+  r.inf = false;
+  fp_sub(F, D, &r.x);
+  fp_sub(r.x, D, &r.x);               // X3 = F - 2D
+  Fp c8;
+  fp_dbl(C, &c8);
+  fp_dbl(c8, &c8);
+  fp_dbl(c8, &c8);                    // 8C
+  fp_sub(D, r.x, &t);
+  fp_mul(E, t, &r.y);
+  fp_sub(r.y, c8, &r.y);              // Y3 = E(D - X3) - 8C
+  fp_mul(p.y, p.z, &t);
+  fp_dbl(t, &r.z);                    // Z3 = 2YZ
+  *out = r;
+}
+
+void g1_add(const G1& p, const G1& q, G1* out) {
+  if (p.inf) {
+    *out = q;
+    return;
+  }
+  if (q.inf) {
+    *out = p;
+    return;
+  }
+  // add-2007-bl
+  Fp z1z1, z2z2, u1, u2, s1, s2, h, i, j, rr, v, t;
+  fp_sqr(p.z, &z1z1);
+  fp_sqr(q.z, &z2z2);
+  fp_mul(p.x, z2z2, &u1);
+  fp_mul(q.x, z1z1, &u2);
+  fp_mul(p.y, q.z, &t);
+  fp_mul(t, z2z2, &s1);
+  fp_mul(q.y, p.z, &t);
+  fp_mul(t, z1z1, &s2);
+  fp_sub(u2, u1, &h);
+  fp_sub(s2, s1, &rr);
+  if (is_zero(h)) {
+    if (is_zero(rr)) {
+      g1_dbl(p, out);
+      return;
+    }
+    out->inf = true;
+    return;
+  }
+  fp_dbl(h, &t);
+  fp_sqr(t, &i);
+  fp_mul(h, i, &j);
+  fp_dbl(rr, &rr);
+  fp_mul(u1, i, &v);
+  G1 r;
+  r.inf = false;
+  fp_sqr(rr, &r.x);
+  fp_sub(r.x, j, &r.x);
+  fp_sub(r.x, v, &r.x);
+  fp_sub(r.x, v, &r.x);               // X3 = r^2 - J - 2V
+  fp_sub(v, r.x, &t);
+  fp_mul(rr, t, &r.y);
+  Fp s1j;
+  fp_mul(s1, j, &s1j);
+  fp_dbl(s1j, &s1j);
+  fp_sub(r.y, s1j, &r.y);             // Y3 = r(V - X3) - 2 S1 J
+  fp_add(p.z, q.z, &t);
+  fp_sqr(t, &t);
+  fp_sub(t, z1z1, &t);
+  fp_sub(t, z2z2, &t);
+  fp_mul(t, h, &r.z);                 // Z3 = ((Z1+Z2)^2 - Z1Z1 - Z2Z2) H
+  *out = r;
+}
+
+// 4-bit windowed scalar multiplication, MSB first.
+void g1_mul(const G1& p, const u8* scalar_be, G1* out) {
+  G1 table[16];
+  table[0].inf = true;
+  table[1] = p;
+  for (int k = 2; k < 16; ++k) g1_add(table[k - 1], p, &table[k]);
+  G1 acc;
+  acc.inf = true;
+  bool any = false;
+  for (int i = 0; i < 32; ++i) {
+    for (int half = 0; half < 2; ++half) {
+      int d = half ? (scalar_be[i] & 0xf) : (scalar_be[i] >> 4);
+      if (any) {
+        g1_dbl(acc, &acc);
+        g1_dbl(acc, &acc);
+        g1_dbl(acc, &acc);
+        g1_dbl(acc, &acc);
+      }
+      if (d) {
+        g1_add(acc, table[d], &acc);
+        any = true;
+      } else if (any) {
+        // nothing
+      }
+    }
+  }
+  *out = acc;
+}
+
+void load_fp_be(const u8* be, Fp* out) {
+  for (int i = 0; i < 4; ++i) {
+    u64 v = 0;
+    for (int j = 0; j < 8; ++j) v = (v << 8) | be[(3 - i) * 8 + j];
+    out->v[i] = v;
+  }
+}
+
+void store_fp_be(const Fp& a, u8* be) {
+  for (int i = 0; i < 4; ++i) {
+    u64 v = a.v[3 - i];
+    for (int j = 0; j < 8; ++j) be[i * 8 + j] = (u8)(v >> (56 - 8 * j));
+  }
+}
+
+void load_point(const u8* x_be, const u8* y_be, G1* out) {
+  Fp x, y;
+  load_fp_be(x_be, &x);
+  load_fp_be(y_be, &y);
+  out->inf = is_zero(x) && is_zero(y);
+  to_mont(x, &out->x);
+  to_mont(y, &out->y);
+  memcpy(out->z.v, ONE_M, sizeof(ONE_M));
+}
+
+}  // namespace
+
+extern "C" {
+
+// out = sum_i scalar_i * (x_i, y_i).  Inputs/outputs 32-byte big-endian
+// affine; (0, 0) encodes infinity.  Returns 1 when the sum is infinity.
+int bn254_g1_msm(int n, const u8* xs, const u8* ys, const u8* scalars,
+                 u8* out_x, u8* out_y) {
+  G1 acc;
+  acc.inf = true;
+  for (int i = 0; i < n; ++i) {
+    G1 p, t;
+    load_point(xs + 32 * i, ys + 32 * i, &p);
+    if (p.inf) continue;
+    g1_mul(p, scalars + 32 * i, &t);
+    g1_add(acc, t, &acc);
+  }
+  if (acc.inf) {
+    memset(out_x, 0, 32);
+    memset(out_y, 0, 32);
+    return 1;
+  }
+  Fp zinv, zinv2, zinv3, ax, ay;
+  fp_inv(acc.z, &zinv);
+  fp_sqr(zinv, &zinv2);
+  fp_mul(zinv2, zinv, &zinv3);
+  fp_mul(acc.x, zinv2, &ax);
+  fp_mul(acc.y, zinv3, &ay);
+  from_mont(ax, &ax);
+  from_mont(ay, &ay);
+  store_fp_be(ax, out_x);
+  store_fp_be(ay, out_y);
+  return 0;
+}
+
+// out_i = scalar_i * (x_i, y_i), independent muls; shared Montgomery
+// batch inversion for the affine conversions.  inf_flags[i] set when
+// the result is infinity.
+int bn254_g1_mul_many(int n, const u8* xs, const u8* ys, const u8* scalars,
+                      u8* out_xs, u8* out_ys, u8* inf_flags) {
+  G1* res = new G1[n];
+  for (int i = 0; i < n; ++i) {
+    G1 p;
+    load_point(xs + 32 * i, ys + 32 * i, &p);
+    if (p.inf) {
+      res[i].inf = true;
+      continue;
+    }
+    g1_mul(p, scalars + 32 * i, &res[i]);
+  }
+  // batch inversion of all finite Z's
+  Fp* prefix = new Fp[n + 1];
+  memcpy(prefix[0].v, ONE_M, sizeof(ONE_M));
+  for (int i = 0; i < n; ++i) {
+    if (res[i].inf) {
+      prefix[i + 1] = prefix[i];
+    } else {
+      fp_mul(prefix[i], res[i].z, &prefix[i + 1]);
+    }
+  }
+  Fp inv;
+  fp_inv(prefix[n], &inv);
+  for (int i = n - 1; i >= 0; --i) {
+    if (res[i].inf) {
+      inf_flags[i] = 1;
+      memset(out_xs + 32 * i, 0, 32);
+      memset(out_ys + 32 * i, 0, 32);
+      continue;
+    }
+    inf_flags[i] = 0;
+    Fp zinv, zinv2, zinv3, ax, ay;
+    fp_mul(inv, prefix[i], &zinv);
+    fp_mul(inv, res[i].z, &inv);
+    fp_sqr(zinv, &zinv2);
+    fp_mul(zinv2, zinv, &zinv3);
+    fp_mul(res[i].x, zinv2, &ax);
+    fp_mul(res[i].y, zinv3, &ay);
+    from_mont(ax, &ax);
+    from_mont(ay, &ay);
+    store_fp_be(ax, out_xs + 32 * i);
+    store_fp_be(ay, out_ys + 32 * i);
+  }
+  delete[] res;
+  delete[] prefix;
+  return 0;
+}
+
+}  // extern "C"

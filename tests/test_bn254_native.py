@@ -1,0 +1,60 @@
+"""Native BN254 G1 backend (native/bn254.cc): parity with the pure-
+Python affine implementation on random, infinity, and edge inputs."""
+
+import random
+
+import pytest
+
+from fabric_tpu import native
+from fabric_tpu.idemix import bn254 as bn
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native library unavailable"
+)
+
+RNG = random.Random(99)
+
+
+def _rand_points(n):
+    return [bn._g1_mul_py(bn.G1_GEN, bn.rand_zr(RNG)) for _ in range(n)]
+
+
+def test_msm_parity():
+    pts = _rand_points(6)
+    ks = [bn.rand_zr(RNG) for _ in range(6)]
+    ref = None
+    for p, k in zip(pts, ks):
+        ref = bn.g1_add(ref, bn._g1_mul_py(p, k))
+    assert native.bn254_msm(pts, ks) == ref
+
+
+def test_msm_edge_scalars():
+    p = _rand_points(1)[0]
+    # k = 0, 1, R-1, R, R+5 (reduction mod R)
+    for k in (0, 1, bn.R - 1, bn.R, bn.R + 5):
+        ref = bn._g1_mul_py(p, k)
+        assert native.bn254_msm([p], [k]) == ref
+
+
+def test_msm_infinity_paths():
+    p = _rand_points(1)[0]
+    # cancellation -> infinity
+    assert native.bn254_msm([p, bn.g1_neg(p)], [7, 7]) is None
+    # infinity input skipped
+    assert native.bn254_msm([None, p], [3, 2]) == bn._g1_mul_py(p, 2)
+    # empty
+    assert native.bn254_msm([], []) is None
+
+
+def test_mul_many_parity():
+    pts = _rand_points(5) + [None]
+    ks = [bn.rand_zr(RNG) for _ in range(5)] + [11]
+    ref = [bn._g1_mul_py(p, k) if p else None for p, k in zip(pts, ks)]
+    assert native.bn254_mul_many(pts, ks) == ref
+
+
+def test_doubling_chain_parity():
+    # repeated doubling exercises g1_dbl + the add h==0 branch
+    p = _rand_points(1)[0]
+    assert native.bn254_msm([p, p], [3, 3]) == bn._g1_mul_py(p, 6)
+    assert native.bn254_msm([p], [2]) == bn.g1_add(p, p)
