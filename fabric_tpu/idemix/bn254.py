@@ -556,6 +556,17 @@ def multi_pairing(pairs):
     return final_exponentiation(f)
 
 
+def pairing_check(pairs) -> bool:
+    """prod_i e(P_i, Q_i) == 1 — the only form idemix consumes
+    (credential ver, weak-BB, signature checks).  Native Miller loop +
+    shared final exponentiation when available (native/pairing.cc),
+    else the Python towers."""
+    nat = _native()
+    if nat is not None:
+        return nat.bn254_pairing_check(pairs)
+    return multi_pairing(pairs) == FP12_ONE
+
+
 # --- Group element serialization & hashing ----------------------------------
 
 

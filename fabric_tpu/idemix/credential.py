@@ -117,10 +117,9 @@ class Credential:
             raise ValueError("credential: B does not match attributes")
         # e(A, g2^e * W) == e(B, g2)
         lhs_g2 = bn.g2_add(bn.g2_mul(bn.G2_GEN, self.e), ipk.w)
-        ok = bn.multi_pairing(
+        if not bn.pairing_check(
             [(self.a, lhs_g2), (bn.g1_neg(self.b), bn.G2_GEN)]
-        )
-        if ok != bn.FP12_ONE:
+        ):
             raise ValueError("credential: pairing check fails")
 
 

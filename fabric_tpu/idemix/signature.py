@@ -276,10 +276,9 @@ def verify(sig: Signature, ipk: IssuerPublicKey, msg: bytes) -> bool:
     recomputation then two Ate pairings at :290-291)."""
     if not _check_schnorr(sig, ipk, msg):
         return False
-    check = bn.multi_pairing(
+    return bn.pairing_check(
         [(sig.a_prime, ipk.w), (bn.g1_neg(sig.a_bar), bn.G2_GEN)]
     )
-    return check == bn.FP12_ONE
 
 
 def verify_batch(
@@ -305,15 +304,11 @@ def verify_batch(
     weights = {i: bn.rand_zr(rng) for i in live}
     acc_ap = bn.g1_msm([(sigs[i].a_prime, weights[i]) for i in live])
     acc_ab = bn.g1_msm([(sigs[i].a_bar, weights[i]) for i in live])
-    combined = bn.multi_pairing(
-        [(acc_ap, ipk.w), (bn.g1_neg(acc_ab), bn.G2_GEN)]
-    )
-    if combined == bn.FP12_ONE:
+    if bn.pairing_check([(acc_ap, ipk.w), (bn.g1_neg(acc_ab), bn.G2_GEN)]):
         return ok
     # Rare path: at least one forged pairing — isolate per item.
     for i in live:
-        check = bn.multi_pairing(
+        ok[i] = bn.pairing_check(
             [(sigs[i].a_prime, ipk.w), (bn.g1_neg(sigs[i].a_bar), bn.G2_GEN)]
         )
-        ok[i] = check == bn.FP12_ONE
     return ok

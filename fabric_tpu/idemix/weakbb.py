@@ -24,7 +24,6 @@ def wbb_verify(pk: tuple, sig: tuple, m: int) -> bool:
     if sig is None or not bn.g1_is_on_curve(sig):
         return False
     lhs_g2 = bn.g2_add(pk, bn.g2_mul(bn.G2_GEN, m))
-    check = bn.multi_pairing(
+    return bn.pairing_check(
         [(sig, lhs_g2), (bn.g1_neg(bn.G1_GEN), bn.G2_GEN)]
     )
-    return check == bn.FP12_ONE

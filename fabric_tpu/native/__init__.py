@@ -17,7 +17,7 @@ import numpy as np
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRCS = [os.path.join(_DIR, "marshal.cc"), os.path.join(_DIR, "collect.cc"),
-         os.path.join(_DIR, "bn254.cc")]
+         os.path.join(_DIR, "bn254.cc"), os.path.join(_DIR, "pairing.cc")]
 _LIB = os.path.join(_DIR, "libfabricmarshal.so")
 
 _lock = threading.Lock()
@@ -79,6 +79,9 @@ def _load():
             mm = lib.bn254_g1_mul_many
             mm.restype = ctypes.c_int
             mm.argtypes = [ctypes.c_int] + [ctypes.c_char_p] * 3 + [u8p] * 3
+            pc = lib.bn254_pairing_check
+            pc.restype = ctypes.c_int
+            pc.argtypes = [ctypes.c_int] + [ctypes.c_char_p] * 6
             _lib = lib
         except Exception:
             _lib = None
@@ -251,7 +254,29 @@ def bn254_mul_many(points, scalars) -> list[tuple[int, int] | None]:
 _BN254_R = 0x30644e72e131a029b85045b68181585d2833e84879b9709143e1f593f0000001
 
 
+def bn254_pairing_check(pairs) -> bool:
+    """prod e(P_i, Q_i) == 1?  pairs: [(g1_point|None, g2_point|None)]
+    with g1 = (x, y) ints and g2 = ((xa, xb), (ya, yb)) Fp2 ints.
+    Raises RuntimeError when the native library is unavailable."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    n = len(pairs)
+    bufs = [bytearray(32 * n) for _ in range(6)]
+    for i, (pg1, qg2) in enumerate(pairs):
+        if pg1 is None or qg2 is None:
+            continue  # identity factor
+        o = 32 * i
+        bufs[0][o:o + 32] = pg1[0].to_bytes(32, "big")
+        bufs[1][o:o + 32] = pg1[1].to_bytes(32, "big")
+        bufs[2][o:o + 32] = qg2[0][0].to_bytes(32, "big")
+        bufs[3][o:o + 32] = qg2[0][1].to_bytes(32, "big")
+        bufs[4][o:o + 32] = qg2[1][0].to_bytes(32, "big")
+        bufs[5][o:o + 32] = qg2[1][1].to_bytes(32, "big")
+    return bool(lib.bn254_pairing_check(n, *(bytes(b) for b in bufs)))
+
+
 __all__ = [
     "available", "marshal_batch", "collect_block", "bn254_msm",
-    "bn254_mul_many",
+    "bn254_mul_many", "bn254_pairing_check",
 ]

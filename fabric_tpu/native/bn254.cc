@@ -16,152 +16,29 @@
 #include <cstdint>
 #include <cstring>
 
+#include "fp254.h"
+
 typedef uint8_t u8;
 typedef uint64_t u64;
-typedef unsigned __int128 u128;
 
 namespace {
 
-// BN254 prime and Montgomery constants (little-endian 64-bit limbs).
-static const u64 PRIME[4] = {0x3c208c16d87cfd47ULL, 0x97816a916871ca8dULL,
-                             0xb85045b68181585dULL, 0x30644e72e131a029ULL};
-static const u64 N0INV = 0x87d20782e4866389ULL;  // -P^-1 mod 2^64
-static const u64 R2[4] = {0xf32cfc5b538afa89ULL, 0xb5e71911d44501fbULL,
-                          0x47ab1eff0a417ff6ULL, 0x06d89f71cab8351fULL};
-static const u64 ONE_M[4] = {0xd35d438dc58f0d9dULL, 0x0a78eb28f5c70b3dULL,
-                             0x666ea36f7879462cULL, 0x0e0a77c19a07df2fULL};
+using fp254::Fp;
+using fp254::ONE_M;
+using fp254::fp_add;
+using fp254::fp_dbl;
+using fp254::fp_inv;
+using fp254::fp_is_zero;
+using fp254::fp_mul;
+using fp254::fp_sqr;
+using fp254::from_mont;
+using fp254::load_fp_be;
+using fp254::store_fp_be;
+using fp254::to_mont;
 
-struct Fp {
-  u64 v[4];
-};
+using fp254::fp_sub;
 
-inline bool is_zero(const Fp& a) {
-  return (a.v[0] | a.v[1] | a.v[2] | a.v[3]) == 0;
-}
-
-inline int cmp_p(const u64* a) {
-  for (int i = 3; i >= 0; --i) {
-    if (a[i] != PRIME[i]) return a[i] < PRIME[i] ? -1 : 1;
-  }
-  return 0;
-}
-
-inline void sub_p(u64* a) {  // a -= P (caller ensures a >= P)
-  u128 borrow = 0;
-  for (int i = 0; i < 4; ++i) {
-    u128 d = (u128)a[i] - PRIME[i] - (u64)borrow;
-    a[i] = (u64)d;
-    borrow = (d >> 64) ? 1 : 0;
-  }
-}
-
-inline void fp_add(const Fp& a, const Fp& b, Fp* out) {
-  u128 carry = 0;
-  u64 t[4];
-  for (int i = 0; i < 4; ++i) {
-    u128 s = (u128)a.v[i] + b.v[i] + (u64)carry;
-    t[i] = (u64)s;
-    carry = s >> 64;
-  }
-  if (carry || cmp_p(t) >= 0) sub_p(t);
-  memcpy(out->v, t, sizeof(t));
-}
-
-inline void fp_sub(const Fp& a, const Fp& b, Fp* out) {
-  u128 borrow = 0;
-  u64 t[4];
-  for (int i = 0; i < 4; ++i) {
-    u128 d = (u128)a.v[i] - b.v[i] - (u64)borrow;
-    t[i] = (u64)d;
-    borrow = (d >> 64) ? 1 : 0;
-  }
-  if (borrow) {  // += P
-    u128 carry = 0;
-    for (int i = 0; i < 4; ++i) {
-      u128 s = (u128)t[i] + PRIME[i] + (u64)carry;
-      t[i] = (u64)s;
-      carry = s >> 64;
-    }
-  }
-  memcpy(out->v, t, sizeof(t));
-}
-
-inline void fp_dbl(const Fp& a, Fp* out) { fp_add(a, a, out); }
-
-// Montgomery CIOS multiplication: out = a*b*R^-1 mod P.
-void fp_mul(const Fp& a, const Fp& b, Fp* out) {
-  u64 t[5] = {0, 0, 0, 0, 0};
-  for (int i = 0; i < 4; ++i) {
-    // t += a[i] * b
-    u128 carry = 0;
-    for (int j = 0; j < 4; ++j) {
-      u128 s = (u128)a.v[i] * b.v[j] + t[j] + (u64)carry;
-      t[j] = (u64)s;
-      carry = s >> 64;
-    }
-    u64 t4 = t[4] + (u64)carry;
-    // m = t[0] * n0inv; t += m * P; t >>= 64
-    u64 m = t[0] * N0INV;
-    carry = ((u128)m * PRIME[0] + t[0]) >> 64;
-    for (int j = 1; j < 4; ++j) {
-      u128 s = (u128)m * PRIME[j] + t[j] + (u64)carry;
-      t[j - 1] = (u64)s;
-      carry = s >> 64;
-    }
-    u128 s = (u128)t4 + (u64)carry;
-    t[3] = (u64)s;
-    t[4] = (u64)(s >> 64);
-  }
-  if (t[4] || cmp_p(t) >= 0) sub_p(t);
-  memcpy(out->v, t, 4 * sizeof(u64));
-}
-
-inline void fp_sqr(const Fp& a, Fp* out) { fp_mul(a, a, out); }
-
-void to_mont(const Fp& a, Fp* out) {
-  Fp r2;
-  memcpy(r2.v, R2, sizeof(R2));
-  fp_mul(a, r2, out);
-}
-
-void from_mont(const Fp& a, Fp* out) {
-  Fp one = {{1, 0, 0, 0}};
-  fp_mul(a, one, out);
-}
-
-// Montgomery inversion via Fermat: a^(P-2).  ~380 muls; used once per
-// batch thanks to the shared batch-inversion trick.
-void fp_inv(const Fp& a, Fp* out) {
-  // exponent P-2, big-endian bit scan
-  u64 e[4];
-  memcpy(e, PRIME, sizeof(e));
-  // subtract 2
-  if (e[0] >= 2) {
-    e[0] -= 2;
-  } else {
-    e[0] = e[0] - 2;  // wraps; borrow
-    int i = 1;
-    while (e[i] == 0) e[i++] -= 1;
-    e[i] -= 1;
-  }
-  Fp result;
-  memcpy(result.v, ONE_M, sizeof(ONE_M));
-  bool started = false;
-  for (int limb = 3; limb >= 0; --limb) {
-    for (int bit = 63; bit >= 0; --bit) {
-      if (started) fp_sqr(result, &result);
-      if ((e[limb] >> bit) & 1) {
-        if (!started) {
-          result = a;
-          started = true;
-        } else {
-          fp_mul(result, a, &result);
-        }
-      }
-    }
-  }
-  *out = result;
-}
+inline bool is_zero(const Fp& a) { return fp_is_zero(a); }
 
 // ---------------------------------------------------------------------------
 // G1 Jacobian (Montgomery-domain coordinates).
@@ -287,21 +164,6 @@ void g1_mul(const G1& p, const u8* scalar_be, G1* out) {
     }
   }
   *out = acc;
-}
-
-void load_fp_be(const u8* be, Fp* out) {
-  for (int i = 0; i < 4; ++i) {
-    u64 v = 0;
-    for (int j = 0; j < 8; ++j) v = (v << 8) | be[(3 - i) * 8 + j];
-    out->v[i] = v;
-  }
-}
-
-void store_fp_be(const Fp& a, u8* be) {
-  for (int i = 0; i < 4; ++i) {
-    u64 v = a.v[3 - i];
-    for (int j = 0; j < 8; ++j) be[i * 8 + j] = (u8)(v >> (56 - 8 * j));
-  }
 }
 
 void load_point(const u8* x_be, const u8* y_be, G1* out) {

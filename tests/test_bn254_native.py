@@ -58,3 +58,36 @@ def test_doubling_chain_parity():
     p = _rand_points(1)[0]
     assert native.bn254_msm([p, p], [3, 3]) == bn._g1_mul_py(p, 6)
     assert native.bn254_msm([p], [2]) == bn.g1_add(p, p)
+
+
+def test_pairing_check_bilinearity():
+    a, b = bn.rand_zr(RNG), bn.rand_zr(RNG)
+    p1 = bn._g1_mul_py(bn.G1_GEN, a)
+    q1 = bn.g2_mul(bn.G2_GEN, b)
+    p2 = bn.g1_neg(bn._g1_mul_py(bn.G1_GEN, a * b % bn.R))
+    assert native.bn254_pairing_check([(p1, q1), (p2, bn.G2_GEN)])
+    # python oracle agrees
+    assert bn.multi_pairing([(p1, q1), (p2, bn.G2_GEN)]) == bn.FP12_ONE
+    # tampered pair fails
+    assert not native.bn254_pairing_check([(p1, q1), (bn.g1_neg(p1), bn.G2_GEN)])
+
+
+def test_pairing_check_identity_inputs():
+    p = bn._g1_mul_py(bn.G1_GEN, 5)
+    # infinity on either side contributes the identity factor
+    assert native.bn254_pairing_check([(None, bn.G2_GEN)])
+    assert native.bn254_pairing_check([(p, None)])
+    assert native.bn254_pairing_check([])
+    # a single non-degenerate pairing is NOT one
+    assert not native.bn254_pairing_check([(p, bn.G2_GEN)])
+
+
+def test_pairing_check_three_way_split():
+    # e(aG,bQ) e(bG,cQ) e(-G, (ab+bc)Q) == 1
+    a, b, c = (bn.rand_zr(RNG) for _ in range(3))
+    pairs = [
+        (bn._g1_mul_py(bn.G1_GEN, a), bn.g2_mul(bn.G2_GEN, b)),
+        (bn._g1_mul_py(bn.G1_GEN, b), bn.g2_mul(bn.G2_GEN, c)),
+        (bn.g1_neg(bn.G1_GEN), bn.g2_mul(bn.G2_GEN, (a * b + b * c) % bn.R)),
+    ]
+    assert native.bn254_pairing_check(pairs)
