@@ -39,7 +39,7 @@ def bench_host(csp, items, repeat: int = 1) -> float:
     return len(items) / dt
 
 
-def bench_tpu(items, repeat: int = 3) -> float:
+def bench_tpu(items, repeat: int = 5) -> float:
     from fabric_tpu.csp.tpu.provider import TPUCSP
 
     csp = TPUCSP(min_device_batch=1)

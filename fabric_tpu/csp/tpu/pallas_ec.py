@@ -541,6 +541,9 @@ def _unpack_words(wref):
     return jnp.concatenate(rows, axis=0)
 
 
+KEYTAB = 256  # fixed unique-key table size for the dedup kernel variant
+
+
 def _kernel(qx_ref, qy_ref, d1_ref, d2_ref, c0_ref, flags_ref,
             solmat_ref, bias_ref, r256_ref, r512_ref,
             subc_ref, plimbs_ref, nlimbs_ref, gx_ref, gy_ref,
@@ -549,9 +552,55 @@ def _kernel(qx_ref, qy_ref, d1_ref, d2_ref, c0_ref, flags_ref,
         solmat_ref[:], bias_ref[:], r256_ref[:],
         r512_ref[:], subc_ref[:], plimbs_ref[:],
     )
-    blk = qx_ref.shape[-1]
     qx = _unpack_words(qx_ref)
     qy = _unpack_words(qy_ref)
+    _kernel_body(fp, qx, qy, d1_ref, d2_ref, c0_ref, flags_ref,
+                 nlimbs_ref, gx_ref, gy_ref, out_ref,
+                 tabx, taby, tabz, tabinf)
+
+
+def _kernel_dedup(ktabx_ref, ktaby_ref, kidx_ref, d1_ref, d2_ref, c0_ref,
+                  flags_ref, solmat_ref, bias_ref, r256_ref, r512_ref,
+                  subc_ref, plimbs_ref, nlimbs_ref, gx_ref, gy_ref,
+                  out_ref, tabx, taby, tabz, tabinf):
+    """Variant with a shared unique-key table: real blocks carry few
+    distinct endorser keys, so per-lane pubkeys (64B/sig of transfer)
+    collapse to a (8, KEYTAB)-word table + one u32 index per lane.
+    Per-lane coordinates materialize via an exact one-hot f32 MXU
+    contraction (limbs < 2^16, one-hot sum -> < 2^24)."""
+    fp = FpP256(
+        solmat_ref[:], bias_ref[:], r256_ref[:],
+        r512_ref[:], subc_ref[:], plimbs_ref[:],
+    )
+    blk = kidx_ref.shape[-1]
+    tx = _unpack_words_wide(ktabx_ref)  # (17, KEYTAB)
+    ty = _unpack_words_wide(ktaby_ref)
+    idx = kidx_ref[0:1].astype(jnp.int32)  # (1, blk)
+    iota = jax.lax.broadcasted_iota(jnp.int32, (KEYTAB, blk), 0)
+    oh = (iota == idx).astype(jnp.float32)  # (KEYTAB, blk)
+    qx = _f2u(jnp.dot(_u2f(tx), oh, precision=jax.lax.Precision.HIGHEST))
+    qy = _f2u(jnp.dot(_u2f(ty), oh, precision=jax.lax.Precision.HIGHEST))
+    _kernel_body(fp, qx, qy, d1_ref, d2_ref, c0_ref, flags_ref,
+                 nlimbs_ref, gx_ref, gy_ref, out_ref,
+                 tabx, taby, tabz, tabinf)
+
+
+def _unpack_words_wide(wref):
+    """(8, U) 32-bit words -> (17, U) canonical limbs (same layout rule
+    as _unpack_words)."""
+    w = wref[:]
+    rows = []
+    for i in range(8):
+        rows.append(w[i:i + 1] & jnp.uint32(MASK))
+        rows.append(w[i:i + 1] >> jnp.uint32(LIMB_BITS))
+    rows.append(jnp.zeros_like(rows[0]))
+    return jnp.concatenate(rows, axis=0)
+
+
+def _kernel_body(fp, qx, qy, d1_ref, d2_ref, c0_ref, flags_ref,
+                 nlimbs_ref, gx_ref, gy_ref, out_ref,
+                 tabx, taby, tabz, tabinf):
+    blk = qx.shape[-1]
     fin = jnp.zeros((1, blk), jnp.int32)  # flags are int32 0/1
 
     # -- Q window table (entries 0, 1 direct; 2..15 via mixed-add chain) --
@@ -650,35 +699,33 @@ def _kernel(qx_ref, qy_ref, d1_ref, d2_ref, c0_ref, flags_ref,
     )
 
 
-@functools.lru_cache(maxsize=None)
-def _build_call(nblocks: int, blk: int, interpret: bool):
-    grid = (nblocks,)
+def _specs(blk):
     lane_spec = lambda rows: pl.BlockSpec(  # noqa: E731
         (rows, blk), lambda i: (0, i), memory_space=pltpu.VMEM
     )
     const_spec = lambda shape: pl.BlockSpec(  # noqa: E731
         shape, lambda i: tuple(0 for _ in shape), memory_space=pltpu.VMEM
     )
-    fn = pl.pallas_call(
-        _kernel,
-        grid=grid,
-        in_specs=[
-            lane_spec(8),      # qx (packed 32-bit words)
-            lane_spec(8),      # qy
-            lane_spec(8),      # d1 (8 window digits per word)
-            lane_spec(8),      # d2
-            lane_spec(8),      # cand0
-            lane_spec(2),      # flags: [cand1_ok; valid]
-            const_spec((NLIMBS, 2 * WIDE)),           # solmat
-            const_spec((WIDE, 1)),                    # bias
-            const_spec((NLIMBS, 1)),                  # r256
-            const_spec((NLIMBS, 1)),                  # r512
-            const_spec((WIDE, 1)),                    # sub_c
-            const_spec((WIDE, 1)),                    # p_limbs
-            const_spec((WIDE, 1)),                    # n_limbs (group order)
-            const_spec((TABLE, WIDE)),                # gx
-            const_spec((TABLE, WIDE)),                # gy
-        ],
+    return lane_spec, const_spec
+
+
+def _common_specs(const_spec):
+    return [
+        const_spec((NLIMBS, 2 * WIDE)),           # solmat
+        const_spec((WIDE, 1)),                    # bias
+        const_spec((NLIMBS, 1)),                  # r256
+        const_spec((NLIMBS, 1)),                  # r512
+        const_spec((WIDE, 1)),                    # sub_c
+        const_spec((WIDE, 1)),                    # p_limbs
+        const_spec((WIDE, 1)),                    # n_limbs (group order)
+        const_spec((TABLE, WIDE)),                # gx
+        const_spec((TABLE, WIDE)),                # gy
+    ]
+
+
+def _pallas_opts(nblocks, blk, interpret):
+    return dict(
+        grid=(nblocks,),
         out_specs=pl.BlockSpec(
             (1, 8, blk), lambda i: (i, 0, 0), memory_space=pltpu.VMEM
         ),
@@ -693,6 +740,42 @@ def _build_call(nblocks: int, blk: int, interpret: bool):
             vmem_limit_bytes=100 * 1024 * 1024,
         ),
         interpret=interpret,
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _build_call(nblocks: int, blk: int, interpret: bool):
+    lane_spec, const_spec = _specs(blk)
+    fn = pl.pallas_call(
+        _kernel,
+        in_specs=[
+            lane_spec(8),      # qx (packed 32-bit words)
+            lane_spec(8),      # qy
+            lane_spec(8),      # d1 (8 window digits per word)
+            lane_spec(8),      # d2
+            lane_spec(8),      # cand0
+            lane_spec(2),      # flags: [cand1_ok; valid]
+        ] + _common_specs(const_spec),
+        **_pallas_opts(nblocks, blk, interpret),
+    )
+    return jax.jit(fn)
+
+
+@functools.lru_cache(maxsize=None)
+def _build_call_dedup(nblocks: int, blk: int, interpret: bool):
+    lane_spec, const_spec = _specs(blk)
+    fn = pl.pallas_call(
+        _kernel_dedup,
+        in_specs=[
+            const_spec((8, KEYTAB)),  # ktabx (unique-key words)
+            const_spec((8, KEYTAB)),  # ktaby
+            lane_spec(1),      # kidx (u32 per lane)
+            lane_spec(8),      # d1
+            lane_spec(8),      # d2
+            lane_spec(8),      # cand0
+            lane_spec(2),      # flags
+        ] + _common_specs(const_spec),
+        **_pallas_opts(nblocks, blk, interpret),
     )
     return jax.jit(fn)
 
@@ -796,12 +879,18 @@ def prepare_packed(items) -> dict:
 
 def verify_packed(packed: dict, blk: int = BLK,
                   interpret: bool | None = None):
-    """Run the kernel on prepare_packed output; returns a lazy device
-    array handle via a callable -> (B,) bool (so callers can dispatch
-    several chunks before blocking on any result)."""
+    """Run the kernel on prepare_packed / dedup_keys output; returns a
+    lazy device array handle via a callable -> (B,) bool (so callers can
+    dispatch several chunks before blocking on any result).
+
+    When `packed` carries "kidx"/"ktabx"/"ktaby" (the deduplicated-key
+    layout from `dedup_keys`), the key-table kernel variant runs: 64B of
+    per-lane pubkey transfer collapses to one shared (8, 256)-word
+    table + a u32 index per lane."""
     if interpret is None:
         interpret = _use_interpret()
-    b = packed["qx"].shape[1]
+    dedup = "kidx" in packed
+    b = (packed["kidx"] if dedup else packed["qx"]).shape[-1]
     nb = -(-b // blk)
     pad = nb * blk - b
 
@@ -819,9 +908,15 @@ def verify_packed(packed: dict, blk: int = BLK,
         ]
     )
     c = _consts()
-    inputs = [
-        padlanes(packed["qx"]),
-        padlanes(packed["qy"]),
+    if dedup:
+        head = [
+            packed["ktabx"],
+            packed["ktaby"],
+            padlanes(packed["kidx"].reshape(1, -1)),
+        ]
+    else:
+        head = [padlanes(packed["qx"]), padlanes(packed["qy"])]
+    inputs = head + [
         padlanes(packed["d1"]),
         padlanes(packed["d2"]),
         padlanes(packed["cand0"]),
@@ -836,12 +931,32 @@ def verify_packed(packed: dict, blk: int = BLK,
         c["gx"][:, :, 0],
         c["gy"][:, :, 0],
     ]
-    out = _build_call(nb, blk, interpret)(*inputs)
+    build = _build_call_dedup if dedup else _build_call
+    out = build(nb, blk, interpret)(*inputs)
 
     def collect():
         return np.asarray(out)[:, 0, :].reshape(-1)[:b].astype(bool)
 
     return collect
+
+
+def dedup_keys(packed: dict, max_keys: int = KEYTAB) -> dict:
+    """Rewrite a packed dict into the deduplicated-key layout when the
+    batch uses at most `max_keys` distinct public keys (typical blocks
+    carry a handful of endorser identities); otherwise return it
+    unchanged.  Saves 64B/signature of host->device transfer."""
+    qx, qy = packed["qx"], packed["qy"]
+    cols = np.concatenate([qx, qy]).T  # (B, 16) words per key
+    uniq, idx = np.unique(cols, axis=0, return_inverse=True)
+    if uniq.shape[0] > max_keys:
+        return packed
+    ktab = np.zeros((max_keys, 16), np.uint32)
+    ktab[: uniq.shape[0]] = uniq
+    out = {k: v for k, v in packed.items() if k not in ("qx", "qy")}
+    out["ktabx"] = np.ascontiguousarray(ktab[:, :8].T)
+    out["ktaby"] = np.ascontiguousarray(ktab[:, 8:].T)
+    out["kidx"] = idx.astype(np.uint32)
+    return out
 
 
 def _pack_words(limbs_bn: np.ndarray) -> np.ndarray:

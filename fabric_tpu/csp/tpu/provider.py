@@ -194,11 +194,16 @@ class TPUCSP(CSP):
                         )
                         for k, v in sl.items()
                     }
-                pending.append((pallas_ec.verify_packed(sl), keep))
+                pending.append(
+                    (pallas_ec.verify_packed(pallas_ec.dedup_keys(sl)), keep)
+                )
         else:
             for chunk, keep in chunks():
                 packed = pallas_ec.prepare_packed(chunk)
-                pending.append((pallas_ec.verify_packed(packed), keep))
+                pending.append(
+                    (pallas_ec.verify_packed(pallas_ec.dedup_keys(packed)),
+                     keep)
+                )
         def collect_all():
             results = []
             for collect, keep in pending:

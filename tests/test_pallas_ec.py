@@ -138,3 +138,42 @@ def test_cand1_branch_r_plus_n():
         **{k: prep_bad[k] for k in keys}
     )
     assert list(got_bad) == [True, True, False]
+
+
+def test_dedup_keys_layout_and_parity():
+    """dedup_keys collapses repeated public keys into the shared table
+    layout; the dedup kernel variant returns the same mask as the
+    per-lane kernel and the host oracle."""
+    rng = random.Random(21)
+    csp = SWCSP()
+    keys = [csp.key_gen() for _ in range(3)]
+    items = []
+    for i in range(9):
+        key = keys[i % 3]
+        digest = csp.hash(b"dedup-%d" % i)
+        r, s = api.unmarshal_ecdsa_signature(csp.sign(key, digest))
+        if i == 4:
+            r += 1  # tampered lane
+        pub = key.public_key()
+        items.append((pub.x, pub.y, digest, r, s))
+    packed = pallas_ec.prepare_packed(items)
+    ded = pallas_ec.dedup_keys(packed)
+    assert "kidx" in ded and ded["ktabx"].shape == (8, pallas_ec.KEYTAB)
+    # key indices repeat with period 3 and reference identical table rows
+    idx = ded["kidx"]
+    assert (idx[:3] == idx[3:6]).all() and (idx[:3] == idx[6:9]).all()
+    got = pallas_ec.verify_packed(ded)()
+    ref = pallas_ec.verify_packed(packed)()
+    assert (got == ref).all()
+    assert list(got) == [True] * 4 + [False] + [True] * 4
+
+    # too many distinct keys: layout unchanged (per-lane path)
+    many = [csp.key_gen() for _ in range(5)]
+    items2 = []
+    for i, key in enumerate(many):
+        digest = csp.hash(b"many-%d" % i)
+        r, s = api.unmarshal_ecdsa_signature(csp.sign(key, digest))
+        pub = key.public_key()
+        items2.append((pub.x, pub.y, digest, r, s))
+    packed2 = pallas_ec.prepare_packed(items2)
+    assert "kidx" not in pallas_ec.dedup_keys(packed2, max_keys=4)
