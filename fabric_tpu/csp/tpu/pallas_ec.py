@@ -573,8 +573,8 @@ def _kernel_dedup(ktabx_ref, ktaby_ref, kidx_ref, d1_ref, d2_ref, c0_ref,
         r512_ref[:], subc_ref[:], plimbs_ref[:],
     )
     blk = kidx_ref.shape[-1]
-    tx = _unpack_words_wide(ktabx_ref)  # (17, KEYTAB)
-    ty = _unpack_words_wide(ktaby_ref)
+    tx = _unpack_words(ktabx_ref)  # (17, KEYTAB); shape-agnostic helper
+    ty = _unpack_words(ktaby_ref)
     idx = kidx_ref[0:1].astype(jnp.int32)  # (1, blk)
     iota = jax.lax.broadcasted_iota(jnp.int32, (KEYTAB, blk), 0)
     oh = (iota == idx).astype(jnp.float32)  # (KEYTAB, blk)
@@ -583,18 +583,6 @@ def _kernel_dedup(ktabx_ref, ktaby_ref, kidx_ref, d1_ref, d2_ref, c0_ref,
     _kernel_body(fp, qx, qy, d1_ref, d2_ref, c0_ref, flags_ref,
                  nlimbs_ref, gx_ref, gy_ref, out_ref,
                  tabx, taby, tabz, tabinf)
-
-
-def _unpack_words_wide(wref):
-    """(8, U) 32-bit words -> (17, U) canonical limbs (same layout rule
-    as _unpack_words)."""
-    w = wref[:]
-    rows = []
-    for i in range(8):
-        rows.append(w[i:i + 1] & jnp.uint32(MASK))
-        rows.append(w[i:i + 1] >> jnp.uint32(LIMB_BITS))
-    rows.append(jnp.zeros_like(rows[0]))
-    return jnp.concatenate(rows, axis=0)
 
 
 def _kernel_body(fp, qx, qy, d1_ref, d2_ref, c0_ref, flags_ref,
@@ -692,7 +680,16 @@ def _kernel_body(fp, qx, qy, d1_ref, d2_ref, c0_ref, flags_ref,
     m1 = matches(cand1)
     cand1_ok = flags_ref[0:1].astype(jnp.int32)
     valid = flags_ref[1:2].astype(jnp.int32)
-    ok = jnp.minimum(m0 + m1 * cand1_ok, 1) * (1 - jnp.minimum(inf, 1)) * valid
+    # z == 0 means the ladder degenerated (possible only for
+    # out-of-group inputs, e.g. an off-curve or zero public key); the
+    # x(R) check would then compare 0 == cand*0 and accept everything,
+    # so such lanes are forced invalid (defense in depth — the host
+    # stack never feeds off-curve keys).
+    z_ok = 1 - fp.is_zero(z)
+    ok = (
+        jnp.minimum(m0 + m1 * cand1_ok, 1)
+        * (1 - jnp.minimum(inf, 1)) * z_ok * valid
+    )
     # (1, 8, BLK) block: row dim padded to the TPU sublane tile
     out_ref[:] = jnp.broadcast_to(
         ok.astype(jnp.uint32)[None], out_ref.shape
@@ -940,17 +937,22 @@ def verify_packed(packed: dict, blk: int = BLK,
     return collect
 
 
-def dedup_keys(packed: dict, max_keys: int = KEYTAB) -> dict:
+def dedup_keys(packed: dict) -> dict:
     """Rewrite a packed dict into the deduplicated-key layout when the
-    batch uses at most `max_keys` distinct public keys (typical blocks
-    carry a handful of endorser identities); otherwise return it
-    unchanged.  Saves 64B/signature of host->device transfer."""
+    batch uses at most KEYTAB distinct public keys (typical blocks carry
+    a handful of endorser identities); otherwise return it unchanged.
+    Saves 64B/signature of host->device transfer.
+
+    The table shape is pinned to (8, KEYTAB): the kernel's one-hot is
+    hard-wired to KEYTAB lanes, and an index outside it would select the
+    zero point — which the kernel's z==0 guard rejects, but the layout
+    never produces such an index in the first place."""
     qx, qy = packed["qx"], packed["qy"]
     cols = np.concatenate([qx, qy]).T  # (B, 16) words per key
     uniq, idx = np.unique(cols, axis=0, return_inverse=True)
-    if uniq.shape[0] > max_keys:
+    if uniq.shape[0] > KEYTAB:
         return packed
-    ktab = np.zeros((max_keys, 16), np.uint32)
+    ktab = np.zeros((KEYTAB, 16), np.uint32)
     ktab[: uniq.shape[0]] = uniq
     out = {k: v for k, v in packed.items() if k not in ("qx", "qy")}
     out["ktabx"] = np.ascontiguousarray(ktab[:, :8].T)

@@ -173,30 +173,36 @@ class TPUCSP(CSP):
         packed_all = self._marshal_native(items)
         pending = []
         if packed_all is not None:
+            # one np.unique + one key-table upload for the whole batch;
+            # chunks slice only the per-lane arrays (the shared ktab
+            # rides along by reference)
+            packed_all = pallas_ec.dedup_keys(packed_all)
+            shared = ("ktabx", "ktaby")
             n = len(items)
             bsz = _bucket(n, _BATCH_BUCKETS)
             for off in range(0, n, bsz):
-                sl = {
-                    k: (v[:, off:off + bsz] if v.ndim == 2
-                        else v[off:off + bsz])
-                    for k, v in packed_all.items()
-                }
+                sl = {}
+                for k, v in packed_all.items():
+                    if k in shared:
+                        sl[k] = v
+                    elif v.ndim == 2:
+                        sl[k] = v[:, off:off + bsz]
+                    else:
+                        sl[k] = v[off:off + bsz]
                 keep = sl["valid"].shape[0]
                 if keep < bsz:
                     # zero-pad (valid=False lanes) to the bucket size so
                     # every chunk reuses the same compiled kernel shape
                     sl = {
-                        k: np.concatenate(
+                        k: (v if k in shared else np.concatenate(
                             [v, np.zeros(
                                 v.shape[:-1] + (bsz - keep,), v.dtype
                             )],
                             axis=-1,
-                        )
+                        ))
                         for k, v in sl.items()
                     }
-                pending.append(
-                    (pallas_ec.verify_packed(pallas_ec.dedup_keys(sl)), keep)
-                )
+                pending.append((pallas_ec.verify_packed(sl), keep))
         else:
             for chunk, keep in chunks():
                 packed = pallas_ec.prepare_packed(chunk)

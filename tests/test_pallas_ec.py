@@ -167,13 +167,12 @@ def test_dedup_keys_layout_and_parity():
     assert (got == ref).all()
     assert list(got) == [True] * 4 + [False] + [True] * 4
 
-    # too many distinct keys: layout unchanged (per-lane path)
-    many = [csp.key_gen() for _ in range(5)]
-    items2 = []
-    for i, key in enumerate(many):
-        digest = csp.hash(b"many-%d" % i)
-        r, s = api.unmarshal_ecdsa_signature(csp.sign(key, digest))
-        pub = key.public_key()
-        items2.append((pub.x, pub.y, digest, r, s))
-    packed2 = pallas_ec.prepare_packed(items2)
-    assert "kidx" not in pallas_ec.dedup_keys(packed2, max_keys=4)
+    # zero/off-curve key lanes must NOT verify (the kernel's z==0
+    # guard; without it a degenerate ladder compares 0 == cand*0 and
+    # accepts anything)
+    zk = pallas_ec.prepare_packed(
+        [(0, 0, csp.hash(b"zk"), 5, 7)]
+    )
+    assert list(pallas_ec.verify_packed(zk)()) == [False]
+    ded_zk = pallas_ec.dedup_keys(zk)
+    assert list(pallas_ec.verify_packed(ded_zk)()) == [False]
