@@ -310,6 +310,85 @@ def cmd_lifecycle_querycommitted(args) -> int:
     return 0
 
 
+def cmd_node_pause(args) -> int:
+    from fabric_tpu.ledger import admin
+
+    admin.pause(args.root, args.channel)
+    print(f"channel {args.channel} paused")
+    return 0
+
+
+def cmd_node_resume(args) -> int:
+    from fabric_tpu.ledger import admin
+
+    admin.resume(args.root, args.channel)
+    print(f"channel {args.channel} resumed")
+    return 0
+
+
+def cmd_node_upgrade_dbs(args) -> int:
+    from fabric_tpu.ledger import admin
+
+    rebuilt = admin.upgrade_dbs(args.root)
+    print("up to date" if not rebuilt else f"rebuilt: {', '.join(rebuilt)}")
+    return 0
+
+
+def cmd_channel_create(args) -> int:
+    """Create a channel: submit its genesis block to the orderer's
+    channel-participation API (the reference's post-system-channel flow:
+    osnadmin channel join / channelparticipation restapi.go)."""
+    with open(args.file, "rb") as f:
+        raw = f.read()
+    out = RPCClient(*parse_endpoint(args.orderer)).call(
+        "participation.Join", raw
+    )
+    print(f"channel {out.decode()} created")
+    return 0
+
+
+def cmd_channel_update(args) -> int:
+    """Submit a signed CONFIG_UPDATE envelope (reference peer channel
+    update)."""
+    from fabric_tpu.protos.orderer import ab_pb2
+
+    with open(args.file, "rb") as f:
+        raw = f.read()
+    resp = ab_pb2.BroadcastResponse.FromString(
+        RPCClient(*parse_endpoint(args.orderer)).call("ab.Broadcast", raw)
+    )
+    print(f"update status: {resp.status}")
+    return 0 if resp.status == 200 else 1
+
+
+def cmd_channel_signconfigtx(args) -> int:
+    """Add this identity's signature to a config-update envelope in
+    place (reference peer channel signconfigtx)."""
+    from fabric_tpu import protoutil
+    from fabric_tpu.protos.common import configtx_pb2
+
+    signer = load_signer(args.msp_dir, args.mspid)
+    with open(args.file, "rb") as f:
+        env = common_pb2.Envelope.FromString(f.read())
+    payload = common_pb2.Payload.FromString(env.payload)
+    cue = configtx_pb2.ConfigUpdateEnvelope.FromString(payload.data)
+    shdr = protoutil.make_signature_header(
+        signer.serialize(), protoutil.random_nonce()
+    ).SerializeToString()
+    sig = cue.signatures.add()
+    sig.signature_header = shdr
+    sig.signature = signer.sign(shdr + cue.config_update)
+    payload.data = cue.SerializeToString()
+    env = common_pb2.Envelope(
+        payload=payload.SerializeToString(),
+        signature=signer.sign(payload.SerializeToString()),
+    )
+    with open(args.file, "wb") as f:
+        f.write(env.SerializeToString())
+    print(f"signed config update as {args.mspid}")
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="peer")
     sub = ap.add_subparsers(dest="cmd", required=True)
@@ -335,11 +414,34 @@ def main(argv=None) -> int:
     ro.add_argument("-c", "--channel", required=True)
     ro.add_argument("-b", "--block-number", type=int, required=True)
     ro.set_defaults(fn=cmd_node_rollback)
+    for opname, fn in (("pause", cmd_node_pause), ("resume", cmd_node_resume)):
+        op = node.add_parser(opname)
+        op.add_argument("--root", required=True)
+        op.add_argument("-c", "--channel", required=True)
+        op.set_defaults(fn=fn)
+    ud = node.add_parser("upgrade-dbs")
+    ud.add_argument("--root", required=True)
+    ud.set_defaults(fn=cmd_node_upgrade_dbs)
     rs = node.add_parser("reset")
     rs.add_argument("--root", required=True)
     rs.set_defaults(fn=cmd_node_reset)
 
     chan = sub.add_parser("channel").add_subparsers(dest="sub", required=True)
+    create = chan.add_parser("create")
+    create.add_argument("-f", "--file", required=True,
+                        help="genesis block for the new channel")
+    create.add_argument("--orderer", required=True)
+    create.set_defaults(fn=cmd_channel_create)
+    upd = chan.add_parser("update")
+    upd.add_argument("-f", "--file", required=True,
+                     help="signed CONFIG_UPDATE envelope")
+    upd.add_argument("--orderer", required=True)
+    upd.set_defaults(fn=cmd_channel_update)
+    sct = chan.add_parser("signconfigtx")
+    sct.add_argument("-f", "--file", required=True)
+    sct.add_argument("--mspid", required=True)
+    sct.add_argument("--msp-dir", required=True)
+    sct.set_defaults(fn=cmd_channel_signconfigtx)
     join = chan.add_parser("join")
     join.add_argument("--block", required=True)
     join.add_argument("--peer", required=True)

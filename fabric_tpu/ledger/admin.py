@@ -133,3 +133,64 @@ def verify_rebuild(root_dir: str, ledger_id: str) -> int:
 
 __all__ = ["rebuild_dbs", "rollback", "reset", "list_channels",
            "verify_rebuild"]
+
+
+# -- pause / resume / upgrade-dbs (reference internal/peer/node/
+# {pause,resume,upgrade_dbs}.go) --------------------------------------------
+
+_PAUSED_KEY = b"admin/paused/"
+# Data-format version stamp (reference dataformat.Version checks in
+# kvledger upgrade_dbs): bump when derived-DB encodings change.
+DATA_FORMAT_VERSION = b"fabric-tpu/2.0"
+_FORMAT_KEY = b"admin/dataformat"
+
+
+def pause(root_dir: str, ledger_id: str) -> None:
+    """Mark a channel paused: the peer skips it at startup until resume
+    (reference pauseChannelCmd -> kvledger.PauseChannel)."""
+    kv = _open_kv(root_dir)
+    try:
+        kv.put(_PAUSED_KEY + ledger_id.encode(), b"1")
+    finally:
+        kv.close()
+
+
+def resume(root_dir: str, ledger_id: str) -> None:
+    kv = _open_kv(root_dir)
+    try:
+        kv.delete(_PAUSED_KEY + ledger_id.encode())
+    finally:
+        kv.close()
+
+
+def paused_channels(root_dir: str) -> set[str]:
+    kv = _open_kv(root_dir)
+    try:
+        return {
+            k[len(_PAUSED_KEY):].decode()
+            for k, _ in kv.iterate(_PAUSED_KEY, _PAUSED_KEY + b"\xff")
+        }
+    finally:
+        kv.close()
+
+
+def upgrade_dbs(root_dir: str) -> list[str]:
+    """Upgrade derived databases to the current data format: when the
+    stored format stamp differs, drop + rebuild every derived DB from
+    the block store (the reference's upgradeDBs resets statedb/history/
+    etc. and replays; rebuild_dbs is exactly that) and stamp the new
+    version."""
+    kv = _open_kv(root_dir)
+    try:
+        current = kv.get(_FORMAT_KEY)
+    finally:
+        kv.close()
+    if current == DATA_FORMAT_VERSION:
+        return []
+    rebuilt = rebuild_dbs(root_dir)
+    kv = _open_kv(root_dir)
+    try:
+        kv.put(_FORMAT_KEY, DATA_FORMAT_VERSION)
+    finally:
+        kv.close()
+    return rebuilt

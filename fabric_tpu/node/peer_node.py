@@ -181,8 +181,13 @@ class PeerNode:
         # joined (reference ledgermgmt.NewLedgerMgr opens all ledger ids;
         # internal/peer/node/start.go re-initializes each channel)
         if os.path.isdir(root_dir):
+            from fabric_tpu.ledger import admin as ledger_admin
+
+            paused = ledger_admin.paused_channels(root_dir)
             for entry in sorted(os.listdir(root_dir)):
                 if not os.path.isdir(os.path.join(root_dir, entry, "chains")):
+                    continue
+                if entry in paused:  # `peer node resume` re-enables
                     continue
                 ledger = self.provider.open(entry)
                 genesis = ledger.get_block_by_number(0)

@@ -1,0 +1,89 @@
+"""peer channel create/update/signconfigtx + node pause CLI flows."""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from orgfix import make_org
+
+from fabric_tpu.common import configtx_builder as ctx
+from fabric_tpu.msp import msp_config_from_ca
+from fabric_tpu.node.orderer_node import OrdererNode
+from fabric_tpu.protos.common import common_pb2, configtx_pb2
+
+
+@pytest.fixture
+def world(tmp_path):
+    org = make_org("Org1MSP")
+    oorg = make_org("OrdererMSP")
+    app = ctx.application_group(
+        {"Org1": ctx.org_group("Org1MSP", msp_config_from_ca(org.ca, "Org1MSP"))}
+    )
+    ordg = ctx.orderer_group(
+        {"O": ctx.org_group("OrdererMSP", msp_config_from_ca(oorg.ca, "OrdererMSP"))},
+        consensus_type="solo",
+    )
+    genesis = ctx.genesis_block("clich", ctx.channel_group(app, ordg))
+    node = OrdererNode(str(tmp_path / "orderer"), org.csp, signer=None)
+    node.start()
+    yield org, genesis, node, tmp_path
+    node.stop()
+
+
+def test_channel_create_via_participation(world):
+    from fabric_tpu.cmd.peer import main
+
+    org, genesis, node, tmp_path = world
+    gpath = str(tmp_path / "clich.block")
+    with open(gpath, "wb") as f:
+        f.write(genesis.SerializeToString())
+    rc = main([
+        "channel", "create", "-f", gpath,
+        "--orderer", "%s:%d" % node.addr,
+    ])
+    assert rc == 0
+
+
+def test_signconfigtx_appends_signature(world, tmp_path):
+    from fabric_tpu.cmd.peer import main
+
+    org, genesis, node, base = world
+    # write the org's MSP dir for load_signer
+    mspdir = tmp_path / "msp"
+    pair = org.issue("admin1", ous=["admin"])
+    os.makedirs(mspdir / "signcerts")
+    os.makedirs(mspdir / "keystore")
+    (mspdir / "signcerts" / "cert.pem").write_bytes(pair.cert_pem)
+    (mspdir / "keystore" / "key.pem").write_bytes(pair.key_pem)
+
+    cue = configtx_pb2.ConfigUpdateEnvelope(config_update=b"update-bytes")
+    payload = common_pb2.Payload(data=cue.SerializeToString())
+    env = common_pb2.Envelope(payload=payload.SerializeToString())
+    fpath = str(tmp_path / "update.pb")
+    with open(fpath, "wb") as f:
+        f.write(env.SerializeToString())
+
+    rc = main([
+        "channel", "signconfigtx", "-f", fpath,
+        "--mspid", "Org1MSP", "--msp-dir", str(mspdir),
+    ])
+    assert rc == 0
+    env2 = common_pb2.Envelope.FromString(open(fpath, "rb").read())
+    p2 = common_pb2.Payload.FromString(env2.payload)
+    cue2 = configtx_pb2.ConfigUpdateEnvelope.FromString(p2.data)
+    assert len(cue2.signatures) == 1
+    assert cue2.signatures[0].signature
+    assert env2.signature  # envelope re-signed by the signer
+    # signing twice appends a second signature
+    rc = main([
+        "channel", "signconfigtx", "-f", fpath,
+        "--mspid", "Org1MSP", "--msp-dir", str(mspdir),
+    ])
+    assert rc == 0
+    env3 = common_pb2.Envelope.FromString(open(fpath, "rb").read())
+    cue3 = configtx_pb2.ConfigUpdateEnvelope.FromString(
+        common_pb2.Payload.FromString(env3.payload).data
+    )
+    assert len(cue3.signatures) == 2

@@ -198,3 +198,23 @@ def test_cached_msp_memoizes():
     cached.validate(i1)
     cached.validate(i2)
     assert calls["val"] == 1
+
+
+def test_pause_resume_and_upgrade_dbs(tmp_path):
+    """pause/resume markers + data-format stamp (reference
+    internal/peer/node/{pause,resume,upgrade_dbs}.go)."""
+    from fabric_tpu.ledger import admin
+
+    root = str(tmp_path / "peer")
+    import os
+
+    os.makedirs(root)
+    # seed a dummy index store via pause itself
+    admin.pause(root, "ch1")
+    admin.pause(root, "ch2")
+    assert admin.paused_channels(root) == {"ch1", "ch2"}
+    admin.resume(root, "ch1")
+    assert admin.paused_channels(root) == {"ch2"}
+    # upgrade stamps the format; second run is a no-op
+    admin.upgrade_dbs(root)
+    assert admin.upgrade_dbs(root) == []
