@@ -3,74 +3,96 @@
 Prints exactly ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 
-North-star metric (BASELINE.md): batched ECDSA-P256 verification throughput
-— the data plane under committed-tx/s at 1000-tx blocks.  Baseline is the
-host per-signature verify loop (the reference's bccsp/sw semantics:
-sequential `ecdsa.Verify` per endorsement, bccsp/sw/ecdsa.go:41 +
-common/policies/policy.go:365-402); the measured value is the TPU batch
-kernel (fabric_tpu/csp/tpu/ec.py) on the same signatures.
+North-star metric (BASELINE.json / BASELINE.md): **committed tx/s** for
+1000-tx blocks under a 3-of-5 (MAJORITY over 5 orgs) endorsement policy
+through the pipelined txvalidator with the TPU batch-verify backend.
+Baseline is the *faithful* reference-shaped host path: sequential
+per-signature `ecdsa.Verify` with every sub-policy re-verifying its
+signatures per tx and no verify-item interning or endorsement-plan
+caching (bccsp/sw/ecdsa.go:41 + common/policies/policy.go:365-402 +
+core/committer/txvalidator/v20/validator.go:180-265 semantics).
 """
 
 from __future__ import annotations
 
 import json
+import os
+import sys
 import time
 
-
-def make_items(n: int):
-    from fabric_tpu.csp import SWCSP, VerifyBatchItem
-
-    csp = SWCSP()
-    keys = [csp.key_gen() for _ in range(min(n, 64))]
-    items = []
-    for i in range(n):
-        key = keys[i % len(keys)]
-        d = csp.hash(b"bench-tx-%d" % i)
-        items.append(VerifyBatchItem(key.public_key(), d, csp.sign(key, d)))
-    return csp, items
+_ROOT = os.path.dirname(os.path.abspath(__file__))
 
 
-def bench_host(csp, items, repeat: int = 1) -> float:
-    t0 = time.perf_counter()
-    for _ in range(repeat):
-        ok = csp.verify_batch(items)
-    dt = (time.perf_counter() - t0) / repeat
-    assert all(ok)
-    return len(items) / dt
-
-
-def bench_tpu(items, repeat: int = 5) -> float:
-    from fabric_tpu.csp.tpu.provider import TPUCSP
-
-    csp = TPUCSP(min_device_batch=1)
-    ok = csp.verify_batch(items)  # warm-up: compile
-    assert all(ok)
-    best = float("inf")
-    for _ in range(repeat):
-        t0 = time.perf_counter()
-        ok = csp.verify_batch(items)
-        best = min(best, time.perf_counter() - t0)
-    assert all(ok)
-    return len(items) / best
+def _setup_path() -> None:
+    for p in (_ROOT, os.path.join(_ROOT, "scripts"), os.path.join(_ROOT, "tests")):
+        if p not in sys.path:
+            sys.path.insert(0, p)
 
 
 def main() -> None:
-    n = 32768
-    csp, items = make_items(n)
-    host = bench_host(csp, items[:512])
+    _setup_path()
+    from bench_pipeline import _build_world, _make_blocks
+
+    from fabric_tpu.csp import SWCSP
+    from fabric_tpu.peer.txvalidator import TxValidator
+    from fabric_tpu.protos.common import common_pb2
+
+    n_txs, n_blocks = 1000, 4
+    sw = SWCSP()
+    orgs, genesis = _build_world(5)
+    ledger, bundle, blocks = _make_blocks(orgs, genesis, sw, n_txs, 3, n_blocks)
+
+    def copies(k):
+        out = []
+        for j in range(k):
+            b = common_pb2.Block()
+            b.CopyFrom(blocks[j % n_blocks])
+            out.append(b)
+        return out
+
+    # Faithful reference-shaped host baseline (slow by design — that is
+    # the point of the comparison).  Warmed + best-of-2 so process
+    # warm-up (EC backend init, native lib load, proto class setup) is
+    # not charged to the baseline.
+    vf = TxValidator("benchch", ledger, bundle, sw, faithful=True)
+    vf.validate(copies(1)[0])  # warm-up
+    base_best = float("inf")
+    for _ in range(2):
+        (b,) = copies(1)
+        t0 = time.perf_counter()
+        flags = vf.validate(b)
+        base_best = min(base_best, time.perf_counter() - t0)
+        assert all(f == 0 for f in flags)
+    baseline = n_txs / base_best
+
+    # Measured: pipelined committed tx/s with the TPU backend (falls
+    # back to the optimized host path when no device is reachable).
     try:
-        tpu = bench_tpu(items)
-        value, unit = tpu, "sigs/s"
+        from fabric_tpu.csp.tpu.provider import TPUCSP
+
+        csp = TPUCSP(min_device_batch=1)
+        warm = TxValidator("benchch", ledger, bundle, csp)
+        warm.validate(copies(1)[0])  # compile + first transfer
     except Exception:
-        # Device unavailable: report the host baseline (vs_baseline = 1).
-        value, unit = host, "sigs/s"
+        csp = sw
+
+    best = float("inf")
+    for _ in range(3):
+        v = TxValidator("benchch", ledger, bundle, csp)
+        bs = copies(n_blocks)
+        t0 = time.perf_counter()
+        for flags in v.validate_pipeline(iter(bs), depth=3):
+            assert all(f == 0 for f in flags)
+        best = min(best, time.perf_counter() - t0)
+    value = n_blocks * n_txs / best
+
     print(
         json.dumps(
             {
-                "metric": "ecdsa_p256_batch_verify_throughput",
+                "metric": "committed_tx_per_s_1000tx_3of5_pipelined",
                 "value": round(value, 2),
-                "unit": unit,
-                "vs_baseline": round(value / host, 3),
+                "unit": "tx/s",
+                "vs_baseline": round(value / baseline, 3),
             }
         )
     )

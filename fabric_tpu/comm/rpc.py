@@ -1,7 +1,7 @@
-"""Minimal framed RPC over TCP.
+"""Minimal framed RPC over TCP, with optional (mutual) TLS.
 
 The reference's universal substrate is gRPC over mutual TLS
-(internal/pkg/comm/server.go, client.go).  This is the same
+(internal/pkg/comm/server.go:56, client.go).  This is the same
 architectural role with a deliberately small wire format:
 
     frame   := uint32_be length | payload
@@ -10,15 +10,24 @@ architectural role with a deliberately small wire format:
 
 A handler returns bytes (unary: one DATA + END), an iterator of bytes
 (server streaming: DATA per item + END), or raises (ERR with message).
-Authentication rides in the payloads themselves (signed envelopes /
-SignedProposals, exactly as the reference checks creator signatures at
-the application layer on top of TLS).
-"""
+Authentication above the transport rides in the payloads themselves
+(signed envelopes / SignedProposals, exactly as the reference checks
+creator signatures at the application layer on top of TLS).
+
+TLS: pass a `comm.tls.TLSCredentials` to RPCServer/RPCClient.  The
+server performs its handshake in the per-connection handler thread (a
+slow or malicious client cannot stall the accept loop), demands a
+client cert when `require_client_auth` (mutual TLS), and rejects peers
+failing the optional pinned-cert allowlist (the orderer cluster scheme,
+orderer/common/cluster/comm.go:116).  Handlers see the authenticated
+peer certificate via `Stream.peer_cert` (DER), which the gossip layer
+binds into its signed handshake."""
 
 from __future__ import annotations
 
 import socket
 import socketserver
+import ssl
 import struct
 import threading
 
@@ -60,10 +69,12 @@ def write_frame(sock, payload: bytes) -> None:
 class Stream:
     """Server-side handle for bidirectional-ish methods: the handler may
     read further client frames (e.g. a deliver SeekInfo stream) and send
-    DATA frames incrementally."""
+    DATA frames incrementally.  `peer_cert` is the TLS-authenticated
+    client certificate (DER) or None on plaintext connections."""
 
-    def __init__(self, sock):
+    def __init__(self, sock, peer_cert: bytes | None = None):
         self._sock = sock
+        self.peer_cert = peer_cert
 
     def send(self, body: bytes) -> None:
         write_frame(self._sock, bytes([KIND_DATA]) + body)
@@ -76,6 +87,23 @@ class _Handler(socketserver.BaseRequestHandler):
     def handle(self):
         server: RPCServer = self.server.rpc  # type: ignore[attr-defined]
         sock = self.request
+        peer_cert: bytes | None = None
+        if server.tls is not None:
+            # Handshake here, in the per-connection thread — the accept
+            # loop stays responsive regardless of handshake latency.
+            try:
+                sock = server.ssl_context.wrap_socket(sock, server_side=True)
+            except (ssl.SSLError, OSError):
+                return
+            peer_cert = sock.getpeercert(binary_form=True)
+            if not server.tls.check_pinned(peer_cert):
+                try:
+                    write_frame(
+                        sock, bytes([KIND_ERR]) + b"certificate not pinned"
+                    )
+                finally:
+                    sock.close()
+                return
         try:
             frame = read_frame(sock)
             if frame is None or not frame:
@@ -90,7 +118,7 @@ class _Handler(socketserver.BaseRequestHandler):
                 )
                 return
             try:
-                out = fn(body, Stream(sock))
+                out = fn(body, Stream(sock, peer_cert))
             except Exception as exc:  # noqa: BLE001 — error surface to client
                 try:
                     write_frame(
@@ -127,8 +155,10 @@ class _ThreadingServer(socketserver.ThreadingTCPServer):
 class RPCServer:
     """method name -> handler(body: bytes, stream: Stream)."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, tls=None):
         self.methods: dict = {}
+        self.tls = tls  # comm.tls.TLSCredentials | None
+        self.ssl_context = tls.server_context() if tls is not None else None
         self._srv = _ThreadingServer((host, port), _Handler)
         self._srv.rpc = self  # type: ignore[attr-defined]
         self._thread: threading.Thread | None = None
@@ -193,12 +223,32 @@ class RPCServer:
 
 
 class RPCClient:
-    def __init__(self, host: str, port: int, timeout: float = 10.0):
+    def __init__(self, host: str, port: int, timeout: float = 10.0,
+                 tls=None, server_hostname: str | None = None):
         self._addr = (host, port)
         self._timeout = timeout
+        self._tls = tls  # comm.tls.TLSCredentials | None
+        self._server_hostname = server_hostname
+        self._ssl_context = (
+            tls.client_context(server_hostname) if tls is not None else None
+        )
 
     def _connect(self, method: str, body: bytes):
         sock = socket.create_connection(self._addr, timeout=self._timeout)
+        if self._ssl_context is not None:
+            try:
+                sock = self._ssl_context.wrap_socket(
+                    sock, server_hostname=self._server_hostname or self._addr[0]
+                )
+                peer = sock.getpeercert(binary_form=True)
+                if not self._tls.check_pinned(peer):
+                    raise RPCError("server certificate not pinned")
+            except (ssl.SSLError, OSError) as exc:
+                sock.close()
+                raise RPCError(f"tls handshake failed: {exc}") from exc
+            except RPCError:
+                sock.close()
+                raise
         m = method.encode("utf-8")
         write_frame(sock, bytes([len(m)]) + m + body)
         return sock

@@ -142,7 +142,15 @@ class CA:
             )
             .add_extension(x509.SubjectKeyIdentifier(_ski(key.public_key())), critical=False)
             .add_extension(
-                x509.AuthorityKeyIdentifier.from_issuer_public_key(self.key.public_key()),
+                # keyid must equal the issuer's (sha256-based) SKI —
+                # OpenSSL rejects chain candidates on keyid mismatch,
+                # so derive it from the CA cert's actual extension
+                # rather than from_issuer_public_key's sha1 form
+                x509.AuthorityKeyIdentifier.from_issuer_subject_key_identifier(
+                    self.cert.extensions.get_extension_for_class(
+                        x509.SubjectKeyIdentifier
+                    ).value
+                ),
                 critical=False,
             )
         )

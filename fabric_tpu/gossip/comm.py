@@ -177,10 +177,29 @@ class InProcGossipComm(GossipComm):
 
 class TCPGossipComm(GossipComm):
     """Real deployment transport: one listener; outbound connections cached
-    per endpoint; ConnEstablish handshake exchanges identities."""
+    per endpoint; ConnEstablish handshake exchanges identities.
 
-    def __init__(self, listen_addr: tuple[str, int], self_identity: bytes, mcs=None):
+    With `tls` (comm.tls.TLSCredentials) every stream runs over mutual
+    TLS and the handshake binds the TLS session to the signed gossip
+    identity: each side puts the SHA-256 of its own TLS leaf in
+    ConnEstablish.tls_cert_hash and signs pki_id || tls_cert_hash; the
+    receiver recomputes the hash from the certificate the TLS layer
+    actually authenticated (reference gossip/comm/crypto.go:20-40 used
+    by comm_impl.go:60 authenticateRemotePeer), so a handshake replayed
+    over a different TLS session is rejected."""
+
+    def __init__(self, listen_addr: tuple[str, int], self_identity: bytes,
+                 mcs=None, tls=None):
         super().__init__(self_identity, mcs)
+        if tls is not None and not tls.require_client_auth:
+            # without a client cert there is nothing to bind the signed
+            # handshake to — gossip TLS is mutual or nothing, as in the
+            # reference (comm_impl.go extractCertificateHashFromContext)
+            raise ValueError("gossip TLS requires require_client_auth=True")
+        self._tls = tls
+        self._server_ctx = tls.server_context() if tls is not None else None
+        self._client_ctx = tls.client_context() if tls is not None else None
+        self._cert_hash = tls.cert_hash if tls is not None else b""
         self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._server.bind(listen_addr)
@@ -209,8 +228,11 @@ class TCPGossipComm(GossipComm):
             pass  # gossip is loss-tolerant
 
     def _handshake_frame(self) -> bytes:
-        ce = gpb.ConnEstablish(pki_id=self.pki_id, identity=self.identity)
-        ce.signature = self.mcs.sign(self.pki_id)
+        ce = gpb.ConnEstablish(
+            pki_id=self.pki_id, identity=self.identity,
+            tls_cert_hash=self._cert_hash,
+        )
+        ce.signature = self.mcs.sign(self.pki_id + self._cert_hash)
         raw = ce.SerializeToString()
         return _LEN.pack(len(raw)) + raw
 
@@ -227,6 +249,10 @@ class TCPGossipComm(GossipComm):
                         host, port = endpoint.rsplit(":", 1)
                         sock = socket.create_connection((host, int(port)), timeout=2)
                         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                        if self._client_ctx is not None:
+                            sock = self._client_ctx.wrap_socket(
+                                sock, server_hostname=host
+                            )
                         sock.sendall(self._handshake_frame())
                     except OSError:
                         sock = None
@@ -271,6 +297,13 @@ class TCPGossipComm(GossipComm):
     def _serve(self, conn: socket.socket) -> None:
         buf = bytearray()
         conn.settimeout(60)
+        peer_der: bytes | None = None
+        if self._server_ctx is not None:
+            try:
+                conn = self._server_ctx.wrap_socket(conn, server_side=True)
+                peer_der = conn.getpeercert(binary_form=True)
+            except OSError:  # includes ssl.SSLError
+                return
         try:
             frame = self._read_frame(conn, buf)
             if frame is None:
@@ -278,8 +311,24 @@ class TCPGossipComm(GossipComm):
             ce = gpb.ConnEstablish.FromString(frame)
             if self.mcs.get_pki_id(ce.identity) != ce.pki_id:
                 return  # identity/pki mismatch
-            if ce.signature and not self.mcs.verify(
-                ce.identity, ce.signature, ce.pki_id
+            sig_payload = bytes(ce.pki_id) + bytes(ce.tls_cert_hash)
+            if self._tls is not None:
+                from fabric_tpu.comm.tls import cert_hash_from_der
+
+                # the claimed hash must match the cert the TLS layer
+                # authenticated on THIS session (crypto.go:20-40), and
+                # the binding is only as strong as the signature over
+                # it — an unsigned handshake proves nothing
+                if not peer_der or ce.tls_cert_hash != cert_hash_from_der(
+                    peer_der
+                ):
+                    return
+                if not ce.signature or not self.mcs.verify(
+                    ce.identity, ce.signature, sig_payload
+                ):
+                    return
+            elif ce.signature and not self.mcs.verify(
+                ce.identity, ce.signature, sig_payload
             ):
                 return
             self.learn_identity(ce.identity)

@@ -4,10 +4,18 @@ Reference: gossip/privdata/coordinator.go:149 StoreBlock (txvalidator ->
 pvtdata assembly -> CommitLegacy) + core/committer/committer_impl.go.
 Private-data fetching slots in between validate and commit when the
 pvtdata subsystem lands.
-"""
+
+`store_stream` is the TPU-first throughput path: the validator pipeline
+overlaps host collect with device verify across blocks, and a dedicated
+committer thread overlaps MVCC+persist of block k with collect of
+k+1/k+2 (the reference serializes validate -> commit per block inside
+StoreBlock; deliver clients therefore see commit latency on the
+validation critical path)."""
 
 from __future__ import annotations
 
+import collections
+import queue
 import threading
 import time
 
@@ -45,6 +53,79 @@ class Committer:
         for fn in self._listeners:
             fn(block, flags)
         return flags
+
+    def store_stream(self, blocks, depth: int = 3):
+        """Pipelined validate+commit over a block stream; yields each
+        block's final (post-MVCC) flags in order.
+
+        Three overlapped stages: host collect (validator), device
+        verify (CSP async), and MVCC+persist (this method's committer
+        thread).  Same documented relaxation as validate_pipeline: SBE
+        metadata reads for block k+1 may precede block k's commit;
+        depth=1 restores strict adjacency."""
+        from fabric_tpu import protoutil
+
+        pending: collections.deque = collections.deque()
+        releases: collections.deque = collections.deque()
+
+        def tee(it):
+            for b in it:
+                pending.append(b)
+                yield b
+
+        commit_q: queue.Queue = queue.Queue(maxsize=depth)
+        done_q: queue.Queue = queue.Queue()
+
+        def commit_loop():
+            failed = False
+            while True:
+                item = commit_q.get()
+                if item is None:
+                    return
+                if failed:
+                    continue  # drain without committing past a failure
+                blk, release_txids = item
+                try:
+                    with self._lock:
+                        self._ledger.commit(blk)
+                    # the ledger index now holds these txids: safe to
+                    # close the validator's in-flight dedup window
+                    release_txids()
+                    flags = list(protoutil.tx_filter(blk))
+                    for fn in self._listeners:
+                        fn(blk, flags)
+                    done_q.put(flags)
+                except Exception as e:  # surfaced to the consumer;
+                    # nothing further commits onto suspect state
+                    failed = True
+                    done_q.put(e)
+
+        th = threading.Thread(
+            target=commit_loop, name="committer-stream", daemon=True
+        )
+        th.start()
+        n_in = n_out = 0
+        try:
+            for _flags in self._validator.validate_pipeline(
+                tee(blocks), depth=depth, release=releases.append
+            ):
+                commit_q.put((pending.popleft(), releases.popleft()))
+                n_in += 1
+                while not done_q.empty():
+                    r = done_q.get()
+                    if isinstance(r, Exception):
+                        raise r
+                    n_out += 1
+                    yield r
+            while n_out < n_in:
+                r = done_q.get()
+                if isinstance(r, Exception):
+                    raise r
+                n_out += 1
+                yield r
+        finally:
+            commit_q.put(None)
+            th.join()
 
     @property
     def height(self) -> int:

@@ -25,6 +25,7 @@ the builtin plugin evaluates the channel/chaincode endorsement policy.
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 
 from fabric_tpu.peer.validation_plugins import (
     IllegalWritesetError,
@@ -57,11 +58,15 @@ class _ItemSink:
     EvaluateSignedData).  Here identical triples collapse to ONE device
     lane and every pending keeps index lists into the shared mask."""
 
-    def __init__(self):
+    def __init__(self, dedup: bool = True):
         self.items: list = []
         self._index: dict = {}
+        self._dedup = dedup
 
     def add(self, item) -> int:
+        if not self._dedup:
+            self.items.append(item)
+            return len(self.items) - 1
         k = (item.key.x, item.key.y, item.digest, item.signature)
         i = self._index.get(k)
         if i is None:
@@ -111,13 +116,23 @@ class TxValidator:
         csp,
         definition_provider=None,
         plugin_registry: PluginRegistry | None = None,
+        faithful: bool = False,
     ):
+        """`faithful=True` reproduces the reference's validation cost
+        model for baseline measurement: no verify-item interning, no
+        endorsement-plan caching, and no per-block creator memo, so
+        every sub-policy re-verifies its signatures per tx exactly as
+        common/policies/policy.go:365 does.  (Block digesting still
+        runs in the shared native collect pass — hashing cost is
+        charged identically to both paths.)  Results are identical;
+        only the work amortization differs."""
         self.channel_id = channel_id
         self._ledger = ledger
         self._bundle = bundle
         self._csp = csp
         self._definitions = definition_provider
-        self._registry = plugin_registry or PluginRegistry()
+        self._faithful = faithful
+        self._registry = plugin_registry or PluginRegistry(plans=not faithful)
         self._policy_provider = PolicyProvider(
             bundle.policy_manager, bundle.msp_manager, definition_provider
         )
@@ -135,7 +150,23 @@ class TxValidator:
 
     # -- phase 1: per-tx syntactic validation + collection ----------------
 
-    def _collect_tx(self, env_bytes: bytes, seen_txids: set, sink: _ItemSink, work: _TxWork) -> int:
+    def _creator_identity(self, creator_bytes: bytes, memo: dict):
+        """Deserialize + channel-validate a creator, memoized per block —
+        a 1000-tx block typically carries a handful of distinct client
+        certs, and the per-call MSP cache still pays a lock + LRU
+        shuffle per tx.  Returns None when invalid.  Faithful mode
+        bypasses the memo (the reference pays this per tx)."""
+        if not self._faithful and creator_bytes in memo:
+            return memo[creator_bytes]
+        try:
+            ident = self._bundle.msp_manager.deserialize_identity(creator_bytes)
+            self._bundle.msp_manager.validate(ident)
+        except Exception:
+            ident = None
+        memo[creator_bytes] = ident
+        return ident
+
+    def _collect_tx(self, env_bytes: bytes, seen_txids: set, sink: _ItemSink, work: _TxWork, memo: dict) -> int:
         try:
             env = common_pb2.Envelope.FromString(env_bytes)
             if not env.payload:
@@ -153,10 +184,8 @@ class TxValidator:
             return V.BAD_CHANNEL_HEADER
 
         # creator must deserialize and be valid under a channel MSP
-        try:
-            creator = self._bundle.msp_manager.deserialize_identity(shdr.creator)
-            self._bundle.msp_manager.validate(creator)
-        except Exception:
+        creator = self._creator_identity(shdr.creator, memo)
+        if creator is None:
             return V.BAD_CREATOR_SIGNATURE
         # creator signature over the payload bytes (checkSignatureFromCreator)
         work.creator_item = sink.add(
@@ -222,9 +251,16 @@ class TxValidator:
             if ev.chaincode_id != cc_id:
                 return V.INVALID_OTHER_REASON
 
-        # endorsement policy: each endorsement signs prp_bytes || endorser
+        # endorsement policy: each endorsement signs prp_bytes || endorser.
+        # Digests are precomputed so policy prepare hits the plan cache
+        # (and the device path skips host-side re-hashing).
         signed = [
-            SignedData(prp_bytes + e.endorser, e.endorser, e.signature)
+            SignedData(
+                prp_bytes + e.endorser,
+                e.endorser,
+                e.signature,
+                digest=hashlib.sha256(prp_bytes + e.endorser).digest(),
+            )
             for e in cap.action.endorsements
         ]
         return self._prepare_namespaces(
@@ -236,7 +272,7 @@ class TxValidator:
     def validate(self, block: common_pb2.Block) -> list[int]:
         return self._finish_block(*self._start_block(block, set()))
 
-    def validate_pipeline(self, blocks, depth: int = 2):
+    def validate_pipeline(self, blocks, depth: int = 2, release=None):
         """Pipelined validation: yields per-block flag lists in order,
         keeping up to `depth` blocks in flight so block k+1's host
         collect phase overlaps block k's device verify (the reference
@@ -244,11 +280,14 @@ class TxValidator:
         the TPU build overlaps across blocks instead).
 
         Duplicate-txid detection spans the ledger plus every block still
-        in flight in this pipeline (a block's txids leave the window
-        once its flags are finished — past that point sequential
-        validate-then-commit relies on the ledger index too, so the
-        window is bounded at `depth` blocks without losing detection
-        strength vs the sequential path).
+        in flight in this pipeline.  By default a block's txids leave
+        the window once its flags are finished — correct for callers
+        that commit each block before pulling the next flags.  A caller
+        that commits asynchronously (Committer.store_stream) passes
+        `release`: for every yielded block it receives a zero-arg
+        callable and the txid window stays open until that callable
+        runs (after the commit lands, when ledger.tx_id_exists takes
+        over detection — no gap either way).
         Documented relaxation vs strict serial validation: key-level
         endorsement-policy (SBE) metadata reads for block k+1 see the
         state committed BEFORE block k (k is not committed while k+1
@@ -262,7 +301,11 @@ class TxValidator:
 
         def finish(started):
             flags = self._finish_block(*started[:-1])
-            seen_txids.difference_update(started[-1])  # close the window
+            txids = started[-1]
+            if release is None:
+                seen_txids.difference_update(txids)  # close the window
+            else:
+                release(lambda: seen_txids.difference_update(txids))
             return flags
 
         for block in blocks:
@@ -279,13 +322,17 @@ class TxValidator:
         n = len(block.data.data)
         flags = [V.NOT_VALIDATED] * n
         works = [_TxWork() for _ in range(n)]
-        sink = _ItemSink()
+        sink = _ItemSink(dedup=not self._faithful)
 
-        native = self._collect_native(block, seen_txids, sink, works, flags)
+        memo: dict = {}  # per-block creator-identity memo
+        self._policy_provider.begin_block()
+        native = self._collect_native(
+            block, seen_txids, sink, works, flags, memo
+        )
         if not native:
             for i in range(n):
                 flags[i] = self._collect_tx(
-                    block.data.data[i], seen_txids, sink, works[i]
+                    block.data.data[i], seen_txids, sink, works[i], memo
                 )
 
         collect = (
@@ -316,7 +363,7 @@ class TxValidator:
         -13: V.NIL_TXACTION,
     }
 
-    def _collect_native(self, block, seen_txids, sink: _ItemSink, works, flags) -> bool:
+    def _collect_native(self, block, seen_txids, sink: _ItemSink, works, flags, memo: dict) -> bool:
         """Native-assisted collect: one C++ pass walks every envelope's
         wire format (syntactic checks + SHA-256 digests, collect.cc),
         then this glue does only identity/policy work per tx.  Returns
@@ -346,13 +393,37 @@ class TxValidator:
         def sl(off, ln):
             return buf[off:off + ln]
 
+        # one bulk numpy->python conversion; per-element indexing of
+        # numpy arrays costs a scalar-boxing allocation per access
+        status_l = co["status"].tolist()
+        creator_off_l = co["creator_off"].tolist()
+        creator_len_l = co["creator_len"].tolist()
+        sig_off_l = co["sig_off"].tolist()
+        sig_len_l = co["sig_len"].tolist()
+        txid_off_l = co["txid_off"].tolist()
+        txid_len_l = co["txid_len"].tolist()
+        prp_off_l = co["prp_off"].tolist()
+        prp_len_l = co["prp_len"].tolist()
+        rwset_off_l = co["rwset_off"].tolist()
+        rwset_len_l = co["rwset_len"].tolist()
+        ccid_off_l = co["ccid_off"].tolist()
+        ccid_len_l = co["ccid_len"].tolist()
+        endo_start_l = co["endo_start"].tolist()
+        endo_count_l = co["endo_count"].tolist()
+        ee_off = co["e_endorser_off"].tolist()
+        ee_len = co["e_endorser_len"].tolist()
+        es_off = co["e_sig_off"].tolist()
+        es_len = co["e_sig_len"].tolist()
+
         for i in range(len(data)):
-            st = int(co["status"][i])
+            st = status_l[i]
             if st == -12:  # python fallback for this tx
-                flags[i] = self._collect_tx(data[i], seen_txids, sink, works[i])
+                flags[i] = self._collect_tx(
+                    data[i], seen_txids, sink, works[i], memo
+                )
                 continue
             if st in self._NATIVE_EARLY and not (
-                st == -2 and co["creator_len"][i]
+                st == -2 and creator_len_l[i]
             ):
                 # st == -2 with a creator present is a DEEP parse failure
                 # (tx/cap/prp wire) — those flow through the creator and
@@ -361,13 +432,9 @@ class TxValidator:
                 continue
             # creator deserialize + validate (reference flag precedence:
             # BAD_CREATOR_SIGNATURE wins over later-stage failures)
-            creator_bytes = sl(int(co["creator_off"][i]), int(co["creator_len"][i]))
-            try:
-                creator = self._bundle.msp_manager.deserialize_identity(
-                    creator_bytes
-                )
-                self._bundle.msp_manager.validate(creator)
-            except Exception:
+            creator_bytes = sl(creator_off_l[i], creator_len_l[i])
+            creator = self._creator_identity(creator_bytes, memo)
+            if creator is None:
                 flags[i] = V.BAD_CREATOR_SIGNATURE
                 continue
             w = works[i]
@@ -375,7 +442,7 @@ class TxValidator:
                 VerifyBatchItem(
                     creator.public_key,
                     digs[32 * i:32 * i + 32],
-                    sl(int(co["sig_off"][i]), int(co["sig_len"][i])),
+                    sl(sig_off_l[i], sig_len_l[i]),
                 )
             )
             if st == 1:  # CONFIG tx: creator signature only
@@ -387,7 +454,7 @@ class TxValidator:
 
             # dup-txid stage: the txid registers even when a LATER check
             # fails (the reference adds to the dedup set right here too)
-            txid = sl(int(co["txid_off"][i]), int(co["txid_len"][i])).decode()
+            txid = sl(txid_off_l[i], txid_len_l[i]).decode()
             if txid in seen_txids or self._ledger.tx_id_exists(txid):
                 flags[i] = V.DUPLICATE_TXID
                 continue
@@ -400,15 +467,15 @@ class TxValidator:
                 flags[i] = V.BAD_PAYLOAD
                 continue
 
-            prp_bytes = sl(int(co["prp_off"][i]), int(co["prp_len"][i]))
-            cc_id = sl(int(co["ccid_off"][i]), int(co["ccid_len"][i])).decode()
-            rwset_bytes = sl(int(co["rwset_off"][i]), int(co["rwset_len"][i]))
-            es, ec = int(co["endo_start"][i]), int(co["endo_count"][i])
+            prp_bytes = sl(prp_off_l[i], prp_len_l[i])
+            cc_id = sl(ccid_off_l[i], ccid_len_l[i]).decode()
+            rwset_bytes = sl(rwset_off_l[i], rwset_len_l[i])
+            es, ec = endo_start_l[i], endo_count_l[i]
             signed = [
                 SignedData(
                     b"",
-                    sl(int(co["e_endorser_off"][k]), int(co["e_endorser_len"][k])),
-                    sl(int(co["e_sig_off"][k]), int(co["e_sig_len"][k])),
+                    sl(ee_off[k], ee_len[k]),
+                    sl(es_off[k], es_len[k]),
                     digest=edigs[32 * k:32 * k + 32],
                 )
                 for k in range(es, es + ec)

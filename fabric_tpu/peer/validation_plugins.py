@@ -34,6 +34,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Callable, Sequence
 
+from fabric_tpu.csp.api import VerifyBatchItem
 from fabric_tpu.ledger.txmgmt import VALIDATION_PARAMETER, hash_ns
 from fabric_tpu.policies.signature_policy import SignaturePolicy
 from fabric_tpu.protos.ledger.rwset import rwset_pb2
@@ -156,19 +157,49 @@ class _FailPending(PendingValidation):
 class PolicyProvider:
     """Resolves policy references for a channel: inline signature
     policies, channel-policy references, and the per-chaincode default
-    (reference plugindispatcher/plugin_validator.go policy fetching)."""
+    (reference plugindispatcher/plugin_validator.go policy fetching).
+
+    Parsed policies are memoized by their raw bytes: every tx carrying
+    the same chaincode-level validation parameter or key-level
+    VALIDATION_PARAMETER resolves to the SAME compiled SignaturePolicy
+    object, so downstream per-(policy, endorser-set) caches hit across
+    txs and blocks."""
+
+    _MEMO_CAP = 512
 
     def __init__(self, policy_manager, deserializer, definition_provider=None):
         self._pm = policy_manager
         self._deserializer = deserializer
         self._definitions = definition_provider
+        self._app_memo: dict[bytes, object] = {}
+        self._sig_memo: dict[bytes, object] = {}
+        self._ns_memo: dict[str, object] = {}
+
+    @property
+    def deserializer(self):
+        return self._deserializer
+
+    def begin_block(self) -> None:
+        """Reset per-block memos.  Chaincode-level policy resolution is
+        stable within one block but may change between blocks (a
+        lifecycle commit lands a new definition), so the validator calls
+        this at every block start."""
+        self._ns_memo.clear()
 
     def default_policy(self):
         return self._pm.get_policy("/Channel/Application/Endorsement")
 
     def chaincode_policy(self, namespace: str):
         """The chaincode-level endorsement policy from the committed
-        definition's validation parameter, else the channel default."""
+        definition's validation parameter, else the channel default.
+        Memoized per block (see begin_block)."""
+        if namespace in self._ns_memo:
+            return self._ns_memo[namespace]
+        pol = self._resolve_chaincode_policy(namespace)
+        self._ns_memo[namespace] = pol
+        return pol
+
+    def _resolve_chaincode_policy(self, namespace: str):
         if self._definitions is not None:
             info = self._definitions.validation_info(namespace)
             if info is not None:
@@ -201,6 +232,15 @@ class PolicyProvider:
         encoding; None when empty/unparseable."""
         if not raw:
             return None
+        if raw in self._app_memo:
+            return self._app_memo[raw]
+        pol = self._parse_application_policy(raw)
+        if len(self._app_memo) >= self._MEMO_CAP:
+            self._app_memo.clear()
+        self._app_memo[raw] = pol
+        return pol
+
+    def _parse_application_policy(self, raw: bytes):
         try:
             ap = collection_pb2.ApplicationPolicy.FromString(raw)
             which = ap.WhichOneof("type")
@@ -223,6 +263,15 @@ class PolicyProvider:
         own parser, as in the reference)."""
         if not raw:
             return None
+        if raw in self._sig_memo:
+            return self._sig_memo[raw]
+        pol = self._parse_signature_policy(raw)
+        if len(self._sig_memo) >= self._MEMO_CAP:
+            self._sig_memo.clear()
+        self._sig_memo[raw] = pol
+        return pol
+
+    def _parse_signature_policy(self, raw: bytes):
         try:
             env = policies_pb2.SignaturePolicyEnvelope.FromString(raw)
             if env.rule.ByteSize() or env.identities:
@@ -232,11 +281,118 @@ class PolicyProvider:
         return None
 
 
+class EndorsementPlan:
+    """Amortized policy combinatorics for one (policy set, ordered unique
+    endorser set).
+
+    Within a block — and across blocks — most txs repeat the same
+    chaincode policy against the same endorsing orgs; only the digests
+    and signatures differ per tx.  The reference re-runs identity
+    deserialization, principal matching, and the cauthdsl closure for
+    every tx (common/policies/policy.go:365 + cauthdsl.go:40-92).  A
+    plan does all of that ONCE: it deserializes each unique endorser,
+    prepares every policy against sentinel digests to learn which item
+    lane maps to which endorser, and memoizes `decide(bits)` — the pure
+    function from per-endorser verify outcomes to the policy verdict.
+    Per tx, validation is then k VerifyBatchItem constructions plus one
+    dict lookup."""
+
+    def __init__(self, policies, endorser_bytes: tuple, deserializer):
+        self.identities = []
+        for eb in endorser_bytes:
+            try:
+                self.identities.append(deserializer.deserialize_identity(eb))
+            except Exception:
+                self.identities.append(None)
+        # Sentinel digests (1-based: the all-zero digest is the dummy
+        # item for identities that fail to deserialize) recover the
+        # item-lane -> endorser-index mapping from each policy's prepare.
+        sentinels = {}
+        signed = []
+        for j, eb in enumerate(endorser_bytes):
+            d = (j + 1).to_bytes(32, "big")
+            sentinels[d] = j
+            signed.append(SignedData(b"", eb, b"", digest=d))
+        self._pendings = []
+        for pol in policies:
+            p = pol.prepare(signed)
+            mapping = [sentinels.get(bytes(it.digest), -1) for it in p.items]
+            self._pendings.append((p, mapping))
+        self._decisions: dict[tuple, bool] = {}
+
+    def decide(self, bits: tuple) -> bool:
+        r = self._decisions.get(bits)
+        if r is None:
+            r = all(
+                p.finish([bits[j] if j >= 0 else False for j in mapping])
+                for p, mapping in self._pendings
+            )
+            self._decisions[bits] = r
+        return r
+
+
+class _PlanPending(PendingValidation):
+    """Per-tx pending bound to a shared EndorsementPlan: `items` carry
+    this tx's digests/signatures for the endorsers that deserialize;
+    `finish` folds the mask into the plan's memoized decision."""
+
+    def __init__(self, plan: EndorsementPlan, lanes: list, items: list):
+        self._plan = plan
+        self._lanes = lanes  # endorser index per item position
+        self.items = items
+
+    def finish(self, mask) -> bool:
+        bits = [False] * len(self._plan.identities)
+        for pos, j in enumerate(self._lanes):
+            bits[j] = bool(mask[pos])
+        return self._plan.decide(tuple(bits))
+
+
 class BuiltinV20Plugin:
     """The default endorsement-policy plugin ("vscc"), key-level aware.
     Evaluates the single namespace in `ctx.namespace`; the validator
     dispatches one prepare per written namespace, as the reference
     dispatcher does."""
+
+    _PLAN_CAP = 256
+
+    def __init__(self, plans: bool = True):
+        self._use_plans = plans
+        self._plans: dict[tuple, EndorsementPlan] = {}
+
+    def _plan_pending(self, ctx: ValidationContext, policies) -> PendingValidation | None:
+        """Plan-cached fast path; None when an endorsement lacks a
+        precomputed digest (the generic per-tx path handles it)."""
+        ends = ctx.endorsements
+        if not self._use_plans or not ends:
+            return None
+        uniq: dict[bytes, SignedData] = {}
+        for sd in ends:
+            if sd.digest is None:
+                return None
+            if sd.identity not in uniq:
+                uniq[sd.identity] = sd
+        key = (tuple(policies), tuple(uniq))
+        plan = self._plans.get(key)
+        if plan is None:
+            try:
+                plan = EndorsementPlan(
+                    policies, tuple(uniq), ctx.policy_provider.deserializer
+                )
+            except Exception:
+                return None
+            if len(self._plans) >= self._PLAN_CAP:
+                self._plans.clear()
+            self._plans[key] = plan
+        lanes, items = [], []
+        for j, sd in enumerate(uniq.values()):
+            ident = plan.identities[j]
+            if ident is not None:
+                lanes.append(j)
+                items.append(
+                    VerifyBatchItem(ident.public_key, sd.digest, sd.signature)
+                )
+        return _PlanPending(plan, lanes, items)
 
     def prepare(self, ctx: ValidationContext) -> PendingValidation:
         try:
@@ -295,6 +451,10 @@ class BuiltinV20Plugin:
                 ctx.policy_provider.chaincode_policy(ctx.namespace)
             )
 
+        planned = self._plan_pending(ctx, policies)
+        if planned is not None:
+            return planned
+
         items: list = []
         pendings = []
         for pol in policies:
@@ -309,8 +469,8 @@ class PluginRegistry:
     """Maps validation-plugin names from chaincode definitions to plugin
     instances (reference txvalidator/plugin/plugin.go MapBasedMapper)."""
 
-    def __init__(self):
-        self._plugins: dict[str, object] = {"vscc": BuiltinV20Plugin()}
+    def __init__(self, plans: bool = True):
+        self._plugins: dict[str, object] = {"vscc": BuiltinV20Plugin(plans=plans)}
 
     def register(self, name: str, plugin) -> None:
         self._plugins[name] = plugin

@@ -1,0 +1,152 @@
+"""store_stream: pipelined validate+commit matches sequential
+store_block (flags, ledger state, heights); faithful-mode validator
+produces identical flags to the optimized path."""
+
+from __future__ import annotations
+
+import pytest
+
+from orgfix import make_org
+
+from fabric_tpu import protoutil
+from fabric_tpu.common import configtx_builder as ctx
+from fabric_tpu.common.channelconfig import bundle_from_genesis
+from fabric_tpu.ledger import LedgerProvider
+from fabric_tpu.msp import msp_config_from_ca
+from fabric_tpu.peer.committer import Committer
+from fabric_tpu.peer.endorser import Endorser
+from fabric_tpu.peer.txvalidator import TxValidator
+from fabric_tpu.protos.common import common_pb2
+from fabric_tpu.protos.peer import proposal_pb2, transaction_pb2
+
+V = transaction_pb2
+
+
+def _cc(sim, args):
+    sim.set_state("strcc", args[0].decode(), args[1])
+    return 200, "", b""
+
+
+@pytest.fixture(scope="module")
+def world():
+    org = make_org("Org1MSP")
+    oorg = make_org("OrdererMSP")
+    app = ctx.application_group(
+        {"Org1": ctx.org_group("Org1MSP", msp_config_from_ca(org.ca, "Org1MSP"))}
+    )
+    ordg = ctx.orderer_group(
+        {"O": ctx.org_group("OrdererMSP", msp_config_from_ca(oorg.ca, "OrdererMSP"))},
+        consensus_type="solo",
+    )
+    genesis = ctx.genesis_block("strch", ctx.channel_group(app, ordg))
+    return org, genesis
+
+
+def _fresh(org, genesis):
+    ledger = LedgerProvider(None).create(genesis)
+    bundle = bundle_from_genesis(genesis, org.csp)
+    endorser = Endorser(
+        "strch", ledger, bundle, org.signer("peer0", role_ou="peer"),
+        {"strcc": _cc}, org.csp,
+    )
+    return ledger, bundle, endorser
+
+
+def _blocks(endorser, client, n_blocks: int, n_txs: int):
+    blocks = []
+    for b in range(n_blocks):
+        envs = []
+        for i in range(n_txs):
+            prop, _ = protoutil.create_chaincode_proposal(
+                client.serialize(), "strch", "strcc", [b"k%d-%d" % (b, i), b"v"]
+            )
+            signed = proposal_pb2.SignedProposal(
+                proposal_bytes=prop.SerializeToString(),
+                signature=client.sign(prop.SerializeToString()),
+            )
+            resp = endorser.process_proposal(signed)
+            env = protoutil.create_signed_tx(prop, client, [resp])
+            if i == 1:  # one tampered creator signature per block
+                env = common_pb2.Envelope(
+                    payload=env.payload, signature=env.signature[:-2] + b"xx"
+                )
+            envs.append(env)
+        blk = common_pb2.Block()
+        blk.header.number = b + 1
+        blk.data.data.extend(e.SerializeToString() for e in envs)
+        while len(blk.metadata.metadata) < 3:
+            blk.metadata.metadata.append(b"")
+        blocks.append(blk)
+    return blocks
+
+
+def _copies(blocks):
+    out = []
+    for blk in blocks:
+        c = common_pb2.Block()
+        c.CopyFrom(blk)
+        out.append(c)
+    return out
+
+
+def test_store_stream_matches_sequential(world):
+    org, genesis = world
+    ledger_a, bundle_a, endorser = _fresh(org, genesis)
+    client = org.signer("user1", role_ou="client")
+    blocks = _blocks(endorser, client, 4, 3)
+
+    seq_committer = Committer(
+        TxValidator("strch", ledger_a, bundle_a, org.csp), ledger_a
+    )
+    seq = [seq_committer.store_block(b) for b in _copies(blocks)]
+
+    ledger_b, bundle_b, _ = _fresh(org, genesis)
+    stream_committer = Committer(
+        TxValidator("strch", ledger_b, bundle_b, org.csp), ledger_b
+    )
+    piped = list(stream_committer.store_stream(iter(_copies(blocks)), depth=3))
+
+    assert piped == seq
+    assert ledger_b.height == ledger_a.height == len(blocks) + 1
+    for b in range(len(blocks)):
+        for i in (0, 2):
+            key = "k%d-%d" % (b, i)
+            assert ledger_b.get_state("strcc", key) == ledger_a.get_state(
+                "strcc", key
+            )
+    # the tampered tx never landed in state
+    assert ledger_b.get_state("strcc", "k0-1") in (None, b"")
+
+
+def test_store_stream_listener_and_flags(world):
+    org, genesis = world
+    ledger, bundle, endorser = _fresh(org, genesis)
+    client = org.signer("user1", role_ou="client")
+    blocks = _blocks(endorser, client, 2, 2)
+
+    seen: list = []
+    committer = Committer(TxValidator("strch", ledger, bundle, org.csp), ledger)
+    committer.add_commit_listener(lambda blk, flags: seen.append(blk.header.number))
+    flags = list(committer.store_stream(iter(blocks), depth=2))
+    assert seen == [1, 2]
+    for f in flags:
+        assert f[0] == V.VALID and f[1] == V.BAD_CREATOR_SIGNATURE
+
+
+def test_faithful_validator_matches_optimized(world):
+    org, genesis = world
+    ledger, bundle, endorser = _fresh(org, genesis)
+    client = org.signer("user1", role_ou="client")
+    blocks = _blocks(endorser, client, 2, 3)
+
+    fast = [
+        TxValidator("strch", ledger, bundle, org.csp).validate(b)
+        for b in _copies(blocks)
+    ]
+    faithful = [
+        TxValidator("strch", ledger, bundle, org.csp, faithful=True).validate(b)
+        for b in _copies(blocks)
+    ]
+    assert fast == faithful
+    for f in fast:
+        assert f[1] == V.BAD_CREATOR_SIGNATURE

@@ -1,0 +1,134 @@
+"""Mutual TLS on the RPC substrate: handshake, client-auth enforcement,
+wrong-CA rejection, pinned-cert allowlists, peer-cert exposure."""
+
+from __future__ import annotations
+
+import pytest
+
+from fabric_tpu.comm.rpc import RPCClient, RPCError, RPCServer
+from fabric_tpu.comm.tls import TLSCredentials, credentials_from_ca
+from fabric_tpu.common.crypto import CA
+
+
+@pytest.fixture(scope="module")
+def cas():
+    return CA("tlsca.org1.example.com", "org1"), CA(
+        "tlsca.org2.example.com", "org2"
+    )
+
+
+def _server(creds):
+    srv = RPCServer(tls=creds)
+    srv.register("echo", lambda body, stream: b"ok:" + body)
+    srv.start()
+    return srv
+
+
+def test_mutual_tls_roundtrip(cas):
+    ca, _ = cas
+    srv = _server(credentials_from_ca(ca, "server.org1"))
+    try:
+        cli = RPCClient(*srv.addr, tls=credentials_from_ca(ca, "client.org1"))
+        assert cli.call("echo", b"hi") == b"ok:hi"
+    finally:
+        srv.stop()
+
+
+def test_client_without_cert_rejected(cas):
+    ca, _ = cas
+    srv = _server(credentials_from_ca(ca, "server.org1"))
+    try:
+        # TLS context with trust but *no* client certificate
+        import socket
+        import ssl
+
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+        ctx.check_hostname = False
+        ctx.verify_mode = ssl.CERT_REQUIRED
+        ctx.load_verify_locations(cadata=ca.cert_pem.decode())
+        sock = socket.create_connection(srv.addr, timeout=5)
+        with pytest.raises((ssl.SSLError, ConnectionError, OSError)):
+            tls_sock = ctx.wrap_socket(sock)
+            # server requires a client cert: handshake or first read fails
+            tls_sock.sendall(b"x" * 8)
+            tls_sock.recv(1)
+            tls_sock.recv(1)
+            raise ConnectionError("server accepted an unauthenticated client")
+    finally:
+        srv.stop()
+
+
+def test_wrong_ca_client_rejected(cas):
+    ca1, ca2 = cas
+    srv = _server(credentials_from_ca(ca1, "server.org1"))
+    try:
+        # client cert from a CA the server does not trust
+        pair = ca2.issue("evil.org2", client=True, server=True)
+        wrong = TLSCredentials(
+            cert_pem=pair.cert_pem, key_pem=pair.key_pem,
+            ca_pems=[ca1.cert_pem],
+        )
+        cli = RPCClient(*srv.addr, tls=wrong, timeout=5)
+        with pytest.raises((RPCError, ConnectionError, OSError)):
+            cli.call("echo", b"hi")
+    finally:
+        srv.stop()
+
+
+def test_plaintext_client_to_tls_server_fails(cas):
+    ca, _ = cas
+    srv = _server(credentials_from_ca(ca, "server.org1"))
+    try:
+        cli = RPCClient(*srv.addr, timeout=5)
+        with pytest.raises((RPCError, ConnectionError, OSError)):
+            cli.call("echo", b"hi")
+    finally:
+        srv.stop()
+
+
+def test_pinned_cert_allowlist(cas):
+    ca, _ = cas
+    good = credentials_from_ca(ca, "client.good")
+    other = credentials_from_ca(ca, "client.other")
+    server_creds = credentials_from_ca(ca, "server.org1")
+    server_creds.pinned_certs = [good.cert_der]  # only `good` may connect
+    srv = _server(server_creds)
+    try:
+        cli = RPCClient(*srv.addr, tls=good)
+        assert cli.call("echo", b"hi") == b"ok:hi"
+        bad = RPCClient(*srv.addr, tls=other, timeout=5)
+        with pytest.raises((RPCError, ConnectionError, OSError)):
+            bad.call("echo", b"hi")
+    finally:
+        srv.stop()
+
+
+def test_peer_cert_exposed_to_handler(cas):
+    ca, _ = cas
+    seen: list = []
+    srv = RPCServer(tls=credentials_from_ca(ca, "server.org1"))
+
+    def capture(body, stream):
+        seen.append(stream.peer_cert)
+        return b"ok"
+
+    srv.register("cap", capture)
+    srv.start()
+    try:
+        client_creds = credentials_from_ca(ca, "client.org1")
+        RPCClient(*srv.addr, tls=client_creds).call("cap")
+        assert seen and seen[0] == client_creds.cert_der
+    finally:
+        srv.stop()
+
+
+def test_streaming_over_tls(cas):
+    ca, _ = cas
+    srv = RPCServer(tls=credentials_from_ca(ca, "server.org1"))
+    srv.register("count", lambda body, stream: (b"%d" % i for i in range(5)))
+    srv.start()
+    try:
+        cli = RPCClient(*srv.addr, tls=credentials_from_ca(ca, "client.org1"))
+        assert list(cli.stream("count")) == [b"0", b"1", b"2", b"3", b"4"]
+    finally:
+        srv.stop()
