@@ -1,8 +1,10 @@
 """Shared CLI plumbing (reference cmd/common + internal/peer/common):
-MSP-dir signer loading, endpoint parsing, proposal/transaction helpers."""
+MSP-dir signer loading, endpoint parsing, TLS flags,
+proposal/transaction helpers."""
 
 from __future__ import annotations
 
+import argparse
 import os
 
 from fabric_tpu import protoutil
@@ -15,6 +17,37 @@ from fabric_tpu.protos.peer import proposal_pb2, proposal_response_pb2
 def parse_endpoint(s: str, default_host: str = "127.0.0.1") -> tuple[str, int]:
     host, _, port = s.rpartition(":")
     return (host or default_host, int(port))
+
+
+def tls_parent() -> argparse.ArgumentParser:
+    """Parent parser contributing the TLS flags every network-touching
+    subcommand shares (reference peer CLI --tls/--cafile/--certfile/
+    --keyfile; here a cryptogen-layout tls dir + extra roots)."""
+    p = argparse.ArgumentParser(add_help=False)
+    p.add_argument(
+        "--tls-dir", default=None,
+        help="dir with {server|client}.{crt,key} + ca.crt (cryptogen tls/)",
+    )
+    p.add_argument(
+        "--tls-root", action="append", default=[],
+        help="extra trusted TLS root CA PEM file (repeatable; other orgs)",
+    )
+    return p
+
+
+def tls_from_args(args):
+    """TLSCredentials from --tls-dir/--tls-root, or None (plaintext)."""
+    d = getattr(args, "tls_dir", None)
+    if not d:
+        return None
+    from fabric_tpu.comm.tls import credentials_from_files
+
+    stem = "server" if os.path.exists(os.path.join(d, "server.crt")) else "client"
+    return credentials_from_files(
+        os.path.join(d, f"{stem}.crt"),
+        os.path.join(d, f"{stem}.key"),
+        [os.path.join(d, "ca.crt")] + list(getattr(args, "tls_root", []) or []),
+    )
 
 
 def load_signer(msp_dir: str, mspid: str, csp=None) -> SigningIdentity:
@@ -39,6 +72,7 @@ def endorse(
     channel_id: str,
     cc_name: str,
     args: list[bytes],
+    tls=None,
 ):
     """Send a signed proposal to each peer; returns (proposal, responses)."""
     prop, _txid = protoutil.create_chaincode_proposal(
@@ -50,7 +84,7 @@ def endorse(
     )
     responses = []
     for ep in peer_endpoints:
-        raw = RPCClient(*ep).call(
+        raw = RPCClient(*ep, tls=tls).call(
             "endorser.ProcessProposal", signed.SerializeToString()
         )
         responses.append(
@@ -64,15 +98,17 @@ def submit(
     signer: SigningIdentity,
     prop,
     responses,
+    tls=None,
 ) -> int:
     """Assemble the signed transaction and broadcast it; returns status."""
     from fabric_tpu.protos.orderer import ab_pb2
 
     env = protoutil.create_signed_tx(prop, signer, responses)
-    raw = RPCClient(*orderer_endpoint).call(
+    raw = RPCClient(*orderer_endpoint, tls=tls).call(
         "ab.Broadcast", env.SerializeToString()
     )
     return ab_pb2.BroadcastResponse.FromString(raw).status
 
 
-__all__ = ["parse_endpoint", "load_signer", "endorse", "submit"]
+__all__ = ["parse_endpoint", "load_signer", "endorse", "submit",
+           "tls_parent", "tls_from_args"]

@@ -12,7 +12,12 @@ import argparse
 import json
 import sys
 
-from fabric_tpu.cmd.common import load_signer, parse_endpoint
+from fabric_tpu.cmd.common import (
+    load_signer,
+    parse_endpoint,
+    tls_from_args,
+    tls_parent,
+)
 from fabric_tpu.comm import RPCClient
 from fabric_tpu.discovery.client import DiscoveryClient, select_endorsers
 from fabric_tpu.protos.discovery import protocol_pb2 as dpb
@@ -20,7 +25,7 @@ from fabric_tpu.protos.discovery import protocol_pb2 as dpb
 
 def _client(args) -> DiscoveryClient:
     signer = load_signer(args.msp_dir, args.mspid)
-    rpc = RPCClient(*parse_endpoint(args.peer))
+    rpc = RPCClient(*parse_endpoint(args.peer), tls=tls_from_args(args))
 
     def send(signed: dpb.SignedRequest) -> dpb.Response:
         raw = rpc.call("discovery.Process", signed.SerializeToString())
@@ -32,8 +37,9 @@ def _client(args) -> DiscoveryClient:
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="discover")
     sub = ap.add_subparsers(dest="cmd", required=True)
+    tlsp = tls_parent()
     for name in ("peers", "config", "endorsers"):
-        p = sub.add_parser(name)
+        p = sub.add_parser(name, parents=[tlsp])
         p.add_argument("--channel", required=True)
         p.add_argument("--peer", required=True)
         p.add_argument("--mspid", required=True)

@@ -12,7 +12,12 @@ import signal
 import sys
 import threading
 
-from fabric_tpu.cmd.common import load_signer, parse_endpoint
+from fabric_tpu.cmd.common import (
+    load_signer,
+    parse_endpoint,
+    tls_from_args,
+    tls_parent,
+)
 from fabric_tpu.csp import SWCSP
 from fabric_tpu.node.orderer_node import OrdererNode
 from fabric_tpu.protos.common import common_pb2
@@ -28,7 +33,7 @@ def main(argv=None) -> int:
         cfg.get("general.listenAddress", "127.0.0.1"),
         cfg.get_int("general.listenPort", 0),
     )
-    ap = argparse.ArgumentParser(prog="orderer")
+    ap = argparse.ArgumentParser(prog="orderer", parents=[tls_parent()])
     ap.add_argument("--listen", default=cfg_listen)
     ap.add_argument("--root", default=cfg.get("fileLedger.location"))
     ap.add_argument("--genesis", action="append", default=[])
@@ -53,7 +58,7 @@ def main(argv=None) -> int:
     host, port = parse_endpoint(args.listen)
     node = OrdererNode(
         args.root, SWCSP(), signer=signer, host=host, port=port,
-        genesis_blocks=blocks,
+        genesis_blocks=blocks, tls=tls_from_args(args),
     )
     node.start()
     print(f"orderer listening on {node.addr[0]}:{node.addr[1]}", flush=True)

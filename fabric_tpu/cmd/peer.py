@@ -21,7 +21,14 @@ import signal
 import sys
 import threading
 
-from fabric_tpu.cmd.common import endorse, load_signer, parse_endpoint, submit
+from fabric_tpu.cmd.common import (
+    endorse,
+    load_signer,
+    parse_endpoint,
+    submit,
+    tls_from_args,
+    tls_parent,
+)
 from fabric_tpu.comm import RPCClient
 from fabric_tpu.protos.common import common_pb2
 from fabric_tpu.protos.orderer import ab_pb2
@@ -58,7 +65,26 @@ def cmd_node_start(args) -> int:
         deliver_concurrency=cfg.get_int(
             "peer.limits.concurrency.deliverService", 2500
         ),
+        tls=tls_from_args(args),
     )
+    gossip_bootstrap = list(args.gossip_bootstrap) or [
+        str(b) for b in (cfg.get("peer.gossip.bootstrap") or [])
+    ]
+    if args.gossip_listen:
+        node.enable_gossip(
+            parse_endpoint(args.gossip_listen),
+            gossip_bootstrap,
+            fanout=cfg.get_int("peer.gossip.fanout", 3),
+            store_capacity=cfg.get_int(
+                "peer.gossip.maxBlockCountToStore", 200
+            ),
+            tick_interval_s=cfg.get_duration(
+                "peer.gossip.pullInterval", 4.0
+            ),
+            identity_ttl_s=cfg.get_duration(
+                "peer.gossip.identityExpiration", 3600.0
+            ),
+        )
     node.start()
     print(f"peer listening on {node.addr[0]}:{node.addr[1]}", flush=True)
     stop = threading.Event()
@@ -98,13 +124,17 @@ def cmd_node_reset(args) -> int:
 def cmd_channel_join(args) -> int:
     with open(args.block, "rb") as f:
         raw = f.read()
-    out = RPCClient(*parse_endpoint(args.peer)).call("admin.JoinChannel", raw)
+    out = RPCClient(*parse_endpoint(args.peer), tls=tls_from_args(args)).call(
+        "admin.JoinChannel", raw
+    )
     print(f"joined channel {out.decode()}")
     return 0
 
 
 def cmd_channel_list(args) -> int:
-    raw = RPCClient(*parse_endpoint(args.peer)).call("admin.Channels")
+    raw = RPCClient(
+        *parse_endpoint(args.peer), tls=tls_from_args(args)
+    ).call("admin.Channels")
     resp = peer_cfg.ChannelQueryResponse.FromString(raw)
     for ch in resp.channels:
         print(ch.channel_id)
@@ -112,7 +142,7 @@ def cmd_channel_list(args) -> int:
 
 
 def cmd_channel_getinfo(args) -> int:
-    raw = RPCClient(*parse_endpoint(args.peer)).call(
+    raw = RPCClient(*parse_endpoint(args.peer), tls=tls_from_args(args)).call(
         "admin.Height", args.channel.encode()
     )
     print(f"height: {raw.decode()}")
@@ -132,7 +162,7 @@ def cmd_channel_fetch(args) -> int:
     target = args.peer or args.orderer
     method = "deliver.Deliver" if args.peer else "ab.Deliver"
     blk = None
-    for raw in RPCClient(*parse_endpoint(target)).stream(
+    for raw in RPCClient(*parse_endpoint(target), tls=tls_from_args(args)).stream(
         method, env.SerializeToString()
     ):
         resp = ab_pb2.DeliverResponse.FromString(raw)
@@ -155,7 +185,8 @@ def cmd_chaincode_invoke(args) -> int:
     signer = _signer(args)
     peers = [parse_endpoint(p) for p in args.peer]
     prop, responses = endorse(
-        peers, signer, args.channel, args.name, _cc_args(args)
+        peers, signer, args.channel, args.name, _cc_args(args),
+        tls=tls_from_args(args),
     )
     for r in responses:
         # same success range create_signed_tx enforces (2xx/3xx)
@@ -163,7 +194,10 @@ def cmd_chaincode_invoke(args) -> int:
             print(f"endorsement failed: {r.response.message}",
                   file=sys.stderr)
             return 1
-    status = submit(parse_endpoint(args.orderer), signer, prop, responses)
+    status = submit(
+        parse_endpoint(args.orderer), signer, prop, responses,
+        tls=tls_from_args(args),
+    )
     ok = status == common_pb2.SUCCESS
     print("committed" if ok else f"broadcast status {status}")
     return 0 if ok else 1
@@ -173,7 +207,7 @@ def cmd_chaincode_query(args) -> int:
     signer = _signer(args)
     _, responses = endorse(
         [parse_endpoint(args.peer[0])], signer, args.channel, args.name,
-        _cc_args(args),
+        _cc_args(args), tls=tls_from_args(args),
     )
     r = responses[0]
     if not (200 <= r.response.status < 400):
@@ -190,7 +224,7 @@ def _lifecycle_call(args, fn_name: str, payload: bytes, channel: str = ""):
     peers = [parse_endpoint(p) for p in args.peer]
     prop, resps = endorse(
         peers, _signer(args), channel or getattr(args, "channel", ""),
-        "_lifecycle", [fn_name.encode(), payload],
+        "_lifecycle", [fn_name.encode(), payload], tls=tls_from_args(args),
     )
     for r in resps:
         if not (200 <= r.response.status < 400):
@@ -253,7 +287,8 @@ def cmd_lifecycle_approve(args) -> int:
     prop, resps = _lifecycle_call(
         args, "ApproveChaincodeDefinitionForMyOrg", req.SerializeToString()
     )
-    status = submit(parse_endpoint(args.orderer), _signer(args), prop, resps)
+    status = submit(parse_endpoint(args.orderer), _signer(args), prop, resps,
+                    tls=tls_from_args(args))
     print(f"approval submitted: {status}")
     return 0 if status == 200 else 1
 
@@ -278,7 +313,8 @@ def cmd_lifecycle_commit(args) -> int:
     prop, resps = _lifecycle_call(
         args, "CommitChaincodeDefinition", req.SerializeToString()
     )
-    status = submit(parse_endpoint(args.orderer), _signer(args), prop, resps)
+    status = submit(parse_endpoint(args.orderer), _signer(args), prop, resps,
+                    tls=tls_from_args(args))
     print(f"commit submitted: {status}")
     return 0 if status == 200 else 1
 
@@ -340,9 +376,9 @@ def cmd_channel_create(args) -> int:
     osnadmin channel join / channelparticipation restapi.go)."""
     with open(args.file, "rb") as f:
         raw = f.read()
-    out = RPCClient(*parse_endpoint(args.orderer)).call(
-        "participation.Join", raw
-    )
+    out = RPCClient(
+        *parse_endpoint(args.orderer), tls=tls_from_args(args)
+    ).call("participation.Join", raw)
     print(f"channel {out.decode()} created")
     return 0
 
@@ -355,7 +391,9 @@ def cmd_channel_update(args) -> int:
     with open(args.file, "rb") as f:
         raw = f.read()
     resp = ab_pb2.BroadcastResponse.FromString(
-        RPCClient(*parse_endpoint(args.orderer)).call("ab.Broadcast", raw)
+        RPCClient(
+            *parse_endpoint(args.orderer), tls=tls_from_args(args)
+        ).call("ab.Broadcast", raw)
     )
     print(f"update status: {resp.status}")
     return 0 if resp.status == 200 else 1
@@ -392,9 +430,10 @@ def cmd_channel_signconfigtx(args) -> int:
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="peer")
     sub = ap.add_subparsers(dest="cmd", required=True)
+    tlsp = tls_parent()
 
     node = sub.add_parser("node").add_subparsers(dest="sub", required=True)
-    start = node.add_parser("start")
+    start = node.add_parser("start", parents=[tlsp])
     start.add_argument("--listen", default="127.0.0.1:0")
     start.add_argument("--root", default=None)
     start.add_argument("--mspid", required=True)
@@ -402,6 +441,10 @@ def main(argv=None) -> int:
     start.add_argument("--orderer", action="append", default=[])
     start.add_argument("--chaincode", action="append", default=[])
     start.add_argument("--operations-port", type=int, default=None)
+    start.add_argument("--gossip-listen", default=None,
+                       help="host:port for the gossip transport")
+    start.add_argument("--gossip-bootstrap", action="append", default=[],
+                       help="bootstrap gossip endpoint (repeatable)")
     start.set_defaults(fn=cmd_node_start)
     # offline repair ops (reference internal/peer/node/{reset,rollback,
     # rebuild_dbs}.go) — run against a STOPPED peer's storage root
@@ -427,12 +470,12 @@ def main(argv=None) -> int:
     rs.set_defaults(fn=cmd_node_reset)
 
     chan = sub.add_parser("channel").add_subparsers(dest="sub", required=True)
-    create = chan.add_parser("create")
+    create = chan.add_parser("create", parents=[tlsp])
     create.add_argument("-f", "--file", required=True,
                         help="genesis block for the new channel")
     create.add_argument("--orderer", required=True)
     create.set_defaults(fn=cmd_channel_create)
-    upd = chan.add_parser("update")
+    upd = chan.add_parser("update", parents=[tlsp])
     upd.add_argument("-f", "--file", required=True,
                      help="signed CONFIG_UPDATE envelope")
     upd.add_argument("--orderer", required=True)
@@ -442,18 +485,18 @@ def main(argv=None) -> int:
     sct.add_argument("--mspid", required=True)
     sct.add_argument("--msp-dir", required=True)
     sct.set_defaults(fn=cmd_channel_signconfigtx)
-    join = chan.add_parser("join")
+    join = chan.add_parser("join", parents=[tlsp])
     join.add_argument("--block", required=True)
     join.add_argument("--peer", required=True)
     join.set_defaults(fn=cmd_channel_join)
-    lst = chan.add_parser("list")
+    lst = chan.add_parser("list", parents=[tlsp])
     lst.add_argument("--peer", required=True)
     lst.set_defaults(fn=cmd_channel_list)
-    info = chan.add_parser("getinfo")
+    info = chan.add_parser("getinfo", parents=[tlsp])
     info.add_argument("-c", "--channel", required=True)
     info.add_argument("--peer", required=True)
     info.set_defaults(fn=cmd_channel_getinfo)
-    fetch = chan.add_parser("fetch")
+    fetch = chan.add_parser("fetch", parents=[tlsp])
     fetch.add_argument("position")  # newest | oldest | block number
     fetch.add_argument("out")
     fetch.add_argument("-c", "--channel", required=True)
@@ -468,7 +511,7 @@ def main(argv=None) -> int:
         ("invoke", cmd_chaincode_invoke, True),
         ("query", cmd_chaincode_query, False),
     ):
-        p = cc.add_parser(name)
+        p = cc.add_parser(name, parents=[tlsp])
         p.add_argument("-C", "--channel", required=True)
         p.add_argument("-n", "--name", required=True)
         p.add_argument("-a", "--arg", action="append", default=[])
@@ -495,7 +538,7 @@ def main(argv=None) -> int:
         ("commit", cmd_lifecycle_commit),
         ("querycommitted", cmd_lifecycle_querycommitted),
     ):
-        p = lcc.add_parser(name)
+        p = lcc.add_parser(name, parents=[tlsp])
         p.add_argument("--peer", action="append", required=True)
         p.add_argument("--mspid", required=True)
         p.add_argument("--msp-dir", required=True)

@@ -1,0 +1,145 @@
+"""Certstore: identity dissemination over the pull protocol.
+
+Reference gossip/gossip/certstore.go:30 — a pull engine
+(hello/digest/request/response, PULL_IDENTITY_MSG) whose items are
+SELF-SIGNED PeerIdentity messages: each peer signs its own identity
+message once; receivers forward the original signed envelope intact, so
+any peer can verify provenance without having met the owner.  Verified
+identities land in the IdentityMapper (expiration-aware) and in the
+comm layer's identity table so subsequent message signatures verify.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+
+from fabric_tpu.protos.gossip import message_pb2 as gpb
+
+
+class CertStore:
+    def __init__(self, comm, mapper, membership, rng=None):
+        self._comm = comm
+        self._mapper = mapper
+        self._membership = membership
+        self._rng = rng or random.Random()
+        self._nonce = 0
+        self._pending: dict[int, str] = {}
+        self._lock = threading.Lock()
+        # pki-hex -> serialized SignedGossipMessage (owner-signed)
+        self._signed: dict[str, bytes] = {}
+        self._add_own_identity()
+        comm.subscribe(self._handle)
+
+    def _add_own_identity(self) -> None:
+        m = gpb.GossipMessage(tag=gpb.GossipMessage.EMPTY)
+        m.peer_identity.pki_id = self._comm.pki_id
+        m.peer_identity.cert = self._comm.identity
+        signed = self._comm.wrap(m)  # signed by our own MCS key
+        self._signed[self._comm.pki_id.hex()] = signed.SerializeToString()
+
+    # -- pull round --------------------------------------------------------
+
+    def tick(self) -> None:
+        peers = list(self._membership())
+        if not peers:
+            return
+        target = self._rng.choice(peers)
+        self._nonce += 1
+        hello = gpb.GossipMessage()
+        hello.hello.nonce = self._nonce
+        hello.hello.msg_type = gpb.PULL_IDENTITY_MSG
+        with self._lock:
+            self._pending[self._nonce] = target
+            while len(self._pending) > 32:
+                del self._pending[min(self._pending)]
+        self._comm.send(target, hello)
+
+    def known_pkis(self) -> list[str]:
+        with self._lock:
+            return sorted(self._signed)
+
+    # -- inbound -----------------------------------------------------------
+
+    def _handle(self, rm) -> None:
+        msg = rm.msg
+        kind = msg.WhichOneof("content")
+        if kind == "hello" and msg.hello.msg_type == gpb.PULL_IDENTITY_MSG:
+            resp = gpb.GossipMessage()
+            resp.data_dig.nonce = msg.hello.nonce
+            resp.data_dig.msg_type = gpb.PULL_IDENTITY_MSG
+            for h in self.known_pkis():
+                resp.data_dig.digests.append(h.encode())
+            self._respond(rm, resp)
+        elif kind == "data_dig" and msg.data_dig.msg_type == gpb.PULL_IDENTITY_MSG:
+            with self._lock:
+                target = self._pending.pop(msg.data_dig.nonce, None)
+                have = set(self._signed)
+            if target is None:
+                return
+            want = [d for d in msg.data_dig.digests if d.decode() not in have]
+            if not want:
+                return
+            req = gpb.GossipMessage()
+            req.data_req.nonce = msg.data_dig.nonce
+            req.data_req.msg_type = gpb.PULL_IDENTITY_MSG
+            req.data_req.digests.extend(want)
+            self._comm.send(target, req)
+        elif kind == "data_req" and msg.data_req.msg_type == gpb.PULL_IDENTITY_MSG:
+            resp = gpb.GossipMessage()
+            resp.data_update.nonce = msg.data_req.nonce
+            resp.data_update.msg_type = gpb.PULL_IDENTITY_MSG
+            with self._lock:
+                for d in msg.data_req.digests:
+                    raw = self._signed.get(d.decode())
+                    if raw is not None:
+                        resp.data_update.data.append(
+                            gpb.SignedGossipMessage.FromString(raw)
+                        )
+            self._respond(rm, resp)
+        elif kind == "data_update" and msg.data_update.msg_type == gpb.PULL_IDENTITY_MSG:
+            for signed in msg.data_update.data:
+                self._learn(signed)
+
+    def _learn(self, signed: gpb.SignedGossipMessage) -> None:
+        """Admit a pulled identity: the inner PeerIdentity's pki must
+        derive from its cert, and the envelope must verify under THAT
+        identity (self-signed — certstore.go validateIdentityMsg)."""
+        try:
+            inner = gpb.GossipMessage.FromString(signed.payload)
+            if inner.WhichOneof("content") != "peer_identity":
+                return
+            ident = bytes(inner.peer_identity.cert)
+            pki = bytes(inner.peer_identity.pki_id)
+            if self._comm.mcs.get_pki_id(ident) != pki:
+                return  # forged pki binding
+            if not self._comm.mcs.verify(
+                ident, bytes(signed.signature), bytes(signed.payload)
+            ):
+                return  # not signed by the identity's owner
+            self._mapper.put(ident)  # raises when expired
+        except Exception:
+            return
+        with self._lock:
+            self._signed.setdefault(pki.hex(), signed.SerializeToString())
+        self._comm.learn_identity(ident)
+
+    def _respond(self, rm, msg: gpb.GossipMessage) -> None:
+        ep = self._endpoint_for(rm.sender_pki)
+        if ep:
+            self._comm.send(ep, msg)
+        else:
+            try:
+                rm.respond(msg)
+            except Exception:
+                pass
+
+    endpoint_lookup = None
+
+    def _endpoint_for(self, pki_id: bytes):
+        if self.endpoint_lookup is not None:
+            return self.endpoint_lookup(pki_id)
+        return None
+
+
+__all__ = ["CertStore"]

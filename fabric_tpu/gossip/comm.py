@@ -104,6 +104,11 @@ class GossipComm:
         with self._lock:
             return self._known_identities.get(pki_id)
 
+    def forget_identity(self, pki_id: bytes) -> None:
+        """Drop a learned identity (identity-mapper expiration purge)."""
+        with self._lock:
+            self._known_identities.pop(pki_id, None)
+
     def wrap(self, msg: gpb.GossipMessage) -> gpb.SignedGossipMessage:
         payload = msg.SerializeToString()
         return gpb.SignedGossipMessage(

@@ -1,0 +1,97 @@
+"""Identity mapper: pki-id -> serialized identity with expiration.
+
+Reference gossip/identity/identity.go:38 (NewIdentityMapper) — the
+store behind gossip message verification and the certstore.  Identities
+expire at their X.509 certificate's notAfter (when the identity parses
+as an msp.SerializedIdentity carrying a PEM cert); opaque identities
+fall back to a default TTL.  Expired identities are purged on access
+and by `sweep()`, and an `on_purge` hook lets the comm layer drop its
+own caches (the reference deletes the peer's connections too).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+def identity_expiration(identity: bytes) -> float | None:
+    """Seconds-since-epoch expiration for an identity, or None when it
+    carries no parseable certificate (caller applies its default TTL).
+    Mirrors msgCryptoService.Expiration feeding the mapper."""
+    try:
+        from cryptography import x509
+
+        from fabric_tpu.protos.msp import identities_pb2
+
+        sid = identities_pb2.SerializedIdentity.FromString(identity)
+        cert = x509.load_pem_x509_certificate(sid.id_bytes)
+        return cert.not_valid_after_utc.timestamp()
+    except Exception:
+        return None
+
+
+class IdentityMapper:
+    def __init__(
+        self,
+        mcs,
+        self_identity: bytes,
+        default_ttl_s: float = 3600.0,
+        clock=time.time,
+        on_purge=None,
+    ):
+        self._mcs = mcs
+        self._default_ttl = default_ttl_s
+        self._clock = clock
+        self._on_purge = on_purge or (lambda pki: None)
+        self._lock = threading.Lock()
+        # pki -> (identity bytes, expiration epoch-seconds)
+        self._store: dict[bytes, tuple[bytes, float]] = {}
+        self.self_pki = self.put(self_identity)
+
+    def put(self, identity: bytes) -> bytes:
+        """Store (or refresh) an identity; returns its pki-id.  Raises
+        ValueError when the identity is already expired."""
+        pki = self._mcs.get_pki_id(identity)
+        exp = identity_expiration(identity)
+        if exp is None:
+            exp = self._clock() + self._default_ttl
+        if exp <= self._clock():
+            raise ValueError("identity is expired")
+        with self._lock:
+            self._store[pki] = (identity, exp)
+        return pki
+
+    def get(self, pki: bytes) -> bytes | None:
+        with self._lock:
+            entry = self._store.get(pki)
+            if entry is None:
+                return None
+            identity, exp = entry
+            if exp <= self._clock():
+                del self._store[pki]
+            else:
+                return identity
+        self._on_purge(pki)
+        return None
+
+    def known(self) -> list[tuple[bytes, bytes]]:
+        """[(pki, identity)] of unexpired entries."""
+        self.sweep()
+        with self._lock:
+            return [(pki, ident) for pki, (ident, _) in self._store.items()]
+
+    def sweep(self) -> list[bytes]:
+        """Purge expired identities; returns the purged pki-ids
+        (reference identity.go periodic purge + SuspectPeers)."""
+        now = self._clock()
+        with self._lock:
+            dead = [p for p, (_, exp) in self._store.items() if exp <= now]
+            for p in dead:
+                del self._store[p]
+        for p in dead:
+            self._on_purge(p)
+        return dead
+
+
+__all__ = ["IdentityMapper", "identity_expiration"]

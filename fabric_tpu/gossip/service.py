@@ -11,9 +11,11 @@ from __future__ import annotations
 
 import threading
 
+from fabric_tpu.gossip.certstore import CertStore
 from fabric_tpu.gossip.core import ChannelGossip
 from fabric_tpu.gossip.discovery import DiscoveryCore
 from fabric_tpu.gossip.election import LeaderElection
+from fabric_tpu.gossip.identity import IdentityMapper
 from fabric_tpu.gossip.state import StateProvider
 
 
@@ -35,11 +37,24 @@ class GossipService:
         comm,
         bootstrap: list[str],
         alive_expiration_ticks: int = 5,
+        identity_ttl_s: float = 3600.0,
     ):
         self._comm = comm
         self.discovery = DiscoveryCore(
             comm, bootstrap, expiration_ticks=alive_expiration_ticks
         )
+        # identity dissemination: expiration-aware mapper + pull-based
+        # certstore (reference gossip/identity + gossip/gossip/certstore)
+        self.identities = IdentityMapper(
+            comm.mcs, comm.identity,
+            default_ttl_s=identity_ttl_s,
+            on_purge=comm.forget_identity,
+        )
+        self.certstore = CertStore(
+            comm, self.identities,
+            lambda: [p.endpoint for p in self.discovery.alive_peers()],
+        )
+        self.certstore.endpoint_lookup = self.discovery.endpoint_of
         self._channels: dict[str, ChannelHandle] = {}
         self._lock = threading.Lock()
         self._deliver_starters: dict[str, tuple] = {}
@@ -54,9 +69,13 @@ class GossipService:
         committer,
         deliver_client=None,  # object with .start()/.stop(), run by the leader
         fanout: int = 3,
+        store_capacity: int = 200,
     ) -> ChannelHandle:
         membership = lambda: [p.endpoint for p in self.discovery.alive_peers()]
-        gossip = ChannelGossip(channel_id, self._comm, membership, fanout=fanout)
+        gossip = ChannelGossip(
+            channel_id, self._comm, membership, fanout=fanout,
+            store_capacity=store_capacity,
+        )
         gossip.endpoint_lookup = self.discovery.endpoint_of
         state = StateProvider(channel_id, gossip, committer, self._comm)
 
@@ -81,8 +100,11 @@ class GossipService:
             return self._channels.get(channel_id)
 
     def tick(self) -> None:
-        """One logical round for the whole node: discovery + all channels."""
+        """One logical round for the whole node: discovery, identity
+        pull + expiration sweep, then all channels."""
         self.discovery.tick()
+        self.certstore.tick()
+        self.identities.sweep()
         with self._lock:
             handles = list(self._channels.values())
         for h in handles:

@@ -210,6 +210,13 @@ class KVLedger:
     def tx_id_exists(self, txid: str) -> bool:
         return self._blocks.get_tx_loc(txid) is not None
 
+    def define_index(self, ns: str, field: str) -> None:
+        """Create (and backfill) a rich-query index on a dotted JSON
+        field of a namespace — the statecouchdb index-definition
+        equivalent (statecouchdb.go:53); chaincode deployments feed
+        this from META-INF/statedb/indexes/*.json."""
+        self._state.define_index(ns, field)
+
     def new_tx_simulator(self) -> TxSimulator:
         return TxSimulator(self._state)
 

@@ -2,11 +2,21 @@
 (reference core/ledger/kvledger/txmgmt/statedb/statecouchdb with its
 Mango selector queries, surfaced to chaincode as GetQueryResult).
 
-The state backend here is ordered-KV, so selectors run as a scan with
-document matching — semantically the reference's behavior on an
-unindexed CouchDB field.  Supported selector subset: implicit equality,
-$eq $ne $gt $gte $lt $lte $in $nin $exists, dotted field paths, $and /
-$or combinators, and an optional "limit".
+Supported selector subset: implicit equality, $eq $ne $gt $gte $lt
+$lte $in $nin $exists, dotted field paths, $and / $or combinators, and
+an optional "limit".
+
+Execution is index-assisted when the statedb defines an index on a
+field the selector constrains conjunctively (statedb.VersionedDB
+define_index; reference statecouchdb.go:53 index-backed queries): the
+planner picks one indexed condition ($eq, then $in, then a range),
+range-scans the order-preserving index for candidate keys, and rechecks
+every candidate document with the full selector — so an imprecise index
+can only over-select, never change results.  Results are key-ordered
+and limit-truncated identically to the scan path, keeping endorsement
+read/write sets deterministic whether or not an index exists.  Without
+a usable index, selectors run as the full-namespace scan (semantically
+the reference's behavior on an unindexed CouchDB field).
 
 As in the reference, rich-query results are NOT protected by MVCC
 phantom detection (statecouchdb documents this caveat); only range
@@ -85,16 +95,103 @@ def match_selector(doc, selector: dict) -> bool:
     return True
 
 
-def execute_query(
-    pairs: Iterable[tuple[str, bytes]], query: str
-) -> list[tuple[str, bytes]]:
-    """Filter (key, value) pairs by a JSON selector query string."""
+def _parse_query(query: str) -> tuple[dict, int | None]:
     q = json.loads(query)
     selector = q.get("selector", {}) if isinstance(q, dict) else {}
     limit = q.get("limit") if isinstance(q, dict) else None
     if limit is not None:
         if not isinstance(limit, int) or isinstance(limit, bool) or limit < 0:
             raise ValueError(f"invalid limit {limit!r}")
+    return selector, limit
+
+
+def _conjunctive_conds(selector: dict) -> list[tuple[str, object]]:
+    """(field, condition) pairs that must ALL hold — top-level fields
+    plus $and arms; $or arms contribute nothing (any single-field
+    prefilter would under-select a disjunction)."""
+    out: list[tuple[str, object]] = []
+    for key, cond in selector.items():
+        if key == "$and":
+            for sub in cond:
+                if isinstance(sub, dict):
+                    out.extend(_conjunctive_conds(sub))
+        elif key != "$or":
+            out.append((key, cond))
+    return out
+
+
+def plan_index(selector: dict, indexed: set) -> tuple | None:
+    """Pick the best indexed prefilter: ("eq", field, value) |
+    ("in", field, values) | ("range", field, lo|None, hi|None) | None.
+    Range bounds are widened to inclusive (the recheck restores
+    exactness)."""
+    conds = [
+        (f, c) for f, c in _conjunctive_conds(selector) if f in indexed
+    ]
+    for field, cond in conds:
+        if not isinstance(cond, dict):
+            return ("eq", field, cond)
+        if "$eq" in cond:
+            return ("eq", field, cond["$eq"])
+    for field, cond in conds:
+        if isinstance(cond, dict) and isinstance(cond.get("$in"), list):
+            return ("in", field, cond["$in"])
+    for field, cond in conds:
+        if not isinstance(cond, dict):
+            continue
+        lo = cond.get("$gte", cond.get("$gt"))
+        hi = cond.get("$lte", cond.get("$lt"))
+        if lo is not None or hi is not None:
+            return ("range", field, lo, hi)
+    return None
+
+
+def execute_query_indexed(db, ns: str, query: str):
+    """Index-assisted execution against a statedb.VersionedDB; returns
+    [(key, value, version)] in key order, or None when no defined index
+    matches the selector (caller falls back to the scan path)."""
+    from fabric_tpu.ledger.statedb import encode_scalar
+
+    selector, limit = _parse_query(query)
+    p = plan_index(selector, db.indexes_for(ns))
+    if p is None:
+        return None
+    if p[0] == "eq":
+        keys = list(db.index_eq(ns, p[1], p[2]))
+    elif p[0] == "in":
+        keys = []
+        for v in p[2]:
+            keys.extend(db.index_eq(ns, p[1], v))
+    else:
+        _, field, lo, hi = p
+        lo_enc = encode_scalar(lo) if lo is not None else None
+        hi_enc = encode_scalar(hi) if hi is not None else None
+        if (lo is not None and lo_enc is None) or (
+            hi is not None and hi_enc is None
+        ):
+            return None  # unencodable bound: fall back to the scan
+        keys = list(db.index_scan(ns, field, lo_enc, hi_enc))
+    out = []
+    for key in sorted(set(keys)):
+        vv = db.get_state(ns, key)
+        if vv is None:
+            continue
+        try:
+            doc = json.loads(vv.value.decode("utf-8"))
+        except Exception:
+            continue
+        if isinstance(doc, dict) and match_selector(doc, selector):
+            out.append((key, vv.value, vv.version))
+            if limit is not None and len(out) >= limit:
+                break
+    return out
+
+
+def execute_query(
+    pairs: Iterable[tuple[str, bytes]], query: str
+) -> list[tuple[str, bytes]]:
+    """Filter (key, value) pairs by a JSON selector query string."""
+    selector, limit = _parse_query(query)
     out = []
     for key, value in pairs:
         if limit is not None and len(out) >= limit:
@@ -110,4 +207,9 @@ def execute_query(
     return out
 
 
-__all__ = ["match_selector", "execute_query"]
+__all__ = [
+    "match_selector",
+    "execute_query",
+    "execute_query_indexed",
+    "plan_index",
+]

@@ -3,13 +3,26 @@
 Reference SPI: core/ledger/kvledger/txmgmt/statedb/statedb.go:29
 (VersionedDB: GetState/GetStateMultipleKeys/GetStateRangeScanIterator/
 ApplyUpdates with a savepoint height).  Backend here is the KVStore SPI
-(stateleveldb equivalent); a CouchDB-style rich-query backend can slot in
-behind the same interface later.
+(stateleveldb equivalent).
+
+Field indexes (the CouchDB-backend performance surface —
+statecouchdb.go:53 index-backed Mango queries): an index on (ns, field)
+materializes order-preserving entries
+
+    \x03 ns \x00 field \x00 enc(value) \x00 key   ->  b""
+
+in the SAME ordered KV store, so an indexed selector runs as a range
+scan instead of a full-namespace document scan, on every backend
+(sqlite or memory), atomically maintained inside ApplyUpdates' one
+write batch.  `enc` is a type-tagged order-preserving encoding (null <
+bool < number < string); richquery's planner rechecks every candidate
+document, so the index only ever has to be a superset filter.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import json
 import struct
 
 from fabric_tpu.ledger.kvstore import KVStore, NamedDB
@@ -41,10 +54,98 @@ class VersionedValue:
 
 _NS_SEP = b"\x00"
 _SAVEPOINT_KEY = b"\x01savepoint"
+_IDX_PREFIX = b"\x03"
+_IDX_DEF_PREFIX = b"\x04"
 
 
 def _state_key(ns: str, key: str) -> bytes:
     return b"\x02" + ns.encode() + _NS_SEP + key.encode()
+
+
+def _esc(raw: bytes) -> bytes:
+    """Order-preserving escape so \\x00 can terminate components."""
+    return raw.replace(b"\x00", b"\x00\xff")
+
+
+def encode_scalar(v) -> bytes | None:
+    """Type-tagged order-preserving encoding of a JSON scalar; None for
+    non-indexable values (objects/arrays)."""
+    if v is None:
+        return b"\x01"
+    if isinstance(v, bool):
+        return b"\x02" + (b"\x01" if v else b"\x00")
+    if isinstance(v, (int, float)):
+        bits = struct.unpack(">Q", struct.pack(">d", float(v)))[0]
+        # IEEE754 total-order trick: flip sign bit for positives,
+        # invert everything for negatives
+        bits = bits ^ 0x8000000000000000 if bits < 1 << 63 else ~bits & (1 << 64) - 1
+        return b"\x03" + struct.pack(">Q", bits)
+    if isinstance(v, str):
+        return b"\x04" + _esc(v.encode("utf-8"))
+    return None
+
+
+def _idx_entry_state_key(rest: bytes) -> str | None:
+    """Parse `enc \\x00 statekey` (the tail of an index entry after the
+    ns/field prefix) and return the state key.  The encoding length is
+    recovered from its type tag — number encodings and state keys (e.g.
+    composite keys) may legitimately contain \\x00 bytes, so a plain
+    split would misparse."""
+    tag = rest[0:1]
+    if tag == b"\x01":
+        n = 1
+    elif tag == b"\x02":
+        n = 2
+    elif tag == b"\x03":
+        n = 9
+    elif tag == b"\x04":  # escaped string: ends at the first bare \x00
+        i = 1
+        while True:
+            j = rest.find(b"\x00", i)
+            if j < 0:
+                return None
+            if rest[j + 1:j + 2] == b"\xff":
+                i = j + 2
+                continue
+            n = j
+            break
+    else:
+        return None
+    if rest[n:n + 1] != b"\x00":
+        return None
+    try:
+        return rest[n + 1:].decode()
+    except UnicodeDecodeError:
+        return None
+
+
+def _idx_key(ns: str, field: str, enc: bytes, key: str) -> bytes:
+    return (
+        _IDX_PREFIX + _esc(ns.encode()) + b"\x00" + _esc(field.encode())
+        + b"\x00" + enc + b"\x00" + key.encode()
+    )
+
+
+def _idx_prefix(ns: str, field: str, enc: bytes = b"") -> bytes:
+    base = _IDX_PREFIX + _esc(ns.encode()) + b"\x00" + _esc(field.encode()) + b"\x00"
+    return base + enc
+
+
+def _doc_field(value: bytes, path: str):
+    """Extract a dotted field from a JSON document value; (None, False)
+    when the value is not JSON or the path is absent."""
+    try:
+        doc = json.loads(value.decode("utf-8"))
+    except Exception:
+        return None, False
+    if not isinstance(doc, dict):
+        return None, False
+    cur = doc
+    for part in path.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return None, False
+        cur = cur[part]
+    return cur, True
 
 
 def _encode_value(vv: VersionedValue) -> bytes:
@@ -64,10 +165,95 @@ def _decode_value(raw: bytes) -> VersionedValue:
 
 
 class VersionedDB:
-    """KV-backed versioned state (reference stateleveldb.VersionedDB)."""
+    """KV-backed versioned state (reference stateleveldb.VersionedDB),
+    with optional per-(ns, field) rich-query indexes."""
 
     def __init__(self, store: KVStore, name: str = "statedb"):
         self._db = NamedDB(store, name)
+        self._indexes: dict[str, set[str]] | None = None  # lazy-loaded
+
+    # -- index definitions -------------------------------------------------
+
+    def _load_indexes(self) -> dict[str, set[str]]:
+        if self._indexes is None:
+            out: dict[str, set[str]] = {}
+            end = _IDX_DEF_PREFIX + b"\xff"
+            for k, _ in self._db.iterate(_IDX_DEF_PREFIX, end):
+                ns_b, field_b = k[len(_IDX_DEF_PREFIX):].split(b"\x00", 1)
+                out.setdefault(ns_b.decode(), set()).add(field_b.decode())
+            self._indexes = out
+        return self._indexes
+
+    def indexes_for(self, ns: str) -> set[str]:
+        return self._load_indexes().get(ns, set())
+
+    def define_index(self, ns: str, field: str) -> None:
+        """Create (and backfill) an index on a dotted JSON field —
+        the statecouchdb index-definition equivalent.  Idempotent."""
+        if field in self.indexes_for(ns):
+            return
+        puts = {_IDX_DEF_PREFIX + ns.encode() + b"\x00" + field.encode(): b""}
+        for key, vv in self.get_state_range(ns, "", ""):
+            val, present = _doc_field(vv.value, field)
+            if present:
+                enc = encode_scalar(val)
+                if enc is not None:
+                    puts[_idx_key(ns, field, enc, key)] = b""
+        self._db.write_batch(puts, [])
+        self._load_indexes().setdefault(ns, set()).add(field)
+
+    # -- index scans (planner entry points) --------------------------------
+
+    def index_scan(self, ns: str, field: str, lo: bytes | None,
+                   hi: bytes | None):
+        """Yield state keys whose indexed encoding is in [lo, hi]
+        (inclusive; None = open end).  Encodings come from
+        encode_scalar; the caller rechecks each document."""
+        start = _idx_prefix(ns, field, lo if lo is not None else b"")
+        if hi is None:
+            end = _idx_prefix(ns, field) + b"\xfe\xff"
+        else:
+            end = _idx_prefix(ns, field, hi) + b"\x01"
+        plen = len(_idx_prefix(ns, field))
+        for k, _ in self._db.iterate(start, end):
+            key = _idx_entry_state_key(k[plen:])
+            if key is not None:
+                yield key
+
+    def index_eq(self, ns: str, field: str, value):
+        enc = encode_scalar(value)
+        if enc is None:
+            return
+        yield from self.index_scan(ns, field, enc, enc)
+
+    def _index_mutations(self, batch: dict, puts: dict, deletes: list) -> None:
+        """Maintain index entries for namespaces with indexes: remove the
+        old value's entries, add the new value's — inside the same
+        atomic write batch as the state update."""
+        idx = self._load_indexes()
+        dels: set[bytes] = set()
+        for ns, kvs in batch.items():
+            fields = idx.get(ns)
+            if not fields:
+                continue
+            for key, vv in kvs.items():
+                old = self.get_state(ns, key)
+                for field in fields:
+                    if old is not None:
+                        oval, opresent = _doc_field(old.value, field)
+                        if opresent:
+                            oenc = encode_scalar(oval)
+                            if oenc is not None:
+                                dels.add(_idx_key(ns, field, oenc, key))
+                    if vv is not None:
+                        nval, npresent = _doc_field(vv.value, field)
+                        if npresent:
+                            nenc = encode_scalar(nval)
+                            if nenc is not None:
+                                puts[_idx_key(ns, field, nenc, key)] = b""
+        # an unchanged encoding would be deleted after being re-put
+        # (write_batch applies puts before deletes) — drop those
+        deletes.extend(dels - puts.keys())
 
     def get_state(self, ns: str, key: str) -> VersionedValue | None:
         raw = self._db.get(_state_key(ns, key))
@@ -95,7 +281,8 @@ class VersionedDB:
         """batch: {ns: {key: VersionedValue | None}} (None = delete).
         Atomic with the savepoint write (reference ApplyUpdates)."""
         puts: dict[bytes, bytes] = {}
-        deletes = []
+        deletes: list[bytes] = []
+        self._index_mutations(batch, puts, deletes)  # reads OLD state
         for ns, kvs in batch.items():
             for key, vv in kvs.items():
                 if vv is None:
@@ -111,4 +298,4 @@ class VersionedDB:
         return None if raw is None else Height.unpack(raw)
 
 
-__all__ = ["Height", "VersionedValue", "VersionedDB"]
+__all__ = ["Height", "VersionedValue", "VersionedDB", "encode_scalar"]

@@ -197,7 +197,19 @@ class TxSimulator:
         for MVCC version checks (reference queryHelper adds each result
         to the rwset); only phantoms go unprotected, matching the
         reference's couchdb caveat."""
-        from fabric_tpu.ledger.richquery import execute_query
+        from fabric_tpu.ledger.richquery import (
+            execute_query,
+            execute_query_indexed,
+        )
+
+        if hasattr(self._db, "indexes_for"):
+            got = execute_query_indexed(self._db, ns, query)
+            if got is not None:
+                out = []
+                for key, value, version in got:
+                    self._reads.setdefault((ns, key), version)
+                    out.append((key, value))
+                return out
 
         versions = {}
 

@@ -36,7 +36,9 @@ class OrdererNode:
         consenter_overrides: dict | None = None,
         node_id: int = 1,
         transport=None,
+        tls=None,
     ):
+        self.tls = tls  # comm.tls.TLSCredentials | None
         self.registrar = Registrar(
             root_dir,
             csp,
@@ -61,7 +63,7 @@ class OrdererNode:
             self.registrar.startup(genesis_blocks)
 
         self._signer = signer
-        self.rpc = RPCServer(host, port)
+        self.rpc = RPCServer(host, port, tls=tls)
         self.rpc.register("ab.Broadcast", self._broadcast)
         self.rpc.register("ab.Deliver", self._deliver)
         self.rpc.register("participation.Join", self._join)
@@ -123,7 +125,9 @@ class OrdererNode:
         if self.registrar.get_chain(channel_id) is not None:
             raise ValueError(f"channel {channel_id!r} already exists")
         host, _, port = req["from"].rpartition(":")
-        client = RPCClient(host or "127.0.0.1", int(port), timeout=30.0)
+        client = RPCClient(
+            host or "127.0.0.1", int(port), timeout=30.0, tls=self.tls
+        )
         env = make_seek_info_envelope(
             channel_id, 0, "newest", signer=self._signer,
             behavior=ab_pb2.SeekInfo.FAIL_IF_NOT_READY,

@@ -78,7 +78,9 @@ class _Channel:
             self.deliver_client = DeliverClient(
                 self.channel_id,
                 [
-                    _orderer_deliver_fn(ep, self.channel_id, node.signer)
+                    _orderer_deliver_fn(
+                        ep, self.channel_id, node.signer, tls=node.tls
+                    )
                     for ep in node.orderer_endpoints
                 ],
                 height_fn=lambda: self.ledger.height,
@@ -86,7 +88,14 @@ class _Channel:
                 bundle=self.bundle,
                 csp=node.csp,
             )
-            self.deliver_client.start()
+            # with gossip enabled, leader election decides which peer
+            # runs the orderer deliver client (gossip_service.go:205
+            # leaderElection -> deliveryService); without it, every
+            # peer pulls for itself
+            if node.gossip is None:
+                self.deliver_client.start()
+        if node.gossip is not None:
+            node.gossip_join_channel(self)
 
     @property
     def store(self):  # DeliverService support surface (.height,
@@ -104,13 +113,14 @@ class _Channel:
             self.deliver_client.stop()
 
 
-def _orderer_deliver_fn(endpoint: tuple[str, int], channel_id: str, signer):
+def _orderer_deliver_fn(endpoint: tuple[str, int], channel_id: str, signer,
+                        tls=None):
     """start_num -> iterator of Block, over the orderer's ab.Deliver."""
     from fabric_tpu.comm import RPCClient
     from fabric_tpu.common.deliver import make_seek_info_envelope
 
     def connect(start_num: int):
-        client = RPCClient(*endpoint, timeout=30.0)
+        client = RPCClient(*endpoint, timeout=30.0, tls=tls)
         env = make_seek_info_envelope(
             channel_id, start_num, 0x7FFFFFFFFFFFFFFF, signer=signer
         )
@@ -122,6 +132,24 @@ def _orderer_deliver_fn(endpoint: tuple[str, int], channel_id: str, signer):
                 return
 
     return connect
+
+
+class _NodeDeserializer:
+    """Identity deserializer spanning every joined channel's MSP manager
+    (gossip message verification is node-scoped; the reference routes it
+    through the channel MSPs too)."""
+
+    def __init__(self, node: "PeerNode"):
+        self._node = node
+
+    def deserialize_identity(self, raw: bytes):
+        last: Exception | None = None
+        for ch in list(self._node.channels.values()):
+            try:
+                return ch.bundle.msp_manager.deserialize_identity(raw)
+            except Exception as e:  # try the next channel's MSPs
+                last = e
+        raise last or ValueError("no channel MSP recognizes identity")
 
 
 class PeerNode:
@@ -138,9 +166,15 @@ class PeerNode:
         operations_port: int | None = None,
         endorser_concurrency: int = 2500,
         deliver_concurrency: int = 2500,
+        tls=None,
     ):
         self.csp = csp
         self.signer = signer
+        self.tls = tls  # comm.tls.TLSCredentials | None — all transports
+        self.gossip = None  # GossipService when enable_gossip() was called
+        self.gossip_comm = None
+        self._gossip_runner = None
+        self._gossip_opts: dict = {}
         self.provider = LedgerProvider(root_dir)
         self.orderer_endpoints = orderer_endpoints or []
         self.channels: dict[str, _Channel] = {}
@@ -208,7 +242,7 @@ class PeerNode:
                 ) else "empty ledger",
             )
 
-        self.rpc = RPCServer(host, port)
+        self.rpc = RPCServer(host, port, tls=tls)
         # per-service concurrency limiters (reference
         # internal/peer/node/grpc_limiters.go; values from core.yaml
         # peer.limits.concurrency via the CLI, defaults 2500)
@@ -457,6 +491,49 @@ class PeerNode:
 
     # -- lifecycle ---------------------------------------------------------
 
+    # -- gossip ------------------------------------------------------------
+
+    def enable_gossip(
+        self,
+        listen: tuple[str, int],
+        bootstrap: list[str],
+        fanout: int = 3,
+        store_capacity: int = 200,
+        tick_interval_s: float = 1.0,
+        identity_ttl_s: float = 3600.0,
+    ) -> None:
+        """Start the gossip stack (TCP transport over the node's TLS,
+        SWIM discovery, certstore identity pull, per-channel block
+        dissemination + leader election).  Call before start(); knobs
+        come from core.yaml peer.gossip.* via the CLI."""
+        from fabric_tpu.gossip import GossipRunner, GossipService
+        from fabric_tpu.gossip.comm import SignerMCS, TCPGossipComm
+
+        mcs = SignerMCS(self.signer, _NodeDeserializer(self), self.csp)
+        self.gossip_comm = TCPGossipComm(
+            listen, self.signer.serialize(), mcs=mcs, tls=self.tls
+        )
+        self.gossip = GossipService(
+            self.gossip_comm, bootstrap, identity_ttl_s=identity_ttl_s
+        )
+        self._gossip_opts = {
+            "fanout": fanout, "store_capacity": store_capacity,
+        }
+        for ch in list(self.channels.values()):
+            self.gossip_join_channel(ch)
+        self._gossip_runner = GossipRunner(self.gossip, tick_interval_s)
+        self._gossip_runner.start()
+
+    def gossip_join_channel(self, ch: _Channel) -> None:
+        if self.gossip.channel(ch.channel_id) is not None:
+            return
+        self.gossip.join_channel(
+            ch.channel_id,
+            ch.committer,
+            deliver_client=ch.deliver_client,
+            **self._gossip_opts,
+        )
+
     @property
     def addr(self):
         return self.rpc.addr
@@ -469,6 +546,10 @@ class PeerNode:
     def stop(self) -> None:
         self.rpc.stop()
         self.deliver.stop()
+        if self._gossip_runner is not None:
+            self._gossip_runner.stop()
+        if self.gossip_comm is not None:
+            self.gossip_comm.close()
         if self.operations is not None:
             self.operations.stop()
         for ch in self.channels.values():

@@ -12,6 +12,13 @@ one interface:
                      for real multi-process deployments; per-peer sender
                      threads with bounded queues (drop-on-overflow, raft
                      tolerates loss) and automatic reconnect.
+
+TLS: pass a comm.tls.TLSCredentials with `pinned_certs` set to the
+consenter set's TLS leaf DERs — every link is then mutual TLS and BOTH
+sides require the counterparty's exact certificate to be in the
+allowlist, the reference's pinned-cert cluster scheme
+(orderer/common/cluster/comm.go:116 VerifyConnection); update the
+allowlist on config changes via set_pinned().
 """
 
 from __future__ import annotations
@@ -65,8 +72,10 @@ class InProcTransport:
 
 
 class _PeerSender:
-    def __init__(self, addr: tuple[str, int]):
+    def __init__(self, addr: tuple[str, int], tls=None, ssl_ctx=None):
         self.addr = addr
+        self._tls = tls
+        self._ssl_ctx = ssl_ctx
         self.q: queue.Queue = queue.Queue(maxsize=4096)
         self._sock: socket.socket | None = None
         self._thread = threading.Thread(target=self._run, daemon=True)
@@ -83,6 +92,15 @@ class _PeerSender:
         try:
             s = socket.create_connection(self.addr, timeout=2.0)
             s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            if self._ssl_ctx is not None:
+                s = self._ssl_ctx.wrap_socket(
+                    s, server_hostname=self.addr[0]
+                )
+                if not self._tls.check_pinned(
+                    s.getpeercert(binary_form=True)
+                ):
+                    s.close()
+                    return None  # counterparty not in the consenter set
             return s
         except OSError:
             return None
@@ -118,9 +136,12 @@ class _PeerSender:
 class TCPTransport:
     """One listener per ordering node; senders keyed by node id."""
 
-    def __init__(self, node_id: int, listen_addr: tuple[str, int]):
+    def __init__(self, node_id: int, listen_addr: tuple[str, int], tls=None):
         self.node_id = node_id
         self._handler = None
+        self._tls = tls
+        self._server_ctx = tls.server_context() if tls is not None else None
+        self._client_ctx = tls.client_context() if tls is not None else None
         self._peers: dict[int, _PeerSender] = {}
         self._lock = threading.Lock()
         self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -142,7 +163,9 @@ class TCPTransport:
                 return
             if old is not None:
                 old.close()
-            self._peers[node_id] = _PeerSender(tuple(addr))
+            self._peers[node_id] = _PeerSender(
+                tuple(addr), self._tls, self._client_ctx
+            )
 
     def remove_peer(self, node_id: int) -> None:
         with self._lock:
@@ -166,9 +189,26 @@ class TCPTransport:
                 target=self._serve_conn, args=(conn,), daemon=True
             ).start()
 
+    def set_pinned(self, certs: list) -> None:
+        """Replace the pinned-cert allowlist (DER leaves) — called when a
+        config block changes the consenter set."""
+        if self._tls is not None:
+            self._tls.pinned_certs = list(certs)
+
     def _serve_conn(self, conn: socket.socket) -> None:
         buf = b""
         conn.settimeout(30.0)
+        if self._server_ctx is not None:
+            try:
+                conn = self._server_ctx.wrap_socket(conn, server_side=True)
+            except OSError:
+                return
+            if not self._tls.check_pinned(conn.getpeercert(binary_form=True)):
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                return
         try:
             while not self._stop.is_set():
                 while len(buf) < _LEN.size:

@@ -1,0 +1,96 @@
+"""Worker process for the multi-process gossip convergence test
+(test_gossip_mp.py): one gossip node over the real TCP transport.
+
+argv: name listen_port bootstrap(-|host:port) have_lo have_hi
+      want_blocks want_idents out_json
+Adds blocks [have_lo, have_hi] with push DISABLED, then ticks until it
+holds want_blocks blocks and want_idents identities — i.e. convergence
+happens purely through the pull engines (block pull + state
+anti-entropy + certstore identity pull)."""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from fabric_tpu.gossip import GossipService
+from fabric_tpu.gossip.comm import MessageCryptoService, TCPGossipComm
+from fabric_tpu.protos.common import common_pb2
+
+
+class ToyMCS(MessageCryptoService):
+    def sign(self, payload: bytes) -> bytes:
+        return hashlib.sha256(b"mp-secret" + payload).digest()
+
+    def verify(self, identity: bytes, signature: bytes, payload: bytes) -> bool:
+        return signature == hashlib.sha256(b"mp-secret" + payload).digest()
+
+
+class Committer:
+    def __init__(self):
+        self.blocks: dict[int, common_pb2.Block] = {}
+
+    @property
+    def height(self) -> int:
+        return (max(self.blocks) + 1) if self.blocks else 1
+
+    def store_block(self, blk: common_pb2.Block) -> None:
+        self.blocks[blk.header.number] = blk
+
+    def get_block_by_number(self, seq: int):
+        return self.blocks.get(seq)
+
+
+def _block(seq: int) -> bytes:
+    b = common_pb2.Block()
+    b.header.number = seq
+    b.data.data.append(b"tx-%d" % seq)
+    return b.SerializeToString()
+
+
+def main(argv) -> int:
+    name, port, bootstrap, lo, hi, want_blocks, want_idents, out = argv
+    comm = TCPGossipComm(("127.0.0.1", int(port)), name.encode(), mcs=ToyMCS())
+    svc = GossipService(
+        comm, bootstrap=[] if bootstrap == "-" else [bootstrap]
+    )
+    committer = Committer()
+    handle = svc.join_channel("mpch", committer)
+    for seq in range(int(lo), int(hi) + 1):
+        handle.gossip.add_block(seq, _block(seq), push=False)
+
+    deadline = time.time() + 60
+    converged = False
+    grace_until = None  # keep serving pulls so LATER joiners converge too
+    while time.time() < deadline:
+        svc.tick()
+        idents = {i for _, i in svc.identities.known()}
+        if (
+            len(committer.blocks) >= int(want_blocks)
+            and len(idents) >= int(want_idents)
+        ):
+            converged = True
+            if grace_until is None:
+                grace_until = time.time() + 12
+            elif time.time() >= grace_until:
+                break
+        time.sleep(0.2)
+    with open(out, "w") as f:
+        json.dump(
+            {
+                "blocks": sorted(committer.blocks),
+                "identities": sorted(i.decode() for i in idents),
+            },
+            f,
+        )
+    comm.close()
+    return 0 if converged else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

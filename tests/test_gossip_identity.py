@@ -1,0 +1,119 @@
+"""IdentityMapper expiration semantics + certstore verification:
+forged pki bindings and wrong-signer identity messages are rejected;
+expired identities are purged (and the comm layer notified)."""
+
+from __future__ import annotations
+
+import hashlib
+
+from fabric_tpu.gossip.certstore import CertStore
+from fabric_tpu.gossip.comm import (
+    InProcGossipComm,
+    InProcGossipNet,
+    MessageCryptoService,
+)
+from fabric_tpu.gossip.identity import IdentityMapper, identity_expiration
+from fabric_tpu.protos.gossip import message_pb2 as gpb
+
+
+class ToyMCS(MessageCryptoService):
+    """Per-identity deterministic signatures (key = the identity)."""
+
+    def sign_as(self, identity: bytes, payload: bytes) -> bytes:
+        return hashlib.sha256(identity + b"|" + payload).digest()
+
+    def verify(self, identity: bytes, signature: bytes, payload: bytes) -> bool:
+        return signature == self.sign_as(identity, payload)
+
+
+class SelfSigningMCS(ToyMCS):
+    def __init__(self, identity: bytes):
+        self._id = identity
+
+    def sign(self, payload: bytes) -> bytes:
+        return self.sign_as(self._id, payload)
+
+
+def test_mapper_expiration_and_purge_hook():
+    now = [1000.0]
+    purged = []
+    mcs = MessageCryptoService()
+    m = IdentityMapper(
+        mcs, b"me", default_ttl_s=50, clock=lambda: now[0],
+        on_purge=purged.append,
+    )
+    pki = m.put(b"other")
+    assert m.get(pki) == b"other"
+    now[0] += 49
+    assert m.get(pki) == b"other"
+    now[0] += 2  # past the TTL
+    assert m.get(pki) is None
+    assert purged == [pki]
+    assert all(p != pki for p, _ in m.known())
+
+
+def test_mapper_x509_expiration_from_cert():
+    from fabric_tpu.common.crypto import CA
+    from fabric_tpu.protos.msp import identities_pb2
+
+    ca = CA("expca", "org")
+    pair = ca.issue("ephemeral", validity_days=1)
+    sid = identities_pb2.SerializedIdentity(
+        mspid="OrgMSP", id_bytes=pair.cert_pem
+    ).SerializeToString()
+    exp = identity_expiration(sid)
+    assert exp is not None
+    # mapper honors the certificate's notAfter
+    m = IdentityMapper(MessageCryptoService(), b"me", clock=lambda: exp + 1)
+    try:
+        m.put(sid)
+        raise AssertionError("expired identity must be rejected")
+    except ValueError:
+        pass
+
+
+def _certstore_pair():
+    net = InProcGossipNet()
+    a = InProcGossipComm("a", net, b"idA", mcs=SelfSigningMCS(b"idA"))
+    b = InProcGossipComm("b", net, b"idB", mcs=SelfSigningMCS(b"idB"))
+    ma = IdentityMapper(a.mcs, b"idA")
+    mb = IdentityMapper(b.mcs, b"idB")
+    csa = CertStore(a, ma, lambda: ["b"])
+    csb = CertStore(b, mb, lambda: ["a"])
+    csa.endpoint_lookup = lambda pki: "b" if pki == b.pki_id else "a"
+    csb.endpoint_lookup = lambda pki: "a" if pki == a.pki_id else "b"
+    return a, b, ma, mb, csa, csb
+
+
+def test_certstore_pull_disseminates_identities():
+    a, b, ma, mb, csa, csb = _certstore_pair()
+    assert mb.get(a.pki_id) is None
+    csb.tick()  # b pulls from a
+    assert mb.get(a.pki_id) == b"idA"
+    assert b.identity_of(a.pki_id) == b"idA"
+    csa.tick()
+    assert ma.get(b.pki_id) == b"idB"
+
+
+def test_certstore_rejects_forged_pki_binding():
+    a, b, ma, mb, csa, csb = _certstore_pair()
+    # craft an identity message whose pki does not derive from the cert
+    m = gpb.GossipMessage()
+    m.peer_identity.pki_id = b"\x00" * 16
+    m.peer_identity.cert = b"idZ"
+    signed = gpb.SignedGossipMessage(payload=m.SerializeToString())
+    signed.signature = a.mcs.sign_as(b"idZ", signed.payload)
+    csb._learn(signed)
+    assert mb.get(b"\x00" * 16) is None
+
+
+def test_certstore_rejects_wrong_signer():
+    a, b, ma, mb, csa, csb = _certstore_pair()
+    m = gpb.GossipMessage()
+    m.peer_identity.pki_id = a.mcs.get_pki_id(b"idZ")
+    m.peer_identity.cert = b"idZ"
+    signed = gpb.SignedGossipMessage(payload=m.SerializeToString())
+    # signed by idA, not by idZ's owner
+    signed.signature = a.mcs.sign_as(b"idA", signed.payload)
+    csb._learn(signed)
+    assert mb.get(a.mcs.get_pki_id(b"idZ")) is None

@@ -15,6 +15,19 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+# TLS material layout (cryptogen output, relative to the network root)
+_ORD_TLS = ("crypto-config/ordererOrganizations/example.com/orderers/"
+            "orderer.example.com/tls")
+_ORD_TLSCA = ("crypto-config/ordererOrganizations/example.com/tlsca/"
+              "tlsca.example.com-cert.pem")
+_ORG1_TLSCA = ("crypto-config/peerOrganizations/org1.example.com/tlsca/"
+               "tlsca.org1.example.com-cert.pem")
+_PEER_TLS = ("crypto-config/peerOrganizations/org1.example.com/peers/"
+             "peer0.org1.example.com/tls")
+_ADMIN_TLS = ("crypto-config/peerOrganizations/org1.example.com/users/"
+              "Admin@org1.example.com/tls")
+
+
 def _free_port() -> int:
     s = socket.socket()
     s.bind(("127.0.0.1", 0))
@@ -123,6 +136,8 @@ class Network:
             "--msp-dir",
             "crypto-config/ordererOrganizations/example.com/orderers/"
             "orderer.example.com/msp",
+            "--tls-dir", _ORD_TLS,
+            "--tls-root", _ORG1_TLSCA,
         ])
         _wait_listening(self.orderer_port)
 
@@ -137,6 +152,8 @@ class Network:
             "peer0.org1.example.com/msp",
             "--orderer", f"127.0.0.1:{self.orderer_port}",
             "--chaincode", "kvcc=kvcc:KV",
+            "--tls-dir", _PEER_TLS,
+            "--tls-root", _ORD_TLSCA,
         ])
         _wait_listening(self.peer_port)
 
@@ -170,8 +187,12 @@ class Network:
         return ("crypto-config/peerOrganizations/org1.example.com/users/"
                 "Admin@org1.example.com/msp")
 
+    @property
+    def client_tls(self) -> list[str]:
+        return ["--tls-dir", _ADMIN_TLS, "--tls-root", _ORD_TLSCA]
+
     def peer_cli(self, *args: str) -> subprocess.CompletedProcess:
-        return self.cli(["fabric_tpu.cmd.peer", *args])
+        return self.cli(["fabric_tpu.cmd.peer", *args, *self.client_tls])
 
     def invoke(self, *cc_args: str) -> subprocess.CompletedProcess:
         argv = ["chaincode", "invoke", "-C", "nwoch", "-n", "kvcc"]
@@ -240,6 +261,7 @@ def test_discover_peers_and_endorsers(net):
         "fabric_tpu.cmd.discover", "peers", "--channel", "nwoch",
         "--peer", f"127.0.0.1:{net.peer_port}",
         "--mspid", "Org1MSP", "--msp-dir", net.admin_msp,
+        *net.client_tls,
     ])
     assert out.returncode == 0, out.stderr
     peers = json.loads(out.stdout)
@@ -250,6 +272,7 @@ def test_discover_peers_and_endorsers(net):
         "--chaincode", "kvcc",
         "--peer", f"127.0.0.1:{net.peer_port}",
         "--mspid", "Org1MSP", "--msp-dir", net.admin_msp,
+        *net.client_tls,
     ])
     assert out.returncode == 0, out.stderr
     assert json.loads(out.stdout), "endorser selection empty"
@@ -288,3 +311,35 @@ def test_peer_sigterm_restart_recovers_state(net):
             return
         time.sleep(0.3)
     raise AssertionError("state not recovered after peer restart")
+
+
+def test_wrong_ca_client_rejected_by_peer(net):
+    """The network runs mutual TLS: a client presenting a cert from an
+    unrelated CA must be refused by the peer's transport (the
+    reference's ClientAuthRequired threat model)."""
+    import sys as _sys
+
+    _sys.path.insert(0, REPO)
+    from fabric_tpu.comm.rpc import RPCClient, RPCError
+    from fabric_tpu.comm.tls import credentials_from_ca
+    from fabric_tpu.common.crypto import CA
+
+    rogue_ca = CA("tlsca.rogue.example.com", "rogue")
+    creds = credentials_from_ca(rogue_ca, "intruder")
+    # trust the peer's real TLS CA so only CLIENT auth can fail
+    with open(os.path.join(net.root, _ORG1_TLSCA), "rb") as f:
+        creds.ca_pems.append(f.read())
+    cli = RPCClient("127.0.0.1", net.peer_port, timeout=5, tls=creds)
+    with pytest.raises((RPCError, OSError)):
+        cli.call("admin.Channels")
+
+
+def test_plaintext_client_rejected_by_peer(net):
+    import sys as _sys
+
+    _sys.path.insert(0, REPO)
+    from fabric_tpu.comm.rpc import RPCClient, RPCError
+
+    cli = RPCClient("127.0.0.1", net.peer_port, timeout=5)
+    with pytest.raises((RPCError, OSError)):
+        cli.call("admin.Channels")

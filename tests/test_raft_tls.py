@@ -1,0 +1,108 @@
+"""Raft cluster TCP transport over pinned mutual TLS: consenter-set
+members exchange Step frames; a node whose cert is not pinned cannot
+deliver into the cluster (reference orderer/common/cluster/comm.go:116
+VerifyConnection pinning)."""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from fabric_tpu.comm.tls import credentials_from_ca
+from fabric_tpu.common.crypto import CA
+from fabric_tpu.orderer.raft.transport import TCPTransport
+from fabric_tpu.protos.orderer import raft_pb2 as rpb
+
+
+@pytest.fixture(scope="module")
+def ca():
+    return CA("tlsca.orderer.example.com", "orderer")
+
+
+def _wait(pred, timeout=5.0):
+    end = time.time() + timeout
+    while time.time() < end:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def _step(frm: int, term: int = 7) -> rpb.StepRequest:
+    req = rpb.StepRequest()
+    req.channel = "tlsch"
+    req.consensus.type = rpb.MSG_APPEND
+    req.consensus.sender = frm
+    req.consensus.term = term
+    return req
+
+
+def test_pinned_cluster_step(ca):
+    creds = {i: credentials_from_ca(ca, f"orderer{i}") for i in (1, 2)}
+    pinned = [c.cert_der for c in creds.values()]
+    for c in creds.values():
+        c.pinned_certs = list(pinned)
+
+    t1 = TCPTransport(1, ("127.0.0.1", 0), tls=creds[1])
+    t2 = TCPTransport(2, ("127.0.0.1", 0), tls=creds[2])
+    got = []
+    t2.set_handler(lambda req: got.append(req.consensus.sender))
+    try:
+        t1.set_peer(2, t2.addr)
+        t1.send(1, 2, _step(1))
+        assert _wait(lambda: got == [1])
+    finally:
+        t1.close()
+        t2.close()
+
+
+def test_unpinned_node_rejected(ca):
+    creds = {i: credentials_from_ca(ca, f"orderer{i}") for i in (1, 2)}
+    pinned = [c.cert_der for c in creds.values()]
+    for c in creds.values():
+        c.pinned_certs = list(pinned)
+
+    t2 = TCPTransport(2, ("127.0.0.1", 0), tls=creds[2])
+    got = []
+    t2.set_handler(lambda req: got.append(req.consensus.sender))
+
+    # same CA, valid chain — but not in the consenter allowlist
+    rogue_creds = credentials_from_ca(ca, "rogue-orderer")
+    rogue_creds.pinned_certs = list(pinned)  # it even pins the others
+    rogue = TCPTransport(9, ("127.0.0.1", 0), tls=rogue_creds)
+    try:
+        rogue.set_peer(2, t2.addr)
+        rogue.send(9, 2, _step(9))
+        assert not _wait(lambda: got, timeout=1.5)
+    finally:
+        rogue.close()
+        t2.close()
+
+
+def test_set_pinned_admits_new_consenter(ca):
+    creds = {i: credentials_from_ca(ca, f"orderer{i}") for i in (1, 2)}
+    pinned = [creds[1].cert_der, creds[2].cert_der]
+    for c in creds.values():
+        c.pinned_certs = list(pinned)
+
+    t2 = TCPTransport(2, ("127.0.0.1", 0), tls=creds[2])
+    got = []
+    t2.set_handler(lambda req: got.append(req.consensus.sender))
+
+    c3 = credentials_from_ca(ca, "orderer3")
+    c3.pinned_certs = list(pinned)
+    t3 = TCPTransport(3, ("127.0.0.1", 0), tls=c3)
+    try:
+        t3.set_peer(2, t2.addr)
+        t3.send(3, 2, _step(3))
+        assert not _wait(lambda: got, timeout=1.0), "not yet admitted"
+        # config change adds orderer3 to the consenter set
+        t2.set_pinned(pinned + [c3.cert_der])
+        t3.remove_peer(2)  # drop the sender's failed/cached socket
+        t3.set_peer(2, t2.addr)
+        t3.send(3, 2, _step(3))
+        assert _wait(lambda: got == [3])
+    finally:
+        t3.close()
+        t2.close()
