@@ -50,7 +50,7 @@ def _emit_node(base: str, ca: CA, name: str, ou: str, node_ous: bool = True,
         os.makedirs(tdir, exist_ok=True)
         host = name.split(".", 1)[0]
         tpair = tlsca.issue(
-            name, sans=[name, host, "localhost"], client=True, server=True
+            name, sans=[name, host, "localhost", "127.0.0.1"], client=True, server=True
         )
         stem = "server" if server else "client"
         with open(os.path.join(tdir, "ca.crt"), "wb") as f:

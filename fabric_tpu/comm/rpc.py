@@ -230,7 +230,7 @@ class RPCClient:
         self._tls = tls  # comm.tls.TLSCredentials | None
         self._server_hostname = server_hostname
         self._ssl_context = (
-            tls.client_context(server_hostname) if tls is not None else None
+            tls.client_context() if tls is not None else None
         )
 
     def _connect(self, method: str, body: bytes):

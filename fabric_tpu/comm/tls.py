@@ -37,6 +37,8 @@ class TLSCredentials:
     pinned_certs: optional DER allowlist; when set, the counterparty's
       leaf must be byte-identical to one of these (the orderer cluster's
       pinned-cert scheme, cluster/comm.go:116).
+    verify_server_name: clients verify the dialed host against the
+      server cert's SANs (DNS or IP), like gRPC's transport credentials.
     """
 
     cert_pem: bytes
@@ -44,6 +46,7 @@ class TLSCredentials:
     ca_pems: list
     require_client_auth: bool = True
     pinned_certs: list | None = None
+    verify_server_name: bool = True
 
     _tmpdir: tempfile.TemporaryDirectory | None = dataclasses.field(
         default=None, repr=False, compare=False
@@ -92,13 +95,17 @@ class TLSCredentials:
             )
         return ctx
 
-    def client_context(self, server_hostname: str | None = None) -> ssl.SSLContext:
+    def client_context(self) -> ssl.SSLContext:
+        """Client-side context.  Endpoint names ARE verified: the name
+        passed to wrap_socket(server_hostname=...) — every in-repo
+        transport passes the dialed host — must match a SAN (DNS or IP)
+        of the server's cert, as the reference's gRPC credentials do.
+        Without this, any client cert from any trusted org TLS CA could
+        impersonate any peer/orderer endpoint.  Set verify_server_name
+        False only for pin-protected transports."""
         ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
         ctx.minimum_version = ssl.TLSVersion.TLSv1_2
-        # Trust is rooted in the channel's TLS CAs, not in DNS names —
-        # the reference verifies the chain against org TLS-CA certs and
-        # (for the cluster) pins exact certs; SAN checking is optional.
-        ctx.check_hostname = server_hostname is not None
+        ctx.check_hostname = self.verify_server_name
         ctx.verify_mode = ssl.CERT_REQUIRED
         ctx.load_verify_locations(
             cadata="\n".join(p.decode() for p in self.ca_pems)
@@ -128,7 +135,7 @@ def credentials_from_ca(
     bundle it with that CA's root (plus any extra roots) as trust."""
     pair = ca.issue(
         common_name,
-        sans=sans or ["localhost"],
+        sans=sans or ["localhost", "127.0.0.1"],
         client=True,
         server=True,
     )

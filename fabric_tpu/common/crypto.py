@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import datetime
 import hashlib
+import ipaddress
 
 from cryptography import x509
 from cryptography.hazmat.primitives import hashes, serialization
@@ -155,8 +156,14 @@ class CA:
             )
         )
         if sans:
+            names: list[x509.GeneralName] = []
+            for s in sans:
+                try:
+                    names.append(x509.IPAddress(ipaddress.ip_address(s)))
+                except ValueError:
+                    names.append(x509.DNSName(s))
             builder = builder.add_extension(
-                x509.SubjectAlternativeName([x509.DNSName(s) for s in sans]), critical=False
+                x509.SubjectAlternativeName(names), critical=False
             )
         if eku:
             builder = builder.add_extension(x509.ExtendedKeyUsage(eku), critical=False)

@@ -29,7 +29,19 @@ class CertStore:
         # pki-hex -> serialized SignedGossipMessage (owner-signed)
         self._signed: dict[str, bytes] = {}
         self._add_own_identity()
+        if hasattr(mapper, "add_purge_listener"):
+            # stop advertising/serving identities the mapper expired —
+            # otherwise every pull round re-offers certs receivers can
+            # only reject (reference certstore deletes purged ids from
+            # the pull mediator)
+            mapper.add_purge_listener(self._evict)
         comm.subscribe(self._handle)
+
+    def _evict(self, pki: bytes) -> None:
+        if pki == self._comm.pki_id:
+            return  # never stop advertising our own identity
+        with self._lock:
+            self._signed.pop(pki.hex(), None)
 
     def _add_own_identity(self) -> None:
         m = gpb.GossipMessage(tag=gpb.GossipMessage.EMPTY)

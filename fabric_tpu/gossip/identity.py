@@ -43,7 +43,7 @@ class IdentityMapper:
         self._mcs = mcs
         self._default_ttl = default_ttl_s
         self._clock = clock
-        self._on_purge = on_purge or (lambda pki: None)
+        self._purge_listeners: list = [on_purge] if on_purge else []
         self._lock = threading.Lock()
         # pki -> (identity bytes, expiration epoch-seconds)
         self._store: dict[bytes, tuple[bytes, float]] = {}
@@ -72,8 +72,18 @@ class IdentityMapper:
                 del self._store[pki]
             else:
                 return identity
-        self._on_purge(pki)
+        self._notify_purge(pki)
         return None
+
+    def add_purge_listener(self, fn) -> None:
+        """Register an extra purge hook (certstore eviction, comm cache
+        drop — the reference certstore deletes purged identities from
+        its pull mediator, gossip/gossip/certstore.go)."""
+        self._purge_listeners.append(fn)
+
+    def _notify_purge(self, pki: bytes) -> None:
+        for fn in self._purge_listeners:
+            fn(pki)
 
     def known(self) -> list[tuple[bytes, bytes]]:
         """[(pki, identity)] of unexpired entries."""
@@ -90,7 +100,7 @@ class IdentityMapper:
             for p in dead:
                 del self._store[p]
         for p in dead:
-            self._on_purge(p)
+            self._notify_purge(p)
         return dead
 
 

@@ -146,6 +146,30 @@ def plan_index(selector: dict, indexed: set) -> tuple | None:
     return None
 
 
+def _eq_encodings(v) -> list[bytes] | None:
+    """All index encodings an equality operand must probe, or None when
+    the index cannot serve it (caller falls back to the full scan).
+
+    Two invariants keep "index can only over-select" true: (a) docs with
+    non-scalar values (arrays/objects) are never indexed, so an
+    unencodable operand means the index would silently drop matches;
+    (b) match_selector compares with Python ==, under which True == 1
+    and False == 0, while bool and number encode under different type
+    tags — so bool operands also probe the numeric entry and 0/1
+    numeric operands also probe the bool entry."""
+    from fabric_tpu.ledger.statedb import encode_scalar
+
+    enc = encode_scalar(v)
+    if enc is None:
+        return None
+    probes = [enc]
+    if isinstance(v, bool):
+        probes.append(encode_scalar(int(v)))
+    elif isinstance(v, (int, float)) and v in (0, 1):
+        probes.append(encode_scalar(bool(v)))
+    return probes
+
+
 def execute_query_indexed(db, ns: str, query: str):
     """Index-assisted execution against a statedb.VersionedDB; returns
     [(key, value, version)] in key order, or None when no defined index
@@ -156,14 +180,19 @@ def execute_query_indexed(db, ns: str, query: str):
     p = plan_index(selector, db.indexes_for(ns))
     if p is None:
         return None
-    if p[0] == "eq":
-        keys = list(db.index_eq(ns, p[1], p[2]))
-    elif p[0] == "in":
+    if p[0] in ("eq", "in"):
+        operands = [p[2]] if p[0] == "eq" else list(p[2])
         keys = []
-        for v in p[2]:
-            keys.extend(db.index_eq(ns, p[1], v))
+        for v in operands:
+            probes = _eq_encodings(v)
+            if probes is None:
+                return None  # index can't serve this operand: full scan
+            for enc in probes:
+                keys.extend(db.index_scan(ns, p[1], enc, enc))
     else:
         _, field, lo, hi = p
+        if isinstance(lo, bool) or isinstance(hi, bool):
+            return None  # bool bounds cross-compare with numbers: scan
         lo_enc = encode_scalar(lo) if lo is not None else None
         hi_enc = encode_scalar(hi) if hi is not None else None
         if (lo is not None and lo_enc is None) or (
@@ -171,6 +200,18 @@ def execute_query_indexed(db, ns: str, query: str):
         ):
             return None  # unencodable bound: fall back to the scan
         keys = list(db.index_scan(ns, field, lo_enc, hi_enc))
+        lo_num = lo if isinstance(lo, (int, float)) else None
+        hi_num = hi if isinstance(hi, (int, float)) else None
+        if (lo_num is not None or hi_num is not None) and (
+            lo_num is None or lo_num <= 1
+        ) and (hi_num is None or hi_num >= 0):
+            # bool doc values order-compare with numeric bounds under
+            # Python (True >= 1), but live under a different type tag —
+            # sweep the (two-value) bool region when the bounds overlap
+            # [False, True] ≡ [0, 1]; the recheck is exact
+            keys.extend(
+                db.index_scan(ns, field, encode_scalar(False), encode_scalar(True))
+            )
     out = []
     for key in sorted(set(keys)):
         vv = db.get_state(ns, key)

@@ -56,6 +56,7 @@ _NS_SEP = b"\x00"
 _SAVEPOINT_KEY = b"\x01savepoint"
 _IDX_PREFIX = b"\x03"
 _IDX_DEF_PREFIX = b"\x04"
+_META_NS_KEY = b"\x05metans"
 
 
 def _state_key(ns: str, key: str) -> bytes:
@@ -75,7 +76,10 @@ def encode_scalar(v) -> bytes | None:
     if isinstance(v, bool):
         return b"\x02" + (b"\x01" if v else b"\x00")
     if isinstance(v, (int, float)):
-        bits = struct.unpack(">Q", struct.pack(">d", float(v)))[0]
+        f = float(v)
+        if f == 0.0:
+            f = 0.0  # normalize -0.0: Python == equates them, keys must too
+        bits = struct.unpack(">Q", struct.pack(">d", f))[0]
         # IEEE754 total-order trick: flip sign bit for positives,
         # invert everything for negatives
         bits = bits ^ 0x8000000000000000 if bits < 1 << 63 else ~bits & (1 << 64) - 1
@@ -171,6 +175,31 @@ class VersionedDB:
     def __init__(self, store: KVStore, name: str = "statedb"):
         self._db = NamedDB(store, name)
         self._indexes: dict[str, set[str]] | None = None  # lazy-loaded
+        self._meta_ns: set[str] | bool | None = None  # lazy; True = unknown
+
+    # -- metadata presence fast path ---------------------------------------
+
+    def _load_meta_ns(self):
+        """Namespaces that have EVER stored key metadata (validation
+        parameters / SBE).  Most workloads have none, and the committed-
+        metadata lookup sits on the per-tx validation hot path — when a
+        namespace is not in this set, get_state_metadata can answer {}
+        without touching the store.  Monotone (never un-flagged), so it
+        can only over-report, never under-report.  Legacy DBs written
+        before this key existed stay permanently conservative."""
+        if self._meta_ns is None:
+            raw = self._db.get(_META_NS_KEY)
+            if raw is not None:
+                self._meta_ns = set(json.loads(raw.decode()))
+            elif self._db.get(_SAVEPOINT_KEY) is not None:
+                self._meta_ns = True  # pre-existing DB: unknown history
+            else:
+                self._meta_ns = set()
+        return self._meta_ns
+
+    def may_have_metadata(self, ns: str) -> bool:
+        m = self._load_meta_ns()
+        return True if m is True else ns in m
 
     # -- index definitions -------------------------------------------------
 
@@ -219,12 +248,6 @@ class VersionedDB:
             key = _idx_entry_state_key(k[plen:])
             if key is not None:
                 yield key
-
-    def index_eq(self, ns: str, field: str, value):
-        enc = encode_scalar(value)
-        if enc is None:
-            return
-        yield from self.index_scan(ns, field, enc, enc)
 
     def _index_mutations(self, batch: dict, puts: dict, deletes: list) -> None:
         """Maintain index entries for namespaces with indexes: remove the
@@ -283,12 +306,19 @@ class VersionedDB:
         puts: dict[bytes, bytes] = {}
         deletes: list[bytes] = []
         self._index_mutations(batch, puts, deletes)  # reads OLD state
+        meta_ns = self._load_meta_ns()
+        meta_dirty = False
         for ns, kvs in batch.items():
             for key, vv in kvs.items():
                 if vv is None:
                     deletes.append(_state_key(ns, key))
                 else:
                     puts[_state_key(ns, key)] = _encode_value(vv)
+                    if vv.metadata and meta_ns is not True and ns not in meta_ns:
+                        meta_ns.add(ns)
+                        meta_dirty = True
+        if meta_dirty:
+            puts[_META_NS_KEY] = json.dumps(sorted(meta_ns)).encode()
         if height is not None:
             puts[_SAVEPOINT_KEY] = height.pack()
         self._db.write_batch(puts, deletes)

@@ -141,7 +141,16 @@ class TCPTransport:
         self._handler = None
         self._tls = tls
         self._server_ctx = tls.server_context() if tls is not None else None
-        self._client_ctx = tls.client_context() if tls is not None else None
+        if tls is not None:
+            self._client_ctx = tls.client_context()
+            if tls.pinned_certs is not None:
+                # the cluster authenticates by byte-exact pinned leaves
+                # (reference cluster/comm.go:116) — strictly stronger
+                # than SAN matching, and consenter endpoints are often
+                # dialed by addresses absent from their cert SANs
+                self._client_ctx.check_hostname = False
+        else:
+            self._client_ctx = None
         self._peers: dict[int, _PeerSender] = {}
         self._lock = threading.Lock()
         self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -191,9 +200,13 @@ class TCPTransport:
 
     def set_pinned(self, certs: list) -> None:
         """Replace the pinned-cert allowlist (DER leaves) — called when a
-        config block changes the consenter set."""
+        config block changes the consenter set.  Once pinning is active
+        the client context drops SAN matching, same as construction-time
+        pinning (byte-exact leaves are the cluster's authentication)."""
         if self._tls is not None:
             self._tls.pinned_certs = list(certs)
+            if self._client_ctx is not None:
+                self._client_ctx.check_hostname = False
 
     def _serve_conn(self, conn: socket.socket) -> None:
         buf = b""

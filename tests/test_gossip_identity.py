@@ -117,3 +117,37 @@ def test_certstore_rejects_wrong_signer():
     signed.signature = a.mcs.sign_as(b"idA", signed.payload)
     csb._learn(signed)
     assert mb.get(a.mcs.get_pki_id(b"idZ")) is None
+
+
+def test_certstore_evicts_purged_identities():
+    """Identities the mapper expires must stop being advertised and
+    served by the certstore (reference certstore deletes purged ids
+    from the pull mediator) — otherwise every pull round re-offers
+    certs receivers can only reject."""
+    net = InProcGossipNet()
+    a = InProcGossipComm("a", net, b"idA", mcs=SelfSigningMCS(b"idA"))
+    now = [1000.0]
+    ma = IdentityMapper(a.mcs, b"idA", default_ttl_s=50, clock=lambda: now[0])
+    csa = CertStore(a, ma, lambda: [])
+    other_pki = ma.put(b"idOther")
+    csa._signed[other_pki.hex()] = b"envelope"  # as if pulled earlier
+    assert other_pki.hex() in csa.known_pkis()
+    now[0] += 60
+    assert other_pki in ma.sweep()
+    assert other_pki.hex() not in csa.known_pkis()
+    # own identity is never evicted
+    assert a.pki_id.hex() in csa.known_pkis()
+
+
+def test_mapper_multiple_purge_listeners():
+    purged_a, purged_b = [], []
+    now = [0.0]
+    m = IdentityMapper(
+        MessageCryptoService(), b"me", default_ttl_s=10,
+        clock=lambda: now[0], on_purge=purged_a.append,
+    )
+    m.add_purge_listener(purged_b.append)
+    pki = m.put(b"x")
+    now[0] += 11
+    m.sweep()
+    assert pki in purged_a and pki in purged_b

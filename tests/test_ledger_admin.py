@@ -218,3 +218,101 @@ def test_pause_resume_and_upgrade_dbs(tmp_path):
     # upgrade stamps the format; second run is a no-op
     admin.upgrade_dbs(root)
     assert admin.upgrade_dbs(root) == []
+
+
+class TestIndexedQueryParity:
+    """Indexed execution must never under-select vs the full scan
+    (advisor round-2 high finding): non-scalar operands and bool/number
+    cross-type matches (True == 1 under Python ==, different index type
+    tags) have to fall back or probe both encodings."""
+
+    def _db(self, docs):
+        from fabric_tpu.ledger.kvstore import MemKVStore
+        from fabric_tpu.ledger.statedb import Height, VersionedDB, VersionedValue
+
+        db = VersionedDB(MemKVStore())
+        db.apply_updates(
+            {
+                "cc": {
+                    k: VersionedValue(json.dumps(d).encode(), Height(1, i))
+                    for i, (k, d) in enumerate(docs.items())
+                }
+            },
+            Height(1, len(docs)),
+        )
+        return db
+
+    def _both(self, db, selector, **extra):
+        from fabric_tpu.ledger.richquery import execute_query_indexed
+
+        q = json.dumps({"selector": selector, **extra})
+        scan = [
+            k
+            for k, _ in execute_query(
+                ((k, vv.value) for k, vv in db.get_state_range("cc", "", "")), q
+            )
+        ]
+        indexed = execute_query_indexed(db, "cc", q)
+        return scan, indexed
+
+    def test_nonscalar_eq_falls_back_to_scan(self):
+        db = self._db({"d1": {"tags": ["a", "b"]}, "d2": {"tags": "x"}})
+        db.define_index("cc", "tags")
+        scan, indexed = self._both(db, {"tags": ["a", "b"]})
+        assert scan == ["d1"]
+        assert indexed is None  # planner must decline, not return []
+
+    def test_bool_number_cross_type_eq(self):
+        db = self._db(
+            {"b1": {"flag": True}, "n1": {"flag": 1}, "z": {"flag": 0},
+             "b0": {"flag": False}, "n2": {"flag": 2}}
+        )
+        db.define_index("cc", "flag")
+        for sel, want in [
+            ({"flag": 1}, ["b1", "n1"]),      # 1 == True
+            ({"flag": True}, ["b1", "n1"]),
+            ({"flag": 0}, ["b0", "z"]),
+            ({"flag": False}, ["b0", "z"]),
+            ({"flag": 2}, ["n2"]),
+            ({"flag": {"$in": [True, 2]}}, ["b1", "n1", "n2"]),
+        ]:
+            scan, indexed = self._both(db, sel)
+            assert scan == want
+            assert indexed is not None and [k for k, _, _ in indexed] == want
+
+    def test_numeric_range_includes_bool_docs(self):
+        db = self._db(
+            {"b1": {"v": True}, "n1": {"v": 5}, "n0": {"v": -3}}
+        )
+        db.define_index("cc", "v")
+        scan, indexed = self._both(db, {"v": {"$gte": 0}})
+        assert scan == ["b1", "n1"]
+        assert indexed is not None and [k for k, _, _ in indexed] == scan
+
+    def test_bool_range_bound_falls_back(self):
+        db = self._db({"n1": {"v": 5}, "b1": {"v": True}})
+        db.define_index("cc", "v")
+        scan, indexed = self._both(db, {"v": {"$gte": True}})
+        assert indexed is None or [k for k, _, _ in indexed] == scan
+
+    def test_unencodable_in_member_falls_back(self):
+        db = self._db({"d1": {"v": [1, 2]}, "d2": {"v": "s"}})
+        db.define_index("cc", "v")
+        scan, indexed = self._both(db, {"v": {"$in": [[1, 2], "s"]}})
+        assert scan == ["d1", "d2"]
+        assert indexed is None
+
+    def test_negative_zero_eq_and_range(self):
+        db = self._db({"neg0": {"v": -0.0}, "pos0": {"v": 0}})
+        db.define_index("cc", "v")
+        for sel in ({"v": 0}, {"v": {"$gte": 0}}, {"v": {"$gte": -1, "$lte": 1}}):
+            scan, indexed = self._both(db, sel)
+            assert scan == ["neg0", "pos0"]
+            assert indexed is not None and [k for k, _, _ in indexed] == scan
+
+    def test_bool_sweep_gated_outside_01(self):
+        db = self._db({"b1": {"v": True}, "n1": {"v": 500}})
+        db.define_index("cc", "v")
+        scan, indexed = self._both(db, {"v": {"$gte": 100}})
+        assert scan == ["n1"]
+        assert indexed is not None and [k for k, _, _ in indexed] == scan

@@ -132,3 +132,44 @@ def test_streaming_over_tls(cas):
         assert list(cli.stream("count")) == [b"0", b"1", b"2", b"3", b"4"]
     finally:
         srv.stop()
+
+
+def test_server_name_verified_by_default(cas):
+    """A cert from the right CA but without the dialed address in its
+    SANs must NOT pass as a server endpoint (advisor round-2 medium:
+    otherwise any org-issued client cert can impersonate any peer or
+    orderer).  Mirrors gRPC transport-credential SAN verification."""
+    ca, _ = cas
+    rogue_pair = ca.issue(
+        "user1@org1", sans=["user1.example.com"], client=True, server=True
+    )
+    rogue = TLSCredentials(
+        cert_pem=rogue_pair.cert_pem,
+        key_pem=rogue_pair.key_pem,
+        ca_pems=[ca.cert_pem],
+    )
+    srv = _server(rogue)  # "server" presenting a user cert
+    try:
+        cli = RPCClient(*srv.addr, tls=credentials_from_ca(ca, "client.org1"))
+        with pytest.raises(RPCError, match="tls"):
+            cli.call("echo", b"hi")
+    finally:
+        srv.stop()
+
+
+def test_server_name_verification_opt_out(cas):
+    ca, _ = cas
+    pair = ca.issue(
+        "node.org1", sans=["node.example.com"], client=True, server=True
+    )
+    srv_creds = TLSCredentials(
+        cert_pem=pair.cert_pem, key_pem=pair.key_pem, ca_pems=[ca.cert_pem]
+    )
+    srv = _server(srv_creds)
+    try:
+        cli_creds = credentials_from_ca(ca, "client.org1")
+        cli_creds.verify_server_name = False  # pin-protected transports
+        cli = RPCClient(*srv.addr, tls=cli_creds)
+        assert cli.call("echo", b"hi") == b"ok:hi"
+    finally:
+        srv.stop()
