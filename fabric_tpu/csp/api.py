@@ -72,6 +72,10 @@ class ECDSAP256PublicKey(Key):
         nums = key.public_numbers()
         self.x: int = nums.x
         self.y: int = nums.y
+        # fixed-width coordinates, precomputed once: the batch
+        # marshaller consumes these per verify item on the hot path
+        self.x_bytes: bytes = self.x.to_bytes(32, "big")
+        self.y_bytes: bytes = self.y.to_bytes(32, "big")
         self._ski = _point_ski(self.x, self.y)
 
     def ski(self) -> bytes:

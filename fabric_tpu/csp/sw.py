@@ -118,3 +118,5 @@ def _verify_one(key: ECDSAP256PublicKey, signature: bytes, digest: bytes) -> boo
         return True
     except InvalidSignature:
         return False
+    except ValueError:
+        return False  # e.g. digest length != 32: invalid, never a throw

@@ -102,6 +102,7 @@ class BlockStore:
             b"n" + struct.pack(">Q", blk.header.number): struct.pack(">QQ", file_idx, offset),
             b"h" + protoutil.block_header_hash(blk.header): struct.pack(">Q", blk.header.number),
         }
+        tx_keys: list[tuple[bytes, int]] = []
         for pos, raw_env in enumerate(blk.data.data):
             try:
                 env = common_pb2.Envelope.FromString(raw_env)
@@ -111,9 +112,13 @@ class BlockStore:
             except Exception:
                 continue
             if txid:
-                key = b"t" + txid.encode()
-                if self._index.get(key) is None:  # first occurrence wins
-                    puts[key] = struct.pack(">QQ", blk.header.number, pos)
+                tx_keys.append((b"t" + txid.encode(), pos))
+        # one bulk probe for already-indexed txids; first occurrence wins
+        # across blocks AND within this block
+        existing = self._index.get_many([k for k, _ in tx_keys])
+        for key, pos in tx_keys:
+            if key not in existing and key not in puts:
+                puts[key] = struct.pack(">QQ", blk.header.number, pos)
         self._index.write_batch(puts)
 
     # -- public API --------------------------------------------------------
@@ -185,6 +190,13 @@ class BlockStore:
             return None
         num, pos = struct.unpack(">QQ", raw)
         return num, pos
+
+    def tx_ids_exist(self, txids) -> set[str]:
+        """Subset of `txids` already present in the txid index — ONE
+        index round-trip for a whole block's duplicate check (the
+        reference pays a leveldb get per tx, validator.go:459)."""
+        got = self._index.get_many([b"t" + t.encode() for t in txids])
+        return {k[1:].decode() for k in got}
 
     def get_tx_by_id(self, txid: str) -> common_pb2.Envelope | None:
         loc = self.get_tx_loc(txid)

@@ -125,15 +125,20 @@ class KVLedger:
         block: common_pb2.Block,
         pvt_data: dict[int, bytes] | None = None,
         missing_pvt: list[tuple[int, str, str]] | None = None,
+        rwsets: list[bytes | None] | None = None,
     ) -> None:
         """MVCC-validate (updating the tx filter), persist block + private
         data, apply state + history.  Signature/policy flags must already
         be set by the txvalidator; this adds the MVCC codes.  pvt_data maps
         tx index -> marshaled TxPvtReadWriteSet (cleartext private writes
         this peer is eligible for); missing_pvt records eligible-but-absent
-        collections for the reconciler."""
+        collections for the reconciler.  `rwsets` may carry the per-tx
+        marshaled TxReadWriteSets the validator already extracted
+        (Committer.store_stream) — the commit then skips re-walking
+        every envelope."""
         flags = list(protoutil.tx_filter(block))
-        rwsets = extract_rwsets(block)
+        if rwsets is None or len(rwsets) != len(flags):
+            rwsets = extract_rwsets(block)
         batch = self._mvcc.validate_and_prepare(
             block.header.number, rwsets, flags, pvt_data
         )
@@ -210,6 +215,10 @@ class KVLedger:
     def tx_id_exists(self, txid: str) -> bool:
         return self._blocks.get_tx_loc(txid) is not None
 
+    def tx_ids_exist(self, txids) -> set[str]:
+        """Bulk duplicate-txid probe (one index round-trip)."""
+        return self._blocks.tx_ids_exist(txids)
+
     def define_index(self, ns: str, field: str) -> None:
         """Create (and backfill) a rich-query index on a dotted JSON
         field of a namespace — the statecouchdb index-definition
@@ -279,6 +288,8 @@ class QueryExecutor:
         get_state_metadata; `ns` may be a derived hashed namespace."""
         from fabric_tpu.ledger.txmgmt import decode_metadata
 
+        if not self._state.may_have_metadata(ns):
+            return {}  # namespace never stored metadata: skip the store
         vv = self._state.get_state(ns, key)
         return decode_metadata(vv.metadata) if vv else {}
 

@@ -23,6 +23,16 @@ class KVStore:
     def get(self, key: bytes) -> bytes | None:
         raise NotImplementedError
 
+    def get_many(self, keys) -> dict[bytes, bytes]:
+        """Present keys -> values (absent keys omitted).  Backends
+        override with one round-trip; the default loops."""
+        out = {}
+        for k in keys:
+            v = self.get(k)
+            if v is not None:
+                out[k] = v
+        return out
+
     def write_batch(self, puts: dict[bytes, bytes], deletes=()) -> None:
         raise NotImplementedError
 
@@ -97,6 +107,20 @@ class SqliteKVStore(KVStore):
             row = self._conn.execute("SELECT v FROM kv WHERE k = ?", (key,)).fetchone()
         return None if row is None else row[0]
 
+    def get_many(self, keys) -> dict[bytes, bytes]:
+        keys = list(keys)
+        out: dict[bytes, bytes] = {}
+        with self._lock:
+            for off in range(0, len(keys), 500):  # sqlite variable limit
+                chunk = keys[off:off + 500]
+                rows = self._conn.execute(
+                    "SELECT k, v FROM kv WHERE k IN (%s)"
+                    % ",".join("?" * len(chunk)),
+                    chunk,
+                ).fetchall()
+                out.update(rows)
+        return out
+
     def write_batch(self, puts, deletes=()) -> None:
         with self._lock:
             with self._conn:
@@ -141,6 +165,11 @@ class NamedDB(KVStore):
 
     def get(self, key: bytes) -> bytes | None:
         return self._base.get(self._k(key))
+
+    def get_many(self, keys) -> dict[bytes, bytes]:
+        plen = len(self._prefix)
+        got = self._base.get_many([self._k(k) for k in keys])
+        return {k[plen:]: v for k, v in got.items()}
 
     def write_batch(self, puts, deletes=()) -> None:
         self._base.write_batch(

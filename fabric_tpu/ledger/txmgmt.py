@@ -539,6 +539,8 @@ class MVCCValidator:
         if key in ns_batch:
             base = ns_batch[key]
             return base.metadata if base is not None else b""
+        if not self._db.may_have_metadata(ns):
+            return b""  # namespace never stored metadata: skip the store
         vv = self._db.get_state(ns, key)
         return vv.metadata if vv is not None else b""
 

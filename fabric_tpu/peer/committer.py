@@ -67,6 +67,7 @@ class Committer:
 
         pending: collections.deque = collections.deque()
         releases: collections.deque = collections.deque()
+        rwsets_q: collections.deque = collections.deque()
 
         def tee(it):
             for b in it:
@@ -84,10 +85,10 @@ class Committer:
                     return
                 if failed:
                     continue  # drain without committing past a failure
-                blk, release_txids = item
+                blk, release_txids, rwsets = item
                 try:
                     with self._lock:
-                        self._ledger.commit(blk)
+                        self._ledger.commit(blk, rwsets=rwsets)
                     # the ledger index now holds these txids: safe to
                     # close the validator's in-flight dedup window
                     release_txids()
@@ -107,9 +108,13 @@ class Committer:
         n_in = n_out = 0
         try:
             for _flags in self._validator.validate_pipeline(
-                tee(blocks), depth=depth, release=releases.append
+                tee(blocks), depth=depth, release=releases.append,
+                rwsets_out=rwsets_q.append,
             ):
-                commit_q.put((pending.popleft(), releases.popleft()))
+                commit_q.put(
+                    (pending.popleft(), releases.popleft(),
+                     rwsets_q.popleft())
+                )
                 n_in += 1
                 while not done_q.empty():
                     r = done_q.get()

@@ -89,6 +89,9 @@ class _TxWork:
     pendings: list = dataclasses.field(default_factory=list)
     # [(PendingValidation, [item index, ...])] — one per written namespace
     touched_keys: frozenset = frozenset()  # {(ns_or_hashns, key)}
+    rwset: bytes | None = None
+    # marshaled TxReadWriteSet, handed to the committer so the ledger
+    # commit skips re-walking every envelope (kvledger extract_rwsets)
     meta_keys: frozenset = frozenset()
     # keys whose VALIDATION_PARAMETER this tx rewrites; once the tx is
     # VALID, later in-block txs touching them are invalidated
@@ -272,7 +275,8 @@ class TxValidator:
     def validate(self, block: common_pb2.Block) -> list[int]:
         return self._finish_block(*self._start_block(block, set()))
 
-    def validate_pipeline(self, blocks, depth: int = 2, release=None):
+    def validate_pipeline(self, blocks, depth: int = 2, release=None,
+                          rwsets_out=None):
         """Pipelined validation: yields per-block flag lists in order,
         keeping up to `depth` blocks in flight so block k+1's host
         collect phase overlaps block k's device verify (the reference
@@ -301,6 +305,10 @@ class TxValidator:
 
         def finish(started):
             flags = self._finish_block(*started[:-1])
+            if rwsets_out is not None:
+                # per-tx marshaled TxReadWriteSets, so the committer's
+                # ledger.commit skips re-walking every envelope
+                rwsets_out([w.rwset for w in started[2]])
             txids = started[-1]
             if release is None:
                 seen_txids.difference_update(txids)  # close the window
@@ -396,12 +404,26 @@ class TxValidator:
         # one bulk numpy->python conversion; per-element indexing of
         # numpy arrays costs a scalar-boxing allocation per access
         status_l = co["status"].tolist()
+        txid_off_pre = co["txid_off"].tolist()
+        txid_len_pre = co["txid_len"].tolist()
+        # one bulk ledger probe for the whole block's duplicate-txid
+        # check (the reference pays a store get per tx, validator.go:459)
+        if hasattr(self._ledger, "tx_ids_exist"):
+            probe = {
+                buf[txid_off_pre[i]:txid_off_pre[i] + txid_len_pre[i]].decode()
+                for i in range(len(data))
+                if txid_len_pre[i]
+            }
+            ledger_dups = self._ledger.tx_ids_exist(probe)
+            txid_known = lambda t: t in ledger_dups  # noqa: E731
+        else:
+            txid_known = self._ledger.tx_id_exists
         creator_off_l = co["creator_off"].tolist()
         creator_len_l = co["creator_len"].tolist()
         sig_off_l = co["sig_off"].tolist()
         sig_len_l = co["sig_len"].tolist()
-        txid_off_l = co["txid_off"].tolist()
-        txid_len_l = co["txid_len"].tolist()
+        txid_off_l = txid_off_pre
+        txid_len_l = txid_len_pre
         prp_off_l = co["prp_off"].tolist()
         prp_len_l = co["prp_len"].tolist()
         rwset_off_l = co["rwset_off"].tolist()
@@ -455,7 +477,7 @@ class TxValidator:
             # dup-txid stage: the txid registers even when a LATER check
             # fails (the reference adds to the dedup set right here too)
             txid = sl(txid_off_l[i], txid_len_l[i]).decode()
-            if txid in seen_txids or self._ledger.tx_id_exists(txid):
+            if txid in seen_txids or txid_known(txid):
                 flags[i] = V.DUPLICATE_TXID
                 continue
             seen_txids.add(txid)
@@ -517,6 +539,7 @@ class TxValidator:
                 return V.INVALID_OTHER_REASON
             w.pendings.append((pending, sink.add_many(pending.items)))
         w.touched_keys = footprint.touched
+        w.rwset = rwset_bytes
         w.meta_keys = frozenset(footprint.meta_writes)
         return V.VALID
 
