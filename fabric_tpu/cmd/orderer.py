@@ -18,7 +18,8 @@ from fabric_tpu.cmd.common import (
     tls_from_args,
     tls_parent,
 )
-from fabric_tpu.csp import SWCSP
+from fabric_tpu.comm.rpc import KeepaliveOptions
+from fabric_tpu.csp import csp_from_config
 from fabric_tpu.node.orderer_node import OrdererNode
 from fabric_tpu.protos.common import common_pb2
 
@@ -57,16 +58,33 @@ def main(argv=None) -> int:
     )
     host, port = parse_endpoint(args.listen)
     node = OrdererNode(
-        args.root, SWCSP(), signer=signer, host=host, port=port,
+        # orderer.yaml General.BCCSP block (reference localconfig)
+        args.root, csp_from_config(cfg, prefix="general.bccsp"),
+        signer=signer, host=host, port=port,
+        keepalive=KeepaliveOptions.from_config(cfg, prefix="general.keepalive"),
         genesis_blocks=blocks, tls=tls_from_args(args),
     )
     node.start()
+    profile_srv = None
+    if cfg.get_bool("general.profile.enabled", False):
+        # reference orderer/common/server/main.go:410-412 initializeProfiling
+        from fabric_tpu.common.profile import ProfileServer
+
+        phost, pport = parse_endpoint(
+            str(cfg.get("general.profile.address", "127.0.0.1:6060"))
+        )
+        profile_srv = ProfileServer(phost, pport)
+        profile_srv.start()
+        print(f"profiling on {profile_srv.addr[0]}:{profile_srv.addr[1]}",
+              flush=True)
     print(f"orderer listening on {node.addr[0]}:{node.addr[1]}", flush=True)
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *a: stop.set())
     signal.signal(signal.SIGINT, lambda *a: stop.set())
     stop.wait()
     node.stop()
+    if profile_srv is not None:
+        profile_srv.stop()
     return 0
 
 

@@ -30,6 +30,7 @@ from fabric_tpu.cmd.common import (
     tls_parent,
 )
 from fabric_tpu.comm import RPCClient
+from fabric_tpu.comm.rpc import KeepaliveOptions
 from fabric_tpu.protos.common import common_pb2
 from fabric_tpu.protos.orderer import ab_pb2
 from fabric_tpu.protos.peer import configuration_pb2 as peer_cfg
@@ -42,7 +43,7 @@ def _signer(args):
 def cmd_node_start(args) -> int:
     from fabric_tpu.common.config import Config
     from fabric_tpu.common.diag import install_signal_handler
-    from fabric_tpu.csp import SWCSP
+    from fabric_tpu.csp import csp_from_config
     from fabric_tpu.node.peer_node import PeerNode
 
     install_signal_handler()  # SIGUSR1 -> thread dump (common/diag)
@@ -52,7 +53,8 @@ def cmd_node_start(args) -> int:
     host, port = parse_endpoint(args.listen)
     node = PeerNode(
         args.root,
-        SWCSP(),
+        # bccsp block selects SW/TPU and the SKI-keyed file keystore
+        csp_from_config(cfg),
         load_signer(args.msp_dir, args.mspid),
         host=host,
         port=port,
@@ -66,7 +68,21 @@ def cmd_node_start(args) -> int:
             "peer.limits.concurrency.deliverService", 2500
         ),
         tls=tls_from_args(args),
+        keepalive=KeepaliveOptions.from_config(cfg),
     )
+    profile_srv = None
+    if cfg.get_bool("peer.profile.enabled", False):
+        # pprof equivalent (reference cmd/peer/main.go:10 +
+        # core/peer/config.go:83-85 ProfileEnabled/ProfileListenAddress)
+        from fabric_tpu.common.profile import ProfileServer
+
+        phost, pport = parse_endpoint(
+            str(cfg.get("peer.profile.listenAddress", "127.0.0.1:6060"))
+        )
+        profile_srv = ProfileServer(phost, pport)
+        profile_srv.start()
+        print(f"profiling on {profile_srv.addr[0]}:{profile_srv.addr[1]}",
+              flush=True)
     gossip_bootstrap = list(args.gossip_bootstrap) or [
         str(b) for b in (cfg.get("peer.gossip.bootstrap") or [])
     ]
@@ -92,6 +108,8 @@ def cmd_node_start(args) -> int:
     signal.signal(signal.SIGINT, lambda *a: stop.set())
     stop.wait()
     node.stop()
+    if profile_srv is not None:
+        profile_srv.stop()
     return 0
 
 

@@ -25,6 +25,8 @@ binds into its signed handshake."""
 
 from __future__ import annotations
 
+import dataclasses
+import queue
 import socket
 import socketserver
 import ssl
@@ -34,8 +36,70 @@ import threading
 KIND_DATA = 0
 KIND_END = 1
 KIND_ERR = 2
+KIND_PING = 3  # server liveness marker on quiet streams; clients skip it
 
 _MAX_FRAME = 100 * 1024 * 1024  # reference default max message size
+
+
+@dataclasses.dataclass(frozen=True)
+class KeepaliveOptions:
+    """Connection-lifecycle knobs (reference
+    internal/pkg/comm/config.go:26 DefaultKeepaliveOptions, surfaced in
+    core.yaml peer.keepalive).
+
+    idle_timeout: server closes a connection that sends no request
+      within this window (a connected-but-silent peer stops holding a
+      thread forever).
+    ping_interval: on a streaming response with no data for this long,
+      the server emits a PING frame so live-idle streams are
+      distinguishable from dead servers.
+    ping_timeout: clients reading a stream treat silence longer than
+      ping_interval + ping_timeout as a dead peer.
+    tcp_*: kernel keepalive probing for both directions (SO_KEEPALIVE
+      + TCP_USER_TIMEOUT), reaping peers that vanish without FIN.
+    """
+
+    idle_timeout: float = 30.0
+    ping_interval: float = 15.0
+    ping_timeout: float = 20.0
+    tcp_idle_s: int = 30
+    tcp_interval_s: int = 10
+    tcp_count: int = 3
+
+    @classmethod
+    def from_config(cls, cfg, prefix: str = "peer.keepalive") -> "KeepaliveOptions":
+        d = {}
+        for name, key in (
+            ("idle_timeout", "idleTimeout"),
+            ("ping_interval", "interval"),
+            ("ping_timeout", "timeout"),
+        ):
+            v = cfg.get(f"{prefix}.{key}")
+            if v is not None:
+                d[name] = float(v)
+        return cls(**d)
+
+
+def set_tcp_keepalive(sock, ka: "KeepaliveOptions") -> None:
+    """Kernel-level dead-peer detection: keepalive probes on idle
+    connections plus a bound on how long unacked writes linger."""
+    try:
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1)
+        # TCP_KEEPIDLE/-INTVL/-CNT/USER_TIMEOUT are Linux names; other
+        # platforms (e.g. macOS) lack some — probe each
+        for opt, val in (
+            ("TCP_KEEPIDLE", ka.tcp_idle_s),
+            ("TCP_KEEPINTVL", ka.tcp_interval_s),
+            ("TCP_KEEPCNT", ka.tcp_count),
+            (
+                "TCP_USER_TIMEOUT",
+                1000 * (ka.tcp_idle_s + ka.tcp_interval_s * ka.tcp_count),
+            ),
+        ):
+            if hasattr(socket, opt):
+                sock.setsockopt(socket.IPPROTO_TCP, getattr(socket, opt), val)
+    except OSError:
+        pass  # platform without the options: lifecycle still app-level
 
 
 class RPCError(Exception):
@@ -87,12 +151,31 @@ class _Handler(socketserver.BaseRequestHandler):
     def handle(self):
         server: RPCServer = self.server.rpc  # type: ignore[attr-defined]
         sock = self.request
+        ka = server.keepalive
+        set_tcp_keepalive(sock, ka)
+        # Idle reaping: a connected-but-silent peer must not hold this
+        # thread (and later a limiter permit) forever — the handshake
+        # and the request read each get the idle window, then the
+        # timeout clears for the handler's own streaming reads.
+        sock.settimeout(ka.idle_timeout)
+        # the holder is re-pointed at the TLS socket after the wrap
+        # (wrap_socket detaches the raw fd — closing the pre-wrap object
+        # in stop() would be a no-op for TLS connections)
+        holder = [sock]
+        server._track(holder)
+        try:
+            self._serve(server, sock, holder)
+        finally:
+            server._untrack(holder)
+
+    def _serve(self, server: "RPCServer", sock, holder) -> None:
         peer_cert: bytes | None = None
         if server.tls is not None:
             # Handshake here, in the per-connection thread — the accept
             # loop stays responsive regardless of handshake latency.
             try:
                 sock = server.ssl_context.wrap_socket(sock, server_side=True)
+                holder[0] = sock
             except (ssl.SSLError, OSError):
                 return
             peer_cert = sock.getpeercert(binary_form=True)
@@ -105,9 +188,13 @@ class _Handler(socketserver.BaseRequestHandler):
                     sock.close()
                 return
         try:
-            frame = read_frame(sock)
+            try:
+                frame = read_frame(sock)
+            except socket.timeout:
+                return  # reaped: no request within the idle window
             if frame is None or not frame:
                 return
+            sock.settimeout(None)  # handler-controlled from here on
             mlen = frame[0]
             method = frame[1:1 + mlen].decode("utf-8")
             body = frame[1 + mlen:]
@@ -134,17 +221,60 @@ class _Handler(socketserver.BaseRequestHandler):
                 write_frame(sock, bytes([KIND_END]))
             else:  # iterator of bytes — generators raise lazily, so the
                 # iteration needs the same ERR surface as the call itself
-                try:
-                    for item in out:
-                        write_frame(sock, bytes([KIND_DATA]) + item)
-                except Exception as exc:  # noqa: BLE001
-                    write_frame(
-                        sock, bytes([KIND_ERR]) + str(exc).encode("utf-8")
-                    )
+                if not _pump_stream(sock, out, server.keepalive):
                     return
                 write_frame(sock, bytes([KIND_END]))
         except (ConnectionError, OSError):
             pass
+
+
+def _pump_stream(sock, out, ka: KeepaliveOptions) -> bool:
+    """Write the iterator's items as DATA frames, emitting a PING frame
+    whenever the stream is quiet for ka.ping_interval so clients can
+    tell a live-idle stream from a dead server.  The iterator runs in a
+    side thread (it may block indefinitely between items, e.g. a
+    deliver stream waiting for new blocks).  Returns False when the
+    handler raised (ERR already written)."""
+    q: queue.Queue = queue.Queue(maxsize=8)
+    _END, _ERR = object(), object()
+    dead = threading.Event()
+
+    def put(item) -> bool:
+        while not dead.is_set():
+            try:
+                q.put(item, timeout=1.0)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def pull():
+        try:
+            for item in out:
+                if not put(item):
+                    break  # client gone: run the generator's finally
+        except Exception as exc:  # noqa: BLE001 — surfaced as ERR frame
+            put((_ERR, str(exc)))
+            return
+        put(_END)
+
+    t = threading.Thread(target=pull, daemon=True)
+    t.start()
+    try:
+        while True:
+            try:
+                item = q.get(timeout=ka.ping_interval)
+            except queue.Empty:
+                write_frame(sock, bytes([KIND_PING]))  # live but idle
+                continue
+            if item is _END:
+                return True
+            if isinstance(item, tuple) and item[0] is _ERR:
+                write_frame(sock, bytes([KIND_ERR]) + item[1].encode("utf-8"))
+                return False
+            write_frame(sock, bytes([KIND_DATA]) + item)
+    finally:
+        dead.set()
 
 
 class _ThreadingServer(socketserver.ThreadingTCPServer):
@@ -155,13 +285,33 @@ class _ThreadingServer(socketserver.ThreadingTCPServer):
 class RPCServer:
     """method name -> handler(body: bytes, stream: Stream)."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0, tls=None):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, tls=None,
+                 keepalive: KeepaliveOptions | None = None):
         self.methods: dict = {}
         self.tls = tls  # comm.tls.TLSCredentials | None
+        self.keepalive = keepalive or KeepaliveOptions()
         self.ssl_context = tls.server_context() if tls is not None else None
         self._srv = _ThreadingServer((host, port), _Handler)
         self._srv.rpc = self  # type: ignore[attr-defined]
         self._thread: threading.Thread | None = None
+        self._conns: set = set()
+        self._holders: dict = {}  # id -> [current socket] per connection
+        self._conn_lock = threading.Lock()
+
+    def _track(self, holder: list) -> None:
+        with self._conn_lock:
+            self._conns.add(id(holder))
+            self._holders[id(holder)] = holder
+
+    def _untrack(self, holder: list) -> None:
+        with self._conn_lock:
+            self._conns.discard(id(holder))
+            self._holders.pop(id(holder), None)
+
+    @property
+    def connection_count(self) -> int:
+        with self._conn_lock:
+            return len(self._conns)
 
     @property
     def addr(self) -> tuple[str, int]:
@@ -220,21 +370,31 @@ class RPCServer:
     def stop(self) -> None:
         self._srv.shutdown()
         self._srv.server_close()
+        with self._conn_lock:
+            holders = list(self._holders.values())
+        for holder in holders:  # unblock handler threads mid-read
+            try:
+                holder[0].close()
+            except OSError:
+                pass
 
 
 class RPCClient:
     def __init__(self, host: str, port: int, timeout: float = 10.0,
-                 tls=None, server_hostname: str | None = None):
+                 tls=None, server_hostname: str | None = None,
+                 keepalive: KeepaliveOptions | None = None):
         self._addr = (host, port)
         self._timeout = timeout
         self._tls = tls  # comm.tls.TLSCredentials | None
         self._server_hostname = server_hostname
+        self._keepalive = keepalive or KeepaliveOptions()
         self._ssl_context = (
             tls.client_context() if tls is not None else None
         )
 
     def _connect(self, method: str, body: bytes):
         sock = socket.create_connection(self._addr, timeout=self._timeout)
+        set_tcp_keepalive(sock, self._keepalive)
         if self._ssl_context is not None:
             try:
                 sock = self._ssl_context.wrap_socket(
@@ -263,6 +423,8 @@ class RPCClient:
                 if frame is None:
                     raise RPCError("connection closed mid-reply")
                 kind, rest = frame[0], frame[1:]
+                if kind == KIND_PING:
+                    continue  # server alive, reply still pending
                 if kind == KIND_ERR:
                     raise RPCError(rest.decode("utf-8", "replace"))
                 if kind == KIND_END:
@@ -272,14 +434,29 @@ class RPCClient:
             sock.close()
 
     def stream(self, method: str, body: bytes = b""):
-        """Server-streaming call: yields DATA bodies until END."""
+        """Server-streaming call: yields DATA bodies until END.
+
+        Long-lived streams are keepalive-aware: the server emits PING
+        frames on quiet intervals, so the read deadline is
+        ping_interval + ping_timeout — silence past that means a dead
+        peer (RPCError), while a merely idle stream stays up
+        indefinitely."""
         sock = self._connect(method, body)
+        ka = self._keepalive
         try:
+            sock.settimeout(ka.ping_interval + ka.ping_timeout)
             while True:
-                frame = read_frame(sock)
+                try:
+                    frame = read_frame(sock)
+                except socket.timeout:
+                    raise RPCError(
+                        "stream silent past the keepalive deadline"
+                    ) from None
                 if frame is None:
                     raise RPCError("connection closed mid-stream")
                 kind, rest = frame[0], frame[1:]
+                if kind == KIND_PING:
+                    continue  # live-idle stream
                 if kind == KIND_ERR:
                     raise RPCError(rest.decode("utf-8", "replace"))
                 if kind == KIND_END:
@@ -289,5 +466,6 @@ class RPCClient:
             sock.close()
 
 
-__all__ = ["RPCServer", "RPCClient", "RPCError", "Stream", "read_frame",
+__all__ = ["RPCServer", "RPCClient", "RPCError", "Stream",
+           "KeepaliveOptions", "set_tcp_keepalive", "read_frame",
            "write_frame"]

@@ -27,6 +27,9 @@ class OrdererConfig:
     preferred_max_bytes: int
     batch_timeout_s: float
     org_mspids: list[str]
+    # ConsensusType.State: STATE_NORMAL / STATE_MAINTENANCE (the
+    # consensus-type migration gate, reference maintenancefilter.go)
+    consensus_state: int = 0
 
 
 @dataclasses.dataclass
@@ -105,6 +108,7 @@ class Bundle:
         return OrdererConfig(
             consensus_type=ct.type,
             consensus_metadata=ct.metadata,
+            consensus_state=ct.state,
             max_message_count=bs.max_message_count,
             absolute_max_bytes=bs.absolute_max_bytes,
             preferred_max_bytes=bs.preferred_max_bytes,

@@ -1,0 +1,129 @@
+"""Runtime profiling endpoint — the pprof equivalent.
+
+Reference: both binaries import net/http/pprof (cmd/peer/main.go:10,
+orderer/common/server/main.go:16) and serve it when enabled
+(peer.profile.* in core.yaml via core/peer/config.go:83-85;
+General.Profile.Address, orderer main.go:410-412).  The Python host has
+no pprof, so this serves the same intent natively:
+
+  /debug/pprof/            index
+  /debug/pprof/goroutine   stack dump of every live thread (the
+                           goroutine-profile analogue; same content as
+                           the SIGUSR1 diag dump)
+  /debug/pprof/profile     ?seconds=N (default 5): statistical CPU
+                           profile — samples sys._current_frames()
+                           every ~10ms and returns collapsed stacks
+                           ("frame;frame;frame count" per line), the
+                           flamegraph.pl / speedscope input format
+  /debug/pprof/heap        tracemalloc snapshot (top allocations by
+                           size; tracing starts at the first request)
+"""
+
+from __future__ import annotations
+
+import http.server
+import sys
+import threading
+import time
+import traceback
+from collections import Counter
+from urllib.parse import parse_qs, urlparse
+
+from fabric_tpu.common.diag import dump_threads
+
+
+def collect_cpu_profile(seconds: float, interval: float = 0.01) -> str:
+    """Sample every thread's stack for `seconds`; returns collapsed
+    stacks, one `frame;frame;... count` line per distinct stack."""
+    counts: Counter = Counter()
+    me = threading.get_ident()
+    deadline = time.monotonic() + seconds
+    while time.monotonic() < deadline:
+        for tid, frame in sys._current_frames().items():
+            if tid == me:
+                continue
+            stack = traceback.extract_stack(frame)
+            key = ";".join(
+                f"{f.name} ({f.filename.rsplit('/', 1)[-1]}:{f.lineno})"
+                for f in stack
+            )
+            counts[key] += 1
+        time.sleep(interval)
+    return "\n".join(f"{k} {v}" for k, v in counts.most_common()) + "\n"
+
+
+def collect_heap_profile(limit: int = 50) -> str:
+    import tracemalloc
+
+    if not tracemalloc.is_tracing():
+        tracemalloc.start()
+        return (
+            "tracemalloc started now; request again after the workload "
+            "allocates\n"
+        )
+    snap = tracemalloc.take_snapshot()
+    lines = [
+        str(stat) for stat in snap.statistics("lineno")[:limit]
+    ]
+    return "\n".join(lines) + "\n"
+
+
+class _Handler(http.server.BaseHTTPRequestHandler):
+    def log_message(self, *a):  # quiet
+        pass
+
+    def _text(self, body: str, code: int = 200) -> None:
+        raw = body.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "text/plain; charset=utf-8")
+        self.send_header("Content-Length", str(len(raw)))
+        self.end_headers()
+        self.wfile.write(raw)
+
+    def do_GET(self):
+        url = urlparse(self.path)
+        if url.path in ("/debug/pprof", "/debug/pprof/"):
+            self._text(
+                "profiles:\n  goroutine\n  profile?seconds=N\n  heap\n"
+            )
+        elif url.path == "/debug/pprof/goroutine":
+            import io
+
+            buf = io.StringIO()
+            dump_threads(buf)
+            self._text(buf.getvalue())
+        elif url.path == "/debug/pprof/profile":
+            q = parse_qs(url.query)
+            seconds = min(float(q.get("seconds", ["5"])[0]), 120.0)
+            self._text(collect_cpu_profile(seconds))
+        elif url.path == "/debug/pprof/heap":
+            self._text(collect_heap_profile())
+        else:
+            self._text("not found\n", 404)
+
+
+class ProfileServer:
+    """The peer/orderer profiling listener (enabled by
+    peer.profile.enabled / General.Profile.Enabled)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._srv = http.server.ThreadingHTTPServer((host, port), _Handler)
+        self._thread: threading.Thread | None = None
+
+    @property
+    def addr(self) -> tuple[str, int]:
+        return self._srv.server_address[:2]
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._srv.serve_forever, daemon=True,
+            name="profile-server",
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
+__all__ = ["ProfileServer", "collect_cpu_profile", "collect_heap_profile"]

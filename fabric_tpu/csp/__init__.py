@@ -17,7 +17,12 @@ from fabric_tpu.csp.api import (
     VerifyBatchItem,
 )
 from fabric_tpu.csp.sw import SWCSP
-from fabric_tpu.csp.factory import get_default, init_factories
+from fabric_tpu.csp.factory import csp_from_config, get_default, init_factories
+from fabric_tpu.csp.keystore import (
+    DummyKeyStore,
+    FileKeyStore,
+    InMemoryKeyStore,
+)
 
 __all__ = [
     "CSP",
@@ -28,4 +33,8 @@ __all__ = [
     "SWCSP",
     "get_default",
     "init_factories",
+    "csp_from_config",
+    "InMemoryKeyStore",
+    "FileKeyStore",
+    "DummyKeyStore",
 ]

@@ -44,9 +44,43 @@ def get_default() -> CSP:
 
 def _new_csp(provider: str, **kwargs) -> CSP:
     if provider == "sw":
-        return SWCSP()
+        return SWCSP(**kwargs)
     if provider == "tpu":
         from fabric_tpu.csp.tpu.provider import TPUCSP
 
         return TPUCSP(**kwargs)
     raise ValueError(f"unknown CSP provider {provider!r}")
+
+
+def csp_from_config(cfg, prefix: str = "bccsp") -> CSP:
+    """Build a CSP from a core.yaml/orderer.yaml BCCSP block (reference
+    bccsp/factory/opts.go + sampleconfig/core.yaml:290-315):
+
+        bccsp:
+          default: SW | TPU
+          sw:
+            fileKeyStore:
+              keyStorePath: <dir>     # empty/absent -> in-memory
+          tpu:
+            minDeviceBatch: <n>
+
+    The file keystore is what makes node restarts reuse generated keys
+    (reference fileks.go); it backs BOTH providers' key management (the
+    tpu provider delegates keys/signing to its embedded sw provider)."""
+    provider = str(cfg.get(f"{prefix}.default", "SW")).lower()
+    ks_path = cfg.get(f"{prefix}.sw.fileKeyStore.keyStorePath")
+    keystore = None
+    if ks_path:
+        from fabric_tpu.csp.keystore import FileKeyStore
+
+        keystore = FileKeyStore(str(ks_path))
+    sw = SWCSP(keystore=keystore)
+    if provider == "tpu":
+        from fabric_tpu.csp.tpu.provider import TPUCSP
+
+        kwargs = {}
+        mdb = cfg.get(f"{prefix}.tpu.minDeviceBatch")
+        if mdb is not None:
+            kwargs["min_device_batch"] = int(mdb)
+        return TPUCSP(sw=sw, **kwargs)
+    return sw

@@ -12,7 +12,6 @@ DER-unmarshal, reject r/s <= 0, reject high-S, then curve verify.
 from __future__ import annotations
 
 import hashlib
-import threading
 from typing import Sequence
 
 from cryptography.exceptions import InvalidSignature
@@ -33,12 +32,13 @@ _PREHASHED_SHA256 = ec.ECDSA(Prehashed(hashes.SHA256()))
 
 
 class SWCSP(CSP):
-    """In-memory keystore + host crypto. Reference: bccsp/sw/impl.go,
-    bccsp/sw/inmemoryks.go."""
+    """Host crypto over a pluggable keystore (reference bccsp/sw/impl.go;
+    keystores: inmemoryks.go default, fileks.go via csp.keystore)."""
 
-    def __init__(self) -> None:
-        self._keys: dict[bytes, Key] = {}
-        self._lock = threading.Lock()
+    def __init__(self, keystore=None) -> None:
+        from fabric_tpu.csp.keystore import InMemoryKeyStore
+
+        self._ks = keystore if keystore is not None else InMemoryKeyStore()
 
     # -- key management ----------------------------------------------------
 
@@ -61,15 +61,10 @@ class SWCSP(CSP):
         return key
 
     def get_key(self, ski: bytes) -> Key:
-        with self._lock:
-            key = self._keys.get(ski)
-        if key is None:
-            raise KeyError(f"no key for SKI {ski.hex()}")
-        return key
+        return self._ks.get_key(ski)
 
     def _store(self, key: Key) -> None:
-        with self._lock:
-            self._keys[key.ski()] = key
+        self._ks.store_key(key)
 
     # -- hashing -----------------------------------------------------------
 

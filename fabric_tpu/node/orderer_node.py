@@ -37,6 +37,7 @@ class OrdererNode:
         node_id: int = 1,
         transport=None,
         tls=None,
+        keepalive=None,
     ):
         self.tls = tls  # comm.tls.TLSCredentials | None
         self.registrar = Registrar(
@@ -63,7 +64,7 @@ class OrdererNode:
             self.registrar.startup(genesis_blocks)
 
         self._signer = signer
-        self.rpc = RPCServer(host, port, tls=tls)
+        self.rpc = RPCServer(host, port, tls=tls, keepalive=keepalive)
         self.rpc.register("ab.Broadcast", self._broadcast)
         self.rpc.register("ab.Deliver", self._deliver)
         self.rpc.register("participation.Join", self._join)

@@ -167,6 +167,7 @@ class PeerNode:
         endorser_concurrency: int = 2500,
         deliver_concurrency: int = 2500,
         tls=None,
+        keepalive=None,
     ):
         self.csp = csp
         self.signer = signer
@@ -242,7 +243,7 @@ class PeerNode:
                 ) else "empty ledger",
             )
 
-        self.rpc = RPCServer(host, port, tls=tls)
+        self.rpc = RPCServer(host, port, tls=tls, keepalive=keepalive)
         # per-service concurrency limiters (reference
         # internal/peer/node/grpc_limiters.go; values from core.yaml
         # peer.limits.concurrency via the CLI, defaults 2500)

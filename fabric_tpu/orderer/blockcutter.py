@@ -26,6 +26,15 @@ class BlockCutter:
     def from_orderer_config(cls, oc) -> "BlockCutter":
         return cls(oc.max_message_count, oc.preferred_max_bytes, oc.absolute_max_bytes)
 
+    def update_from_orderer_config(self, oc) -> None:
+        """Adopt new BatchSize limits in place (a committed config
+        update must take effect on the RUNNING chain, which holds this
+        cutter; pending messages keep accumulating under the new
+        limits)."""
+        self.max_message_count = oc.max_message_count
+        self.preferred_max_bytes = oc.preferred_max_bytes
+        self.absolute_max_bytes = oc.absolute_max_bytes
+
     def ordered(self, env_bytes: bytes) -> tuple[list[list[bytes]], bool]:
         """Enqueue one message; returns (cut batches, pending remains)."""
         batches: list[list[bytes]] = []

@@ -138,6 +138,10 @@ class KafkaChain:
     def wait_ready(self) -> None:
         return
 
+    def set_batch_timeout(self, seconds: float) -> None:
+        """Adopt a committed BatchTimeout config change."""
+        self._timeout = seconds
+
     def order(self, env, config_seq: int = 0) -> None:
         if self._halted.is_set():
             raise RuntimeError("chain is halted")

@@ -3,7 +3,14 @@
 Reference: orderer/common/msgprocessor (standardchannel.go:100
 ProcessNormalMsg runs the rule set; sigfilter.go evaluates the channel
 Writers policy over the envelope signature; sizefilter.go enforces
-absolute_max_bytes; expiration.go rejects expired creator certs).
+absolute_max_bytes; expiration.go rejects expired creator certs;
+maintenancefilter.go:31-44 gates consensus-type changes behind
+STATE_MAINTENANCE and forbids type changes on entry/exit).
+
+Config updates run the configtx engine (ProposeConfigUpdate), pass the
+maintenance filter, and come back wrapped as an orderer-signed CONFIG
+envelope for the consenter's configure() path — the reference's
+StandardChannel.ProcessConfigUpdateMsg shape.
 """
 
 from __future__ import annotations
@@ -15,7 +22,11 @@ from cryptography import x509
 
 from fabric_tpu.protos.common import common_pb2
 from fabric_tpu.protos.msp import identities_pb2
+from fabric_tpu.protos.orderer import configuration_pb2 as orderer_cfg_pb2
 from fabric_tpu.protoutil import SignedData
+
+STATE_NORMAL = orderer_cfg_pb2.ConsensusType.STATE_NORMAL
+STATE_MAINTENANCE = orderer_cfg_pb2.ConsensusType.STATE_MAINTENANCE
 
 
 class Classification(enum.Enum):
@@ -29,10 +40,24 @@ class MsgProcessorError(Exception):
 
 
 class StandardChannelProcessor:
-    def __init__(self, channel_id: str, bundle, csp):
+    def __init__(self, channel_id: str, bundle, csp, signer=None):
         self.channel_id = channel_id
         self._bundle = bundle
         self._csp = csp
+        self._signer = signer  # orderer identity wrapping CONFIG envelopes
+
+    @property
+    def bundle(self):
+        return self._bundle
+
+    def update_bundle(self, bundle) -> None:
+        """Adopt the post-config-block resources (the reference swaps the
+        channelconfig Bundle on the chain support after a config commit)."""
+        self._bundle = bundle
+
+    def in_maintenance(self) -> bool:
+        oc = self._bundle.orderer_config
+        return oc is not None and oc.consensus_state == STATE_MAINTENANCE
 
     def classify(self, env: common_pb2.Envelope) -> Classification:
         payload = common_pb2.Payload.FromString(env.payload)
@@ -77,10 +102,132 @@ class StandardChannelProcessor:
             raise MsgProcessorError("creator certificate has expired")
 
     def _sig_filter(self, env: common_pb2.Envelope, shdr) -> None:
-        policy = self._bundle.policy_manager.get_policy("/Channel/Writers")
+        # During maintenance the write gate tightens to the ORDERER
+        # writers policy — application clients cannot submit while the
+        # consensus type migrates (reference standardchannel.go NewSigFilter
+        # with ChannelWriters/ChannelOrdererWriters pair).
+        name = (
+            "/Channel/Orderer/Writers"
+            if self.in_maintenance()
+            else "/Channel/Writers"
+        )
+        policy = self._bundle.policy_manager.get_policy(name)
         sd = [SignedData(env.payload, shdr.creator, env.signature)]
         if not policy.evaluate_signed_data(sd, self._csp):
-            raise MsgProcessorError("message did not satisfy the channel Writers policy")
+            raise MsgProcessorError(
+                f"message did not satisfy the {name} policy"
+            )
+
+    # -- config updates ----------------------------------------------------
+
+    def process_config_update_msg(self, env: common_pb2.Envelope):
+        """Run a CONFIG_UPDATE through the configtx engine + maintenance
+        filter; returns (orderer-signed CONFIG envelope, config seq)
+        for the consenter's configure() path (reference
+        standardchannel.go ProcessConfigUpdateMsg)."""
+        from fabric_tpu.common.configtx import ConfigtxValidator
+        from fabric_tpu.protos.common import configtx_pb2
+        from fabric_tpu import protoutil
+
+        self._size_filter(env)
+        payload = common_pb2.Payload.FromString(env.payload)
+        chdr = common_pb2.ChannelHeader.FromString(
+            payload.header.channel_header
+        )
+        if chdr.channel_id != self.channel_id:
+            raise MsgProcessorError(
+                f"config update for channel {chdr.channel_id!r}, "
+                f"this is {self.channel_id!r}"
+            )
+        shdr = common_pb2.SignatureHeader.FromString(
+            payload.header.signature_header
+        )
+        self._expiration_filter(shdr.creator)
+        # same sigfilter pair as normal messages — during maintenance
+        # this is the gate that keeps application admins from slipping
+        # config updates into a live migration (reference applies the
+        # filter chain to ProcessConfigUpdateMsg too)
+        self._sig_filter(env, shdr)
+        try:
+            update_env = configtx_pb2.ConfigUpdateEnvelope.FromString(
+                payload.data
+            )
+        except Exception as exc:
+            raise MsgProcessorError(f"bad config update: {exc}") from exc
+        validator = ConfigtxValidator(
+            self.channel_id,
+            self._bundle.config,
+            policy_manager=self._bundle.policy_manager,
+            csp=self._csp,
+        )
+        try:
+            cfg_env = validator.propose_config_update(update_env)
+        except Exception as exc:
+            raise MsgProcessorError(str(exc)) from exc
+        self._maintenance_filter(cfg_env.config)
+        cfg_env.last_update.CopyFrom(env)
+        import os
+
+        creator = (
+            self._signer.serialize() if self._signer is not None else b""
+        )
+        payload_bytes = protoutil.make_payload_bytes(
+            protoutil.make_channel_header(
+                common_pb2.CONFIG, channel_id=self.channel_id
+            ),
+            protoutil.make_signature_header(creator, os.urandom(24)),
+            cfg_env.SerializeToString(),
+        )
+        new_env = protoutil.make_envelope(payload_bytes, signer=self._signer)
+        return new_env, self._bundle.config.sequence
+
+    def _maintenance_filter(self, new_config) -> None:
+        """Reference maintenancefilter.go:31-44 semantics: the consensus
+        type may only change while the channel is in (and stays in)
+        STATE_MAINTENANCE; entering or leaving maintenance must not
+        itself change the type."""
+        from fabric_tpu.common.channelconfig import Bundle
+
+        cur = self._bundle.orderer_config
+        if cur is None:
+            return
+        nxt = Bundle(
+            self.channel_id, _config_copy(new_config), self._csp
+        ).orderer_config
+        if nxt is None:
+            raise MsgProcessorError(
+                "config update removes the Orderer group"
+            )
+        if cur.consensus_state == STATE_NORMAL:
+            if nxt.consensus_type != cur.consensus_type:
+                raise MsgProcessorError(
+                    "attempted to change consensus type from "
+                    f"{cur.consensus_type!r} to {nxt.consensus_type!r} "
+                    "outside of maintenance mode"
+                )
+        else:  # currently in maintenance
+            if (
+                nxt.consensus_state == STATE_NORMAL
+                and nxt.consensus_type != cur.consensus_type
+            ):
+                raise MsgProcessorError(
+                    "attempted to change consensus type and exit "
+                    "maintenance mode in the same update"
+                )
 
 
-__all__ = ["StandardChannelProcessor", "MsgProcessorError", "Classification"]
+def _config_copy(config):
+    from fabric_tpu.protos.common import configtx_pb2
+
+    out = configtx_pb2.Config()
+    out.CopyFrom(config)
+    return out
+
+
+__all__ = [
+    "StandardChannelProcessor",
+    "MsgProcessorError",
+    "Classification",
+    "STATE_NORMAL",
+    "STATE_MAINTENANCE",
+]

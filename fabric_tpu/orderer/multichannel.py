@@ -91,9 +91,12 @@ class Registrar:
         writer = BlockWriter(store, signer=self.signer)
         oc = bundle.orderer_config
         cutter = BlockCutter.from_orderer_config(oc) if oc else BlockCutter()
-        processor = StandardChannelProcessor(channel_id, bundle, self.csp)
+        processor = StandardChannelProcessor(
+            channel_id, bundle, self.csp, signer=self.signer
+        )
         chain = self._build_consenter(channel_id, bundle, cutter, writer)
         cs = ChainSupport(channel_id, bundle, store, writer, processor, chain)
+        cs.cutter = cutter  # the running chain shares this instance
         with self._lock:
             self._chains[channel_id] = cs
         chain.start()
@@ -183,8 +186,78 @@ class Registrar:
         self._on_block_hooks.append(hook)
 
     def _fan_out(self, channel_id: str, blk: common_pb2.Block) -> None:
+        self._maybe_apply_config(channel_id, blk)
         for hook in self._on_block_hooks:
             hook(channel_id, blk)
+
+    # -- config-block application (bundle swap + consensus migration) ------
+
+    def _maybe_apply_config(self, channel_id: str, blk: common_pb2.Block) -> None:
+        """When a written block carries a CONFIG tx, swap the channel's
+        bundle/processor/cutter to the new resources (the reference's
+        BlockWriter.WriteConfigBlock -> chainSupport bundle update), and
+        when the config changed the consensus TYPE — the maintenance-mode
+        migration path — replace the consenter with a freshly built one.
+        The swap runs on a helper thread: the notification arrives on
+        the old chain's own thread, which halt() must join."""
+        try:
+            env = protoutil.extract_envelope(blk, 0)
+            chdr = protoutil.channel_header(env)
+            if chdr.type != common_pb2.CONFIG:
+                return
+        except Exception:
+            return
+        cs = self.get_chain(channel_id)
+        if cs is None:
+            return
+        try:
+            new_bundle = bundle_from_genesis(blk, self.csp)
+        except Exception:
+            return
+        old_type = (
+            cs.bundle.orderer_config.consensus_type
+            if cs.bundle.orderer_config
+            else "solo"
+        )
+        cs.bundle = new_bundle
+        cs.processor.update_bundle(new_bundle)
+        oc = new_bundle.orderer_config
+        if oc:
+            from fabric_tpu.orderer.blockcutter import BlockCutter
+
+            new_type = oc.consensus_type or "solo"
+            if (
+                new_type != old_type
+                and "type" not in self._consenter_overrides
+            ):
+                threading.Thread(
+                    target=self._migrate_consenter,
+                    args=(channel_id, new_bundle,
+                          BlockCutter.from_orderer_config(oc)),
+                    daemon=True,
+                ).start()
+            else:
+                # same consenter keeps running: adopt the new BatchSize
+                # in the SHARED cutter and the new BatchTimeout in place
+                cutter = getattr(cs, "cutter", None)
+                if cutter is not None:
+                    cutter.update_from_orderer_config(oc)
+                if hasattr(cs.chain, "set_batch_timeout"):
+                    cs.chain.set_batch_timeout(oc.batch_timeout_s)
+
+    def _migrate_consenter(self, channel_id: str, bundle, cutter) -> None:
+        cs = self.get_chain(channel_id)
+        if cs is None:
+            return
+        old = cs.chain
+        try:
+            old.halt()
+        except Exception:
+            pass
+        chain = self._build_consenter(channel_id, bundle, cutter, cs.writer)
+        cs.cutter = cutter
+        cs.chain = chain
+        chain.start()
 
     def halt_all(self) -> None:
         with self._lock:

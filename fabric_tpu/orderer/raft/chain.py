@@ -105,6 +105,10 @@ class RaftChain:
     def wait_ready(self) -> None:
         return
 
+    def set_batch_timeout(self, seconds: float) -> None:
+        """Adopt a committed BatchTimeout config change."""
+        self._timeout = seconds
+
     @property
     def is_leader(self) -> bool:
         return self.node.is_leader

@@ -43,6 +43,10 @@ class SoloChain:
     def wait_ready(self) -> None:
         return
 
+    def set_batch_timeout(self, seconds: float) -> None:
+        """Adopt a committed BatchTimeout config change."""
+        self._timeout = seconds
+
     def order(self, env: common_pb2.Envelope, config_seq: int = 0) -> None:
         if self._halted.is_set():
             raise RuntimeError("chain is halted")

@@ -110,3 +110,88 @@ def test_der_marshal_roundtrip():
 def test_to_low_s():
     assert api.to_low_s(api.P256_HALF_N) == api.P256_HALF_N
     assert api.to_low_s(api.P256_HALF_N + 1) == api.P256_N - api.P256_HALF_N - 1
+
+
+class TestKeystores:
+    def test_file_keystore_persists_across_instances(self, tmp_path):
+        from fabric_tpu.csp import FileKeyStore, SWCSP
+
+        ks_dir = str(tmp_path / "keystore")
+        sw1 = SWCSP(keystore=FileKeyStore(ks_dir))
+        key = sw1.key_gen()
+        ski = key.ski()
+        # a fresh provider over the same directory finds the key
+        sw2 = SWCSP(keystore=FileKeyStore(ks_dir))
+        import hashlib
+        d = hashlib.sha256(b"persisted").digest()
+        sig = sw2.sign(sw2.get_key(ski), d)
+        assert sw1.verify(key, sig, d)
+
+    def test_file_keystore_permissions_and_mismatch(self, tmp_path):
+        import os
+
+        from fabric_tpu.csp import FileKeyStore, SWCSP
+
+        ks_dir = str(tmp_path / "ks")
+        ks = FileKeyStore(ks_dir)
+        sw = SWCSP(keystore=ks)
+        key = sw.key_gen()
+        sk = os.path.join(ks_dir, key.ski().hex() + "_sk.pem")
+        assert os.path.exists(sk)
+        assert oct(os.stat(sk).st_mode & 0o777) == "0o600"
+        assert oct(os.stat(ks_dir).st_mode & 0o777) == "0o700"
+        # a file renamed under the wrong SKI is rejected
+        other = SWCSP().key_gen()
+        bogus = os.path.join(ks_dir, other.ski().hex() + "_sk.pem")
+        os.rename(sk, bogus)
+        ks2 = FileKeyStore(ks_dir)
+        try:
+            ks2.get_key(other.ski())
+            raise AssertionError("SKI mismatch must be rejected")
+        except KeyError:
+            pass
+
+    def test_read_only_keystore_refuses_store(self, tmp_path):
+        from fabric_tpu.csp import FileKeyStore, SWCSP
+
+        ks = FileKeyStore(str(tmp_path / "ro"), read_only=True)
+        sw = SWCSP(keystore=ks)
+        try:
+            sw.key_gen()
+            raise AssertionError("read-only keystore must refuse stores")
+        except PermissionError:
+            pass
+
+    def test_dummy_keystore(self):
+        from fabric_tpu.csp import DummyKeyStore, SWCSP
+
+        sw = SWCSP(keystore=DummyKeyStore())
+        key = sw.key_gen()  # store is a no-op
+        try:
+            sw.get_key(key.ski())
+            raise AssertionError("dummy keystore must hold nothing")
+        except KeyError:
+            pass
+
+    def test_csp_from_config_selects_keystore_and_provider(self, tmp_path):
+        from fabric_tpu.common.config import Config
+        from fabric_tpu.csp import FileKeyStore, SWCSP, csp_from_config
+
+        ks_dir = str(tmp_path / "cfgks")
+        cfg = Config(
+            {
+                "bccsp": {
+                    "default": "SW",
+                    "sw": {"fileKeyStore": {"keyStorePath": ks_dir}},
+                }
+            }
+        )
+        csp = csp_from_config(cfg)
+        assert isinstance(csp, SWCSP)
+        key = csp.key_gen()
+        # restart: a second config-built CSP reuses the persisted key
+        csp2 = csp_from_config(cfg)
+        assert csp2.get_key(key.ski()).ski() == key.ski()
+        # empty path -> in-memory
+        csp3 = csp_from_config(Config({"bccsp": {"default": "SW"}}))
+        assert isinstance(csp3._ks, type(SWCSP()._ks))

@@ -165,3 +165,187 @@ def test_deliver_unknown_channel(registrar, org):
     svc = DeliverService(registrar.get_chain, org.csp)
     env = make_seek_info_envelope("ghost", 0, 0, signer=org.admin)
     assert list(svc.deliver(env)) == [("status", common_pb2.NOT_FOUND)]
+
+
+# -- maintenance mode + consensus-type migration ---------------------------
+# (reference orderer/common/msgprocessor/maintenancefilter.go:31-44)
+
+
+class _MigrationWorld:
+    """A solo channel whose admins can drive config updates end to end."""
+
+    def __init__(self, tmp_path):
+        from fabric_tpu.common import configtx_builder as cb
+
+        self.org1 = make_org("Org1MSP")
+        self.oorg = make_org("OrdererMSP")
+        app = ctx.application_group(
+            {"Org1": ctx.org_group(
+                "Org1MSP", msp_config_from_ca(self.org1.ca, "Org1MSP"))}
+        )
+        ordg = ctx.orderer_group(
+            {"OrdererOrg": ctx.org_group(
+                "OrdererMSP", msp_config_from_ca(self.oorg.ca, "OrdererMSP"))},
+            consensus_type="solo",
+            max_message_count=1,
+            batch_timeout="200ms",
+        )
+        self.channel_id = "migrch"
+        self.genesis = ctx.genesis_block(
+            self.channel_id, ctx.channel_group(app, ordg)
+        )
+        self.csp = self.org1.csp
+        self.client = self.org1.signer("client", role_ou="client")
+        self.orderer_admin = self.oorg.signer("oadmin", role_ou="admin")
+        from fabric_tpu.orderer.kafka import InProcBroker
+
+        self.registrar = Registrar(
+            str(tmp_path), self.csp,
+            signer=self.oorg.signer("orderer0", role_ou="orderer"),
+            consenter_overrides={"broker": InProcBroker()},
+        )
+        self.registrar.startup([self.genesis])
+        self.handler = BroadcastHandler(self.registrar)
+
+    def current_config(self):
+        return self.registrar.get_chain(self.channel_id).bundle.config
+
+    def update_env(self, mutate):
+        """Signed CONFIG_UPDATE envelope transforming the current config
+        with `mutate(updated_config)`."""
+        from fabric_tpu.common.configtx import compute_update
+        from fabric_tpu.protos.common import configtx_pb2
+
+        cur = self.current_config()
+        upd_cfg = configtx_pb2.Config()
+        upd_cfg.CopyFrom(cur)
+        mutate(upd_cfg)
+        update = compute_update(self.channel_id, cur, upd_cfg)
+        ue = configtx_pb2.ConfigUpdateEnvelope(
+            config_update=update.SerializeToString()
+        )
+        shdr = protoutil.make_signature_header(
+            self.orderer_admin.serialize(), protoutil.random_nonce()
+        ).SerializeToString()
+        ue.signatures.add(
+            signature_header=shdr,
+            signature=self.orderer_admin.sign(
+                shdr + ue.config_update
+            ),
+        )
+        chdr = protoutil.make_channel_header(
+            common_pb2.CONFIG_UPDATE, channel_id=self.channel_id
+        )
+        payload = protoutil.make_payload_bytes(
+            chdr,
+            protoutil.make_signature_header(
+                self.orderer_admin.serialize(), protoutil.random_nonce()
+            ),
+            ue.SerializeToString(),
+        )
+        return protoutil.make_envelope(payload, signer=self.orderer_admin)
+
+    def set_consensus(self, cfg, ctype=None, state=None):
+        from fabric_tpu.common import configtx_builder as cb
+        from fabric_tpu.protos.orderer import configuration_pb2 as ocp
+
+        og = cfg.channel_group.groups["Orderer"]
+        cur = ocp.ConsensusType.FromString(
+            og.values[cb.CONSENSUS_TYPE_KEY].value
+        )
+        if ctype is not None:
+            cur.type = ctype
+        if state is not None:
+            cur.state = state
+        og.values[cb.CONSENSUS_TYPE_KEY].value = cur.SerializeToString()
+
+    def normal_tx(self, signer, data=b"tx"):
+        chdr = protoutil.make_channel_header(
+            common_pb2.ENDORSER_TRANSACTION, channel_id=self.channel_id
+        )
+        shdr = protoutil.make_signature_header(
+            signer.serialize(), protoutil.random_nonce()
+        )
+        payload = common_pb2.Payload(data=data)
+        payload.header.channel_header = chdr.SerializeToString()
+        payload.header.signature_header = shdr.SerializeToString()
+        raw = payload.SerializeToString()
+        return common_pb2.Envelope(payload=raw, signature=signer.sign(raw))
+
+    def wait_height(self, h, timeout=10.0):
+        cs = self.registrar.get_chain(self.channel_id)
+        deadline = time.time() + timeout
+        while cs.store.height < h and time.time() < deadline:
+            time.sleep(0.02)
+        return cs.store.height
+
+
+def test_consensus_migration_through_maintenance_mode(tmp_path):
+    """Full migration flow: type change rejected in NORMAL; enter
+    maintenance; client txs rejected while orderer admins still write;
+    type change accepted in maintenance; exit maintenance; the channel
+    orders through the NEW consenter."""
+    from fabric_tpu.orderer.msgprocessor import (
+        STATE_MAINTENANCE,
+        STATE_NORMAL,
+    )
+
+    w = _MigrationWorld(tmp_path)
+    try:
+        reg, h = w.registrar, w.handler
+        # 0) type change outside maintenance is FORBIDDEN
+        env = w.update_env(
+            lambda c: w.set_consensus(c, ctype="kafka")
+        )
+        assert h.process_message(env) == common_pb2.FORBIDDEN
+
+        # 1) enter maintenance (type unchanged) — accepted
+        env = w.update_env(
+            lambda c: w.set_consensus(c, state=STATE_MAINTENANCE)
+        )
+        assert h.process_message(env) == common_pb2.SUCCESS
+        hh = w.wait_height(2)
+        assert hh == 2
+        cs = reg.get_chain(w.channel_id)
+        assert cs.processor.in_maintenance()
+
+        # 2) while in maintenance, client txs are rejected...
+        assert (
+            h.process_message(w.normal_tx(w.client))
+            == common_pb2.FORBIDDEN
+        )
+        # ...and entering again with a simultaneous exit+type change fails
+        env = w.update_env(
+            lambda c: w.set_consensus(c, ctype="kafka", state=STATE_NORMAL)
+        )
+        assert h.process_message(env) == common_pb2.FORBIDDEN
+
+        # 3) change the consensus type INSIDE maintenance — accepted;
+        #    the registrar swaps the consenter (solo -> kafka)
+        env = w.update_env(lambda c: w.set_consensus(c, ctype="kafka"))
+        assert h.process_message(env) == common_pb2.SUCCESS
+        assert w.wait_height(3) == 3
+        deadline = time.time() + 5
+        from fabric_tpu.orderer.kafka import KafkaChain
+
+        while time.time() < deadline and not isinstance(
+            reg.get_chain(w.channel_id).chain, KafkaChain
+        ):
+            time.sleep(0.05)
+        assert isinstance(reg.get_chain(w.channel_id).chain, KafkaChain)
+
+        # 4) exit maintenance (type now stays kafka) — accepted
+        env = w.update_env(
+            lambda c: w.set_consensus(c, state=STATE_NORMAL)
+        )
+        assert h.process_message(env) == common_pb2.SUCCESS
+        assert w.wait_height(4) == 4
+        assert not reg.get_chain(w.channel_id).processor.in_maintenance()
+
+        # 5) normal client traffic orders through the NEW consenter
+        assert (
+            h.process_message(w.normal_tx(w.client)) == common_pb2.SUCCESS
+        )
+        assert w.wait_height(5) == 5
+    finally:
+        w.registrar.halt_all()

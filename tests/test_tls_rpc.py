@@ -173,3 +173,106 @@ def test_server_name_verification_opt_out(cas):
         assert cli.call("echo", b"hi") == b"ok:hi"
     finally:
         srv.stop()
+
+
+# -- keepalive / connection lifecycle --------------------------------------
+
+
+def test_hung_peer_reaped_by_idle_timeout():
+    """A client that connects and never sends a request is reaped after
+    the idle window (reference keepalive semantics: silent connections
+    must not hold server resources forever)."""
+    import socket
+    import time
+
+    from fabric_tpu.comm.rpc import KeepaliveOptions, RPCServer
+
+    srv = RPCServer(
+        keepalive=KeepaliveOptions(idle_timeout=0.3, ping_interval=0.2)
+    )
+    srv.register("echo", lambda body, stream: b"ok")
+    srv.start()
+    try:
+        sock = socket.create_connection(srv.addr, timeout=5)
+        deadline = time.time() + 5
+        while srv.connection_count == 0 and time.time() < deadline:
+            time.sleep(0.01)
+        assert srv.connection_count >= 1
+        # the server closes it without us ever sending a byte
+        sock.settimeout(5)
+        assert sock.recv(1) == b""
+        deadline = time.time() + 5
+        while srv.connection_count and time.time() < deadline:
+            time.sleep(0.02)
+        assert srv.connection_count == 0
+        sock.close()
+    finally:
+        srv.stop()
+
+
+def test_live_idle_stream_survives_keepalive():
+    """A streaming handler with gaps longer than the ping interval is
+    NOT torn down: PING frames keep the read deadline fresh and the
+    client still sees every item."""
+    import time
+
+    from fabric_tpu.comm.rpc import KeepaliveOptions, RPCClient, RPCServer
+
+    ka = KeepaliveOptions(
+        idle_timeout=5.0, ping_interval=0.15, ping_timeout=0.2
+    )
+
+    def slow(body, stream):
+        yield b"a"
+        time.sleep(0.6)  # several ping intervals of silence
+        yield b"b"
+
+    srv = RPCServer(keepalive=ka)
+    srv.register("slow", slow)
+    srv.start()
+    try:
+        cli = RPCClient(*srv.addr, timeout=5, keepalive=ka)
+        assert list(cli.stream("slow")) == [b"a", b"b"]
+    finally:
+        srv.stop()
+
+
+def test_dead_server_detected_on_stream():
+    """Silence past ping_interval + ping_timeout on a stream raises
+    instead of hanging forever (dead-peer detection)."""
+    import threading
+    import time
+
+    from fabric_tpu.comm.rpc import (
+        KeepaliveOptions,
+        RPCClient,
+        RPCError,
+        RPCServer,
+    )
+
+    # a server whose keepalive never fires (huge interval) simulates a
+    # peer that froze mid-stream
+    srv = RPCServer(keepalive=KeepaliveOptions(ping_interval=60.0))
+    hang = threading.Event()
+
+    def frozen(body, stream):
+        yield b"first"
+        hang.wait(10)  # never yields again, never ends
+
+    srv.register("frozen", frozen)
+    srv.start()
+    try:
+        ka = KeepaliveOptions(ping_interval=0.2, ping_timeout=0.2)
+        cli = RPCClient(*srv.addr, timeout=5, keepalive=ka)
+        it = cli.stream("frozen")
+        assert next(it) == b"first"
+        t0 = time.time()
+        try:
+            next(it)
+            raise AssertionError("frozen stream must raise")
+        except RPCError:
+            pass
+        assert time.time() - t0 < 5
+    finally:
+        hang.set()
+        srv.stop()
