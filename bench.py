@@ -109,12 +109,12 @@ def main() -> None:
         csp = sw
 
     best = float("inf")
-    for _ in range(5):
+    for _ in range(8):
         led = fresh_ledger()
         committer = Committer(TxValidator("benchch", led, bundle, csp), led)
         bs = copies(n_blocks)
         t0 = time.perf_counter()
-        for flags in committer.store_stream(iter(bs), depth=3):
+        for flags in committer.store_stream(iter(bs), depth=4):
             assert all(f == 0 for f in flags)
         best = min(best, time.perf_counter() - t0)
         assert led.height == 1 + n_blocks

@@ -37,8 +37,13 @@ class IdemixCSP:
     """Stateless provider; keys are passed explicitly (reference keeps them
     behind bccsp.Key handles — our callers hold the dataclasses directly)."""
 
-    def __init__(self, rng=None):
+    def __init__(self, rng=None, device: bool = False):
         self._rng = rng
+        # device=True batches the Schnorr commitment recomputation on
+        # the TPU (csp/tpu/bn254_batch.py); pairings stay native-host.
+        # Off by default: the kernel compiles per batch-shape bucket,
+        # which host-only flows should never pay for.
+        self._device = device
 
     # -- key generation (handlers/issuer.go, handlers/user.go) -------------
 
@@ -108,7 +113,12 @@ class IdemixCSP:
     ) -> list[bool]:
         """Per-item mask, two pairings for the whole batch (BASELINE.md
         BN256 batch-verify configuration)."""
-        return signature.verify_batch(
+        fn = (
+            signature.verify_batch_device
+            if self._device
+            else signature.verify_batch
+        )
+        return fn(
             [i.sig for i in items], ipk, [i.msg for i in items],
             rng=self._rng,
         )

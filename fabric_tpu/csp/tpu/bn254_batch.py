@@ -1,0 +1,401 @@
+"""Batched idemix Schnorr recomputation on the device (BN254 G1).
+
+The idemix verify hot path (reference idemix/signature.go:243 Ver)
+re-derives three ZK commitments per signature — small G1 multi-scalar
+multiplications — before the two pairings.  Round 2 ran this on the
+native CPU backend; here the whole batch's MSMs execute as ONE jitted
+XLA program over the shared limb machinery (csp/tpu/limbs.py, the same
+16-bit-limb arithmetic the ECDSA kernel uses), with the pairings staying
+on the native host path (csp's verify_batch collapses them to two per
+batch via random linear combination).
+
+Per signature the verifier needs (signature.py _relations +
+schnorr.recompute_commitments, with targets flattened into the MSMs —
+y1^(−c) = a_bar^(−c)·b_prime^{c}, y2^(−c) = G1^{c}·Π h_attrs[i]^{c·m_i}):
+
+  T1 = a_bar^{-c} · b_prime^{c} · a_prime^{z_neg_e} · h_rand^{z_r2}
+  T2 = G1^{c} · h_sk^{z_sk} · h_rand^{z_s'} · Π_i h_attrs[i]^{s_i}
+         · b_prime^{z_neg_r3}         s_i = c·m_i (disclosed) | z_mi (hidden)
+  T3 = nym^{-c} · h_sk^{z_sk} · h_rand^{z_r_nym}
+
+Shared bases (G1, h_sk, h_rand, h_attrs[*]) come as precomputed affine
+4-bit window tables (per issuer key, built once on host); per-lane bases
+(a_prime, a_bar, b_prime, nym) get device-built Jacobian tables.  One
+MSB-first 64-window ladder accumulates all three commitments; outputs
+are Jacobian, normalized on host with one batched inversion.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from fabric_tpu.csp.tpu import ec, limbs
+from fabric_tpu.csp.tpu.ec import Aff, Jac
+from fabric_tpu.csp.tpu.limbs import WIDE
+from fabric_tpu.idemix import bn254 as bn
+
+NWINDOWS = 64
+TABLE = 16
+# pad buckets (one XLA compile per (bucket, n_attrs)); batches beyond
+# the largest bucket chunk at _MAX_LANES so compiled shapes are reused
+_BUCKETS = (16, 64, 256, 1024)
+_MAX_LANES = _BUCKETS[-1]
+
+# per-lane scalar slots, fixed order
+_LANE_BASES = ("a_prime", "a_bar", "b_prime", "nym")
+
+
+def _fp():
+    return limbs.mod_ctx(bn.P)
+
+
+def _to_limbs(x: int) -> np.ndarray:
+    return limbs.int_to_limbs(x % bn.P, WIDE)
+
+
+def _recode(u: int) -> np.ndarray:
+    return np.asarray(
+        [(u >> (4 * (NWINDOWS - 1 - k))) & 15 for k in range(NWINDOWS)],
+        np.int32,
+    )
+
+
+@functools.lru_cache(maxsize=8)
+def shared_tables(ipk_key: tuple) -> dict:
+    """Affine 4-bit window tables (16 multiples) for the issuer key's
+    fixed bases, computed once on host ints.  ipk_key is the hashable
+    ((x, y), ...) tuple of (G1, h_sk, h_rand, *h_attrs)."""
+    tabs_x, tabs_y, tabs_inf = [], [], []
+    for pt in ipk_key:
+        xs, ys, infs = [], [], []
+        for k in range(TABLE):
+            q = bn.g1_mul(pt, k) if k else None
+            if q is None:
+                xs.append(_to_limbs(0))
+                ys.append(_to_limbs(0))
+                infs.append(True)
+            else:
+                xs.append(_to_limbs(q[0]))
+                ys.append(_to_limbs(q[1]))
+                infs.append(False)
+        tabs_x.append(np.stack(xs))
+        tabs_y.append(np.stack(ys))
+        tabs_inf.append(np.asarray(infs))
+    return {
+        "x": np.stack(tabs_x),  # (n_shared, 16, 17)
+        "y": np.stack(tabs_y),
+        "inf": np.stack(tabs_inf),  # (n_shared, 16)
+    }
+
+
+def _dbl_a0(fp, p: Jac) -> Jac:
+    """Jacobian doubling for a = 0 (BN254: y^2 = x^3 + 3), dbl-2009-l."""
+    a = fp.sqr(p.x)
+    b = fp.sqr(p.y)
+    c = fp.sqr(b)
+    d_inner = fp.sqr(fp.add(p.x, b))
+    d = fp.mul_const(fp.sub(fp.sub(d_inner, a), c), 2)
+    e = fp.mul_const(a, 3)
+    f = fp.sqr(e)
+    x3 = fp.sub(f, fp.add(d, d))
+    y3 = fp.sub(fp.mul(e, fp.sub(d, x3)), fp.mul_const(c, 8))
+    z3 = fp.mul_const(fp.mul(p.y, p.z), 2)
+    return Jac(x3, y3, z3, p.inf)
+
+
+def _lane_window_table(fp, px, py, pinf):
+    """Jacobian multiples 0..15 of per-lane affine points, a=0 chain."""
+    b = px.shape[:-1]
+    zero = jnp.zeros(b + (WIDE,), jnp.uint32)
+    inf_t = jnp.ones(b, bool)
+    p_aff = Aff(px, py, pinf)
+    p1 = Jac(px, py, ec._one_like(px), pinf)
+
+    def step(p: Jac, _):
+        nxt = ec.point_add_mixed(fp, p, p_aff, dbl=_dbl_a0)
+        return nxt, nxt
+
+    _, rest = jax.lax.scan(step, p1, None, length=TABLE - 2)
+    cat = lambda z, o, r: jnp.concatenate(  # noqa: E731
+        [z[..., None, :], o[..., None, :], jnp.moveaxis(r, 0, -2)], axis=-2
+    )
+    tinf = jnp.concatenate(
+        [inf_t[..., None], pinf[..., None], jnp.moveaxis(rest.inf, 0, -1)],
+        axis=-1,
+    )
+    return (
+        cat(zero, p1.x, rest.x),
+        cat(zero, p1.y, rest.y),
+        cat(zero, p1.z, rest.z),
+        tinf,
+    )
+
+
+def commitments_kernel(
+    lane_x, lane_y, lane_inf,      # (4, B, 17) / (4, B)  a',abar,b',nym
+    shared_x, shared_y, shared_inf,  # (n_shared, 16, 17) / (n_shared, 16)
+    digits,                        # (n_terms, B, 64) int32
+    term_table,                    # (n_terms,) int32: unified table index
+    term_acc,                      # (n_terms,) int32: accumulator 0..2
+):
+    """One joint 64-window MSB-first ladder accumulating T1, T2, T3.
+
+    Kept deliberately SMALL as a traced graph: the three accumulators
+    are one stacked (3, B) Jacobian (one vectorized doubling), all
+    window tables live in one (n_tables, B, 16) stack, and the per-term
+    adds run as an inner scan whose body is a single full Jacobian add
+    with dynamic table/accumulator indexing — field ops on this 254-bit
+    modulus cost several fold passes each, so graph size, not FLOPs,
+    bounds compile time."""
+    fp = _fp()
+    b = lane_x.shape[1]
+    n_shared = shared_x.shape[0]
+
+    # per-lane Jacobian tables, all 4 bases at once (batch dims (4, B))
+    ltx, lty, ltz, ltinf = _lane_window_table(fp, lane_x, lane_y, lane_inf)
+    # unified stack: shared tables broadcast over lanes, z = 1, then the
+    # 4 per-lane tables.  (n_tables, B, 16, 17) / (n_tables, B, 16)
+    ones = jnp.broadcast_to(
+        ec._one_like(shared_x)[:, None], (n_shared, b, TABLE, WIDE)
+    )
+    utx = jnp.concatenate(
+        [jnp.broadcast_to(shared_x[:, None], (n_shared, b, TABLE, WIDE)),
+         ltx], axis=0
+    )
+    uty = jnp.concatenate(
+        [jnp.broadcast_to(shared_y[:, None], (n_shared, b, TABLE, WIDE)),
+         lty], axis=0
+    )
+    utz = jnp.concatenate([ones, ltz], axis=0)
+    utinf = jnp.concatenate(
+        [jnp.broadcast_to(shared_inf[:, None], (n_shared, b, TABLE)),
+         ltinf], axis=0
+    )
+
+    zeros = jnp.zeros((3, b, WIDE), jnp.uint32)
+    acc0 = Jac(zeros, zeros, zeros, jnp.ones((3, b), bool))
+
+    def window(acc, w):
+        for _ in range(4):
+            acc = _dbl_a0(fp, acc)  # all 3 accumulators at once
+
+        def term(acc, t):
+            dig = jax.lax.dynamic_index_in_dim(
+                digits, t, axis=0, keepdims=False
+            )[:, w]  # (B,)
+            ti = term_table[t]
+            gx = jax.lax.dynamic_index_in_dim(utx, ti, 0, keepdims=False)
+            gy = jax.lax.dynamic_index_in_dim(uty, ti, 0, keepdims=False)
+            gz = jax.lax.dynamic_index_in_dim(utz, ti, 0, keepdims=False)
+            ginf = jax.lax.dynamic_index_in_dim(
+                utinf, ti, 0, keepdims=False
+            )
+            q = ec._gather_pt(gx, gy, gz, ginf, dig)
+            ai = term_acc[t]
+            cur = Jac(
+                jax.lax.dynamic_index_in_dim(acc.x, ai, 0, False),
+                jax.lax.dynamic_index_in_dim(acc.y, ai, 0, False),
+                jax.lax.dynamic_index_in_dim(acc.z, ai, 0, False),
+                jax.lax.dynamic_index_in_dim(acc.inf, ai, 0, False),
+            )
+            new = ec.point_add(fp, cur, q, dbl=_dbl_a0)
+            upd = lambda s, v: jax.lax.dynamic_update_index_in_dim(  # noqa: E731
+                s, v, ai, 0
+            )
+            return Jac(
+                upd(acc.x, new.x), upd(acc.y, new.y),
+                upd(acc.z, new.z), upd(acc.inf, new.inf),
+            ), None
+
+        acc, _ = jax.lax.scan(term, acc, jnp.arange(digits.shape[0]))
+        return acc, None
+
+    acc, _ = jax.lax.scan(window, acc0, jnp.arange(NWINDOWS))
+    return (
+        fp.canon(acc.x), fp.canon(acc.y), fp.canon(acc.z),
+        acc.inf.astype(jnp.uint32),
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _jit_kernel():
+    return jax.jit(commitments_kernel)
+
+
+def schnorr_commitments_batch(sigs, ipk) -> list | None:
+    """Device-batched T1/T2/T3 for every signature; returns per-sig
+    [(T1, T2, T3)] as affine int tuples (None = infinity), or None for
+    lanes whose inputs are malformed (caller marks them failed).
+
+    Mirrors signature._relations + schnorr.recompute_commitments; parity
+    is enforced by tests/test_bn254_device.py against the host path.
+    """
+    n = len(sigs)
+    if n == 0:
+        return []
+    if n > _MAX_LANES:
+        # chunk at the largest bucket: bounds pad waste to the tail and
+        # reuses the already-compiled shapes
+        out: list = []
+        for off in range(0, n, _MAX_LANES):
+            out.extend(
+                schnorr_commitments_batch(sigs[off:off + _MAX_LANES], ipk)
+            )
+        return out
+    n_attrs = len(ipk.h_attrs)
+    shared_pts = (bn.G1_GEN, ipk.h_sk, ipk.h_rand, *ipk.h_attrs)
+    n_shared = len(shared_pts)
+    tabs = shared_tables(tuple(shared_pts))
+    # unified term layout: (table index, accumulator).  Shared tables
+    # occupy indices 0..n_shared-1 of the kernel's table stack, the 4
+    # per-lane bases (_LANE_BASES order) follow at n_shared+0..3.
+    #   T1: h_rand^z_r2, a_bar^{-c}, b_prime^{c}, a_prime^{z_neg_e}
+    #   T2: G1^c, h_sk^z_sk, h_rand^z_s', h_attrs[i]^{s_i}, b'^{z_neg_r3}
+    #   T3: h_sk^z_sk, h_rand^z_r_nym, nym^{-c}
+    term_table = (
+        2, n_shared + 1, n_shared + 2, n_shared + 0,
+        0, 1, 2, *range(3, 3 + n_attrs), n_shared + 2,
+        1, 2, n_shared + 3,
+    )
+    term_acc = (0, 0, 0, 0, 1, 1, 1, *([1] * n_attrs), 1, 2, 2, 2)
+    n_terms = len(term_table)
+
+    lane_x = np.zeros((4, n, WIDE), np.uint32)
+    lane_y = np.zeros((4, n, WIDE), np.uint32)
+    lane_inf = np.zeros((4, n), bool)
+    digits = np.zeros((n_terms, n, NWINDOWS), np.int32)
+    ok = [True] * n
+
+    for j, sig in enumerate(sigs):
+        try:
+            pts = (sig.a_prime, sig.a_bar, sig.b_prime, sig.nym)
+            if any(p is None or not bn.g1_is_on_curve(p) for p in pts):
+                raise ValueError("bad point")
+            if len(sig.disclosure) != n_attrs:
+                raise ValueError("bad disclosure length")
+            for i, p in enumerate(pts):
+                lane_x[i, j] = _to_limbs(p[0])
+                lane_y[i, j] = _to_limbs(p[1])
+            c = sig.challenge % bn.R
+            z = sig.responses
+            hidden = [i for i, d in enumerate(sig.disclosure) if not d]
+            need = {"neg_e", "r2", "sk", "sprime", "neg_r3", "r_nym",
+                    *{f"m_{i}" for i in hidden}}
+            if not need <= set(z):
+                raise ValueError("missing responses")
+            s_attr = []
+            for i in range(n_attrs):
+                if sig.disclosure[i]:
+                    if i not in sig.disclosed_attrs:
+                        raise ValueError("missing disclosed attr")
+                    s_attr.append((c * sig.disclosed_attrs[i]) % bn.R)
+                else:
+                    s_attr.append(z[f"m_{i}"] % bn.R)
+            scalars = [
+                # T1
+                z["r2"] % bn.R,         # h_rand
+                (-c) % bn.R,            # a_bar
+                c,                      # b_prime
+                z["neg_e"] % bn.R,      # a_prime
+                # T2
+                c,                      # G1
+                z["sk"] % bn.R,         # h_sk
+                z["sprime"] % bn.R,     # h_rand
+                *s_attr,                # h_attrs
+                z["neg_r3"] % bn.R,     # b_prime
+                # T3
+                z["sk"] % bn.R,         # h_sk
+                z["r_nym"] % bn.R,      # h_rand
+                (-c) % bn.R,            # nym
+            ]
+            for t, u in enumerate(scalars):
+                digits[t, j] = _recode(u)
+        except (ValueError, IndexError, KeyError, TypeError,
+                OverflowError, AttributeError):
+            ok[j] = False  # zero scalars: lane computes but is ignored
+
+    # pad lanes to a bucket size so each (bucket, n_attrs) pair compiles
+    # once; padded lanes carry zero scalars (every digit selects the
+    # infinity table entry) and are sliced away below
+    bsz = _BUCKETS[0]
+    for b in _BUCKETS:
+        if n <= b:
+            bsz = b
+            break
+    if bsz != n:
+        pad = bsz - n
+        lane_x = np.concatenate(
+            [lane_x, np.zeros((4, pad, WIDE), np.uint32)], axis=1
+        )
+        lane_y = np.concatenate(
+            [lane_y, np.zeros((4, pad, WIDE), np.uint32)], axis=1
+        )
+        lane_inf = np.concatenate(
+            [lane_inf, np.ones((4, pad), bool)], axis=1
+        )
+        digits = np.concatenate(
+            [digits, np.zeros((n_terms, pad, NWINDOWS), np.int32)], axis=1
+        )
+    kern = _jit_kernel()
+    ax, ay, az, ainf = kern(
+        jnp.asarray(lane_x), jnp.asarray(lane_y), jnp.asarray(lane_inf),
+        jnp.asarray(tabs["x"]), jnp.asarray(tabs["y"]),
+        jnp.asarray(tabs["inf"]),
+        jnp.asarray(digits),
+        jnp.asarray(term_table, jnp.int32),
+        jnp.asarray(term_acc, jnp.int32),
+    )
+    ax, ay, az, ainf = (np.asarray(o) for o in (ax, ay, az, ainf))
+
+    # Jacobian -> affine with ONE batched modular inversion (host ints)
+    zs, metas = [], []
+    results: list = [None] * n
+    for j in range(n):
+        if not ok[j]:
+            continue
+        tri = []
+        for t in range(3):
+            x = limbs.limbs_to_int(ax[t, j]) % bn.P
+            y = limbs.limbs_to_int(ay[t, j]) % bn.P
+            zv = limbs.limbs_to_int(az[t, j]) % bn.P
+            inf = bool(ainf[t, j])
+            tri.append((x, y, zv, inf))
+        metas.append((j, tri))
+        for (_, _, zv, inf) in tri:
+            zs.append(1 if (inf or zv == 0) else zv)
+    if metas:
+        invs = _batch_inverse(zs, bn.P)
+        k = 0
+        for j, tri in metas:
+            pts = []
+            for (x, y, zv, inf) in tri:
+                if inf or zv == 0:
+                    pts.append(None)
+                else:
+                    zi = invs[k]
+                    zi2 = zi * zi % bn.P
+                    pts.append((x * zi2 % bn.P, y * zi2 * zi % bn.P))
+                k += 1
+            results[j] = tuple(pts)
+    return results
+
+
+def _batch_inverse(vals: list[int], m: int) -> list[int]:
+    """Montgomery's trick: one pow for the whole list."""
+    pre = [1] * (len(vals) + 1)
+    for i, v in enumerate(vals):
+        pre[i + 1] = pre[i] * v % m
+    inv = pow(pre[-1], -1, m)
+    out = [0] * len(vals)
+    for i in range(len(vals) - 1, -1, -1):
+        out[i] = inv * pre[i] % m
+        inv = inv * vals[i] % m
+    return out
+
+
+__all__ = ["schnorr_commitments_batch", "shared_tables"]

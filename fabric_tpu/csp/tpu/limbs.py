@@ -183,17 +183,29 @@ def mul_wide(a, b):
 
 
 class Mod:
-    """Precomputed constants for arithmetic mod m (m must be > 2**255 here:
-    the fold-table bounds in add/sub/mul assume a 256-bit modulus)."""
+    """Precomputed constants for arithmetic mod any 249..256-bit m.
+
+    Reduction depth adapts to the modulus: fold tables converge in one
+    pass for a 256-bit m (R[i] is tiny) and in a bound-computed chain of
+    passes for smaller moduli like BN254's 254-bit p/r (see _settle);
+    canon's conditional-subtract chain is likewise sized from m."""
 
     def __init__(self, m: int):
-        if not (1 << 255) < m < (1 << 256):
-            raise ValueError("Mod expects a 256-bit modulus")
+        # 249..256-bit moduli: P-256's p and n sit just under 2**256;
+        # BN254's p and r are 254-bit.  The lazy invariant (value <
+        # ~2**257) and the fold tables work for any modulus in this
+        # range; canon uses a binary cond-sub chain sized to the ratio
+        # 2**258 / m so smaller moduli still reduce fully.
+        if not (1 << 248) < m < (1 << 256):
+            raise ValueError("Mod expects a 249..256-bit modulus")
         self.m = m
         self.m_limbs = int_to_limbs(m, WIDE)
         # fold table: R[i] = 2**(256 + 16 i) mod m, canonical 16 limbs.
+        self._fold_ints = [
+            (1 << (256 + LIMB_BITS * i)) % m for i in range(18)
+        ]
         self.fold = np.stack(
-            [int_to_limbs((1 << (256 + LIMB_BITS * i)) % m, NLIMBS) for i in range(18)]
+            [int_to_limbs(r, NLIMBS) for r in self._fold_ints]
         )
         # relaxed subtraction constant C = c*m with C in [2**259, 2**259+m):
         # limbwise r dominates any invariant-bounded operand (top limb <= 7).
@@ -223,24 +235,41 @@ class Mod:
         acc = acc.at[..., 1 : NLIMBS + 1].add(phi.sum(axis=-2))
         return resolve(acc, out_width)
 
+    def _settle(self, v, bound: int):
+        """Fold until the (trace-time, Python-int) value bound drops
+        under the 2**257 invariant at width 17.  The number of passes is
+        modulus-dependent: a 256-bit m converges in one fold (R[i] is
+        tiny), while a 254-bit m like BN254's p has R[i] ~ m and sheds
+        only a few bits per pass — the bound arithmetic below sizes the
+        chain exactly, at trace time, so the jitted graph is static."""
+        while bound >= (1 << 257) or v.shape[-1] != WIDE:
+            nrows = v.shape[-1] - NLIMBS
+            newb = 1 << 256
+            for j in range(nrows):
+                hj = min(MASK, bound >> (256 + LIMB_BITS * j))
+                if hj:
+                    newb += hj * self._fold_ints[j]
+            out_w = WIDE if newb < (1 << 271) else NLIMBS + 2
+            v = self._fold_once(v, nrows, out_w)
+            bound = newb
+        return v
+
     def reduce_product(self, v):
         """34-limb product -> invariant element (< 2**257, 17 limbs)."""
-        v = self._fold_once(v, 18, 18)  # value < 2**277
-        v = self._fold_once(v, 2, WIDE)  # value < 2**262
-        return self._fold_once(v, 1, WIDE)  # value < 2**257
+        return self._settle(v, ((1 << 257) - 1) ** 2)
 
     def _minifold(self, v):
         """17-limb value with small top limb -> invariant element."""
-        return self._fold_once(v, 1, WIDE)
+        return self._settle(v, (1 << 272) - 1)
 
     # -- field ops (all preserve the invariant) ---------------------------
 
     def add(self, a, b):
-        return self._minifold(resolve(a + b, WIDE))
+        return self._settle(resolve(a + b, WIDE), 1 << 258)
 
     def sub(self, a, b):
         c = jnp.asarray(self.sub_c)
-        return self._minifold(resolve(a + (c - b), WIDE))
+        return self._settle(resolve(a + (c - b), WIDE), 1 << 261)
 
     def mul(self, a, b):
         return self.reduce_product(mul_wide(a, b))
@@ -253,19 +282,34 @@ class Mod:
         within the lazy invariant without an extra fold pass)."""
         assert 0 < k <= 256
         p = a * jnp.uint32(k)
-        # limbs < 2**32 exact; resolve to 18 then fold.
+        # limbs < 2**32 exact; resolve to 18 then settle.
         v = resolve(p, WIDE + 1)
-        return self._fold_once(v, 2, WIDE)
+        return self._settle(v, k << 257)
 
     # -- canonicalization --------------------------------------------------
 
     def canon(self, a):
-        """Invariant element -> canonical residue < m (17 limbs, top 0)."""
+        """Invariant element -> canonical residue < m (17 limbs, top 0).
+
+        Binary cond-sub chain [2**k m, ..., 2m, m]: the minifolded value
+        is < 2**258 (the invariant plus one fold's slack for sub-256-bit
+        moduli), and v < 2**(j+1) m before step j implies v < 2**j m
+        after it, so the chain ends below m."""
         v = self._minifold(a)
-        m_pad = jnp.asarray(self.m_limbs)
-        for _ in range(3):
-            v = _cond_sub(v, m_pad)
+        for mult in self._canon_chain():
+            v = _cond_sub(v, jnp.asarray(mult))
         return v
+
+    @functools.lru_cache(maxsize=1)
+    def _canon_chain(self):
+        # numpy (NOT jnp): jax constants minted here could leak out of
+        # whatever trace first invoked canon via the lru_cache
+        k = 0
+        while (self.m << (k + 1)) < (1 << 258):
+            k += 1
+        return tuple(
+            int_to_limbs(self.m << j, WIDE) for j in range(k, -1, -1)
+        )
 
     def is_zero(self, a):
         return jnp.all(self.canon(a) == 0, axis=-1)

@@ -49,14 +49,14 @@ def _bucket(n: int, buckets) -> int:
     return buckets[-1]
 
 
-def _chunk_plan(n: int) -> list[tuple[int, int]]:
+def _chunk_plan(n: int, max_chunk: int = _MAX_CHUNK) -> list[tuple[int, int]]:
     """(lanes, padded_bucket) per kernel execution.  Full chunks run at
-    _MAX_CHUNK; the tail pads to its own bucket instead of inflating the
+    max_chunk; the tail pads to its own bucket instead of inflating the
     whole batch to the next power of two."""
     out = []
     left = n
     while left > 0:
-        take = min(left, _MAX_CHUNK)
+        take = min(left, max_chunk)
         out.append((take, _bucket(take, _BATCH_BUCKETS)))
         left -= take
     return out
@@ -97,7 +97,7 @@ class _KeyTable:
         self._idx[key.ski()] = j
         self._ktabx[:, j] = self._words(key.x_bytes)
         self._ktaby[:, j] = self._words(key.y_bytes)
-        self._dev = None
+        self._dev = None  # invalidate every device's cached copy
         return j
 
     def assign(self, keys) -> np.ndarray | None:
@@ -123,16 +123,20 @@ class _KeyTable:
             self._dev = None
         return None
 
-    def device_tables(self):
-        """(ktabx, ktaby) as cached on-device jax arrays."""
-        if self._dev is None:
-            import jax
+    def device_tables(self, device=None):
+        """(ktabx, ktaby) as cached on-device jax arrays, one copy per
+        target device (multi-chip dispatch places chunks round-robin)."""
+        import jax
 
-            self._dev = (
-                jax.device_put(self._ktabx.copy()),
-                jax.device_put(self._ktaby.copy()),
+        if self._dev is None:
+            self._dev = {}
+        key = device
+        if key not in self._dev:
+            self._dev[key] = (
+                jax.device_put(self._ktabx.copy(), device),
+                jax.device_put(self._ktaby.copy(), device),
             )
-        return self._dev
+        return self._dev[key]
 
 
 class _FlushResult:
@@ -194,6 +198,7 @@ class TPUCSP(CSP):
         min_device_batch: int = 16,
         coalesce_lanes: int = 6144,
         host_fraction: float = 0.1,
+        max_chunk: int = _MAX_CHUNK,
     ):
         self._sw = sw or SWCSP()
         # Below this size, host verify wins on latency (device dispatch
@@ -219,6 +224,13 @@ class TPUCSP(CSP):
         self._pend_lanes = 0
         self._flushed: dict[int, object] = {}  # gen -> _FlushResult
         self._gen = 0
+        self._max_chunk = max_chunk
+        # -- multi-device sharding (SURVEY.md §2.9): chunks place
+        # round-robin across every visible device — verification is
+        # embarrassingly parallel, so data-parallel placement with no
+        # collectives is the idiomatic mesh layout, and each device
+        # crunches its chunk while the host marshals the next.
+        self.last_dispatch_devices: tuple = ()
 
     def _tune_host_fraction(self, t_host: float, t_dev_wait: float) -> None:
         if t_dev_wait > max(0.02, 0.25 * t_host):
@@ -333,6 +345,25 @@ class TPUCSP(CSP):
     def _dispatch(self, items) -> "_FlushResult":
         import jax
 
+        # local_devices: on a multi-host pod, jax.devices() includes
+        # devices other processes own; device_put to those raises
+        devices = jax.local_devices()
+        used: list = []
+
+        def place(i: int, bucket: int | None = None):
+            """Round-robin target for chunk i; None = default device.
+            Chunks whose padded bucket is not a whole number of kernel
+            blocks stay on the default device — verify_packed would pad
+            them with a host-side concatenate, pulling committed arrays
+            back off the device."""
+            if len(devices) <= 1:
+                return None
+            if bucket is not None and bucket % 256 != 0:
+                return None
+            dev = devices[i % len(devices)]
+            used.append(dev)
+            return dev
+
         if jax.default_backend() != "tpu":
             # The fused kernel is TPU-only (Mosaic); other backends get
             # the portable XLA kernel (interpreted Pallas would be
@@ -342,10 +373,16 @@ class TPUCSP(CSP):
             # collector so pipelined callers keep their overlap.
             from fabric_tpu.csp.tpu import ec
 
-            pending = [
-                (ec.verify_prepared(**ec.prepare_batch(chunk)), keep)
-                for chunk, keep in self._tuple_chunks(items)
-            ]
+            pending = []
+            for i, (chunk, keep) in enumerate(self._tuple_chunks(items)):
+                prep = ec.prepare_batch(chunk)
+                dev = place(i)
+                if dev is not None:
+                    prep = {
+                        k: jax.device_put(v, dev) for k, v in prep.items()
+                    }
+                pending.append((ec.verify_prepared(**prep), keep))
+            self.last_dispatch_devices = tuple(dict.fromkeys(used))
             return _FlushResult(pending, len(items))
 
         from fabric_tpu.csp.tpu import pallas_ec
@@ -382,21 +419,21 @@ class TPUCSP(CSP):
                     for it in items
                 ]
             )
-            if kidx is not None:
-                ktabx, ktaby = self._key_table.device_tables()
+            use_table = kidx is not None
+            if use_table:
                 packed_all = {
                     k: v
                     for k, v in packed_all.items()
                     if k not in ("qx", "qy")
                 }
                 packed_all["kidx"] = kidx
-                packed_all["ktabx"] = ktabx
-                packed_all["ktaby"] = ktaby
             else:
                 packed_all = pallas_ec.dedup_keys(packed_all)
             shared = ("ktabx", "ktaby")
             off = 0
-            for take, bsz in _chunk_plan(len(items)):
+            for i, (take, bsz) in enumerate(
+                _chunk_plan(len(items), self._max_chunk)
+            ):
                 sl = {}
                 for k, v in packed_all.items():
                     if k in shared:
@@ -418,22 +455,44 @@ class TPUCSP(CSP):
                         ))
                         for k, v in sl.items()
                     }
+                dev = place(i, bucket=bsz)
+                if dev is not None:
+                    # cand1_ok/valid stay host-side: verify_packed
+                    # np.asarray's them into its flags stack anyway
+                    host_side = ("cand1_ok", "valid")
+                    sl = {
+                        k: (
+                            v
+                            if k in shared or k in host_side
+                            else jax.device_put(v, dev)
+                        )
+                        for k, v in sl.items()
+                    }
+                if use_table:
+                    # persistent table: one resident copy per device
+                    sl["ktabx"], sl["ktaby"] = (
+                        self._key_table.device_tables(dev)
+                    )
                 pending.append((pallas_ec.verify_packed(sl), take))
         else:
-            for chunk, keep in self._tuple_chunks(items):
-                packed = pallas_ec.prepare_packed(chunk)
-                pending.append(
-                    (pallas_ec.verify_packed(pallas_ec.dedup_keys(packed)),
-                     keep)
+            for i, (chunk, keep) in enumerate(self._tuple_chunks(items)):
+                packed = pallas_ec.dedup_keys(
+                    pallas_ec.prepare_packed(chunk)
                 )
+                dev = place(i)
+                if dev is not None:
+                    packed = {
+                        k: jax.device_put(v, dev) for k, v in packed.items()
+                    }
+                pending.append((pallas_ec.verify_packed(packed), keep))
+        self.last_dispatch_devices = tuple(dict.fromkeys(used))
         return _FlushResult(
             pending, len(items) + len(host_items),
             host_items=host_items, sw=self._sw,
             tune=self._tune_host_fraction,
         )
 
-    @staticmethod
-    def _tuple_chunks(items):
+    def _tuple_chunks(self, items):
         """(padded tuple chunk, kept lanes) pairs for the non-native
         prep paths (Python-side DER parse)."""
         tuples = []
@@ -447,7 +506,7 @@ class TPUCSP(CSP):
                 r, s = -1, -1  # prepare marks the lane invalid
             tuples.append((key.x, key.y, it.digest, r, s))
         off = 0
-        for take, bsz in _chunk_plan(len(tuples)):
+        for take, bsz in _chunk_plan(len(tuples), self._max_chunk):
             chunk = tuples[off:off + take]
             off += take
             chunk = chunk + [

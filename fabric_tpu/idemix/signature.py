@@ -298,6 +298,13 @@ def verify_batch(
     ok = [
         _check_schnorr(s, ipk, m) for s, m in zip(sigs, msgs)
     ]
+    return _pairing_mask(sigs, ok, ipk, rng)
+
+
+def _pairing_mask(sigs, ok: list[bool], ipk, rng=None) -> list[bool]:
+    """Combined two-pairing check over the Schnorr-surviving items with
+    random weights; falls back to per-item pairings when the combined
+    check fails so the result stays a per-signature mask."""
     live = [i for i, v in enumerate(ok) if v]
     if not live:
         return ok
@@ -312,3 +319,47 @@ def verify_batch(
             [(sigs[i].a_prime, ipk.w), (bn.g1_neg(sigs[i].a_bar), bn.G2_GEN)]
         )
     return ok
+
+
+def verify_batch_device(
+    sigs: list[Signature],
+    ipk: IssuerPublicKey,
+    msgs: list[bytes],
+    rng=None,
+) -> list[bool]:
+    """verify_batch with the Schnorr commitment recomputation batched on
+    the device (csp/tpu/bn254_batch.py — one XLA program re-derives
+    every signature's T1/T2/T3 G1 MSMs); challenge re-hash and the
+    RLC-collapsed pairings stay on host.  Any device-path failure falls
+    back to the host implementation, so the result is always the host
+    oracle's mask."""
+    try:
+        from fabric_tpu.csp.tpu import bn254_batch
+
+        comms = bn254_batch.schnorr_commitments_batch(sigs, ipk)
+    except Exception as exc:
+        # loud fallback: otherwise a broken device path silently
+        # re-measures/re-runs the host implementation
+        from fabric_tpu.common.flogging import must_get_logger
+
+        must_get_logger("idemix").warning(
+            "device Schnorr path failed (%s: %s); falling back to host",
+            type(exc).__name__, exc,
+        )
+        return verify_batch(sigs, ipk, msgs, rng=rng)
+    ok: list[bool] = []
+    for sig, msg, tri in zip(sigs, msgs, comms):
+        if tri is None:
+            ok.append(False)
+            continue
+        try:
+            c = _challenge_bytes(
+                ipk, list(tri), sig.a_prime, sig.a_bar, sig.b_prime,
+                sig.nym, sig.disclosure, sig.disclosed_attrs, msg,
+                sig.nonce,
+            )
+            ok.append(c == sig.challenge)
+        except (ValueError, IndexError, KeyError, TypeError,
+                OverflowError, AttributeError):
+            ok.append(False)
+    return _pairing_mask(sigs, ok, ipk, rng)

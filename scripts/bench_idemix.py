@@ -7,9 +7,11 @@ verify_batch collapses all pairing checks for one issuer into TWO
 pairings per batch via random linear combination, leaving per-item
 Schnorr recomputation as the host cost.
 
-    python scripts/bench_idemix.py [--sigs 64]
+    python scripts/bench_idemix.py [--sigs 64] [--device]
 
-Prints one JSON line: sequential vs batched sigs/s.
+Prints one JSON line: sequential vs batched sigs/s (and, with
+--device, the TPU-batched Schnorr path — csp/tpu/bn254_batch.py — at
+the same batch size; one warm-up call pays the per-shape compile).
 """
 
 from __future__ import annotations
@@ -27,6 +29,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--sigs", type=int, default=64)
+    ap.add_argument("--device", action="store_true")
     args = ap.parse_args()
 
     from fabric_tpu.idemix import bn254 as bn
@@ -65,13 +68,25 @@ def main():
         best = min(best, time.perf_counter() - t0)
     assert all(ok)
 
-    print(json.dumps({
+    out = {
         "metric": "idemix_bn254_batch_verify",
         "sigs": args.sigs,
         "sequential_sigs_s": round(args.sigs / t_seq, 2),
         "batched_sigs_s": round(args.sigs / best, 2),
         "speedup": round(t_seq / best, 2),
-    }))
+    }
+    if args.device:
+        ok = signature.verify_batch_device(sigs, ik.ipk, msgs, rng)  # warm
+        assert all(ok)
+        dbest = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            ok = signature.verify_batch_device(sigs, ik.ipk, msgs, rng)
+            dbest = min(dbest, time.perf_counter() - t0)
+        assert all(ok)
+        out["device_batched_sigs_s"] = round(args.sigs / dbest, 2)
+        out["device_speedup_vs_host_batch"] = round(best / dbest, 2)
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

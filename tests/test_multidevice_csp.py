@@ -1,0 +1,66 @@
+"""Multi-device sharding in the TPU CSP provider.
+
+Conftest forces an 8-virtual-device CPU mesh, so these tests exercise
+the provider's production scaling axis (SURVEY.md §2.9): when more than
+one device is visible, verify chunks are placed round-robin across the
+mesh — verification is embarrassingly parallel, so data-parallel chunk
+placement (no collectives, no global barrier) is the TPU-idiomatic
+layout, and each chunk's host marshalling overlaps other chunks'
+device time.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import jax
+import pytest
+
+from fabric_tpu.csp import SWCSP
+from fabric_tpu.csp.api import VerifyBatchItem
+from fabric_tpu.csp.tpu.provider import TPUCSP
+
+
+@pytest.fixture(scope="module")
+def items():
+    sw = SWCSP()
+    keys = [sw.key_gen() for _ in range(4)]
+    out = []
+    for i in range(700):
+        d = hashlib.sha256(b"md-%d" % i).digest()
+        k = keys[i % 4]
+        out.append(VerifyBatchItem(k.public_key(), d, sw.sign(k, d)))
+    # one tampered lane
+    out[13] = VerifyBatchItem(
+        out[13].key, hashlib.sha256(b"other").digest(), out[13].signature
+    )
+    return out
+
+
+def test_mesh_is_visible():
+    assert len(jax.devices()) == 8  # conftest's virtual mesh
+
+
+def test_chunks_spread_across_devices(items):
+    # small chunks force a multi-chunk dispatch even at 700 lanes
+    csp = TPUCSP(min_device_batch=1, max_chunk=128, coalesce_lanes=1)
+    mask = csp.verify_batch(items)
+    assert mask[13] is False
+    assert all(v for i, v in enumerate(mask) if i != 13)
+    used = csp.last_dispatch_devices
+    assert len(used) >= 2, f"expected spread over devices, got {used}"
+
+
+def test_multidevice_matches_single_device(items):
+    multi = TPUCSP(min_device_batch=1, max_chunk=128, coalesce_lanes=1)
+    single = TPUCSP(min_device_batch=1)
+    assert multi.verify_batch(items) == single.verify_batch(items)
+
+
+def test_async_coalesced_multidevice(items):
+    csp = TPUCSP(min_device_batch=1, max_chunk=256)
+    c1 = csp.verify_batch_async(items[:400])
+    c2 = csp.verify_batch_async(items[400:])
+    m = c1() + c2()
+    assert m[13] is False and sum(m) == len(items) - 1
+    assert len(csp.last_dispatch_devices) >= 2
