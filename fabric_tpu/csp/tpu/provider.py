@@ -49,15 +49,20 @@ def _bucket(n: int, buckets) -> int:
     return buckets[-1]
 
 
-def _chunk_plan(n: int, max_chunk: int = _MAX_CHUNK) -> list[tuple[int, int]]:
+def _chunk_plan(
+    n: int, max_chunk: int = _MAX_CHUNK, min_bucket: int = 0
+) -> list[tuple[int, int]]:
     """(lanes, padded_bucket) per kernel execution.  Full chunks run at
     max_chunk; the tail pads to its own bucket instead of inflating the
-    whole batch to the next power of two."""
+    whole batch to the next power of two.  min_bucket floors the pad
+    size — the Pallas paths pass the kernel block (256) so every chunk
+    is a whole number of grid blocks and device placement never falls
+    back to a host-side pad."""
     out = []
     left = n
     while left > 0:
         take = min(left, max_chunk)
-        out.append((take, _bucket(take, _BATCH_BUCKETS)))
+        out.append((take, max(_bucket(take, _BATCH_BUCKETS), min_bucket)))
         left -= take
     return out
 
@@ -350,15 +355,12 @@ class TPUCSP(CSP):
         devices = jax.local_devices()
         used: list = []
 
-        def place(i: int, bucket: int | None = None):
+        def place(i: int):
             """Round-robin target for chunk i; None = default device.
-            Chunks whose padded bucket is not a whole number of kernel
-            blocks stay on the default device — verify_packed would pad
-            them with a host-side concatenate, pulling committed arrays
-            back off the device."""
+            Pallas chunks are always padded to whole kernel blocks
+            (min_bucket=256 in their _chunk_plan), so placement never
+            triggers a host-side pad in verify_packed."""
             if len(devices) <= 1:
-                return None
-            if bucket is not None and bucket % 256 != 0:
                 return None
             dev = devices[i % len(devices)]
             used.append(dev)
@@ -432,7 +434,7 @@ class TPUCSP(CSP):
             shared = ("ktabx", "ktaby")
             off = 0
             for i, (take, bsz) in enumerate(
-                _chunk_plan(len(items), self._max_chunk)
+                _chunk_plan(len(items), self._max_chunk, min_bucket=256)
             ):
                 sl = {}
                 for k, v in packed_all.items():
@@ -455,7 +457,7 @@ class TPUCSP(CSP):
                         ))
                         for k, v in sl.items()
                     }
-                dev = place(i, bucket=bsz)
+                dev = place(i)
                 if dev is not None:
                     # cand1_ok/valid stay host-side: verify_packed
                     # np.asarray's them into its flags stack anyway
@@ -475,7 +477,7 @@ class TPUCSP(CSP):
                     )
                 pending.append((pallas_ec.verify_packed(sl), take))
         else:
-            for i, (chunk, keep) in enumerate(self._tuple_chunks(items)):
+            for i, (chunk, keep) in enumerate(self._tuple_chunks(items, min_bucket=256)):
                 packed = pallas_ec.dedup_keys(
                     pallas_ec.prepare_packed(chunk)
                 )
@@ -492,7 +494,7 @@ class TPUCSP(CSP):
             tune=self._tune_host_fraction,
         )
 
-    def _tuple_chunks(self, items):
+    def _tuple_chunks(self, items, min_bucket: int = 0):
         """(padded tuple chunk, kept lanes) pairs for the non-native
         prep paths (Python-side DER parse)."""
         tuples = []
@@ -506,7 +508,7 @@ class TPUCSP(CSP):
                 r, s = -1, -1  # prepare marks the lane invalid
             tuples.append((key.x, key.y, it.digest, r, s))
         off = 0
-        for take, bsz in _chunk_plan(len(tuples), self._max_chunk):
+        for take, bsz in _chunk_plan(len(tuples), self._max_chunk, min_bucket):
             chunk = tuples[off:off + take]
             off += take
             chunk = chunk + [

@@ -70,6 +70,13 @@ class ChannelGossip:
         self._rng = rng or random.Random()
         self._nonce = 0
         self._pending_pulls: dict[int, str] = {}
+        # per-digest in-flight filter: digest -> tick stamp.  Concurrent
+        # pulls (several hellos per round, reference algo/pull.go) must
+        # not re-request a block another in-flight request already
+        # covers; entries expire after a couple of ticks so a dropped
+        # response never wedges a digest.
+        self._inflight: dict[int, int] = {}
+        self._tick_no = 0
         self._heights: dict[bytes, int] = {}  # peer pki -> advertised height
         self._height_eps: dict[bytes, str] = {}
         self._lock = threading.Lock()
@@ -86,6 +93,8 @@ class ChannelGossip:
     def add_block(self, seq: int, block_bytes: bytes, push: bool = True) -> None:
         """Called by the delivery pipeline when a block arrives (from the
         orderer or from a peer). Stores, hands to state layer, pushes."""
+        with self._lock:
+            self._inflight.pop(seq, None)  # pull satisfied
         if not self.store.add(seq, block_bytes):
             return
         self._on_block(seq, block_bytes)
@@ -110,19 +119,30 @@ class ChannelGossip:
             self._comm.send(ep, m)
 
     def tick(self) -> None:
-        """One pull round + state advertisement."""
-        targets = self._targets(1)
-        if targets:
+        """One pull round + state advertisement.  Pulls run CONCURRENTLY
+        against several random peers (reference algo/pull.go engages
+        defPullPeerNum=3 per round); the per-digest in-flight filter in
+        _handle keeps the responses disjoint."""
+        with self._lock:
+            self._tick_no += 1
+            # expire stale in-flight digests (response lost / peer died)
+            dead = [
+                d for d, t in self._inflight.items()
+                if t < self._tick_no - 2
+            ]
+            for d in dead:
+                del self._inflight[d]
+        for target in self._targets(min(3, self._fanout)):
             self._nonce += 1
             hello = gpb.GossipMessage(channel=self._chan_bytes)
             hello.hello.nonce = self._nonce
             hello.hello.msg_type = gpb.PULL_BLOCK_MSG
             with self._lock:
-                self._pending_pulls[self._nonce] = targets[0]
+                self._pending_pulls[self._nonce] = target
                 # bound pending table
                 while len(self._pending_pulls) > 32:
                     del self._pending_pulls[min(self._pending_pulls)]
-            self._comm.send(targets[0], hello)
+            self._comm.send(target, hello)
         self.advertise_state()
 
     # -- peers ahead of us (state transfer support) ------------------------
@@ -158,11 +178,16 @@ class ChannelGossip:
             if target is None:
                 return
             have = set(self.store.digests())
-            want = [
-                d
-                for d in msg.data_dig.digests
-                if int(d) not in have
-            ]
+            with self._lock:
+                # per-digest filter: skip blocks another concurrent
+                # pull already requested this round
+                want = []
+                for d in msg.data_dig.digests:
+                    seq = int(d)
+                    if seq in have or seq in self._inflight:
+                        continue
+                    self._inflight[seq] = self._tick_no
+                    want.append(d)
             if not want:
                 return
             req = gpb.GossipMessage(channel=self._chan_bytes)
