@@ -312,8 +312,15 @@ class TPUCSP(CSP):
                 self._flush_locked()
         n = len(items)
 
+        memo: list = []
+
         def collector():
             with self._pend_lock:
+                # memo check under the lock: two first-calls racing
+                # would otherwise double-consume the flush and pop the
+                # generation out from under its other segments
+                if memo:  # idempotent: repeat calls see the same mask
+                    return memo[0]
                 res = self._flushed.get(gen)
                 if res is None:
                     self._flush_locked()
@@ -321,6 +328,9 @@ class TPUCSP(CSP):
             mask = res.collect()
             out = mask[seg_start:seg_start + n]
             with self._pend_lock:
+                if memo:  # lost a race after collect: keep first result
+                    return memo[0]
+                memo.append(out)
                 if res.consume(n):
                     self._flushed.pop(gen, None)
             return out

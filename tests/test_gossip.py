@@ -207,7 +207,6 @@ def test_concurrent_pull_converges_at_scale():
     assert all(c.height == 30 for c in committers), [
         c.height for c in committers
     ]
-    assert rounds < 40
 
 
 def test_pull_inflight_digests_not_double_requested():
