@@ -203,4 +203,66 @@ def cert_expiration(pem: bytes) -> datetime.datetime:
     return min(c.not_valid_after_utc for c in certs)
 
 
-__all__ = ["CA", "CertKeyPair", "cert_expiration"]
+def expiration_warning(
+    pem: bytes, label: str, now: datetime.datetime | None = None,
+    warn_within: datetime.timedelta = datetime.timedelta(days=7),
+) -> str | None:
+    """Warning text when `pem`'s earliest cert expires within
+    `warn_within` (or has expired); None otherwise.  Reference
+    common/crypto/expiration.go TrackExpiration, wired at node start
+    (internal/peer/node/start.go:310) so operators hear about dying
+    enrollment/TLS certs a week ahead instead of at outage time."""
+    try:
+        exp = cert_expiration(pem)
+    except Exception:
+        return None
+    return _expiry_text(exp, label, now, warn_within)
+
+
+def _expiry_text(exp, label, now=None, warn_within=datetime.timedelta(days=7)):
+    now = now or datetime.datetime.now(datetime.timezone.utc)
+    if exp <= now:
+        return f"{label} certificate EXPIRED at {exp.isoformat()}"
+    if exp - now <= warn_within:
+        days = -((now - exp) // datetime.timedelta(days=1))  # ceil
+        return (
+            f"{label} certificate expires within "
+            f"{days} day(s), at {exp.isoformat()}"
+        )
+    return None
+
+
+def track_expiration(entries, warn) -> None:
+    """Run expiration_warning over [(label, pem)] pairs, calling
+    `warn(text)` for each finding — the node-start expiration sweep."""
+    for label, pem in entries:
+        if not pem:
+            continue
+        text = expiration_warning(pem, label)
+        if text:
+            warn(text)
+
+
+def warn_node_cert_expirations(signer, tls, signer_label: str, warn) -> None:
+    """The shared peer/orderer start-time sweep: week-ahead warnings for
+    the node's signing identity (via its already-parsed expiry) and its
+    TLS certificate (reference TrackExpiration at node start)."""
+    if signer is not None and hasattr(signer, "expires_at"):
+        try:
+            text = _expiry_text(signer.expires_at(), signer_label)
+        except Exception:
+            text = None
+        if text:
+            warn(text)
+    if tls is not None:
+        track_expiration([("server TLS", tls.cert_pem)], warn)
+
+
+__all__ = [
+    "CA",
+    "CertKeyPair",
+    "cert_expiration",
+    "expiration_warning",
+    "track_expiration",
+    "warn_node_cert_expirations",
+]

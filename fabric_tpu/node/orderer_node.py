@@ -76,7 +76,19 @@ class OrdererNode:
         return self.rpc.addr
 
     def start(self) -> None:
+        self._warn_expiring_certs()
         self.rpc.start()
+
+    def _warn_expiring_certs(self) -> None:
+        """Week-ahead warnings for the orderer's signing and TLS certs
+        (reference expiration.go TrackExpiration, orderer main.go)."""
+        from fabric_tpu.common.crypto import warn_node_cert_expirations
+        from fabric_tpu.common.flogging import must_get_logger
+
+        warn_node_cert_expirations(
+            self._signer, self.tls, "signing",
+            must_get_logger("orderer").warning,
+        )
 
     def stop(self) -> None:
         self.rpc.stop()

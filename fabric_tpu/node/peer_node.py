@@ -540,9 +540,22 @@ class PeerNode:
         return self.rpc.addr
 
     def start(self) -> None:
+        self._warn_expiring_certs()
         self.rpc.start()
         if self.operations is not None:
             self.operations.start()
+
+    def _warn_expiring_certs(self) -> None:
+        """Week-ahead warnings for the node's enrollment and TLS certs
+        (reference common/crypto/expiration.go TrackExpiration, wired at
+        internal/peer/node/start.go:310)."""
+        from fabric_tpu.common.crypto import warn_node_cert_expirations
+        from fabric_tpu.common.flogging import must_get_logger
+
+        warn_node_cert_expirations(
+            self.signer, self.tls, "enrollment",
+            must_get_logger("peer").warning,
+        )
 
     def stop(self) -> None:
         self.rpc.stop()

@@ -103,3 +103,73 @@ def test_peer_profile_config_knob_consumed():
                               "listenAddress": "127.0.0.1:0"}}}
     )
     assert cfg.get_bool("peer.profile.enabled", False)
+
+
+def test_cert_expiration_warnings():
+    """Week-ahead expiry warnings (reference expiration.go
+    TrackExpiration wired at peer/orderer start)."""
+    import datetime
+
+    from fabric_tpu.common.crypto import (
+        CA,
+        expiration_warning,
+        track_expiration,
+    )
+
+    ca = CA("expwarn-ca", "org")
+    soon = ca.issue(
+        "dying",
+        not_after=datetime.datetime.now(datetime.timezone.utc)
+        + datetime.timedelta(days=3),
+    )
+    fine = ca.issue("healthy", validity_days=365)
+    expired = ca.issue(
+        "dead",
+        not_after=datetime.datetime.now(datetime.timezone.utc)
+        - datetime.timedelta(days=1),
+    )
+    assert "expires within" in expiration_warning(soon.cert_pem, "x")
+    assert expiration_warning(fine.cert_pem, "x") is None
+    assert "EXPIRED" in expiration_warning(expired.cert_pem, "x")
+    got = []
+    track_expiration(
+        [("a", soon.cert_pem), ("b", fine.cert_pem), ("c", expired.cert_pem),
+         ("d", b"")],
+        got.append,
+    )
+    assert len(got) == 2 and "a" in got[0] and "c" in got[1]
+
+
+def test_node_start_warns_on_expiring_certs(tmp_path, capsys):
+    """A peer started with a nearly-expired TLS cert logs the warning."""
+    import datetime
+    import logging
+
+    from fabric_tpu.common.crypto import CA
+    from fabric_tpu.comm.tls import TLSCredentials
+    from fabric_tpu.csp import SWCSP
+    from fabric_tpu.node.peer_node import PeerNode
+
+    ca = CA("expwarn-tls", "org")
+    pair = ca.issue(
+        "peer0", sans=["localhost", "127.0.0.1"], client=True, server=True,
+        not_after=datetime.datetime.now(datetime.timezone.utc)
+        + datetime.timedelta(days=2),
+    )
+    creds = TLSCredentials(
+        cert_pem=pair.cert_pem, key_pem=pair.key_pem, ca_pems=[ca.cert_pem]
+    )
+    records = []
+
+    class Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record.getMessage())
+
+    logging.getLogger("fabric_tpu.peer").addHandler(h := Capture())
+    try:
+        node = PeerNode(None, SWCSP(), None, port=0, tls=creds)
+        node.start()
+        node.stop()
+    finally:
+        logging.getLogger("fabric_tpu.peer").removeHandler(h)
+    assert any("TLS certificate expires within" in m for m in records), records
