@@ -64,3 +64,47 @@ def test_sampleconfig_parses():
                           os.path.join(root, "sampleconfig", "orderer.yaml"))
     assert orderer.get_int("general.listenPort") == 7050
     assert orderer.get_duration("consensus.tickInterval") == 0.5
+
+
+def test_keepalive_options_from_config():
+    """peer.keepalive / general.keepalive blocks feed the RPC
+    connection-lifecycle knobs on both daemons."""
+    from fabric_tpu.comm.rpc import KeepaliveOptions
+    from fabric_tpu.common.config import Config
+
+    cfg = Config(
+        {
+            "peer": {"keepalive": {"idleTimeout": 11, "interval": 5,
+                                   "timeout": 7}},
+            "general": {"keepalive": {"idleTimeout": 42}},
+        }
+    )
+    ka = KeepaliveOptions.from_config(cfg)
+    assert (ka.idle_timeout, ka.ping_interval, ka.ping_timeout) == (11, 5, 7)
+    oka = KeepaliveOptions.from_config(cfg, prefix="general.keepalive")
+    assert oka.idle_timeout == 42
+    assert oka.ping_interval == KeepaliveOptions().ping_interval  # default
+    # absent block -> all defaults
+    dka = KeepaliveOptions.from_config(Config({}))
+    assert dka == KeepaliveOptions()
+
+
+def test_csp_from_config_selects_tpu_provider():
+    from fabric_tpu.common.config import Config
+    from fabric_tpu.csp import csp_from_config
+    from fabric_tpu.csp.tpu.provider import TPUCSP
+
+    csp = csp_from_config(
+        Config({"bccsp": {"default": "TPU",
+                          "tpu": {"minDeviceBatch": 7}}})
+    )
+    assert isinstance(csp, TPUCSP)
+    assert csp._min_device_batch == 7
+    # orderer-style nested prefix
+    csp2 = csp_from_config(
+        Config({"general": {"bccsp": {"default": "SW"}}}),
+        prefix="general.bccsp",
+    )
+    from fabric_tpu.csp import SWCSP
+
+    assert isinstance(csp2, SWCSP)

@@ -349,3 +349,57 @@ def test_consensus_migration_through_maintenance_mode(tmp_path):
         assert w.wait_height(5) == 5
     finally:
         w.registrar.halt_all()
+
+
+def test_maintenance_filter_unit_rules(tmp_path):
+    """Filter matrix at the unit level (the e2e migration test covers
+    the happy path): every NORMAL-state type change is rejected, both
+    maintenance transitions keep the type, removal of the Orderer group
+    is rejected."""
+    from fabric_tpu.orderer.msgprocessor import (
+        MsgProcessorError,
+        STATE_MAINTENANCE,
+        STATE_NORMAL,
+    )
+
+    w = _MigrationWorld(tmp_path)
+    try:
+        cs = w.registrar.get_chain(w.channel_id)
+        proc = cs.processor
+        from fabric_tpu.protos.common import configtx_pb2
+
+        def cfg_with(ctype=None, state=None, drop_orderer=False):
+            c = configtx_pb2.Config()
+            c.CopyFrom(w.current_config())
+            c.sequence += 1
+            if drop_orderer:
+                del c.channel_group.groups["Orderer"]
+            else:
+                w.set_consensus(c, ctype=ctype, state=state)
+            return c
+
+        # NORMAL -> type change: rejected
+        with pytest.raises(MsgProcessorError):
+            proc._maintenance_filter(cfg_with(ctype="kafka"))
+        # NORMAL -> enter maintenance, same type: allowed
+        proc._maintenance_filter(cfg_with(state=STATE_MAINTENANCE))
+        # Orderer group removal: rejected
+        with pytest.raises(MsgProcessorError):
+            proc._maintenance_filter(cfg_with(drop_orderer=True))
+        # while IN maintenance: type change allowed; exit+change rejected
+        import dataclasses
+
+        oc = cs.bundle.orderer_config
+        cs.bundle.orderer_config = dataclasses.replace(
+            oc, consensus_state=STATE_MAINTENANCE
+        )
+        proc._maintenance_filter(
+            cfg_with(ctype="kafka", state=STATE_MAINTENANCE)
+        )
+        with pytest.raises(MsgProcessorError):
+            proc._maintenance_filter(
+                cfg_with(ctype="kafka", state=STATE_NORMAL)
+            )
+        cs.bundle.orderer_config = oc
+    finally:
+        w.registrar.halt_all()
