@@ -44,6 +44,9 @@ class DevNode:
         self.csp = csp or csp_factory.get_default()
         self.bundle = bundle_from_genesis(genesis, self.csp)
         self.channel_id = self.bundle.channel_id
+        self._peer_signer = peer_signer
+        self._chaincodes = chaincodes or {}
+        self._definitions = definition_provider
 
         # peer side
         self.provider = LedgerProvider(root_dir)
@@ -72,7 +75,9 @@ class DevNode:
         self._orderer_store.add_block(genesis)
         self.writer = BlockWriter(self._orderer_store)
         cutter = BlockCutter.from_orderer_config(oc) if oc else BlockCutter()
-        self.processor = StandardChannelProcessor(self.channel_id, self.bundle, self.csp)
+        self.processor = StandardChannelProcessor(
+            self.channel_id, self.bundle, self.csp, signer=peer_signer
+        )
         timeout = batch_timeout_s if batch_timeout_s is not None else (
             oc.batch_timeout_s if oc else 2.0
         )
@@ -85,6 +90,44 @@ class DevNode:
     def _deliver_to_peer(self, blk: common_pb2.Block) -> None:
         copy = common_pb2.Block.FromString(blk.SerializeToString())
         self.committer.store_block(copy)
+        self._maybe_adopt_config(copy)
+
+    def _maybe_adopt_config(self, blk: common_pb2.Block) -> None:
+        """After a VALID config tx commits, swap in the new channel
+        resources on both halves of the dev node (the registrar does
+        this in multichannel._maybe_apply_config; without it, follow-up
+        config updates validate against stale config and a maintenance
+        migration can never reach its second step).  The dev node stays
+        on its solo chain regardless of a consensus-type value change —
+        it is a single-process tool; type changes only matter for the
+        maintenance-filter semantics."""
+        from fabric_tpu import protoutil
+
+        try:
+            env = protoutil.extract_envelope(blk, 0)
+            chdr = protoutil.channel_header(env)
+            if chdr.type != common_pb2.CONFIG:
+                return
+            if list(protoutil.tx_filter(blk))[:1] != [0]:
+                return  # invalid config tx: keep the old bundle
+            new_bundle = bundle_from_genesis(blk, self.csp)
+        except Exception:
+            return
+        self.bundle = new_bundle
+        self.processor.update_bundle(new_bundle)
+        self.validator = TxValidator(
+            self.channel_id, self.ledger, new_bundle, self.csp,
+            definition_provider=self._definitions,
+        )
+        self.committer = Committer(self.validator, self.ledger)
+        self.committer.add_commit_listener(
+            lambda b, flags: self._commit_events.put((b.header.number, flags))
+        )
+        if self.endorser is not None:
+            self.endorser = Endorser(
+                self.channel_id, self.ledger, new_bundle,
+                self._peer_signer, self._chaincodes, self.csp,
+            )
 
     # -- client surface ----------------------------------------------------
 
@@ -95,7 +138,11 @@ class DevNode:
             seq = self.processor.process_normal_msg(env)
             self.chain.order(env, seq)
         elif kind == Classification.CONFIG_UPDATE:
-            raise NotImplementedError("config updates land with the configtx engine")
+            # configtx engine + maintenance filter, same as the real
+            # orderer's broadcast path (msgprocessor
+            # process_config_update_msg)
+            new_env, seq = self.processor.process_config_update_msg(env)
+            self.chain.configure(new_env, seq)
         else:
             self.chain.configure(env, 0)
 

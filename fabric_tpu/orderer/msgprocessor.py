@@ -166,11 +166,15 @@ class StandardChannelProcessor:
             raise MsgProcessorError(str(exc)) from exc
         self._maintenance_filter(cfg_env.config)
         cfg_env.last_update.CopyFrom(env)
+        if self._signer is None:
+            # a creator-less CONFIG envelope would be committed with an
+            # invalid tx flag downstream — fail loudly at the source
+            raise MsgProcessorError(
+                "node has no signing identity to wrap CONFIG envelopes"
+            )
         import os
 
-        creator = (
-            self._signer.serialize() if self._signer is not None else b""
-        )
+        creator = self._signer.serialize()
         payload_bytes = protoutil.make_payload_bytes(
             protoutil.make_channel_header(
                 common_pb2.CONFIG, channel_id=self.channel_id

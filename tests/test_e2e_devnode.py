@@ -158,3 +158,66 @@ def test_tampered_creator_signature(net):
     node.chain.order(bad)
     _, flags = node.wait_commit()
     assert flags == [V.BAD_CREATOR_SIGNATURE]
+
+
+def test_devnode_broadcast_config_update(tmp_path):
+    """CONFIG_UPDATE through the dev node's broadcast surface runs the
+    configtx engine + maintenance filter, commits the config block, and
+    ADOPTS the new bundle — so the full two-step maintenance flow works:
+    enter maintenance, then change the consensus type (which the filter
+    only allows once the FIRST update is in force)."""
+    from test_orderer_services import _MigrationWorld
+
+    from fabric_tpu.node.devnode import DevNode
+    from fabric_tpu.orderer.msgprocessor import STATE_MAINTENANCE
+
+    w = _MigrationWorld(tmp_path)
+    w.registrar.halt_all()  # only the world's update builder is needed
+    signer = w.org1.signer("peer0", role_ou="peer")
+    dn = DevNode(w.genesis, csp=w.csp, peer_signer=signer, chaincodes={})
+    try:
+        w.current_config = lambda: dn.processor.bundle.config
+        env = w.update_env(
+            lambda c: w.set_consensus(c, state=STATE_MAINTENANCE)
+        )
+        dn.broadcast(env)
+        num, flags = dn.wait_commit(10)
+        assert flags == [0]
+        assert dn.processor.in_maintenance()  # new bundle in force
+        assert dn.processor.bundle.config.sequence == 1
+        # second step: the type change is legal only because the
+        # committed maintenance state was adopted
+        env2 = w.update_env(lambda c: w.set_consensus(c, ctype="kafka"))
+        dn.broadcast(env2)
+        num2, flags2 = dn.wait_commit(10)
+        assert flags2 == [0] and num2 == num + 1
+        assert dn.bundle.orderer_config.consensus_type == "kafka"
+    finally:
+        dn.shutdown()
+
+
+def test_devnode_config_update_without_signer_fails_loudly(tmp_path):
+    """A dev node without a signing identity must reject config updates
+    at broadcast time instead of committing an invalid config tx."""
+    import pytest
+
+    from test_orderer_services import _MigrationWorld
+
+    from fabric_tpu.node.devnode import DevNode
+    from fabric_tpu.orderer.msgprocessor import (
+        MsgProcessorError,
+        STATE_MAINTENANCE,
+    )
+
+    w = _MigrationWorld(tmp_path)
+    w.registrar.halt_all()
+    dn = DevNode(w.genesis, csp=w.csp, chaincodes={})
+    try:
+        w.current_config = lambda: dn.processor.bundle.config
+        env = w.update_env(
+            lambda c: w.set_consensus(c, state=STATE_MAINTENANCE)
+        )
+        with pytest.raises(MsgProcessorError, match="signing identity"):
+            dn.broadcast(env)
+    finally:
+        dn.shutdown()
