@@ -300,16 +300,21 @@ class Mod:
             v = _cond_sub(v, jnp.asarray(mult))
         return v
 
-    @functools.lru_cache(maxsize=1)
     def _canon_chain(self):
+        # per-instance memo (NOT lru_cache on the method: a 1-slot cache
+        # keyed by self thrashes when several Mod instances alternate —
+        # P-256 p/n, BN254 p/r — and pins the last instance alive).
         # numpy (NOT jnp): jax constants minted here could leak out of
-        # whatever trace first invoked canon via the lru_cache
-        k = 0
-        while (self.m << (k + 1)) < (1 << 258):
-            k += 1
-        return tuple(
-            int_to_limbs(self.m << j, WIDE) for j in range(k, -1, -1)
-        )
+        # whatever trace first invoked canon
+        chain = getattr(self, "_canon_chain_memo", None)
+        if chain is None:
+            k = 0
+            while (self.m << (k + 1)) < (1 << 258):
+                k += 1
+            chain = self._canon_chain_memo = tuple(
+                int_to_limbs(self.m << j, WIDE) for j in range(k, -1, -1)
+            )
+        return chain
 
     def is_zero(self, a):
         return jnp.all(self.canon(a) == 0, axis=-1)

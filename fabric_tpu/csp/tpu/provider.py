@@ -147,45 +147,145 @@ class _KeyTable:
 class _FlushResult:
     """One flushed (coalesced) device dispatch: lazy per-chunk
     collectors plus a consumption count so the provider can drop the
-    materialized mask once every enqueued segment has read its slice."""
+    materialized mask once every enqueued segment has read its slice.
+
+    A dedicated WAITER THREAD blocks on the device result the moment
+    the flush is dispatched (`start_background`).  This is load-bearing
+    on the tunneled runtime: a queued execution only runs to completion
+    while some host thread is parked in its wait — with a waiter
+    pinned there (GIL released), the device crunches flush k while the
+    main thread collects block k+2 and the committer thread persists
+    block k.  Without it, "async" dispatch quietly serializes against
+    the caller's next Python phase and the pipeline runs at
+    host-plus-device instead of max(host, device).  Materialization is
+    memoized once (`_seal`), so the waiter, any number of consuming
+    segments, and a deadline-triggered host race all land safely on the
+    one shared mask.
+
+    DEADLINE FALLBACK (p99 control): the shared chip is time-shared and
+    a flush occasionally takes many times its usual wall time.  A
+    consumer that passes `deadline` seconds waits that long for the
+    waiter, then starts verifying the flush's own items on the host in
+    mini-batches, polling for device completion in between — whichever
+    side finishes first supplies the mask, so a stalled chip costs at
+    most deadline + full-host-verify (~0.5 s for a 4096-lane flush)
+    instead of an unbounded chip wait.  Late device results are simply
+    discarded."""
+
+    _RACE_STEP = 192  # host mini-batch between device-completion polls
 
     def __init__(self, pending, total_lanes: int,
-                 host_items=(), sw: SWCSP | None = None, tune=None):
+                 host_items=(), sw: SWCSP | None = None,
+                 device_items=None, deadline: float | None = None):
         self._pending = pending  # [(collect, kept_lanes)]
         self._mask: list[bool] | None = None
+        self._exc: Exception | None = None
         self._outstanding = total_lanes
-        # tail slice verified on the HOST while the device crunches:
-        # the collecting thread would otherwise idle in np.asarray, so
-        # host verification there is free throughput — as long as the
-        # device is actually the slower side (see tune feedback)
+        # optional tail verified on the host inside the waiter (kept for
+        # explicit host_fraction configs; the degraded no-device path
+        # also rides this)
         self._host_items = host_items
         self._sw = sw
-        self._tune = tune
+        # per-lane items of the DEVICE portion, in lane order — the
+        # host-race fallback needs them to re-verify independently
+        self._device_items = device_items
+        self.deadline = deadline
+        self._seal_lock = threading.Lock()
+        self._wait_lock = threading.Lock()
+        self._done = threading.Event()
 
-    def collect(self) -> list[bool]:
-        if self._mask is None:
-            import time as _time
+    def start_background(self) -> None:
+        threading.Thread(
+            target=self._wait_device, name="tpu-flush-waiter", daemon=True
+        ).start()
 
-            t0 = _time.perf_counter()
-            host_mask = (
-                self._sw.verify_batch(self._host_items)
-                if self._host_items
-                else []
+    def _seal(self, mask: list | None, exc: Exception | None = None) -> None:
+        """First writer wins; every consumer wakes.  Drops the input
+        references (device collectors, item lists) either way — a flush
+        coalesces thousands of VerifyBatchItems and the late loser of a
+        host/device race must not pin them (nor device output buffers)
+        for the rest of the result's lifetime."""
+        with self._seal_lock:
+            if self._mask is None and self._exc is None:
+                self._mask = mask
+                self._exc = exc
+        self._pending = ()
+        self._host_items = ()
+        self._device_items = None
+        self._done.set()
+
+    def _wait_device(self) -> None:
+        """Materialize the device result (waiter thread or any direct
+        caller); idempotent.  Snapshots the input references up front —
+        a concurrently sealing host race clears them (see _seal)."""
+        with self._wait_lock:
+            if self._done.is_set():
+                return
+            pending, host_items = self._pending, self._host_items
+            device_items = self._device_items
+            try:
+                # host tail FIRST: it runs while the device crunches
+                # (that overlap is the whole point of host_fraction);
+                # the result order stays device-lanes-then-host-lanes
+                host_mask = (
+                    self._sw.verify_batch(host_items) if host_items else []
+                )
+                out: list[bool] = []
+                for collect, keep in pending:
+                    # pallas chunks hand back a lazy collector; the XLA
+                    # fallback hands back the device array itself
+                    mask = collect() if callable(collect) else np.asarray(collect)
+                    out.extend(bool(v) for v in mask[:keep])
+                out.extend(host_mask)
+            except Exception as e:
+                if device_items is not None and self._sw is not None:
+                    # device path died mid-flight: the host oracle can
+                    # still answer (same degradation _flush_locked
+                    # applies to dispatch-time failures)
+                    try:
+                        out = list(self._sw.verify_batch(device_items))
+                        out.extend(self._sw.verify_batch(host_items))
+                        self._seal(out)
+                        return
+                    except Exception as e2:
+                        e = e2
+                self._seal(None, e)
+                return
+            self._seal(out)
+
+    def _host_race(self) -> bool:
+        """Deadline expired: verify this flush's items on the host,
+        checking for (and yielding to) device completion between
+        mini-batches.  True when the host supplied the mask."""
+        device_items, host_items = self._device_items, self._host_items
+        if device_items is None:
+            return False  # sealed concurrently: use the device mask
+        items = list(device_items) + list(host_items)
+        out: list[bool] = []
+        for off in range(0, len(items), self._RACE_STEP):
+            if self._done.is_set():
+                return False  # device finished after all — use it
+            out.extend(
+                self._sw.verify_batch(items[off:off + self._RACE_STEP])
             )
-            t1 = _time.perf_counter()
-            out: list[bool] = []
-            for collect, keep in self._pending:
-                # pallas chunks hand back a lazy collector; the XLA
-                # fallback hands back the device array itself
-                mask = collect() if callable(collect) else np.asarray(collect)
-                out.extend(bool(v) for v in mask[:keep])
-            t2 = _time.perf_counter()
-            if self._tune is not None:
-                self._tune(t1 - t0, t2 - t1)
-            out.extend(host_mask)
-            self._mask = out
-            self._pending = ()
-            self._host_items = ()
+        self._seal(out)
+        return True
+
+    def collect(self, deadline: float | None = None) -> list[bool]:
+        if self._mask is None and self._exc is None:
+            deadline = self.deadline if deadline is None else deadline
+            if (
+                deadline is not None
+                and self._device_items is not None
+                and self._sw is not None
+                and not self._done.wait(deadline)
+            ):
+                self._host_race()
+            if not self._done.is_set():
+                self._wait_device()
+            self._done.wait()
+        if self._exc is not None:
+            raise self._exc
         return self._mask
 
     def consume(self, lanes: int) -> bool:
@@ -202,8 +302,10 @@ class TPUCSP(CSP):
         sw: SWCSP | None = None,
         min_device_batch: int = 16,
         coalesce_lanes: int = 6144,
-        host_fraction: float = 0.1,
+        host_fraction: float = 0.0,
         max_chunk: int = _MAX_CHUNK,
+        stall_factor: float | None = 1.0,
+        host_rate_hint: float = 9000.0,
     ):
         self._sw = sw or SWCSP()
         # Below this size, host verify wins on latency (device dispatch
@@ -218,12 +320,22 @@ class TPUCSP(CSP):
         # invoked (correctness).  Callers that pipeline blocks get ~2
         # blocks per execution for free.
         self._coalesce = max(1, coalesce_lanes)
-        # fraction of each flush verified host-side under the device
-        # wait — ADAPTIVE: grows while the device still makes the
-        # collector wait after the host tail is done (device-bound),
-        # shrinks toward zero when the device result arrives before the
-        # host finishes (host-bound / fast-chip regime)
+        # fraction of each flush verified host-side in the waiter thread.
+        # Default 0: flushes pad to power-of-two kernel buckets, so
+        # shaving a sub-bucket tail saves no device time at all, and the
+        # pipelined callers need the host core for collect/commit work.
+        # Chip-stall protection is the collector's deadline fallback,
+        # not a pre-committed split.
         self._host_fraction = host_fraction
+        # -- stall deadline (p99 control): a consumer that finds its
+        # flush unfinished `stall_factor * lanes / host_rate` seconds
+        # after asking starts racing the chip with host verification
+        # (see _FlushResult).  Anchored to HOST speed, not an EMA of
+        # chip speed, so a chronically time-share-starved chip window
+        # still gets beaten instead of normalized: per-flush wall is
+        # capped near 2x the pure-host cost in the worst window.
+        self._stall_factor = stall_factor
+        self._host_rate = host_rate_hint
         self._pend_lock = threading.RLock()
         self._pend_batches: list = []  # list[Sequence[VerifyBatchItem]]
         self._pend_lanes = 0
@@ -236,12 +348,6 @@ class TPUCSP(CSP):
         # collectives is the idiomatic mesh layout, and each device
         # crunches its chunk while the host marshals the next.
         self.last_dispatch_devices: tuple = ()
-
-    def _tune_host_fraction(self, t_host: float, t_dev_wait: float) -> None:
-        if t_dev_wait > max(0.02, 0.25 * t_host):
-            self._host_fraction = min(0.30, self._host_fraction + 0.02)
-        elif t_dev_wait < 0.005:
-            self._host_fraction = max(0.0, self._host_fraction - 0.03)
 
     # -- key management / signing: host side ------------------------------
 
@@ -348,14 +454,17 @@ class TPUCSP(CSP):
         gen = self._gen
         self._gen += 1
         try:
-            self._flushed[gen] = self._dispatch(items)
+            res = self._dispatch(items)
+            # park a waiter on the device result NOW — the tunneled
+            # runtime only drives a queued execution to completion
+            # while a host thread blocks in its wait (see _FlushResult)
+            res.start_background()
         except Exception:
             # a failed dispatch must not strand the other coalesced
             # batches' collectors (their items are already dequeued):
             # degrade the whole flush to the host oracle, lazily
-            self._flushed[gen] = _FlushResult(
-                [], len(items), host_items=items, sw=self._sw
-            )
+            res = _FlushResult([], len(items), host_items=items, sw=self._sw)
+        self._flushed[gen] = res
 
     def _dispatch(self, items) -> "_FlushResult":
         import jax
@@ -395,7 +504,9 @@ class TPUCSP(CSP):
                     }
                 pending.append((ec.verify_prepared(**prep), keep))
             self.last_dispatch_devices = tuple(dict.fromkeys(used))
-            return _FlushResult(pending, len(items))
+            return _FlushResult(
+                pending, len(items), sw=self._sw, device_items=list(items)
+            )
 
         from fabric_tpu.csp.tpu import pallas_ec
 
@@ -498,10 +609,15 @@ class TPUCSP(CSP):
                     }
                 pending.append((pallas_ec.verify_packed(packed), keep))
         self.last_dispatch_devices = tuple(dict.fromkeys(used))
+        deadline = None
+        if self._stall_factor is not None:
+            deadline = max(
+                0.2, self._stall_factor * len(items) / self._host_rate
+            )
         return _FlushResult(
             pending, len(items) + len(host_items),
             host_items=host_items, sw=self._sw,
-            tune=self._tune_host_fraction,
+            device_items=list(items), deadline=deadline,
         )
 
     def _tuple_chunks(self, items, min_bucket: int = 0):

@@ -97,28 +97,56 @@ class BlockStore:
     def _write_checkpoint(self, file_idx: int, offset: int) -> None:
         self._index.put(b"cp", struct.pack(">QQQ", file_idx, offset, self._height))
 
-    def _index_block(self, blk: common_pb2.Block, file_idx: int, offset: int) -> None:
+    @staticmethod
+    def _parse_txid(raw_env: bytes) -> str | None:
+        try:
+            env = common_pb2.Envelope.FromString(raw_env)
+            payload = common_pb2.Payload.FromString(env.payload)
+            chdr = common_pb2.ChannelHeader.FromString(
+                payload.header.channel_header
+            )
+            return chdr.tx_id or None
+        except Exception:
+            return None
+
+    def _index_block(
+        self,
+        blk: common_pb2.Block,
+        file_idx: int,
+        offset: int,
+        txids: list | None = None,
+        checkpoint: tuple[int, int] | None = None,
+    ) -> None:
+        """`txids` may carry the validator's per-position txids so the
+        healthy path parses no envelopes; positions it has no txid for
+        (early parse failures, config txs) fall back to a local parse —
+        index contents are identical either way.  `checkpoint` rides the
+        number/hash write batch so commit pays two index round-trips
+        (txid insert-if-absent + everything else), not four."""
+        num_b = struct.pack(">Q", blk.header.number)
         puts = {
-            b"n" + struct.pack(">Q", blk.header.number): struct.pack(">QQ", file_idx, offset),
-            b"h" + protoutil.block_header_hash(blk.header): struct.pack(">Q", blk.header.number),
+            b"n" + num_b: struct.pack(">QQ", file_idx, offset),
+            b"h" + protoutil.block_header_hash(blk.header): num_b,
         }
-        tx_keys: list[tuple[bytes, int]] = []
-        for pos, raw_env in enumerate(blk.data.data):
-            try:
-                env = common_pb2.Envelope.FromString(raw_env)
-                payload = common_pb2.Payload.FromString(env.payload)
-                chdr = common_pb2.ChannelHeader.FromString(payload.header.channel_header)
-                txid = chdr.tx_id
-            except Exception:
-                continue
+        if checkpoint is not None:
+            puts[b"cp"] = struct.pack(
+                ">QQQ", checkpoint[0], checkpoint[1], self._height
+            )
+        data = blk.data.data
+        if txids is None or len(txids) != len(data):
+            txids = [None] * len(data)
+        tx_puts: dict[bytes, bytes] = {}
+        loc = num_b  # block_num prefix shared by every tx loc value
+        for pos, txid in enumerate(txids):
+            if txid is None:
+                txid = self._parse_txid(data[pos])
             if txid:
-                tx_keys.append((b"t" + txid.encode(), pos))
-        # one bulk probe for already-indexed txids; first occurrence wins
-        # across blocks AND within this block
-        existing = self._index.get_many([k for k, _ in tx_keys])
-        for key, pos in tx_keys:
-            if key not in existing and key not in puts:
-                puts[key] = struct.pack(">QQ", blk.header.number, pos)
+                # dict insertion keeps the FIRST in-block occurrence;
+                # insert-if-absent keeps the first across blocks
+                tx_puts.setdefault(
+                    b"t" + txid.encode(), loc + struct.pack(">Q", pos)
+                )
+        self._index.write_batch_if_absent(tx_puts)
         self._index.write_batch(puts)
 
     # -- public API --------------------------------------------------------
@@ -134,18 +162,29 @@ class BlockStore:
     def info(self):
         return {"height": self._height, "currentBlockHash": self._last_hash}
 
-    def add_block(self, blk: common_pb2.Block) -> None:
+    def add_block(
+        self,
+        blk: common_pb2.Block,
+        txids: list | None = None,
+        env_bytes: list | None = None,
+    ) -> None:
+        """Append + index.  `txids`/`env_bytes` are optional commit-path
+        assists from the validator (see CommitAssist): known txids skip
+        the per-envelope parse in the index, and the envelope bytes let
+        serialization splice instead of re-encode."""
         with self._lock:
             if blk.header.number != self._height:
                 raise BlockStoreError(
                     f"block number {blk.header.number} != expected {self._height}"
                 )
-            raw = blk.SerializeToString()
+            raw = protoutil.serialize_block(blk, env_bytes)
             if self._mem_blocks is not None:
                 self._mem_blocks.append(raw)
-                self._index_block(blk, 0, len(self._mem_blocks) - 1)
                 self._height += 1
-                self._write_checkpoint(0, len(self._mem_blocks))
+                self._index_block(
+                    blk, 0, len(self._mem_blocks) - 1, txids,
+                    checkpoint=(0, len(self._mem_blocks)),
+                )
             else:
                 file_idx, offset, _ = self._checkpoint()
                 if offset > ROLL_SIZE:
@@ -159,9 +198,11 @@ class BlockStore:
                     f.write(raw)
                     f.flush()
                     os.fsync(f.fileno())
-                self._index_block(blk, file_idx, offset)
                 self._height += 1
-                self._write_checkpoint(file_idx, offset + _LEN.size + len(raw))
+                self._index_block(
+                    blk, file_idx, offset, txids,
+                    checkpoint=(file_idx, offset + _LEN.size + len(raw)),
+                )
             self._last_hash = protoutil.block_header_hash(blk.header)
 
     def get_block_by_number(self, num: int) -> common_pb2.Block | None:

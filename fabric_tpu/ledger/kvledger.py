@@ -28,6 +28,26 @@ from fabric_tpu.protos.ledger.rwset.kvrwset import kv_rwset_pb2
 from fabric_tpu import protoutil
 
 
+import dataclasses
+
+
+@dataclasses.dataclass
+class CommitAssist:
+    """Everything the validator already learned about a block that the
+    commit path would otherwise re-derive: per-tx marshaled rwsets (no
+    envelope re-walk), per-tx decoded RwsetFootprints (no rwset
+    re-unmarshal in MVCC/history), per-tx txids (no envelope parse in the
+    block-store index), and the materialized envelope byte list (the
+    store splice-serializes the block from these instead of re-encoding
+    the whole message).  The reference re-unmarshals at every one of
+    those stages (validator.go, validateAndPrepareBatch, blockindex.go)."""
+
+    rwsets: list  # per-tx marshaled TxReadWriteSet | None
+    footprints: list  # per-tx RwsetFootprint | None
+    txids: list  # per-tx txid str | None
+    env_bytes: list | None = None  # the block's envelope byte strings
+
+
 def extract_rwsets(block: common_pb2.Block) -> list[bytes | None]:
     """Per-tx marshaled TxReadWriteSet for endorser txs (None otherwise)."""
     out: list[bytes | None] = []
@@ -46,11 +66,23 @@ def extract_rwsets(block: common_pb2.Block) -> list[bytes | None]:
     return out
 
 
-def _history_writes(rwsets: list[bytes | None], flags: list[int]):
-    """Per-tx (ns, key) write lists for the history index (valid txs only)."""
+def _history_writes(
+    rwsets: list[bytes | None],
+    flags: list[int],
+    footprints: list | None = None,
+):
+    """Per-tx (ns, key) write lists for the history index (valid txs
+    only).  When the validator's decoded footprints ride along, the
+    public write keys are read straight off them — no re-unmarshal."""
     writes_per_tx: list[list[tuple[str, str]]] = [[] for _ in flags]
     for tx_num, raw in enumerate(rwsets):
         if flags[tx_num] != VALID or raw is None:
+            continue
+        fp = footprints[tx_num] if footprints is not None else None
+        if fp is not None:
+            out = writes_per_tx[tx_num]
+            for ns, kvrw, _colls in fp.parsed:
+                out.extend((ns, w.key) for w in kvrw.writes)
             continue
         try:
             txrw = rwset_pb2.TxReadWriteSet.FromString(raw)
@@ -126,6 +158,7 @@ class KVLedger:
         pvt_data: dict[int, bytes] | None = None,
         missing_pvt: list[tuple[int, str, str]] | None = None,
         rwsets: list[bytes | None] | None = None,
+        assist: CommitAssist | None = None,
     ) -> None:
         """MVCC-validate (updating the tx filter), persist block + private
         data, apply state + history.  Signature/policy flags must already
@@ -135,15 +168,25 @@ class KVLedger:
         collections for the reconciler.  `rwsets` may carry the per-tx
         marshaled TxReadWriteSets the validator already extracted
         (Committer.store_stream) — the commit then skips re-walking
-        every envelope."""
+        every envelope; a full `assist` additionally skips the rwset
+        re-unmarshal (MVCC + history read the decoded footprints), the
+        txid envelope parse in the block index, and the whole-block
+        re-serialization (splice from the envelope bytes)."""
         flags = list(protoutil.tx_filter(block))
+        footprints = txids = env_bytes = None
+        if assist is not None and len(assist.rwsets) == len(flags):
+            rwsets = assist.rwsets
+            footprints = assist.footprints
+            txids = assist.txids
+            env_bytes = assist.env_bytes
         if rwsets is None or len(rwsets) != len(flags):
             rwsets = extract_rwsets(block)
         batch = self._mvcc.validate_and_prepare(
-            block.header.number, rwsets, flags, pvt_data
+            block.header.number, rwsets, flags, pvt_data,
+            footprints=footprints,
         )
         protoutil.set_tx_filter(block, flags)
-        self._blocks.add_block(block)
+        self._blocks.add_block(block, txids=txids, env_bytes=env_bytes)
         # Pvt store before state so recovery-after-crash can replay the
         # cleartext writes (state savepoint is the recovery watermark).
         self.pvt_store.commit(
@@ -151,7 +194,7 @@ class KVLedger:
         )
         self._state.apply_updates(batch, Height(block.header.number, len(flags)))
         self._history.commit(
-            block.header.number, _history_writes(rwsets, flags)
+            block.header.number, _history_writes(rwsets, flags, footprints)
         )
 
     def commit_old_pvt_data(
@@ -218,6 +261,12 @@ class KVLedger:
     def tx_ids_exist(self, txids) -> set[str]:
         """Bulk duplicate-txid probe (one index round-trip)."""
         return self._blocks.tx_ids_exist(txids)
+
+    def may_have_state_metadata(self, ns: str) -> bool:
+        """False guarantees no key in `ns` (public or derived hashed
+        namespace) carries state metadata — the validator's key-level
+        endorsement fast path."""
+        return self._state.may_have_metadata(ns)
 
     def define_index(self, ns: str, field: str) -> None:
         """Create (and backfill) a rich-query index on a dotted JSON

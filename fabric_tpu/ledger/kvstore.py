@@ -36,6 +36,14 @@ class KVStore:
     def write_batch(self, puts: dict[bytes, bytes], deletes=()) -> None:
         raise NotImplementedError
 
+    def write_batch_if_absent(self, puts: dict[bytes, bytes]) -> None:
+        """Insert keys that do not exist yet; existing keys keep their
+        value (leveldb has no native merge operator either — the
+        reference reads before writing for first-wins indexes; backends
+        here do it in one INSERT OR IGNORE round-trip)."""
+        existing = self.get_many(list(puts))
+        self.write_batch({k: v for k, v in puts.items() if k not in existing})
+
     def put(self, key: bytes, value: bytes) -> None:
         self.write_batch({key: value})
 
@@ -133,6 +141,16 @@ class SqliteKVStore(KVStore):
                     "DELETE FROM kv WHERE k = ?", [(k,) for k in deletes]
                 )
 
+    def write_batch_if_absent(self, puts) -> None:
+        # first occurrence wins WITHIN the batch too: sqlite executes
+        # the rows in order and ignores every later conflicting insert
+        with self._lock:
+            with self._conn:
+                self._conn.executemany(
+                    "INSERT OR IGNORE INTO kv(k, v) VALUES(?, ?)",
+                    list(puts.items()),
+                )
+
     def iterate(self, start: bytes = b"", end: bytes | None = None):
         with self._lock:
             if end is None:
@@ -174,6 +192,11 @@ class NamedDB(KVStore):
     def write_batch(self, puts, deletes=()) -> None:
         self._base.write_batch(
             {self._k(k): v for k, v in puts.items()}, [self._k(k) for k in deletes]
+        )
+
+    def write_batch_if_absent(self, puts) -> None:
+        self._base.write_batch_if_absent(
+            {self._k(k): v for k, v in puts.items()}
         )
 
     def iterate(self, start: bytes = b"", end: bytes | None = None):

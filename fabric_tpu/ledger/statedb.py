@@ -198,6 +198,16 @@ class VersionedDB:
         return self._meta_ns
 
     def may_have_metadata(self, ns: str) -> bool:
+        """False guarantees no key under `ns` carries metadata.
+
+        The set is cached for read speed and re-loaded from the store at
+        every apply_updates (see there), so metadata written through a
+        DIFFERENT VersionedDB over the same backing store (offline
+        repair tooling) becomes visible at the next commit boundary.
+        Between commits the answer may lag by at most one block — the
+        same adjacency relaxation the pipelined validator documents.
+        Hot callers (the per-tx key-level endorsement fast path) should
+        memoize per block, as TxValidator does."""
         m = self._load_meta_ns()
         return True if m is True else ns in m
 
@@ -322,6 +332,10 @@ class VersionedDB:
         if height is not None:
             puts[_SAVEPOINT_KEY] = height.pack()
         self._db.write_batch(puts, deletes)
+        # drop the metadata-namespace cache so the next reader re-loads
+        # it from the store: one cheap get per commit buys visibility of
+        # out-of-band writers (a second VersionedDB over this store)
+        self._meta_ns = None
 
     def savepoint(self) -> Height | None:
         raw = self._db.get(_SAVEPOINT_KEY)

@@ -390,6 +390,7 @@ class MVCCValidator:
         rwsets: list[bytes | None],
         flags: list[int],
         pvt_data: dict[int, bytes] | None = None,
+        footprints: list | None = None,
     ) -> dict:
         """rwsets[i]: marshaled TxReadWriteSet of tx i (None = not an
         endorser tx or already invalid).  Mutates `flags` with MVCC codes;
@@ -401,6 +402,13 @@ class MVCCValidator:
         coordinator verifies hashes before commit,
         gossip/privdata/coordinator.go).
 
+        footprints[i], when given, is the validator's RwsetFootprint for
+        tx i: its `.parsed` [(ns, KVRWSet, [(coll, HashedRWSet, hash)])]
+        is exactly this method's decode, so the rwset wire format is
+        walked once per tx per lifecycle instead of once per stage (the
+        reference re-unmarshals in validateAndPrepareBatch,
+        validation/validator.go:82).
+
         Matches the reference's serial-in-commit-order semantics: a tx sees
         conflicts against committed state AND the writes of earlier valid
         txs in the same block."""
@@ -410,28 +418,32 @@ class MVCCValidator:
         for tx_num, raw in enumerate(rwsets):
             if flags[tx_num] != VALID or raw is None:
                 continue
-            try:
-                txrw = rwset_pb2.TxReadWriteSet.FromString(raw)
-                parsed = [
-                    (
-                        nsrw.namespace,
-                        kv_rwset_pb2.KVRWSet.FromString(nsrw.rwset),
-                        [
-                            (
-                                ch.collection_name,
-                                kv_rwset_pb2.HashedRWSet.FromString(
-                                    ch.hashed_rwset
-                                ),
-                                bytes(ch.pvt_rwset_hash),
-                            )
-                            for ch in nsrw.collection_hashed_rwset
-                        ],
-                    )
-                    for nsrw in txrw.ns_rwset
-                ]
-            except Exception:
-                flags[tx_num] = BAD_RWSET
-                continue
+            fp = footprints[tx_num] if footprints is not None else None
+            if fp is not None:
+                parsed = fp.parsed
+            else:
+                try:
+                    txrw = rwset_pb2.TxReadWriteSet.FromString(raw)
+                    parsed = [
+                        (
+                            nsrw.namespace,
+                            kv_rwset_pb2.KVRWSet.FromString(nsrw.rwset),
+                            [
+                                (
+                                    ch.collection_name,
+                                    kv_rwset_pb2.HashedRWSet.FromString(
+                                        ch.hashed_rwset
+                                    ),
+                                    bytes(ch.pvt_rwset_hash),
+                                )
+                                for ch in nsrw.collection_hashed_rwset
+                            ],
+                        )
+                        for nsrw in txrw.ns_rwset
+                    ]
+                except Exception:
+                    flags[tx_num] = BAD_RWSET
+                    continue
             code = VALID
             for ns, kvrw, colls in parsed:
                 for read in kvrw.reads:

@@ -85,10 +85,10 @@ class Committer:
                     return
                 if failed:
                     continue  # drain without committing past a failure
-                blk, release_txids, rwsets = item
+                blk, release_txids, assist = item
                 try:
                     with self._lock:
-                        self._ledger.commit(blk, rwsets=rwsets)
+                        self._ledger.commit(blk, assist=assist)
                     # the ledger index now holds these txids: safe to
                     # close the validator's in-flight dedup window
                     release_txids()

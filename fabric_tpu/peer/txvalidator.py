@@ -92,6 +92,13 @@ class _TxWork:
     rwset: bytes | None = None
     # marshaled TxReadWriteSet, handed to the committer so the ledger
     # commit skips re-walking every envelope (kvledger extract_rwsets)
+    footprint: object | None = None
+    # the ONE RwsetFootprint parse of this tx's rwset — carries the
+    # decoded KVRWSets down the commit path (MVCC + history) so nothing
+    # downstream re-unmarshals the rwset wire format
+    txid: str | None = None
+    # chdr.tx_id when the envelope parsed far enough to yield one; the
+    # block store indexes from these instead of re-parsing every envelope
     meta_keys: frozenset = frozenset()
     # keys whose VALIDATION_PARAMETER this tx rewrites; once the tx is
     # VALID, later in-block txs touching them are invalidated
@@ -135,6 +142,18 @@ class TxValidator:
         self._csp = csp
         self._definitions = definition_provider
         self._faithful = faithful
+        # committed-state metadata oracle (None on ledgers without one):
+        # lets the builtin plugin skip per-key VALIDATION_PARAMETER
+        # lookups for namespaces that have never stored metadata.
+        # Memoized per block (_start_block) — statedb re-loads its
+        # namespace set at every commit, so a fresh memo per block sees
+        # commits land while staying O(1) per tx.
+        self._ns_meta = (
+            None
+            if faithful
+            else getattr(ledger, "may_have_state_metadata", None)
+        )
+        self._ns_meta_block = None  # per-block memoized wrapper
         self._registry = plugin_registry or PluginRegistry(plans=not faithful)
         self._policy_provider = PolicyProvider(
             bundle.policy_manager, bundle.msp_manager, definition_provider
@@ -179,6 +198,7 @@ class TxValidator:
             shdr = common_pb2.SignatureHeader.FromString(payload.header.signature_header)
         except Exception:
             return V.BAD_PAYLOAD
+        work.txid = chdr.tx_id or None  # for the block store's txid index
         if not shdr.creator or not shdr.nonce:
             return V.BAD_COMMON_HEADER
         if chdr.channel_id != self.channel_id:
@@ -273,7 +293,8 @@ class TxValidator:
     # -- the three-phase validate -----------------------------------------
 
     def validate(self, block: common_pb2.Block) -> list[int]:
-        return self._finish_block(*self._start_block(block, set()))
+        block, flags, works, collect, _envs = self._start_block(block, set())
+        return self._finish_block(block, flags, works, collect)
 
     def validate_pipeline(self, blocks, depth: int = 2, release=None,
                           rwsets_out=None):
@@ -304,12 +325,23 @@ class TxValidator:
         seen_txids: set[str] = set()
 
         def finish(started):
-            flags = self._finish_block(*started[:-1])
+            block, flags, works, collect, envs, txids = started
+            flags = self._finish_block(block, flags, works, collect)
             if rwsets_out is not None:
-                # per-tx marshaled TxReadWriteSets, so the committer's
-                # ledger.commit skips re-walking every envelope
-                rwsets_out([w.rwset for w in started[2]])
-            txids = started[-1]
+                # ONE per-block assist bundle: the marshaled rwsets, the
+                # already-decoded footprints (MVCC + history reuse), the
+                # txids (block-store index), and the envelope bytes (the
+                # store splice-serializes instead of re-encoding 1-2 MB)
+                from fabric_tpu.ledger.kvledger import CommitAssist
+
+                rwsets_out(
+                    CommitAssist(
+                        rwsets=[w.rwset for w in works],
+                        footprints=[w.footprint for w in works],
+                        txids=[w.txid for w in works],
+                        env_bytes=envs,
+                    )
+                )
             if release is None:
                 seen_txids.difference_update(txids)  # close the window
             else:
@@ -327,20 +359,35 @@ class TxValidator:
 
     def _start_block(self, block: common_pb2.Block, seen_txids: set):
         """Phases 1+2: collect every tx, dispatch the device verify."""
-        n = len(block.data.data)
+        envs = list(block.data.data)  # ONE materialization of the
+        # envelope byte strings (each repeated-field access copies)
+        n = len(envs)
         flags = [V.NOT_VALIDATED] * n
         works = [_TxWork() for _ in range(n)]
         sink = _ItemSink(dedup=not self._faithful)
 
         memo: dict = {}  # per-block creator-identity memo
         self._policy_provider.begin_block()
+        raw_meta = self._ns_meta
+        if raw_meta is not None:
+            meta_memo: dict = {}
+
+            def ns_meta(ns, _memo=meta_memo, _raw=raw_meta):
+                v = _memo.get(ns)
+                if v is None:
+                    v = _memo[ns] = _raw(ns)
+                return v
+
+            self._ns_meta_block = ns_meta
+        else:
+            self._ns_meta_block = None
         native = self._collect_native(
-            block, seen_txids, sink, works, flags, memo
+            envs, seen_txids, sink, works, flags, memo
         )
         if not native:
             for i in range(n):
                 flags[i] = self._collect_tx(
-                    block.data.data[i], seen_txids, sink, works[i], memo
+                    envs[i], seen_txids, sink, works[i], memo
                 )
 
         collect = (
@@ -348,7 +395,7 @@ class TxValidator:
             if sink.items
             else (lambda: [])
         )
-        return block, flags, works, collect
+        return block, flags, works, collect, envs
 
     # C++ status codes (collect.cc) -> TxValidationCode, for the stages
     # BEFORE creator validation (parse/header failures).
@@ -371,19 +418,19 @@ class TxValidator:
         -13: V.NIL_TXACTION,
     }
 
-    def _collect_native(self, block, seen_txids, sink: _ItemSink, works, flags, memo: dict) -> bool:
+    def _collect_native(self, data, seen_txids, sink: _ItemSink, works, flags, memo: dict) -> bool:
         """Native-assisted collect: one C++ pass walks every envelope's
         wire format (syntactic checks + SHA-256 digests, collect.cc),
-        then this glue does only identity/policy work per tx.  Returns
-        False when the native library is unavailable (caller runs the
-        pure-Python path); individual txs the C++ pass cannot decide
-        (status -12) fall back to Python per tx."""
+        then this glue does only identity/policy work per tx.  `data` is
+        the block's materialized envelope byte list.  Returns False when
+        the native library is unavailable (caller runs the pure-Python
+        path); individual txs the C++ pass cannot decide (status -12)
+        fall back to Python per tx."""
         from fabric_tpu import native
         from fabric_tpu.csp.api import VerifyBatchItem
 
         if not native.available():
             return False
-        data = block.data.data
         offs = [0]
         for d in data:
             offs.append(offs[-1] + len(d))
@@ -477,6 +524,7 @@ class TxValidator:
             # dup-txid stage: the txid registers even when a LATER check
             # fails (the reference adds to the dedup set right here too)
             txid = sl(txid_off_l[i], txid_len_l[i]).decode()
+            w.txid = txid
             if txid in seen_txids or txid_known(txid):
                 flags[i] = V.DUPLICATE_TXID
                 continue
@@ -532,6 +580,7 @@ class TxValidator:
                 policy_provider=self._policy_provider,
                 state_metadata=self._committed_metadata,
                 footprint=footprint,
+                ns_has_metadata=self._ns_meta_block,
             )
             try:
                 pending = self._plugin_for(ns).prepare(ctx)
@@ -540,6 +589,7 @@ class TxValidator:
             w.pendings.append((pending, sink.add_many(pending.items)))
         w.touched_keys = footprint.touched
         w.rwset = rwset_bytes
+        w.footprint = footprint
         w.meta_keys = frozenset(footprint.meta_writes)
         return V.VALID
 

@@ -60,12 +60,20 @@ class RwsetFootprint:
     #                      "coll": [(coll, hashns, hkey)],
     #                      "coll_meta": [(coll, hashns, hkey)],
     #                      "writes": bool}
+    parsed: list = dataclasses.field(default_factory=list)
+    # the SAME decode the MVCC validator and history index need later:
+    # [(ns, KVRWSet, [(coll, HashedRWSet, pvt_rwset_hash)])] — handed down
+    # the commit path so each tx's rwset wire format is walked exactly
+    # once per lifecycle (the reference re-unmarshals it in the
+    # dispatcher, in validateAndPrepareBatch AND in the history db,
+    # rwsetutil/rwset_proto_util.go callers)
 
 
 def parse_footprint(rwset_bytes: bytes | None) -> RwsetFootprint:
     touched: set[tuple[str, str]] = set()
     meta: dict[tuple[str, str], dict[str, bytes]] = {}
     per_ns: dict[str, dict] = {}
+    parsed: list = []
     if rwset_bytes:
         txrw = rwset_pb2.TxReadWriteSet.FromString(rwset_bytes)
         for nsrw in txrw.ns_rwset:
@@ -79,6 +87,8 @@ def parse_footprint(rwset_bytes: bytes | None) -> RwsetFootprint:
             }
             seen_colls: set[str] = set()
             kvrw = kv_rwset_pb2.KVRWSet.FromString(nsrw.rwset)
+            colls: list = []
+            parsed.append((nsrw.namespace, kvrw, colls))
             for w in kvrw.writes:
                 touched.add((nsrw.namespace, w.key))
                 entry["pub"].append(w.key)
@@ -99,6 +109,9 @@ def parse_footprint(rwset_bytes: bytes | None) -> RwsetFootprint:
                 seen_colls.add(ch.collection_name)
                 hns = hash_ns(nsrw.namespace, ch.collection_name)
                 hrw = kv_rwset_pb2.HashedRWSet.FromString(ch.hashed_rwset)
+                colls.append(
+                    (ch.collection_name, hrw, bytes(ch.pvt_rwset_hash))
+                )
                 for hw in hrw.hashed_writes:
                     hkey = bytes(hw.key_hash).hex()
                     touched.add((hns, hkey))
@@ -114,7 +127,7 @@ def parse_footprint(rwset_bytes: bytes | None) -> RwsetFootprint:
                     meta[(hns, hkey)] = {
                         e.name: bytes(e.value) for e in mw.entries
                     }
-    return RwsetFootprint(frozenset(touched), meta, per_ns)
+    return RwsetFootprint(frozenset(touched), meta, per_ns, parsed)
 
 
 @dataclasses.dataclass
@@ -130,6 +143,12 @@ class ValidationContext:
     state_metadata: Callable[[str, str], dict[str, bytes]]
     # (ns_or_hashns, key) -> committed metadata entries
     footprint: RwsetFootprint | None = None
+    ns_has_metadata: Callable[[str], bool] | None = None
+    # committed-state oracle: False guarantees NO key in the namespace
+    # carries metadata, letting the plugin skip the per-written-key
+    # VALIDATION_PARAMETER lookups wholesale (the reference pays a
+    # GetStateMetadata fetch per written key per tx,
+    # statebased/vpmanagerimpl.go:293); None = unknown, look keys up
 
 
 class PendingValidation:
@@ -427,10 +446,31 @@ class BuiltinV20Plugin:
                     ctx.namespace
                 )
 
-        for coll, ns, key in (
-            [("", ctx.namespace, k) for k in sorted(pub_keys)]
-            + sorted(coll_keys)
-        ):
+        # Namespaces whose committed state holds no metadata at all can
+        # skip the per-key lookups: every key falls back, and the
+        # fallback resolution is memoized, so the whole loop collapses
+        # to one resolve per (namespace, collection).
+        has_meta = ctx.ns_has_metadata
+        check: list[tuple[str, str, str]] = []
+        if pub_keys:
+            if has_meta is not None and not has_meta(ctx.namespace):
+                resolve_fallback("")
+            else:
+                check.extend(
+                    ("", ctx.namespace, k) for k in sorted(pub_keys)
+                )
+        if coll_keys:
+            skip_ns: dict[str, bool] = {}
+            for coll, ns, key in sorted(coll_keys):
+                sk = skip_ns.get(ns)
+                if sk is None:
+                    sk = has_meta is not None and not has_meta(ns)
+                    skip_ns[ns] = sk
+                if sk:
+                    resolve_fallback(coll)
+                else:
+                    check.append((coll, ns, key))
+        for coll, ns, key in check:
             raw = ctx.state_metadata(ns, key).get(VALIDATION_PARAMETER)
             if not raw:
                 resolve_fallback(coll)

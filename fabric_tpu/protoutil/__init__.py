@@ -30,6 +30,7 @@ from fabric_tpu.protoutil.blocks import (
     extract_envelope,
     get_last_config_index,
     init_block_metadata,
+    serialize_block,
     tx_filter,
     set_tx_filter,
 )
@@ -63,6 +64,7 @@ __all__ = [
     "new_block",
     "create_next_block",
     "extract_envelope",
+    "serialize_block",
     "get_last_config_index",
     "init_block_metadata",
     "tx_filter",

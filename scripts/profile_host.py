@@ -1,0 +1,98 @@
+"""Profile the non-crypto host cost of the measured pipeline.
+
+Uses a CSP whose verify_batch returns all-True instantly, so every
+millisecond measured is host-side Python (collect glue, footprint,
+policy prepare/finish, MVCC, persistence) — the serial budget that
+bounds committed tx/s once device verify is overlapped.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+import time
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+for p in (_ROOT, os.path.join(_ROOT, "scripts"), os.path.join(_ROOT, "tests")):
+    if p not in sys.path:
+        sys.path.insert(0, p)
+
+from bench_pipeline import _build_world, _make_blocks  # noqa: E402
+
+from fabric_tpu.csp import SWCSP  # noqa: E402
+from fabric_tpu.ledger import LedgerProvider  # noqa: E402
+from fabric_tpu.peer.committer import Committer  # noqa: E402
+from fabric_tpu.peer.txvalidator import TxValidator  # noqa: E402
+from fabric_tpu.protos.common import common_pb2  # noqa: E402
+
+
+class NullCSP(SWCSP):
+    """All signatures 'verify' instantly."""
+
+    def verify_batch(self, items):
+        return [True] * len(items)
+
+    def verify_batch_async(self, items):
+        n = len(items)
+        return lambda: [True] * n
+
+
+def main() -> None:
+    n_txs, n_blocks = 1000, 8
+    sw = SWCSP()
+    orgs, genesis = _build_world(5)
+    _, bundle, blocks = _make_blocks(orgs, genesis, sw, n_txs, 3, n_blocks)
+    csp = NullCSP()
+
+    tmp = tempfile.TemporaryDirectory(prefix="fabric-prof-")
+    fresh_n = [0]
+
+    def fresh_ledger():
+        fresh_n[0] += 1
+        provider = LedgerProvider(os.path.join(tmp.name, f"run{fresh_n[0]}"))
+        return provider.create(genesis)
+
+    def copies(k):
+        out = []
+        for j in range(k):
+            b = common_pb2.Block()
+            b.CopyFrom(blocks[j % n_blocks])
+            out.append(b)
+        return out
+
+    # warm
+    led = fresh_ledger()
+    Committer(TxValidator("benchch", led, bundle, csp), led).store_block(copies(1)[0])
+
+    # total host wall for the stream
+    best = float("inf")
+    for _ in range(3):
+        led = fresh_ledger()
+        committer = Committer(TxValidator("benchch", led, bundle, csp), led)
+        bs = copies(n_blocks)
+        t0 = time.perf_counter()
+        for flags in committer.store_stream(iter(bs), depth=4):
+            assert all(f == 0 for f in flags)
+        best = min(best, time.perf_counter() - t0)
+    print(f"stream host wall: {best:.3f}s total, {best / n_blocks * 1e3:.1f} ms/block, {n_blocks * n_txs / best:.0f} tx/s ceiling")
+
+    # per-phase breakdown on the serial path
+    import cProfile
+    import pstats
+
+    led = fresh_ledger()
+    committer = Committer(TxValidator("benchch", led, bundle, csp), led)
+    bs = copies(n_blocks)
+    pr = cProfile.Profile()
+    pr.enable()
+    for flags in committer.store_stream(iter(bs), depth=4):
+        pass
+    pr.disable()
+    st = pstats.Stats(pr)
+    st.sort_stats("cumulative")
+    st.print_stats(35)
+
+
+if __name__ == "__main__":
+    main()

@@ -56,3 +56,111 @@ def test_verify_batch_small_falls_back_to_host():
     d = sw.hash(b"x")
     items = [VerifyBatchItem(key.public_key(), d, sw.sign(key, d))]
     assert tpu.verify_batch(items) == [True]
+
+
+# -- flush waiter / deadline host-race mechanics -------------------------
+
+
+def _signed_items(n, sw=None):
+    sw = sw or SWCSP()
+    key = sw.key_gen()
+    out = []
+    for i in range(n):
+        d = sw.hash(b"race-%d" % i)
+        sig = sw.sign(key, d)
+        if i % 5 == 4:
+            sig = b"\x30\x02\x01\x01"  # invalid lane
+        out.append(VerifyBatchItem(key.public_key(), d, sig))
+    return out
+
+
+def test_flush_deadline_host_race_beats_stalled_device():
+    """A device that never answers is beaten by the host race after the
+    deadline; mask matches the host oracle exactly."""
+    import threading
+
+    from fabric_tpu.csp.tpu.provider import _FlushResult
+
+    sw = SWCSP()
+    items = _signed_items(12, sw)
+    release = threading.Event()
+
+    def stalled_collect():
+        release.wait(10)
+        return [True] * len(items)
+
+    res = _FlushResult(
+        [(stalled_collect, len(items))], len(items), sw=sw,
+        device_items=items, deadline=0.05,
+    )
+    got = res.collect()
+    release.set()
+    assert got == sw.verify_batch(items)
+
+
+def test_flush_race_yields_to_device_completion():
+    """If the device finishes while the host race is mid-way, the device
+    mask wins (no partial/mixed result)."""
+    from fabric_tpu.csp.tpu.provider import _FlushResult
+
+    sw = SWCSP()
+    items = _signed_items(8, sw)
+    res = _FlushResult(
+        [(lambda: [True] * len(items), len(items))], len(items), sw=sw,
+        device_items=items, deadline=0.01,
+    )
+    # seal via the waiter path first, as the background thread would
+    res.start_background()
+    got = res.collect()
+    assert got == [True] * len(items)
+
+
+def test_flush_waiter_failure_degrades_to_host():
+    """A device collector that raises mid-flight leaves the host oracle
+    answering for the whole flush."""
+    from fabric_tpu.csp.tpu.provider import _FlushResult
+
+    sw = SWCSP()
+    items = _signed_items(10, sw)
+
+    def broken_collect():
+        raise RuntimeError("device lost")
+
+    res = _FlushResult(
+        [(broken_collect, len(items))], len(items), sw=sw,
+        device_items=items,
+    )
+    assert res.collect() == sw.verify_batch(items)
+
+
+def test_flush_collect_concurrent_segments_consistent():
+    """Many threads collecting the same flush all see the one sealed
+    mask (the r3 advisor's double-materialization race)."""
+    import threading
+
+    from fabric_tpu.csp.tpu.provider import _FlushResult
+
+    sw = SWCSP()
+    items = _signed_items(16, sw)
+    calls = []
+
+    def device_collect():
+        calls.append(1)
+        return sw.verify_batch(items)
+
+    res = _FlushResult(
+        [(device_collect, len(items))], len(items), sw=sw,
+        device_items=items,
+    )
+    got: list = [None] * 6
+    ths = [
+        threading.Thread(target=lambda i=i: got.__setitem__(i, res.collect()))
+        for i in range(6)
+    ]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join()
+    want = sw.verify_batch(items)
+    assert all(g == want for g in got)
+    assert len(calls) == 1  # materialized exactly once
