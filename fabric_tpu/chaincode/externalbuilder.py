@@ -147,17 +147,36 @@ class BuilderRegistry:
         raise BuildError(f"no builder detected package {package_id!r}")
 
     def run(self, package_id: str, package_bytes: bytes, chaincode_id: str,
-            peer_address: str) -> subprocess.Popen:
+            peer_address: str, auth_token: str) -> subprocess.Popen:
+        """`auth_token` (ChaincodeSupport.issue_launch_token) rides in
+        chaincode.json like the reference's launch-issued client
+        key/cert pair does (externalbuilder writes client_cert/client_key
+        there); the shim presents it in the listener handshake.  It is
+        REQUIRED: the TCP listener refuses un-handshaked streams, so a
+        token-less launch would silently never register.  The run dir
+        and chaincode.json are owner-only — the token is the launch
+        credential and must not be readable by other local users."""
+        if not auth_token:
+            raise ValueError(
+                "auth_token is required: mint one with "
+                "ChaincodeSupport.issue_launch_token(chaincode_id)"
+            )
         builder, out = self.build(package_id, package_bytes)
         run_meta = os.path.join(
             self.build_root, package_id.replace(":", "_"), "run"
         )
         os.makedirs(run_meta, exist_ok=True)
-        with open(os.path.join(run_meta, "chaincode.json"), "w") as f:
-            json.dump(
-                {"chaincode_id": chaincode_id, "peer_address": peer_address},
-                f,
-            )
+        os.chmod(run_meta, 0o700)
+        meta = {
+            "chaincode_id": chaincode_id,
+            "peer_address": peer_address,
+            "auth_token": auth_token,
+        }
+        path = os.path.join(run_meta, "chaincode.json")
+        fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+        with os.fdopen(fd, "w") as f:
+            json.dump(meta, f)
+        os.chmod(path, 0o600)  # pre-existing file: tighten regardless
         return builder.run(out, run_meta)
 
 

@@ -278,9 +278,15 @@ class ShimHandler:
             )
 
 
-def shim_main(cc: Chaincode, name: str, peer_address: str) -> None:
+def shim_main(
+    cc: Chaincode, name: str, peer_address: str,
+    auth_token: str | None = None,
+) -> None:
     """External chaincode entry: connect to the peer's chaincode listener
-    (CORE_PEER_ADDRESS equivalent) and serve forever."""
+    (CORE_PEER_ADDRESS equivalent) and serve forever.  `auth_token` is
+    the launch credential from chaincode.json; the listener's handshake
+    requires it before any protocol message (the reference presents its
+    launch-issued TLS client cert instead)."""
     host, port = peer_address.rsplit(":", 1)
     sock = socket.create_connection((host, int(port)))
     sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
@@ -289,6 +295,11 @@ def shim_main(cc: Chaincode, name: str, peer_address: str) -> None:
     def send(data: bytes) -> None:
         with lock:
             sock.sendall(_LEN.pack(len(data)) + data)
+
+    if auth_token is not None:
+        send(b"\x00".join(
+            [b"CCAUTH1", name.encode(), auth_token.encode()]
+        ))
 
     buf = bytearray()
 

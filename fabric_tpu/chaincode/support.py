@@ -69,13 +69,43 @@ class ChaincodeSupport:
         self._lock = threading.Lock()
         self._timeout = invoke_timeout_s
         self.cc2cc_allowed = True
+        self._launch_tokens: dict[str, str] = {}
+
+    # -- launch credentials (reference core/chaincode/accesscontrol:
+    # the peer issues each chaincode a client TLS cert at launch and the
+    # Register handler rejects a stream whose cert hash it did not
+    # issue.  Here the launch credential is a random token the peer
+    # writes into the process's chaincode.json; the TCP listener demands
+    # it in a handshake frame before any protocol message, so a rogue
+    # local process can neither register at all nor claim another
+    # chaincode's name.  In-process streams are peer-owned and trusted.)
+
+    def issue_launch_token(self, name: str) -> str:
+        """Mint (and remember) the launch credential for one chaincode
+        process; re-issuing invalidates the previous token."""
+        import secrets
+
+        token = secrets.token_hex(32)
+        with self._lock:
+            self._launch_tokens[name] = token
+        return token
+
+    def check_launch_token(self, name: str, token: str) -> bool:
+        import hmac
+
+        with self._lock:
+            want = self._launch_tokens.get(name)
+        return want is not None and hmac.compare_digest(want, token)
 
     # -- registration (one per stream) -------------------------------------
 
-    def register_stream(self, send, recv) -> None:
+    def register_stream(self, send, recv, authorized_name: str | None = None) -> None:
         """Serve one chaincode connection until EOF.  `send(bytes)`,
         `recv() -> bytes | None`.  Replies to ledger callbacks go back on
-        this same stream (handler.go serialSendAsync)."""
+        this same stream (handler.go serialSendAsync).  When
+        `authorized_name` is set (authenticated TCP streams), REGISTER
+        for any other name is rejected — the reference makes the same
+        cert-to-name binding check in handleRegister via accesscontrol."""
         name: str | None = None
         handle: _CCHandle | None = None
         try:
@@ -86,6 +116,18 @@ class ChaincodeSupport:
                 msg = M.FromString(raw)
                 if msg.type == M.REGISTER:
                     cid = chaincode_pb2.ChaincodeID.FromString(msg.payload)
+                    if (
+                        authorized_name is not None
+                        and cid.name != authorized_name
+                    ):
+                        send(
+                            M(
+                                type=M.ERROR,
+                                payload=b"chaincode name does not match "
+                                b"launch credential",
+                            ).SerializeToString()
+                        )
+                        return
                     with self._lock:
                         if cid.name in self._ccs:
                             # Duplicate registration is rejected, matching
@@ -388,7 +430,18 @@ class InProcStream:
 
 
 class TCPChaincodeListener:
-    """Accepts external chaincode processes (peer's chaincode listener)."""
+    """Accepts external chaincode processes (peer's chaincode listener).
+
+    Every connection must open with a handshake frame
+    ``CCAUTH1\\0<name>\\0<token>`` carrying the launch credential the
+    peer issued for that chaincode (ChaincodeSupport.issue_launch_token,
+    delivered via chaincode.json); anything else closes the socket.
+    Loopback binding is a mitigation, not an equivalent — the reference
+    authenticates with per-launch TLS client certs
+    (core/chaincode/accesscontrol/access_control.go), and this handshake
+    is the framed-TCP analogue."""
+
+    _HELLO = b"CCAUTH1"
 
     def __init__(self, support: ChaincodeSupport, listen_addr=("127.0.0.1", 0)):
         self._support = support
@@ -433,7 +486,17 @@ class TCPChaincodeListener:
             return frame
 
         try:
-            self._support.register_stream(send, recv)
+            hello = recv()
+            if hello is None:
+                return
+            parts = hello.split(b"\x00")
+            if len(parts) != 3 or parts[0] != self._HELLO:
+                return  # not an authenticated chaincode stream
+            name = parts[1].decode("utf-8", "replace")
+            token = parts[2].decode("utf-8", "replace")
+            if not self._support.check_launch_token(name, token):
+                return  # unknown/forged credential: drop silently
+            self._support.register_stream(send, recv, authorized_name=name)
         finally:
             try:
                 conn.close()

@@ -24,11 +24,25 @@ from fabric_tpu.protos.gossip import message_pb2 as gpb
 
 class MessageStore:
     """Bounded per-channel store of data messages keyed by seq num
-    (reference gossip/gossip/msgstore with TTL; we bound by count)."""
+    (reference gossip/gossip/msgstore/msgs.go: messages expire by TTL
+    with an expiration callback; a count bound caps burst memory).
 
-    def __init__(self, capacity: int = 200):
+    TTL is measured in gossip TICKS (the deterministic clock every other
+    gossip subsystem uses): `expire(now)` drops messages added more than
+    `ttl_ticks` ago and invokes `on_expire(seq, block_bytes)` for each —
+    mirroring the reference's expiredCallback, which the pull mediator
+    uses to stop serving a digest while anti-entropy/state transfer
+    still serves the block from the ledger.  ttl_ticks=0 disables TTL
+    (count bound only)."""
+
+    def __init__(self, capacity: int = 200, ttl_ticks: int = 0,
+                 on_expire=None):
         self._cap = capacity
+        self._ttl = ttl_ticks
+        self._on_expire = on_expire
         self._by_seq: dict[int, bytes] = {}
+        self._added: dict[int, int] = {}  # seq -> tick stamp
+        self._now = 0
         self._lock = threading.Lock()
 
     def add(self, seq: int, block_bytes: bytes) -> bool:
@@ -36,9 +50,31 @@ class MessageStore:
             if seq in self._by_seq:
                 return False
             self._by_seq[seq] = block_bytes
+            self._added[seq] = self._now
             while len(self._by_seq) > self._cap:
-                del self._by_seq[min(self._by_seq)]
+                oldest = min(self._by_seq)
+                del self._by_seq[oldest]
+                self._added.pop(oldest, None)
             return True
+
+    def expire(self, now: int) -> None:
+        """Advance the store clock and drop messages older than the TTL,
+        reporting each through on_expire OUTSIDE the lock."""
+        expired: list[tuple[int, bytes]] = []
+        with self._lock:
+            self._now = now
+            if self._ttl:
+                for seq in [
+                    s for s, t in self._added.items()
+                    if t <= now - self._ttl
+                ]:
+                    blk = self._by_seq.pop(seq, None)
+                    del self._added[seq]
+                    if blk is not None:
+                        expired.append((seq, blk))
+        if self._on_expire is not None:
+            for seq, blk in expired:
+                self._on_expire(seq, blk)
 
     def digests(self) -> list[int]:
         with self._lock:
@@ -57,7 +93,9 @@ class ChannelGossip:
         membership,  # callable -> list of alive peer endpoints in channel
         fanout: int = 3,
         store_capacity: int = 200,
+        store_ttl_ticks: int = 0,
         on_block=None,
+        on_expire=None,
         rng: random.Random | None = None,
     ):
         self.channel_id = channel_id
@@ -65,7 +103,9 @@ class ChannelGossip:
         self._comm = comm
         self._membership = membership
         self._fanout = fanout
-        self.store = MessageStore(store_capacity)
+        self.store = MessageStore(
+            store_capacity, ttl_ticks=store_ttl_ticks, on_expire=on_expire
+        )
         self._on_block = on_block or (lambda seq, blk: None)
         self._rng = rng or random.Random()
         self._nonce = 0
@@ -125,6 +165,7 @@ class ChannelGossip:
         _handle keeps the responses disjoint."""
         with self._lock:
             self._tick_no += 1
+            tick_no = self._tick_no
             # expire stale in-flight digests (response lost / peer died)
             dead = [
                 d for d, t in self._inflight.items()
@@ -132,6 +173,9 @@ class ChannelGossip:
             ]
             for d in dead:
                 del self._inflight[d]
+        # TTL sweep: expired blocks leave the pull digests; state
+        # transfer still serves them from the ledger
+        self.store.expire(tick_no)
         for target in self._targets(min(3, self._fanout)):
             self._nonce += 1
             hello = gpb.GossipMessage(channel=self._chan_bytes)

@@ -218,6 +218,22 @@ class StandardChannelProcessor:
                     "attempted to change consensus type and exit "
                     "maintenance mode in the same update"
                 )
+            # While in maintenance, nothing OUTSIDE the Orderer group may
+            # change (reference maintenancefilter.go ensureOnlyOrdererChange:
+            # an admin must not slip Application/Consortiums edits into a
+            # consensus migration window).
+            cur_cg = _config_copy_group(self._bundle.config.channel_group)
+            nxt_cg = _config_copy_group(new_config.channel_group)
+            for cg in (cur_cg, nxt_cg):
+                if "Orderer" in cg.groups:
+                    del cg.groups["Orderer"]
+            if cur_cg.SerializeToString(
+                deterministic=True
+            ) != nxt_cg.SerializeToString(deterministic=True):
+                raise MsgProcessorError(
+                    "config changes outside the Orderer group are not "
+                    "permitted while the channel is in maintenance mode"
+                )
 
 
 def _config_copy(config):
@@ -225,6 +241,14 @@ def _config_copy(config):
 
     out = configtx_pb2.Config()
     out.CopyFrom(config)
+    return out
+
+
+def _config_copy_group(group):
+    from fabric_tpu.protos.common import configtx_pb2
+
+    out = configtx_pb2.ConfigGroup()
+    out.CopyFrom(group)
     return out
 
 

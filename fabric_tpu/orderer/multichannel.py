@@ -57,6 +57,7 @@ class Registrar:
         self.transport = transport
         self._chains: dict[str, ChainSupport] = {}
         self._lock = threading.Lock()
+        self._halted = False
         self._consenter_overrides = consenter_overrides or {}
         self._on_block_hooks: list = []
 
@@ -135,6 +136,13 @@ class Registrar:
                 heartbeat_tick=opts.heartbeat_tick or 1,
                 snapshot_interval_size=opts.snapshot_interval_size or (16 << 20),
                 on_block=on_block,
+                eviction_suspicion_ticks=self._consenter_overrides.get(
+                    "eviction_suspicion_ticks"
+                ),
+                active_consenters_probe=self._consenter_overrides.get(
+                    "eviction_probe"
+                ),
+                on_eviction=lambda: self.demote_evicted(channel_id),
             )
             if self.transport is not None:
                 self.transport.register_channel(channel_id, chain.handle_step)
@@ -259,8 +267,54 @@ class Registrar:
         cs.chain = chain
         chain.start()
 
+    def demote_evicted(self, channel_id: str) -> None:
+        """A consenter chain confirmed its own eviction (raft eviction
+        suspicion): swap it for the follower path — a FollowerChain when
+        a cluster block puller is available (keeps replicating, rejoins
+        if re-added — reference etcdraft/eviction.go hands off to the
+        follower.Chain), else an InactiveChain that just refuses
+        service."""
+        from fabric_tpu.orderer.follower import FollowerChain, InactiveChain
+
+        cs = self.get_chain(channel_id)
+        if cs is None:
+            return
+        try:
+            cs.chain.halt()
+        except Exception:
+            pass
+        # the swap + start runs under the registrar lock and respects
+        # the halted flag: the eviction probe fires from an arbitrary
+        # daemon thread and must not start a follower AFTER halt_all
+        # tore the node down (it would pull into a dying store forever)
+        with self._lock:
+            if self._halted:
+                return
+            puller = self._consenter_overrides.get("follower_puller")
+            if puller is not None:
+                chain = FollowerChain(
+                    channel_id,
+                    cs.store.height,
+                    puller,
+                    # config blocks must be written AS config blocks so
+                    # the last_config index in ORDERER metadata tracks
+                    # them and the local bundle adopts cluster config
+                    # updates
+                    lambda blk, w=cs.writer: w.write_block(
+                        blk, is_config=FollowerChain._is_config(blk)
+                    ),
+                    self._consenter_overrides.get(
+                        "in_consenter_set", lambda blk: False
+                    ),
+                )
+            else:
+                chain = InactiveChain(channel_id)
+            cs.chain = chain
+            chain.start()
+
     def halt_all(self) -> None:
         with self._lock:
+            self._halted = True
             chains = list(self._chains.values())
         for cs in chains:
             cs.halt()

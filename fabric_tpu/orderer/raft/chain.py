@@ -47,7 +47,22 @@ class RaftChain:
         snapshot_interval_size: int = 16 << 20,
         on_block=None,
         block_puller=None,
+        eviction_suspicion_ticks: int | None = None,
+        active_consenters_probe=None,
+        on_eviction=None,
     ):
+        """`active_consenters_probe` () -> set[int] | None and
+        `on_eviction` () -> None power EVICTION SUSPICION (reference
+        orderer/consensus/etcdraft/eviction.go PeriodicCheck +
+        EvictionSuspector): a consenter that was removed from the set
+        while partitioned keeps campaigning against its stale local
+        voter list forever unless it can learn of its own eviction.
+        After `eviction_suspicion_ticks` ticks without a leader
+        (default: the reference's 10-minute EvictionSuspicion), the
+        chain asks the cluster for the ACTIVE consenter set via the
+        probe (None = peers unreachable, keep waiting); if it is absent
+        from the set it halts and fires `on_eviction`, which the
+        registrar uses to demote the node to the follower path."""
         self.channel_id = channel_id
         self.node_id = node_id
         self._cutter = cutter
@@ -80,6 +95,15 @@ class RaftChain:
         self.node.snapshot_payload_fn = self._fill_snapshot
         self._applied_bytes_since_snap = 0
         self._pending_snap_block = 0
+
+        self._probe = active_consenters_probe
+        self._on_evicted = on_eviction
+        self._suspicion_ticks = eviction_suspicion_ticks or max(
+            1, int(600.0 / tick_interval_s)
+        )
+        self._no_leader_ticks = 0
+        self._probe_inflight = False
+        self.evicted = threading.Event()
 
         self._was_leader = False
         self._events: queue.Queue = queue.Queue()
@@ -127,6 +151,20 @@ class RaftChain:
             raise RuntimeError("chain is halted")
         self._events.put(("submit", (env.SerializeToString(), True, config_seq)))
 
+    def propose_conf_change(self, cc: rpb.ConfChange) -> None:
+        """Thread-safe consenter-set change proposal.  Raises when this
+        node is not (or stops being) the leader rather than silently
+        dropping — the caller must resubmit to the actual leader, same
+        contract as the reference's Configure on a follower."""
+        if self._halted.is_set():
+            raise RuntimeError("chain is halted")
+        if not self.node.is_leader:
+            raise RuntimeError(
+                f"node {self.node_id} is not the raft leader; submit the "
+                "consenter change to the leader"
+            )
+        self._events.put(("conf", cc))
+
     # transport delivers StepRequests here (cluster/comm.go DispatchConsensus)
     def handle_step(self, req: rpb.StepRequest) -> None:
         if req.WhichOneof("payload") == "consensus":
@@ -158,6 +196,13 @@ class RaftChain:
                 break
             if kind == "raft":
                 self.node.step(payload)
+            elif kind == "conf":
+                if self.node.is_leader:
+                    self.node.propose_conf_change(payload)
+                # else: leadership moved between enqueue and drain — the
+                # proposal is lost exactly as if the leader crashed
+                # pre-append; callers confirm via the committed conf
+                # change, never the submit
             elif kind == "submit":
                 env_bytes, is_config, config_seq = payload
                 if self.node.leader == 0 and len(self._waiting) < 10000:
@@ -186,6 +231,7 @@ class RaftChain:
             if now - last_tick >= self._tick_interval:
                 self.node.tick()
                 last_tick = now
+                self._tick_eviction_suspicion()
             if self._waiting and self.node.leader != 0:
                 for p in self._waiting:
                     self._events.put(("submit", p))
@@ -197,6 +243,53 @@ class RaftChain:
             self._drain_ready()
         # final flush of raft outputs (e.g. persisted state)
         self._drain_ready()
+
+    def _tick_eviction_suspicion(self) -> None:
+        """One suspicion-clock tick (run-loop thread).  Reference
+        eviction.go: PeriodicCheck arms after LeaderlessCheckInterval
+        without a leader; EvictionSuspector.confirmSuspicion pulls the
+        cluster's latest config and self-demotes when absent from it."""
+        if self._probe is None:
+            return
+        if self.node.leader != 0 or self.node.is_leader:
+            self._no_leader_ticks = 0
+            return
+        self._no_leader_ticks += 1
+        if self._no_leader_ticks < self._suspicion_ticks:
+            return
+        self._no_leader_ticks = 0  # re-arm: probe once per suspicion period
+        if self._probe_inflight:
+            return  # previous confirmation still running
+        self._probe_inflight = True
+        # The probe is a CLUSTER RPC — run it off the loop thread so a
+        # slow or hanging peer never freezes tick/step processing (the
+        # reference likewise runs PeriodicCheck/EvictionSuspector off
+        # the consensus goroutine).
+        threading.Thread(
+            target=self._confirm_eviction,
+            name=f"raft-eviction-probe-{self.channel_id}",
+            daemon=True,
+        ).start()
+
+    def _confirm_eviction(self) -> None:
+        try:
+            try:
+                active = self._probe()
+            except Exception:
+                active = None
+            if active is None or self.node.id in active:
+                return  # peers unreachable, or still a member: keep waiting
+            # Confirmed eviction: stop consenting.  The halt flag ends
+            # the run loop; the registrar's callback swaps in the
+            # follower path (it may join the loop thread via halt(), so
+            # it must not run on it).
+            self.evicted.set()
+            self._halted.set()
+            self._events.put(("halt", None))  # wake the loop promptly
+            if self._on_evicted is not None:
+                self._on_evicted()
+        finally:
+            self._probe_inflight = False
 
     # -- leader-side block creation ---------------------------------------
     # The leader may have proposed blocks that raft has not yet committed,
@@ -242,6 +335,16 @@ class RaftChain:
         self._transport.send(self.node.id, leader, req)
 
     def _drain_ready(self) -> None:
+        """Drain one Ready batch in the etcd order: persist hard state +
+        entries to the WAL FIRST, then apply committed entries, then
+        hand messages to the transport.  CRASH CONTRACT (pinned by
+        test_ready_persist_crash_contract): ready() advances the node's
+        in-memory applied/emitted cursors eagerly, so a crash between
+        ready() and the WAL save loses exactly that in-memory
+        advancement — which is safe because nothing external (message,
+        block write) happens before the save, and on restart the replay
+        re-emits every committed-but-unapplied entry; _apply is
+        idempotent via the writer-height check."""
         if self.node.is_leader and not self._was_leader:
             self._reset_creator()
         self._was_leader = self.node.is_leader

@@ -270,6 +270,10 @@ class RaftNode:
         self._elapsed = 0
         self._timeout = self._rand_timeout()
         self._votes = {self.id: True}
+        # an election attempt means leader contact was lost — drop the
+        # stale hint (etcd becomePreCandidate/becomeCandidate reset
+        # r.lead; eviction suspicion keys off leader == 0)
+        self.leader = 0
         if pre:
             # Pre-vote: probe electability at term+1 WITHOUT bumping our term
             self.state = PRE_CANDIDATE

@@ -142,21 +142,24 @@ EXTERNAL_CC = textwrap.dedent(
             stub.put_state("echo", b"-".join(stub.args))
             return success(b"-".join(stub.args))
 
-    shim_main(Echo(), "echocc", sys.argv[1])
+    shim_main(Echo(), sys.argv[2], sys.argv[1], auth_token=sys.argv[3])
     """
 )
 
 
 def test_external_process_chaincode(support, sim, tmp_path):
     """The externalbuilder path: chaincode as a separate OS process
-    connecting back over TCP (reference core/container/externalbuilder)."""
+    connecting back over TCP (reference core/container/externalbuilder),
+    presenting its launch credential in the listener handshake."""
     import os
 
     listener = TCPChaincodeListener(support)
+    token = support.issue_launch_token("echocc")
     script = tmp_path / "echo_cc.py"
     script.write_text(EXTERNAL_CC % os.getcwd())
     proc = subprocess.Popen(
-        [sys.executable, str(script), f"127.0.0.1:{listener.addr[1]}"],
+        [sys.executable, str(script), f"127.0.0.1:{listener.addr[1]}",
+         "echocc", token],
         stdout=subprocess.PIPE,
         stderr=subprocess.PIPE,
     )
@@ -211,3 +214,68 @@ def test_rich_query_via_shim(support, sim):
     resp, _ = support.execute("richcc", "ch", "rq2", sim, [b"query", q])
     assert resp.status == 200
     assert json.loads(resp.payload) == ["doc3"]
+
+
+def test_rogue_process_cannot_register(support):
+    """Chaincode-connection access control (reference
+    core/chaincode/accesscontrol/access_control.go): a local process
+    WITHOUT a peer-issued launch credential never registers — neither
+    bare protocol (no handshake), nor a guessed token, nor a VALID
+    credential presented for a different chaincode's name."""
+    import socket as socketlib
+    import struct
+
+    from fabric_tpu.protos.peer import chaincode_pb2
+    from fabric_tpu.protos.peer import chaincode_shim_pb2 as shim_pb
+
+    LEN = struct.Struct(">I")
+    M = shim_pb.ChaincodeMessage
+    listener = TCPChaincodeListener(support)
+    support.issue_launch_token("legitcc")
+
+    def attempt(frames):
+        sock = socketlib.create_connection(("127.0.0.1", listener.addr[1]))
+        try:
+            for f in frames:
+                sock.sendall(LEN.pack(len(f)) + f)
+            sock.settimeout(2.0)
+            got = b""
+            try:
+                while len(got) < 4:
+                    chunk = sock.recv(4096)
+                    if not chunk:
+                        return None  # closed without an answer
+                    got += chunk
+            except TimeoutError:
+                return None
+            (ln,) = LEN.unpack_from(got)
+            while len(got) < 4 + ln:
+                got += sock.recv(4096)
+            return M.FromString(got[4:4 + ln])
+        finally:
+            sock.close()
+
+    reg = M(
+        type=M.REGISTER,
+        payload=chaincode_pb2.ChaincodeID(name="legitcc").SerializeToString(),
+    ).SerializeToString()
+
+    # 1) no handshake at all: dropped before the protocol starts
+    assert attempt([reg]) is None
+    assert not support.registered("legitcc")
+    # 2) forged token: dropped
+    bad = b"\x00".join([b"CCAUTH1", b"legitcc", b"00" * 32])
+    assert attempt([bad, reg]) is None
+    assert not support.registered("legitcc")
+    # 3) valid token for another name: REGISTER name mismatch -> ERROR
+    other_token = support.issue_launch_token("othercc")
+    hello = b"\x00".join([b"CCAUTH1", b"othercc", other_token.encode()])
+    resp = attempt([hello, reg])
+    assert resp is not None and resp.type == M.ERROR
+    assert not support.registered("legitcc")
+    # 4) the real credential works end to end
+    tok = support.issue_launch_token("legitcc")
+    hello = b"\x00".join([b"CCAUTH1", b"legitcc", tok.encode()])
+    resp = attempt([hello, reg])
+    assert resp is not None and resp.type == M.REGISTERED
+    listener.close()

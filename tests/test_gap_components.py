@@ -168,11 +168,26 @@ def test_builder_detection_order_and_run(tmp_path):
     assert os.path.exists(os.path.join(out, "main.sh"))
     # cached: same object back
     assert reg.build("extcc:aa11", _package())[1] == out
-    proc = reg.run("extcc:aa11", _package(), "extcc:aa11", "127.0.0.1:7052")
+    with pytest.raises(ValueError):  # token-less launch is unrepresentable
+        reg.run("extcc:aa11", _package(), "extcc:aa11", "127.0.0.1:7052", "")
+    proc = reg.run(
+        "extcc:aa11", _package(), "extcc:aa11", "127.0.0.1:7052",
+        auth_token="tok-aa11",
+    )
     proc.wait(timeout=10)
     with open(os.path.join(out, "launched")) as f:
         meta = f.read()
     assert "extcc:aa11" in meta and "127.0.0.1:7052" in meta
+    # the launch credential is owner-only on disk
+    import json as _json
+    import stat
+
+    run_meta = os.path.join(str(tmp_path / "bld"), "extcc_aa11", "run")
+    cc_json = os.path.join(run_meta, "chaincode.json")
+    with open(cc_json) as f:
+        assert _json.load(f)["auth_token"] == "tok-aa11"
+    assert stat.S_IMODE(os.stat(cc_json).st_mode) == 0o600
+    assert stat.S_IMODE(os.stat(run_meta).st_mode) == 0o700
 
 
 def test_builder_none_detects(tmp_path):
@@ -267,3 +282,81 @@ def test_rpc_limiter_spans_streams():
     finally:
         gate.set()
         srv.stop()
+
+
+def test_registrar_demotes_evicted_chain_to_follower(tmp_path):
+    """Registrar.demote_evicted (raft eviction hand-off): the consenter
+    chain is swapped for a FollowerChain that keeps replicating from the
+    cluster — config blocks written AS config blocks (the last_config
+    index must track them) — and refuses client service; without a
+    puller the swap degrades to InactiveChain."""
+    import time
+
+    from fabric_tpu.csp import SWCSP
+    from fabric_tpu.orderer.follower import FollowerChain, NotServicedError
+    from fabric_tpu.orderer.multichannel import Registrar
+
+    from orgfix import make_org
+    from fabric_tpu.common import configtx_builder as ctx
+    from fabric_tpu.msp import msp_config_from_ca
+
+    org = make_org("Org1MSP")
+    oorg = make_org("OrdererMSP")
+    app = ctx.application_group(
+        {"Org1": ctx.org_group("Org1MSP", msp_config_from_ca(org.ca, "Org1MSP"))}
+    )
+    ordg = ctx.orderer_group(
+        {"O": ctx.org_group(
+            "OrdererMSP", msp_config_from_ca(oorg.ca, "OrdererMSP")
+        )},
+        consensus_type="solo",
+    )
+    genesis = ctx.genesis_block("democh", ctx.channel_group(app, ordg))
+
+    # cluster blocks the demoted node will pull: one normal, one config
+    def _blk(num, cfg):
+        chdr = protoutil.make_channel_header(
+            common_pb2.CONFIG if cfg else common_pb2.ENDORSER_TRANSACTION,
+            "democh", tx_id=f"d{num}",
+        )
+        shdr = protoutil.make_signature_header(b"c", b"n%d" % num)
+        env = common_pb2.Envelope(
+            payload=protoutil.make_payload_bytes(chdr, shdr, b"x")
+        )
+        blk = common_pb2.Block()
+        blk.header.number = num
+        blk.data.data.append(env.SerializeToString())
+        return blk
+
+    remote = {1: _blk(1, False), 2: _blk(2, True)}
+
+    reg = Registrar(
+        str(tmp_path), SWCSP(),
+        consenter_overrides={
+            "follower_puller": lambda h: remote.get(h),
+        },
+    )
+    cs = reg.create_chain(genesis)
+    reg.demote_evicted("democh")
+    assert isinstance(cs.chain, FollowerChain)
+    with pytest.raises(NotServicedError):
+        cs.chain.order(common_pb2.Envelope())
+    deadline = time.time() + 5
+    while cs.store.height < 3 and time.time() < deadline:
+        time.sleep(0.02)
+    assert cs.store.height == 3, "follower must replicate cluster blocks"
+    # the pulled CONFIG block was written as a config block: the ORDERER
+    # metadata's last_config index points at it
+    assert protoutil.get_last_config_index(
+        cs.store.get_block_by_number(2)
+    ) == 2
+    reg.halt_all()
+
+    # no puller configured -> InactiveChain
+    from fabric_tpu.orderer.follower import InactiveChain
+
+    reg2 = Registrar(str(tmp_path / "b"), SWCSP())
+    cs2 = reg2.create_chain(genesis)
+    reg2.demote_evicted("democh")
+    assert isinstance(cs2.chain, InactiveChain)
+    reg2.halt_all()

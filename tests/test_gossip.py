@@ -257,3 +257,46 @@ def test_pull_inflight_digests_not_double_requested():
     cg._handle(FakeRM(dig(hellos[1].hello.nonce)))
     reqs = [m for _, m in sent if m.WhichOneof("content") == "data_req"]
     assert len(reqs) == 1, "digest 7 must be requested exactly once"
+
+
+def test_msgstore_ttl_expires_blocks_from_digests():
+    """TTL semantics (reference gossip/gossip/msgstore/msgs.go): a block
+    older than the TTL leaves the store — its digest is no longer
+    advertised to pulls, the expiration callback fires exactly once, and
+    younger blocks survive.  The count bound still caps bursts."""
+    from fabric_tpu.gossip.core import ChannelGossip
+
+    class SpyComm:
+        pki_id = b"spy"
+
+        def subscribe(self, fn):
+            self.handler = fn
+
+        def send(self, ep, msg):
+            pass
+
+    expired = []
+    cg = ChannelGossip(
+        "ch", SpyComm(), lambda: [], store_ttl_ticks=3,
+        on_expire=lambda seq, blk: expired.append((seq, blk)),
+    )
+    cg.add_block(1, b"b1", push=False)
+    cg.tick()
+    cg.add_block(2, b"b2", push=False)
+    cg.tick()  # tick 2: block 1 is 2 ticks old — still there
+    assert cg.store.digests() == [1, 2]
+    cg.tick()  # tick 3: block 1 (stamped tick 0) hits ttl=3
+    assert cg.store.digests() == [2]
+    assert cg.store.get(1) is None
+    assert expired == [(1, b"b1")]
+    cg.tick()  # tick 4: block 2 (stamped tick 1) expires too
+    assert cg.store.digests() == []
+    assert expired == [(1, b"b1"), (2, b"b2")]
+
+    # without a TTL the count bound alone evicts (oldest first, no cb)
+    cg2 = ChannelGossip("ch", SpyComm(), lambda: [], store_capacity=2)
+    for s in (1, 2, 3):
+        cg2.add_block(s, b"x", push=False)
+    for _ in range(10):
+        cg2.tick()
+    assert cg2.store.digests() == [2, 3]

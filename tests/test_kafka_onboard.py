@@ -14,7 +14,8 @@ from fabric_tpu.protos.common import common_pb2
 from fabric_tpu import protoutil
 
 
-def _genesis(channel="kafkach", consensus="kafka", max_msgs=3):
+def _genesis(channel="kafkach", consensus="kafka", max_msgs=3,
+             batch_timeout="150ms"):
     org = make_org("Org1MSP")
     oorg = make_org("OrdererMSP")
     app = ctx.application_group(
@@ -24,7 +25,7 @@ def _genesis(channel="kafkach", consensus="kafka", max_msgs=3):
         {"O": ctx.org_group("OrdererMSP", msp_config_from_ca(oorg.ca, "OrdererMSP"))},
         consensus_type=consensus,
         max_message_count=max_msgs,
-        batch_timeout="150ms",
+        batch_timeout=batch_timeout,
     )
     blk = ctx.genesis_block(channel, ctx.channel_group(app, ordg))
     return blk, org, oorg
@@ -171,3 +172,56 @@ class TestOnboarding:
             )
         src.stop()
         dst.stop()
+
+
+class TestKafkaRestartBacklog:
+    def test_restart_with_pending_batch_and_stale_ttc(self, tmp_path):
+        """Restart mid-partition with a pending (uncut) batch while the
+        partition still holds a TIME-TO-CUT from the previous
+        incarnation.  The stale TTC (block_number != the restarted
+        chain's pending block) must be IGNORED (kafka.py ignore path;
+        reference kafka/chain.go processTimeToCut 'ignore stale') — a
+        buggy replica would cut a short block and fork from replicas
+        that cut at the right offset."""
+        from fabric_tpu.orderer.kafka import InProcBroker, _wrap
+        from fabric_tpu.orderer.multichannel import Registrar
+
+        # batch timeout far beyond the test horizon: the ONLY thing
+        # that may cut the backlog is an explicit TIME-TO-CUT message
+        genesis, org, _ = _genesis(max_msgs=3, batch_timeout="60s")
+        broker = InProcBroker()
+        reg = Registrar(
+            str(tmp_path), SWCSP(),
+            consenter_overrides={"broker": broker},
+        )
+        cs = reg.create_chain(genesis)
+        # cut block 1 cleanly (3 envelopes = max_msgs)
+        for i in range(3):
+            cs.chain.order(_env(org, "kafkach", i))
+        _wait_height(cs.store, 2)
+        # leave a 2-envelope backlog pending, then "crash" the chain
+        cs.chain.order(_env(org, "kafkach", 7))
+        cs.chain.order(_env(org, "kafkach", 8))
+        time.sleep(0.1)
+        reg.halt_all()  # timer dies with the chain; TTC not yet sent
+
+        # the dead incarnation's timer fires late: a TTC for a block
+        # number the cluster has MOVED PAST lands in the partition
+        broker.partition("kafkach").append(_wrap("timetocut", block_number=1))
+
+        reg2 = Registrar(
+            str(tmp_path), SWCSP(),
+            consenter_overrides={"broker": broker},
+        )
+        cs2 = reg2.create_chain(genesis)
+        # replay: backlog (2 envs) pending again, stale TTC(1) ignored
+        time.sleep(0.5)
+        assert cs2.store.height == 2, "stale TTC must not cut a block"
+        # a TTC for the CORRECT pending block (what a live replica's
+        # timer would post) cuts the backlog exactly once
+        broker.partition("kafkach").append(
+            _wrap("timetocut", block_number=2)
+        )
+        _wait_height(cs2.store, 3)
+        assert len(cs2.store.get_block_by_number(2).data.data) == 2
+        reg2.halt_all()

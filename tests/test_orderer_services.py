@@ -400,6 +400,13 @@ def test_maintenance_filter_unit_rules(tmp_path):
             proc._maintenance_filter(
                 cfg_with(ctype="kafka", state=STATE_NORMAL)
             )
+        # while IN maintenance: touching anything OUTSIDE the Orderer
+        # group rides along a migration update — rejected
+        # (maintenancefilter.go ensures only-Orderer changes)
+        tainted = cfg_with(ctype="kafka", state=STATE_MAINTENANCE)
+        tainted.channel_group.groups["Application"].version += 1
+        with pytest.raises(MsgProcessorError):
+            proc._maintenance_filter(tainted)
         cs.bundle.orderer_config = oc
     finally:
         w.registrar.halt_all()
