@@ -201,15 +201,10 @@ def point_add_mixed(fp: limbs.Mod, p1: Jac, a2: Aff, dbl=None) -> Jac:
     out = Jac(x3, y3, z3, jnp.zeros_like(p1.inf))
     out = _sel_pt(h_zero & r_zero, (dbl or point_dbl)(fp, p1), out)
     out = Jac(out.x, out.y, out.z, out.inf | (h_zero & ~r_zero))
-    a2j = Jac(a2.x, a2.y, _one_like(a2.x), a2.inf)
+    a2j = Jac(a2.x, a2.y, fp.one_like(a2.x), a2.inf)
     out = _sel_pt(a2.inf, p1, out)
     out = _sel_pt(p1.inf, a2j, out)
     return out
-
-
-def _one_like(x):
-    one = jnp.zeros_like(x)
-    return one.at[..., 0].set(1)
 
 
 # ---------------------------------------------------------------------------
@@ -225,7 +220,7 @@ def _q_window_table(fp: limbs.Mod, qx, qy):
     inf_t = jnp.ones(b, bool)
     fin = jnp.zeros(b, bool)
     q_aff = Aff(qx, qy, fin)
-    q1 = Jac(qx, qy, _one_like(qx), fin)
+    q1 = Jac(qx, qy, fp.one_like(qx), fin)
 
     def step(p: Jac, _):
         nxt = point_add_mixed(fp, p, q_aff)

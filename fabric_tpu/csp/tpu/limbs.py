@@ -177,6 +177,43 @@ def mul_wide(a, b):
     return resolve(cols.astype(jnp.uint32), na + nb)
 
 
+@functools.lru_cache(maxsize=None)
+def _column_matrix_low(na: int, nb: int, width: int) -> np.ndarray:
+    """Like _column_matrix but keeping only result columns < width —
+    product limbs landing at or above `width` are simply dropped, which
+    is exact truncation mod 2**(16*width) (no carry out of column
+    width-1 can re-enter the kept range)."""
+    s = np.zeros((2 * na * nb, width), np.float32)
+    for i in range(na):
+        for j in range(nb):
+            if i + j < width:
+                s[i * nb + j, i + j] = 1.0
+            if i + j + 1 < width:
+                s[na * nb + i * nb + j, i + j + 1] = 1.0
+    return s
+
+
+def mul_low(a, b, width: int):
+    """(a * b) mod 2**(16*width) as canonical limbs — the truncated
+    low-half multiply Montgomery reduction needs (u = T * m' mod R)."""
+    na = a.shape[-1]
+    nb = b.shape[-1]
+    p = a[..., :, None] * b[..., None, :]
+    plo = (p & jnp.uint32(MASK)).astype(jnp.float32)
+    phi = (p >> jnp.uint32(LIMB_BITS)).astype(jnp.float32)
+    flat = jnp.concatenate(
+        [plo.reshape(*a.shape[:-1], na * nb), phi.reshape(*a.shape[:-1], na * nb)],
+        axis=-1,
+    )
+    cols = jnp.matmul(
+        flat, _column_matrix_low(na, nb, width),
+        precision=jax.lax.Precision.HIGHEST,
+    )
+    # resolve's carry passes drop carries out of the top limb, which is
+    # exactly the mod-2**(16*width) semantics wanted here
+    return resolve(cols.astype(jnp.uint32), width)
+
+
 # ---------------------------------------------------------------------------
 # Modulus context.
 # ---------------------------------------------------------------------------
@@ -218,6 +255,23 @@ class Mod:
         assert (r >= 0).all() and r[16] >= 7
         self.sub_c = r.astype(np.uint32)
         assert limbs_to_int(self.sub_c) == c * m
+        # the representation of 1 in this context's element form —
+        # Montgomery subclasses override it with R mod m so shared
+        # EC formulas can mint z=1 coordinates without knowing the form
+        self._one = int_to_limbs(1, WIDE)
+
+    def one_like(self, x):
+        """Limb vector for the field element 1, broadcast to x's shape."""
+        return jnp.broadcast_to(jnp.asarray(self._one), x.shape)
+
+    # element-form <-> plain-int boundary, identity for the plain form
+    # (MontMod overrides with ·R / ·R⁻¹) — lets callers convert at the
+    # host edges without knowing which form the context uses
+    def to_mont_int(self, x: int) -> int:
+        return x % self.m
+
+    def from_mont_int(self, v: int) -> int:
+        return v % self.m
 
     # -- reduction ---------------------------------------------------------
 
@@ -323,6 +377,107 @@ class Mod:
         return jnp.all(self.canon(a) == self.canon(b), axis=-1)
 
 
+class MontMod(Mod):
+    """Mod variant whose elements live in Montgomery form a·R mod m with
+    R = 2**272 (one full 17-limb word), and whose mul/sqr use REDC
+    instead of the fold-table chains.
+
+    Why: for a 254-bit modulus like BN254's p the fold table entries
+    R[i] = 2**(256+16i) mod m are nearly as large as m, so `_settle`
+    sheds only a few bits per pass and a single `mul` costs ~6 fold
+    passes.  Montgomery reduction replaces the whole chain with two
+    fixed multiplies — u = T·m' mod R (low-half) and u·m (full) — and
+    one carry resolve: t = (T + u·m)/R, exact division because
+    T + u·m ≡ 0 (mod R).  Bounds: inputs < 2**257 (the shared lazy
+    invariant) give T < 2**514 < m·R, so t < m + 2**242 < 2m — outputs
+    are always tighter than the invariant they consume.
+
+    add, sub, mul_const and the relaxed-subtraction constant are
+    inherited: they are value-preserving mod m and therefore agnostic
+    to the element form.  is_zero and canon are overridden below with
+    cheaper REDC-based versions (eq inherits and picks up the new
+    canon).  canon() of a Montgomery element yields the canonical
+    *Montgomery* residue; use from_mont_int on the host to leave the
+    form.
+
+    Replaces the AMCL big-number arithmetic the reference's idemix
+    stack runs per-signature on host Go (idemix/signature.go:290, via
+    math/amcl FP256BN) with batched device math.
+    """
+
+    def __init__(self, m: int):
+        super().__init__(m)
+        r = 1 << (LIMB_BITS * WIDE)
+        self.r = r
+        self.r_inv = pow(r, -1, m)
+        self.m_prime = (-pow(m, -1, r)) % r
+        self.m_prime_limbs = int_to_limbs(self.m_prime, WIDE)
+        self.one_int = r % m
+        self._one = int_to_limbs(self.one_int, WIDE)
+        self.r2_limbs = int_to_limbs(r * r % m, WIDE)
+
+    # -- host conversions (python ints, used building tables/results) ----
+
+    def to_mont_int(self, x: int) -> int:
+        return (x % self.m) * self.r % self.m
+
+    def from_mont_int(self, v: int) -> int:
+        return v % self.m * self.r_inv % self.m
+
+    # -- device form conversions ------------------------------------------
+
+    def to_mont(self, a):
+        """Plain element -> Montgomery form (a·R): one mont-mul by R²."""
+        return self.mul(a, jnp.asarray(self.r2_limbs))
+
+    def from_mont(self, a):
+        """Montgomery form -> plain element: REDC(a·1) = a·R⁻¹."""
+        return self._redc(a)
+
+    # -- REDC --------------------------------------------------------------
+
+    def _redc(self, t):
+        """t (..., <=34 limbs canonical, value < m·R) -> (t·R⁻¹ mod m)
+        as a (..., 17)-limb element < 2m."""
+        lo = t[..., :WIDE] if t.shape[-1] > WIDE else t
+        u = mul_low(lo, jnp.asarray(self.m_prime_limbs), WIDE)
+        v = mul_wide(u, jnp.asarray(self.m_limbs))  # (..., 34)
+        w = 2 * WIDE + 1
+
+        def pad(x):
+            return jnp.pad(
+                x, [(0, 0)] * (x.ndim - 1) + [(0, w - x.shape[-1])]
+            )
+
+        s = resolve(pad(t) + pad(v), w)
+        # low 17 limbs are exactly zero (T + u·m ≡ 0 mod R); the value
+        # is < 2m < 2**255 so limbs 34+ are zero too — slice the word
+        return s[..., WIDE:2 * WIDE]
+
+    def mul(self, a, b):
+        return self._redc(mul_wide(a, b))
+
+    def sqr(self, a):
+        return self.mul(a, a)
+
+    # -- cheaper predicates/canonicalization via REDC ----------------------
+    #
+    # The inherited versions run canon()'s minifold + conditional-subtract
+    # chain per call; here one REDC of the 17-limb value lands in [0, m]
+    # (bound: (2**257 + R·m)/R < m + 1), so zero-testing is two limb
+    # compares and canon is one mont-mul by the form's 1 plus one
+    # conditional subtract.
+
+    def is_zero(self, a):
+        r = self._redc(a)
+        m_l = jnp.asarray(self.m_limbs)
+        return jnp.all(r == 0, axis=-1) | jnp.all(r == m_l, axis=-1)
+
+    def canon(self, a):
+        v = self.mul(a, jnp.asarray(self._one))  # value preserved, < 2m
+        return _cond_sub(v, jnp.asarray(self.m_limbs))
+
+
 def _cond_sub(a, b_const):
     """a - b if a >= b else a; a, b canonical limbs, same width."""
     width = a.shape[-1]
@@ -339,13 +494,21 @@ def mod_ctx(m: int) -> Mod:
     return Mod(m)
 
 
+@functools.lru_cache(maxsize=None)
+def mont_ctx(m: int) -> MontMod:
+    return MontMod(m)
+
+
 __all__ = [
     "LIMB_BITS",
     "MASK",
     "NLIMBS",
     "WIDE",
     "Mod",
+    "MontMod",
     "mod_ctx",
+    "mont_ctx",
+    "mul_low",
     "mul_wide",
     "resolve",
     "int_to_limbs",

@@ -1,0 +1,72 @@
+"""Micro-benchmark: the device Schnorr-commitment kernel alone.
+
+Times `schnorr_commitments_batch` (compile excluded) at a given lane
+count, isolating the XLA kernel + host conversion cost from the rest of
+the idemix verify path (challenge re-hash, RLC pairings).  Used to
+compare field-arithmetic variants (fold-chain vs Montgomery REDC).
+
+    python scripts/bench_bn254_kernel.py [--sigs 1024] [--reps 3]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--sigs", type=int, default=1024)
+    ap.add_argument("--reps", type=int, default=3)
+    args = ap.parse_args()
+
+    from fabric_tpu.csp.tpu import bn254_batch
+    from fabric_tpu.idemix import bn254 as bn
+    from fabric_tpu.idemix import signature
+    from fabric_tpu.idemix.credential import (
+        attribute_to_scalar,
+        new_cred_request,
+        new_credential,
+    )
+    from fabric_tpu.idemix.issuer import IssuerKey
+
+    rng = random.Random(42)
+    ik = IssuerKey.generate(["OU", "Role"], rng=rng)
+    sk = bn.rand_zr(rng)
+    req = new_cred_request(sk, b"nonce", ik.ipk, rng=rng)
+    attrs = [attribute_to_scalar("org1"), attribute_to_scalar(2)]
+    cred = new_credential(ik, req, attrs, rng=rng)
+
+    base = [
+        signature.new_signature(cred, sk, ik.ipk, b"bench-%d" % i, rng=rng)
+        for i in range(min(args.sigs, 32))
+    ]
+    sigs = [base[i % len(base)] for i in range(args.sigs)]
+
+    t0 = time.perf_counter()
+    comms = bn254_batch.schnorr_commitments_batch(sigs, ik.ipk)  # compile
+    compile_s = time.perf_counter() - t0
+    assert all(c is not None for c in comms)
+
+    best = float("inf")
+    for _ in range(args.reps):
+        t0 = time.perf_counter()
+        comms = bn254_batch.schnorr_commitments_batch(sigs, ik.ipk)
+        best = min(best, time.perf_counter() - t0)
+    print(json.dumps({
+        "metric": "bn254_schnorr_kernel",
+        "sigs": args.sigs,
+        "first_call_s": round(compile_s, 2),
+        "steady_s": round(best, 3),
+        "sigs_s": round(args.sigs / best, 1),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,109 @@
+"""Micro-benchmark of individual limb field ops on the device.
+
+Times jitted chains of mul / add / sub / is_zero / point_add for the
+fold-chain Mod and the Montgomery MontMod over BN254's p, to locate
+where the Schnorr kernel's time actually goes.
+
+    python scripts/bench_fieldops.py [--batch 3072] [--chain 64]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def bench(fn, args, reps=5):
+    import jax
+
+    jfn = jax.jit(fn)
+    out = jax.block_until_ready(jfn(*args))  # compile
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = jax.block_until_ready(jfn(*args))
+        best = min(best, time.perf_counter() - t0)
+    del out
+    return best
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=3072)
+    ap.add_argument("--chain", type=int, default=64)
+    args = ap.parse_args()
+
+    import jax.numpy as jnp
+
+    from fabric_tpu.csp.tpu import ec, limbs
+    from fabric_tpu.idemix import bn254 as bn
+
+    rng = random.Random(9)
+    n, k = args.batch, args.chain
+    vals = [rng.randrange(bn.P) for _ in range(n)]
+    a_np = np.asarray(limbs.ints_to_limbs(vals))
+    b_np = np.asarray(limbs.ints_to_limbs(list(reversed(vals))))
+
+    out = {"batch": n, "chain": k}
+    for name, ctx in (
+        ("fold", limbs.mod_ctx(bn.P)),
+        ("mont", limbs.mont_ctx(bn.P)),
+    ):
+        a = jnp.asarray(a_np)
+        b = jnp.asarray(b_np)
+
+        def chain_mul(a, b, _ctx=ctx):
+            for _ in range(k):
+                a = _ctx.mul(a, b)
+            return a
+
+        def chain_add(a, b, _ctx=ctx):
+            for _ in range(k):
+                a = _ctx.add(a, b)
+            return a
+
+        def chain_sub(a, b, _ctx=ctx):
+            for _ in range(k):
+                a = _ctx.sub(a, b)
+            return a
+
+        def chain_iszero(a, b, _ctx=ctx):
+            acc = jnp.zeros(a.shape[:-1], bool)
+            for i in range(k):
+                acc = acc | _ctx.is_zero(a + jnp.uint32(i))
+            return acc
+
+        def chain_mulconst(a, b, _ctx=ctx):
+            for _ in range(k):
+                a = _ctx.mul_const(a, 3)
+            return a
+
+        def chain_ptadd(a, b, _ctx=ctx):
+            one = _ctx.one_like(a)
+            p = ec.Jac(a, b, one, jnp.zeros(a.shape[:-1], bool))
+            q = ec.Jac(b, a, one, jnp.zeros(a.shape[:-1], bool))
+            for _ in range(max(1, k // 8)):
+                p = ec.point_add(_ctx, p, q)
+            return p.x
+
+        for label, fn in (
+            ("mul", chain_mul), ("add", chain_add), ("sub", chain_sub),
+            ("is_zero", chain_iszero), ("mul_const", chain_mulconst),
+            ("point_add", chain_ptadd),
+        ):
+            t = bench(fn, (a, b))
+            per = t / (k if label != "point_add" else max(1, k // 8))
+            out[f"{name}_{label}_us"] = round(per * 1e6, 1)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

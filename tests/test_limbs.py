@@ -106,3 +106,83 @@ def test_mul_wide_parity():
     got = limbs.limbs_to_ints(np.asarray(limbs.mul_wide(a, b)))
     for i in range(16):
         assert got[i] == xs[i] * ys[i]
+
+
+def test_mul_low_parity():
+    rng = random.Random(6)
+    xs = [0, 1, (1 << 272) - 1] + [rng.randrange(1 << 272) for _ in range(13)]
+    ys = [(1 << 272) - 1, 0, (1 << 272) - 1] + [
+        rng.randrange(1 << 272) for _ in range(13)
+    ]
+    a = np.asarray(limbs.ints_to_limbs(xs, 17))
+    b = np.asarray(limbs.ints_to_limbs(ys, 17))
+    got = limbs.limbs_to_ints(np.asarray(limbs.mul_low(a, b, 17)))
+    for i in range(len(xs)):
+        assert got[i] == (xs[i] * ys[i]) % (1 << 272), i
+
+
+BN_P = 21888242871839275222246405745257275088696311157297823662689037894645226208583
+
+
+@pytest.mark.parametrize("m", [BN_P, P, N])
+def test_mont_ops_parity(m):
+    """MontMod keeps exact parity mod m with plain-int math: elements in
+    Montgomery form x·R, REDC-based mul/sqr, inherited add/sub/canon."""
+    rng = random.Random(4321 + m % 89)
+    ctx = limbs.mont_ctx(m)
+    r = ctx.r
+    vals_a = [0, 1, m - 1, m // 3] + [rng.randrange(m) for _ in range(28)]
+    vals_b = [m - 1, 1, 0, m // 7] + [rng.randrange(m) for _ in range(28)]
+    a = np.asarray(limbs.ints_to_limbs([ctx.to_mont_int(x) for x in vals_a]))
+    b = np.asarray(limbs.ints_to_limbs([ctx.to_mont_int(x) for x in vals_b]))
+
+    got_mul = limbs.limbs_to_ints(np.asarray(ctx.mul(a, b)))
+    got_sqr = limbs.limbs_to_ints(np.asarray(ctx.sqr(a)))
+    got_add = limbs.limbs_to_ints(np.asarray(ctx.add(a, b)))
+    got_sub = limbs.limbs_to_ints(np.asarray(ctx.sub(a, b)))
+    got_k3 = limbs.limbs_to_ints(np.asarray(ctx.mul_const(a, 3)))
+    got_canon = limbs.limbs_to_ints(np.asarray(ctx.canon(a)))
+    got_plain = limbs.limbs_to_ints(np.asarray(ctx.from_mont(a)))
+
+    for i, (x, y) in enumerate(zip(vals_a, vals_b)):
+        assert got_mul[i] % m == (x * y) % m * r % m, ("mul", i)
+        assert got_mul[i] < 2 * m, ("mul bound", i)
+        assert got_sqr[i] % m == (x * x) % m * r % m, ("sqr", i)
+        assert got_add[i] % m == (x + y) % m * r % m, ("add", i)
+        assert got_add[i] < 1 << 257, ("add bound", i)
+        assert got_sub[i] % m == (x - y) % m * r % m, ("sub", i)
+        assert got_k3[i] % m == 3 * x % m * r % m, ("k3", i)
+        assert got_canon[i] == x * r % m, ("canon", i)
+        assert got_plain[i] % m == x, ("from_mont", i)
+        assert ctx.from_mont_int(got_canon[i]) == x, ("from_mont_int", i)
+
+
+def test_mont_chain_stress():
+    """Interleaved Montgomery op chains keep parity and the invariant."""
+    m = BN_P
+    rng = random.Random(88)
+    ctx = limbs.mont_ctx(m)
+    vals = [rng.randrange(m) for _ in range(8)]
+    dev = np.asarray(limbs.ints_to_limbs([ctx.to_mont_int(x) for x in vals]))
+    ref = list(vals)
+    for step in range(48):
+        op = rng.choice(["add", "sub", "mul", "sqr"])
+        j = rng.randrange(8)
+        other = np.roll(dev, j, axis=0)
+        ref_other = ref[-j:] + ref[:-j]
+        if op == "add":
+            dev = np.asarray(ctx.add(dev, other))
+            ref = [(x + y) % m for x, y in zip(ref, ref_other)]
+        elif op == "sub":
+            dev = np.asarray(ctx.sub(dev, other))
+            ref = [(x - y) % m for x, y in zip(ref, ref_other)]
+        elif op == "mul":
+            dev = np.asarray(ctx.mul(dev, other))
+            ref = [(x * y) % m for x, y in zip(ref, ref_other)]
+        else:
+            dev = np.asarray(ctx.sqr(dev))
+            ref = [(x * x) % m for x in ref]
+        got = limbs.limbs_to_ints(dev)
+        for i in range(8):
+            assert got[i] < 1 << 257, (step, op, i)
+            assert got[i] % m == ref[i] * ctx.r % m, (step, op, i)
