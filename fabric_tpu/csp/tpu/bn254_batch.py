@@ -28,6 +28,7 @@ are Jacobian, normalized on host with one batched inversion.
 from __future__ import annotations
 
 import functools
+import os
 
 import numpy as np
 
@@ -48,6 +49,22 @@ _MAX_LANES = _BUCKETS[-1]
 
 # per-lane scalar slots, fixed order
 _LANE_BASES = ("a_prime", "a_bar", "b_prime", "nym")
+
+# set on the first Pallas failure so later batches skip straight to the
+# XLA engine instead of re-packing + re-failing + re-warning each time
+_PALLAS_BROKEN = [False]
+
+
+def _pallas_preferred() -> bool:
+    """Use the Pallas engine only where it runs compiled: on the TPU
+    backend (or when a test forces it — interpret mode executes the
+    grid in Python and would be far slower than the XLA fallback it
+    preempts on CPU/GPU hosts)."""
+    if _PALLAS_BROKEN[0] or os.environ.get("FABRIC_BN254_NO_PALLAS"):
+        return False
+    if os.environ.get("FABRIC_BN254_FORCE_PALLAS"):
+        return True
+    return jax.default_backend() == "tpu"
 
 
 def _fp():
@@ -70,15 +87,25 @@ def _recode(u: int) -> np.ndarray:
 
 
 @functools.lru_cache(maxsize=8)
+def shared_multiples(ipk_key: tuple) -> tuple:
+    """k*P for k in 0..15 per shared base (None = infinity): the raw
+    host scalar multiplications both device engines derive their window
+    tables from (one cache, not one per engine).  ipk_key is the
+    hashable ((x, y), ...) tuple of (G1, h_sk, h_rand, *h_attrs)."""
+    return tuple(
+        tuple(bn.g1_mul(pt, k) if k else None for k in range(TABLE))
+        for pt in ipk_key
+    )
+
+
+@functools.lru_cache(maxsize=8)
 def shared_tables(ipk_key: tuple) -> dict:
     """Affine 4-bit window tables (16 multiples) for the issuer key's
-    fixed bases, computed once on host ints.  ipk_key is the hashable
-    ((x, y), ...) tuple of (G1, h_sk, h_rand, *h_attrs)."""
+    fixed bases in the XLA engine's limb layout."""
     tabs_x, tabs_y, tabs_inf = [], [], []
-    for pt in ipk_key:
+    for row in shared_multiples(ipk_key):
         xs, ys, infs = [], [], []
-        for k in range(TABLE):
-            q = bn.g1_mul(pt, k) if k else None
+        for q in row:
             if q is None:
                 xs.append(_to_limbs(0))
                 ys.append(_to_limbs(0))
@@ -256,7 +283,6 @@ def schnorr_commitments_batch(sigs, ipk) -> list | None:
     n_attrs = len(ipk.h_attrs)
     shared_pts = (bn.G1_GEN, ipk.h_sk, ipk.h_rand, *ipk.h_attrs)
     n_shared = len(shared_pts)
-    tabs = shared_tables(tuple(shared_pts))
     # unified term layout: (table index, accumulator).  Shared tables
     # occupy indices 0..n_shared-1 of the kernel's table stack, the 4
     # per-lane bases (_LANE_BASES order) follow at n_shared+0..3.
@@ -269,14 +295,70 @@ def schnorr_commitments_batch(sigs, ipk) -> list | None:
         1, 2, n_shared + 3,
     )
     term_acc = (0, 0, 0, 0, 1, 1, 1, *([1] * n_attrs), 1, 2, 2, 2)
-    n_terms = len(term_table)
 
-    lane_x = np.zeros((4, n, WIDE), np.uint32)
-    lane_y = np.zeros((4, n, WIDE), np.uint32)
-    lane_inf = np.zeros((4, n), bool)
-    digits = np.zeros((n_terms, n, NWINDOWS), np.int32)
-    ok = [True] * n
+    pts_l, scalars_l, ok = _prepare_sigs(sigs, ipk, n_attrs)
 
+    # preferred engine: the fused Pallas ladder (VMEM-resident Montgomery
+    # field ops, pallas_bn254.py); the XLA scan kernel is the fallback
+    # when Mosaic is unavailable or fails
+    jac = None
+    if _pallas_preferred():
+        try:
+            from fabric_tpu.csp.tpu import pallas_bn254
+
+            jac = pallas_bn254.commitments(
+                pts_l, scalars_l, ok, term_table, term_acc, shared_pts
+            )
+        except Exception as exc:
+            from fabric_tpu.common.flogging import must_get_logger
+
+            _PALLAS_BROKEN[0] = True  # don't re-pack + re-fail per batch
+            must_get_logger("bn254").warning(
+                "pallas BN254 ladder failed (%s: %s); using the XLA path "
+                "for the rest of this process",
+                type(exc).__name__, exc,
+            )
+            jac = None
+    if jac is None:
+        jac = _commitments_xla(
+            pts_l, scalars_l, ok, term_table, term_acc, shared_pts
+        )
+
+    # Jacobian -> affine with ONE batched modular inversion (host ints)
+    zs, metas = [], []
+    results: list = [None] * n
+    for j in range(n):
+        if not ok[j]:
+            continue
+        tri = jac[j]
+        metas.append((j, tri))
+        for (_, _, zv, inf) in tri:
+            zs.append(1 if (inf or zv == 0) else zv)
+    if metas:
+        invs = _batch_inverse(zs, bn.P)
+        k = 0
+        for j, tri in metas:
+            pts = []
+            for (x, y, zv, inf) in tri:
+                if inf or zv == 0:
+                    pts.append(None)
+                else:
+                    zi = invs[k]
+                    zi2 = zi * zi % bn.P
+                    pts.append((x * zi2 % bn.P, y * zi2 * zi % bn.P))
+                k += 1
+            results[j] = tuple(pts)
+    return results
+
+
+def _prepare_sigs(sigs, ipk, n_attrs):
+    """Shared host prep for both device engines: per sig the 4 lane
+    base points, the n_terms scalars (term order matching term_table),
+    and validity.  Bad sigs get ok=False (the engines run them with
+    zero scalars / infinity bases and the caller marks them failed)."""
+    pts_l: list = []
+    scalars_l: list = []
+    ok = [True] * len(sigs)
     for j, sig in enumerate(sigs):
         try:
             pts = (sig.a_prime, sig.a_bar, sig.b_prime, sig.nym)
@@ -284,9 +366,6 @@ def schnorr_commitments_batch(sigs, ipk) -> list | None:
                 raise ValueError("bad point")
             if len(sig.disclosure) != n_attrs:
                 raise ValueError("bad disclosure length")
-            for i, p in enumerate(pts):
-                lane_x[i, j] = _to_limbs(p[0])
-                lane_y[i, j] = _to_limbs(p[1])
             c = sig.challenge % bn.R
             z = sig.responses
             hidden = [i for i, d in enumerate(sig.disclosure) if not d]
@@ -319,11 +398,37 @@ def schnorr_commitments_batch(sigs, ipk) -> list | None:
                 z["r_nym"] % bn.R,      # h_rand
                 (-c) % bn.R,            # nym
             ]
-            for t, u in enumerate(scalars):
-                digits[t, j] = _recode(u)
+            pts_l.append(pts)
+            scalars_l.append(scalars)
         except (ValueError, IndexError, KeyError, TypeError,
                 OverflowError, AttributeError):
             ok[j] = False  # zero scalars: lane computes but is ignored
+            pts_l.append((None,) * 4)
+            scalars_l.append(None)
+    return pts_l, scalars_l, ok
+
+
+def _commitments_xla(pts_l, scalars_l, ok, term_table, term_acc,
+                     shared_pts):
+    """The XLA scan-kernel engine: returns per-sig [(x, y, z, inf)] * 3
+    Jacobian ints in plain (non-Montgomery) form."""
+    n = len(pts_l)
+    n_terms = len(term_table)
+    tabs = shared_tables(tuple(shared_pts))
+
+    lane_x = np.zeros((4, n, WIDE), np.uint32)
+    lane_y = np.zeros((4, n, WIDE), np.uint32)
+    lane_inf = np.zeros((4, n), bool)
+    digits = np.zeros((n_terms, n, NWINDOWS), np.int32)
+    for j in range(n):
+        if not ok[j]:
+            lane_inf[:, j] = True
+            continue
+        for i, p in enumerate(pts_l[j]):
+            lane_x[i, j] = _to_limbs(p[0])
+            lane_y[i, j] = _to_limbs(p[1])
+        for t, u in enumerate(scalars_l[j]):
+            digits[t, j] = _recode(u)
 
     # pad lanes to a bucket size so each (bucket, n_attrs) pair compiles
     # once; padded lanes carry zero scalars (every digit selects the
@@ -357,39 +462,21 @@ def schnorr_commitments_batch(sigs, ipk) -> list | None:
         jnp.asarray(term_acc, jnp.int32),
     )
     ax, ay, az, ainf = (np.asarray(o) for o in (ax, ay, az, ainf))
-
-    # Jacobian -> affine with ONE batched modular inversion (host ints)
-    zs, metas = [], []
-    results: list = [None] * n
+    fp = _fp()
+    jac = []
     for j in range(n):
         if not ok[j]:
+            jac.append(None)
             continue
         tri = []
-        fp = _fp()
         for t in range(3):
             x = fp.from_mont_int(limbs.limbs_to_int(ax[t, j]))
             y = fp.from_mont_int(limbs.limbs_to_int(ay[t, j]))
             zv = fp.from_mont_int(limbs.limbs_to_int(az[t, j]))
             inf = bool(ainf[t, j])
             tri.append((x, y, zv, inf))
-        metas.append((j, tri))
-        for (_, _, zv, inf) in tri:
-            zs.append(1 if (inf or zv == 0) else zv)
-    if metas:
-        invs = _batch_inverse(zs, bn.P)
-        k = 0
-        for j, tri in metas:
-            pts = []
-            for (x, y, zv, inf) in tri:
-                if inf or zv == 0:
-                    pts.append(None)
-                else:
-                    zi = invs[k]
-                    zi2 = zi * zi % bn.P
-                    pts.append((x * zi2 % bn.P, y * zi2 * zi % bn.P))
-                k += 1
-            results[j] = tuple(pts)
-    return results
+        jac.append(tri)
+    return jac
 
 
 def _batch_inverse(vals: list[int], m: int) -> list[int]:

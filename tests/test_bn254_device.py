@@ -11,6 +11,14 @@ from __future__ import annotations
 import pytest
 
 from fabric_tpu.idemix import bn254 as bn
+
+
+@pytest.fixture(autouse=True)
+def _pin_xla_engine(monkeypatch):
+    """This module tests the XLA scan engine; the fused Pallas ladder
+    (now the preferred engine) has its own parity suite in
+    tests/test_pallas_bn254.py."""
+    monkeypatch.setenv("FABRIC_BN254_NO_PALLAS", "1")
 from fabric_tpu.idemix import schnorr, signature
 from fabric_tpu.idemix.credential import new_cred_request, new_credential
 from fabric_tpu.idemix.issuer import IssuerKey
