@@ -53,13 +53,16 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from fabric_tpu.csp.tpu import limbs
+from fabric_tpu.csp.tpu import bn254_batch as _xla_engine
 from fabric_tpu.csp.tpu.limbs import LIMB_BITS, MASK, WIDE, int_to_limbs
 from fabric_tpu.idemix import bn254 as bn
 
 BLK = 128  # lanes (signatures) per grid block
-NWINDOWS = 64
-TABLE = 16
-N_LANE_BASES = 4  # a_prime, a_bar, b_prime, nym
+# window geometry and lane-base order are the XLA engine's — the two
+# engines must agree bit-for-bit on the digit recoding and term layout
+NWINDOWS = _xla_engine.NWINDOWS
+TABLE = _xla_engine.TABLE
+N_LANE_BASES = len(_xla_engine._LANE_BASES)  # a', a_bar, b', nym
 
 
 @functools.lru_cache(maxsize=None)

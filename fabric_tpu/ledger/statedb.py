@@ -316,18 +316,28 @@ class VersionedDB:
         puts: dict[bytes, bytes] = {}
         deletes: list[bytes] = []
         self._index_mutations(batch, puts, deletes)  # reads OLD state
+        # re-read the meta-ns set from the store (not the read cache):
+        # the persisted key below must MERGE with flags an out-of-band
+        # writer (a second VersionedDB over this store) may have added
+        # since we last loaded — rewriting a stale cached set would
+        # un-flag their namespaces and silently skip SBE checks
+        self._meta_ns = None
         meta_ns = self._load_meta_ns()
-        meta_dirty = False
         for ns, kvs in batch.items():
             for key, vv in kvs.items():
                 if vv is None:
                     deletes.append(_state_key(ns, key))
                 else:
                     puts[_state_key(ns, key)] = _encode_value(vv)
-                    if vv.metadata and meta_ns is not True and ns not in meta_ns:
+                    if vv.metadata and meta_ns is not True:
                         meta_ns.add(ns)
-                        meta_dirty = True
-        if meta_dirty:
+        if meta_ns is not True:
+            # ALWAYS persisted (even when empty): a store this code has
+            # committed to must carry the key, otherwise the next
+            # _load_meta_ns would see savepoint-without-key and flip to
+            # the permanently-conservative legacy mode — which disabled
+            # the per-tx key-level-endorsement fast path for every
+            # ledger right after its genesis commit
             puts[_META_NS_KEY] = json.dumps(sorted(meta_ns)).encode()
         if height is not None:
             puts[_SAVEPOINT_KEY] = height.pack()

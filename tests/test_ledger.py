@@ -113,6 +113,46 @@ def test_statedb_versions():
     assert db.get_state("cc", "a") is None
 
 
+def test_statedb_meta_ns_stays_empty_after_plain_commits(tmp_path):
+    """The metadata-namespace fast path must survive commits: a store
+    this code has committed to always carries the (possibly empty)
+    meta-ns key, so re-loading after apply_updates never mistakes it
+    for a legacy DB with unknown history (which would permanently
+    disable the per-tx key-level-endorsement skip right after
+    genesis)."""
+    from fabric_tpu.ledger.kvstore import SqliteKVStore
+
+    db = VersionedDB(SqliteKVStore(str(tmp_path / "state.db")))
+    h1 = Height(1, 0)
+    db.apply_updates({"cc": {"a": VersionedValue(b"v", h1)}}, h1)
+    assert db.may_have_metadata("cc") is False  # not True-conservative
+    # a reopened store over the same files stays exact too
+    db2 = VersionedDB(SqliteKVStore(str(tmp_path / "state.db")))
+    assert db2.may_have_metadata("cc") is False
+    # writing metadata flags exactly that namespace, durably
+    h2 = Height(2, 0)
+    db.apply_updates(
+        {"cc2": {"k": VersionedValue(b"v", h2, metadata=b"m")}}, h2
+    )
+    assert db.may_have_metadata("cc2") is True
+    assert db.may_have_metadata("cc") is False
+    db3 = VersionedDB(SqliteKVStore(str(tmp_path / "state.db")))
+    assert db3.may_have_metadata("cc2") is True
+    assert db3.may_have_metadata("cc") is False
+    # out-of-band merge: db3 has cached its set; db writes metadata to a
+    # NEW namespace through the same store; db3's next (plain) commit
+    # must not un-flag it (the persisted key merges with the store, not
+    # with db3's stale cache)
+    h3 = Height(3, 0)
+    db.apply_updates(
+        {"cc3": {"k": VersionedValue(b"v", h3, metadata=b"m")}}, h3
+    )
+    h4 = Height(4, 0)
+    db3.apply_updates({"cc": {"b": VersionedValue(b"v", h4)}}, h4)
+    db4 = VersionedDB(SqliteKVStore(str(tmp_path / "state.db")))
+    assert db4.may_have_metadata("cc3") is True
+
+
 def _sim_rwset(db, reads=(), writes=(), ranges=()):
     sim = TxSimulator(db)
     for ns, k in reads:
