@@ -47,8 +47,10 @@ TABLE = 16
 _BUCKETS = (16, 64, 256, 1024)
 _MAX_LANES = _BUCKETS[-1]
 
-# per-lane scalar slots, fixed order
-_LANE_BASES = ("a_prime", "a_bar", "b_prime", "nym")
+# per-lane scalar slots, fixed order (public: the Pallas engine keys
+# its lane layout off this tuple)
+LANE_BASES = ("a_prime", "a_bar", "b_prime", "nym")
+_LANE_BASES = LANE_BASES  # backwards-compatible alias
 
 # set on the first Pallas failure so later batches skip straight to the
 # XLA engine instead of re-packing + re-failing + re-warning each time

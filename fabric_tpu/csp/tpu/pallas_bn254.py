@@ -62,7 +62,7 @@ BLK = 128  # lanes (signatures) per grid block
 # engines must agree bit-for-bit on the digit recoding and term layout
 NWINDOWS = _xla_engine.NWINDOWS
 TABLE = _xla_engine.TABLE
-N_LANE_BASES = len(_xla_engine._LANE_BASES)  # a', a_bar, b', nym
+N_LANE_BASES = len(_xla_engine.LANE_BASES)  # a', a_bar, b', nym
 
 
 @functools.lru_cache(maxsize=None)

@@ -210,12 +210,21 @@ bool read_varint(const u8*& p, const u8* end, u64* v) {
 // Scan a message, filling `fields[num] = last occurrence` for
 // length-delimited fields and `varints[num]` for varint fields
 // (numbers above `maxf` are skipped).  Returns false on malformed wire.
+// Largest legal protobuf field number (2^29 - 1); python's decoder
+// rejects tags beyond it and field number 0, so the walker must too —
+// and the bound is what keeps `num` a safe array index below (a huge
+// tag varint truncated through int() would otherwise go NEGATIVE and
+// index out of bounds: found by the envelope fuzzer).
+const u64 MAX_FIELD = 536870911u;
+
 bool scan(const u8* p, size_t n, int maxf, Slice* fields, u64* varints) {
   const u8* end = p + n;
   while (p < end) {
     u64 tag;
     if (!read_varint(p, end, &tag)) return false;
-    int num = int(tag >> 3);
+    u64 fnum = tag >> 3;
+    if (fnum == 0 || fnum > MAX_FIELD) return false;
+    int num = int(fnum);
     int wt = int(tag & 7);
     if (wt == 0) {
       u64 v;
@@ -246,7 +255,58 @@ bool scan(const u8* p, size_t n, int maxf, Slice* fields, u64* varints) {
 
 const char HEX[] = "0123456789abcdef";
 
-// Status codes (mapped to TxValidationCode in Python glue).
+// Strict UTF-8 validation (rejects overlongs, surrogates, > U+10FFFF)
+// — the same acceptance set as python's protobuf string decoding.
+// Proto3 `string` fields python PARSES must be checked here: a field
+// the walker treats as raw bytes but python rejects as invalid UTF-8
+// would otherwise flag differently across the two engines (or, worse,
+// crash the glue's .decode()).
+bool utf8_valid(const u8* p, size_t n) {
+  size_t i = 0;
+  while (i < n) {
+    u8 c = p[i];
+    if (c < 0x80) {
+      i++;
+      continue;
+    }
+    int len;
+    u32 cp, min;
+    if ((c & 0xe0) == 0xc0) {
+      len = 2; cp = c & 0x1f; min = 0x80;
+    } else if ((c & 0xf0) == 0xe0) {
+      len = 3; cp = c & 0x0f; min = 0x800;
+    } else if ((c & 0xf8) == 0xf0) {
+      len = 4; cp = c & 0x07; min = 0x10000;
+    } else {
+      return false;
+    }
+    if (i + size_t(len) > n) return false;
+    for (int k = 1; k < len; ++k) {
+      if ((p[i + k] & 0xc0) != 0x80) return false;
+      cp = (cp << 6) | (p[i + k] & 0x3f);
+    }
+    if (cp < min || cp > 0x10FFFF) return false;
+    if (cp >= 0xD800 && cp <= 0xDFFF) return false;
+    i += size_t(len);
+  }
+  return true;
+}
+
+// Fields 1..3 of a submessage are all proto strings (ChaincodeID
+// path/name/version; ChaincodeEvent chaincode_id/tx_id/event_name).
+bool strings_1to3_valid(const Slice* f) {
+  for (int k = 1; k <= 3; ++k) {
+    if (f[k].set && !utf8_valid(f[k].p, f[k].n)) return false;
+  }
+  return true;
+}
+
+// Status codes.  The glue treats EVERY negative status identically —
+// the lane re-runs the canonical pure-python collector, which picks
+// the TxValidationCode (engine parity by construction; see
+// txvalidator._collect_native).  The distinct negative codes exist for
+// debugging and the fuzzer's known-set assertion only; 0/1 are the
+// codes that matter (fully-validated endorser/config tx).
 enum {
   OK_ENDORSER = 0,
   OK_CONFIG = 1,
@@ -310,6 +370,10 @@ int fabric_collect_block(
     Slice cf[8];
     u64 cv[8] = {0};
     if (!scan(chdr.p, chdr.n, 7, cf, cv)) continue;
+    // timestamp (field 3) is a Timestamp SUBMESSAGE python parses
+    // recursively; an opaque-blob pass here would accept garbage
+    // python rejects (accept-side engine divergence)
+    if (cf[3].set && !scan(cf[3].p, cf[3].n, 0, nullptr, nullptr)) continue;
     Slice sf[3];
     if (!scan(shdr.p, shdr.n, 2, sf, nullptr)) continue;
 
@@ -353,21 +417,31 @@ int fabric_collect_block(
     }
 
     // Transaction -> FIRST action (python validates tx.actions[0];
-    // scan() keeps the last occurrence, so walk manually)
+    // scan() keeps the last occurrence, so walk manually).  The walk
+    // continues to the END of the message even after actions[0] is
+    // found: python's Transaction.FromString wire-validates every
+    // trailing action (and any other field), so stopping early would
+    // accept envelopes python rejects.
     if (!pf[2].set) { status[i] = E_NIL_TXACTION; continue; }
     Slice action0;
     {
       const u8* p = pf[2].p;
       const u8* end = p + pf[2].n;
       bool bad = false;
-      while (p < end && !action0.set) {
+      while (p < end) {
         u64 tag;
         if (!read_varint(p, end, &tag)) { bad = true; break; }
+        if ((tag >> 3) == 0 || (tag >> 3) > MAX_FIELD) { bad = true; break; }
         int wt = int(tag & 7);
         if (wt == 2) {
           u64 l;
           if (!read_varint(p, end, &l) || l > size_t(end - p)) { bad = true; break; }
-          if (int(tag >> 3) == 1) { action0.p = p; action0.n = size_t(l); action0.set = true; }
+          if ((tag >> 3) == 1) {
+            // every TransactionAction submessage must be wire-valid
+            // (python parses them all, even past actions[0])
+            if (!scan(p, size_t(l), 0, nullptr, nullptr)) { bad = true; break; }
+            if (!action0.set) { action0.p = p; action0.n = size_t(l); action0.set = true; }
+          }
           p += l;
         } else if (wt == 0) {
           u64 v;
@@ -412,6 +486,10 @@ int fabric_collect_block(
           const u8* field_start = p;
           u64 tag;
           if (!read_varint(p, end, &tag)) { canonical = false; break; }
+          if ((tag >> 3) == 0 || (tag >> 3) > MAX_FIELD) {
+            canonical = false;
+            break;
+          }
           int num = int(tag >> 3);
           int wt = int(tag & 7);
           if (wt != 2 || num <= last_num) { canonical = false; break; }
@@ -451,6 +529,7 @@ int fabric_collect_block(
       while (p < end) {
         u64 tag;
         if (!read_varint(p, end, &tag)) { ok = false; break; }
+        if ((tag >> 3) == 0 || (tag >> 3) > MAX_FIELD) { ok = false; break; }
         int num = int(tag >> 3);
         int wt = int(tag & 7);
         if (wt != 2) { ok = false; break; }
@@ -492,9 +571,16 @@ int fabric_collect_block(
       endo_count[i] = 0;
       continue;
     }
-    Slice hccf[3];
-    if (hef[2].set && !scan(hef[2].p, hef[2].n, 2, hccf, nullptr)) {
+    Slice hccf[4];
+    if (hef[2].set && !scan(hef[2].p, hef[2].n, 3, hccf, nullptr)) {
       status[i] = E_BAD_HEADER_EXTENSION;
+      endo_count[i] = 0;
+      continue;
+    }
+    if (!strings_1to3_valid(hccf)) {
+      // python rejects the whole hdr_ext parse on invalid UTF-8; let
+      // the python collector pick the exact flag
+      status[i] = E_PY_FALLBACK;
       endo_count[i] = 0;
       continue;
     }
@@ -503,21 +589,50 @@ int fabric_collect_block(
       endo_count[i] = 0;
       continue;
     }
-    const Slice ccid = hccf[2];
+    const Slice ccid = hccf[2];  // UTF-8 already vetted just above
     {
-      Slice accf[3];
-      if (!af[4].set || !scan(af[4].p, af[4].n, 2, accf, nullptr) ||
-          !accf[2].set || accf[2].n != ccid.n ||
+      Slice accf[4];
+      if (!af[4].set || !scan(af[4].p, af[4].n, 3, accf, nullptr) ||
+          !strings_1to3_valid(accf)) {
+        status[i] = af[4].set ? E_PY_FALLBACK : E_INVALID_CHAINCODE;
+        endo_count[i] = 0;
+        continue;
+      }
+      if (!accf[2].set || accf[2].n != ccid.n ||
           memcmp(accf[2].p, ccid.p, ccid.n) != 0) {
         status[i] = E_INVALID_CHAINCODE;
         endo_count[i] = 0;
         continue;
       }
     }
+    // ChaincodeAction.response (field 3) is a Response{status=1,
+    // message=2(string), payload=3}: python's ChaincodeAction parse
+    // validates message's UTF-8
+    if (af[3].set && af[3].n) {
+      Slice rf[3];
+      if (!scan(af[3].p, af[3].n, 2, rf, nullptr) ||
+          (rf[2].set && !utf8_valid(rf[2].p, rf[2].n))) {
+        status[i] = E_PY_FALLBACK;
+        endo_count[i] = 0;
+        continue;
+      }
+    }
     if (af[2].set && af[2].n) {  // chaincode event must name the chaincode
-      Slice evf[2];
-      if (!scan(af[2].p, af[2].n, 1, evf, nullptr) || !evf[1].set ||
-          evf[1].n != ccid.n || memcmp(evf[1].p, ccid.p, ccid.n) != 0) {
+      // ChaincodeEvent{chaincode_id=1, tx_id=2, event_name=3, payload=4}
+      // — three proto strings python's parse validates
+      Slice evf[4];
+      if (!scan(af[2].p, af[2].n, 3, evf, nullptr)) {
+        status[i] = E_INVALID_OTHER;
+        endo_count[i] = 0;
+        continue;
+      }
+      if (!strings_1to3_valid(evf)) {  // fields 1..3 are all strings
+        status[i] = E_PY_FALLBACK;
+        endo_count[i] = 0;
+        continue;
+      }
+      if (!evf[1].set || evf[1].n != ccid.n ||
+          memcmp(evf[1].p, ccid.p, ccid.n) != 0) {
         status[i] = E_INVALID_OTHER;
         endo_count[i] = 0;
         continue;

@@ -397,35 +397,26 @@ class TxValidator:
         )
         return block, flags, works, collect, envs
 
-    # C++ status codes (collect.cc) -> TxValidationCode, for the stages
-    # BEFORE creator validation (parse/header failures).
-    _NATIVE_EARLY = {
-        -1: V.NIL_ENVELOPE,
-        -2: V.BAD_PAYLOAD,
-        -3: V.BAD_COMMON_HEADER,
-        -4: V.BAD_CHANNEL_HEADER,
-    }
-    # ... and for the stages AFTER it (the glue re-runs the creator
-    # check first, preserving the reference's flag precedence).
-    _NATIVE_LATE = {
-        -5: V.BAD_PROPOSAL_TXID,
-        -6: V.BAD_RESPONSE_PAYLOAD,
-        -7: V.ENDORSEMENT_POLICY_FAILURE,
-        -8: V.UNKNOWN_TX_TYPE,
-        -9: V.BAD_HEADER_EXTENSION,
-        -10: V.INVALID_CHAINCODE,
-        -11: V.INVALID_OTHER_REASON,
-        -13: V.NIL_TXACTION,
-    }
-
     def _collect_native(self, data, seen_txids, sink: _ItemSink, works, flags, memo: dict) -> bool:
         """Native-assisted collect: one C++ pass walks every envelope's
         wire format (syntactic checks + SHA-256 digests, collect.cc),
         then this glue does only identity/policy work per tx.  `data` is
         the block's materialized envelope byte list.  Returns False when
         the native library is unavailable (caller runs the pure-Python
-        path); individual txs the C++ pass cannot decide (status -12)
-        fall back to Python per tx."""
+        path).
+
+        EVERY lane the walker does not declare fully well-formed
+        (status < 0) re-runs the pure-Python collector for that tx.
+        Validation flags are consensus state, and the walker's
+        strictness can never be byte-for-byte identical to python's
+        protobuf decoder on arbitrary garbage (the envelope fuzzer found
+        a mangled envelope python rejects outright but the walker
+        half-parses, shifting which failure stage — and which flag —
+        fires); deriving all failure flags from the one canonical
+        python path makes the engines agree by construction.  Honest
+        blocks contain no malformed envelopes, so the fallback costs
+        nothing on the hot path, and an adversarial block degrades to
+        at worst the pure-python engine's cost."""
         from fabric_tpu import native
         from fabric_tpu.csp.api import VerifyBatchItem
 
@@ -486,18 +477,10 @@ class TxValidator:
 
         for i in range(len(data)):
             st = status_l[i]
-            if st == -12:  # python fallback for this tx
+            if st < 0:  # python re-derives every non-valid lane
                 flags[i] = self._collect_tx(
                     data[i], seen_txids, sink, works[i], memo
                 )
-                continue
-            if st in self._NATIVE_EARLY and not (
-                st == -2 and creator_len_l[i]
-            ):
-                # st == -2 with a creator present is a DEEP parse failure
-                # (tx/cap/prp wire) — those flow through the creator and
-                # dup-txid stages below, matching the reference's order.
-                flags[i] = self._NATIVE_EARLY[st]
                 continue
             # creator deserialize + validate (reference flag precedence:
             # BAD_CREATOR_SIGNATURE wins over later-stage failures)
@@ -517,28 +500,30 @@ class TxValidator:
             if st == 1:  # CONFIG tx: creator signature only
                 flags[i] = V.VALID
                 continue
-            if st in (-8, -5):  # checks that precede the dup-txid stage
-                flags[i] = self._NATIVE_LATE[st]
+
+            try:
+                # C++ pre-validates both as UTF-8 (64-hex txid; the
+                # chaincode-id string check in collect.cc), so this is
+                # defense in depth — and it must run BEFORE the txid
+                # registers, so a fallback lane replays through
+                # _collect_tx without colliding with itself
+                txid = sl(txid_off_l[i], txid_len_l[i]).decode()
+                cc_id = sl(ccid_off_l[i], ccid_len_l[i]).decode()
+            except UnicodeDecodeError:
+                flags[i] = self._collect_tx(
+                    data[i], seen_txids, sink, works[i], memo
+                )
                 continue
 
             # dup-txid stage: the txid registers even when a LATER check
             # fails (the reference adds to the dedup set right here too)
-            txid = sl(txid_off_l[i], txid_len_l[i]).decode()
             w.txid = txid
             if txid in seen_txids or txid_known(txid):
                 flags[i] = V.DUPLICATE_TXID
                 continue
             seen_txids.add(txid)
 
-            if st in self._NATIVE_LATE:  # post-dup-stage failures
-                flags[i] = self._NATIVE_LATE[st]
-                continue
-            if st == -2:  # deep parse failure (tx/cap/prp wire)
-                flags[i] = V.BAD_PAYLOAD
-                continue
-
             prp_bytes = sl(prp_off_l[i], prp_len_l[i])
-            cc_id = sl(ccid_off_l[i], ccid_len_l[i]).decode()
             rwset_bytes = sl(rwset_off_l[i], rwset_len_l[i])
             es, ec = endo_start_l[i], endo_count_l[i]
             signed = [

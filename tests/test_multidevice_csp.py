@@ -64,3 +64,58 @@ def test_async_coalesced_multidevice(items):
     m = c1() + c2()
     assert m[13] is False and sum(m) == len(items) - 1
     assert len(csp.last_dispatch_devices) >= 2
+
+
+def test_concurrent_submitters_stress(items):
+    """Race-detector stand-in for the coalescer (SURVEY.md §5): many
+    threads concurrently submit overlapping async batches of random
+    sizes against ONE provider and collect in random order.  Every
+    caller must get exactly its own mask — the historical bug classes
+    here were double-consumed chunk collectors and double-materialized
+    flushes (commits de34221, ef06d45), both only visible under
+    contention.  Seeded, so failures reproduce."""
+    import random
+    import threading
+
+    rng = random.Random(4242)
+    csp = TPUCSP(min_device_batch=1, max_chunk=128, coalesce_lanes=8)
+    jobs = []  # (start, size) into the 700-item pool; expected via index
+    for _ in range(24):
+        # a few odd sizes (not a new compile per job): padding and
+        # coalescing still vary per flush, which is what races
+        size = rng.choice((5, 17, 33))
+        start = rng.randrange(0, len(items) - size)
+        jobs.append((start, size))
+    results: list = [None] * len(jobs)
+    errors: list = []
+    barrier = threading.Barrier(8)
+
+    def worker(w):
+        try:
+            barrier.wait()
+            for j in range(w, len(jobs), 8):
+                start, size = jobs[j]
+                collect = csp.verify_batch_async(
+                    items[start:start + size]
+                )
+                if j % 3 == 0:  # some collect immediately, some defer
+                    results[j] = collect()
+                else:
+                    results[j] = ("defer", collect)
+        except Exception as e:  # pragma: no cover - failure path
+            errors.append(e)
+
+    threads = [
+        threading.Thread(target=worker, args=(w,)) for w in range(8)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    for j, r in enumerate(results):
+        if isinstance(r, tuple) and r and r[0] == "defer":
+            results[j] = r[1]()
+    for j, (start, size) in enumerate(jobs):
+        want = [i != 13 for i in range(start, start + size)]
+        assert results[j] == want, (j, start, size)
