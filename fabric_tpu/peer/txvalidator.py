@@ -238,12 +238,19 @@ class TxValidator:
             action = proposal_pb2.ChaincodeAction.FromString(prp.extension)
         except Exception:
             return V.BAD_PAYLOAD
-        # proposal-hash binding: endorsers signed over this exact proposal
-        want = protoutil.proposal_hash(
-            payload.header.channel_header,
-            payload.header.signature_header,
-            cap.chaincode_proposal_payload,
-        )
+        # proposal-hash binding: endorsers signed over this exact proposal.
+        # proposal_hash re-parses the ChaincodeProposalPayload (to drop
+        # the TransientMap), so malformed ccpp bytes raise here — guarded,
+        # or one adversarial envelope would abort the whole block's
+        # validation (found by the wire-level envelope fuzzer)
+        try:
+            want = protoutil.proposal_hash(
+                payload.header.channel_header,
+                payload.header.signature_header,
+                cap.chaincode_proposal_payload,
+            )
+        except Exception:
+            return V.BAD_PAYLOAD
         if prp.proposal_hash != want:
             return V.BAD_RESPONSE_PAYLOAD
         if not cap.action.endorsements:

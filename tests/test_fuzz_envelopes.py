@@ -287,6 +287,35 @@ def test_invalid_utf8_string_field_parity(world):
     assert flags_native[1] == V.BAD_PAYLOAD
 
 
+def test_malformed_ccpp_flags_instead_of_raising(world):
+    """Deterministic regression for the wire-fuzzer's second find: the
+    proposal-hash binding re-parses ChaincodeProposalPayload, and
+    garbage ccpp bytes used to raise straight out of validate() —
+    one adversarial envelope aborted the whole block (peer DoS).  Both
+    engines must flag the lane BAD_PAYLOAD and keep going."""
+    org, genesis, bundle, endorser, client, fresh_ledger = world
+    env = common_pb2.Envelope.FromString(_tx_bytes(endorser, client))
+    p = common_pb2.Payload.FromString(env.payload)
+    tx = transaction_pb2.Transaction.FromString(p.data)
+    cap = transaction_pb2.ChaincodeActionPayload.FromString(
+        tx.actions[0].payload
+    )
+    cap.chaincode_proposal_payload = b"\xff\xff\xff"
+    tx.actions[0].payload = cap.SerializeToString()
+    p.data = tx.SerializeToString()
+    pb = p.SerializeToString()
+    mangled = common_pb2.Envelope(
+        payload=pb, signature=client.sign(pb)
+    ).SerializeToString()
+    batch = [_tx_bytes(endorser, client), mangled]
+    for force_py in (False, True):
+        v = TxValidator("fuzzch", fresh_ledger(), bundle, org.csp)
+        if force_py:
+            v._collect_native = lambda *a, **k: False
+        flags = v.validate(_block(list(batch)))
+        assert flags == [V.VALID, V.BAD_PAYLOAD], (force_py, flags)
+
+
 @pytest.mark.skipif(not native.available(), reason="native unavailable")
 def test_fuzz_native_walker_memory_safety(world):
     """The C++ wire walker must survive arbitrary buffers, STRUCTURED
