@@ -468,45 +468,18 @@ int fabric_collect_block(
     if (!scan(prp.p, prp.n, 2, prpf, nullptr)) continue;
     if (!prpf[1].set || !prpf[2].set) continue;
 
-    // proposal-hash binding: sha256(chdr || shdr || ccpp-without-
-    // TransientMap).  The filtered ccpp must match python's
-    // reserialization (ClearField + SerializeToString): true when the
-    // wire holds fields in canonical order with no duplicates — checked
-    // below; anything else falls back to the Python path.
+    // proposal-hash binding: sha256(chdr || shdr || committed ccpp
+    // bytes AS-IS) — the reference's GetProposalHash2 semantics
+    // (protoutil/txutils.go:431, msgvalidation.go:233).  The committed
+    // ccpp is never parsed by either engine, so no canonicalization and
+    // no content validation are needed: any byte difference from the
+    // endorsed preimage (including a smuggled TransientMap) hashes
+    // differently and the lane flags BAD_RESPONSE_PAYLOAD.
     {
       Sha256 s;
       s.update(chdr.p, chdr.n);
       s.update(shdr.p, shdr.n);
-      bool canonical = true;
-      if (ccpp.set && ccpp.n) {
-        const u8* p = ccpp.p;
-        const u8* end = ccpp.p + ccpp.n;
-        int last_num = 0;
-        while (p < end) {
-          const u8* field_start = p;
-          u64 tag;
-          if (!read_varint(p, end, &tag)) { canonical = false; break; }
-          if ((tag >> 3) == 0 || (tag >> 3) > MAX_FIELD) {
-            canonical = false;
-            break;
-          }
-          int num = int(tag >> 3);
-          int wt = int(tag & 7);
-          if (wt != 2 || num <= last_num) { canonical = false; break; }
-          last_num = num;
-          u64 l;
-          if (!read_varint(p, end, &l) || l > size_t(end - p)) {
-            canonical = false;
-            break;
-          }
-          p += l;
-          if (num == 1) s.update(field_start, p - field_start);
-          // num == 2 (TransientMap) is dropped; other fields unknown ->
-          // python would preserve them, we cannot: fall back.
-          if (num > 2) { canonical = false; break; }
-        }
-      }
-      if (!canonical) { status[i] = E_PY_FALLBACK; continue; }
+      if (ccpp.set && ccpp.n) s.update(ccpp.p, ccpp.n);
       u8 want[32];
       s.final(want);
       if (prpf[1].n != 32 || memcmp(prpf[1].p, want, 32) != 0) {

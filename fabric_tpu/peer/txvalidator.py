@@ -239,18 +239,16 @@ class TxValidator:
         except Exception:
             return V.BAD_PAYLOAD
         # proposal-hash binding: endorsers signed over this exact proposal.
-        # proposal_hash re-parses the ChaincodeProposalPayload (to drop
-        # the TransientMap), so malformed ccpp bytes raise here — guarded,
-        # or one adversarial envelope would abort the whole block's
-        # validation (found by the wire-level envelope fuzzer)
-        try:
-            want = protoutil.proposal_hash(
-                payload.header.channel_header,
-                payload.header.signature_header,
-                cap.chaincode_proposal_payload,
-            )
-        except Exception:
-            return V.BAD_PAYLOAD
+        # GetProposalHash2 semantics (reference msgvalidation.go:233,
+        # txutils.go:431): hash the committed ccpp bytes RAW, never
+        # parsing them — a committed payload that still carries transient
+        # data (or any other byte difference from the endorsed preimage)
+        # simply hashes differently -> BAD_RESPONSE_PAYLOAD.
+        want = protoutil.proposal_hash2(
+            payload.header.channel_header,
+            payload.header.signature_header,
+            cap.chaincode_proposal_payload,
+        )
         if prp.proposal_hash != want:
             return V.BAD_RESPONSE_PAYLOAD
         if not cap.action.endorsements:

@@ -37,6 +37,7 @@ from fabric_tpu.protoutil.blocks import (
 from fabric_tpu.protoutil.txs import (
     create_chaincode_proposal,
     proposal_hash,
+    proposal_hash2,
     create_proposal_response,
     create_signed_tx,
     get_action_from_envelope,
@@ -71,6 +72,7 @@ __all__ = [
     "set_tx_filter",
     "create_chaincode_proposal",
     "proposal_hash",
+    "proposal_hash2",
     "create_proposal_response",
     "create_signed_tx",
     "get_action_from_envelope",

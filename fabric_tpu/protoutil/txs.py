@@ -1,8 +1,9 @@
 """Proposal / transaction construction & unpacking.
 
-Reference surface: protoutil/proputils.go (CreateChaincodeProposal,
-GetProposalHash1/2), protoutil/txutils.go (CreateSignedTx), and the
-endorser-side UnpackProposal (core/endorser/msgvalidation.go:43).
+Reference surface: protoutil/proputils.go (CreateChaincodeProposal),
+protoutil/txutils.go (CreateSignedTx, GetProposalHash1 at :452,
+GetProposalHash2 at :431), and the endorser-side UnpackProposal
+(core/endorser/msgvalidation.go:43).
 """
 
 from __future__ import annotations
@@ -72,6 +73,23 @@ def proposal_hash(chdr_bytes: bytes, shdr_bytes: bytes, ccpp_bytes: bytes) -> by
     h.update(chdr_bytes)
     h.update(shdr_bytes)
     h.update(ccpp.SerializeToString())
+    return h.digest()
+
+
+def proposal_hash2(chdr_bytes: bytes, shdr_bytes: bytes, ccpp_bytes: bytes) -> bytes:
+    """Validation-time proposal hash (the reference's GetProposalHash2,
+    protoutil/txutils.go:431, used by the committer at
+    core/common/validation/msgvalidation.go:233): hashes the committed
+    ChaincodeProposalPayload bytes AS-IS, never parsing them.  The
+    visibility policy was already enforced when the tx was assembled
+    (create_signed_tx strips the TransientMap), so the committed bytes
+    are the endorsed preimage — a tx whose committed ccpp still carries
+    transient data (or any other byte difference) hashes differently and
+    fails the binding, exactly like the reference."""
+    h = hashlib.sha256()
+    h.update(chdr_bytes)
+    h.update(shdr_bytes)
+    h.update(ccpp_bytes)
     return h.digest()
 
 

@@ -288,11 +288,12 @@ def test_invalid_utf8_string_field_parity(world):
 
 
 def test_malformed_ccpp_flags_instead_of_raising(world):
-    """Deterministic regression for the wire-fuzzer's second find: the
-    proposal-hash binding re-parses ChaincodeProposalPayload, and
-    garbage ccpp bytes used to raise straight out of validate() —
-    one adversarial envelope aborted the whole block (peer DoS).  Both
-    engines must flag the lane BAD_PAYLOAD and keep going."""
+    """Regression for the wire-fuzzer's second find, updated for
+    GetProposalHash2 semantics: the committed ChaincodeProposalPayload
+    is hashed raw and never parsed (reference msgvalidation.go:233), so
+    garbage ccpp bytes can neither raise out of validate() nor fork the
+    engines — they simply break the hash binding.  Both engines must
+    flag the lane BAD_RESPONSE_PAYLOAD and keep going."""
     org, genesis, bundle, endorser, client, fresh_ledger = world
     env = common_pb2.Envelope.FromString(_tx_bytes(endorser, client))
     p = common_pb2.Payload.FromString(env.payload)
@@ -313,7 +314,60 @@ def test_malformed_ccpp_flags_instead_of_raising(world):
         if force_py:
             v._collect_native = lambda *a, **k: False
         flags = v.validate(_block(list(batch)))
-        assert flags == [V.VALID, V.BAD_PAYLOAD], (force_py, flags)
+        assert flags == [V.VALID, V.BAD_RESPONSE_PAYLOAD], (force_py, flags)
+
+
+def test_transient_map_in_committed_ccpp_parity(world):
+    """Advisor regression (round 4, high): a committed ccpp that still
+    carries a PARSEABLE TransientMap.  Under the old GetProposalHash1
+    validation the python engine re-parsed and stripped the transient
+    (hash matched -> VALID) while the reference rejects the tx (raw
+    bytes differ from the endorsed preimage) — and the native walker's
+    canonical-walk handling of field 2 forked the engines.  Under
+    GetProposalHash2 both engines hash the committed bytes raw: the
+    smuggled transient breaks the binding and BOTH flag
+    BAD_RESPONSE_PAYLOAD, matching the reference."""
+    org, genesis, bundle, endorser, client, fresh_ledger = world
+    from fabric_tpu.protos.peer import proposal_pb2
+
+    env = common_pb2.Envelope.FromString(_tx_bytes(endorser, client))
+    p = common_pb2.Payload.FromString(env.payload)
+    tx = transaction_pb2.Transaction.FromString(p.data)
+    cap = transaction_pb2.ChaincodeActionPayload.FromString(
+        tx.actions[0].payload
+    )
+    ccpp = proposal_pb2.ChaincodeProposalPayload.FromString(
+        cap.chaincode_proposal_payload
+    )
+    ccpp.TransientMap["secret"] = b"smuggled"
+    # sanity: the OLD filtered hash is unchanged by the transient entry,
+    # i.e. this envelope would have validated under Hash1 semantics
+    from fabric_tpu import protoutil as pu
+
+    prp_old = pu.proposal_hash(
+        p.header.channel_header,
+        p.header.signature_header,
+        ccpp.SerializeToString(),
+    )
+    assert prp_old == pu.proposal_hash(
+        p.header.channel_header,
+        p.header.signature_header,
+        cap.chaincode_proposal_payload,
+    )
+    cap.chaincode_proposal_payload = ccpp.SerializeToString()
+    tx.actions[0].payload = cap.SerializeToString()
+    p.data = tx.SerializeToString()
+    pb = p.SerializeToString()
+    mangled = common_pb2.Envelope(
+        payload=pb, signature=client.sign(pb)
+    ).SerializeToString()
+    batch = [_tx_bytes(endorser, client), mangled]
+    for force_py in (False, True):
+        v = TxValidator("fuzzch", fresh_ledger(), bundle, org.csp)
+        if force_py:
+            v._collect_native = lambda *a, **k: False
+        flags = v.validate(_block(list(batch)))
+        assert flags == [V.VALID, V.BAD_RESPONSE_PAYLOAD], (force_py, flags)
 
 
 @pytest.mark.skipif(not native.available(), reason="native unavailable")
