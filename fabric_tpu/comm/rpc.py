@@ -362,13 +362,18 @@ class RPCServer:
         self.methods[method] = limited
 
     def start(self) -> None:
+        self._started = True
         self._thread = threading.Thread(
             target=self._srv.serve_forever, daemon=True
         )
         self._thread.start()
 
     def stop(self) -> None:
-        self._srv.shutdown()
+        # shutdown() blocks on serve_forever()'s shut-down handshake, so
+        # it must be skipped when start() never ran (a constructed-but-
+        # never-started server would hang its owner's stop() forever)
+        if getattr(self, "_started", False):
+            self._srv.shutdown()
         self._srv.server_close()
         with self._conn_lock:
             holders = list(self._holders.values())

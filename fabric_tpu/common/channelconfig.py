@@ -78,6 +78,22 @@ class Bundle:
         )
         self.orderer_config = self._orderer_config(group)
         self.application_config = self._application_config(group)
+        self.acls = self._acls(group)
+
+    @staticmethod
+    def _acls(group: configtx_pb2.ConfigGroup) -> dict[str, str]:
+        """Application ACLs value: resource name -> policy ref overrides
+        (reference common/channelconfig/acls.go newAPIsProvider, fed to
+        aclmgmt's resourceprovider)."""
+        if "Application" not in group.groups:
+            return {}
+        values = group.groups["Application"].values
+        if keys.ACLS_KEY not in values:
+            return {}
+        from fabric_tpu.protos.peer import configuration_pb2 as peer_cfg
+
+        acls = peer_cfg.ACLs.FromString(values[keys.ACLS_KEY].value)
+        return {name: a.policy_ref for name, a in acls.acls.items()}
 
     @staticmethod
     def _collect_msps(group: configtx_pb2.ConfigGroup, out: list[MSP], csp) -> None:

@@ -24,6 +24,7 @@ BATCH_SIZE_KEY = "BatchSize"
 BATCH_TIMEOUT_KEY = "BatchTimeout"
 CONSORTIUM_KEY = "Consortium"
 ENDORSEMENT_POLICY_KEY = "Endorsement"
+ACLS_KEY = "ACLs"
 
 
 def _implicit_meta(group: configtx_pb2.ConfigGroup, name: str, rule, sub_policy: str | None = None):
@@ -58,7 +59,14 @@ def org_group(mspid: str, msp_conf: msp_config_pb2.MSPConfig, anchor=None) -> co
     return g
 
 
-def application_group(orgs: dict[str, configtx_pb2.ConfigGroup]) -> configtx_pb2.ConfigGroup:
+def application_group(
+    orgs: dict[str, configtx_pb2.ConfigGroup],
+    acls: dict[str, str] | None = None,
+) -> configtx_pb2.ConfigGroup:
+    """`acls` maps resource names (peer/aclmgmt catalog) to policy refs,
+    emitted as the Application ACLs config value (reference
+    encoder.NewApplicationGroup addValue(ACLValues), consumed by
+    aclmgmt's resourceprovider)."""
     g = configtx_pb2.ConfigGroup()
     g.mod_policy = "Admins"
     R = policies_pb2.ImplicitMetaPolicy
@@ -67,6 +75,13 @@ def application_group(orgs: dict[str, configtx_pb2.ConfigGroup]) -> configtx_pb2
     _implicit_meta(g, "Admins", R.MAJORITY)
     _implicit_meta(g, "Endorsement", R.MAJORITY, sub_policy=ENDORSEMENT_POLICY_KEY)
     _implicit_meta(g, "LifecycleEndorsement", R.MAJORITY, sub_policy=ENDORSEMENT_POLICY_KEY)
+    if acls:
+        from fabric_tpu.protos.peer import configuration_pb2 as peer_cfg
+
+        msg = peer_cfg.ACLs()
+        for name, ref in acls.items():
+            msg.acls[name].policy_ref = ref
+        _set_value(g, ACLS_KEY, msg)
     for name, org in orgs.items():
         g.groups[name].CopyFrom(org)
     return g
@@ -163,4 +178,5 @@ __all__ = [
     "BATCH_SIZE_KEY",
     "BATCH_TIMEOUT_KEY",
     "ENDORSEMENT_POLICY_KEY",
+    "ACLS_KEY",
 ]

@@ -52,7 +52,12 @@ class DeliverService:
         notifier: BlockNotifier | None = None,
     ):
         """chain_getter(channel_id) -> object with .store (BlockStore) and
-        .bundle (channel config Bundle), or None."""
+        .bundle (channel config Bundle), or None.
+
+        `policy_path` is the policy gating access: a fixed ref, or a
+        callable(support) -> ref so the peer can resolve it through the
+        channel's ACL catalog (event/Block vs event/FilteredBlock,
+        reference core/peer/deliverevents.go:258-281 + aclmgmt)."""
         self._get = chain_getter
         self._csp = csp
         self._policy_path = policy_path
@@ -68,9 +73,15 @@ class DeliverService:
     def _check_access(self, env: common_pb2.Envelope, support) -> bool:
         payload = common_pb2.Payload.FromString(env.payload)
         shdr = common_pb2.SignatureHeader.FromString(payload.header.signature_header)
-        policy = support.bundle.policy_manager.get_policy(self._policy_path)
+        path = self._policy_path
+        if callable(path):
+            try:
+                path = path(support)
+            except Exception:
+                return False
+        policy = support.bundle.policy_manager.get_policy(path)
         if policy is None:
-            return True
+            return False  # fail closed: no resolvable policy, no access
         sd = [SignedData(env.payload, shdr.creator, env.signature)]
         return policy.evaluate_signed_data(sd, self._csp)
 

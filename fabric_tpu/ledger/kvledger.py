@@ -237,6 +237,13 @@ class KVLedger:
     # -- queries -----------------------------------------------------------
 
     @property
+    def block_store(self):
+        """Read access to the underlying block store (qscc's query
+        surface — GetBlockByHash/GetTransactionByID/GetBlockByTxID ride
+        the store's indexes directly, reference core/scc/qscc/query.go)."""
+        return self._blocks
+
+    @property
     def height(self) -> int:
         return self._blocks.height
 

@@ -39,6 +39,8 @@ from fabric_tpu.comm import RPCServer
 from fabric_tpu.common.channelconfig import bundle_from_genesis
 from fabric_tpu.common.deliver import BlockNotifier, DeliverService
 from fabric_tpu.ledger import LedgerProvider
+from fabric_tpu.peer import aclmgmt
+from fabric_tpu.peer.aclmgmt import ACLProvider
 from fabric_tpu.peer.committer import Committer
 from fabric_tpu.peer.deliverclient import DeliverClient
 from fabric_tpu.peer.endorser import Endorser
@@ -55,6 +57,10 @@ class _Channel:
     def __init__(self, node: "PeerNode", genesis: common_pb2.Block):
         self.bundle = bundle_from_genesis(genesis, node.csp)
         self.channel_id = self.bundle.channel_id
+        # per-channel ACL catalog (defaults + the channel config's ACLs
+        # overrides), consulted by the endorser, deliver, and discovery
+        # entries (reference core/aclmgmt resourceprovider)
+        self.acl = ACLProvider(self.bundle.acls, csp=node.csp)
         # create() is idempotent: it opens an existing ledger and only
         # commits the genesis block when the chain is empty
         self.ledger = node.provider.create(genesis)
@@ -70,7 +76,7 @@ class _Channel:
         )
         self.endorser = Endorser(
             self.channel_id, self.ledger, self.bundle, node.signer,
-            node.chaincodes, node.csp,
+            node.chaincodes, node.csp, acl_provider=self.acl,
         )
         self._lock = threading.Lock()
         self.deliver_client: DeliverClient | None = None
@@ -208,9 +214,23 @@ class PeerNode:
         for name, cc in (chaincodes or {}).items():
             self.install_chaincode(name, cc)
 
+        # two deliver services over one notifier: the full-block and
+        # filtered streams are gated by DIFFERENT ACL resources
+        # (reference deliverevents.go:258-281 event/Block vs
+        # event/FilteredBlock), each resolved through the channel's ACL
+        # catalog so channel-config overrides apply
+        notifier = BlockNotifier()
         self.deliver = DeliverService(
             lambda ch: self.channels.get(ch), csp,
-            policy_path="/Channel/Application/Readers",
+            policy_path=lambda sup: sup.acl.policy_ref(aclmgmt.EVENT_BLOCK),
+            notifier=notifier,
+        )
+        self.deliver_filtered_svc = DeliverService(
+            lambda ch: self.channels.get(ch), csp,
+            policy_path=lambda sup: sup.acl.policy_ref(
+                aclmgmt.EVENT_FILTERED_BLOCK
+            ),
+            notifier=notifier,
         )
         # ledgermgmt-style recovery: reopen every channel this peer had
         # joined (reference ledgermgmt.NewLedgerMgr opens all ledger ids;
@@ -407,7 +427,7 @@ class PeerNode:
     def _deliver_filtered(self, body: bytes, stream):
         from fabric_tpu.common.deliver import deliver_filtered_frames
 
-        return deliver_filtered_frames(self.deliver, body)
+        return deliver_filtered_frames(self.deliver_filtered_svc, body)
 
     def _admin_join(self, body: bytes, stream) -> bytes:
         blk = common_pb2.Block.FromString(body)
@@ -476,6 +496,23 @@ class PeerNode:
             orgs = [o.mspid for o in app.orgs.values()] if app else []
             return signed_by_any_member(sorted(orgs))
 
+        def acl_check(channel, sd):
+            """Channel-scoped discovery requires the channel's Writers
+            policy (reference internal/peer/node/start.go:945
+            NewChannelVerifier(policies.ChannelApplicationWriters)) —
+            the evaluation also verifies the request signature."""
+            chn = self.channels.get(channel)
+            if chn is None:
+                raise PermissionError(f"unknown channel {channel!r}")
+            pol = chn.bundle.policy_manager.get_policy(
+                "/Channel/Application/Writers"
+            )
+            if pol is None or not pol.evaluate_signed_data([sd], self.csp):
+                raise PermissionError(
+                    "discovery request does not satisfy the channel's "
+                    "Writers policy"
+                )
+
         support = DiscoverySupport(
             channels=self.channel_list,
             bundle=lambda ch: self.channels[ch].bundle,
@@ -484,7 +521,7 @@ class PeerNode:
             orderer_endpoints=lambda ch: {},
             chaincode_policy=cc_policy,
             collection_filter=lambda ch, cc, colls: (lambda p: True),
-            acl_check=lambda ch, sd: None,
+            acl_check=acl_check,
         )
         svc = DiscoveryService(support, self.csp)
         req = dpb.SignedRequest.FromString(body)
@@ -560,6 +597,7 @@ class PeerNode:
     def stop(self) -> None:
         self.rpc.stop()
         self.deliver.stop()
+        self.deliver_filtered_svc.stop()
         if self._gossip_runner is not None:
             self._gossip_runner.stop()
         if self.gossip_comm is not None:

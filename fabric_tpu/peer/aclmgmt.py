@@ -22,6 +22,8 @@ class ACLError(Exception):
 # Resource names (reference resources.go).
 LSCC_GET_CC_DATA = "lscc/GetChaincodeData"
 LSCC_GET_CHAINCODES = "lscc/GetInstantiatedChaincodes"
+LSCC_CC_EXISTS = "lscc/ChaincodeExists"
+LSCC_GET_DEP_SPEC = "lscc/GetDeploymentSpec"
 QSCC_GET_CHAIN_INFO = "qscc/GetChainInfo"
 QSCC_GET_BLOCK_BY_NUMBER = "qscc/GetBlockByNumber"
 QSCC_GET_BLOCK_BY_HASH = "qscc/GetBlockByHash"
@@ -50,6 +52,8 @@ _ADMINS = "/Channel/Application/Admins"
 DEFAULT_POLICIES: dict[str, str] = {
     LSCC_GET_CC_DATA: _READERS,
     LSCC_GET_CHAINCODES: _READERS,
+    LSCC_CC_EXISTS: _READERS,
+    LSCC_GET_DEP_SPEC: _READERS,
     QSCC_GET_CHAIN_INFO: _READERS,
     QSCC_GET_BLOCK_BY_NUMBER: _READERS,
     QSCC_GET_BLOCK_BY_HASH: _READERS,
@@ -71,6 +75,52 @@ DEFAULT_POLICIES: dict[str, str] = {
     EVENT_FILTERED_BLOCK: _READERS,
     GOSSIP_PRIVATE_DATA: _READERS,
 }
+
+
+# System-chaincode function -> resource mapping.  The reference checks
+# these inside each SCC, where the stub exposes the SignedProposal
+# (qscc/query.go:112 fn->resource switch, cscc/configure.go:163-186,
+# lifecycle/scc.go:209 "_lifecycle/<FuncName>"); here the enforcement
+# point is the endorser entry, the one place this build has the signed
+# proposal, the channel policy manager, and the chaincode name+function
+# together.
+SCC_FUNCTION_RESOURCES: dict[tuple[str, str], str] = {
+    ("qscc", "GetChainInfo"): QSCC_GET_CHAIN_INFO,
+    ("qscc", "GetBlockByNumber"): QSCC_GET_BLOCK_BY_NUMBER,
+    ("qscc", "GetBlockByHash"): QSCC_GET_BLOCK_BY_HASH,
+    ("qscc", "GetTransactionByID"): QSCC_GET_TX_BY_ID,
+    ("qscc", "GetBlockByTxID"): QSCC_GET_BLOCK_BY_TX_ID,
+    ("cscc", "GetConfigBlock"): CSCC_GET_CONFIG_BLOCK,
+    ("cscc", "GetChannelConfig"): CSCC_GET_CHANNEL_CONFIG,
+    ("cscc", "GetChannels"): CSCC_GET_CHANNELS,
+    ("cscc", "JoinChain"): CSCC_JOIN_CHAIN,
+    # fn names as the lscc dispatch spells them (chaincode/lscc.py:58-70)
+    ("lscc", "getccdata"): LSCC_GET_CC_DATA,
+    ("lscc", "getchaincodes"): LSCC_GET_CHAINCODES,
+    ("lscc", "getid"): LSCC_CC_EXISTS,
+    ("lscc", "getdepspec"): LSCC_GET_DEP_SPEC,
+    # deploy/upgrade: "ACL check covered by PROPOSAL" in the reference
+    # (defaultaclprovider.go:69-70) — the channel Writers gate applies
+    ("lscc", "deploy"): PEER_PROPOSE,
+    ("lscc", "upgrade"): PEER_PROPOSE,
+    ("_lifecycle", "ApproveChaincodeDefinitionForMyOrg"): LIFECYCLE_APPROVE,
+    ("_lifecycle", "CommitChaincodeDefinition"): LIFECYCLE_COMMIT,
+    ("_lifecycle", "CheckCommitReadiness"): LIFECYCLE_CHECK_READINESS,
+    ("_lifecycle", "QueryChaincodeDefinition"): LIFECYCLE_QUERY_COMMITTED,
+}
+
+SYSTEM_CHAINCODES = frozenset({"qscc", "cscc", "lscc", "_lifecycle"})
+
+
+def resource_for_chaincode(cc_name: str, fn: str) -> str | None:
+    """Resource an on-channel proposal must satisfy: the per-function
+    SCC resource, peer/Propose for application chaincodes, or None for
+    an SCC function with no catalog entry (the SCC itself rejects or
+    serves unknown functions; the reference likewise only gates
+    cataloged functions)."""
+    if cc_name in SYSTEM_CHAINCODES:
+        return SCC_FUNCTION_RESOURCES.get((cc_name, fn))
+    return PEER_PROPOSE
 
 
 class ACLProvider:
@@ -95,6 +145,10 @@ class ACLProvider:
         ref = self._overrides.get(resource) or DEFAULT_POLICIES.get(resource)
         if ref is None:
             raise ACLError(f"no ACL policy for resource {resource!r}")
+        if not ref.startswith("/"):
+            # a non-fully-qualified ref is relative to the Application
+            # group (reference aclmgmtimpl newACLMgmt policy resolution)
+            ref = "/Channel/Application/" + ref
         return ref
 
     def check_acl(
@@ -117,4 +171,7 @@ __all__ = [
     "ACLProvider",
     "ACLError",
     "DEFAULT_POLICIES",
+    "SCC_FUNCTION_RESOURCES",
+    "SYSTEM_CHAINCODES",
+    "resource_for_chaincode",
 ]

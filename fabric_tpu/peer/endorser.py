@@ -12,24 +12,60 @@ into the same `chaincodes` registry when it lands.
 
 from __future__ import annotations
 
+from fabric_tpu.peer import aclmgmt
 from fabric_tpu.protos.peer import chaincode_pb2, proposal_pb2, proposal_response_pb2
 from fabric_tpu import protoutil
+from fabric_tpu.protoutil import SignedData
 
 
 class EndorserError(Exception):
     pass
 
 
+class ACLDeniedError(EndorserError):
+    pass
+
+
 class Endorser:
-    def __init__(self, channel_id: str, ledger, bundle, signer, chaincodes: dict, csp):
+    def __init__(self, channel_id: str, ledger, bundle, signer, chaincodes: dict, csp,
+                 acl_provider: aclmgmt.ACLProvider | None = None):
         """chaincodes: name -> fn(tx_simulator, args: list[bytes]) ->
-        (status:int, message:str, payload:bytes)."""
+        (status:int, message:str, payload:bytes).
+
+        `acl_provider` defaults to one built from the channel config's
+        ACLs value (Bundle.acls) — enforcement is on by default, like
+        the reference peer (endorser.go:286 CheckACL before simulating;
+        per-function SCC resources per aclmgmt.SCC_FUNCTION_RESOURCES)."""
         self.channel_id = channel_id
         self._ledger = ledger
         self._bundle = bundle
         self._signer = signer
         self._chaincodes = chaincodes
         self._csp = csp
+        self._acl = acl_provider or aclmgmt.ACLProvider(
+            getattr(bundle, "acls", None), csp=csp
+        )
+
+    def _check_acl(self, up, signed) -> None:
+        """peer/Propose for application chaincodes (reference
+        endorser.go:284-290 via support.go:137); the cataloged
+        per-function resource for system chaincodes (checked inside each
+        SCC in the reference — qscc/query.go:112, cscc/configure.go:163,
+        lifecycle/scc.go:209 — here at the endorser entry, where the
+        SignedProposal is in scope)."""
+        fn = up.input.args[0].decode("utf-8", "replace") if up.input.args else ""
+        resource = aclmgmt.resource_for_chaincode(up.chaincode_name, fn)
+        if resource is None:
+            return
+        sd = SignedData(
+            signed.proposal_bytes,
+            up.signature_header.creator,
+            signed.signature,
+        )
+        try:
+            self._acl.check_acl(resource, self._bundle.policy_manager, sd)
+        except aclmgmt.ACLError as exc:
+            raise ACLDeniedError(str(exc)) from exc
 
     def process_proposal(
         self, signed: proposal_pb2.SignedProposal
@@ -53,6 +89,7 @@ class Endorser:
             raise EndorserError(f"creator identity invalid: {exc}") from exc
         if not creator.verify(signed.proposal_bytes, signed.signature):
             raise EndorserError("invalid creator signature on proposal")
+        self._check_acl(up, signed)
 
         # -- simulate ------------------------------------------------------
         cc = self._chaincodes.get(up.chaincode_name)
@@ -78,4 +115,4 @@ class Endorser:
         )
 
 
-__all__ = ["Endorser", "EndorserError"]
+__all__ = ["Endorser", "EndorserError", "ACLDeniedError"]

@@ -1,0 +1,192 @@
+"""E2E ACL enforcement at the peer's API entries (reference
+core/aclmgmt wired per core/endorser/endorser.go:286,
+core/scc/qscc/query.go:112, core/peer/deliverevents.go:258-281,
+internal/peer/node/start.go:945): a VALIDLY-SIGNED client whose
+identity does not satisfy a resource's policy must be rejected at that
+resource — and only there.  The channel config's ACLs value overrides
+the default resource policies per channel."""
+
+import pytest
+
+from fabric_tpu.common import configtx_builder as ctx
+from fabric_tpu.common.deliver import make_seek_info_envelope
+from fabric_tpu.msp import msp_config_from_ca
+from fabric_tpu.node.peer_node import PeerNode
+from fabric_tpu.peer import aclmgmt
+from fabric_tpu.peer.endorser import ACLDeniedError
+from fabric_tpu.protos.common import common_pb2
+from fabric_tpu.protos.orderer import ab_pb2
+from fabric_tpu.protos.peer import proposal_pb2
+from fabric_tpu import protoutil
+
+from orgfix import make_org
+
+ADMINS = "/Channel/Application/Admins"
+
+
+def kvcc(sim, args):
+    if args[0] == b"put":
+        sim.set_state("kvcc", args[1].decode(), args[2])
+        return 200, "", b""
+    return 500, "bad op", b""
+
+
+@pytest.fixture(scope="module")
+def net(tmp_path_factory):
+    org = make_org("Org1MSP")
+    oorg = make_org("OrdererMSP")
+    app = ctx.application_group(
+        {"Org1": ctx.org_group("Org1MSP", msp_config_from_ca(org.ca, "Org1MSP"))},
+        acls={
+            aclmgmt.PEER_PROPOSE: ADMINS,
+            aclmgmt.QSCC_GET_CHAIN_INFO: ADMINS,
+            aclmgmt.EVENT_BLOCK: ADMINS,
+            # event/FilteredBlock left at its default (Readers): the
+            # same client must be allowed there and denied on the two
+            # overridden resources
+        },
+    )
+    ordg = ctx.orderer_group(
+        {"OrdererOrg": ctx.org_group("OrdererMSP", msp_config_from_ca(oorg.ca, "OrdererMSP"))},
+        consensus_type="solo",
+    )
+    genesis = ctx.genesis_block("aclch", ctx.channel_group(app, ordg))
+    node = PeerNode(None, org.csp, org.signer("peer0", role_ou="peer"),
+                    chaincodes={"kvcc": kvcc})
+    node.join_channel(genesis)
+    node.start()
+    yield org, node
+    node.stop()
+
+
+def _signed_proposal(client, channel, cc, args):
+    prop, _ = protoutil.create_chaincode_proposal(
+        client.serialize(), channel, cc, args
+    )
+    return proposal_pb2.SignedProposal(
+        proposal_bytes=prop.SerializeToString(),
+        signature=client.sign(prop.SerializeToString()),
+    )
+
+
+def test_propose_acl_denies_non_admin(net):
+    org, node = net
+    member = org.signer("client-member", role_ou="client")
+    admin = org.signer("client-admin", role_ou="admin")
+    ch = node.channels["aclch"]
+    sp = _signed_proposal(member, "aclch", "kvcc", [b"put", b"k", b"v"])
+    with pytest.raises(ACLDeniedError, match="peer/Propose"):
+        ch.endorser.process_proposal(sp)
+    sp = _signed_proposal(admin, "aclch", "kvcc", [b"put", b"k", b"v"])
+    resp = ch.endorser.process_proposal(sp)
+    assert resp.response.status == 200
+
+
+def test_qscc_function_acl(net):
+    org, node = net
+    member = org.signer("q-member", role_ou="client")
+    admin = org.signer("q-admin", role_ou="admin")
+    ch = node.channels["aclch"]
+    sp = _signed_proposal(member, "aclch", "qscc", [b"GetChainInfo", b"aclch"])
+    with pytest.raises(ACLDeniedError, match="qscc/GetChainInfo"):
+        ch.endorser.process_proposal(sp)
+    sp = _signed_proposal(admin, "aclch", "qscc", [b"GetChainInfo", b"aclch"])
+    assert ch.endorser.process_proposal(sp).response.status == 200
+    # an UN-overridden qscc resource keeps its default (Readers): the
+    # member passes there — denial was per-resource, not per-identity
+    sp = _signed_proposal(
+        member, "aclch", "qscc", [b"GetBlockByNumber", b"aclch", b"0"]
+    )
+    assert ch.endorser.process_proposal(sp).response.status == 200
+
+
+def test_lscc_deploy_covered_by_propose(net):
+    """lscc deploy/upgrade ride the peer/Propose gate (reference
+    defaultaclprovider.go:69-70 'ACL check covered by PROPOSAL'), so
+    the Admins override denies a member there too — while an lscc
+    query with its default Readers policy still admits the member
+    (the ACL fires before simulation, so a 404-ish chaincode result
+    is fine; a DENIAL would raise instead)."""
+    org, node = net
+    member = org.signer("l-member", role_ou="client")
+    ch = node.channels["aclch"]
+    sp = _signed_proposal(member, "aclch", "lscc", [b"deploy", b"aclch", b"x"])
+    with pytest.raises(ACLDeniedError, match="peer/Propose"):
+        ch.endorser.process_proposal(sp)
+    sp = _signed_proposal(member, "aclch", "lscc", [b"getccdata", b"aclch", b"x"])
+    resp = ch.endorser.process_proposal(sp)
+    assert resp.response.status != 200  # served (not found), not denied
+
+
+def test_deliver_block_vs_filtered_acl(net):
+    org, node = net
+    member = org.signer("d-member", role_ou="client")
+    env = make_seek_info_envelope(
+        "aclch", 0, 0, signer=member,
+        behavior=ab_pb2.SeekInfo.FAIL_IF_NOT_READY,
+    )
+    events = list(node.deliver.deliver(env))
+    assert events == [("status", common_pb2.FORBIDDEN)]
+    # the filtered stream's default (Readers) still admits the member
+    events = list(node.deliver_filtered_svc.deliver(env))
+    kinds = [k for k, _ in events]
+    assert kinds == ["block", "status"]
+    assert events[-1] == ("status", common_pb2.SUCCESS)
+    # an admin satisfies the override on the full-block stream
+    admin = org.signer("d-admin", role_ou="admin")
+    env = make_seek_info_envelope(
+        "aclch", 0, 0, signer=admin,
+        behavior=ab_pb2.SeekInfo.FAIL_IF_NOT_READY,
+    )
+    events = list(node.deliver.deliver(env))
+    assert [k for k, _ in events] == ["block", "status"]
+
+
+def test_discovery_acl_rejects_foreign_identity(net):
+    org, node = net
+    from fabric_tpu.discovery import DiscoveryClient
+    from fabric_tpu.protos.discovery import protocol_pb2 as dpb
+
+    def send(signed: dpb.SignedRequest) -> dpb.Response:
+        return dpb.Response.FromString(
+            node._discovery(signed.SerializeToString(), None)
+        )
+
+    member = org.signer("disc-member", role_ou="client")
+    resp = DiscoveryClient(member, send).peers("aclch")
+    assert resp  # membership query served
+
+    outsider = make_org("EvilMSP").signer("mallory", role_ou="client")
+    with pytest.raises(Exception, match="access denied"):
+        DiscoveryClient(outsider, send).peers("aclch")
+
+
+def test_default_acls_admit_members():
+    """Without overrides every defaulted resource behaves as before:
+    a plain member can propose (Writers) and read blocks (Readers)."""
+    org = make_org("Org1MSP")
+    oorg = make_org("OrdererMSP")
+    app = ctx.application_group(
+        {"Org1": ctx.org_group("Org1MSP", msp_config_from_ca(org.ca, "Org1MSP"))}
+    )
+    ordg = ctx.orderer_group(
+        {"OrdererOrg": ctx.org_group("OrdererMSP", msp_config_from_ca(oorg.ca, "OrdererMSP"))},
+        consensus_type="solo",
+    )
+    genesis = ctx.genesis_block("defch", ctx.channel_group(app, ordg))
+    node = PeerNode(None, org.csp, org.signer("peer0", role_ou="peer"),
+                    chaincodes={"kvcc": kvcc})
+    node.join_channel(genesis)
+    node.start()
+    try:
+        member = org.signer("m", role_ou="client")
+        ch = node.channels["defch"]
+        sp = _signed_proposal(member, "defch", "kvcc", [b"put", b"k", b"v"])
+        assert ch.endorser.process_proposal(sp).response.status == 200
+        env = make_seek_info_envelope(
+            "defch", 0, 0, signer=member,
+            behavior=ab_pb2.SeekInfo.FAIL_IF_NOT_READY,
+        )
+        assert [k for k, _ in node.deliver.deliver(env)] == ["block", "status"]
+    finally:
+        node.stop()
