@@ -160,6 +160,38 @@ class CollectionStore:
             return False
 
 
+class LedgerBackedCollectionStore(CollectionStore):
+    """Collection registry answering from COMMITTED lifecycle definitions
+    (reference core/common/privdata/store.go pulling from the deployed
+    chaincode info provider) — no explicit set_collections calls; a
+    definition upgrade is visible at the next lookup."""
+
+    def __init__(self, definition_provider, deserializer):
+        """definition_provider: object with
+        collection_config(name, collection) -> StaticCollectionConfig|None
+        (chaincode.lifecycle.DefinitionProvider or a test fake)."""
+        super().__init__(deserializer)
+        self._definitions = definition_provider
+
+    def collection(self, chaincode: str, name: str) -> SimpleCollection:
+        sc = (
+            self._definitions.collection_config(chaincode, name)
+            if self._definitions is not None
+            else None
+        )
+        if sc is None:
+            raise NoSuchCollectionError(f"{chaincode}/{name}")
+        return SimpleCollection(sc, self._deserializer)
+
+    def collections_of(self, chaincode: str) -> list[SimpleCollection]:
+        getter = getattr(self._definitions, "definition", None)
+        d = getter(chaincode) if getter is not None else None
+        if d is None or not d.collections:
+            return []
+        self.set_collections(chaincode, bytes(d.collections))
+        return super().collections_of(chaincode)
+
+
 def static_collection(
     name: str,
     member_mspids: list[str],
@@ -201,6 +233,7 @@ def collection_package(
 
 __all__ = [
     "CollectionStore",
+    "LedgerBackedCollectionStore",
     "SimpleCollection",
     "NoSuchCollectionError",
     "static_collection",

@@ -72,7 +72,7 @@ class SignerMCS(MessageCryptoService):
     def verify(self, identity: bytes, signature: bytes, payload: bytes) -> bool:
         try:
             ident = self._deserializer.deserialize_identity(identity)
-            return ident.verify(payload, signature, self._csp)
+            return ident.verify(payload, signature)
         except Exception:
             return False
 
@@ -235,9 +235,11 @@ class TCPGossipComm(GossipComm):
     def _handshake_frame(self) -> bytes:
         ce = gpb.ConnEstablish(
             pki_id=self.pki_id, identity=self.identity,
-            tls_cert_hash=self._cert_hash,
+            tls_cert_hash=self._cert_hash, endpoint=self.endpoint,
         )
-        ce.signature = self.mcs.sign(self.pki_id + self._cert_hash)
+        ce.signature = self.mcs.sign(
+            self.pki_id + self._cert_hash + self.endpoint.encode()
+        )
         raw = ce.SerializeToString()
         return _LEN.pack(len(raw)) + raw
 
@@ -316,7 +318,10 @@ class TCPGossipComm(GossipComm):
             ce = gpb.ConnEstablish.FromString(frame)
             if self.mcs.get_pki_id(ce.identity) != ce.pki_id:
                 return  # identity/pki mismatch
-            sig_payload = bytes(ce.pki_id) + bytes(ce.tls_cert_hash)
+            sig_payload = (
+                bytes(ce.pki_id) + bytes(ce.tls_cert_hash)
+                + ce.endpoint.encode()
+            )
             if self._tls is not None:
                 from fabric_tpu.comm.tls import cert_hash_from_der
 
@@ -332,13 +337,22 @@ class TCPGossipComm(GossipComm):
                     ce.identity, ce.signature, sig_payload
                 ):
                     return
-            elif ce.signature and not self.mcs.verify(
-                ce.identity, ce.signature, sig_payload
-            ):
+            elif not self.mcs.verify(ce.identity, ce.signature, sig_payload):
+                # plaintext transport: the handshake must STILL verify
+                # under the MCS — an MSP-backed MCS rejects an empty
+                # signature, so a replayed public cert cannot register
+                # an identity (and an attack endpoint for dial-back
+                # replies); the permissive dev-default MCS accepts all
                 return
             self.learn_identity(ce.identity)
             sender_pki = ce.pki_id
-            respond = lambda m: None  # responses go via send() to endpoints
+            # responses dial back to the sender's SIGNED listen endpoint
+            # (connections are one-directional; the reference replies
+            # over its bidirectional stream instead)
+            if ce.endpoint:
+                respond = lambda m, _ep=ce.endpoint: self.send(_ep, m)
+            else:
+                respond = lambda m: None  # legacy handshake: no reply path
             while not self._stop.is_set():
                 frame = self._read_frame(conn, buf)
                 if frame is None:

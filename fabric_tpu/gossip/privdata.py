@@ -136,12 +136,17 @@ class PrivDataHandler:
     from local stores (reference gossip/privdata pull.go handlers)."""
 
     def __init__(self, comm, transient_store, pvtdata_store,
-                 collection_store, ledger_height):
+                 collection_store, ledger_height, channel: str | None = None):
+        """`channel`: when set, pushes and pull requests for OTHER
+        channels are ignored — a node serving several channels mounts
+        one handler per channel on the shared comm, and each must only
+        touch its own transient/pvt stores."""
         self._comm = comm
         self._transient = transient_store
         self._pvtstore = pvtdata_store
         self._collections = collection_store
         self._height = ledger_height  # callable -> int
+        self._channel = channel
         self._pending: list[tuple[dict, threading.Event, set]] = []
         self._lock = threading.Lock()
         comm.subscribe(self._on_message)
@@ -151,6 +156,19 @@ class PrivDataHandler:
     def _on_message(self, rm) -> None:
         msg = rm.msg
         which = msg.WhichOneof("content")
+        if self._channel is not None:
+            if which == "private_data":
+                ch = msg.private_data.channel
+            elif which == "private_req":
+                ch = msg.private_req.channel
+            elif which == "private_res":
+                # responses carry the channel on the outer message
+                # (_serve echoes req.channel there)
+                ch = bytes(msg.channel).decode("utf-8", "replace")
+            else:
+                ch = None
+            if ch is not None and ch != self._channel:
+                return
         if which == "private_data":
             pd = msg.private_data
             self._transient.persist(
@@ -292,9 +310,21 @@ class PrivDataCoordinator:
     def add_commit_listener(self, fn) -> None:
         self._listeners.append(fn)
 
+    def set_fetcher(self, fetcher, fetch_endpoints) -> None:
+        """Late-bind the gossip pull path (a node wires the coordinator
+        at channel creation but gossip may come up afterwards)."""
+        self._fetcher = fetcher
+        self._fetch_endpoints = fetch_endpoints
+
     @property
     def height(self) -> int:
         return self._ledger.height
+
+    def get_block_by_number(self, num: int):
+        """Committed-block reader for gossip state transfer: a peer
+        serving a state_request reads past the store's TTL window from
+        the ledger (gossip/state.py _read_committed)."""
+        return self._ledger.get_block_by_number(num)
 
     def store_block(self, block) -> list[int]:
         self._validator.validate(block)
@@ -333,7 +363,6 @@ class PrivDataCoordinator:
                 self._fetch_endpoints(),
             )
             for tx_num, ds in to_fetch.items():
-                txid, _, _ = ds[0]
                 _, needed = reqs[tx_num]
                 for txid_, ns, coll in ds:
                     raw = fetched.get((txid_, ns, coll))

@@ -387,6 +387,12 @@ class LedgerProvider:
         self._ledgers[ledger_id] = ledger
         return ledger
 
+    @property
+    def kv(self):
+        """The provider's shared index KVStore — side stores that live
+        next to the ledgers (transient store) mount namespaces on it."""
+        return self._kv
+
     def list(self) -> list[str]:
         return sorted(self._ledgers)
 

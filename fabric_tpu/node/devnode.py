@@ -24,7 +24,6 @@ from fabric_tpu.orderer.msgprocessor import (
     StandardChannelProcessor,
 )
 from fabric_tpu.orderer.solo import SoloChain
-from fabric_tpu.peer.committer import Committer
 from fabric_tpu.peer.endorser import Endorser
 from fabric_tpu.peer.txvalidator import TxValidator
 from fabric_tpu.protos.common import common_pb2
@@ -55,11 +54,32 @@ class DevNode:
             self.channel_id, self.ledger, self.bundle, self.csp,
             definition_provider=definition_provider,
         )
-        self.committer = Committer(self.validator, self.ledger)
+        # single-process private-data loop: the endorser persists
+        # cleartext collection writes to the transient store, the
+        # commit coordinator reads them back at commit (no gossip leg
+        # in a one-peer dev network)
+        from fabric_tpu.common.privdata import LedgerBackedCollectionStore
+        from fabric_tpu.gossip.privdata import PrivDataCoordinator
+        from fabric_tpu.ledger.transientstore import TransientStore
+
+        self.collections = LedgerBackedCollectionStore(
+            definition_provider, self.bundle.msp_manager
+        )
+        self.transient = TransientStore(self.provider.kv, self.channel_id)
+        self.ledger.set_btl_policy(self.collections.btl_policy())
+        self.committer = PrivDataCoordinator(
+            self.validator, self.ledger, self.transient, self.collections,
+            self_identity=(
+                peer_signer.serialize() if peer_signer is not None else b""
+            ),
+        )
         self.endorser = (
             Endorser(
                 self.channel_id, self.ledger, self.bundle, peer_signer,
                 chaincodes or {}, self.csp,
+                pvt_handoff=lambda txid, pvt: self.transient.persist(
+                    txid, self.ledger.height, pvt
+                ),
             )
             if peer_signer is not None
             else None
@@ -119,7 +139,16 @@ class DevNode:
             self.channel_id, self.ledger, new_bundle, self.csp,
             definition_provider=self._definitions,
         )
-        self.committer = Committer(self.validator, self.ledger)
+        from fabric_tpu.gossip.privdata import PrivDataCoordinator
+
+        self.committer = PrivDataCoordinator(
+            self.validator, self.ledger, self.transient, self.collections,
+            self_identity=(
+                self._peer_signer.serialize()
+                if self._peer_signer is not None
+                else b""
+            ),
+        )
         self.committer.add_commit_listener(
             lambda b, flags: self._commit_events.put((b.header.number, flags))
         )
@@ -127,6 +156,9 @@ class DevNode:
             self.endorser = Endorser(
                 self.channel_id, self.ledger, new_bundle,
                 self._peer_signer, self._chaincodes, self.csp,
+                pvt_handoff=lambda txid, pvt: self.transient.persist(
+                    txid, self.ledger.height, pvt
+                ),
             )
 
     # -- client surface ----------------------------------------------------

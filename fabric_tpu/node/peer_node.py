@@ -38,10 +38,12 @@ from fabric_tpu.common.semaphore import Semaphore
 from fabric_tpu.comm import RPCServer
 from fabric_tpu.common.channelconfig import bundle_from_genesis
 from fabric_tpu.common.deliver import BlockNotifier, DeliverService
+from fabric_tpu.common.privdata import LedgerBackedCollectionStore
+from fabric_tpu.gossip.privdata import PrivDataCoordinator
 from fabric_tpu.ledger import LedgerProvider
+from fabric_tpu.ledger.transientstore import TransientStore
 from fabric_tpu.peer import aclmgmt
 from fabric_tpu.peer.aclmgmt import ACLProvider
-from fabric_tpu.peer.committer import Committer
 from fabric_tpu.peer.deliverclient import DeliverClient
 from fabric_tpu.peer.endorser import Endorser
 from fabric_tpu.peer.txvalidator import TxValidator
@@ -55,6 +57,7 @@ class _Channel:
     """Per-channel resources (reference core/peer/peer.go channel map)."""
 
     def __init__(self, node: "PeerNode", genesis: common_pb2.Block):
+        self._node = node
         self.bundle = bundle_from_genesis(genesis, node.csp)
         self.channel_id = self.bundle.channel_id
         # per-channel ACL catalog (defaults + the channel config's ACLs
@@ -69,7 +72,25 @@ class _Channel:
             self.channel_id, self.ledger, self.bundle, node.csp,
             definition_provider=self.definitions,
         )
-        self.committer = Committer(self.validator, self.ledger)
+        # private-data stack: collections from committed lifecycle
+        # definitions, per-channel transient store, and a commit
+        # coordinator that assembles cleartext pvt data (transient
+        # first, gossip pull second) before the ledger commit
+        # (reference gossip/privdata/coordinator.go:149)
+        self.collections = LedgerBackedCollectionStore(
+            self.definitions, self.bundle.msp_manager
+        )
+        self.transient = TransientStore(node.provider.kv, self.channel_id)
+        self.ledger.set_btl_policy(self.collections.btl_policy())
+        self.committer = PrivDataCoordinator(
+            self.validator, self.ledger, self.transient, self.collections,
+            self_identity=(
+                node.signer.serialize() if node.signer is not None else b""
+            ),
+        )
+        self.pvt_handler = None   # bound when gossip joins the channel
+        self.distributor = None
+        self.reconciler = None
         self.notifier = BlockNotifier()
         self.committer.add_commit_listener(
             lambda *a, **k: self.notifier.notify()
@@ -77,6 +98,7 @@ class _Channel:
         self.endorser = Endorser(
             self.channel_id, self.ledger, self.bundle, node.signer,
             node.chaincodes, node.csp, acl_provider=self.acl,
+            pvt_handoff=self._pvt_handoff,
         )
         self._lock = threading.Lock()
         self.deliver_client: DeliverClient | None = None
@@ -103,12 +125,38 @@ class _Channel:
         if node.gossip is not None:
             node.gossip_join_channel(self)
 
+    def _pvt_handoff(self, txid: str, pvt_bytes: bytes) -> None:
+        """Endorsement-time private-data handoff (reference
+        endorser.go:234 -> distributor.go:138): persist the cleartext
+        rwsets to the transient store at the current height, then push
+        to collection-eligible peers over gossip.  Raises (failing the
+        endorsement) when a collection's required_peer_count cannot be
+        met."""
+        self.transient.persist(txid, self.ledger.height, pvt_bytes)
+        if self.distributor is not None:
+            self.distributor.distribute(
+                self.channel_id, txid, self.ledger.height, pvt_bytes
+            )
+
     @property
     def store(self):  # DeliverService support surface (.height,
         # .get_block_by_number) — the ledger exposes both
         return self.ledger
 
     def _receive_block(self, seq: int, block_bytes: bytes) -> None:
+        # with gossip up, delivered blocks enter the channel's state
+        # provider: it commits in order AND disseminates to org peers
+        # (the reference leader's deliver sink is gossip AddPayload,
+        # blocksprovider.go -> state.go:750); without gossip, commit
+        # directly
+        handle = (
+            self._node.gossip.channel(self.channel_id)
+            if self._node.gossip is not None
+            else None
+        )
+        if handle is not None:
+            handle.state.add_payload(seq, block_bytes, from_orderer=True)
+            return
         blk = common_pb2.Block.FromString(block_bytes)
         with self._lock:
             if blk.header.number == self.ledger.height:
@@ -539,6 +587,7 @@ class PeerNode:
         store_capacity: int = 200,
         tick_interval_s: float = 1.0,
         identity_ttl_s: float = 3600.0,
+        reconcile_interval_s: float = 60.0,
     ) -> None:
         """Start the gossip stack (TCP transport over the node's TLS,
         SWIM discovery, certstore identity pull, per-channel block
@@ -561,6 +610,25 @@ class PeerNode:
             self.gossip_join_channel(ch)
         self._gossip_runner = GossipRunner(self.gossip, tick_interval_s)
         self._gossip_runner.start()
+        # background private-data repair (reference reconcile.go runs on
+        # peer.gossip.pvtData.reconcileSleepInterval, default 1m)
+        self._reconcile_stop = threading.Event()
+
+        def reconcile_loop():
+            while not self._reconcile_stop.wait(reconcile_interval_s):
+                for ch in list(self.channels.values()):
+                    rec = ch.reconciler
+                    if rec is None:
+                        continue
+                    try:
+                        rec.reconcile_once()
+                    except Exception:
+                        pass  # endpoints may be down; next sweep retries
+
+        self._reconcile_thread = threading.Thread(
+            target=reconcile_loop, daemon=True
+        )
+        self._reconcile_thread.start()
 
     def gossip_join_channel(self, ch: _Channel) -> None:
         if self.gossip.channel(ch.channel_id) is not None:
@@ -570,6 +638,40 @@ class PeerNode:
             ch.committer,
             deliver_client=ch.deliver_client,
             **self._gossip_opts,
+        )
+        # private-data flows over the gossip comm: push receiver + pull
+        # server (handler), commit-time pull (coordinator fetcher),
+        # endorsement-time push (distributor), background repair
+        # (reconciler) — reference gossip/privdata wired at
+        # gossip_service.go InitializeChannel
+        from fabric_tpu.gossip.privdata import (
+            PrivDataDistributor,
+            PrivDataHandler,
+            Reconciler,
+        )
+
+        def peer_endpoints():
+            return [
+                p.endpoint for p in self.gossip.discovery.alive_peers()
+            ]
+
+        def membership():
+            return [
+                (p.endpoint, self.gossip_comm.identity_of(p.pki_id))
+                for p in self.gossip.discovery.alive_peers()
+            ]
+
+        ch.pvt_handler = PrivDataHandler(
+            self.gossip_comm, ch.transient, ch.ledger.pvt_store,
+            ch.collections, lambda: ch.ledger.height,
+            channel=ch.channel_id,
+        )
+        ch.committer.set_fetcher(ch.pvt_handler, peer_endpoints)
+        ch.distributor = PrivDataDistributor(
+            self.gossip_comm, ch.collections, membership
+        )
+        ch.reconciler = Reconciler(
+            ch.ledger, ch.pvt_handler, ch.channel_id, peer_endpoints
         )
 
     @property
@@ -600,6 +702,8 @@ class PeerNode:
         self.deliver_filtered_svc.stop()
         if self._gossip_runner is not None:
             self._gossip_runner.stop()
+        if getattr(self, "_reconcile_stop", None) is not None:
+            self._reconcile_stop.set()
         if self.gossip_comm is not None:
             self.gossip_comm.close()
         if self.operations is not None:

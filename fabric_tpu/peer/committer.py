@@ -31,6 +31,12 @@ class Committer:
     def add_commit_listener(self, fn) -> None:
         self._listeners.append(fn)
 
+    def get_block_by_number(self, num: int):
+        """Committed-block reader for gossip state transfer
+        (gossip/state.py _read_committed serves state_requests from it
+        once blocks age out of the gossip message store)."""
+        return self._ledger.get_block_by_number(num)
+
     def store_block(self, block) -> list[int]:
         """The per-block pipeline; returns final validation flags."""
         t0 = time.perf_counter()

@@ -28,14 +28,24 @@ class ACLDeniedError(EndorserError):
 
 class Endorser:
     def __init__(self, channel_id: str, ledger, bundle, signer, chaincodes: dict, csp,
-                 acl_provider: aclmgmt.ACLProvider | None = None):
+                 acl_provider: aclmgmt.ACLProvider | None = None,
+                 pvt_handoff=None):
         """chaincodes: name -> fn(tx_simulator, args: list[bytes]) ->
         (status:int, message:str, payload:bytes).
 
         `acl_provider` defaults to one built from the channel config's
         ACLs value (Bundle.acls) — enforcement is on by default, like
         the reference peer (endorser.go:286 CheckACL before simulating;
-        per-function SCC resources per aclmgmt.SCC_FUNCTION_RESOURCES)."""
+        per-function SCC resources per aclmgmt.SCC_FUNCTION_RESOURCES).
+
+        `pvt_handoff(txid, pvt_bytes)`: receives the CLEARTEXT private
+        simulation results before the endorsement is returned — node
+        assemblies wire it to transient-store persist + gossip push
+        (reference endorser.go:234 DistributePrivateData); its failure
+        fails the endorsement.  A bare Endorser (auxiliary signer in
+        tests, no node attached) has nowhere to persist, so None drops
+        the cleartext — the PUBLIC response still carries the hashed
+        rwsets either way."""
         self.channel_id = channel_id
         self._ledger = ledger
         self._bundle = bundle
@@ -45,6 +55,7 @@ class Endorser:
         self._acl = acl_provider or aclmgmt.ACLProvider(
             getattr(bundle, "acls", None), csp=csp
         )
+        self._pvt_handoff = pvt_handoff
 
     def _check_acl(self, up, signed) -> None:
         """peer/Propose for application chaincodes (reference
@@ -103,6 +114,25 @@ class Endorser:
                 response=proposal_pb2.Response(status=status, message=message)
             )
         results = sim.get_tx_simulation_results()
+
+        # -- private-data handoff (endorser.go:220-240): cleartext
+        # collection writes go to the transient store and eligible peers
+        # BEFORE the endorsement is returned; only the hashed rwsets
+        # ride the public response.  A failed handoff (e.g. a
+        # collection's required_peer_count unmet) fails the endorsement,
+        # as the reference does.
+        pvt = (
+            sim.get_pvt_simulation_results()
+            if hasattr(sim, "get_pvt_simulation_results")
+            else None
+        )
+        if pvt is not None and self._pvt_handoff is not None:
+            try:
+                self._pvt_handoff(up.channel_header.tx_id, pvt)
+            except Exception as exc:
+                raise EndorserError(
+                    f"private data distribution failed: {exc}"
+                ) from exc
 
         # -- endorse (default endorsement plugin) --------------------------
         return protoutil.create_proposal_response(
