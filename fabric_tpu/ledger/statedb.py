@@ -320,7 +320,14 @@ class VersionedDB:
         # the persisted key below must MERGE with flags an out-of-band
         # writer (a second VersionedDB over this store) may have added
         # since we last loaded — rewriting a stale cached set would
-        # un-flag their namespaces and silently skip SBE checks
+        # un-flag their namespaces and silently skip SBE checks.
+        # ASSUMPTION: commits against one store are SERIALIZED (one
+        # committer per ledger — kvledger holds the commit lock, as the
+        # reference does).  Two VersionedDB instances committing
+        # CONCURRENTLY could still interleave this load with the other's
+        # write_batch and drop a freshly-added flag; the re-read narrows
+        # that window, it does not close it.  Concurrent committers
+        # would need the merge under the store's write lock.
         self._meta_ns = None
         meta_ns = self._load_meta_ns()
         for ns, kvs in batch.items():
