@@ -17,6 +17,7 @@ from fabric_tpu.csp.api import (
     VerifyBatchItem,
 )
 from fabric_tpu.csp.sw import SWCSP
+from fabric_tpu.csp.idemix_provider import IdemixCSP, IdemixVerifyItem
 from fabric_tpu.csp.factory import csp_from_config, get_default, init_factories
 from fabric_tpu.csp.keystore import (
     DummyKeyStore,
@@ -31,6 +32,8 @@ __all__ = [
     "ECDSAP256PrivateKey",
     "VerifyBatchItem",
     "SWCSP",
+    "IdemixCSP",
+    "IdemixVerifyItem",
     "get_default",
     "init_factories",
     "csp_from_config",

@@ -25,6 +25,18 @@ from fabric_tpu.idemix.credential import (
 from fabric_tpu.idemix.issuer import IssuerKey, IssuerPublicKey
 
 
+def _on_tpu() -> bool:
+    """True when jax resolves to a TPU backend (lazy: importing jax —
+    and initializing its backend — only happens once a batch actually
+    crosses the auto-select threshold)."""
+    try:
+        import jax
+
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
 @dataclasses.dataclass(frozen=True)
 class IdemixVerifyItem:
     """One (signature, message) pair for batched presentation verify."""
@@ -37,13 +49,26 @@ class IdemixCSP:
     """Stateless provider; keys are passed explicitly (reference keeps them
     behind bccsp.Key handles — our callers hold the dataclasses directly)."""
 
-    def __init__(self, rng=None, device: bool = False):
+    # Measured host/device crossover (BASELINE.md round-4 table: the
+    # Pallas ladder wins from ~100 signatures — 1.75x at 128, 2.97x at
+    # 1024; below it, per-dispatch overhead makes the host path faster).
+    DEVICE_CROSSOVER = 100
+
+    def __init__(self, rng=None, device: bool | None = None,
+                 device_crossover: int | None = None):
         self._rng = rng
-        # device=True batches the Schnorr commitment recomputation on
-        # the TPU (csp/tpu/bn254_batch.py); pairings stay native-host.
-        # Off by default: the kernel compiles per batch-shape bucket,
-        # which host-only flows should never pay for.
+        # device batches the Schnorr commitment recomputation on the
+        # TPU (csp/tpu/bn254_batch.py); pairings stay native-host.
+        # None (default) AUTO-SELECTS per batch: device at or above the
+        # measured crossover, host below it — so large batches hit the
+        # TPU without callers knowing the constant, and host-only flows
+        # never pay a kernel compile for small ones.  True/False force.
         self._device = device
+        self._crossover = (
+            device_crossover
+            if device_crossover is not None
+            else self.DEVICE_CROSSOVER
+        )
 
     # -- key generation (handlers/issuer.go, handlers/user.go) -------------
 
@@ -112,10 +137,20 @@ class IdemixCSP:
         self, items: Sequence[IdemixVerifyItem], ipk: IssuerPublicKey
     ) -> list[bool]:
         """Per-item mask, two pairings for the whole batch (BASELINE.md
-        BN256 batch-verify configuration)."""
+        BN256 batch-verify configuration).  Ref being beaten: the
+        reference verifies serially per signature
+        (idemix/signature.go:290)."""
+        if self._device is not None:
+            use_device = self._device
+        else:
+            # auto: device at or above the TPU-measured crossover, and
+            # only when a TPU backend is actually present — a CPU-only
+            # host must never pay the per-bucket kernel compile the
+            # host path exists to avoid
+            use_device = len(items) >= self._crossover and _on_tpu()
         fn = (
             signature.verify_batch_device
-            if self._device
+            if use_device
             else signature.verify_batch
         )
         return fn(

@@ -52,17 +52,23 @@ _MAX_LANES = _BUCKETS[-1]
 LANE_BASES = ("a_prime", "a_bar", "b_prime", "nym")
 _LANE_BASES = LANE_BASES  # backwards-compatible alias
 
-# set on the first Pallas failure so later batches skip straight to the
-# XLA engine instead of re-packing + re-failing + re-warning each time
-_PALLAS_BROKEN = [False]
+# Pallas failure bookkeeping, scoped per (batch, n_attrs) SHAPE with a
+# bounded retry budget: one transient failure (an OOM at an unusually
+# large bucket, a tunnel hiccup) must not permanently downgrade every
+# later batch to the ~3-4x slower XLA engine, while a shape that fails
+# repeatedly stops re-packing + re-failing + re-warning each time.
+_PALLAS_FAILURES: dict = {}
+_PALLAS_MAX_FAILURES = 2
 
 
-def _pallas_preferred() -> bool:
+def _pallas_preferred(shape=None) -> bool:
     """Use the Pallas engine only where it runs compiled: on the TPU
     backend (or when a test forces it — interpret mode executes the
     grid in Python and would be far slower than the XLA fallback it
     preempts on CPU/GPU hosts)."""
-    if _PALLAS_BROKEN[0] or os.environ.get("FABRIC_BN254_NO_PALLAS"):
+    if os.environ.get("FABRIC_BN254_NO_PALLAS"):
+        return False
+    if _PALLAS_FAILURES.get(shape, 0) >= _PALLAS_MAX_FAILURES:
         return False
     if os.environ.get("FABRIC_BN254_FORCE_PALLAS"):
         return True
@@ -304,21 +310,29 @@ def schnorr_commitments_batch(sigs, ipk) -> list | None:
     # field ops, pallas_bn254.py); the XLA scan kernel is the fallback
     # when Mosaic is unavailable or fails
     jac = None
-    if _pallas_preferred():
+    # budget key = the COMPILE bucket, not the raw batch length: every
+    # length padding to the same bucket shares one compiled kernel, so
+    # a deterministic failure is retried per compile unit, not per
+    # distinct batch size
+    bucket = next((b for b in _BUCKETS if len(ok) <= b), _MAX_LANES)
+    shape = (bucket, n_attrs)
+    if _pallas_preferred(shape):
         try:
             from fabric_tpu.csp.tpu import pallas_bn254
 
             jac = pallas_bn254.commitments(
                 pts_l, scalars_l, ok, term_table, term_acc, shared_pts
             )
+            _PALLAS_FAILURES.pop(shape, None)  # success resets the budget
         except Exception as exc:
             from fabric_tpu.common.flogging import must_get_logger
 
-            _PALLAS_BROKEN[0] = True  # don't re-pack + re-fail per batch
+            _PALLAS_FAILURES[shape] = _PALLAS_FAILURES.get(shape, 0) + 1
             must_get_logger("bn254").warning(
-                "pallas BN254 ladder failed (%s: %s); using the XLA path "
-                "for the rest of this process",
-                type(exc).__name__, exc,
+                "pallas BN254 ladder failed for shape %s (%s: %s), "
+                "failure %d/%d; using the XLA path for this batch",
+                shape, type(exc).__name__, exc,
+                _PALLAS_FAILURES[shape], _PALLAS_MAX_FAILURES,
             )
             jac = None
     if jac is None:
