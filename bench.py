@@ -20,6 +20,11 @@ memo (bccsp/sw/ecdsa.go:41 + common/policies/policy.go:365-402
 semantics), committing each block serially after validation the way
 coordinator.StoreBlock does (gossip/privdata/coordinator.go:149).
 
+Fairness: BOTH sides take best-of-N with the SAME N (4) over fresh
+on-disk ledgers, after one warmup each — on a time-shared chip/host an
+asymmetric N would score scheduling luck, not the pipeline
+(round-4 verdict, weak #5).
+
 Also reported: p99 block-validate latency (the second north-star
 metric) over every per-block validate duration observed on the
 measured path.
@@ -82,7 +87,7 @@ def main() -> None:
     )
     warm.store_block(copies(1)[0])  # EC backend init, native lib, protos
     base_best = float("inf")
-    for _ in range(2):
+    for _ in range(4):
         led = fresh_ledger()
         committer = Committer(
             TxValidator("benchch", led, bundle, sw, faithful=True), led
@@ -100,7 +105,11 @@ def main() -> None:
     try:
         from fabric_tpu.csp.tpu.provider import TPUCSP
 
-        csp = TPUCSP(min_device_batch=1)
+        # flush/depth point measured on the real chip (round-5 sweep):
+        # ~1-block flushes at depth 6 beat the old 2-block flushes at
+        # depth 4 — the fixed dispatch cost amortizes worse than the
+        # lost overlap from waiting for a second block's lanes
+        csp = TPUCSP(min_device_batch=1, coalesce_lanes=4096)
         wl2 = fresh_ledger()
         Committer(
             TxValidator("benchch", wl2, bundle, csp), wl2
@@ -109,12 +118,12 @@ def main() -> None:
         csp = sw
 
     best = float("inf")
-    for _ in range(8):
+    for _ in range(4):
         led = fresh_ledger()
         committer = Committer(TxValidator("benchch", led, bundle, csp), led)
         bs = copies(n_blocks)
         t0 = time.perf_counter()
-        for flags in committer.store_stream(iter(bs), depth=4):
+        for flags in committer.store_stream(iter(bs), depth=6):
             assert all(f == 0 for f in flags)
         best = min(best, time.perf_counter() - t0)
         assert led.height == 1 + n_blocks

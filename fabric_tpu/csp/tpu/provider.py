@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import hashlib
 import threading
+import time
 from typing import Sequence
 
 import numpy as np
@@ -176,7 +177,8 @@ class _FlushResult:
 
     def __init__(self, pending, total_lanes: int,
                  host_items=(), sw: SWCSP | None = None,
-                 device_items=None, deadline: float | None = None):
+                 device_items=None, deadline: float | None = None,
+                 on_device_wall=None):
         self._pending = pending  # [(collect, kept_lanes)]
         self._mask: list[bool] | None = None
         self._exc: Exception | None = None
@@ -190,6 +192,11 @@ class _FlushResult:
         # host-race fallback needs them to re-verify independently
         self._device_items = device_items
         self.deadline = deadline
+        # deadline-calibration feedback: called (lanes, seconds) when
+        # the DEVICE supplied the mask (provider EWMA, see _dispatch)
+        self._on_device_wall = on_device_wall
+        self._n_device_lanes = len(device_items) if device_items else 0
+        self._t0 = time.perf_counter()
         self._seal_lock = threading.Lock()
         self._wait_lock = threading.Lock()
         self._done = threading.Event()
@@ -228,7 +235,7 @@ class _FlushResult:
                 # (that overlap is the whole point of host_fraction);
                 # the result order stays device-lanes-then-host-lanes
                 host_mask = (
-                    self._sw.verify_batch(host_items) if host_items else []
+                    self._host_verify(host_items) if host_items else []
                 )
                 out: list[bool] = []
                 for collect, keep in pending:
@@ -243,15 +250,43 @@ class _FlushResult:
                     # still answer (same degradation _flush_locked
                     # applies to dispatch-time failures)
                     try:
-                        out = list(self._sw.verify_batch(device_items))
-                        out.extend(self._sw.verify_batch(host_items))
+                        out = list(self._host_verify(device_items))
+                        out.extend(self._host_verify(host_items))
                         self._seal(out)
                         return
                     except Exception as e2:
                         e = e2
                 self._seal(None, e)
                 return
+            if (
+                self._on_device_wall is not None
+                and self._n_device_lanes
+                and not host_items
+            ):
+                # feed the provider's flush-wall EWMA — only from walls
+                # the device actually produced (a host-race win says
+                # nothing about chip speed), and only for pure-device
+                # flushes: with a host tail the wall includes the
+                # tail's serial verify and would inflate the per-lane
+                # estimate toward the anchor cap
+                self._on_device_wall(
+                    self._n_device_lanes, time.perf_counter() - self._t0
+                )
             self._seal(out)
+
+    def _host_verify(self, items):
+        """Host verification preferring the native libcrypto batch
+        (native/ecverify.cc) — GIL-free and a multiple of the
+        python-per-signature rate on hosts with a fast libcrypto; the
+        python engine is the fallback oracle."""
+        if not items:
+            return []
+        from fabric_tpu import native
+
+        mask = native.ecdsa_verify_host(items)
+        if mask is not None:
+            return mask
+        return self._sw.verify_batch(items)
 
     def _host_race(self) -> bool:
         """Deadline expired: verify this flush's items on the host,
@@ -266,7 +301,7 @@ class _FlushResult:
             if self._done.is_set():
                 return False  # device finished after all — use it
             out.extend(
-                self._sw.verify_batch(items[off:off + self._RACE_STEP])
+                self._host_verify(items[off:off + self._RACE_STEP])
             )
         self._seal(out)
         return True
@@ -328,14 +363,22 @@ class TPUCSP(CSP):
         # not a pre-committed split.
         self._host_fraction = host_fraction
         # -- stall deadline (p99 control): a consumer that finds its
-        # flush unfinished `stall_factor * lanes / host_rate` seconds
-        # after asking starts racing the chip with host verification
-        # (see _FlushResult).  Anchored to HOST speed, not an EMA of
-        # chip speed, so a chronically time-share-starved chip window
-        # still gets beaten instead of normalized: per-flush wall is
-        # capped near 2x the pure-host cost in the worst window.
+        # flush unfinished at the deadline starts racing the chip with
+        # host verification (see _FlushResult).  The deadline is a
+        # PER-BLOCK LATENCY BUDGET: 1.5x the EWMA-predicted flush wall
+        # (per-lane rate learned from completed device flushes, floor
+        # 0.15 s), CAPPED by the host anchor
+        # `stall_factor * lanes / host_rate` — the cap keeps a
+        # chronically time-share-starved chip window from normalizing
+        # its own slowness into ever-longer deadlines: per-flush wall
+        # stays near 2x the pure-host cost in the worst window, and in
+        # ordinary windows the EWMA keeps the race trigger tight enough
+        # that a single stalled flush costs ~deadline + host-verify,
+        # not the anchor.
         self._stall_factor = stall_factor
         self._host_rate = host_rate_hint
+        self._lane_wall_ewma: float | None = None  # s/lane, device flushes
+        self._ewma_lock = threading.Lock()
         self._pend_lock = threading.RLock()
         self._pend_batches: list = []  # list[Sequence[VerifyBatchItem]]
         self._pend_lanes = 0
@@ -485,6 +528,18 @@ class TPUCSP(CSP):
             used.append(dev)
             return dev
 
+        # Hybrid split (both backends): a tail of the flush verifies on
+        # the host DURING the device wait (see _FlushResult.collect) —
+        # sized so host time stays under the device execution's fixed
+        # cost.  The virtual-mesh dryrun leans on this to keep its
+        # device leg small while still exercising real mesh dispatch.
+        host_items: Sequence[VerifyBatchItem] = ()
+        if self._host_fraction > 0 and len(items) >= 2048:
+            h = int(len(items) * self._host_fraction)
+            if h:
+                host_items = items[len(items) - h:]
+                items = items[:len(items) - h]
+
         if jax.default_backend() != "tpu":
             # The fused kernel is TPU-only (Mosaic); other backends get
             # the portable XLA kernel (interpreted Pallas would be
@@ -505,20 +560,12 @@ class TPUCSP(CSP):
                 pending.append((ec.verify_prepared(**prep), keep))
             self.last_dispatch_devices = tuple(dict.fromkeys(used))
             return _FlushResult(
-                pending, len(items), sw=self._sw, device_items=list(items)
+                pending, len(items) + len(host_items),
+                host_items=host_items, sw=self._sw,
+                device_items=list(items),
             )
 
         from fabric_tpu.csp.tpu import pallas_ec
-
-        # Hybrid split: a small tail of the flush verifies on the host
-        # DURING the device wait (see _FlushResult.collect) — sized so
-        # host time stays under the device execution's fixed cost.
-        host_items: Sequence[VerifyBatchItem] = ()
-        if self._host_fraction > 0 and len(items) >= 2048:
-            h = int(len(items) * self._host_fraction)
-            if h:
-                host_items = items[len(items) - h:]
-                items = items[:len(items) - h]
 
         # Chunked pipeline over the fused Pallas kernel: every chunk is
         # dispatched (host prep + async device call) before any result is
@@ -609,16 +656,37 @@ class TPUCSP(CSP):
                     }
                 pending.append((pallas_ec.verify_packed(packed), keep))
         self.last_dispatch_devices = tuple(dict.fromkeys(used))
-        deadline = None
-        if self._stall_factor is not None:
-            deadline = max(
-                0.2, self._stall_factor * len(items) / self._host_rate
-            )
         return _FlushResult(
             pending, len(items) + len(host_items),
             host_items=host_items, sw=self._sw,
-            device_items=list(items), deadline=deadline,
+            device_items=list(items),
+            deadline=self._deadline_for(len(items)),
+            on_device_wall=self._note_device_wall,
         )
+
+    def _note_device_wall(self, lanes: int, wall: float) -> None:
+        """EWMA of per-lane device flush wall (dispatch -> mask),
+        fed only by flushes the DEVICE completed."""
+        if lanes <= 0 or wall <= 0:
+            return
+        per_lane = wall / lanes
+        with self._ewma_lock:
+            cur = self._lane_wall_ewma
+            self._lane_wall_ewma = (
+                per_lane if cur is None else 0.7 * cur + 0.3 * per_lane
+            )
+
+    def _deadline_for(self, lanes: int) -> float | None:
+        """Per-flush latency budget: 1.5x the EWMA-predicted wall,
+        floored at 0.15 s, capped by the host anchor (see __init__)."""
+        if self._stall_factor is None:
+            return None
+        anchor = max(0.2, self._stall_factor * lanes / self._host_rate)
+        with self._ewma_lock:
+            per_lane = self._lane_wall_ewma
+        if per_lane is None:
+            return anchor
+        return max(0.15, min(1.5 * per_lane * lanes, anchor))
 
     def _tuple_chunks(self, items, min_bucket: int = 0):
         """(padded tuple chunk, kept lanes) pairs for the non-native

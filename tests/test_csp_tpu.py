@@ -74,6 +74,32 @@ def _signed_items(n, sw=None):
     return out
 
 
+def test_deadline_ewma_budget():
+    """The stall deadline is a latency budget: host anchor until the
+    EWMA is primed, then 1.5x the predicted flush wall clamped to
+    [0.15s, anchor] — so ordinary windows race early while a starved
+    chip window cannot inflate its own deadline past the host cost."""
+    csp = TPUCSP(stall_factor=1.0, host_rate_hint=10000.0)
+    # unprimed: the anchor (lanes/host_rate, floor 0.2)
+    assert csp._deadline_for(4000) == 0.4
+    assert csp._deadline_for(100) == 0.2
+    # primed with a fast chip: tight budget, floored at 0.15
+    for _ in range(4):
+        csp._note_device_wall(4000, 0.08)  # 20 us/lane -> 50 klane/s
+    d = csp._deadline_for(4000)
+    assert abs(d - 0.15) < 1e-9 or d < 0.2  # 1.5*0.08=0.12 -> floor 0.15
+    # a big flush scales linearly but stays under the anchor
+    d = csp._deadline_for(16000)
+    assert 0.15 <= d <= 1.6
+    assert abs(d - 1.5 * (0.08 / 4000) * 16000) < 1e-9
+    # a starved window (chip 10x slower) is capped by the anchor
+    for _ in range(12):
+        csp._note_device_wall(4000, 3.2)
+    assert csp._deadline_for(4000) == 0.4  # anchor, not 1.5*3.2
+    # disabled stall factor -> no deadline at all
+    assert TPUCSP(stall_factor=None)._deadline_for(4000) is None
+
+
 def test_flush_deadline_host_race_beats_stalled_device():
     """A device that never answers is beaten by the host race after the
     deadline; mask matches the host oracle exactly."""

@@ -207,13 +207,16 @@ class TestIdemixCSPDeviceSelect:
         )
         assert calls == ["device", "host", "device"]
 
-    def test_auto_device_path_is_correct(self, issuer, user):
+    def test_auto_device_path_is_correct(self, issuer, user, monkeypatch):
         """Real (un-mocked) dispatch above the crossover must produce
         the same mask as the host path — parity at the provider level.
-        Uses a lowered crossover so the suite stays fast; the device
-        engine transparently falls back to XLA off-TPU."""
+        Uses a lowered crossover so the suite stays fast; _on_tpu is
+        forced True (the suite runs on CPU) so the REAL
+        verify_batch_device call executes via its XLA fallback."""
         from fabric_tpu.csp import IdemixCSP, IdemixVerifyItem
+        from fabric_tpu.csp import idemix_provider as ip
 
+        monkeypatch.setattr(ip, "_on_tpu", lambda: True)
         sk, cred = user
         msgs = [b"b%d" % i for i in range(6)]
         sigs = [

@@ -131,6 +131,25 @@ def test_sw_path(world):
         assert g == e, f"sw disagrees on {n}: got {g}, want {e}"
 
 
+def test_native_host_verify_path(world):
+    """The libcrypto batch verifier (native/ecverify.cc — the TPU
+    provider's chip-stall fallback) must agree with the sw oracle on
+    the full DER/scalar corpus: a laxer native parse would let a
+    stalled-chip window change which signatures a block accepts."""
+    import pytest
+
+    from fabric_tpu import native
+
+    if not native.available():
+        pytest.skip("native unavailable")
+    names, expect, items = _expected_and_names(world)
+    got = native.ecdsa_verify_host(items)
+    if got is None:
+        pytest.skip("libcrypto unavailable")
+    for n, e, g in zip(names, expect, got):
+        assert g == e, f"native host verify disagrees on {n}: got {g}, want {e}"
+
+
 def test_xla_kernel_path(world):
     from fabric_tpu.csp.tpu import ec
 
