@@ -107,13 +107,17 @@ class RPCError(Exception):
 
 
 def _read_exact(sock, n: int) -> bytes | None:
-    buf = b""
+    # recv(k) allocates a k-byte buffer up front, so the chunk size must
+    # be capped: a client declaring a ~100MB frame and sending nothing
+    # would otherwise pin ~100MB of allocation PER CONNECTION while the
+    # idle timeout runs down (found by the framing fuzzer).
+    buf = bytearray()
     while len(buf) < n:
-        got = sock.recv(n - len(buf))
+        got = sock.recv(min(n - len(buf), 1 << 18))
         if not got:
             return None
         buf += got
-    return buf
+    return bytes(buf)
 
 
 def read_frame(sock) -> bytes | None:
@@ -192,11 +196,23 @@ class _Handler(socketserver.BaseRequestHandler):
                 frame = read_frame(sock)
             except socket.timeout:
                 return  # reaped: no request within the idle window
+            except RPCError as exc:  # oversized frame declaration
+                write_frame(sock, bytes([KIND_ERR]) + str(exc).encode())
+                return
             if frame is None or not frame:
                 return
             sock.settimeout(None)  # handler-controlled from here on
             mlen = frame[0]
-            method = frame[1:1 + mlen].decode("utf-8")
+            try:
+                # a method length pointing past the frame or bytes that
+                # are not UTF-8 is a malformed request, not a server
+                # error: answer ERR and drop the connection cleanly
+                if 1 + mlen > len(frame):
+                    raise ValueError("method length exceeds frame")
+                method = frame[1:1 + mlen].decode("utf-8")
+            except (ValueError, UnicodeDecodeError):
+                write_frame(sock, bytes([KIND_ERR]) + b"malformed request")
+                return
             body = frame[1 + mlen:]
             fn = server.methods.get(method)
             if fn is None:

@@ -173,7 +173,11 @@ class _FlushResult:
     instead of an unbounded chip wait.  Late device results are simply
     discarded."""
 
-    _RACE_STEP = 192  # host mini-batch between device-completion polls
+    # host mini-batch between device-completion polls: sized so a poll
+    # happens every ~20-100ms — larger when the native batch verifier
+    # is in play (its per-call key setup amortizes over the chunk)
+    _RACE_STEP = 192
+    _RACE_STEP_NATIVE = 1024
 
     def __init__(self, pending, total_lanes: int,
                  host_items=(), sw: SWCSP | None = None,
@@ -295,14 +299,19 @@ class _FlushResult:
         device_items, host_items = self._device_items, self._host_items
         if device_items is None:
             return False  # sealed concurrently: use the device mask
+        from fabric_tpu import native
+
+        step = (
+            self._RACE_STEP_NATIVE
+            if native.available()
+            else self._RACE_STEP
+        )
         items = list(device_items) + list(host_items)
         out: list[bool] = []
-        for off in range(0, len(items), self._RACE_STEP):
+        for off in range(0, len(items), step):
             if self._done.is_set():
                 return False  # device finished after all — use it
-            out.extend(
-                self._host_verify(items[off:off + self._RACE_STEP])
-            )
+            out.extend(self._host_verify(items[off:off + step]))
         self._seal(out)
         return True
 

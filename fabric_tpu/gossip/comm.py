@@ -116,14 +116,33 @@ class GossipComm:
         )
 
     def _dispatch(self, signed: gpb.SignedGossipMessage, sender_pki: bytes, respond):
-        msg = gpb.GossipMessage.FromString(signed.payload)
+        try:
+            msg = gpb.GossipMessage.FromString(signed.payload)
+        except Exception:
+            return  # malformed payload: drop, never kill the serving loop
+        # Every message must verify under the sender's HANDSHAKE-bound
+        # identity.  The old form skipped verification for UNSIGNED
+        # messages, so a peer that completed a handshake could inject
+        # arbitrary gossip without its MCS ever seeing a signature
+        # (found while fuzzing this surface; the permissive dev-default
+        # MCS still accepts everything by its own choice).
         ident = self.identity_of(sender_pki)
-        if signed.signature and ident is not None:
-            if not self.mcs.verify(ident, signed.signature, signed.payload):
-                return  # forged
+        if ident is None:
+            return  # no handshake-learned identity: unauthenticated
+        if not self.mcs.verify(ident, signed.signature, signed.payload):
+            return  # forged or unsigned
         rm = ReceivedMessage(msg, sender_pki, respond)
         for h in list(self._subscribers):
-            h(rm)
+            try:
+                h(rm)
+            except Exception:
+                # one subscriber's bug must not starve the others or
+                # tear down the connection's serving loop
+                from fabric_tpu.common.flogging import must_get_logger
+
+                must_get_logger("gossip.comm").warning(
+                    "gossip subscriber raised", exc_info=True
+                )
 
 
 class InProcGossipNet:
@@ -284,14 +303,20 @@ class TCPGossipComm(GossipComm):
                 return
             threading.Thread(target=self._serve, args=(conn,), daemon=True).start()
 
-    @staticmethod
-    def _read_frame(conn, buf: bytearray) -> bytes | None:
+    # same bound as the RPC transport's frame cap: a peer declaring a
+    # multi-GB frame must be cut off, not streamed into memory
+    _MAX_FRAME = 100 * 1024 * 1024
+
+    @classmethod
+    def _read_frame(cls, conn, buf: bytearray) -> bytes | None:
         while len(buf) < _LEN.size:
             chunk = conn.recv(65536)
             if not chunk:
                 return None
             buf.extend(chunk)
         (ln,) = _LEN.unpack_from(bytes(buf[: _LEN.size]))
+        if ln > cls._MAX_FRAME:
+            return None  # oversized declaration: drop the connection
         while len(buf) < _LEN.size + ln:
             chunk = conn.recv(65536)
             if not chunk:
@@ -315,7 +340,10 @@ class TCPGossipComm(GossipComm):
             frame = self._read_frame(conn, buf)
             if frame is None:
                 return
-            ce = gpb.ConnEstablish.FromString(frame)
+            try:
+                ce = gpb.ConnEstablish.FromString(frame)
+            except Exception:
+                return  # malformed handshake: clean drop, no traceback
             if self.mcs.get_pki_id(ce.identity) != ce.pki_id:
                 return  # identity/pki mismatch
             sig_payload = (
@@ -357,9 +385,11 @@ class TCPGossipComm(GossipComm):
                 frame = self._read_frame(conn, buf)
                 if frame is None:
                     return
-                self._dispatch(
-                    gpb.SignedGossipMessage.FromString(frame), sender_pki, respond
-                )
+                try:
+                    sm = gpb.SignedGossipMessage.FromString(frame)
+                except Exception:
+                    continue  # malformed frame: drop it, keep serving
+                self._dispatch(sm, sender_pki, respond)
         except OSError:
             return
         finally:

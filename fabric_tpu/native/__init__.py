@@ -156,9 +156,11 @@ def ecdsa_verify_host(items) -> list[bool] | None:
         key = it.key
         pub = key.public_key() if hasattr(key, "public_key") else key
         try:
-            qxy[64 * i:64 * i + 32] = pub.x.to_bytes(32, "big")
-            qxy[64 * i + 32:64 * i + 64] = pub.y.to_bytes(32, "big")
-        except (AttributeError, OverflowError):
+            # the key caches its 32-byte big-endian coordinates exactly
+            # for hot-path marshalling — no per-lane int conversion
+            qxy[64 * i:64 * i + 32] = pub.x_bytes
+            qxy[64 * i + 32:64 * i + 64] = pub.y_bytes
+        except (AttributeError, ValueError):
             pass  # zeroed key never validates a real signature
         d = it.digest
         if len(d) == 32:

@@ -1,0 +1,345 @@
+"""Seeded wire fuzzers for the two remaining transport surfaces
+(round-4 verdict #8, same harness style as test_fuzz_envelopes): the
+framed RPC transport (comm/rpc.py) and the TCP gossip comm
+(gossip/comm.py).  The reference covers this layer with its
+race-detector/sanitizer CI (scripts/run-unit-tests.sh); here the
+properties are behavioral: no abuse kills the server, no malformed
+frame kills a serving loop, declared lengths never buy unbounded
+allocation, and unauthenticated/unsigned gossip never reaches
+subscribers.
+
+Findings this suite pinned when first written:
+  - a client declaring a ~100MB frame pinned a ~100MB recv() buffer
+    per connection (comm/rpc.py _read_exact now caps recv chunks);
+  - a malformed SignedGossipMessage killed the TCP serving thread
+    (DecodeError escaped the loop);
+  - an UNSIGNED gossip message from a handshaken peer dispatched
+    without the MCS ever seeing a signature;
+  - one raising subscriber starved every later subscriber.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import random
+import socket
+import struct
+import threading
+import time
+
+import pytest
+
+from fabric_tpu.comm import RPCClient, RPCServer
+from fabric_tpu.comm.rpc import KIND_DATA, KIND_END, KIND_ERR
+from fabric_tpu.gossip.comm import MessageCryptoService, TCPGossipComm
+from fabric_tpu.protos.gossip import message_pb2 as gpb
+
+_LEN = struct.Struct(">I")
+
+
+def _wait(pred, timeout=5.0):
+    end = time.time() + timeout
+    while time.time() < end:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+# ---------------------------------------------------------------------------
+# RPC framing
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def rpc_server():
+    srv = RPCServer("127.0.0.1", 0)
+    srv.register("echo.Echo", lambda body, stream: b"ok:" + body)
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def _alive(srv) -> bool:
+    """The liveness oracle: a well-formed request round-trips."""
+    return RPCClient(*srv.addr, timeout=5.0).call("echo.Echo", b"ping") == b"ok:ping"
+
+
+def _send_raw(addr, payload: bytes, close_early: bool = False) -> bytes:
+    """Open a raw connection, send bytes, read whatever comes back.  A
+    reset mid-send/receive is a legitimate server response to abuse."""
+    s = socket.create_connection(addr, timeout=3)
+    out = b""
+    try:
+        try:
+            s.sendall(payload)
+            if close_early:
+                return b""
+            s.settimeout(1.5)
+            while True:
+                got = s.recv(65536)
+                if not got:
+                    break
+                out += got
+        except OSError:
+            pass
+        return out
+    finally:
+        s.close()
+
+
+def _valid_request(method: bytes, body: bytes) -> bytes:
+    frame = bytes([len(method)]) + method + body
+    return _LEN.pack(len(frame)) + frame
+
+
+def test_rpc_framing_fuzz_server_survives(rpc_server):
+    """Seeded mutants of the request framing: every abuse either gets a
+    clean ERR or a dropped connection — and the server answers a valid
+    request after each one."""
+    rng = random.Random(90210)
+    addr = rpc_server.addr
+    abuses = [
+        b"",                                     # connect + close
+        b"\x00",                                 # partial length prefix
+        _LEN.pack(10),                           # declared 10, sent 0
+        _LEN.pack(5) + b"ab",                    # truncated body
+        _LEN.pack(0),                            # empty frame
+        _LEN.pack(1) + b"\xff",                  # mlen 255 > frame
+        _LEN.pack(6) + bytes([4]) + b"\xff\xfe\xfd\xfc" + b"x",  # bad UTF-8
+        _valid_request(b"no.Such", b""),          # unknown method
+        _LEN.pack(200 * 1024 * 1024),            # oversized declaration
+    ]
+    for i in range(40):
+        abuses.append(bytes(rng.randrange(256) for _ in range(rng.randrange(1, 64))))
+    for i, raw in enumerate(abuses):
+        _send_raw(addr, raw, close_early=(i % 3 == 2))
+        assert _alive(rpc_server), f"server died after abuse #{i}: {raw[:16]!r}"
+
+
+def test_rpc_oversized_declaration_rejected_without_read(rpc_server):
+    """A frame declaring more than the 100MB limit is refused up front
+    with an ERR frame — the server never tries to read (or buffer) the
+    declared payload."""
+    out = _send_raw(rpc_server.addr, _LEN.pack(101 * 1024 * 1024))
+    assert out[:4] == _LEN.pack(len(out) - 4)
+    assert out[4] == KIND_ERR
+    assert b"too large" in out[5:]
+    assert _alive(rpc_server)
+
+
+def test_rpc_malformed_method_gets_err_frame(rpc_server):
+    out = _send_raw(rpc_server.addr, _LEN.pack(1) + b"\x10")  # mlen 16 > 0
+    assert out and out[4] == KIND_ERR and b"malformed" in out
+    assert _alive(rpc_server)
+
+
+def test_rpc_valid_after_interleaved_garbage(rpc_server):
+    """Valid requests interleave with garbage connections; every valid
+    one must round-trip exactly."""
+    rng = random.Random(7)
+    for i in range(10):
+        _send_raw(
+            rpc_server.addr,
+            bytes(rng.randrange(256) for _ in range(rng.randrange(0, 32))),
+        )
+        got = RPCClient(*rpc_server.addr, timeout=5.0).call(
+            "echo.Echo", b"n%d" % i
+        )
+        assert got == b"ok:n%d" % i
+
+
+def test_rpc_tls_garbage_and_truncated_records():
+    """Plaintext garbage and a truncated TLS record against a TLS
+    server: both die in the handshake without hurting the listener."""
+    from fabric_tpu.common.crypto import CA
+    from fabric_tpu.comm.tls import credentials_from_ca
+
+    ca = CA("fuzz-tls-ca", "org1")
+    creds = credentials_from_ca(ca, "server")
+    srv = RPCServer("127.0.0.1", 0, tls=creds)
+    srv.register("echo.Echo", lambda body, stream: b"ok:" + body)
+    srv.start()
+    try:
+        rng = random.Random(4)
+        # plaintext garbage (no TLS at all)
+        _send_raw(srv.addr, bytes(rng.randrange(256) for _ in range(40)))
+        # a plausible TLS record header, then silence/close (truncated
+        # handshake record)
+        _send_raw(srv.addr, b"\x16\x03\x01\x40\x00" + b"\x01" * 10,
+                  close_early=True)
+        # a record whose declared length never arrives
+        _send_raw(srv.addr, b"\x16\x03\x03\xff\xff" + b"\x02" * 5,
+                  close_early=True)
+        client = RPCClient(
+            *srv.addr, timeout=5.0, tls=credentials_from_ca(ca, "client")
+        )
+        assert client.call("echo.Echo", b"tls") == b"ok:tls"
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# TCP gossip comm
+# ---------------------------------------------------------------------------
+
+
+class _ToyMCS(MessageCryptoService):
+    """Shared-secret signer: real (verifiable) signatures without MSPs,
+    and — unlike the permissive base class — REJECTS bad ones."""
+
+    def sign(self, payload: bytes) -> bytes:
+        return hashlib.sha256(b"fuzz-secret" + payload).digest()
+
+    def verify(self, identity: bytes, signature: bytes, payload: bytes) -> bool:
+        return signature == hashlib.sha256(b"fuzz-secret" + payload).digest()
+
+
+def _data_msg(payload: bytes) -> gpb.GossipMessage:
+    m = gpb.GossipMessage()
+    m.data_msg.block = payload
+    m.data_msg.seq_num = 1
+    return m
+
+
+def _handshake(mcs: _ToyMCS, identity: bytes, endpoint: str) -> bytes:
+    ce = gpb.ConnEstablish(
+        pki_id=mcs.get_pki_id(identity), identity=identity,
+        endpoint=endpoint,
+    )
+    ce.signature = mcs.sign(bytes(ce.pki_id) + b"" + endpoint.encode())
+    raw = ce.SerializeToString()
+    return _LEN.pack(len(raw)) + raw
+
+
+def _signed_frame(mcs: _ToyMCS, msg: gpb.GossipMessage) -> bytes:
+    payload = msg.SerializeToString()
+    sm = gpb.SignedGossipMessage(payload=payload, signature=mcs.sign(payload))
+    raw = sm.SerializeToString()
+    return _LEN.pack(len(raw)) + raw
+
+
+def test_gossip_frame_fuzz_connection_survives():
+    """After a VALID handshake, mutated frames (garbage, truncated
+    protos, oversized declarations on fresh connections) must never
+    stop the receiver from processing a later valid message."""
+    rng = random.Random(1337)
+    mcs = _ToyMCS()
+    b = TCPGossipComm(("127.0.0.1", 0), b"idB", mcs=mcs)
+    got = []
+    b.subscribe(lambda rm: got.append(bytes(rm.msg.data_msg.block)))
+    try:
+        host, port = b.endpoint.rsplit(":", 1)
+        s = socket.create_connection((host, int(port)), timeout=3)
+        s.sendall(_handshake(mcs, b"idA", "127.0.0.1:1"))
+        # malformed protobuf frames on the SAME connection
+        for _ in range(25):
+            junk = bytes(
+                rng.randrange(256) for _ in range(rng.randrange(1, 80))
+            )
+            s.sendall(_LEN.pack(len(junk)) + junk)
+        # then a valid signed message — the serving loop must still run
+        s.sendall(_signed_frame(mcs, _data_msg(b"after-junk")))
+        assert _wait(lambda: b"after-junk" in got), (
+            "serving loop died on malformed frames"
+        )
+        s.close()
+        # an oversized frame declaration drops the connection (no
+        # unbounded buffering) but not the listener
+        s2 = socket.create_connection((host, int(port)), timeout=3)
+        s2.sendall(_handshake(mcs, b"idA", "127.0.0.1:1"))
+        s2.sendall(_LEN.pack(2 ** 31))
+        s2.close()
+        a = TCPGossipComm(("127.0.0.1", 0), b"idC", mcs=mcs)
+        try:
+            a.send(b.endpoint, _data_msg(b"fresh-peer"))
+            assert _wait(lambda: b"fresh-peer" in got)
+        finally:
+            a.close()
+    finally:
+        b.close()
+
+
+def test_gossip_malformed_handshake_dropped_cleanly():
+    """Garbage in the HANDSHAKE position (first frame) must drop the
+    connection without a traceback — and without hurting the listener
+    (the one malformed-input path the first hardening pass missed)."""
+    rng = random.Random(99)
+    mcs = _ToyMCS()
+    b = TCPGossipComm(("127.0.0.1", 0), b"idB", mcs=mcs)
+    got = []
+    b.subscribe(lambda rm: got.append(bytes(rm.msg.data_msg.block)))
+    try:
+        host, port = b.endpoint.rsplit(":", 1)
+        for _ in range(15):
+            junk = bytes(
+                rng.randrange(256) for _ in range(rng.randrange(1, 60))
+            )
+            s = socket.create_connection((host, int(port)), timeout=3)
+            try:
+                s.sendall(_LEN.pack(len(junk)) + junk)
+            except OSError:
+                pass
+            s.close()
+        a = TCPGossipComm(("127.0.0.1", 0), b"idA", mcs=mcs)
+        try:
+            a.send(b.endpoint, _data_msg(b"still-alive"))
+            assert _wait(lambda: b"still-alive" in got)
+        finally:
+            a.close()
+    finally:
+        b.close()
+
+
+def test_gossip_unsigned_message_dropped():
+    """A handshaken peer sending a WELL-FORMED but unsigned message must
+    not reach subscribers (per-message signatures are mandatory; the
+    old dispatch skipped verification when the signature was empty)."""
+    mcs = _ToyMCS()
+    b = TCPGossipComm(("127.0.0.1", 0), b"idB", mcs=mcs)
+    got = []
+    b.subscribe(lambda rm: got.append(bytes(rm.msg.data_msg.block)))
+    try:
+        host, port = b.endpoint.rsplit(":", 1)
+        s = socket.create_connection((host, int(port)), timeout=3)
+        s.sendall(_handshake(mcs, b"idA", "127.0.0.1:1"))
+        sm = gpb.SignedGossipMessage(
+            payload=_data_msg(b"unsigned").SerializeToString()
+        ).SerializeToString()
+        s.sendall(_LEN.pack(len(sm)) + sm)
+        # forged signature is dropped too
+        sm2 = gpb.SignedGossipMessage(
+            payload=_data_msg(b"forged").SerializeToString(),
+            signature=b"\x00" * 32,
+        ).SerializeToString()
+        s.sendall(_LEN.pack(len(sm2)) + sm2)
+        # and a properly signed one on the same connection still lands
+        s.sendall(_signed_frame(mcs, _data_msg(b"signed")))
+        assert _wait(lambda: b"signed" in got)
+        assert b"unsigned" not in got and b"forged" not in got
+        s.close()
+    finally:
+        b.close()
+
+
+def test_gossip_subscriber_exception_isolated():
+    """One raising subscriber must not starve later subscribers or kill
+    the connection's serving loop."""
+    mcs = _ToyMCS()
+    b = TCPGossipComm(("127.0.0.1", 0), b"idB", mcs=mcs)
+    got = []
+
+    def bad(rm):
+        raise RuntimeError("buggy subscriber")
+
+    b.subscribe(bad)
+    b.subscribe(lambda rm: got.append(bytes(rm.msg.data_msg.block)))
+    a = TCPGossipComm(("127.0.0.1", 0), b"idA", mcs=mcs)
+    try:
+        a.send(b.endpoint, _data_msg(b"first"))
+        assert _wait(lambda: b"first" in got)
+        a.send(b.endpoint, _data_msg(b"second"))  # same connection reused
+        assert _wait(lambda: b"second" in got)
+    finally:
+        a.close()
+        b.close()
