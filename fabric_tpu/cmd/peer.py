@@ -100,6 +100,9 @@ def cmd_node_start(args) -> int:
             identity_ttl_s=cfg.get_duration(
                 "peer.gossip.identityExpiration", 3600.0
             ),
+            reconcile_interval_s=cfg.get_duration(
+                "peer.gossip.pvtData.reconcileSleepInterval", 60.0
+            ),
         )
     node.start()
     print(f"peer listening on {node.addr[0]}:{node.addr[1]}", flush=True)

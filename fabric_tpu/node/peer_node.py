@@ -611,7 +611,11 @@ class PeerNode:
         self._gossip_runner = GossipRunner(self.gossip, tick_interval_s)
         self._gossip_runner.start()
         # background private-data repair (reference reconcile.go runs on
-        # peer.gossip.pvtData.reconcileSleepInterval, default 1m)
+        # peer.gossip.pvtData.reconcileSleepInterval, default 1m).  A
+        # non-positive interval would busy-spin Event.wait(0); clamp to
+        # a floor (the reference disables reconciliation rather than
+        # spin — a 1s floor keeps the repair property without the burn)
+        reconcile_interval_s = max(1.0, float(reconcile_interval_s))
         self._reconcile_stop = threading.Event()
 
         def reconcile_loop():

@@ -461,6 +461,7 @@ class TxValidator:
             txid_known = lambda t: t in ledger_dups  # noqa: E731
         else:
             txid_known = self._ledger.tx_id_exists
+        ident_intern: dict = {}  # endorser cert slice -> canonical object
         creator_off_l = co["creator_off"].tolist()
         creator_len_l = co["creator_len"].tolist()
         sig_off_l = co["sig_off"].tolist()
@@ -531,10 +532,18 @@ class TxValidator:
             prp_bytes = sl(prp_off_l[i], prp_len_l[i])
             rwset_bytes = sl(rwset_off_l[i], rwset_len_l[i])
             es, ec = endo_start_l[i], endo_count_l[i]
+            # intern the endorser identity slices: a block repeats the
+            # same handful of ~1KB certs thousands of times, and fresh
+            # bytes objects re-hash fully at every endorsement-plan
+            # cache lookup (validation_plugins._plan_pending keys on
+            # the identity tuple) — the intern makes every repeat the
+            # SAME object with its hash computed once
             signed = [
                 SignedData(
                     b"",
-                    sl(ee_off[k], ee_len[k]),
+                    ident_intern.setdefault(
+                        _ik := sl(ee_off[k], ee_len[k]), _ik
+                    ),
                     sl(es_off[k], es_len[k]),
                     digest=edigs[32 * k:32 * k + 32],
                 )
