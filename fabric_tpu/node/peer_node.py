@@ -612,27 +612,27 @@ class PeerNode:
         self._gossip_runner.start()
         # background private-data repair (reference reconcile.go runs on
         # peer.gossip.pvtData.reconcileSleepInterval, default 1m).  A
-        # non-positive interval would busy-spin Event.wait(0); clamp to
-        # a floor (the reference disables reconciliation rather than
-        # spin — a 1s floor keeps the repair property without the burn)
-        reconcile_interval_s = max(1.0, float(reconcile_interval_s))
+        # non-positive interval DISABLES the loop, matching the
+        # reference's semantics — clamping would turn "off" into the
+        # most aggressive possible cadence.
         self._reconcile_stop = threading.Event()
+        if reconcile_interval_s > 0:
 
-        def reconcile_loop():
-            while not self._reconcile_stop.wait(reconcile_interval_s):
-                for ch in list(self.channels.values()):
-                    rec = ch.reconciler
-                    if rec is None:
-                        continue
-                    try:
-                        rec.reconcile_once()
-                    except Exception:
-                        pass  # endpoints may be down; next sweep retries
+            def reconcile_loop():
+                while not self._reconcile_stop.wait(reconcile_interval_s):
+                    for ch in list(self.channels.values()):
+                        rec = ch.reconciler
+                        if rec is None:
+                            continue
+                        try:
+                            rec.reconcile_once()
+                        except Exception:
+                            pass  # endpoints down; next sweep retries
 
-        self._reconcile_thread = threading.Thread(
-            target=reconcile_loop, daemon=True
-        )
-        self._reconcile_thread.start()
+            self._reconcile_thread = threading.Thread(
+                target=reconcile_loop, daemon=True
+            )
+            self._reconcile_thread.start()
 
     def gossip_join_channel(self, ch: _Channel) -> None:
         if self.gossip.channel(ch.channel_id) is not None:

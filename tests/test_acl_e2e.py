@@ -142,6 +142,21 @@ def test_deliver_block_vs_filtered_acl(net):
     assert [k for k, _ in events] == ["block", "status"]
 
 
+def test_propose_acl_denied_over_rpc(net):
+    """The denial must surface over the REAL wire too: a member's
+    proposal through the peer's RPC endpoint gets an error naming the
+    resource; the admin's succeeds — same transport, same channel."""
+    from fabric_tpu.cmd.common import endorse
+
+    org, node = net
+    member = org.signer("rpc-member", role_ou="client")
+    admin = org.signer("rpc-admin", role_ou="admin")
+    with pytest.raises(Exception, match="peer/Propose"):
+        endorse([node.addr], member, "aclch", "kvcc", [b"put", b"k", b"v"])
+    _, resps = endorse([node.addr], admin, "aclch", "kvcc", [b"put", b"k", b"v"])
+    assert resps[0].response.status == 200
+
+
 def test_discovery_acl_rejects_foreign_identity(net):
     org, node = net
     from fabric_tpu.discovery import DiscoveryClient
