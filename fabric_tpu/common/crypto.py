@@ -112,6 +112,29 @@ class CA:
         not_after: datetime.datetime | None = None,
     ) -> CertKeyPair:
         key = ec.generate_private_key(ec.SECP256R1())
+        cert = self.issue_for_public_key(
+            common_name, key.public_key(), ous=ous, sans=sans,
+            client=client, server=server, validity_days=validity_days,
+            not_after=not_after,
+        )
+        return CertKeyPair(cert, key)
+
+    def issue_for_public_key(
+        self,
+        common_name: str,
+        public_key,
+        ous: list[str] | None = None,
+        sans: list[str] | None = None,
+        client: bool = True,
+        server: bool = False,
+        validity_days: int = 3650,
+        not_after: datetime.datetime | None = None,
+    ) -> "x509.Certificate":
+        """Certify an EXTERNALLY-HELD key (CSR-style): the subject's
+        private key never touches the CA — the enrollment path for
+        custody/HSM-held keys (csp/custody.py), where key generation
+        happens inside the custody boundary and only the public half
+        comes out for certification."""
         now = datetime.datetime.now(datetime.timezone.utc)
         na = not_after or (now + datetime.timedelta(days=validity_days))
         nb = min(now - datetime.timedelta(minutes=5), na - datetime.timedelta(minutes=10))
@@ -127,7 +150,7 @@ class CA:
             x509.CertificateBuilder()
             .subject_name(x509.Name(attrs))
             .issuer_name(self.cert.subject)
-            .public_key(key.public_key())
+            .public_key(public_key)
             .serial_number(x509.random_serial_number())
             .not_valid_before(nb)
             .not_valid_after(na)
@@ -141,7 +164,7 @@ class CA:
                 ),
                 critical=True,
             )
-            .add_extension(x509.SubjectKeyIdentifier(_ski(key.public_key())), critical=False)
+            .add_extension(x509.SubjectKeyIdentifier(_ski(public_key)), critical=False)
             .add_extension(
                 # keyid must equal the issuer's (sha256-based) SKI —
                 # OpenSSL rejects chain candidates on keyid mismatch,
@@ -167,7 +190,7 @@ class CA:
             )
         if eku:
             builder = builder.add_extension(x509.ExtendedKeyUsage(eku), critical=False)
-        return CertKeyPair(builder.sign(self.key, hashes.SHA256()), key)
+        return builder.sign(self.key, hashes.SHA256())
 
     # -- revocation --------------------------------------------------------
 

@@ -49,6 +49,10 @@ def _new_csp(provider: str, **kwargs) -> CSP:
         from fabric_tpu.csp.tpu.provider import TPUCSP
 
         return TPUCSP(**kwargs)
+    if provider == "custody":
+        from fabric_tpu.csp.custody import CustodyCSP
+
+        return CustodyCSP(**kwargs)
     raise ValueError(f"unknown CSP provider {provider!r}")
 
 
@@ -57,12 +61,17 @@ def csp_from_config(cfg, prefix: str = "bccsp") -> CSP:
     bccsp/factory/opts.go + sampleconfig/core.yaml:290-315):
 
         bccsp:
-          default: SW | TPU
+          default: SW | TPU | CUSTODY
           sw:
             fileKeyStore:
               keyStorePath: <dir>     # empty/absent -> in-memory
           tpu:
             minDeviceBatch: <n>
+          custody:                    # process-isolated key custody
+            endpoint: host:port       # fabric-custody daemon
+            tokenFile: <path>         # shared token (PIN analogue)
+            verify: SW | TPU          # local hash/verify provider
+            tls: {certFile, keyFile, caFiles: [..]}  # mutual TLS
 
     The file keystore is what makes node restarts reuse generated keys
     (reference fileks.go); it backs BOTH providers' key management (the
@@ -83,4 +92,51 @@ def csp_from_config(cfg, prefix: str = "bccsp") -> CSP:
         if mdb is not None:
             kwargs["min_device_batch"] = int(mdb)
         return TPUCSP(sw=sw, **kwargs)
+    if provider == "custody":
+        # bccsp.custody: {endpoint: host:port, tokenFile: path,
+        # verify: SW|TPU, tls: {certFile, keyFile, caFiles: [...]}} —
+        # the pkcs11 config block's role (sampleconfig/core.yaml
+        # BCCSP.PKCS11 library/pin/label)
+        from fabric_tpu.cmd.common import parse_endpoint
+        from fabric_tpu.csp.custody import CustodyCSP, load_token
+
+        endpoint = cfg.get(f"{prefix}.custody.endpoint")
+        token_file = cfg.get(f"{prefix}.custody.tokenFile")
+        if not endpoint:
+            raise ValueError(
+                f"{prefix}.default is CUSTODY but "
+                f"{prefix}.custody.endpoint is not set"
+            )
+        if not token_file:
+            raise ValueError(
+                f"{prefix}.default is CUSTODY but "
+                f"{prefix}.custody.tokenFile is not set"
+            )
+        tls = None
+        cert = cfg.get(f"{prefix}.custody.tls.certFile")
+        key = cfg.get(f"{prefix}.custody.tls.keyFile")
+        cas = cfg.get(f"{prefix}.custody.tls.caFiles")
+        if cert or key or cas:
+            if not (cert and key):
+                raise ValueError(
+                    f"{prefix}.custody.tls needs BOTH certFile and "
+                    "keyFile (partial TLS config would silently send "
+                    "the token in plaintext)"
+                )
+            from fabric_tpu.comm.tls import credentials_from_files
+
+            tls = credentials_from_files(
+                str(cert), str(key), [str(c) for c in (cas or [])]
+            )
+        verify: CSP = sw
+        if str(cfg.get(f"{prefix}.custody.verify", "SW")).lower() == "tpu":
+            from fabric_tpu.csp.tpu.provider import TPUCSP
+
+            verify = TPUCSP(sw=sw)
+        return CustodyCSP(
+            parse_endpoint(str(endpoint)),
+            load_token(str(token_file)),
+            verify_csp=verify,
+            tls=tls,
+        )
     return sw
