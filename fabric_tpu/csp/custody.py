@@ -213,6 +213,12 @@ class CustodyCSP(CSP):
             h = self._handles.get(ski)
         if h is not None:
             return h
+        try:
+            # locally imported (public) keys live in the local provider's
+            # keystore; the bccsp GetKey contract returns them too
+            return self._local.get_key(ski)
+        except KeyError:
+            pass
         pub = self._parse_pub(self._call("custody.GetKey", ski))
         handle = CustodyKeyHandle(ski, pub)
         with self._lock:

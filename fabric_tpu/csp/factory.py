@@ -132,7 +132,11 @@ def csp_from_config(cfg, prefix: str = "bccsp") -> CSP:
         if str(cfg.get(f"{prefix}.custody.verify", "SW")).lower() == "tpu":
             from fabric_tpu.csp.tpu.provider import TPUCSP
 
-            verify = TPUCSP(sw=sw)
+            kwargs = {}
+            mdb = cfg.get(f"{prefix}.tpu.minDeviceBatch")
+            if mdb is not None:
+                kwargs["min_device_batch"] = int(mdb)
+            verify = TPUCSP(sw=sw, **kwargs)
         return CustodyCSP(
             parse_endpoint(str(endpoint)),
             load_token(str(token_file)),

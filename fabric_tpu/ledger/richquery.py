@@ -9,10 +9,13 @@ an optional "limit".
 Execution is index-assisted when the statedb defines an index on a
 field the selector constrains conjunctively (statedb.VersionedDB
 define_index; reference statecouchdb.go:53 index-backed queries): the
-planner picks one indexed condition ($eq, then $in, then a range),
-range-scans the order-preserving index for candidate keys, and rechecks
-every candidate document with the full selector — so an imprecise index
-can only over-select, never change results.  Results are key-ordered
+planner prefers a COMPOUND index whose field prefix is covered by
+equality conditions (optionally one trailing $in/range — longer
+prefixes win), then a single-field condition ($eq, then $in, then a
+range); either way it range-scans the order-preserving index for
+candidate keys and rechecks every candidate document with the full
+selector — so an imprecise index can only over-select, never change
+results.  Results are key-ordered
 and limit-truncated identically to the scan path, keeping endorsement
 read/write sets deterministic whether or not an index exists.  Without
 a usable index, selectors run as the full-namespace scan (semantically
@@ -120,11 +123,95 @@ def _conjunctive_conds(selector: dict) -> list[tuple[str, object]]:
     return out
 
 
+def _field_conds(selector: dict) -> dict:
+    """field -> first usable condition kind for index planning:
+    ("eq", v) | ("in", [vs]) | ("range", lo|None, hi|None).  eq wins
+    over in over range when a field carries several conjuncts."""
+    out: dict = {}
+
+    def rank(kind):  # lower is better
+        return {"eq": 0, "in": 1, "range": 2}[kind]
+
+    for f, cond in _conjunctive_conds(selector):
+        cand = None
+        if not isinstance(cond, dict):
+            cand = ("eq", cond)
+        elif "$eq" in cond:
+            cand = ("eq", cond["$eq"])
+        elif isinstance(cond.get("$in"), list):
+            cand = ("in", cond["$in"])
+        else:
+            lo = cond.get("$gte", cond.get("$gt"))
+            hi = cond.get("$lte", cond.get("$lt"))
+            if lo is not None or hi is not None:
+                cand = ("range", lo, hi)
+        if cand is None:
+            continue
+        cur = out.get(f)
+        if cur is None or rank(cand[0]) < rank(cur[0]):
+            out[f] = cand
+    return out
+
+
+def plan_compound(selector: dict, indexed: set) -> tuple | None:
+    """Best compound-index prefilter: ("comp", spec, fields, eq_values,
+    last|None) where eq_values cover fields[:len(eq_values)] and `last`
+    is an ("in", vs) / ("range", lo, hi) condition on the LAST field.
+
+    A compound index is usable ONLY when the selector constrains EVERY
+    field of the index (equalities on all but optionally the last,
+    which may carry one in/range): a document missing any indexed
+    field is absent from the index, so a selector that leaves a field
+    unconstrained could match documents the index cannot return —
+    CouchDB's well-known partial-index under-selection gotcha, which
+    this planner must never reproduce.  Every planned condition
+    requires presence of a scalar, so index membership covers exactly
+    the candidate set.  More fields win; all-eq beats a trailing
+    range."""
+    from fabric_tpu.ledger.statedb import INDEX_SPEC_SEP
+
+    conds = _field_conds(selector)
+    best = None  # (score, plan)
+    for spec in indexed:
+        if INDEX_SPEC_SEP not in spec:
+            continue
+        fields = spec.split(INDEX_SPEC_SEP)
+        eq_values: list = []
+        last = None
+        for pos, f in enumerate(fields):
+            c = conds.get(f)
+            if c is None:
+                break
+            if c[0] == "eq":
+                eq_values.append(c[1])
+                continue
+            if pos == len(fields) - 1:
+                last = c  # non-eq allowed only on the final field
+            break
+        if len(eq_values) + (1 if last is not None else 0) != len(fields):
+            continue  # not fully covered: unusable (see docstring)
+        score = (len(fields), 1 if last is None else 0)
+        if best is None or score > best[0]:
+            best = (score, ("comp", spec, fields, eq_values, last))
+    return best[1] if best else None
+
+
 def plan_index(selector: dict, indexed: set) -> tuple | None:
-    """Pick the best indexed prefilter: ("eq", field, value) |
-    ("in", field, values) | ("range", field, lo|None, hi|None) | None.
-    Range bounds are widened to inclusive (the recheck restores
-    exactness)."""
+    """Pick the best indexed prefilter: ("comp", ...) (see
+    plan_compound) | ("eq", field, value) | ("in", field, values) |
+    ("range", field, lo|None, hi|None) | None.  Range bounds are
+    widened to inclusive (the recheck restores exactness)."""
+    comp = plan_compound(selector, indexed)
+    if comp is not None:
+        return comp
+    return plan_single(selector, indexed)
+
+
+def plan_single(selector: dict, indexed: set) -> tuple | None:
+    """The single-field arm of plan_index — also the EXECUTION-TIME
+    fallback when a compound plan turns out unservable (non-scalar
+    operand, probe fan-out): a query a single-field index served before
+    a compound index existed must keep being served after."""
     conds = [
         (f, c) for f, c in _conjunctive_conds(selector) if f in indexed
     ]
@@ -170,6 +257,80 @@ def _eq_encodings(v) -> list[bytes] | None:
     return probes
 
 
+def _component_probes(v) -> list[bytes] | None:
+    """_eq_encodings in compound-component form (strings carry their
+    composite terminator)."""
+    probes = _eq_encodings(v)
+    if probes is None:
+        return None
+    return [p + b"\x00" if p[:1] == b"\x04" else p for p in probes]
+
+
+def _compound_keys(db, ns: str, plan) -> list | None:
+    """Candidate state keys for a ("comp", ...) plan, or None when an
+    operand cannot ride the index (caller falls back to the scan)."""
+    from fabric_tpu.ledger.statedb import encode_scalar
+
+    _, spec, _fields, eq_values, last = plan
+    # cartesian product of per-component probe sets (bool/number twin
+    # probes give at most 2 per component; cap the fan-out anyway)
+    prefixes = [b""]
+    for v in eq_values:
+        probes = _component_probes(v)
+        if probes is None:
+            return None
+        prefixes = [p + e for p in prefixes for e in probes]
+        if len(prefixes) > 32:
+            return None
+    keys: list = []
+    if last is None:
+        for p in prefixes:
+            keys.extend(db.index_scan(ns, spec, p, p))
+        return keys
+    if last[0] == "in":
+        for v in last[1]:
+            probes = _component_probes(v)
+            if probes is None:
+                return None
+            for p in prefixes:
+                for e in probes:
+                    keys.extend(db.index_scan(ns, spec, p + e, p + e))
+        return keys
+    # trailing range on the next component
+    _, lo, hi = last
+    if isinstance(lo, bool) or isinstance(hi, bool):
+        return None  # bool bounds cross-compare with numbers: scan
+    lo_enc = encode_scalar(lo) if lo is not None else None
+    hi_enc = encode_scalar(hi) if hi is not None else None
+    if (lo is not None and lo_enc is None) or (
+        hi is not None and hi_enc is None
+    ):
+        return None
+    if lo_enc is not None and lo_enc[:1] == b"\x04":
+        lo_enc += b"\x00"
+    if hi_enc is not None and hi_enc[:1] == b"\x04":
+        hi_enc += b"\x00"
+    for p in prefixes:
+        # open ends stay INSIDE this eq-prefix: every component
+        # encoding starts with a tag <= \x04, so \xfd\xff caps the
+        # prefix's region without crossing into the next prefix
+        start = p + (lo_enc if lo_enc is not None else b"")
+        end = p + (hi_enc if hi_enc is not None else b"\xfd\xff")
+        keys.extend(db.index_scan(ns, spec, start, end))
+        lo_num = lo if isinstance(lo, (int, float)) else None
+        hi_num = hi if isinstance(hi, (int, float)) else None
+        if (lo_num is not None or hi_num is not None) and (
+            lo_num is None or lo_num <= 1
+        ) and (hi_num is None or hi_num >= 0):
+            # bool doc values order-compare with numeric bounds under
+            # Python but live under a different type tag (see the
+            # single-field sweep below)
+            bool_lo = p + encode_scalar(False)
+            bool_hi = p + encode_scalar(True)
+            keys.extend(db.index_scan(ns, spec, bool_lo, bool_hi))
+    return keys
+
+
 def execute_query_indexed(db, ns: str, query: str):
     """Index-assisted execution against a statedb.VersionedDB; returns
     [(key, value, version)] in key order, or None when no defined index
@@ -177,10 +338,22 @@ def execute_query_indexed(db, ns: str, query: str):
     from fabric_tpu.ledger.statedb import encode_scalar
 
     selector, limit = _parse_query(query)
-    p = plan_index(selector, db.indexes_for(ns))
+    indexed = db.indexes_for(ns)
+    p = plan_index(selector, indexed)
+    if p is not None and p[0] == "comp":
+        keys = _compound_keys(db, ns, p)
+        if keys is None:
+            # compound plan unservable at execution time (non-scalar
+            # operand, probe fan-out): retry the single-field planner
+            # before surrendering to the full scan
+            p = plan_single(selector, indexed)
+        else:
+            p = ("_done",)
     if p is None:
         return None
-    if p[0] in ("eq", "in"):
+    if p[0] == "_done":
+        pass
+    elif p[0] in ("eq", "in"):
         operands = [p[2]] if p[0] == "eq" else list(p[2])
         keys = []
         for v in operands:
@@ -253,4 +426,5 @@ __all__ = [
     "execute_query",
     "execute_query_indexed",
     "plan_index",
+    "plan_compound",
 ]

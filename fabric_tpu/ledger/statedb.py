@@ -89,36 +89,66 @@ def encode_scalar(v) -> bytes | None:
     return None
 
 
-def _idx_entry_state_key(rest: bytes) -> str | None:
+# Separator inside a COMPOUND index's field spec ("color\x1fsize") —
+# the unit-separator control char never appears in JSON field paths.
+INDEX_SPEC_SEP = "\x1f"
+
+
+def encode_composite(values) -> bytes | None:
+    """Order-preserving concatenation of scalar encodings for a
+    compound index entry; None when any component is non-indexable.
+    String components carry a \\x00 terminator (their escaped content
+    never holds a bare \\x00), which both delimits them and keeps the
+    concatenation ordered componentwise: a longer string's next content
+    byte is always > the terminator, so ("ab", y) < ("abc", x) for
+    every y, x — matching tuple comparison."""
+    parts = []
+    for v in values:
+        e = encode_scalar(v)
+        if e is None:
+            return None
+        if e[:1] == b"\x04":
+            e += b"\x00"
+        parts.append(e)
+    return b"".join(parts)
+
+
+def _idx_entry_state_key(rest: bytes, n_components: int = 1) -> str | None:
     """Parse `enc \\x00 statekey` (the tail of an index entry after the
     ns/field prefix) and return the state key.  The encoding length is
     recovered from its type tag — number encodings and state keys (e.g.
     composite keys) may legitimately contain \\x00 bytes, so a plain
-    split would misparse."""
-    tag = rest[0:1]
-    if tag == b"\x01":
-        n = 1
-    elif tag == b"\x02":
-        n = 2
-    elif tag == b"\x03":
-        n = 9
-    elif tag == b"\x04":  # escaped string: ends at the first bare \x00
-        i = 1
-        while True:
-            j = rest.find(b"\x00", i)
-            if j < 0:
-                return None
-            if rest[j + 1:j + 2] == b"\xff":
-                i = j + 2
-                continue
-            n = j
-            break
-    else:
-        return None
-    if rest[n:n + 1] != b"\x00":
+    split would misparse.  `n_components` > 1 parses a compound entry
+    (encode_composite: terminated strings)."""
+    pos = 0
+    for _ in range(n_components):
+        tag = rest[pos:pos + 1]
+        if tag == b"\x01":
+            ln = 1
+        elif tag == b"\x02":
+            ln = 2
+        elif tag == b"\x03":
+            ln = 9
+        elif tag == b"\x04":  # escaped string: ends at the first bare \x00
+            i = pos + 1
+            while True:
+                j = rest.find(b"\x00", i)
+                if j < 0:
+                    return None
+                if rest[j + 1:j + 2] == b"\xff":
+                    i = j + 2
+                    continue
+                break
+            ln = j - pos
+            if n_components > 1:
+                ln += 1  # composite strings include their terminator
+        else:
+            return None
+        pos += ln
+    if rest[pos:pos + 1] != b"\x00":
         return None
     try:
-        return rest[n + 1:].decode()
+        return rest[pos + 1:].decode()
     except UnicodeDecodeError:
         return None
 
@@ -226,36 +256,62 @@ class VersionedDB:
     def indexes_for(self, ns: str) -> set[str]:
         return self._load_indexes().get(ns, set())
 
-    def define_index(self, ns: str, field: str) -> None:
-        """Create (and backfill) an index on a dotted JSON field —
-        the statecouchdb index-definition equivalent.  Idempotent."""
-        if field in self.indexes_for(ns):
+    def define_index(self, ns: str, field) -> None:
+        """Create (and backfill) an index on a dotted JSON field — or,
+        given a list/tuple of fields, a COMPOUND index over them (the
+        statecouchdb multi-field index equivalent).  A document enters
+        a compound index only when EVERY field is present with a
+        scalar value — safe, because the planner only uses the index
+        for conditions that require presence of scalars, so unindexed
+        documents cannot match.  Idempotent."""
+        spec = (
+            INDEX_SPEC_SEP.join(field)
+            if isinstance(field, (list, tuple))
+            else field
+        )
+        if spec in self.indexes_for(ns):
             return
-        puts = {_IDX_DEF_PREFIX + ns.encode() + b"\x00" + field.encode(): b""}
+        fields = spec.split(INDEX_SPEC_SEP)
+        puts = {_IDX_DEF_PREFIX + ns.encode() + b"\x00" + spec.encode(): b""}
         for key, vv in self.get_state_range(ns, "", ""):
-            val, present = _doc_field(vv.value, field)
-            if present:
-                enc = encode_scalar(val)
-                if enc is not None:
-                    puts[_idx_key(ns, field, enc, key)] = b""
+            enc = self._index_encoding(vv.value, fields)
+            if enc is not None:
+                puts[_idx_key(ns, spec, enc, key)] = b""
         self._db.write_batch(puts, [])
-        self._load_indexes().setdefault(ns, set()).add(field)
+        self._load_indexes().setdefault(ns, set()).add(spec)
+
+    @staticmethod
+    def _index_encoding(value: bytes, fields: list[str]) -> bytes | None:
+        """The entry encoding of one document under an index spec, or
+        None when the document does not belong in the index."""
+        vals = []
+        for f in fields:
+            v, present = _doc_field(value, f)
+            if not present:
+                return None
+            vals.append(v)
+        if len(fields) == 1:
+            return encode_scalar(vals[0])
+        return encode_composite(vals)
 
     # -- index scans (planner entry points) --------------------------------
 
     def index_scan(self, ns: str, field: str, lo: bytes | None,
                    hi: bytes | None):
         """Yield state keys whose indexed encoding is in [lo, hi]
-        (inclusive; None = open end).  Encodings come from
-        encode_scalar; the caller rechecks each document."""
+        (inclusive; None = open end).  `field` is the index spec
+        (compound specs are INDEX_SPEC_SEP-joined); encodings come from
+        encode_scalar / encode_composite; the caller rechecks each
+        document."""
         start = _idx_prefix(ns, field, lo if lo is not None else b"")
         if hi is None:
             end = _idx_prefix(ns, field) + b"\xfe\xff"
         else:
             end = _idx_prefix(ns, field, hi) + b"\x01"
         plen = len(_idx_prefix(ns, field))
+        n_comp = field.count(INDEX_SPEC_SEP) + 1
         for k, _ in self._db.iterate(start, end):
-            key = _idx_entry_state_key(k[plen:])
+            key = _idx_entry_state_key(k[plen:], n_comp)
             if key is not None:
                 yield key
 
@@ -266,24 +322,21 @@ class VersionedDB:
         idx = self._load_indexes()
         dels: set[bytes] = set()
         for ns, kvs in batch.items():
-            fields = idx.get(ns)
-            if not fields:
+            specs = idx.get(ns)
+            if not specs:
                 continue
+            split = {s: s.split(INDEX_SPEC_SEP) for s in specs}
             for key, vv in kvs.items():
                 old = self.get_state(ns, key)
-                for field in fields:
+                for spec, fields in split.items():
                     if old is not None:
-                        oval, opresent = _doc_field(old.value, field)
-                        if opresent:
-                            oenc = encode_scalar(oval)
-                            if oenc is not None:
-                                dels.add(_idx_key(ns, field, oenc, key))
+                        oenc = self._index_encoding(old.value, fields)
+                        if oenc is not None:
+                            dels.add(_idx_key(ns, spec, oenc, key))
                     if vv is not None:
-                        nval, npresent = _doc_field(vv.value, field)
-                        if npresent:
-                            nenc = encode_scalar(nval)
-                            if nenc is not None:
-                                puts[_idx_key(ns, field, nenc, key)] = b""
+                        nenc = self._index_encoding(vv.value, fields)
+                        if nenc is not None:
+                            puts[_idx_key(ns, spec, nenc, key)] = b""
         # an unchanged encoding would be deleted after being re-put
         # (write_batch applies puts before deletes) — drop those
         deletes.extend(dels - puts.keys())
@@ -359,4 +412,7 @@ class VersionedDB:
         return None if raw is None else Height.unpack(raw)
 
 
-__all__ = ["Height", "VersionedValue", "VersionedDB", "encode_scalar"]
+__all__ = [
+    "Height", "VersionedValue", "VersionedDB", "encode_scalar",
+    "encode_composite", "INDEX_SPEC_SEP",
+]

@@ -220,6 +220,37 @@ def test_pause_resume_and_upgrade_dbs(tmp_path):
     assert admin.upgrade_dbs(root) == []
 
 
+def _index_db(docs):
+    from fabric_tpu.ledger.kvstore import MemKVStore
+    from fabric_tpu.ledger.statedb import Height, VersionedDB, VersionedValue
+
+    db = VersionedDB(MemKVStore())
+    db.apply_updates(
+        {
+            "cc": {
+                k: VersionedValue(json.dumps(d).encode(), Height(1, i))
+                for i, (k, d) in enumerate(docs.items())
+            }
+        },
+        Height(1, len(docs)),
+    )
+    return db
+
+
+def _scan_and_indexed(db, selector, **extra):
+    from fabric_tpu.ledger.richquery import execute_query_indexed
+
+    q = json.dumps({"selector": selector, **extra})
+    scan = [
+        k
+        for k, _ in execute_query(
+            ((k, vv.value) for k, vv in db.get_state_range("cc", "", "")), q
+        )
+    ]
+    indexed = execute_query_indexed(db, "cc", q)
+    return scan, indexed
+
+
 class TestIndexedQueryParity:
     """Indexed execution must never under-select vs the full scan
     (advisor round-2 high finding): non-scalar operands and bool/number
@@ -227,33 +258,10 @@ class TestIndexedQueryParity:
     tags) have to fall back or probe both encodings."""
 
     def _db(self, docs):
-        from fabric_tpu.ledger.kvstore import MemKVStore
-        from fabric_tpu.ledger.statedb import Height, VersionedDB, VersionedValue
-
-        db = VersionedDB(MemKVStore())
-        db.apply_updates(
-            {
-                "cc": {
-                    k: VersionedValue(json.dumps(d).encode(), Height(1, i))
-                    for i, (k, d) in enumerate(docs.items())
-                }
-            },
-            Height(1, len(docs)),
-        )
-        return db
+        return _index_db(docs)
 
     def _both(self, db, selector, **extra):
-        from fabric_tpu.ledger.richquery import execute_query_indexed
-
-        q = json.dumps({"selector": selector, **extra})
-        scan = [
-            k
-            for k, _ in execute_query(
-                ((k, vv.value) for k, vv in db.get_state_range("cc", "", "")), q
-            )
-        ]
-        indexed = execute_query_indexed(db, "cc", q)
-        return scan, indexed
+        return _scan_and_indexed(db, selector, **extra)
 
     def test_nonscalar_eq_falls_back_to_scan(self):
         db = self._db({"d1": {"tags": ["a", "b"]}, "d2": {"tags": "x"}})
@@ -316,3 +324,202 @@ class TestIndexedQueryParity:
         scan, indexed = self._both(db, {"v": {"$gte": 100}})
         assert scan == ["n1"]
         assert indexed is not None and [k for k, _, _ in indexed] == scan
+
+
+class TestCompoundIndex:
+    """Compound (multi-field) indexes: the planner rides only a FULLY
+    eq-covered field set (optionally one trailing in/range on the last
+    field); componentwise order must match tuple order; docs missing
+    ANY indexed field never under-select because partial coverage is
+    refused outright."""
+
+    def _db(self, docs):
+        return _index_db(docs)
+
+    def _both(self, db, selector, **extra):
+        return _scan_and_indexed(db, selector, **extra)
+
+    DOCS = {
+        "r1": {"color": "red", "size": 5, "w": 1},
+        "r2": {"color": "red", "size": 9, "w": 2},
+        "b1": {"color": "blue", "size": 5},
+        "b2": {"color": "blue", "size": 7, "w": 9},
+        "noc": {"size": 5},
+        "nos": {"color": "red"},
+        "arr": {"color": "red", "size": [5]},
+        "nul": {"color": None, "size": 5},
+    }
+
+    def _cdb(self):
+        db = self._db(self.DOCS)
+        db.define_index("cc", ["color", "size"])
+        return db
+
+    def _check(self, db, selector, want_keys=None):
+        scan, indexed = self._both(db, selector)
+        assert indexed is not None, "compound plan declined unexpectedly"
+        assert [k for k, _, _ in indexed] == scan
+        if want_keys is not None:
+            assert scan == want_keys
+
+    def test_eq_eq(self):
+        db = self._cdb()
+        self._check(db, {"color": "red", "size": 5}, ["r1"])
+        self._check(db, {"color": "blue", "size": 7}, ["b2"])
+        self._check(db, {"color": None, "size": 5}, ["nul"])
+
+    def test_partial_coverage_declines(self):
+        # eq on the first field alone must NOT ride the compound index:
+        # docs missing (or non-scalar in) the unconstrained field —
+        # nos, arr — are absent from the index yet match the selector
+        # (CouchDB's partial-index under-selection gotcha)
+        db = self._cdb()
+        scan, indexed = self._both(db, {"color": "red"})
+        assert indexed is None
+        assert scan == ["arr", "nos", "r1", "r2"]
+
+    def test_eq_range(self):
+        db = self._cdb()
+        self._check(db, {"color": "red", "size": {"$gte": 6}}, ["r2"])
+        self._check(
+            db, {"color": "blue", "size": {"$gt": 1, "$lt": 8}},
+            ["b1", "b2"],
+        )
+        self._check(db, {"color": "red", "size": {"$lte": 5}}, ["r1"])
+
+    def test_eq_in(self):
+        self._check(
+            self._cdb(),
+            {"color": "red", "size": {"$in": [5, 7]}},
+            ["r1"],
+        )
+
+    def test_missing_field_docs_never_underselect(self):
+        # noc/nos/arr are absent from the index; the planned conditions
+        # require presence of scalars, so parity holds by construction
+        db = self._cdb()
+        self._check(db, {"color": "red", "size": 5}, ["r1"])
+        scan, indexed = self._both(db, {"color": "red", "size": [5]})
+        assert scan == ["arr"]
+        assert indexed is None  # non-scalar operand: planner declines
+
+    def test_string_order_edge_cases(self):
+        # component order must equal tuple order even with prefixes and
+        # embedded NULs in string values
+        db = self._db({
+            "a": {"f": "ab", "g": 1},
+            "b": {"f": "abc", "g": 1},
+            "c": {"f": "ab" + chr(0) + "x", "g": 1},
+            "d": {"f": "ab", "g": 2},
+        })
+        db.define_index("cc", ["f", "g"])
+        self._check(db, {"f": "ab", "g": 1}, ["a"])
+        self._check(db, {"f": "ab" + chr(0) + "x", "g": 1}, ["c"])
+        self._check(db, {"f": "abc", "g": {"$gte": 0}}, ["b"])
+        self._check(db, {"f": "ab", "g": {"$gte": 1}}, ["a", "d"])
+
+    def test_bool_number_cross_type_components(self):
+        db = self._db({
+            "t1": {"a": True, "b": 1},
+            "n1": {"a": 1, "b": True},
+            "x": {"a": 2, "b": 2},
+        })
+        db.define_index("cc", ["a", "b"])
+        # True == 1 under python ==; both encodings must be probed on
+        # BOTH components
+        self._check(db, {"a": 1, "b": 1}, ["n1", "t1"])
+        self._check(db, {"a": True, "b": True}, ["n1", "t1"])
+        self._check(db, {"a": 2, "b": {"$gte": 0}}, ["x"])
+        # bool doc value vs numeric trailing range sweeps the bool region
+        self._check(db, {"a": 1, "b": {"$gte": 0}}, ["n1", "t1"])
+
+    def test_longer_prefix_beats_shorter(self):
+        from fabric_tpu.ledger.richquery import plan_index
+
+        db = self._db({"d": {"x": 1, "y": 2, "z": 3}})
+        db.define_index("cc", ["x"])
+        db.define_index("cc", ["x", "y", "z"])
+        p = plan_index(
+            {"x": 1, "y": 2, "z": 3}, db.indexes_for("cc")
+        )
+        assert p[0] == "comp" and len(p[3]) == 3  # all three eqs ride
+
+    def test_mutation_maintains_compound_entries(self):
+        from fabric_tpu.ledger.statedb import Height, VersionedValue
+
+        db = self._cdb()
+        # update r1's size; the old entry must leave the index
+        db.apply_updates(
+            {"cc": {"r1": VersionedValue(
+                json.dumps({"color": "red", "size": 6}).encode(),
+                Height(2, 0),
+            )}},
+            Height(2, 1),
+        )
+        self._check(db, {"color": "red", "size": 5}, [])
+        self._check(db, {"color": "red", "size": 6}, ["r1"])
+        # delete removes the entry
+        db.apply_updates({"cc": {"r2": None}}, Height(3, 1))
+        self._check(db, {"color": "red", "size": 9}, [])
+
+    def test_unservable_compound_falls_back_to_single_field(self):
+        # a non-scalar operand kills the compound plan at execution
+        # time; a coexisting single-field index must still serve the
+        # query instead of degrading to the full scan
+        db = self._cdb()
+        db.define_index("cc", "color")
+        from fabric_tpu.ledger.richquery import plan_index
+
+        sel = {"color": "red", "size": [5]}
+        p = plan_index(sel, db.indexes_for("cc"))
+        assert p[0] == "comp"  # planner prefers the compound index...
+        scan, indexed = self._both(db, sel)
+        # ...but execution falls back to the color eq index, not None
+        assert indexed is not None
+        assert [k for k, _, _ in indexed] == scan == ["arr"]
+
+    def test_or_never_rides_the_index(self):
+        db = self._cdb()
+        scan, indexed = self._both(
+            db, {"$or": [{"color": "red"}, {"size": 7}]}
+        )
+        assert indexed is None  # disjunctions fall back to the scan
+        assert scan == ["arr", "b2", "nos", "r1", "r2"]
+
+    def test_randomized_parity_oracle(self):
+        import random
+
+        rng = random.Random(20260801)
+        colors = ["red", "blue", "", "a" + chr(0) + "b", None, True, 0, 1, 2.5]
+        sizes = [0, 1, -1, 2.5, True, False, None, "s", -0.0]
+        docs = {}
+        for i in range(120):
+            d = {}
+            if rng.random() < 0.9:
+                d["color"] = rng.choice(colors)
+            if rng.random() < 0.9:
+                d["size"] = rng.choice(sizes)
+            if rng.random() < 0.2:
+                d["size"] = [1, 2]  # non-indexable
+            docs["k%03d" % i] = d
+        db = self._db(docs)
+        db.define_index("cc", ["color", "size"])
+        selectors = []
+        for _ in range(60):
+            sel = {"color": rng.choice(colors)}
+            mode = rng.random()
+            if mode < 0.4:
+                sel["size"] = rng.choice(sizes)
+            elif mode < 0.7:
+                lo, hi = sorted(
+                    rng.sample([x for x in sizes if isinstance(x, (int, float)) and not isinstance(x, bool)], 2)
+                )
+                sel["size"] = {"$gte": lo, "$lte": hi}
+            else:
+                sel["size"] = {"$in": rng.sample(sizes, 3)}
+            selectors.append(sel)
+        for sel in selectors:
+            scan, indexed = self._both(db, sel)
+            if indexed is None:
+                continue  # planner declined: scan path answered
+            assert [k for k, _, _ in indexed] == scan, sel
