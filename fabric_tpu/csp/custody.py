@@ -213,13 +213,14 @@ class CustodyCSP(CSP):
             h = self._handles.get(ski)
         if h is not None:
             return h
+        # custody FIRST: a custody-held SKI must come back as a
+        # SIGNABLE handle even when its public half was also imported
+        # locally (e.g. an MSP deriving the SKI from a certificate) —
+        # the local keystore serves only SKIs the daemon doesn't hold
         try:
-            # locally imported (public) keys live in the local provider's
-            # keystore; the bccsp GetKey contract returns them too
+            pub = self._parse_pub(self._call("custody.GetKey", ski))
+        except Exception:
             return self._local.get_key(ski)
-        except KeyError:
-            pass
-        pub = self._parse_pub(self._call("custody.GetKey", ski))
         handle = CustodyKeyHandle(ski, pub)
         with self._lock:
             self._handles[ski] = handle

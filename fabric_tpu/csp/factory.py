@@ -56,6 +56,17 @@ def _new_csp(provider: str, **kwargs) -> CSP:
     raise ValueError(f"unknown CSP provider {provider!r}")
 
 
+def _tpu_kwargs(cfg, prefix: str) -> dict:
+    """TPU provider tuning knobs from the config block — shared by the
+    direct-TPU and custody-verify construction sites so a new knob
+    cannot drift between them."""
+    kwargs = {}
+    mdb = cfg.get(f"{prefix}.tpu.minDeviceBatch")
+    if mdb is not None:
+        kwargs["min_device_batch"] = int(mdb)
+    return kwargs
+
+
 def csp_from_config(cfg, prefix: str = "bccsp") -> CSP:
     """Build a CSP from a core.yaml/orderer.yaml BCCSP block (reference
     bccsp/factory/opts.go + sampleconfig/core.yaml:290-315):
@@ -87,11 +98,7 @@ def csp_from_config(cfg, prefix: str = "bccsp") -> CSP:
     if provider == "tpu":
         from fabric_tpu.csp.tpu.provider import TPUCSP
 
-        kwargs = {}
-        mdb = cfg.get(f"{prefix}.tpu.minDeviceBatch")
-        if mdb is not None:
-            kwargs["min_device_batch"] = int(mdb)
-        return TPUCSP(sw=sw, **kwargs)
+        return TPUCSP(sw=sw, **_tpu_kwargs(cfg, prefix))
     if provider == "custody":
         # bccsp.custody: {endpoint: host:port, tokenFile: path,
         # verify: SW|TPU, tls: {certFile, keyFile, caFiles: [...]}} —
@@ -132,11 +139,7 @@ def csp_from_config(cfg, prefix: str = "bccsp") -> CSP:
         if str(cfg.get(f"{prefix}.custody.verify", "SW")).lower() == "tpu":
             from fabric_tpu.csp.tpu.provider import TPUCSP
 
-            kwargs = {}
-            mdb = cfg.get(f"{prefix}.tpu.minDeviceBatch")
-            if mdb is not None:
-                kwargs["min_device_batch"] = int(mdb)
-            verify = TPUCSP(sw=sw, **kwargs)
+            verify = TPUCSP(sw=sw, **_tpu_kwargs(cfg, prefix))
         return CustodyCSP(
             parse_endpoint(str(endpoint)),
             load_token(str(token_file)),

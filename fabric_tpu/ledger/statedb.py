@@ -264,11 +264,17 @@ class VersionedDB:
         scalar value — safe, because the planner only uses the index
         for conditions that require presence of scalars, so unindexed
         documents cannot match.  Idempotent."""
-        spec = (
-            INDEX_SPEC_SEP.join(field)
-            if isinstance(field, (list, tuple))
-            else field
-        )
+        fields_in = list(field) if isinstance(field, (list, tuple)) else [field]
+        for f in fields_in:
+            if INDEX_SPEC_SEP in f:
+                # a field name carrying the spec separator would be
+                # silently re-parsed as a compound spec and the index
+                # would under-select — refuse loudly
+                raise ValueError(
+                    f"index field {f!r} contains the reserved "
+                    "separator \\x1f"
+                )
+        spec = INDEX_SPEC_SEP.join(fields_in)
         if spec in self.indexes_for(ns):
             return
         fields = spec.split(INDEX_SPEC_SEP)

@@ -100,6 +100,24 @@ def test_deadline_ewma_budget():
     assert TPUCSP(stall_factor=None)._deadline_for(4000) is None
 
 
+def test_sole_flush_deadline_is_absolute_budget():
+    """A sole-flush consumer (the serial p99 path) gets an ABSOLUTE
+    latency budget — deadline + host-race stays ~450 ms even when a
+    slow chip window inflates the EWMA past it — while the pipelined
+    deadline is untouched."""
+    csp = TPUCSP(stall_factor=1.0, host_rate_hint=9000.0)
+    # slow window: ordinary flush wall 0.25s for 3000 lanes
+    for _ in range(8):
+        csp._note_device_wall(3000, 0.25)
+    pipelined = csp._deadline_for(3000)
+    assert pipelined == max(0.2, 3000 / 9000.0)  # anchor-capped
+    sole = csp._sole_deadline_for(3000)
+    assert sole is not None
+    assert sole + 3000 / 9000.0 <= 0.451  # budget holds
+    assert sole >= 0.1
+    assert TPUCSP(stall_factor=None)._sole_deadline_for(3000) is None
+
+
 def test_flush_deadline_host_race_beats_stalled_device():
     """A device that never answers is beaten by the host race after the
     deadline; mask matches the host oracle exactly."""
