@@ -170,9 +170,10 @@ class CustodyCSP(CSP):
         # connection per call anyway, but constructing it per sign
         # would rebuild the TLS context (cert/CA parse) on the hot path
         self._client = RPCClient(*endpoint, timeout=timeout, tls=tls)
-        # handle cache: ski -> CustodyKeyHandle (the session-pool
-        # analogue — one daemon round-trip per key, not per use)
-        self._handles: dict[bytes, CustodyKeyHandle] = {}
+        # key cache: ski -> CustodyKeyHandle or locally-imported Key
+        # (the session-pool analogue — one daemon round-trip per key,
+        # not per use)
+        self._handles: dict[bytes, Key] = {}
         self._lock = threading.Lock()
 
     def _call(self, method: str, body: bytes) -> bytes:
@@ -216,15 +217,24 @@ class CustodyCSP(CSP):
         # custody FIRST: a custody-held SKI must come back as a
         # SIGNABLE handle even when its public half was also imported
         # locally (e.g. an MSP deriving the SKI from a certificate) —
-        # the local keystore serves only SKIs the daemon doesn't hold
+        # the local keystore serves only SKIs the daemon doesn't hold.
+        # Only the daemon's unknown-SKI answer falls through; transport
+        # failures and malformed replies PROPAGATE (a daemon outage
+        # must not silently demote a signable key to a public one).
+        from fabric_tpu.comm.rpc import RPCError
+
         try:
             pub = self._parse_pub(self._call("custody.GetKey", ski))
-        except Exception:
-            return self._local.get_key(ski)
-        handle = CustodyKeyHandle(ski, pub)
+            key: Key = CustodyKeyHandle(ski, pub)
+        except RPCError as exc:
+            if "no key for SKI" not in str(exc):
+                raise
+            key = self._local.get_key(ski)  # KeyError if absent
         with self._lock:
-            self._handles[ski] = handle
-        return handle
+            # positive AND local-fallback results cache: a locally
+            # imported key must not pay a daemon round trip per lookup
+            self._handles[ski] = key
+        return key
 
     def sign(self, key: Key, digest: bytes) -> bytes:
         if isinstance(key, CustodyKeyHandle):

@@ -145,6 +145,31 @@ class _KeyTable:
         return self._dev[key]
 
 
+# Process-wide MEASURED host verification rate (sigs/s), fed by real
+# host verifies (_FlushResult._host_verify).  Deadline budgets reserve
+# host-race time from what this host actually delivers under its
+# current load — a configuration hint can be 20-40% optimistic on a
+# contended box, which is exactly the margin a ~450ms latency budget
+# cannot afford to lose.
+_host_rate_lock = threading.Lock()
+_host_rate_ewma: list = [None]
+
+
+def _note_host_rate(lanes: int, secs: float) -> None:
+    if secs <= 0:
+        return
+    rate = lanes / secs
+    with _host_rate_lock:
+        cur = _host_rate_ewma[0]
+        _host_rate_ewma[0] = rate if cur is None else 0.7 * cur + 0.3 * rate
+
+
+def _measured_host_rate(default: float) -> float:
+    with _host_rate_lock:
+        r = _host_rate_ewma[0]
+    return r if r else default
+
+
 class _FlushResult:
     """One flushed (coalesced) device dispatch: lazy per-chunk
     collectors plus a consumption count so the provider can drop the
@@ -282,15 +307,20 @@ class _FlushResult:
         """Host verification preferring the native libcrypto batch
         (native/ecverify.cc) — GIL-free and a multiple of the
         python-per-signature rate on hosts with a fast libcrypto; the
-        python engine is the fallback oracle."""
+        python engine is the fallback oracle.  Feeds the process-wide
+        measured host rate (deadline budgeting reserves race time from
+        OBSERVED speed, not the configuration hint)."""
         if not items:
             return []
         from fabric_tpu import native
 
+        t0 = time.perf_counter()
         mask = native.ecdsa_verify_host(items)
-        if mask is not None:
-            return mask
-        return self._sw.verify_batch(items)
+        if mask is None:
+            mask = self._sw.verify_batch(items)
+        if len(items) >= 256:
+            _note_host_rate(len(items), time.perf_counter() - t0)
+        return mask
 
     def _host_race(self) -> bool:
         """Deadline expired: verify this flush's items on the host,
@@ -697,7 +727,10 @@ class TPUCSP(CSP):
         floored at 0.15 s, capped by the host anchor (see __init__)."""
         if self._stall_factor is None:
             return None
-        anchor = max(0.2, self._stall_factor * lanes / self._host_rate)
+        anchor = max(
+            0.2,
+            self._stall_factor * lanes / _measured_host_rate(self._host_rate),
+        )
         with self._ewma_lock:
             per_lane = self._lane_wall_ewma
         if per_lane is None:
@@ -707,17 +740,19 @@ class TPUCSP(CSP):
     # absolute per-block latency budget for the SOLE-flush case: the
     # serial consumer (per-block validate latency, the p99 metric) has
     # an idle host, so racing early is free — budget the deadline so
-    # deadline + host-race stays under ~450 ms even in a chip window
+    # deadline + host-race stays under ~420 ms even in a chip window
     # whose ORDINARY flush wall would push the pipelined EWMA deadline
-    # past it
-    _SOLE_BUDGET_S = 0.45
+    # past it.  The race reserve uses the MEASURED host rate; the floor
+    # is low because a too-early race on this path costs only one
+    # wasted poll chunk of an otherwise idle host.
+    _SOLE_BUDGET_S = 0.42
 
     def _sole_deadline_for(self, lanes: int) -> float | None:
         base = self._deadline_for(lanes)
         if base is None:
             return None
-        race_est = lanes / self._host_rate
-        return max(0.1, min(base, self._SOLE_BUDGET_S - race_est))
+        race_est = lanes / _measured_host_rate(self._host_rate)
+        return max(0.05, min(base, self._SOLE_BUDGET_S - race_est))
 
     def _tuple_chunks(self, items, min_bucket: int = 0):
         """(padded tuple chunk, kept lanes) pairs for the non-native

@@ -264,16 +264,23 @@ class VersionedDB:
         scalar value — safe, because the planner only uses the index
         for conditions that require presence of scalars, so unindexed
         documents cannot match.  Idempotent."""
-        fields_in = list(field) if isinstance(field, (list, tuple)) else [field]
-        for f in fields_in:
-            if INDEX_SPEC_SEP in f:
-                # a field name carrying the spec separator would be
-                # silently re-parsed as a compound spec and the index
-                # would under-select — refuse loudly
-                raise ValueError(
-                    f"index field {f!r} contains the reserved "
-                    "separator \\x1f"
-                )
+        if isinstance(field, (list, tuple)):
+            fields_in = list(field)
+            for f in fields_in:
+                if INDEX_SPEC_SEP in f:
+                    # a field NAME carrying the spec separator would be
+                    # silently re-parsed as a compound spec and the
+                    # index would under-select — refuse loudly
+                    raise ValueError(
+                        f"index field {f!r} contains the reserved "
+                        "separator \\x1f"
+                    )
+        else:
+            # a separator-joined STRING is the canonical spec form the
+            # rest of the API trades in (indexes_for/index_scan), so
+            # `define_index(ns, s) for s in src.indexes_for(ns)` —
+            # the offline re-index pattern — round-trips compounds
+            fields_in = field.split(INDEX_SPEC_SEP)
         spec = INDEX_SPEC_SEP.join(fields_in)
         if spec in self.indexes_for(ns):
             return

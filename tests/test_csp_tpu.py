@@ -74,11 +74,16 @@ def _signed_items(n, sw=None):
     return out
 
 
-def test_deadline_ewma_budget():
+def test_deadline_ewma_budget(monkeypatch):
     """The stall deadline is a latency budget: host anchor until the
     EWMA is primed, then 1.5x the predicted flush wall clamped to
     [0.15s, anchor] — so ordinary windows race early while a starved
     chip window cannot inflate its own deadline past the host cost."""
+    import fabric_tpu.csp.tpu.provider as prov
+
+    # the process-wide measured host rate (fed by other tests' host
+    # races) must not leak into these exact-equality assertions
+    monkeypatch.setattr(prov, "_host_rate_ewma", [None])
     csp = TPUCSP(stall_factor=1.0, host_rate_hint=10000.0)
     # unprimed: the anchor (lanes/host_rate, floor 0.2)
     assert csp._deadline_for(4000) == 0.4
@@ -102,20 +107,34 @@ def test_deadline_ewma_budget():
 
 def test_sole_flush_deadline_is_absolute_budget():
     """A sole-flush consumer (the serial p99 path) gets an ABSOLUTE
-    latency budget — deadline + host-race stays ~450 ms even when a
-    slow chip window inflates the EWMA past it — while the pipelined
-    deadline is untouched."""
-    csp = TPUCSP(stall_factor=1.0, host_rate_hint=9000.0)
-    # slow window: ordinary flush wall 0.25s for 3000 lanes
-    for _ in range(8):
-        csp._note_device_wall(3000, 0.25)
-    pipelined = csp._deadline_for(3000)
-    assert pipelined == max(0.2, 3000 / 9000.0)  # anchor-capped
-    sole = csp._sole_deadline_for(3000)
-    assert sole is not None
-    assert sole + 3000 / 9000.0 <= 0.451  # budget holds
-    assert sole >= 0.1
-    assert TPUCSP(stall_factor=None)._sole_deadline_for(3000) is None
+    latency budget — deadline + estimated host-race stays inside
+    ~420 ms even when a slow chip window inflates the EWMA past it —
+    while the pipelined deadline keeps its anchor.  The race reserve
+    uses the MEASURED host rate when one exists."""
+    import fabric_tpu.csp.tpu.provider as prov
+
+    with prov._host_rate_lock:
+        saved = prov._host_rate_ewma[0]
+        prov._host_rate_ewma[0] = None  # hint-only, deterministic
+    try:
+        csp = TPUCSP(stall_factor=1.0, host_rate_hint=9000.0)
+        # slow window: ordinary flush wall 0.25s for 3000 lanes
+        for _ in range(8):
+            csp._note_device_wall(3000, 0.25)
+        pipelined = csp._deadline_for(3000)
+        assert pipelined == max(0.2, 3000 / 9000.0)  # anchor-capped
+        sole = csp._sole_deadline_for(3000)
+        assert sole is not None
+        assert sole + 3000 / 9000.0 <= 0.421  # budget holds
+        assert sole >= 0.05
+        assert TPUCSP(stall_factor=None)._sole_deadline_for(3000) is None
+        # a SLOWER measured host rate shrinks the deadline further
+        prov._note_host_rate(3000, 0.5)  # 6000 sigs/s observed
+        tighter = csp._sole_deadline_for(3000)
+        assert tighter == 0.05  # 0.42 - 0.5 < floor
+    finally:
+        with prov._host_rate_lock:
+            prov._host_rate_ewma[0] = saved
 
 
 def test_flush_deadline_host_race_beats_stalled_device():
