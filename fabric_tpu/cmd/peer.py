@@ -12,6 +12,10 @@ ops, chaincode invoke/query, lifecycle commands.
         --orderer :7050 --mspid ... --msp-dir ...
     peer chaincode query  -C ch -n mycc -a get -a k --peer :7051 ...
     peer lifecycle queryinstalled/querycommitted/...
+    peer snapshot submitrequest -c ch -b 500 --peer :7051
+    peer snapshot listpending -c ch --peer :7051
+    peer snapshot joinbysnapshot --snapshotpath .../completed/ch/499 \
+        --peer :7051
 """
 
 from __future__ import annotations
@@ -391,6 +395,65 @@ def cmd_node_upgrade_dbs(args) -> int:
     return 0
 
 
+def cmd_snapshot_submitrequest(args) -> int:
+    """Request a channel snapshot at a block number (0 = the last
+    committed block, generated immediately); future blocks auto-trigger
+    at commit (reference peer snapshot submitrequest)."""
+    import json
+
+    payload = json.dumps(
+        {"channel": args.channel, "block_number": args.block_number}
+    ).encode()
+    raw = RPCClient(*parse_endpoint(args.peer), tls=tls_from_args(args)).call(
+        "admin.SnapshotSubmit", payload
+    )
+    res = json.loads(raw.decode())
+    if res.get("snapshot_dir"):
+        print(f"snapshot generated at {res['snapshot_dir']}")
+    else:
+        print(
+            f"snapshot request submitted for block {res['block_number']}"
+        )
+    return 0
+
+
+def cmd_snapshot_cancelrequest(args) -> int:
+    import json
+
+    payload = json.dumps(
+        {"channel": args.channel, "block_number": args.block_number}
+    ).encode()
+    RPCClient(*parse_endpoint(args.peer), tls=tls_from_args(args)).call(
+        "admin.SnapshotCancel", payload
+    )
+    print(f"cancelled snapshot request for block {args.block_number}")
+    return 0
+
+
+def cmd_snapshot_listpending(args) -> int:
+    import json
+
+    raw = RPCClient(*parse_endpoint(args.peer), tls=tls_from_args(args)).call(
+        "admin.SnapshotList", args.channel.encode()
+    )
+    pending = json.loads(raw.decode())
+    print(
+        "pending: " + (", ".join(str(n) for n in pending) if pending else "none")
+    )
+    return 0
+
+
+def cmd_snapshot_joinbysnapshot(args) -> int:
+    """Join a channel from a snapshot directory: the peer bootstraps a
+    blockless ledger at the snapshot height and catches up from the
+    orderer from there (reference peer channel joinbysnapshot)."""
+    raw = RPCClient(*parse_endpoint(args.peer), tls=tls_from_args(args)).call(
+        "admin.JoinBySnapshot", args.snapshotpath.encode()
+    )
+    print(f"joined channel {raw.decode()} from snapshot")
+    return 0
+
+
 def cmd_channel_create(args) -> int:
     """Create a channel: submit its genesis block to the orderer's
     channel-participation API (the reference's post-system-channel flow:
@@ -526,6 +589,28 @@ def main(argv=None) -> int:
     fetch.add_argument("--mspid")
     fetch.add_argument("--msp-dir")
     fetch.set_defaults(fn=cmd_channel_fetch)
+
+    snap = sub.add_parser("snapshot").add_subparsers(dest="sub", required=True)
+    for name, fn, needs_block in (
+        ("submitrequest", cmd_snapshot_submitrequest, False),
+        ("cancelrequest", cmd_snapshot_cancelrequest, True),
+        ("listpending", cmd_snapshot_listpending, False),
+    ):
+        p = snap.add_parser(name, parents=[tlsp])
+        p.add_argument("-c", "--channel", required=True)
+        p.add_argument("--peer", required=True)
+        if name != "listpending":
+            p.add_argument(
+                "-b", "--block-number", type=int,
+                required=needs_block, default=0,
+                help="0 = snapshot the last committed block now",
+            )
+        p.set_defaults(fn=fn)
+    jbs = snap.add_parser("joinbysnapshot", parents=[tlsp])
+    jbs.add_argument("--snapshotpath", required=True,
+                     help="completed snapshot directory on the peer host")
+    jbs.add_argument("--peer", required=True)
+    jbs.set_defaults(fn=cmd_snapshot_joinbysnapshot)
 
     cc = sub.add_parser("chaincode").add_subparsers(dest="sub", required=True)
     for name, fn, needs_orderer in (

@@ -319,6 +319,43 @@ class _Noop:
         pass
 
 
+class SnapshotMetrics:
+    """Channel-snapshot workload metrics (the gendoc-catalog role for
+    the new subsystem): generation latency, bytes pushed through the
+    CSP hash_batch path with its observed throughput, and the pending-
+    request gauge.  Built from any metrics provider; the operations
+    System exposes a prometheus-registered instance
+    (common/operations.py snapshot_metrics())."""
+
+    def __init__(self, provider):
+        self.generation_duration = provider.new_histogram(HistogramOpts(
+            namespace="snapshot",
+            name="generation_duration",
+            help="Seconds to generate one channel snapshot.",
+            statsd_format="%{channel}",
+        ))
+        self.bytes_hashed = provider.new_counter(CounterOpts(
+            namespace="snapshot",
+            name="bytes_hashed",
+            help="Total snapshot bytes digested through the CSP "
+                 "hash_batch path.",
+            statsd_format="%{channel}",
+        ))
+        self.hash_mb_per_s = provider.new_gauge(GaugeOpts(
+            namespace="snapshot",
+            name="hash_batch_mb_per_s",
+            help="hash_batch throughput observed during the last "
+                 "snapshot export (MB/s).",
+            statsd_format="%{channel}",
+        ))
+        self.pending_requests = provider.new_gauge(GaugeOpts(
+            namespace="snapshot",
+            name="pending_requests",
+            help="Number of pending snapshot requests.",
+            statsd_format="%{channel}",
+        ))
+
+
 __all__ = [
     "CounterOpts",
     "GaugeOpts",
@@ -330,4 +367,5 @@ __all__ = [
     "PrometheusRegistry",
     "StatsdProvider",
     "DisabledProvider",
+    "SnapshotMetrics",
 ]

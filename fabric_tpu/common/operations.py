@@ -33,6 +33,7 @@ class System:
     ):
         self.version = version
         self._checkers: dict[str, object] = {}
+        self._snapshot_metrics = None
         self._lock = threading.Lock()
         if provider == "prometheus":
             self.metrics_provider = PrometheusProvider()
@@ -115,6 +116,21 @@ class System:
     def stop(self) -> None:
         self._server.shutdown()
         self._server.server_close()
+
+    # -- workload metric bundles -------------------------------------------
+
+    def snapshot_metrics(self):
+        """Lazily-built channel-snapshot metrics bound to this system's
+        provider, so snapshot generation/pending gauges surface on the
+        /metrics endpoint (prometheus) or the statsd stream."""
+        with self._lock:
+            if self._snapshot_metrics is None:
+                from fabric_tpu.common.metrics import SnapshotMetrics
+
+                self._snapshot_metrics = SnapshotMetrics(
+                    self.metrics_provider
+                )
+            return self._snapshot_metrics
 
     # -- health ------------------------------------------------------------
 

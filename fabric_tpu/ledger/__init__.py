@@ -17,8 +17,18 @@ from fabric_tpu.ledger.blkstorage import BlockStore, BlockStoreError
 from fabric_tpu.ledger.history import HistoryDB
 from fabric_tpu.ledger.txmgmt import MVCCValidator, TxSimulator
 from fabric_tpu.ledger.kvledger import KVLedger, LedgerProvider, extract_rwsets
+from fabric_tpu.ledger.snapshot import (
+    SnapshotError,
+    SnapshotManager,
+    generate_snapshot,
+    verify_snapshot,
+)
 
 __all__ = [
+    "SnapshotError",
+    "SnapshotManager",
+    "generate_snapshot",
+    "verify_snapshot",
     "KVStore",
     "MemKVStore",
     "SqliteKVStore",

@@ -53,12 +53,32 @@ def list_channels(root_dir: str) -> list[str]:
     )
 
 
+def _check_not_snapshot_bootstrapped(kv, ledger_id: str, op: str) -> None:
+    """Refuse repair ops that would truncate or rebuild through a
+    snapshot bootstrap: blocks below the bootstrap height do not exist
+    locally, so neither a rollback target below it nor a derived-DB
+    replay from block 0 is possible (the reference's rollback/reset/
+    rebuild validation refuses bootstrapped channels the same way)."""
+    from fabric_tpu.ledger.blkstorage import read_bootstrap_height
+
+    bh = read_bootstrap_height(kv, ledger_id)
+    if bh:
+        raise ValueError(
+            f"channel {ledger_id!r} was bootstrapped from a snapshot at "
+            f"block {bh - 1}: {op} would truncate it below its bootstrap "
+            f"height {bh}, and blocks before the snapshot do not exist "
+            "locally to replay"
+        )
+
+
 def rebuild_dbs(root_dir: str, ledger_id: str | None = None) -> list[str]:
     """Drop state/history DBs for one (or every) channel; next open
     replays them from blocks (reference rebuild-dbs + RebuildDBs)."""
     ids = [ledger_id] if ledger_id else list_channels(root_dir)
     kv = _open_kv(root_dir)
     try:
+        for lid in ids:
+            _check_not_snapshot_bootstrapped(kv, lid, "rebuild-dbs")
         for lid in ids:
             for p in _derived_prefixes(lid):
                 _wipe_prefix(kv, p)
@@ -73,6 +93,7 @@ def rollback(root_dir: str, ledger_id: str, target_block: int) -> int:
     kvledger/rollback.go).  Returns the new height."""
     kv = _open_kv(root_dir)
     try:
+        _check_not_snapshot_bootstrapped(kv, ledger_id, "rollback")
         chains_dir = os.path.join(root_dir, ledger_id, "chains")
         store = BlockStore(chains_dir, kv, name=ledger_id)
         if store.height == 0:
@@ -108,7 +129,16 @@ def reset(root_dir: str) -> dict[str, int]:
     """Roll every channel back to its genesis block (reference peer node
     reset)."""
     out = {}
-    for lid in list_channels(root_dir):
+    channels = list_channels(root_dir)
+    # validate EVERY channel before truncating the first one — failing
+    # mid-loop would leave an irreversible half-reset
+    kv = _open_kv(root_dir)
+    try:
+        for lid in channels:
+            _check_not_snapshot_bootstrapped(kv, lid, "reset")
+    finally:
+        kv.close()
+    for lid in channels:
         kv = _open_kv(root_dir)
         try:
             store = BlockStore(

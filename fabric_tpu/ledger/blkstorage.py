@@ -22,9 +22,30 @@ from fabric_tpu import protoutil
 _LEN = struct.Struct(">I")
 ROLL_SIZE = 64 * 1024 * 1024
 
+# bootstrap-from-snapshot info: ">Q" last snapshot block number + its
+# header hash (reference blkstorage bootstrappingSnapshotInfo)
+_BSI_KEY = b"bsi"
+# the channel's config block bytes for ledgers bootstrapped without
+# blocks (join-by-snapshot peers rebuild their channel bundle from this)
+_CFG_KEY = b"cfg"
+# txid-index sentinel for transactions that predate the snapshot: the
+# txid exists (duplicate-tx guard) but no block location does
+_SNAPSHOT_TX_LOC = struct.pack(">QQ", 0xFFFFFFFFFFFFFFFF, 0xFFFFFFFFFFFFFFFF)
+
 
 class BlockStoreError(Exception):
     pass
+
+
+def _bsi_height(raw: bytes | None) -> int:
+    return 0 if raw is None else struct.unpack(">Q", raw[:8])[0] + 1
+
+
+def read_bootstrap_height(index_store: KVStore, name: str) -> int:
+    """Snapshot-bootstrap height straight from a store's index WITHOUT
+    constructing the BlockStore (no recovery file scan, no checkpoint
+    write) — the cheap probe the repair-op guards use."""
+    return _bsi_height(NamedDB(index_store, f"blkindex/{name}").get(_BSI_KEY))
 
 
 class BlockStore:
@@ -55,6 +76,10 @@ class BlockStore:
 
     def _recover_index_only(self) -> None:
         _, _, self._height = self._checkpoint()
+        if self._height and not self._last_hash:
+            raw = self._index.get(_BSI_KEY)
+            if raw is not None:
+                self._last_hash = raw[8:]
 
     def _recover(self) -> None:
         """Re-index any blocks appended after the last checkpoint; truncate
@@ -91,7 +116,13 @@ class BlockStore:
                 break
         if self._height > 0:
             last = self.get_block_by_number(self._height - 1)
-            self._last_hash = protoutil.block_header_hash(last.header)
+            if last is not None:
+                self._last_hash = protoutil.block_header_hash(last.header)
+            else:
+                # snapshot-bootstrapped store with no appended blocks yet:
+                # the last hash lives in the bootstrap info, not a file
+                raw = self._index.get(_BSI_KEY)
+                self._last_hash = raw[8:] if raw is not None else b""
         self._write_checkpoint(file_idx, offset)
 
     def _write_checkpoint(self, file_idx: int, offset: int) -> None:
@@ -162,6 +193,67 @@ class BlockStore:
     def info(self):
         return {"height": self._height, "currentBlockHash": self._last_hash}
 
+    # -- snapshot bootstrap (reference blkstorage BootstrapFromSnapshottedTxIDs)
+
+    @property
+    def bootstrap_height(self) -> int:
+        """Chain height at snapshot bootstrap (0 when this store was not
+        bootstrapped from a snapshot).  Blocks below this height do not
+        exist locally and can never be replayed — repair ops must refuse
+        to truncate through it (ledger/admin.py)."""
+        return _bsi_height(self._index.get(_BSI_KEY))
+
+    def bootstrap(
+        self,
+        last_block_num: int,
+        last_block_hash: bytes,
+        config_block: bytes | None = None,
+    ) -> None:
+        """Initialize an EMPTY store from snapshot bootstrap info: the
+        store reports height last_block_num+1 and accepts the next block
+        at that number, with no block files below it."""
+        with self._lock:
+            if self._height:
+                raise BlockStoreError(
+                    "cannot bootstrap a non-empty block store "
+                    f"(height {self._height})"
+                )
+            self._height = last_block_num + 1
+            self._last_hash = last_block_hash
+            puts = {
+                _BSI_KEY: struct.pack(">Q", last_block_num) + last_block_hash
+            }
+            if config_block is not None:
+                puts[_CFG_KEY] = config_block
+            self._index.write_batch(puts)
+            self._write_checkpoint(0, 0)
+
+    def config_block_bytes(self) -> bytes | None:
+        """The config block stored at snapshot import (None for stores
+        that keep their config in chain block 0)."""
+        return self._index.get(_CFG_KEY)
+
+    def import_snapshot_txids(self, txids) -> None:
+        """Load the snapshot's committed-txid set into the txid index
+        under a sentinel location: tx_ids_exist sees them (duplicate-tx
+        rejection spans the snapshot boundary) while location queries
+        report not-found, matching the reference's 'details not
+        available from snapshot' semantics."""
+        chunk: dict[bytes, bytes] = {}
+        for txid in txids:
+            chunk[b"t" + txid.encode()] = _SNAPSHOT_TX_LOC
+            if len(chunk) >= 10000:
+                self._index.write_batch_if_absent(chunk)
+                chunk = {}
+        if chunk:
+            self._index.write_batch_if_absent(chunk)
+
+    def export_txids(self):
+        """Every indexed txid (appended blocks AND snapshot-imported
+        ones, so chained snapshots stay complete), in index order."""
+        for k, _ in self._index.iterate(b"t", b"u"):
+            yield k[1:].decode()
+
     def add_block(
         self,
         blk: common_pb2.Block,
@@ -227,8 +319,8 @@ class BlockStore:
 
     def get_tx_loc(self, txid: str) -> tuple[int, int] | None:
         raw = self._index.get(b"t" + txid.encode())
-        if raw is None:
-            return None
+        if raw is None or raw == _SNAPSHOT_TX_LOC:
+            return None  # sentinel: committed before the snapshot
         num, pos = struct.unpack(">QQ", raw)
         return num, pos
 
@@ -262,4 +354,4 @@ class BlockStore:
             num += 1
 
 
-__all__ = ["BlockStore", "BlockStoreError"]
+__all__ = ["BlockStore", "BlockStoreError", "read_bootstrap_height"]

@@ -56,5 +56,19 @@ class ConfigHistoryMgr:
     def retriever(self) -> ConfigHistoryRetriever:
         return ConfigHistoryRetriever(self._db)
 
+    # -- snapshot export / import (reference confighistory/db_helper
+    # ExportConfigHistory / ImportConfigHistory) ---------------------------
+
+    def export_entries(self):
+        """All (key, value) entries in key order — the deterministic
+        stream channel snapshots carry so a restored peer can still
+        answer most_recent_below for pre-snapshot blocks."""
+        return self._db.iterate(b"", None)
+
+    def import_entries(self, entries) -> None:
+        puts = dict(entries)
+        if puts:
+            self._db.write_batch(puts)
+
 
 __all__ = ["ConfigHistoryMgr", "ConfigHistoryRetriever"]

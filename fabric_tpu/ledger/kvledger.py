@@ -9,6 +9,7 @@ newer than their savepoints), ledgermgmt/ledger_mgmt.go lifecycle.
 from __future__ import annotations
 
 import os
+import threading
 
 from fabric_tpu.ledger.blkstorage import BlockStore
 from fabric_tpu.ledger.history import HistoryDB
@@ -110,6 +111,7 @@ class KVLedger:
         kv: KVStore,
         btl_policy=None,
     ):
+        from fabric_tpu.ledger.confighistory import ConfigHistoryMgr
         from fabric_tpu.ledger.pvtdatastorage import PvtDataStore
 
         self.ledger_id = ledger_id
@@ -118,6 +120,17 @@ class KVLedger:
         self._history = HistoryDB(kv, f"historydb/{ledger_id}")
         self._mvcc = MVCCValidator(self._state)
         self.pvt_store = PvtDataStore(kv, ledger_id, btl_policy=btl_policy)
+        self.config_history = ConfigHistoryMgr(kv, ledger_id)
+        # SnapshotManager wired by the provider after construction (it
+        # needs the ledger); commit() notifies it per committed block
+        self.snapshots = None
+        # Serializes state mutation against snapshot export: commits are
+        # already single-threaded per ledger (one committer), but an
+        # admin RPC can request an on-demand snapshot concurrently — the
+        # export takes this lock so it never reads a half-committed
+        # block.  RLock because the commit-time auto-trigger generates
+        # while the committing thread already holds it.
+        self.commit_lock = threading.RLock()
         self._recover()
 
     def set_btl_policy(self, btl_policy) -> None:
@@ -172,30 +185,36 @@ class KVLedger:
         re-unmarshal (MVCC + history read the decoded footprints), the
         txid envelope parse in the block index, and the whole-block
         re-serialization (splice from the envelope bytes)."""
-        flags = list(protoutil.tx_filter(block))
-        footprints = txids = env_bytes = None
-        if assist is not None and len(assist.rwsets) == len(flags):
-            rwsets = assist.rwsets
-            footprints = assist.footprints
-            txids = assist.txids
-            env_bytes = assist.env_bytes
-        if rwsets is None or len(rwsets) != len(flags):
-            rwsets = extract_rwsets(block)
-        batch = self._mvcc.validate_and_prepare(
-            block.header.number, rwsets, flags, pvt_data,
-            footprints=footprints,
-        )
-        protoutil.set_tx_filter(block, flags)
-        self._blocks.add_block(block, txids=txids, env_bytes=env_bytes)
-        # Pvt store before state so recovery-after-crash can replay the
-        # cleartext writes (state savepoint is the recovery watermark).
-        self.pvt_store.commit(
-            block.header.number, pvt_data or {}, missing_pvt
-        )
-        self._state.apply_updates(batch, Height(block.header.number, len(flags)))
-        self._history.commit(
-            block.header.number, _history_writes(rwsets, flags, footprints)
-        )
+        with self.commit_lock:
+            flags = list(protoutil.tx_filter(block))
+            footprints = txids = env_bytes = None
+            if assist is not None and len(assist.rwsets) == len(flags):
+                rwsets = assist.rwsets
+                footprints = assist.footprints
+                txids = assist.txids
+                env_bytes = assist.env_bytes
+            if rwsets is None or len(rwsets) != len(flags):
+                rwsets = extract_rwsets(block)
+            batch = self._mvcc.validate_and_prepare(
+                block.header.number, rwsets, flags, pvt_data,
+                footprints=footprints,
+            )
+            protoutil.set_tx_filter(block, flags)
+            self._blocks.add_block(block, txids=txids, env_bytes=env_bytes)
+            # Pvt store before state so recovery-after-crash can replay
+            # the cleartext writes (state savepoint is the recovery
+            # watermark).
+            self.pvt_store.commit(
+                block.header.number, pvt_data or {}, missing_pvt
+            )
+            self._state.apply_updates(
+                batch, Height(block.header.number, len(flags))
+            )
+            self._history.commit(
+                block.header.number, _history_writes(rwsets, flags, footprints)
+            )
+            if self.snapshots is not None:
+                self.snapshots.on_block_committed(block.header.number)
 
     def commit_old_pvt_data(
         self, block_num: int, tx_num: int, pvt_bytes: bytes
@@ -244,6 +263,13 @@ class KVLedger:
         return self._blocks
 
     @property
+    def state_db(self):
+        """Read access to the versioned state DB (the snapshot exporter
+        streams its raw records; everything else should go through the
+        query executor / simulator)."""
+        return self._state
+
+    @property
     def height(self) -> int:
         return self._blocks.height
 
@@ -263,7 +289,9 @@ class KVLedger:
         return self._blocks.get_tx_validation_code(txid)
 
     def tx_id_exists(self, txid: str) -> bool:
-        return self._blocks.get_tx_loc(txid) is not None
+        # presence probe, not a location lookup: txids imported from a
+        # snapshot have no block location but still count as duplicates
+        return bool(self._blocks.tx_ids_exist([txid]))
 
     def tx_ids_exist(self, txids) -> set[str]:
         """Bulk duplicate-txid probe (one index round-trip)."""
@@ -355,10 +383,20 @@ class QueryExecutor:
 
 class LedgerProvider:
     """Opens/creates per-channel ledgers under one root (reference
-    kv_ledger_provider.go + ledgermgmt)."""
+    kv_ledger_provider.go + ledgermgmt).  `csp`/`metrics` feed the
+    snapshot subsystem: per-file digests of generated snapshots go
+    through csp.hash_batch (TPU-batched when the node runs the tpu
+    provider, sw fallback otherwise); `snapshots_dir` defaults to
+    <root>/snapshots."""
 
-    def __init__(self, root_dir: str | None = None):
+    def __init__(self, root_dir: str | None = None, csp=None, metrics=None,
+                 snapshots_dir: str | None = None):
         self._root = root_dir
+        self._csp = csp
+        self._metrics = metrics
+        if snapshots_dir is None and root_dir is not None:
+            snapshots_dir = os.path.join(root_dir, "snapshots")
+        self._snapshots_dir = snapshots_dir
         if root_dir is None:
             self._kv = MemKVStore()
         else:
@@ -384,6 +422,47 @@ class LedgerProvider:
         )
         store = BlockStore(block_dir, self._kv, name=ledger_id)
         ledger = KVLedger(ledger_id, store, self._kv)
+        self._wire_snapshots(ledger)
+        self._ledgers[ledger_id] = ledger
+        return ledger
+
+    def _wire_snapshots(self, ledger: KVLedger) -> None:
+        from fabric_tpu.ledger.snapshot import SnapshotManager
+
+        ledger.snapshots = SnapshotManager(
+            ledger, self._snapshots_dir, self._kv,
+            csp=self._csp, metrics=self._metrics,
+        )
+
+    def create_from_snapshot(self, snapshot_dir: str) -> KVLedger:
+        """Bootstrap a BLOCKLESS channel ledger from a verified snapshot
+        (reference kv_ledger_provider.go CreateFromSnapshot): the block
+        store records the bootstrap height + last block hash so commit
+        resumes at the snapshot height, the state DB is bulk-loaded with
+        its savepoint at the snapshot, and deliver-based catch-up
+        (height_fn) naturally starts there.  Verification recomputes
+        every file digest through csp.hash_batch and refuses tampered
+        snapshots."""
+        from fabric_tpu.ledger import snapshot as snap
+
+        meta = snap.verify_snapshot(snapshot_dir, csp=self._csp)
+        ledger_id = meta["channel_id"]
+        if ledger_id in self._ledgers:
+            raise snap.SnapshotError(
+                f"ledger {ledger_id!r} already exists"
+            )
+        block_dir = (
+            None if self._root is None
+            else os.path.join(self._root, ledger_id, "chains")
+        )
+        store = BlockStore(block_dir, self._kv, name=ledger_id)
+        if store.height:
+            raise snap.SnapshotError(
+                f"channel {ledger_id!r} already has {store.height} blocks"
+            )
+        snap.import_snapshot(meta, snapshot_dir, store, self._kv, ledger_id)
+        ledger = KVLedger(ledger_id, store, self._kv)
+        self._wire_snapshots(ledger)
         self._ledgers[ledger_id] = ledger
         return ledger
 

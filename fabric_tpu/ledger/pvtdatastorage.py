@@ -10,6 +10,7 @@ block-to-live (BTL) via an expiry index consulted on every commit.
 from __future__ import annotations
 
 import json
+import struct
 import threading
 
 from fabric_tpu.ledger.kvstore import KVStore, NamedDB
@@ -18,6 +19,7 @@ from fabric_tpu.protos.ledger.rwset import rwset_pb2
 _DATA = b"d"  # d<block:16x><tx:8x> -> TxPvtReadWriteSet
 _MISS = b"m"  # m<block:16x><tx:8x> -> json [[ns, coll], ...]
 _EXP = b"x"   # x<expiry:16x><block:16x> -> json [[tx, ns, coll], ...]
+_BOOT = b"b"  # ">Q" snapshot bootstrap height (see init_bootstrap_height)
 
 
 def _dkey(block: int, tx: int) -> bytes:
@@ -128,6 +130,21 @@ class PvtDataStore:
                     deletes.append(dkey)
         if deletes or rewrites:
             self._db.write_batch(rewrites, deletes)
+
+    # -- snapshot bootstrap ------------------------------------------------
+
+    def init_bootstrap_height(self, height: int) -> None:
+        """Record that this store was created from a snapshot taken at
+        `height` (reference pvtdatastorage InitLastCommittedBlock): no
+        cleartext private data exists below it — blocks before the
+        bootstrap hold hashes only (in the state DB) until the
+        reconciler fetches the cleartext from collection peers."""
+        self._db.put(_BOOT, struct.pack(">Q", height))
+
+    @property
+    def bootstrap_height(self) -> int:
+        raw = self._db.get(_BOOT)
+        return 0 if raw is None else struct.unpack(">Q", raw)[0]
 
     # -- queries -----------------------------------------------------------
 

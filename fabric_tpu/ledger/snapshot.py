@@ -1,0 +1,523 @@
+"""Channel snapshots & join-by-snapshot: TPU-hashed ledger checkpoints.
+
+Reference: core/ledger/kvledger/snapshot.go + snapshot_mgmt.go (generate
+at commit, request bookkeeping), core/ledger/kvledger/kv_ledger_provider.go
+CreateFromSnapshot, internal/peer/snapshot (CLI surface).  A snapshot is a
+directory of deterministic, ordered export files
+
+    public_state.data          raw (key, value) records of the public
+                               state namespaces, in state-key order
+    private_state_hashes.data  the derived hashed-collection namespaces
+                               (key hashes + value hashes; cleartext
+                               private data is NEVER exported — a
+                               restored peer reconciles it later)
+    txids.data                 every committed txid (duplicate-tx guard)
+    confighistory.data         collection-config history entries
+    channel_config.block       the channel's config block (lets a peer
+                               with no blocks build its channel bundle)
+    _snapshot_signable_metadata.json
+                               channel id, last block number/hash, and
+                               per-file SHA-256 digests
+
+The per-file digests are computed through the CSP `hash_batch` seam
+(fabric_tpu/csp/api.py) — one batched call for all files — so snapshot
+integrity hashing rides the same TPU-batched path as block validation,
+with the sw provider as the host fallback.  `verify_snapshot` recomputes
+the digests on import and refuses a tampered directory.
+
+Request lifecycle (reference snapshot_mgmt.go): requests are persisted
+under the ledger's bookkeeping/snapshot-request namespace (submit /
+cancel / list-pending) and the ledger triggers generation automatically
+when it commits the requested block number.  Generated snapshots land in
+
+    <snapshots_root>/completed/<ledger_id>/<last_block_number>/
+
+written via an in_progress staging directory + atomic rename so a crash
+never leaves a half-written "completed" snapshot.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import struct
+import threading
+import time
+
+from fabric_tpu.ledger.bookkeeping import (
+    SNAPSHOT_REQUEST,
+    BookkeepingProvider,
+)
+from fabric_tpu.ledger.confighistory import ConfigHistoryMgr
+from fabric_tpu.ledger.kvstore import KVStore
+from fabric_tpu.ledger.pvtdatastorage import PvtDataStore
+from fabric_tpu.ledger.statedb import Height, VersionedDB
+
+SNAPSHOT_FORMAT_VERSION = 1
+
+METADATA_FILE = "_snapshot_signable_metadata.json"
+PUBLIC_STATE_FILE = "public_state.data"
+PVT_HASHES_FILE = "private_state_hashes.data"
+TXIDS_FILE = "txids.data"
+CONFIG_HISTORY_FILE = "confighistory.data"
+CONFIG_BLOCK_FILE = "channel_config.block"
+
+# the data files whose digests enter the signable metadata, in the fixed
+# order they are hashed (sorted, so the metadata is deterministic)
+DATA_FILES = (
+    CONFIG_BLOCK_FILE,
+    CONFIG_HISTORY_FILE,
+    PVT_HASHES_FILE,
+    PUBLIC_STATE_FILE,
+    TXIDS_FILE,
+)
+
+_LEN = struct.Struct(">I")
+
+
+class SnapshotError(Exception):
+    pass
+
+
+# -- record files ------------------------------------------------------------
+#
+# All .data files share one trivially deterministic format: a sequence of
+# length-prefixed (key, value) byte-string pairs in the order the source
+# store iterates them (lexicographic key order everywhere).
+
+
+def _write_record(f, k: bytes, v: bytes) -> None:
+    f.write(_LEN.pack(len(k)))
+    f.write(k)
+    f.write(_LEN.pack(len(v)))
+    f.write(v)
+
+
+def write_records(path: str, records) -> tuple[int, int]:
+    """Write (key, value) pairs; returns (record_count, byte_count)."""
+    count = size = 0
+    with open(path, "wb") as f:
+        for k, v in records:
+            _write_record(f, k, v)
+            count += 1
+            size += 8 + len(k) + len(v)
+    return count, size
+
+
+def read_records(path: str):
+    """Yield the (key, value) pairs of a record file; raises
+    SnapshotError on a truncated or malformed file."""
+    with open(path, "rb") as f:
+        while True:
+            hdr = f.read(_LEN.size)
+            if not hdr:
+                return
+            if len(hdr) < _LEN.size:
+                raise SnapshotError(f"truncated record file {path!r}")
+            (klen,) = _LEN.unpack(hdr)
+            k = f.read(klen)
+            vhdr = f.read(_LEN.size)
+            if len(k) < klen or len(vhdr) < _LEN.size:
+                raise SnapshotError(f"truncated record file {path!r}")
+            (vlen,) = _LEN.unpack(vhdr)
+            v = f.read(vlen)
+            if len(v) < vlen:
+                raise SnapshotError(f"truncated record file {path!r}")
+            yield k, v
+
+
+# -- request bookkeeping -----------------------------------------------------
+
+
+class SnapshotRequestBookkeeper:
+    """Durable pending snapshot requests (reference snapshot_mgmt.go
+    snapshotRequestBookkeeper): one key per requested block number under
+    the ledger's bookkeeping/<ledger>/snapshot-request namespace, so
+    pending requests survive a peer restart."""
+
+    def __init__(self, db):
+        self._db = db
+
+    @staticmethod
+    def _key(block_number: int) -> bytes:
+        return b"%016x" % block_number
+
+    def submit(self, block_number: int) -> None:
+        if self.has(block_number):
+            raise SnapshotError(
+                f"snapshot request for block {block_number} already pending"
+            )
+        self._db.put(self._key(block_number), b"")
+
+    def cancel(self, block_number: int) -> None:
+        if not self.has(block_number):
+            raise SnapshotError(
+                f"no pending snapshot request for block {block_number}"
+            )
+        self._db.delete(self._key(block_number))
+
+    def has(self, block_number: int) -> bool:
+        return self._db.get(self._key(block_number)) is not None
+
+    def list_pending(self) -> list[int]:
+        return [int(k, 16) for k, _ in self._db.iterate(b"", None)]
+
+
+# -- generation --------------------------------------------------------------
+
+
+def _metadata_path(snapshot_dir: str) -> str:
+    return os.path.join(snapshot_dir, METADATA_FILE)
+
+
+def load_metadata(snapshot_dir: str) -> dict:
+    path = _metadata_path(snapshot_dir)
+    if not os.path.isfile(path):
+        raise SnapshotError(f"no snapshot metadata at {path!r}")
+    with open(path, "rb") as f:
+        return json.loads(f.read().decode("utf-8"))
+
+
+def _hash_files(snapshot_dir: str, names, csp=None, metrics=None,
+                channel: str = ""):
+    """Per-file SHA-256 digests through the CSP hash_batch seam — ONE
+    batched call covers every file, so on the TPU provider the whole
+    snapshot is digested device-side; sw is the host fallback.  When the
+    csp package itself is unavailable (hosts without `cryptography`),
+    hashlib produces the identical digests."""
+    if csp is None:
+        try:
+            from fabric_tpu.csp.factory import get_default
+
+            csp = get_default()
+        except ImportError:
+            csp = None
+    blobs = []
+    for name in names:
+        path = os.path.join(snapshot_dir, name)
+        if not os.path.isfile(path):
+            raise SnapshotError(f"snapshot file {name!r} is missing")
+        with open(path, "rb") as f:
+            blobs.append(f.read())
+    t0 = time.perf_counter()
+    if csp is not None:
+        digests = csp.hash_batch(blobs)
+    else:
+        import hashlib
+
+        digests = [hashlib.sha256(b).digest() for b in blobs]
+    dt = time.perf_counter() - t0
+    total = sum(len(b) for b in blobs)
+    if metrics is not None:
+        metrics.bytes_hashed.With("channel", channel).add(total)
+        if dt > 0:
+            metrics.hash_mb_per_s.With("channel", channel).set(
+                total / dt / 1e6
+            )
+    return {name: d.hex() for name, d in zip(names, digests)}
+
+
+def generate_snapshot(
+    ledger, snapshots_root: str, csp=None, metrics=None
+) -> str:
+    """Export the ledger into <snapshots_root>/completed/<id>/<height-1>
+    and return the snapshot directory.  Deterministic: same ledger state
+    -> byte-identical files -> identical signable metadata."""
+    if not snapshots_root:
+        raise SnapshotError("ledger provider has no snapshots directory")
+    height = ledger.height
+    if height == 0:
+        raise SnapshotError("cannot snapshot an empty ledger")
+    t0 = time.perf_counter()
+    lid = ledger.ledger_id
+    last_num = height - 1
+    final_dir = os.path.join(snapshots_root, "completed", lid, str(last_num))
+    if os.path.exists(final_dir):
+        raise SnapshotError(
+            f"snapshot for {lid!r} at block {last_num} already exists"
+        )
+    work = os.path.join(snapshots_root, "in_progress", f"{lid}-{last_num}")
+    if os.path.isdir(work):
+        shutil.rmtree(work)  # a crashed previous attempt
+    os.makedirs(work)
+
+    store = ledger.block_store
+    state: VersionedDB = ledger.state_db
+
+    # state: ONE ordered pass routing each record to the public or
+    # hashed-collection file; cleartext private namespaces are skipped
+    # (the reference never exports them either — a restored peer
+    # reconciles cleartext from collection peers).  The ns/key split is
+    # heuristic (a public KEY may itself embed '\x00pvt\x00'-shaped
+    # bytes — the statedb key encoding cannot distinguish that from a
+    # collection namespace), so a pvt-classified record is only DROPPED
+    # when its hashed counterpart exists: every genuinely-private
+    # committed write also committed a hash-namespace entry
+    # (txmgmt validate_and_prepare), while a look-alike public key has
+    # none and must ride the public file.  Misrouting between the two
+    # EXPORTED files is harmless — import re-writes raw records
+    # verbatim from both.
+    import hashlib as _hashlib
+
+    with open(os.path.join(work, PUBLIC_STATE_FILE), "wb") as pub_f, \
+            open(os.path.join(work, PVT_HASHES_FILE), "wb") as hash_f:
+        for raw_key, raw_val in state.export_records():
+            ns, key = VersionedDB.split_state_key(raw_key)
+            parts = ns.split("\x00")
+            if len(parts) == 3 and parts[1] == "pvt":
+                hashed_ns = f"{parts[0]}\x00hash\x00{parts[2]}"
+                khash = _hashlib.sha256(key.encode()).hexdigest()
+                if state.get_state(hashed_ns, khash) is not None:
+                    continue  # confirmed cleartext private: never export
+            out = hash_f if len(parts) == 3 and parts[1] == "hash" else pub_f
+            _write_record(out, raw_key, raw_val)
+    write_records(
+        os.path.join(work, TXIDS_FILE),
+        ((t.encode(), b"") for t in store.export_txids()),
+    )
+    write_records(
+        os.path.join(work, CONFIG_HISTORY_FILE),
+        ledger.config_history.export_entries(),
+    )
+    cfg_raw = store.config_block_bytes()
+    if cfg_raw is None:
+        blk0 = store.get_block_by_number(0)
+        if blk0 is None:
+            raise SnapshotError(
+                f"ledger {lid!r} has neither a config block nor block 0"
+            )
+        cfg_raw = blk0.SerializeToString()
+    with open(os.path.join(work, CONFIG_BLOCK_FILE), "wb") as f:
+        f.write(cfg_raw)
+
+    files = _hash_files(work, DATA_FILES, csp, metrics, channel=lid)
+    last_blk = store.get_block_by_number(last_num)
+    sp = state.savepoint()
+    meta = {
+        "version": SNAPSHOT_FORMAT_VERSION,
+        "channel_id": lid,
+        "last_block_number": last_num,
+        "last_block_hash": store.last_block_hash.hex(),
+        # informational for external auditors signing/checking the
+        # metadata against the source chain (the reference's signable
+        # metadata carries it too); import does not consume it
+        "previous_block_hash": (
+            last_blk.header.previous_hash.hex() if last_blk is not None
+            else ""
+        ),
+        "state_savepoint": [sp.block_num, sp.tx_num] if sp else None,
+        "index_defs": {
+            ns: sorted(state.indexes_for(ns))
+            for ns in sorted(state.indexed_namespaces())
+        },
+        "files": files,
+    }
+    with open(_metadata_path(work), "wb") as f:
+        f.write(json.dumps(meta, sort_keys=True, indent=2).encode())
+
+    os.makedirs(os.path.dirname(final_dir), exist_ok=True)
+    os.replace(work, final_dir)
+    if metrics is not None:
+        metrics.generation_duration.With("channel", lid).observe(
+            time.perf_counter() - t0
+        )
+    return final_dir
+
+
+# -- verification + import ---------------------------------------------------
+
+
+def verify_snapshot(snapshot_dir: str, csp=None) -> dict:
+    """Recompute every data file's digest (through hash_batch) and check
+    it against the signable metadata; returns the metadata.  Raises
+    SnapshotError on any mismatch or missing file."""
+    meta = load_metadata(snapshot_dir)
+    if meta.get("version") != SNAPSHOT_FORMAT_VERSION:
+        raise SnapshotError(
+            f"unsupported snapshot format version {meta.get('version')!r}"
+        )
+    expected = meta.get("files") or {}
+    # a digest for EVERY data file must be present — otherwise editing
+    # the metadata to drop an entry would exempt that file from the
+    # tamper check entirely
+    missing = [n for n in DATA_FILES if n not in expected]
+    if missing:
+        raise SnapshotError(
+            "snapshot metadata lists no digest for: " + ", ".join(missing)
+        )
+    names = sorted(expected)
+    actual = _hash_files(snapshot_dir, names, csp)
+    bad = [n for n in names if actual[n] != expected[n]]
+    if bad:
+        raise SnapshotError(
+            "snapshot file hash mismatch (tampered or corrupt): "
+            + ", ".join(bad)
+        )
+    return meta
+
+
+def import_snapshot(
+    meta: dict, snapshot_dir: str, store, kv: KVStore, ledger_id: str
+) -> None:
+    """Populate an EMPTY channel's stores from a verified snapshot:
+    block-store bootstrap info + txid index, state DB (public + hashed,
+    savepoint at the snapshot height so recovery replays nothing),
+    config history, and the pvt store's bootstrap marker.  The caller
+    then constructs the KVLedger over the same stores."""
+    last_num = int(meta["last_block_number"])
+    with open(os.path.join(snapshot_dir, CONFIG_BLOCK_FILE), "rb") as f:
+        cfg_raw = f.read()
+    store.bootstrap(
+        last_num, bytes.fromhex(meta["last_block_hash"]), config_block=cfg_raw
+    )
+    store.import_snapshot_txids(
+        k.decode() for k, _ in read_records(
+            os.path.join(snapshot_dir, TXIDS_FILE)
+        )
+    )
+
+    def state_records():
+        yield from read_records(os.path.join(snapshot_dir, PUBLIC_STATE_FILE))
+        yield from read_records(os.path.join(snapshot_dir, PVT_HASHES_FILE))
+
+    sp = meta.get("state_savepoint")
+    savepoint = Height(sp[0], sp[1]) if sp else Height(last_num, 0)
+    state = VersionedDB(kv, f"statedb/{ledger_id}")
+    state.import_records(state_records(), savepoint)
+    for ns, specs in (meta.get("index_defs") or {}).items():
+        for spec in specs:
+            state.define_index(ns, spec)
+    ConfigHistoryMgr(kv, ledger_id).import_entries(
+        read_records(os.path.join(snapshot_dir, CONFIG_HISTORY_FILE))
+    )
+    PvtDataStore(kv, ledger_id).init_bootstrap_height(last_num + 1)
+
+
+# -- manager -----------------------------------------------------------------
+
+
+class SnapshotManager:
+    """Per-ledger snapshot front end: request bookkeeping + commit-time
+    auto-trigger + on-demand generation (reference snapshot_mgmt.go's
+    snapshotMgr, owned by the kvledger)."""
+
+    def __init__(self, ledger, snapshots_root: str | None, kv: KVStore,
+                 csp=None, metrics=None):
+        self._ledger = ledger
+        self._root = snapshots_root
+        self._csp = csp
+        self.metrics = metrics
+        self._requests = SnapshotRequestBookkeeper(
+            BookkeepingProvider(kv).get_kv(ledger.ledger_id, SNAPSHOT_REQUEST)
+        )
+        self._lock = threading.Lock()
+        self._update_gauge()
+
+    # -- requests ----------------------------------------------------------
+
+    def _update_gauge(self) -> None:
+        if self.metrics is not None:
+            self.metrics.pending_requests.With(
+                "channel", self._ledger.ledger_id
+            ).set(len(self._requests.list_pending()))
+
+    def submit_request(self, block_number: int = 0) -> dict:
+        """Request a snapshot at `block_number` (0 = the last committed
+        block, generated immediately).  A request at the last committed
+        block also generates immediately; a future block is recorded and
+        auto-triggers when the ledger commits it (reference
+        SubmitSnapshotRequest semantics).
+
+        Lock order everywhere is ledger.commit_lock -> manager lock (the
+        commit-time trigger enters with commit_lock already held), so an
+        RPC-thread generate can never deadlock against a commit — and
+        the export always sees a fully committed block, never a torn
+        one."""
+        with self._ledger.commit_lock:
+            with self._lock:
+                last = self._ledger.height - 1
+                if block_number == 0:
+                    if last < 0:
+                        raise SnapshotError("ledger has no committed blocks")
+                    block_number = last
+                if block_number < last:
+                    raise SnapshotError(
+                        f"requested block {block_number} is already "
+                        f"committed (last committed block is {last})"
+                    )
+                if block_number == last:
+                    path = self._generate()
+                    return {
+                        "block_number": block_number, "snapshot_dir": path
+                    }
+                self._requests.submit(block_number)
+                self._update_gauge()
+                return {"block_number": block_number, "snapshot_dir": None}
+
+    def cancel_request(self, block_number: int) -> None:
+        with self._lock:
+            self._requests.cancel(block_number)
+            self._update_gauge()
+
+    def list_pending(self) -> list[int]:
+        return self._requests.list_pending()
+
+    # -- generation --------------------------------------------------------
+
+    def on_block_committed(self, block_number: int) -> None:
+        """KVLedger.commit calls this after each block (commit_lock
+        held); a matching pending request triggers generation.  The
+        export runs synchronously on the commit thread — deterministic
+        and torn-read-free, at the cost of stalling that channel's
+        commits for the export duration (the reference generates in a
+        background goroutine; background generation is a ROADMAP item).
+        A generation failure is logged and the request dropped — the
+        commit itself must never fail because a snapshot could not be
+        written (reference logs and continues the same way)."""
+        with self._lock:
+            if not self._requests.has(block_number):
+                return
+            self._requests.cancel(block_number)
+            self._update_gauge()
+            try:
+                self._generate()
+            except Exception as exc:
+                from fabric_tpu.common.flogging import must_get_logger
+
+                must_get_logger("ledger.snapshot").warning(
+                    "snapshot generation at block %d failed for %r: %s",
+                    block_number, self._ledger.ledger_id, exc,
+                )
+
+    def generate(self) -> str:
+        """Generate a snapshot at the current committed height."""
+        with self._ledger.commit_lock:
+            with self._lock:
+                return self._generate()
+
+    def _generate(self) -> str:
+        return generate_snapshot(
+            self._ledger, self._root, csp=self._csp, metrics=self.metrics
+        )
+
+
+__all__ = [
+    "SnapshotError",
+    "SnapshotManager",
+    "SnapshotRequestBookkeeper",
+    "generate_snapshot",
+    "verify_snapshot",
+    "import_snapshot",
+    "load_metadata",
+    "read_records",
+    "write_records",
+    "METADATA_FILE",
+    "PUBLIC_STATE_FILE",
+    "PVT_HASHES_FILE",
+    "TXIDS_FILE",
+    "CONFIG_HISTORY_FILE",
+    "CONFIG_BLOCK_FILE",
+    "DATA_FILES",
+    "SNAPSHOT_FORMAT_VERSION",
+]

@@ -256,6 +256,11 @@ class VersionedDB:
     def indexes_for(self, ns: str) -> set[str]:
         return self._load_indexes().get(ns, set())
 
+    def indexed_namespaces(self) -> set[str]:
+        """Namespaces that have at least one index definition (snapshot
+        export records the definitions so import can re-backfill)."""
+        return set(self._load_indexes())
+
     def define_index(self, ns: str, field) -> None:
         """Create (and backfill) an index on a dotted JSON field — or,
         given a list/tuple of fields, a COMPOUND index over them (the
@@ -423,6 +428,56 @@ class VersionedDB:
     def savepoint(self) -> Height | None:
         raw = self._db.get(_SAVEPOINT_KEY)
         return None if raw is None else Height.unpack(raw)
+
+    # -- snapshot export / import ------------------------------------------
+
+    def export_records(self):
+        """Every state entry as a raw (key, value) pair in key order —
+        the deterministic stream channel snapshots are built from.  Keys
+        keep the full internal `\\x02 ns \\x00 key` encoding so import
+        re-writes them verbatim (no decode/re-encode drift); index
+        entries, definitions, and housekeeping keys are excluded."""
+        return self._db.iterate(b"\x02", b"\x03")
+
+    @staticmethod
+    def split_state_key(raw_key: bytes) -> tuple[str, str]:
+        """(ns, key) of a raw entry key from export_records.  Derived
+        private/hashed namespaces embed \\x00 separators
+        ('cc\\x00hash\\x00coll' — see txmgmt.hash_ns/pvt_ns), so that
+        fixed shape is recognized before the plain ns/key split."""
+        s = raw_key[1:]
+        parts = s.split(b"\x00")
+        if len(parts) >= 4 and parts[1] in (b"pvt", b"hash"):
+            ns, key = b"\x00".join(parts[:3]), b"\x00".join(parts[3:])
+        else:
+            ns, _, key = s.partition(b"\x00")
+        return ns.decode(), key.decode()
+
+    def import_records(self, records, savepoint: Height,
+                       batch_size: int = 10000) -> int:
+        """Bulk-load raw state records (a snapshot's export stream) into
+        an EMPTY state DB and set the savepoint, recomputing the
+        metadata-presence namespace set on the way through (so the
+        key-level-endorsement fast path stays exact on a restored
+        ledger).  Returns the record count."""
+        if self._db.get(_SAVEPOINT_KEY) is not None:
+            raise ValueError("cannot import a snapshot into a non-empty state DB")
+        meta_ns: set[str] = set()
+        puts: dict[bytes, bytes] = {}
+        count = 0
+        for k, v in records:
+            puts[k] = v
+            count += 1
+            if _decode_value(v).metadata:
+                meta_ns.add(self.split_state_key(k)[0])
+            if len(puts) >= batch_size:
+                self._db.write_batch(puts, [])
+                puts = {}
+        puts[_META_NS_KEY] = json.dumps(sorted(meta_ns)).encode()
+        puts[_SAVEPOINT_KEY] = savepoint.pack()
+        self._db.write_batch(puts, [])
+        self._meta_ns = None
+        return count
 
 
 __all__ = [

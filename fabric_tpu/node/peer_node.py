@@ -56,17 +56,29 @@ from fabric_tpu.protos.peer import proposal_pb2
 class _Channel:
     """Per-channel resources (reference core/peer/peer.go channel map)."""
 
-    def __init__(self, node: "PeerNode", genesis: common_pb2.Block):
+    def __init__(
+        self,
+        node: "PeerNode",
+        genesis: common_pb2.Block,
+        ledger=None,
+    ):
         self._node = node
         self.bundle = bundle_from_genesis(genesis, node.csp)
         self.channel_id = self.bundle.channel_id
+        # the channel's config block: chain block 0 normally, or the
+        # snapshot-carried config for a join-by-snapshot channel (whose
+        # chain has no block 0); cscc GetConfigBlock serves this
+        self.config_block = genesis
         # per-channel ACL catalog (defaults + the channel config's ACLs
         # overrides), consulted by the endorser, deliver, and discovery
         # entries (reference core/aclmgmt resourceprovider)
         self.acl = ACLProvider(self.bundle.acls, csp=node.csp)
         # create() is idempotent: it opens an existing ledger and only
-        # commits the genesis block when the chain is empty
-        self.ledger = node.provider.create(genesis)
+        # commits the genesis block when the chain is empty; a
+        # snapshot-bootstrapped ledger arrives pre-built
+        self.ledger = (
+            ledger if ledger is not None else node.provider.create(genesis)
+        )
         self.definitions = DefinitionProvider(self.ledger)
         self.validator = TxValidator(
             self.channel_id, self.ledger, self.bundle, node.csp,
@@ -230,7 +242,29 @@ class PeerNode:
         self.gossip_comm = None
         self._gossip_runner = None
         self._gossip_opts: dict = {}
-        self.provider = LedgerProvider(root_dir)
+        # operations endpoint: /metrics /healthz /version /logspec
+        # (reference core/operations wired in start.go serve()); created
+        # BEFORE the ledger provider so snapshot metrics land on its
+        # prometheus registry
+        self.operations = None
+        if operations_port is not None:
+            from fabric_tpu.common.operations import System
+
+            self.operations = System(("127.0.0.1", operations_port))
+            self.operations.register_checker(
+                "ledgers",
+                lambda: None if all(
+                    ch.ledger.height > 0 for ch in self.channels.values()
+                ) else "empty ledger",
+            )
+        self.provider = LedgerProvider(
+            root_dir,
+            csp=csp,
+            metrics=(
+                self.operations.snapshot_metrics()
+                if self.operations is not None else None
+            ),
+        )
         self.orderer_endpoints = orderer_endpoints or []
         self.channels: dict[str, _Channel] = {}
         self._lock = threading.Lock()
@@ -294,22 +328,14 @@ class PeerNode:
                     continue
                 ledger = self.provider.open(entry)
                 genesis = ledger.get_block_by_number(0)
+                if genesis is None:
+                    # snapshot-bootstrapped channel: no chain block 0 —
+                    # its config block rides the block store's index
+                    raw = ledger.block_store.config_block_bytes()
+                    if raw:
+                        genesis = common_pb2.Block.FromString(raw)
                 if genesis is not None:
                     self.join_channel(genesis)
-
-        # operations endpoint: /metrics /healthz /version /logspec
-        # (reference core/operations wired in start.go serve())
-        self.operations = None
-        if operations_port is not None:
-            from fabric_tpu.common.operations import System
-
-            self.operations = System(("127.0.0.1", operations_port))
-            self.operations.register_checker(
-                "ledgers",
-                lambda: None if all(
-                    ch.ledger.height > 0 for ch in self.channels.values()
-                ) else "empty ledger",
-            )
 
         self.rpc = RPCServer(host, port, tls=tls, keepalive=keepalive)
         # per-service concurrency limiters (reference
@@ -330,6 +356,12 @@ class PeerNode:
         self.rpc.register("admin.JoinChannel", self._admin_join)
         self.rpc.register("admin.Channels", self._admin_channels)
         self.rpc.register("admin.Height", self._admin_height)
+        # channel-snapshot surface (reference internal/peer/snapshot
+        # CLI over the snapshot gRPC service)
+        self.rpc.register("admin.SnapshotSubmit", self._admin_snapshot_submit)
+        self.rpc.register("admin.SnapshotCancel", self._admin_snapshot_cancel)
+        self.rpc.register("admin.SnapshotList", self._admin_snapshot_list)
+        self.rpc.register("admin.JoinBySnapshot", self._admin_join_by_snapshot)
 
     # -- chaincode wiring --------------------------------------------------
 
@@ -367,6 +399,28 @@ class PeerNode:
             ch.notifier = self.deliver.notifier
             return ch.channel_id
 
+    def join_by_snapshot(self, snapshot_dir: str) -> str:
+        """Join a channel from a verified snapshot directory (reference
+        peer channel joinbysnapshot -> peer.JoinChannelBySnapshot): the
+        ledger bootstraps blockless at the snapshot height, the channel
+        bundle comes from the snapshot's config block, and the deliver
+        client starts catch-up at ledger.height — i.e. right after the
+        snapshot.  The whole create-and-join runs under the node lock
+        (like join_channel) so two concurrent joins of the same channel
+        cannot interleave their imports into the shared stores."""
+        with self._lock:
+            ledger = self.provider.create_from_snapshot(snapshot_dir)
+            raw = ledger.block_store.config_block_bytes()
+            if not raw:
+                raise ValueError(
+                    f"snapshot at {snapshot_dir!r} carries no channel config"
+                )
+            config_block = common_pb2.Block.FromString(raw)
+            ch = _Channel(self, config_block, ledger=ledger)
+            self.channels[ch.channel_id] = ch
+            ch.notifier = self.deliver.notifier
+            return ch.channel_id
+
     def channel_list(self) -> list[str]:
         return sorted(self.channels)
 
@@ -375,8 +429,10 @@ class PeerNode:
         return ch.ledger if ch else None
 
     def _config_block(self, channel_id: str):
+        # the per-channel config block attr covers snapshot-bootstrapped
+        # channels too, whose chain has no block 0
         ch = self.channels.get(channel_id)
-        return ch.store.get_block_by_number(0) if ch else None
+        return ch.config_block if ch else None
 
     def _app_orgs(self) -> list[str]:
         for ch in self.channels.values():
@@ -490,6 +546,46 @@ class PeerNode:
     def _admin_height(self, body: bytes, stream) -> bytes:
         ch = self.channels.get(body.decode("utf-8"))
         return str(ch.ledger.height if ch else 0).encode()
+
+    # -- snapshot admin (reference internal/peer/snapshot client) ----------
+
+    def _snapshot_mgr(self, channel_id: str):
+        ch = self.channels.get(channel_id)
+        if ch is None:
+            raise KeyError(f"channel {channel_id!r} not joined")
+        if ch.ledger.snapshots is None:
+            raise ValueError(
+                f"channel {channel_id!r} has no snapshot support"
+            )
+        return ch.ledger.snapshots
+
+    def _admin_snapshot_submit(self, body: bytes, stream) -> bytes:
+        import json
+
+        req = json.loads(body.decode("utf-8"))
+        res = self._snapshot_mgr(req["channel"]).submit_request(
+            int(req.get("block_number", 0))
+        )
+        return json.dumps(res).encode()
+
+    def _admin_snapshot_cancel(self, body: bytes, stream) -> bytes:
+        import json
+
+        req = json.loads(body.decode("utf-8"))
+        self._snapshot_mgr(req["channel"]).cancel_request(
+            int(req["block_number"])
+        )
+        return b"ok"
+
+    def _admin_snapshot_list(self, body: bytes, stream) -> bytes:
+        import json
+
+        return json.dumps(
+            self._snapshot_mgr(body.decode("utf-8")).list_pending()
+        ).encode()
+
+    def _admin_join_by_snapshot(self, body: bytes, stream) -> bytes:
+        return self.join_by_snapshot(body.decode("utf-8")).encode("utf-8")
 
     def _discovery(self, body: bytes, stream) -> bytes:
         from fabric_tpu.discovery import PeerInfo

@@ -1,0 +1,131 @@
+"""Channel-snapshot workload harness: export MB/s (CSP hash_batch vs a
+per-file hashlib loop) and restore wall time, BENCH-style JSON lines so
+future PRs can track the snapshot workload next to the validate/commit
+benches.
+
+    python scripts/bench_snapshot.py [--blocks 200] [--txs 20] \
+        [--keys 4] [--value-size 256] [--provider sw|tpu]
+
+Builds a disk-backed channel (no endorsement/crypto — this measures the
+export/restore storage + hashing path, like bench_ledger), generates a
+snapshot, restores it into a fresh provider, and prints one JSON line
+per experiment.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(
+    0,
+    os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "scripts"
+    ),
+)
+
+from bench_ledger import _block_of  # noqa: E402
+
+
+def _build_chain(n_blocks: int, n_txs: int, n_keys: int, vsize: int):
+    # provider.open (no genesis/org setup): this harness measures the
+    # export/restore storage + hashing path, not config crypto
+    from fabric_tpu.ledger import LedgerProvider
+
+    root = tempfile.mkdtemp(prefix="bench-snapshot-src-")
+    ledger = LedgerProvider(root).open("benchledger")
+    height = ledger.height
+    for b in range(n_blocks):
+        writes = [
+            (f"snap-tx{b}-{i}", f"key{(b * n_txs + i) % (n_blocks * n_txs // 2 or 1)}")
+            for i in range(n_txs)
+        ]
+        blk = _block_of(ledger, height, writes, n_keys, vsize, read=False)
+        ledger.commit(blk)
+        height += 1
+    return ledger
+
+
+def _snapshot_size(path: str) -> int:
+    return sum(
+        os.path.getsize(os.path.join(path, f)) for f in os.listdir(path)
+    )
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--blocks", type=int, default=200)
+    ap.add_argument("--txs", type=int, default=20)
+    ap.add_argument("--keys", type=int, default=4)
+    ap.add_argument("--value-size", type=int, default=256)
+    ap.add_argument("--provider", default="sw", choices=["sw", "tpu"],
+                    help="CSP provider for hash_batch during export")
+    args = ap.parse_args()
+
+    ledger = _build_chain(args.blocks, args.txs, args.keys, args.value_size)
+    from fabric_tpu.ledger.snapshot import generate_snapshot
+
+    try:
+        from fabric_tpu.csp.factory import init_factories
+
+        csp = init_factories(args.provider, force=True)
+    except ImportError:
+        csp = None  # no crypto stack on this host: hashlib fallback
+    snap_root = tempfile.mkdtemp(prefix="bench-snapshot-")
+
+    # -- export with the CSP hash_batch path -------------------------------
+    t0 = time.perf_counter()
+    path = generate_snapshot(ledger, snap_root, csp=csp)
+    export_s = time.perf_counter() - t0
+    size = _snapshot_size(path)
+    print(json.dumps({
+        "experiment": "export_hash_batch",
+        "provider": args.provider if csp is not None else "hashlib-fallback",
+        "blocks": args.blocks,
+        "txs_per_block": args.txs,
+        "snapshot_bytes": size,
+        "seconds": round(export_s, 4),
+        "mb_per_s": round(size / export_s / 1e6, 2),
+    }))
+
+    # -- per-file hashlib baseline (what a non-batched exporter would do) --
+    names = sorted(
+        f for f in os.listdir(path) if not f.startswith("_snapshot")
+    )
+    t0 = time.perf_counter()
+    for name in names:
+        with open(os.path.join(path, name), "rb") as f:
+            hashlib.sha256(f.read()).hexdigest()
+    hashlib_s = time.perf_counter() - t0
+    hashed = sum(os.path.getsize(os.path.join(path, n)) for n in names)
+    print(json.dumps({
+        "experiment": "hash_files_hashlib",
+        "bytes": hashed,
+        "seconds": round(hashlib_s, 4),
+        "mb_per_s": round(hashed / hashlib_s / 1e6, 2) if hashlib_s else None,
+    }))
+
+    # -- restore ------------------------------------------------------------
+    from fabric_tpu.ledger import LedgerProvider
+
+    dst_root = tempfile.mkdtemp(prefix="bench-snapshot-dst-")
+    t0 = time.perf_counter()
+    restored = LedgerProvider(dst_root, csp=csp).create_from_snapshot(path)
+    restore_s = time.perf_counter() - t0
+    print(json.dumps({
+        "experiment": "restore",
+        "height": restored.height,
+        "snapshot_bytes": size,
+        "seconds": round(restore_s, 4),
+        "mb_per_s": round(size / restore_s / 1e6, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()
