@@ -356,6 +356,45 @@ class SnapshotMetrics:
         ))
 
 
+class CommitMetrics:
+    """Per-stage ledger-commit pipeline timing (the group-commit
+    tentpole's instrumentation): one histogram labeled (channel, stage)
+    over the stages mvcc / block_append / pvt / state / history (per
+    block) and fsync / kv_txn (per group boundary), plus how many
+    blocks each fsync+txn boundary made durable — the breakdown the
+    next optimisation round reads off /metrics and bench.py's JSON
+    line."""
+
+    STAGES = (
+        "mvcc", "block_append", "pvt", "state", "history",
+        "fsync", "kv_txn",
+    )
+
+    def __init__(self, provider):
+        self.stage_duration = provider.new_histogram(HistogramOpts(
+            namespace="ledger",
+            subsystem="commit",
+            name="stage_duration",
+            help="Seconds spent in one commit-pipeline stage for one "
+                 "block (mvcc/block_append/pvt/state/history) or one "
+                 "group boundary (fsync/kv_txn).",
+            buckets=(
+                0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                0.1, 0.25, 0.5, 1.0, 2.5,
+            ),
+            statsd_format="%{channel}.%{stage}",
+        ))
+        self.blocks_per_sync = provider.new_histogram(HistogramOpts(
+            namespace="ledger",
+            subsystem="commit",
+            name="blocks_per_sync",
+            help="Blocks made durable by one group-commit fsync+txn "
+                 "boundary (1 = no coalescing).",
+            buckets=(1, 2, 3, 4, 6, 8, 12, 16, 24, 32),
+            statsd_format="%{channel}",
+        ))
+
+
 __all__ = [
     "CounterOpts",
     "GaugeOpts",
@@ -368,4 +407,5 @@ __all__ = [
     "StatsdProvider",
     "DisabledProvider",
     "SnapshotMetrics",
+    "CommitMetrics",
 ]

@@ -34,6 +34,7 @@ class System:
         self.version = version
         self._checkers: dict[str, object] = {}
         self._snapshot_metrics = None
+        self._commit_metrics = None
         self._lock = threading.Lock()
         if provider == "prometheus":
             self.metrics_provider = PrometheusProvider()
@@ -131,6 +132,17 @@ class System:
                     self.metrics_provider
                 )
             return self._snapshot_metrics
+
+    def commit_metrics(self):
+        """Lazily-built ledger-commit stage metrics bound to this
+        system's provider — the per-stage mvcc/append/pvt/state/history/
+        fsync breakdown on the /metrics endpoint."""
+        with self._lock:
+            if self._commit_metrics is None:
+                from fabric_tpu.common.metrics import CommitMetrics
+
+                self._commit_metrics = CommitMetrics(self.metrics_provider)
+            return self._commit_metrics
 
     # -- health ------------------------------------------------------------
 

@@ -50,6 +50,14 @@ class CustodyError(Exception):
     pass
 
 
+# Structured sentinel the daemon prefixes to its unknown-SKI answer.
+# The peer's local-keystore fallback keys off THIS machine token, not
+# the human prose after it — a rewording of the daemon's message (or a
+# transport error that happens to mention keys) can no longer be
+# confused with "the daemon does not hold this SKI".
+ERR_UNKNOWN_SKI = "CUSTODY_ERR_UNKNOWN_SKI"
+
+
 class CustodyKeyHandle(Key):
     """The peer-visible face of a custody-held private key: SKI plus
     the public half.  sign() must go through the owning CustodyCSP —
@@ -139,7 +147,13 @@ class KeyCustodyServer:
             raise CustodyError("custody: want ski(32) || digest(32)")
         ski, digest = rest[:32], rest[32:]
         with self._lock:
-            key = self._sw.get_key(ski)
+            try:
+                key = self._sw.get_key(ski)
+            except KeyError:
+                raise CustodyError(
+                    f"{ERR_UNKNOWN_SKI}: daemon holds no key for "
+                    f"SKI {ski.hex()}"
+                ) from None
         if not isinstance(key, ECDSAP256PrivateKey):
             raise CustodyError("custody: no private key for ski")
         return self._sw.sign(key, digest)
@@ -149,7 +163,13 @@ class KeyCustodyServer:
         if len(rest) != 32:
             raise CustodyError("custody: want ski(32)")
         with self._lock:
-            key = self._sw.get_key(rest)
+            try:
+                key = self._sw.get_key(rest)
+            except KeyError:
+                raise CustodyError(
+                    f"{ERR_UNKNOWN_SKI}: daemon holds no key for "
+                    f"SKI {rest.hex()}"
+                ) from None
         return key.public_key().raw() if key.is_private else key.raw()
 
 
@@ -218,16 +238,19 @@ class CustodyCSP(CSP):
         # SIGNABLE handle even when its public half was also imported
         # locally (e.g. an MSP deriving the SKI from a certificate) —
         # the local keystore serves only SKIs the daemon doesn't hold.
-        # Only the daemon's unknown-SKI answer falls through; transport
-        # failures and malformed replies PROPAGATE (a daemon outage
-        # must not silently demote a signable key to a public one).
+        # Only the daemon's STRUCTURED unknown-SKI answer (the
+        # ERR_UNKNOWN_SKI sentinel it prefixes) falls through;
+        # transport failures and malformed replies PROPAGATE (a daemon
+        # outage must not silently demote a signable key to a public
+        # one, and no rewording of the daemon's prose can masquerade
+        # as unknown-SKI).
         from fabric_tpu.comm.rpc import RPCError
 
         try:
             pub = self._parse_pub(self._call("custody.GetKey", ski))
             key: Key = CustodyKeyHandle(ski, pub)
         except RPCError as exc:
-            if "no key for SKI" not in str(exc):
+            if not str(exc).startswith(ERR_UNKNOWN_SKI):
                 raise
             key = self._local.get_key(ski)  # KeyError if absent
         with self._lock:
@@ -287,5 +310,6 @@ __all__ = [
     "CustodyCSP",
     "CustodyKeyHandle",
     "CustodyError",
+    "ERR_UNKNOWN_SKI",
     "load_token",
 ]

@@ -235,20 +235,23 @@ class _FlushResult:
             target=self._wait_device, name="tpu-flush-waiter", daemon=True
         ).start()
 
-    def _seal(self, mask: list | None, exc: Exception | None = None) -> None:
+    def _seal(self, mask: list | None, exc: Exception | None = None) -> bool:
         """First writer wins; every consumer wakes.  Drops the input
         references (device collectors, item lists) either way — a flush
         coalesces thousands of VerifyBatchItems and the late loser of a
         host/device race must not pin them (nor device output buffers)
-        for the rest of the result's lifetime."""
+        for the rest of the result's lifetime.  Returns True when THIS
+        writer won (its mask/exc is the flush's result)."""
         with self._seal_lock:
-            if self._mask is None and self._exc is None:
+            won = self._mask is None and self._exc is None
+            if won:
                 self._mask = mask
                 self._exc = exc
         self._pending = ()
         self._host_items = ()
         self._device_items = None
         self._done.set()
+        return won
 
     def _wait_device(self) -> None:
         """Materialize the device result (waiter thread or any direct
@@ -287,21 +290,26 @@ class _FlushResult:
                         e = e2
                 self._seal(None, e)
                 return
+            won = self._seal(out)
             if (
-                self._on_device_wall is not None
+                won
+                and self._on_device_wall is not None
                 and self._n_device_lanes
                 and not host_items
             ):
                 # feed the provider's flush-wall EWMA — only from walls
                 # the device actually produced (a host-race win says
-                # nothing about chip speed), and only for pure-device
-                # flushes: with a host tail the wall includes the
+                # nothing about chip speed), only for pure-device
+                # flushes (with a host tail the wall includes the
                 # tail's serial verify and would inflate the per-lane
-                # estimate toward the anchor cap
+                # estimate toward the anchor cap), and only when THIS
+                # device result sealed the flush: losing the seal means
+                # the host race already answered because the device
+                # stalled past its deadline, and feeding that stalled
+                # wall would drag the EWMA toward worst-case walls
                 self._on_device_wall(
                     self._n_device_lanes, time.perf_counter() - self._t0
                 )
-            self._seal(out)
 
     def _host_verify(self, items):
         """Host verification preferring the native libcrypto batch

@@ -376,11 +376,15 @@ class TCPGossipComm(GossipComm):
             sender_pki = ce.pki_id
             # responses dial back to the sender's SIGNED listen endpoint
             # (connections are one-directional; the reference replies
-            # over its bidirectional stream instead)
-            if ce.endpoint:
+            # over its bidirectional stream instead).  The claim is
+            # BOUNDED to the connection's source host — an arbitrary
+            # third-party endpoint would turn every response (state
+            # batches especially) into reflected traffic at an
+            # attacker-chosen target.
+            if ce.endpoint and self._dialback_allowed(ce.endpoint, conn):
                 respond = lambda m, _ep=ce.endpoint: self.send(_ep, m)
             else:
-                respond = lambda m: None  # legacy handshake: no reply path
+                respond = lambda m: None  # no (trustworthy) reply path
             while not self._stop.is_set():
                 frame = self._read_frame(conn, buf)
                 if frame is None:
@@ -397,6 +401,31 @@ class TCPGossipComm(GossipComm):
                 conn.close()
             except OSError:
                 pass
+
+    @staticmethod
+    def _dialback_allowed(endpoint: str, conn) -> bool:
+        """True when the self-claimed listen endpoint's host is the
+        connection's own source address (any port — NAT'd peers listen
+        on ports we can't predict, but not on hosts they don't hold).
+        DNS names are refused outright: resolving an attacker-supplied
+        name at respond time would itself be a traffic primitive.
+        Loopback literals of either family are interchangeable."""
+        host = endpoint.rsplit(":", 1)[0].strip("[]")
+        try:
+            src = conn.getpeername()[0]
+        except OSError:
+            return False
+        if host == src:
+            return True
+        import ipaddress
+
+        try:
+            return (
+                ipaddress.ip_address(host).is_loopback
+                and ipaddress.ip_address(src).is_loopback
+            )
+        except ValueError:
+            return False  # not an IP literal: fail closed
 
     def close(self) -> None:
         self._stop.set()

@@ -10,13 +10,19 @@ from fabric_tpu.ledger.kvstore import (
     MemKVStore,
     NamedDB,
     SqliteKVStore,
+    WriteBatchCollector,
     open_kvstore,
 )
 from fabric_tpu.ledger.statedb import Height, VersionedDB, VersionedValue
 from fabric_tpu.ledger.blkstorage import BlockStore, BlockStoreError
 from fabric_tpu.ledger.history import HistoryDB
 from fabric_tpu.ledger.txmgmt import MVCCValidator, TxSimulator
-from fabric_tpu.ledger.kvledger import KVLedger, LedgerProvider, extract_rwsets
+from fabric_tpu.ledger.kvledger import (
+    CommitGroup,
+    KVLedger,
+    LedgerProvider,
+    extract_rwsets,
+)
 from fabric_tpu.ledger.snapshot import (
     SnapshotError,
     SnapshotManager,
@@ -33,7 +39,9 @@ __all__ = [
     "MemKVStore",
     "SqliteKVStore",
     "NamedDB",
+    "WriteBatchCollector",
     "open_kvstore",
+    "CommitGroup",
     "Height",
     "VersionedDB",
     "VersionedValue",

@@ -67,9 +67,11 @@ class BlockStore:
     def _file_path(self, idx: int) -> str:
         return os.path.join(self._dir, f"blocks_{idx:06d}.dat")
 
-    def _checkpoint(self) -> tuple[int, int, int]:
-        """(file_idx, offset_after_last_indexed, height)"""
-        raw = self._index.get(b"cp")
+    def _checkpoint(self, index=None) -> tuple[int, int, int]:
+        """(file_idx, offset_after_last_indexed, height); `index` may be
+        an overlay view so grouped commits see their own buffered
+        checkpoint advance."""
+        raw = (index or self._index).get(b"cp")
         if raw is None:
             return (0, 0, 0)
         return struct.unpack(">QQQ", raw)  # type: ignore[return-value]
@@ -82,10 +84,18 @@ class BlockStore:
                 self._last_hash = raw[8:]
 
     def _recover(self) -> None:
-        """Re-index any blocks appended after the last checkpoint; truncate
-        a torn trailing record (reference blockfile_helper scanForLastCompleteBlock)."""
+        """Re-index any blocks appended after the last checkpoint;
+        truncate from the first damaged record on (reference
+        blockfile_helper scanForLastCompleteBlock).  Group commits
+        append several records between fsyncs, so a crash can tear a
+        NON-tail record (writeback order is not guaranteed): any record
+        that fails to parse, or whose number breaks the contiguous
+        chain (a hole's garbage can "parse" — e.g. zeroed pages decode
+        to an empty block 0), ends the replayable prefix — everything
+        from there on was never acknowledged durable and is dropped."""
         file_idx, offset, height = self._checkpoint()
         self._height = height
+        scanned: set[int] = set()
         while True:
             path = self._file_path(file_idx)
             if not os.path.exists(path):
@@ -99,21 +109,34 @@ class BlockStore:
                         break
                     (n,) = _LEN.unpack(hdr)
                     raw = f.read(n)
-                    if len(raw) < n:
+                    if n == 0 or len(raw) < n:
                         break
-                    blk = common_pb2.Block.FromString(raw)
+                    try:
+                        blk = common_pb2.Block.FromString(raw)
+                    except Exception:
+                        break  # torn mid-file record: prefix ends here
+                    if blk.header.number != self._height:
+                        break  # non-contiguous: damaged or stale bytes
                     self._index_block(blk, file_idx, offset)
                     offset += _LEN.size + n
                     self._height = blk.header.number + 1
+                    scanned.add(file_idx)
             if offset < size:
                 with open(path, "r+b") as f:
                     f.truncate(offset)
+                scanned.add(file_idx)
             next_path = self._file_path(file_idx + 1)
             if os.path.exists(next_path):
                 file_idx += 1
                 offset = 0
             else:
                 break
+        # re-indexed records may never have been fsynced (group-commit
+        # appends sync at flush boundaries only): make the scanned data
+        # durable BEFORE the checkpoint/index below reference it, or a
+        # second crash could leave a committed checkpoint pointing past
+        # what the file actually holds
+        self.sync_files(scanned)
         if self._height > 0:
             last = self.get_block_by_number(self._height - 1)
             if last is not None:
@@ -147,6 +170,7 @@ class BlockStore:
         offset: int,
         txids: list | None = None,
         checkpoint: tuple[int, int] | None = None,
+        index=None,
     ) -> None:
         """`txids` may carry the validator's per-position txids so the
         healthy path parses no envelopes; positions it has no txid for
@@ -177,8 +201,9 @@ class BlockStore:
                 tx_puts.setdefault(
                     b"t" + txid.encode(), loc + struct.pack(">Q", pos)
                 )
-        self._index.write_batch_if_absent(tx_puts)
-        self._index.write_batch(puts)
+        index = index or self._index
+        index.write_batch_if_absent(tx_puts)
+        index.write_batch(puts)
 
     # -- public API --------------------------------------------------------
 
@@ -259,26 +284,40 @@ class BlockStore:
         blk: common_pb2.Block,
         txids: list | None = None,
         env_bytes: list | None = None,
-    ) -> None:
-        """Append + index.  `txids`/`env_bytes` are optional commit-path
-        assists from the validator (see CommitAssist): known txids skip
-        the per-envelope parse in the index, and the envelope bytes let
-        serialization splice instead of re-encode."""
+        into=None,
+        sync: bool = True,
+    ) -> int | None:
+        """Append + index; returns the block-file index written (None
+        for in-memory stores).  `txids`/`env_bytes` are optional
+        commit-path assists from the validator (see CommitAssist):
+        known txids skip the per-envelope parse in the index, and the
+        envelope bytes let serialization splice instead of re-encode.
+
+        Group-commit seams: `into` (a WriteBatchCollector over the
+        index's backing store) buffers the index + checkpoint writes
+        into the block's shared KV transaction, and `sync=False` skips
+        the per-block fsync — the caller then makes the appended data
+        durable with one sync_files() call at the group boundary,
+        BEFORE flushing the collector (block file first, then the
+        all-or-nothing KV txn, the same crash-recovery invariant as
+        per-block commits)."""
         with self._lock:
             if blk.header.number != self._height:
                 raise BlockStoreError(
                     f"block number {blk.header.number} != expected {self._height}"
                 )
+            index = self._index if into is None else self._index.rebase(into)
             raw = protoutil.serialize_block(blk, env_bytes)
             if self._mem_blocks is not None:
                 self._mem_blocks.append(raw)
                 self._height += 1
                 self._index_block(
                     blk, 0, len(self._mem_blocks) - 1, txids,
-                    checkpoint=(0, len(self._mem_blocks)),
+                    checkpoint=(0, len(self._mem_blocks)), index=index,
                 )
+                file_idx = None
             else:
-                file_idx, offset, _ = self._checkpoint()
+                file_idx, offset, _ = self._checkpoint(index)
                 if offset > ROLL_SIZE:
                     file_idx += 1
                     offset = 0
@@ -289,13 +328,61 @@ class BlockStore:
                     f.write(_LEN.pack(len(raw)))
                     f.write(raw)
                     f.flush()
-                    os.fsync(f.fileno())
+                    if sync:
+                        os.fsync(f.fileno())
                 self._height += 1
                 self._index_block(
                     blk, file_idx, offset, txids,
                     checkpoint=(file_idx, offset + _LEN.size + len(raw)),
+                    index=index,
                 )
             self._last_hash = protoutil.block_header_hash(blk.header)
+            return file_idx
+
+    def truncate_to_checkpoint(self) -> None:
+        """Undo appended-but-unindexed records: drop file data past the
+        last COMMITTED checkpoint and restore in-memory height/hash from
+        committed state.  The group-commit failure rollback — a flush
+        that could not land its KV transaction must not leave the live
+        store advertising heights whose blocks have no index (a crash at
+        the same point is handled by _recover's tail scan instead, which
+        REPLAYS the surviving records; here the buffered index data is
+        already lost, so the appends are rolled back with it)."""
+        with self._lock:
+            file_idx, offset, height = self._checkpoint()
+            if self._mem_blocks is not None:
+                del self._mem_blocks[offset:]
+            else:
+                i = file_idx + 1
+                while os.path.exists(self._file_path(i)):
+                    os.remove(self._file_path(i))
+                    i += 1
+                path = self._file_path(file_idx)
+                if os.path.exists(path):
+                    with open(path, "r+b") as f:
+                        f.truncate(offset)
+            self._height = height
+            self._last_hash = b""
+            if height > 0:
+                last = self.get_block_by_number(height - 1)
+                if last is not None:
+                    self._last_hash = protoutil.block_header_hash(last.header)
+                else:
+                    raw = self._index.get(_BSI_KEY)
+                    self._last_hash = raw[8:] if raw is not None else b""
+
+    def sync_files(self, file_idxs) -> None:
+        """fsync the given block files — the group-commit boundary call
+        that makes every append since the last sync durable in one
+        device flush per touched file (usually exactly one)."""
+        if self._mem_blocks is not None:
+            return
+        for idx in sorted(file_idxs):
+            fd = os.open(self._file_path(idx), os.O_RDONLY)
+            try:
+                os.fsync(fd)
+            finally:
+                os.close(fd)
 
     def get_block_by_number(self, num: int) -> common_pb2.Block | None:
         if num >= self._height:

@@ -21,13 +21,21 @@ class HistoryDB:
     def __init__(self, store: KVStore, name: str = "historydb"):
         self._db = NamedDB(store, name)
 
-    def commit(self, block_num: int, writes_per_tx: list[list[tuple[str, str]]]) -> None:
-        """writes_per_tx[tx_num] = [(ns, key), ...] for valid txs."""
+    def commit(
+        self,
+        block_num: int,
+        writes_per_tx: list[list[tuple[str, str]]],
+        into=None,
+    ) -> None:
+        """writes_per_tx[tx_num] = [(ns, key), ...] for valid txs.
+        `into` (a WriteBatchCollector over this DB's backing store)
+        buffers the writes into the block's shared KV transaction."""
+        db = self._db if into is None else self._db.rebase(into)
         puts = {_SAVEPOINT_KEY: struct.pack(">Q", block_num)}
         for tx_num, writes in enumerate(writes_per_tx):
             for ns, key in writes:
                 puts[_hkey(ns, key, block_num, tx_num)] = b""
-        self._db.write_batch(puts)
+        db.write_batch(puts)
 
     def savepoint(self) -> int | None:
         raw = self._db.get(_SAVEPOINT_KEY)

@@ -10,10 +10,16 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 
-from fabric_tpu.ledger.blkstorage import BlockStore
+from fabric_tpu.ledger.blkstorage import BlockStore, BlockStoreError
 from fabric_tpu.ledger.history import HistoryDB
-from fabric_tpu.ledger.kvstore import KVStore, MemKVStore, open_kvstore
+from fabric_tpu.ledger.kvstore import (
+    KVStore,
+    MemKVStore,
+    WriteBatchCollector,
+    open_kvstore,
+)
 from fabric_tpu.ledger.statedb import Height, VersionedDB
 from fabric_tpu.ledger.txmgmt import (
     MVCCValidator,
@@ -47,6 +53,28 @@ class CommitAssist:
     footprints: list  # per-tx RwsetFootprint | None
     txids: list  # per-tx txid str | None
     env_bytes: list | None = None  # the block's envelope byte strings
+
+
+@dataclasses.dataclass
+class CommitGroup:
+    """In-flight group-commit state: a WriteBatchCollector buffering
+    every KV mutation (block index + pvt + state + history + savepoints)
+    destined for ONE atomic base transaction, an overlay-aware state
+    view so MVCC of block k+1 sees block k's buffered writes, and the
+    bookkeeping the flush boundary needs (which block files to fsync,
+    which committed heights to hand the snapshot auto-trigger).  Created
+    by KVLedger.begin_commit_group, reusable across flushes."""
+
+    collector: WriteBatchCollector
+    state: VersionedDB  # rebased view over the collector
+    mvcc: MVCCValidator
+    blocks: int = 0
+    dirty_files: set = dataclasses.field(default_factory=set)
+    snap_notify: list = dataclasses.field(default_factory=list)
+    # set when a buffered block has a pending snapshot request: the
+    # streaming committer flushes at this block so the export height is
+    # exactly the requested height (deterministic across peers)
+    boundary_hint: bool = False
 
 
 def extract_rwsets(block: common_pb2.Block) -> list[bytes | None]:
@@ -110,11 +138,13 @@ class KVLedger:
         block_store: BlockStore,
         kv: KVStore,
         btl_policy=None,
+        metrics=None,
     ):
         from fabric_tpu.ledger.confighistory import ConfigHistoryMgr
         from fabric_tpu.ledger.pvtdatastorage import PvtDataStore
 
         self.ledger_id = ledger_id
+        self._kv = kv
         self._blocks = block_store
         self._state = VersionedDB(kv, f"statedb/{ledger_id}")
         self._history = HistoryDB(kv, f"historydb/{ledger_id}")
@@ -124,6 +154,12 @@ class KVLedger:
         # SnapshotManager wired by the provider after construction (it
         # needs the ledger); commit() notifies it per committed block
         self.snapshots = None
+        # Per-stage commit timing: cumulative wall seconds per pipeline
+        # stage (CommitMetrics.STAGES keys), always maintained (bench.py
+        # reads them); `metrics` (a common.metrics.CommitMetrics) also
+        # gets per-observation histograms for /metrics.
+        self._metrics = metrics
+        self.commit_stage_seconds: dict[str, float] = {}
         # Serializes state mutation against snapshot export: commits are
         # already single-threaded per ledger (one committer), but an
         # admin RPC can request an on-demand snapshot concurrently — the
@@ -131,7 +167,18 @@ class KVLedger:
         # block.  RLock because the commit-time auto-trigger generates
         # while the committing thread already holds it.
         self.commit_lock = threading.RLock()
+        # the CommitGroup currently holding buffered (unflushed) blocks,
+        # if any — commits through any OTHER group are rejected while it
+        # is open (their collectors would disagree about the checkpoint)
+        self._active_group: CommitGroup | None = None
         self._recover()
+        # Durability watermark: the height (and last block hash) as of
+        # the last group boundary — everything at or below it has its
+        # block file fsynced AND its KV transaction committed.  During
+        # an open group, self.height runs ahead of this; snapshot
+        # exports and the auto-trigger only ever observe the watermark.
+        self._durable_height = self._blocks.height
+        self._durable_hash = self._blocks.last_block_hash
 
     def set_btl_policy(self, btl_policy) -> None:
         self.pvt_store._btl = btl_policy or (lambda ns, coll: 0)
@@ -158,12 +205,53 @@ class KVLedger:
         batch = self._mvcc.validate_and_prepare(
             block.header.number, rwsets, flags, pvt_data
         )
+        # a replayed block whose group KV txn died with a crash lost its
+        # cleartext pvt writes (pvt store + state are one atomic txn):
+        # record every endorsed-cleartext collection with no stored data
+        # as MISSING so the reconciler re-fetches instead of the loss
+        # staying silent (may over-report collections this peer was
+        # never eligible for; reconciliation of those is a no-op)
+        missing = self._lost_pvt(rwsets, flags, pvt_data or {})
+        if missing:
+            self.pvt_store.commit(block.header.number, {}, missing)
         self._state.apply_updates(batch, Height(block.header.number, len(flags)))
         self._history.commit(
             block.header.number, _history_writes(rwsets, flags)
         )
 
+    @staticmethod
+    def _lost_pvt(rwsets, flags, pvt_data) -> list[tuple[int, str, str]]:
+        """[(tx, ns, coll)] where the rwset endorsed a cleartext private
+        rwset (non-empty pvt_rwset_hash) but no cleartext survives."""
+        out: list[tuple[int, str, str]] = []
+        for tx_num, raw in enumerate(rwsets):
+            if flags[tx_num] != VALID or raw is None or pvt_data.get(tx_num):
+                continue
+            try:
+                txrw = rwset_pb2.TxReadWriteSet.FromString(raw)
+            except Exception:
+                continue
+            for nsrw in txrw.ns_rwset:
+                for ch in nsrw.collection_hashed_rwset:
+                    if ch.pvt_rwset_hash:
+                        out.append(
+                            (tx_num, nsrw.namespace, ch.collection_name)
+                        )
+        return out
+
     # -- commit path (reference kv_ledger.go:447 CommitLegacy) -------------
+
+    def begin_commit_group(self) -> CommitGroup:
+        """Start a group commit: blocks committed with this group buffer
+        every KV mutation in one shared collector (and skip per-block
+        fsyncs); commit_group_flush lands the whole group with one
+        block-file fsync + one all-or-nothing KV transaction.  Reusable
+        after each flush."""
+        collector = WriteBatchCollector(self._kv)
+        view = self._state.rebased(collector)
+        return CommitGroup(
+            collector=collector, state=view, mvcc=MVCCValidator(view)
+        )
 
     def commit(
         self,
@@ -172,6 +260,7 @@ class KVLedger:
         missing_pvt: list[tuple[int, str, str]] | None = None,
         rwsets: list[bytes | None] | None = None,
         assist: CommitAssist | None = None,
+        group: CommitGroup | None = None,
     ) -> None:
         """MVCC-validate (updating the tx filter), persist block + private
         data, apply state + history.  Signature/policy flags must already
@@ -184,37 +273,186 @@ class KVLedger:
         every envelope; a full `assist` additionally skips the rwset
         re-unmarshal (MVCC + history read the decoded footprints), the
         txid envelope parse in the block index, and the whole-block
-        re-serialization (splice from the envelope bytes)."""
+        re-serialization (splice from the envelope bytes).
+
+        Without `group`, the block is flushed immediately — still as ONE
+        block-file fsync + ONE atomic KV transaction carrying the block
+        index, pvt store, state (with savepoint) and history together
+        (the pre-group code paid one fsync plus four-plus independent
+        KV transactions here).  With `group`, the block lands in the
+        group's buffers and only becomes durable/visible at the next
+        commit_group_flush."""
+        if self.snapshots is not None:
+            # a background snapshot export pinned to the last flush
+            # height must win the commit lock before state advances
+            self.snapshots.wait_generation_turn()
         with self.commit_lock:
-            flags = list(protoutil.tx_filter(block))
-            footprints = txids = env_bytes = None
-            if assist is not None and len(assist.rwsets) == len(flags):
-                rwsets = assist.rwsets
-                footprints = assist.footprints
-                txids = assist.txids
-                env_bytes = assist.env_bytes
-            if rwsets is None or len(rwsets) != len(flags):
-                rwsets = extract_rwsets(block)
-            batch = self._mvcc.validate_and_prepare(
-                block.header.number, rwsets, flags, pvt_data,
-                footprints=footprints,
-            )
-            protoutil.set_tx_filter(block, flags)
-            self._blocks.add_block(block, txids=txids, env_bytes=env_bytes)
-            # Pvt store before state so recovery-after-crash can replay
-            # the cleartext writes (state savepoint is the recovery
-            # watermark).
-            self.pvt_store.commit(
-                block.header.number, pvt_data or {}, missing_pvt
-            )
-            self._state.apply_updates(
-                batch, Height(block.header.number, len(flags))
-            )
-            self._history.commit(
-                block.header.number, _history_writes(rwsets, flags, footprints)
-            )
-            if self.snapshots is not None:
-                self.snapshots.on_block_committed(block.header.number)
+            g = group if group is not None else self.begin_commit_group()
+            if self._active_group is not None and g is not self._active_group:
+                # a DIFFERENT group holds buffered blocks: its index/
+                # checkpoint advance lives only in its collector, so a
+                # fresh collector would read the stale base checkpoint
+                # and index this block at already-occupied offsets
+                raise BlockStoreError(
+                    "another commit group holds unflushed blocks for "
+                    f"ledger {self.ledger_id!r}"
+                )
+            try:
+                self._commit_into(
+                    block, pvt_data, missing_pvt, rwsets, assist, g
+                )
+            except BaseException:
+                # a failure after add_block would otherwise leave the
+                # live block store advanced (file appended, height
+                # bumped) with its index writes stranded in the
+                # abandoned collector — unwind the WHOLE group (its
+                # blocks were never acknowledged)
+                self._rollback_group(g)
+                raise
+            if group is None:
+                self._flush_group(g)
+
+    def commit_group_flush(self, group: CommitGroup) -> None:
+        """Land an open group: fsync the touched block files FIRST, then
+        commit the group's single KV transaction (index + pvt + state +
+        history + savepoints) — the same block-file-first recovery
+        invariant as per-block commits, paid once per group.  Finally
+        fire the deferred snapshot auto-triggers; the durability
+        watermark advances so exports only see fully-synced heights."""
+        if self.snapshots is not None:
+            self.snapshots.wait_generation_turn()
+        with self.commit_lock:
+            self._flush_group(group)
+
+    def _commit_into(
+        self, block, pvt_data, missing_pvt, rwsets, assist,
+        group: CommitGroup,
+    ) -> None:
+        t = time.perf_counter
+        flags = list(protoutil.tx_filter(block))
+        footprints = txids = env_bytes = None
+        if assist is not None and len(assist.rwsets) == len(flags):
+            rwsets = assist.rwsets
+            footprints = assist.footprints
+            txids = assist.txids
+            env_bytes = assist.env_bytes
+        if rwsets is None or len(rwsets) != len(flags):
+            rwsets = extract_rwsets(block)
+        t0 = t()
+        # group.mvcc reads through the collector overlay, so a block
+        # sees the buffered writes of earlier blocks in its group
+        batch = group.mvcc.validate_and_prepare(
+            block.header.number, rwsets, flags, pvt_data,
+            footprints=footprints,
+        )
+        protoutil.set_tx_filter(block, flags)
+        t1 = t()
+        file_idx = self._blocks.add_block(
+            block, txids=txids, env_bytes=env_bytes,
+            into=group.collector, sync=False,
+        )
+        if file_idx is not None:
+            group.dirty_files.add(file_idx)
+        t2 = t()
+        # Pvt store and state ride the SAME atomic KV transaction (with
+        # the savepoint), so recovery never sees state ahead of the pvt
+        # store; a crash losing the whole txn loses both together, and
+        # _recover's replay records reconciler missing-data entries for
+        # cleartext that went down with an unflushed group.
+        self.pvt_store.commit(
+            block.header.number, pvt_data or {}, missing_pvt,
+            into=group.collector,
+        )
+        t3 = t()
+        group.state.apply_updates(
+            batch, Height(block.header.number, len(flags))
+        )
+        t4 = t()
+        self._history.commit(
+            block.header.number, _history_writes(rwsets, flags, footprints),
+            into=group.collector,
+        )
+        t5 = t()
+        group.blocks += 1
+        group.snap_notify.append(block.header.number)
+        self._active_group = group
+        if self.snapshots is not None and self.snapshots.has_pending_request(
+            block.header.number
+        ):
+            group.boundary_hint = True
+        self._observe_stages(
+            mvcc=t1 - t0, block_append=t2 - t1, pvt=t3 - t2,
+            state=t4 - t3, history=t5 - t4,
+        )
+
+    def _flush_group(self, group: CommitGroup) -> None:
+        if group.blocks:
+            t0 = time.perf_counter()
+            try:
+                self._blocks.sync_files(group.dirty_files)
+                t1 = time.perf_counter()
+                group.collector.flush()
+            except BaseException:
+                # roll the WHOLE group back so the live ledger stays
+                # consistent with committed storage: the buffered index
+                # data is gone, so the unindexed file appends go with it
+                # and height/hash return to the durable watermark.  The
+                # group's blocks were never acknowledged; callers may
+                # re-commit them into a fresh (or this, now-empty) group.
+                self._rollback_group(group)
+                raise
+            t2 = time.perf_counter()
+            self._observe_stages(fsync=t1 - t0, kv_txn=t2 - t1)
+            if self._metrics is not None:
+                self._metrics.blocks_per_sync.With(
+                    "channel", self.ledger_id
+                ).observe(group.blocks)
+            # the base store changed under the main view's caches
+            self._state.invalidate_caches()
+            self._durable_height = self._blocks.height
+            self._durable_hash = self._blocks.last_block_hash
+        notify, group.snap_notify = group.snap_notify, []
+        group.blocks = 0
+        group.dirty_files.clear()
+        group.boundary_hint = False
+        if self._active_group is group:
+            self._active_group = None
+        if self.snapshots is not None:
+            for num in notify:
+                self.snapshots.on_block_committed(num)
+
+    def _rollback_group(self, group: CommitGroup) -> None:
+        """Discard a group's buffered KV writes, truncate its unindexed
+        file appends, and restore block-store height/hash to committed
+        state — the all-or-nothing unwind for any group failure."""
+        group.collector.discard()
+        self._blocks.truncate_to_checkpoint()
+        group.blocks = 0
+        group.dirty_files.clear()
+        group.snap_notify.clear()
+        group.boundary_hint = False
+        group.state.invalidate_caches()
+        if self._active_group is group:
+            self._active_group = None
+
+    def _observe_stages(self, **stages: float) -> None:
+        acc = self.commit_stage_seconds
+        for name, dt in stages.items():
+            acc[name] = acc.get(name, 0.0) + dt
+            if self._metrics is not None:
+                self._metrics.stage_duration.With(
+                    "channel", self.ledger_id, "stage", name
+                ).observe(dt)
+
+    @property
+    def durable_height(self) -> int:
+        """Height as of the last flushed group boundary — block files
+        fsynced and the KV transaction committed up to here."""
+        return self._durable_height
+
+    @property
+    def durable_block_hash(self) -> bytes:
+        return self._durable_hash
 
     def commit_old_pvt_data(
         self, block_num: int, tx_num: int, pvt_bytes: bytes
@@ -390,10 +628,11 @@ class LedgerProvider:
     <root>/snapshots."""
 
     def __init__(self, root_dir: str | None = None, csp=None, metrics=None,
-                 snapshots_dir: str | None = None):
+                 snapshots_dir: str | None = None, commit_metrics=None):
         self._root = root_dir
         self._csp = csp
         self._metrics = metrics
+        self._commit_metrics = commit_metrics
         if snapshots_dir is None and root_dir is not None:
             snapshots_dir = os.path.join(root_dir, "snapshots")
         self._snapshots_dir = snapshots_dir
@@ -421,7 +660,9 @@ class LedgerProvider:
             None if self._root is None else os.path.join(self._root, ledger_id, "chains")
         )
         store = BlockStore(block_dir, self._kv, name=ledger_id)
-        ledger = KVLedger(ledger_id, store, self._kv)
+        ledger = KVLedger(
+            ledger_id, store, self._kv, metrics=self._commit_metrics
+        )
         self._wire_snapshots(ledger)
         self._ledgers[ledger_id] = ledger
         return ledger
@@ -461,7 +702,9 @@ class LedgerProvider:
                 f"channel {ledger_id!r} already has {store.height} blocks"
             )
         snap.import_snapshot(meta, snapshot_dir, store, self._kv, ledger_id)
-        ledger = KVLedger(ledger_id, store, self._kv)
+        ledger = KVLedger(
+            ledger_id, store, self._kv, metrics=self._commit_metrics
+        )
         self._wire_snapshots(ledger)
         self._ledgers[ledger_id] = ledger
         return ledger
@@ -479,4 +722,10 @@ class LedgerProvider:
         self._kv.close()
 
 
-__all__ = ["KVLedger", "LedgerProvider", "QueryExecutor", "extract_rwsets"]
+__all__ = [
+    "KVLedger",
+    "LedgerProvider",
+    "QueryExecutor",
+    "CommitGroup",
+    "extract_rwsets",
+]

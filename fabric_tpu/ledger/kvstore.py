@@ -168,6 +168,95 @@ class SqliteKVStore(KVStore):
         self._conn.close()
 
 
+class WriteBatchCollector(KVStore):
+    """Buffers every mutation destined for `base` so one whole commit —
+    state + history + pvt store + block index + savepoints — lands in a
+    SINGLE base write_batch: on the sqlite backend that is exactly one
+    transaction (the group-commit seam; the reference accumulates a
+    leveldbhelper UpdateBatch per store but still pays one WriteBatch
+    per store per block).  Reads are overlay-aware (read-your-writes),
+    so MVCC validation of block k+1 in a group sees block k's buffered
+    writes; flush() is all-or-nothing."""
+
+    def __init__(self, base: KVStore):
+        self._base = base
+        self._puts: dict[bytes, bytes] = {}
+        self._dels: set[bytes] = set()
+
+    def get(self, key: bytes) -> bytes | None:
+        if key in self._puts:
+            return self._puts[key]
+        if key in self._dels:
+            return None
+        return self._base.get(key)
+
+    def get_many(self, keys) -> dict[bytes, bytes]:
+        out: dict[bytes, bytes] = {}
+        missing: list[bytes] = []
+        for k in keys:
+            if k in self._puts:
+                out[k] = self._puts[k]
+            elif k not in self._dels:
+                missing.append(k)
+        if missing:
+            out.update(self._base.get_many(missing))
+        return out
+
+    def write_batch(self, puts, deletes=()) -> None:
+        for k, v in puts.items():
+            self._dels.discard(k)
+            self._puts[k] = v
+        for k in deletes:
+            self._puts.pop(k, None)
+            self._dels.add(k)
+
+    # write_batch_if_absent: the KVStore default (get_many + filtered
+    # write_batch) is already correct here because get_many sees the
+    # overlay — first-wins holds across the buffered blocks of a group
+    # as well as against committed state.
+
+    def iterate(self, start: bytes = b"", end: bytes | None = None):
+        """Merge the overlay into the base's ordered scan (the pvt
+        store's expiry purge range-reads mid-commit)."""
+        ov = iter(sorted(
+            k for k in self._puts
+            if k >= start and (end is None or k < end)
+        ))
+        ok = next(ov, None)
+        for k, v in self._base.iterate(start, end):
+            while ok is not None and ok < k:
+                yield ok, self._puts[ok]
+                ok = next(ov, None)
+            if ok == k:
+                yield k, self._puts[k]
+                ok = next(ov, None)
+                continue
+            if k in self._dels:
+                continue
+            yield k, v
+        while ok is not None:
+            yield ok, self._puts[ok]
+            ok = next(ov, None)
+
+    @property
+    def pending(self) -> int:
+        return len(self._puts) + len(self._dels)
+
+    def flush(self) -> None:
+        """Commit everything buffered to the base store in one
+        write_batch (one sqlite transaction), then reset."""
+        if self._puts or self._dels:
+            self._base.write_batch(self._puts, sorted(self._dels))
+        self._puts = {}
+        self._dels = set()
+
+    def discard(self) -> None:
+        """Drop everything buffered without touching the base store —
+        the group-commit failure rollback."""
+        self._puts = {}
+        self._dels = set()
+
+
 class NamedDB(KVStore):
     """A prefixed view over a shared store — the reference's
     leveldbhelper.Provider GetDBHandle(dbName) pattern."""
@@ -177,6 +266,15 @@ class NamedDB(KVStore):
     def __init__(self, base: KVStore, name: str):
         self._base = base
         self._prefix = name.encode() + self._SEP
+
+    def rebase(self, base: KVStore) -> "NamedDB":
+        """The same namespace view over a different base — how commit
+        hands each store a WriteBatchCollector without re-deriving the
+        prefix from a name."""
+        c = NamedDB.__new__(NamedDB)
+        c._base = base
+        c._prefix = self._prefix
+        return c
 
     def _k(self, key: bytes) -> bytes:
         return self._prefix + key
@@ -228,5 +326,6 @@ __all__ = [
     "MemKVStore",
     "SqliteKVStore",
     "NamedDB",
+    "WriteBatchCollector",
     "open_kvstore",
 ]

@@ -49,11 +49,16 @@ class PvtDataStore:
         block_num: int,
         pvt_data: dict[int, bytes],
         missing: list[tuple[int, str, str]] | None = None,
+        into=None,
     ) -> None:
         """Persist the block's private data ({tx_num: TxPvtReadWriteSet
         bytes}) and missing-data records [(tx_num, ns, coll)]; then purge
         whatever expired at this height (reference store.go Commit +
-        purgeExpiredData)."""
+        purgeExpiredData).  `into` (a WriteBatchCollector over this
+        store's backing KV) buffers everything — including the purge —
+        into the block's shared KV transaction; expiry-merge reads go
+        through the overlay so earlier blocks of a group are visible."""
+        db = self._db if into is None else self._db.rebase(into)
         puts: dict[bytes, bytes] = {}
         expiry_adds: dict[int, list[tuple[int, str, str]]] = {}
         for tx_num in sorted(pvt_data):
@@ -73,14 +78,14 @@ class PvtDataStore:
         with self._lock:
             for exp, entries in expiry_adds.items():
                 key = _xkey(exp, block_num)
-                prior = self._db.get(key)
+                prior = db.get(key)
                 if prior:
                     entries = json.loads(prior) + [list(e) for e in entries]
                 puts[key] = json.dumps(
                     [list(e) for e in entries]
                 ).encode()
-            self._db.write_batch(puts)
-            self._purge_expired(block_num)
+            db.write_batch(puts)
+            self._purge_expired(block_num, db)
 
     def _collections_of(self, raw: bytes):
         try:
@@ -91,12 +96,13 @@ class PvtDataStore:
             for cp in nsp.collection_pvt_rwset:
                 yield nsp.namespace, cp.collection_name
 
-    def _purge_expired(self, current_block: int) -> None:
+    def _purge_expired(self, current_block: int, db=None) -> None:
         """Drop collection rwsets whose BTL elapsed (lock held)."""
+        db = self._db if db is None else db
         deletes: list[bytes] = []
         rewrites: dict[bytes, bytes] = {}
         end = _xkey(current_block + 1, 0)
-        for key, value in self._db.iterate(_EXP, end):
+        for key, value in db.iterate(_EXP, end):
             block = int(key[len(_EXP) + 16 :], 16)
             expired = {(t, n, c) for t, n, c in json.loads(value)}
             deletes.append(key)
@@ -105,7 +111,7 @@ class PvtDataStore:
                 by_tx.setdefault(t, set()).add((n, c))
             for tx_num, colls in by_tx.items():
                 dkey = _dkey(block, tx_num)
-                raw = rewrites.get(dkey) or self._db.get(dkey)
+                raw = rewrites.get(dkey) or db.get(dkey)
                 if raw is None:
                     continue
                 try:
@@ -129,7 +135,7 @@ class PvtDataStore:
                     rewrites.pop(dkey, None)
                     deletes.append(dkey)
         if deletes or rewrites:
-            self._db.write_batch(rewrites, deletes)
+            db.write_batch(rewrites, deletes)
 
     # -- snapshot bootstrap ------------------------------------------------
 

@@ -80,6 +80,13 @@ class SnapshotError(Exception):
     pass
 
 
+class SnapshotExistsError(SnapshotError):
+    """A snapshot for this (channel, height) already exists on disk —
+    benign for the background auto-trigger: two requests satisfied by
+    the same commit group both export at the same durable height, and
+    the loser's request is answered by the winner's snapshot."""
+
+
 # -- record files ------------------------------------------------------------
 #
 # All .data files share one trivially deterministic format: a sequence of
@@ -226,7 +233,10 @@ def generate_snapshot(
     -> byte-identical files -> identical signable metadata."""
     if not snapshots_root:
         raise SnapshotError("ledger provider has no snapshots directory")
-    height = ledger.height
+    # export the DURABLE height: under group commit the in-memory
+    # height can run ahead of the last flushed fsync+txn boundary, and
+    # only the flushed prefix is readable (and crash-safe) here
+    height = getattr(ledger, "durable_height", ledger.height)
     if height == 0:
         raise SnapshotError("cannot snapshot an empty ledger")
     t0 = time.perf_counter()
@@ -234,7 +244,7 @@ def generate_snapshot(
     last_num = height - 1
     final_dir = os.path.join(snapshots_root, "completed", lid, str(last_num))
     if os.path.exists(final_dir):
-        raise SnapshotError(
+        raise SnapshotExistsError(
             f"snapshot for {lid!r} at block {last_num} already exists"
         )
     work = os.path.join(snapshots_root, "in_progress", f"{lid}-{last_num}")
@@ -294,11 +304,14 @@ def generate_snapshot(
     files = _hash_files(work, DATA_FILES, csp, metrics, channel=lid)
     last_blk = store.get_block_by_number(last_num)
     sp = state.savepoint()
+    last_hash = getattr(ledger, "durable_block_hash", None)
+    if last_hash is None:
+        last_hash = store.last_block_hash
     meta = {
         "version": SNAPSHOT_FORMAT_VERSION,
         "channel_id": lid,
         "last_block_number": last_num,
-        "last_block_hash": store.last_block_hash.hex(),
+        "last_block_hash": last_hash.hex(),
         # informational for external auditors signing/checking the
         # metadata against the source chain (the reference's signable
         # metadata carries it too); import does not consume it
@@ -412,6 +425,20 @@ class SnapshotManager:
             BookkeepingProvider(kv).get_kv(ledger.ledger_id, SNAPSHOT_REQUEST)
         )
         self._lock = threading.Lock()
+        # background auto-trigger generations in flight (wait_idle),
+        # plus a spawn/ack handshake: _spawn_seq counts generations
+        # handed to background threads, _ack_seq counts those that have
+        # ACQUIRED the ledger commit lock — commits wait for the two to
+        # match so a pinned export runs before state advances past its
+        # height (the reference blocks commits during generation too)
+        self._idle = threading.Condition()
+        self._inflight = 0
+        self._spawn_seq = 0
+        self._ack_seq = 0
+        # in-memory mirror of the durable pending-request set: the
+        # per-block boundary-hint probe on the commit hot path must not
+        # pay a KV get
+        self._pending = set(self._requests.list_pending())
         self._update_gauge()
 
     # -- requests ----------------------------------------------------------
@@ -436,7 +463,12 @@ class SnapshotManager:
         one."""
         with self._ledger.commit_lock:
             with self._lock:
-                last = self._ledger.height - 1
+                # anchor on the DURABLE height: an open commit group's
+                # buffered tail is neither readable nor crash-safe, so
+                # "the last committed block" means the watermark
+                last = getattr(
+                    self._ledger, "durable_height", self._ledger.height
+                ) - 1
                 if block_number == 0:
                     if last < 0:
                         raise SnapshotError("ledger has no committed blocks")
@@ -451,14 +483,35 @@ class SnapshotManager:
                     return {
                         "block_number": block_number, "snapshot_dir": path
                     }
+                if block_number < self._ledger.height:
+                    # already buffered in an OPEN commit group: the
+                    # stream's flush-at-requested-height hint for this
+                    # block has passed, so the export could only run at
+                    # the group's (later) flush height — silently
+                    # exporting at the wrong height would break the
+                    # deterministic-height guarantee, so refuse instead
+                    raise SnapshotError(
+                        f"requested block {block_number} is already "
+                        f"buffered in an open commit group (last durable "
+                        f"block is {last}); request block 0 for the last "
+                        f"durable block, or a block >= "
+                        f"{self._ledger.height}"
+                    )
                 self._requests.submit(block_number)
+                self._pending.add(block_number)
                 self._update_gauge()
                 return {"block_number": block_number, "snapshot_dir": None}
 
     def cancel_request(self, block_number: int) -> None:
         with self._lock:
             self._requests.cancel(block_number)
+            self._pending.discard(block_number)
             self._update_gauge()
+
+    def has_pending_request(self, block_number: int) -> bool:
+        """O(1) in-memory probe — the commit path's per-block
+        boundary-hint check."""
+        return block_number in self._pending
 
     def list_pending(self) -> list[int]:
         return self._requests.list_pending()
@@ -466,29 +519,90 @@ class SnapshotManager:
     # -- generation --------------------------------------------------------
 
     def on_block_committed(self, block_number: int) -> None:
-        """KVLedger.commit calls this after each block (commit_lock
-        held); a matching pending request triggers generation.  The
-        export runs synchronously on the commit thread — deterministic
-        and torn-read-free, at the cost of stalling that channel's
-        commits for the export duration (the reference generates in a
-        background goroutine; background generation is a ROADMAP item).
-        A generation failure is logged and the request dropped — the
-        commit itself must never fail because a snapshot could not be
-        written (reference logs and continues the same way)."""
+        """KVLedger's group flush calls this for each block made durable
+        (commit_lock held); a matching pending request hands generation
+        to a BACKGROUND thread — the commit thread only dequeues the
+        request, so the export no longer runs inline on the committer
+        (the reference generates in a background goroutine the same
+        way).  Height determinism is preserved by three pieces: the
+        streaming committer flushes AT a requested block (CommitGroup.
+        boundary_hint), submit_request refuses heights already buffered
+        in an open group (whose hint has passed), and
+        wait_generation_turn makes the next commit wait until the
+        export thread holds the commit lock — so the snapshot is taken
+        at exactly the requested height, as the synchronous path
+        guaranteed (and peers generating from the same request agree
+        byte-for-byte).  A generation failure is logged
+        and the request dropped — the commit itself must never fail
+        because a snapshot could not be written (reference logs and
+        continues the same way).  Tests and operators can wait_idle()
+        for the export to finish."""
         with self._lock:
             if not self._requests.has(block_number):
                 return
             self._requests.cancel(block_number)
+            self._pending.discard(block_number)
             self._update_gauge()
-            try:
-                self._generate()
-            except Exception as exc:
-                from fabric_tpu.common.flogging import must_get_logger
+        with self._idle:
+            self._inflight += 1
+            self._spawn_seq += 1
+        threading.Thread(
+            target=self._bg_generate, args=(block_number,),
+            name=f"snapshot-gen-{self._ledger.ledger_id}", daemon=True,
+        ).start()
 
-                must_get_logger("ledger.snapshot").warning(
-                    "snapshot generation at block %d failed for %r: %s",
-                    block_number, self._ledger.ledger_id, exc,
-                )
+    def wait_generation_turn(self, timeout: float = 30.0) -> None:
+        """Block until every spawned background generation has acquired
+        the ledger commit lock.  KVLedger calls this at each commit/
+        flush entry (BEFORE taking the commit lock itself), so an export
+        pinned to the triggering flush's height always runs before state
+        can advance past it — the export height is deterministic, not a
+        race.  Times out rather than wedging commits if a generation
+        thread dies before acquiring."""
+        deadline = time.monotonic() + timeout
+        with self._idle:
+            while self._ack_seq < self._spawn_seq:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return
+                self._idle.wait(remaining)
+
+    def _bg_generate(self, block_number: int) -> None:
+        try:
+            with self._ledger.commit_lock:
+                with self._idle:
+                    self._ack_seq += 1
+                    self._idle.notify_all()
+                with self._lock:
+                    self._generate()
+        except SnapshotExistsError:
+            # several requests satisfied by one commit group race to
+            # export the same durable height: the winner's snapshot
+            # answers every one of them
+            pass
+        except Exception as exc:
+            from fabric_tpu.common.flogging import must_get_logger
+
+            must_get_logger("ledger.snapshot").warning(
+                "snapshot generation at block %d failed for %r: %s",
+                block_number, self._ledger.ledger_id, exc,
+            )
+        finally:
+            with self._idle:
+                self._inflight -= 1
+                self._idle.notify_all()
+
+    def wait_idle(self, timeout: float = 30.0) -> bool:
+        """Block until no background auto-trigger generation is in
+        flight; False on timeout."""
+        deadline = time.monotonic() + timeout
+        with self._idle:
+            while self._inflight:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._idle.wait(remaining)
+        return True
 
     def generate(self) -> str:
         """Generate a snapshot at the current committed height."""
@@ -504,6 +618,7 @@ class SnapshotManager:
 
 __all__ = [
     "SnapshotError",
+    "SnapshotExistsError",
     "SnapshotManager",
     "SnapshotRequestBookkeeper",
     "generate_snapshot",

@@ -207,6 +207,21 @@ class VersionedDB:
         self._indexes: dict[str, set[str]] | None = None  # lazy-loaded
         self._meta_ns: set[str] | bool | None = None  # lazy; True = unknown
 
+    def rebased(self, base: KVStore) -> "VersionedDB":
+        """The same versioned namespace over a different base store —
+        the commit path hands this a WriteBatchCollector so
+        apply_updates buffers into the group's single KV transaction,
+        and reads (MVCC preloads, index maintenance) see the writes of
+        earlier blocks in the same group.  The index-definition cache is
+        shared with the parent (definitions only ever grow); the
+        metadata-namespace cache is NOT — the view reloads it through
+        the overlay so a group's own metadata flags stay visible."""
+        c = VersionedDB.__new__(VersionedDB)
+        c._db = self._db.rebase(base)
+        c._indexes = self._load_indexes()
+        c._meta_ns = None
+        return c
+
     # -- metadata presence fast path ---------------------------------------
 
     def _load_meta_ns(self):
@@ -226,6 +241,14 @@ class VersionedDB:
             else:
                 self._meta_ns = set()
         return self._meta_ns
+
+    def invalidate_caches(self) -> None:
+        """Drop caches derived from the backing store — call after the
+        store changed underneath this view (a WriteBatchCollector flush
+        from a commit group, an out-of-band writer).  Index DEFINITIONS
+        are deliberately kept: they only ever grow, and group commits
+        never add them."""
+        self._meta_ns = None
 
     def may_have_metadata(self, ns: str) -> bool:
         """False guarantees no key under `ns` carries metadata.
@@ -369,6 +392,19 @@ class VersionedDB:
 
     def get_state_multiple(self, ns: str, keys) -> list[VersionedValue | None]:
         return [self.get_state(ns, k) for k in keys]
+
+    def get_state_many(self, pairs) -> dict:
+        """Bulk point lookup: {(ns, key): VersionedValue | None} with an
+        entry for EVERY requested pair (absent keys map to None, so a
+        hit in the result distinguishes known-absent from not-probed) in
+        one store round-trip — the commit path's bulk MVCC preload."""
+        pairs = list(dict.fromkeys(pairs))
+        raw_keys = [_state_key(ns, k) for ns, k in pairs]
+        got = self._db.get_many(raw_keys)
+        return {
+            pair: (_decode_value(got[rk]) if rk in got else None)
+            for pair, rk in zip(pairs, raw_keys)
+        }
 
     def get_state_range(self, ns: str, start_key: str, end_key: str):
         """Iterate (key, VersionedValue) over [start, end); empty end = open."""

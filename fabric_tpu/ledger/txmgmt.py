@@ -379,10 +379,66 @@ class MVCCValidator:
     def __init__(self, db: VersionedDB):
         self._db = db
 
-    def _committed_version(self, ns: str, key: str, updates: dict) -> Height | None:
+    def _committed_version(
+        self, ns: str, key: str, updates: dict, cache: dict | None = None
+    ) -> Height | None:
         if (ns, key) in updates:
             return updates[(ns, key)]
+        if cache is not None and (ns, key) in cache:
+            vv = cache[(ns, key)]
+            return None if vv is None else vv.version
         return self._db.get_version(ns, key)
+
+    def _preload(self, parsed_per_tx: list) -> dict:
+        """Bulk-load the block's whole point read/version set — every
+        read key, hashed read, and (only in namespaces that may carry
+        metadata) every write key, whose committed metadata a value-only
+        write must retain — in ONE get_state_many round-trip instead of
+        a store probe per key (the reference pays a leveldb get per
+        read, validator.go validateKVRead).  Range queries are not
+        preloaded; they fall back to scans.  The result maps every
+        harvested (ns, key) to VersionedValue | None, so a cache entry
+        of None means known-absent, not not-probed."""
+        keys: list[tuple[str, str]] = []
+        may_meta: dict[str, bool] = {}
+
+        def meta(ns: str) -> bool:
+            # _existing_metadata short-circuits on may_have_metadata,
+            # so metadata-free namespaces (the common case) need no
+            # write-key preload at all
+            got = may_meta.get(ns)
+            if got is None:
+                got = may_meta[ns] = self._db.may_have_metadata(ns)
+            return got
+
+        for parsed in parsed_per_tx:
+            if not parsed:
+                continue
+            for ns, kvrw, colls in parsed:
+                keys.extend((ns, r.key) for r in kvrw.reads)
+                if meta(ns):
+                    keys.extend((ns, w.key) for w in kvrw.writes)
+                    keys.extend(
+                        (ns, mw.key) for mw in kvrw.metadata_writes
+                    )
+                for coll, hrw, _ in colls:
+                    hns = hash_ns(ns, coll)
+                    keys.extend(
+                        (hns, bytes(hr.key_hash).hex())
+                        for hr in hrw.hashed_reads
+                    )
+                    if meta(hns):
+                        keys.extend(
+                            (hns, bytes(hw.key_hash).hex())
+                            for hw in hrw.hashed_writes
+                        )
+                        keys.extend(
+                            (hns, bytes(mw.key_hash).hex())
+                            for mw in hrw.metadata_writes
+                        )
+        if not keys:
+            return {}
+        return self._db.get_state_many(keys)
 
     def validate_and_prepare(
         self,
@@ -413,42 +469,51 @@ class MVCCValidator:
         conflicts against committed state AND the writes of earlier valid
         txs in the same block."""
         pvt_data = pvt_data or {}
-        updated_versions: dict[tuple[str, str], Height] = {}
-        batch: dict[str, dict[str, VersionedValue | None]] = {}
+        # decode pass: adopt the validator's footprints or unmarshal
+        # once per tx, so the whole block's read set can be harvested
+        # for ONE bulk version preload before any validation runs
+        parsed_per_tx: list = [None] * len(rwsets)
         for tx_num, raw in enumerate(rwsets):
             if flags[tx_num] != VALID or raw is None:
                 continue
             fp = footprints[tx_num] if footprints is not None else None
             if fp is not None:
-                parsed = fp.parsed
-            else:
-                try:
-                    txrw = rwset_pb2.TxReadWriteSet.FromString(raw)
-                    parsed = [
-                        (
-                            nsrw.namespace,
-                            kv_rwset_pb2.KVRWSet.FromString(nsrw.rwset),
-                            [
-                                (
-                                    ch.collection_name,
-                                    kv_rwset_pb2.HashedRWSet.FromString(
-                                        ch.hashed_rwset
-                                    ),
-                                    bytes(ch.pvt_rwset_hash),
-                                )
-                                for ch in nsrw.collection_hashed_rwset
-                            ],
-                        )
-                        for nsrw in txrw.ns_rwset
-                    ]
-                except Exception:
-                    flags[tx_num] = BAD_RWSET
-                    continue
+                parsed_per_tx[tx_num] = fp.parsed
+                continue
+            try:
+                txrw = rwset_pb2.TxReadWriteSet.FromString(raw)
+                parsed_per_tx[tx_num] = [
+                    (
+                        nsrw.namespace,
+                        kv_rwset_pb2.KVRWSet.FromString(nsrw.rwset),
+                        [
+                            (
+                                ch.collection_name,
+                                kv_rwset_pb2.HashedRWSet.FromString(
+                                    ch.hashed_rwset
+                                ),
+                                bytes(ch.pvt_rwset_hash),
+                            )
+                            for ch in nsrw.collection_hashed_rwset
+                        ],
+                    )
+                    for nsrw in txrw.ns_rwset
+                ]
+            except Exception:
+                flags[tx_num] = BAD_RWSET
+        cache = self._preload(parsed_per_tx)
+        updated_versions: dict[tuple[str, str], Height] = {}
+        batch: dict[str, dict[str, VersionedValue | None]] = {}
+        for tx_num, parsed in enumerate(parsed_per_tx):
+            if parsed is None or flags[tx_num] != VALID:
+                continue
             code = VALID
             for ns, kvrw, colls in parsed:
                 for read in kvrw.reads:
                     want = _height_of(read.version) if read.HasField("version") else None
-                    have = self._committed_version(ns, read.key, updated_versions)
+                    have = self._committed_version(
+                        ns, read.key, updated_versions, cache
+                    )
                     if want != have:
                         code = MVCC_READ_CONFLICT
                         break
@@ -469,7 +534,8 @@ class MVCCValidator:
                             else None
                         )
                         have = self._committed_version(
-                            hns, bytes(hread.key_hash).hex(), updated_versions
+                            hns, bytes(hread.key_hash).hex(),
+                            updated_versions, cache,
                         )
                         if want != have:
                             code = MVCC_READ_CONFLICT
@@ -496,13 +562,13 @@ class MVCCValidator:
                         # puts — reference tx_ops metadata merge).
                         ns_batch[w.key] = VersionedValue(
                             w.value, h,
-                            self._existing_metadata(ns, w.key, ns_batch),
+                            self._existing_metadata(ns, w.key, ns_batch, cache),
                         )
                 for mw in kvrw.metadata_writes:
                     self._apply_metadata_write(
                         ns, mw.key,
                         {e.name: bytes(e.value) for e in mw.entries},
-                        ns_batch, updated_versions, h,
+                        ns_batch, updated_versions, h, cache,
                     )
                 for coll, hrw, expected_hash in colls:
                     hns = hash_ns(ns, coll)
@@ -515,14 +581,16 @@ class MVCCValidator:
                         else:
                             h_batch[hkey] = VersionedValue(
                                 bytes(hw.value_hash), h,
-                                self._existing_metadata(hns, hkey, h_batch),
+                                self._existing_metadata(
+                                    hns, hkey, h_batch, cache
+                                ),
                             )
                             updated_versions[(hns, hkey)] = h
                     for mw in hrw.metadata_writes:
                         self._apply_metadata_write(
                             hns, bytes(mw.key_hash).hex(),
                             {e.name: bytes(e.value) for e in mw.entries},
-                            h_batch, updated_versions, h,
+                            h_batch, updated_versions, h, cache,
                         )
                     # Cleartext private writes, if supplied and authentic.
                     # An empty endorsed hash means NO cleartext rwset was
@@ -545,20 +613,27 @@ class MVCCValidator:
                             p_batch[w.key] = VersionedValue(w.value, h)
         return batch
 
-    def _existing_metadata(self, ns: str, key: str, ns_batch: dict) -> bytes:
+    def _existing_metadata(
+        self, ns: str, key: str, ns_batch: dict, cache: dict | None = None
+    ) -> bytes:
         """Current metadata of a key: in-block overlay first, then
-        committed state; empty for new/deleted keys."""
+        committed state (preload cache before a point probe); empty for
+        new/deleted keys."""
         if key in ns_batch:
             base = ns_batch[key]
             return base.metadata if base is not None else b""
         if not self._db.may_have_metadata(ns):
             return b""  # namespace never stored metadata: skip the store
-        vv = self._db.get_state(ns, key)
+        if cache is not None and (ns, key) in cache:
+            vv = cache[(ns, key)]
+        else:
+            vv = self._db.get_state(ns, key)
         return vv.metadata if vv is not None else b""
 
     def _apply_metadata_write(
         self, ns: str, key: str, entries: dict[str, bytes],
         ns_batch: dict, updated_versions: dict, h: Height,
+        cache: dict | None = None,
     ) -> None:
         """Replace a key's metadata map, keeping its value; a metadata
         write on a non-existent/deleted key is a no-op (reference
@@ -569,7 +644,10 @@ class MVCCValidator:
                 return
             ns_batch[key] = VersionedValue(base.value, h, encode_metadata(entries))
         else:
-            vv = self._db.get_state(ns, key)
+            if cache is not None and (ns, key) in cache:
+                vv = cache[(ns, key)]
+            else:
+                vv = self._db.get_state(ns, key)
             if vv is None:
                 return
             ns_batch[key] = VersionedValue(vv.value, h, encode_metadata(entries))

@@ -264,6 +264,10 @@ class PeerNode:
                 self.operations.snapshot_metrics()
                 if self.operations is not None else None
             ),
+            commit_metrics=(
+                self.operations.commit_metrics()
+                if self.operations is not None else None
+            ),
         )
         self.orderer_endpoints = orderer_endpoints or []
         self.channels: dict[str, _Channel] = {}

@@ -33,12 +33,16 @@ CSCC_GET_CONFIG_BLOCK = "cscc/GetConfigBlock"
 CSCC_GET_CHANNEL_CONFIG = "cscc/GetChannelConfig"
 CSCC_JOIN_CHAIN = "cscc/JoinChain"
 CSCC_GET_CHANNELS = "cscc/GetChannels"
+LSCC_INSTALL = "lscc/Install"
+LSCC_GET_INSTALLED_CC = "lscc/GetInstalledChaincodes"
 LIFECYCLE_INSTALL = "_lifecycle/InstallChaincode"
 LIFECYCLE_QUERY_INSTALLED = "_lifecycle/QueryInstalledChaincodes"
+LIFECYCLE_GET_PACKAGE = "_lifecycle/GetInstalledChaincodePackage"
 LIFECYCLE_APPROVE = "_lifecycle/ApproveChaincodeDefinitionForMyOrg"
 LIFECYCLE_COMMIT = "_lifecycle/CommitChaincodeDefinition"
 LIFECYCLE_CHECK_READINESS = "_lifecycle/CheckCommitReadiness"
 LIFECYCLE_QUERY_COMMITTED = "_lifecycle/QueryChaincodeDefinition"
+LIFECYCLE_QUERY_COMMITTED_ALL = "_lifecycle/QueryChaincodeDefinitions"
 PEER_PROPOSE = "peer/Propose"
 PEER_CC2CC = "peer/ChaincodeToChaincode"
 EVENT_BLOCK = "event/Block"
@@ -63,8 +67,12 @@ DEFAULT_POLICIES: dict[str, str] = {
     CSCC_GET_CHANNEL_CONFIG: _READERS,
     CSCC_GET_CHANNELS: _READERS,  # channel-less in practice
     CSCC_JOIN_CHAIN: _ADMINS,  # local admin in the reference
+    LSCC_INSTALL: _ADMINS,  # local admin in the reference
+    LSCC_GET_INSTALLED_CC: _ADMINS,
     LIFECYCLE_INSTALL: _ADMINS,
     LIFECYCLE_QUERY_INSTALLED: _ADMINS,
+    LIFECYCLE_GET_PACKAGE: _ADMINS,
+    LIFECYCLE_QUERY_COMMITTED_ALL: _READERS,
     LIFECYCLE_APPROVE: _WRITERS,
     LIFECYCLE_COMMIT: _WRITERS,
     LIFECYCLE_CHECK_READINESS: _WRITERS,
@@ -97,29 +105,50 @@ SCC_FUNCTION_RESOURCES: dict[tuple[str, str], str] = {
     # fn names as the lscc dispatch spells them (chaincode/lscc.py:58-70)
     ("lscc", "getccdata"): LSCC_GET_CC_DATA,
     ("lscc", "getchaincodes"): LSCC_GET_CHAINCODES,
+    # the dispatch's GetChaincodesResult alias of getchaincodes
+    # (chaincode/lscc.py:66) must satisfy the same resource — an
+    # uncataloged alias used to skip the check entirely (ADVICE r5)
+    ("lscc", "GetChaincodesResult"): LSCC_GET_CHAINCODES,
     ("lscc", "getid"): LSCC_CC_EXISTS,
     ("lscc", "getdepspec"): LSCC_GET_DEP_SPEC,
+    ("lscc", "install"): LSCC_INSTALL,
+    ("lscc", "getinstalledchaincodes"): LSCC_GET_INSTALLED_CC,
     # deploy/upgrade: "ACL check covered by PROPOSAL" in the reference
     # (defaultaclprovider.go:69-70) — the channel Writers gate applies
     ("lscc", "deploy"): PEER_PROPOSE,
     ("lscc", "upgrade"): PEER_PROPOSE,
+    ("_lifecycle", "InstallChaincode"): LIFECYCLE_INSTALL,
+    ("_lifecycle", "QueryInstalledChaincodes"): LIFECYCLE_QUERY_INSTALLED,
+    ("_lifecycle", "GetInstalledChaincodePackage"): LIFECYCLE_GET_PACKAGE,
     ("_lifecycle", "ApproveChaincodeDefinitionForMyOrg"): LIFECYCLE_APPROVE,
     ("_lifecycle", "CommitChaincodeDefinition"): LIFECYCLE_COMMIT,
     ("_lifecycle", "CheckCommitReadiness"): LIFECYCLE_CHECK_READINESS,
     ("_lifecycle", "QueryChaincodeDefinition"): LIFECYCLE_QUERY_COMMITTED,
+    ("_lifecycle", "QueryChaincodeDefinitions"): LIFECYCLE_QUERY_COMMITTED_ALL,
 }
 
 SYSTEM_CHAINCODES = frozenset({"qscc", "cscc", "lscc", "_lifecycle"})
 
 
-def resource_for_chaincode(cc_name: str, fn: str) -> str | None:
+def resource_for_chaincode(cc_name: str, fn: str) -> str:
     """Resource an on-channel proposal must satisfy: the per-function
-    SCC resource, peer/Propose for application chaincodes, or None for
-    an SCC function with no catalog entry (the SCC itself rejects or
-    serves unknown functions; the reference likewise only gates
-    cataloged functions)."""
+    SCC resource, or peer/Propose for application chaincodes.
+
+    FAIL-CLOSED: a system-chaincode function with NO catalog entry is
+    denied outright (raises ACLError) instead of skipping the check —
+    the old skip meant any SCC function added without a catalog entry
+    (install, query-installed, a dispatch alias) was world-invocable
+    until someone noticed (ADVICE r5).  The SCC's own unknown-function
+    rejection still covers truly nonexistent names, but names it DOES
+    serve must be cataloged here."""
     if cc_name in SYSTEM_CHAINCODES:
-        return SCC_FUNCTION_RESOURCES.get((cc_name, fn))
+        res = SCC_FUNCTION_RESOURCES.get((cc_name, fn))
+        if res is None:
+            raise ACLError(
+                f"access denied: no ACL catalog entry for system "
+                f"chaincode function {cc_name}/{fn!r}"
+            )
+        return res
     return PEER_PROPOSE
 
 

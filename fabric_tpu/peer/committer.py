@@ -68,7 +68,17 @@ class Committer:
         verify (CSP async), and MVCC+persist (this method's committer
         thread).  Same documented relaxation as validate_pipeline: SBE
         metadata reads for block k+1 may precede block k's commit;
-        depth=1 restores strict adjacency."""
+        depth=1 restores strict adjacency.
+
+        Group commit: the committer thread buffers up to `depth` blocks
+        into one CommitGroup (one shared KV transaction + unsynced
+        block-file appends) and flushes at the group boundary — one
+        fsync + one KV txn for the whole group.  The boundary triggers
+        when `depth` blocks are buffered OR the commit queue drains
+        (so a validator-bound stream still goes durable block by block
+        and adds no latency).  Listener callbacks, dedup-window
+        releases, and yielded flags all wait for the flush: nothing is
+        announced before it is durable."""
         from fabric_tpu import protoutil
 
         pending: collections.deque = collections.deque()
@@ -85,25 +95,61 @@ class Committer:
 
         def commit_loop():
             failed = False
+            group = self._ledger.begin_commit_group()
+            grouped: list = []  # (block, release_txids) awaiting flush
+
+            def announce():
+                # post-flush callbacks run OUTSIDE self._lock (as the
+                # per-block path always did): a listener re-entering
+                # the Committer must not deadlock, and slow listeners
+                # must not serialize against other commit entrypoints
+                for blk, release in grouped:
+                    # the ledger index now durably holds these txids:
+                    # safe to close the validator's in-flight dedup
+                    # window
+                    release()
+                    flags = list(protoutil.tx_filter(blk))
+                    for fn in self._listeners:
+                        fn(blk, flags)
+                    done_q.put(flags)
+                grouped.clear()
+
             while True:
                 item = commit_q.get()
                 if item is None:
+                    if not failed and grouped:
+                        try:
+                            with self._lock:
+                                self._ledger.commit_group_flush(group)
+                            announce()
+                        except Exception as e:
+                            done_q.put(e)
                     return
                 if failed:
                     continue  # drain without committing past a failure
                 blk, release_txids, assist = item
                 try:
+                    flushed = False
                     with self._lock:
-                        self._ledger.commit(blk, assist=assist)
-                    # the ledger index now holds these txids: safe to
-                    # close the validator's in-flight dedup window
-                    release_txids()
-                    flags = list(protoutil.tx_filter(blk))
-                    for fn in self._listeners:
-                        fn(blk, flags)
-                    done_q.put(flags)
-                except Exception as e:  # surfaced to the consumer;
-                    # nothing further commits onto suspect state
+                        self._ledger.commit(blk, assist=assist, group=group)
+                        grouped.append((blk, release_txids))
+                        # boundary_hint: a buffered block carries a
+                        # pending snapshot request — flush HERE so the
+                        # export height is exactly the requested one
+                        if (
+                            len(grouped) >= depth
+                            or commit_q.empty()
+                            or getattr(group, "boundary_hint", False)
+                        ):
+                            self._ledger.commit_group_flush(group)
+                            flushed = True
+                    if flushed:
+                        announce()
+                except Exception as e:  # surfaced to the consumer
+                    # (a raising LISTENER counts too — the thread must
+                    # post the error, never die leaving the consumer
+                    # blocked on done_q); nothing further commits onto
+                    # suspect state
                     failed = True
                     done_q.put(e)
 
@@ -140,7 +186,12 @@ class Committer:
 
     @property
     def height(self) -> int:
-        return self._ledger.height
+        """DURABLE chain height — gossip state transfer keys payload
+        dedup and peer advertisement off this, and a buffered group's
+        blocks are neither readable nor guaranteed to survive (a flush
+        failure rolls them back), so they must not be advertised or
+        used to drop incoming copies."""
+        return getattr(self._ledger, "durable_height", self._ledger.height)
 
 
 __all__ = ["Committer"]

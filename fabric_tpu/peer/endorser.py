@@ -65,9 +65,11 @@ class Endorser:
         lifecycle/scc.go:209 — here at the endorser entry, where the
         SignedProposal is in scope)."""
         fn = up.input.args[0].decode("utf-8", "replace") if up.input.args else ""
-        resource = aclmgmt.resource_for_chaincode(up.chaincode_name, fn)
-        if resource is None:
-            return
+        try:
+            # fail-closed: an uncataloged SCC function raises here
+            resource = aclmgmt.resource_for_chaincode(up.chaincode_name, fn)
+        except aclmgmt.ACLError as exc:
+            raise ACLDeniedError(str(exc)) from exc
         sd = SignedData(
             signed.proposal_bytes,
             up.signature_header.creator,

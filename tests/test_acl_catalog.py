@@ -1,0 +1,60 @@
+"""ACL catalog fail-closed regression (ADVICE r5): an uncataloged
+system-chaincode function must be DENIED, not silently exempted from the
+ACL check, and the lscc/_lifecycle install & query-installed family (and
+the GetChaincodesResult dispatch alias) are cataloged under explicit
+policies.  Pure-unit (no crypto stack): the endorser-level enforcement
+rides resource_for_chaincode raising ACLError."""
+
+import pytest
+
+from fabric_tpu.peer import aclmgmt
+from fabric_tpu.peer.aclmgmt import (
+    ACLError,
+    DEFAULT_POLICIES,
+    SCC_FUNCTION_RESOURCES,
+    resource_for_chaincode,
+)
+
+ADMINS = "/Channel/Application/Admins"
+
+
+def test_uncataloged_scc_function_denied():
+    for cc, fn in (
+        ("qscc", "TotallyMadeUp"),
+        ("lscc", "getchaincodedata-typo"),
+        ("_lifecycle", "NotAFunction"),
+        ("cscc", ""),
+    ):
+        with pytest.raises(ACLError):
+            resource_for_chaincode(cc, fn)
+
+
+def test_application_chaincode_still_propose():
+    assert resource_for_chaincode("mycc", "anything") == aclmgmt.PEER_PROPOSE
+
+
+def test_install_family_cataloged_under_admins():
+    for cc, fn, resource in (
+        ("lscc", "install", aclmgmt.LSCC_INSTALL),
+        ("lscc", "getinstalledchaincodes", aclmgmt.LSCC_GET_INSTALLED_CC),
+        ("_lifecycle", "InstallChaincode", aclmgmt.LIFECYCLE_INSTALL),
+        ("_lifecycle", "QueryInstalledChaincodes",
+         aclmgmt.LIFECYCLE_QUERY_INSTALLED),
+        ("_lifecycle", "GetInstalledChaincodePackage",
+         aclmgmt.LIFECYCLE_GET_PACKAGE),
+    ):
+        assert resource_for_chaincode(cc, fn) == resource
+        assert DEFAULT_POLICIES[resource] == ADMINS
+
+
+def test_getchaincodesresult_alias_matches_getchaincodes():
+    assert (
+        resource_for_chaincode("lscc", "GetChaincodesResult")
+        == resource_for_chaincode("lscc", "getchaincodes")
+        == aclmgmt.LSCC_GET_CHAINCODES
+    )
+
+
+def test_every_cataloged_resource_has_a_default_policy():
+    for resource in SCC_FUNCTION_RESOURCES.values():
+        assert resource in DEFAULT_POLICIES, resource

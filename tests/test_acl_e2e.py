@@ -100,6 +100,18 @@ def test_qscc_function_acl(net):
     assert ch.endorser.process_proposal(sp).response.status == 200
 
 
+def test_uncataloged_scc_function_fails_closed(net):
+    """ADVICE r5 regression: a system-chaincode function with no ACL
+    catalog entry is DENIED at the endorser — even for an admin — not
+    silently exempted from the check."""
+    org, node = net
+    admin = org.signer("fc-admin", role_ou="admin")
+    ch = node.channels["aclch"]
+    sp = _signed_proposal(admin, "aclch", "qscc", [b"NotInTheCatalog"])
+    with pytest.raises(ACLDeniedError, match="no ACL catalog entry"):
+        ch.endorser.process_proposal(sp)
+
+
 def test_lscc_deploy_covered_by_propose(net):
     """lscc deploy/upgrade ride the peer/Propose gate (reference
     defaultaclprovider.go:69-70 'ACL check covered by PROPOSAL'), so

@@ -81,6 +81,36 @@ def test_no_private_material_crosses_the_boundary(daemon):
         csp.sign(local, hashlib.sha256(b"d").digest())
 
 
+def test_unknown_ski_sentinel_vs_transport_failure(daemon):
+    """The local-keystore fallback keys off the daemon's STRUCTURED
+    ERR_UNKNOWN_SKI sentinel, not prose: an unknown SKI falls through to
+    the local keystore, while a transport-ish error whose message merely
+    mentions missing keys PROPAGATES (a daemon outage must never
+    silently demote a signable key to a public one)."""
+    from fabric_tpu.comm.rpc import RPCError
+    from fabric_tpu.csp.custody import ERR_UNKNOWN_SKI
+
+    srv, _ = daemon
+    local = SWCSP()
+    local_key = local.key_gen()
+    csp = CustodyCSP(srv.addr, TOKEN, verify_csp=local)
+    # daemon answers the sentinel for a SKI it does not hold -> the
+    # locally-held key is served
+    assert csp.get_key(local_key.ski()).ski() == local_key.ski()
+    # a reworded/unstructured error must NOT be mistaken for unknown-SKI
+    csp2 = CustodyCSP(srv.addr, TOKEN, verify_csp=local)
+    def _flaky(method, body):
+        raise RPCError("connection reset: daemon has no key material yet")
+    csp2._call = _flaky
+    with pytest.raises(RPCError, match="connection reset"):
+        csp2.get_key(local_key.ski())
+    # a totally unknown SKI surfaces the sentinel code end to end
+    with pytest.raises(KeyError):
+        csp.get_key(b"\x00" * 32)
+    with pytest.raises(RPCError, match=ERR_UNKNOWN_SKI):
+        CustodyCSP(srv.addr, TOKEN)._call("custody.GetKey", b"\x01" * 32)
+
+
 def test_keys_survive_daemon_restart(daemon, tmp_path):
     srv, ksdir = daemon
     csp = CustodyCSP(srv.addr, TOKEN)

@@ -291,6 +291,36 @@ def test_gossip_malformed_handshake_dropped_cleanly():
         b.close()
 
 
+def test_gossip_dialback_bound_to_source_host():
+    """A handshake self-claiming a THIRD-PARTY listen endpoint must get
+    NO dial-back reply path — otherwise every response (state batches
+    especially) becomes reflected traffic at an attacker-chosen target.
+    A claim matching the connection's source host keeps its reply
+    path."""
+    mcs = _ToyMCS()
+    b = TCPGossipComm(("127.0.0.1", 0), b"idB", mcs=mcs)
+    sent: list = []
+    b.send = lambda ep, m: sent.append(ep)  # capture dial-back targets
+    b.subscribe(lambda rm: rm.respond(_data_msg(b"pong")))
+    try:
+        host, port = b.endpoint.rsplit(":", 1)
+        # reflection attempt: endpoint names a host we did NOT connect from
+        s = socket.create_connection((host, int(port)), timeout=3)
+        s.sendall(_handshake(mcs, b"attacker", "203.0.113.9:4444"))
+        s.sendall(_signed_frame(mcs, _data_msg(b"reflect-me")))
+        time.sleep(0.5)
+        assert sent == [], "reply dialed back to an unverified endpoint"
+        s.close()
+        # honest claim: same host as the connection source, any port
+        s2 = socket.create_connection((host, int(port)), timeout=3)
+        s2.sendall(_handshake(mcs, b"honest", "127.0.0.1:65001"))
+        s2.sendall(_signed_frame(mcs, _data_msg(b"ping")))
+        assert _wait(lambda: "127.0.0.1:65001" in sent)
+        s2.close()
+    finally:
+        b.close()
+
+
 def test_gossip_unsigned_message_dropped():
     """A handshaken peer sending a WELL-FORMED but unsigned message must
     not reach subscribers (per-message signatures are mandatory; the

@@ -79,9 +79,11 @@ def test_request_bookkeeping_and_auto_trigger(tmp_path):
         str(tmp_path / "src"), "snapshots", "completed", CHANNEL, "8"
     )
     assert not os.path.isdir(snap_dir)
-    # committing block 8 auto-generates and clears the request
+    # committing block 8 auto-generates (in the background, off the
+    # commit thread) and clears the request
     _commit_blocks(ledger, 8, 1)
     assert mgr.list_pending() == []
+    assert mgr.wait_idle()
     assert os.path.isdir(snap_dir)
     meta = load_metadata(snap_dir)
     assert meta["last_block_number"] == 8
@@ -106,6 +108,7 @@ def test_request_survives_reopen(tmp_path):
     assert ledger2.snapshots.list_pending() == [10]
     _commit_blocks(ledger2, 3, 8)
     assert ledger2.snapshots.list_pending() == []
+    assert ledger2.snapshots.wait_idle()
     assert os.path.isdir(
         os.path.join(
             str(tmp_path / "src"), "snapshots", "completed", CHANNEL, "10"
@@ -383,6 +386,7 @@ def test_snapshot_metrics_wiring(tmp_path):
     exposed = prov.registry.expose()
     assert 'snapshot_pending_requests{channel="snapch"} 1' in exposed
     _commit_blocks(ledger, 5, 5)  # auto-trigger at block 9
+    assert ledger.snapshots.wait_idle()
     exposed = prov.registry.expose()
     assert 'snapshot_pending_requests{channel="snapch"} 0' in exposed
     assert "snapshot_generation_duration_count" in exposed
