@@ -24,11 +24,11 @@ policy) is what the txvalidator's VSCC reads via DefinitionProvider
 
 from __future__ import annotations
 
-import hashlib
 import json
 import os
 
 from fabric_tpu.chaincode.shim import Chaincode, ChaincodeStub, error, success
+from fabric_tpu.common.hashing import sha256 as _sha256
 from fabric_tpu.protos.peer import lifecycle_pb2 as lc
 
 NAMESPACE = "_lifecycle"
@@ -43,7 +43,7 @@ class PackageStore:
 
     @staticmethod
     def package_id(label: str, package_bytes: bytes) -> str:
-        return f"{label}:{hashlib.sha256(package_bytes).hexdigest()}"
+        return f"{label}:{_sha256(package_bytes).hex()}"
 
     def _path(self, package_id: str) -> str:
         # content hash names the file; labels live in the index
@@ -91,7 +91,7 @@ class PackageStore:
 
 
 def _definition_hash(d: lc.ChaincodeDefinition) -> bytes:
-    return hashlib.sha256(d.SerializeToString()).digest()
+    return _sha256(d.SerializeToString())
 
 
 def _approval_key(name: str, sequence: int, mspid: str) -> str:
@@ -157,7 +157,7 @@ class LifecycleSCC(Chaincode):
                         return meta.get("label", "unlabeled")
         except (tarfile.TarError, gzip.BadGzipFile, OSError, ValueError):
             pass
-        return "pkg-" + hashlib.sha256(pkg).hexdigest()[:12]
+        return "pkg-" + _sha256(pkg).hex()[:12]
 
     def _query_installed(self, stub, raw):
         res = lc.QueryInstalledChaincodesResult()

@@ -25,10 +25,10 @@ lscc-backed DeployedChaincodeInfoProvider.
 
 from __future__ import annotations
 
-import hashlib
 import re
 
 from fabric_tpu.chaincode.shim import Chaincode, error, success
+from fabric_tpu.common.hashing import sha256 as _sha256
 from fabric_tpu.protos.peer import chaincode_pb2, query_pb2
 
 NAMESPACE = "lscc"
@@ -114,7 +114,7 @@ class LSCC(Chaincode):
             escc=params[3].decode() if len(params) > 3 and params[3] else "escc",
             vscc=params[4].decode() if len(params) > 4 and params[4] else "vscc",
             policy=bytes(params[2]) if len(params) > 2 else b"",
-            id=hashlib.sha256(params[1]).digest(),
+            id=_sha256(params[1]),
         )
         stub.put_state(name, data.SerializeToString())
         return success(data.SerializeToString())

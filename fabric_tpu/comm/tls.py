@@ -17,10 +17,11 @@ object; CA roots load from memory via `cadata`.
 from __future__ import annotations
 
 import dataclasses
-import hashlib
 import os
 import ssl
 import tempfile
+
+from fabric_tpu.common.hashing import sha256 as _sha256
 
 from cryptography import x509
 from cryptography.hazmat.primitives.serialization import Encoding
@@ -81,7 +82,7 @@ class TLSCredentials:
         """SHA-256 of the DER leaf — the value gossip binds into its
         signed connection handshake (reference gossip/comm/crypto.go:20
         certHashFromRawCert)."""
-        return hashlib.sha256(self.cert_der).digest()
+        return _sha256(self.cert_der)
 
     def server_context(self) -> ssl.SSLContext:
         ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
@@ -148,7 +149,7 @@ def credentials_from_ca(
 
 
 def cert_hash_from_der(der: bytes | None) -> bytes:
-    return hashlib.sha256(der).digest() if der else b""
+    return _sha256(der) if der else b""
 
 
 def credentials_from_files(

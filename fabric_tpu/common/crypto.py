@@ -18,6 +18,16 @@ from cryptography.hazmat.primitives.asymmetric import ec
 from cryptography.x509.oid import ExtendedKeyUsageOID, NameOID
 
 
+# The host-side CSP hash seam lives in common/hashing.py (stdlib-only,
+# so protoutil/ledger/chaincode can import it on hosts without the
+# `cryptography` package); re-exported here for cert-side callers.
+from fabric_tpu.common.hashing import (  # noqa: F401
+    set_hash_backend,
+    sha256,
+    sha256_many,
+)
+
+
 def _name(common_name: str, org: str | None = None, ou: str | None = None) -> x509.Name:
     attrs = [x509.NameAttribute(NameOID.COMMON_NAME, common_name)]
     if org:
@@ -282,6 +292,9 @@ def warn_node_cert_expirations(signer, tls, signer_label: str, warn) -> None:
 
 
 __all__ = [
+    "set_hash_backend",
+    "sha256",
+    "sha256_many",
     "CA",
     "CertKeyPair",
     "cert_expiration",

@@ -18,12 +18,36 @@ import hashlib
 import typing
 from typing import Sequence
 
-from cryptography.hazmat.primitives import serialization
-from cryptography.hazmat.primitives.asymmetric import ec
-from cryptography.hazmat.primitives.asymmetric.utils import (
-    decode_dss_signature,
-    encode_dss_signature,
-)
+# Guarded: the interface types (CSP protocol, VerifyBatchItem) must stay
+# importable on hosts without the `cryptography` package — policy/
+# validation modules import them for type use only.  Key construction
+# and (de)serialization raise at call time instead of import time.
+# ModuleNotFoundError only: a PRESENT-but-broken cryptography install
+# (version mismatch, missing symbol) must surface, not degrade silently.
+try:
+    from cryptography.hazmat.primitives import serialization
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.hazmat.primitives.asymmetric.utils import (
+        decode_dss_signature,
+        encode_dss_signature,
+    )
+except ModuleNotFoundError as _exc:  # pragma: no cover - minimal hosts
+    # Same policy as csp/__init__.py: only cryptography ITSELF missing is
+    # forgivable; a missing transitive dep (cffi) is a broken install.
+    if (_exc.name or "").split(".")[0] != "cryptography":
+        raise
+    serialization = ec = None
+    decode_dss_signature = encode_dss_signature = None
+
+
+def _require_crypto() -> None:
+    """Called at every key-construction/serialization entry point so a
+    minimal host gets an actionable error, not AttributeError on None."""
+    if serialization is None:
+        raise ImportError(
+            "the 'cryptography' package is required for ECDSA key "
+            "construction and (de)serialization but is not installed"
+        )
 
 # ---------------------------------------------------------------------------
 # P-256 domain parameters (NIST FIPS 186-4).
@@ -67,6 +91,7 @@ def _point_ski(x: int, y: int) -> bytes:
 
 class ECDSAP256PublicKey(Key):
     def __init__(self, key: ec.EllipticCurvePublicKey):
+        _require_crypto()
         if not isinstance(key.curve, ec.SECP256R1):
             raise ValueError("only P-256 keys supported")
         self._key = key
@@ -108,22 +133,26 @@ class ECDSAP256PublicKey(Key):
 
     @classmethod
     def from_point(cls, x: int, y: int) -> "ECDSAP256PublicKey":
+        _require_crypto()
         nums = ec.EllipticCurvePublicNumbers(x, y, ec.SECP256R1())
         return cls(nums.public_key())
 
     @classmethod
     def from_der(cls, der: bytes) -> "ECDSAP256PublicKey":
+        _require_crypto()
         key = serialization.load_der_public_key(der)
         return cls(key)
 
     @classmethod
     def from_pem(cls, pem: bytes) -> "ECDSAP256PublicKey":
+        _require_crypto()
         key = serialization.load_pem_public_key(pem)
         return cls(key)
 
 
 class ECDSAP256PrivateKey(Key):
     def __init__(self, key: ec.EllipticCurvePrivateKey):
+        _require_crypto()
         if not isinstance(key.curve, ec.SECP256R1):
             raise ValueError("only P-256 keys supported")
         self._key = key
@@ -152,14 +181,17 @@ class ECDSAP256PrivateKey(Key):
 
     @classmethod
     def generate(cls) -> "ECDSAP256PrivateKey":
+        _require_crypto()
         return cls(ec.generate_private_key(ec.SECP256R1()))
 
     @classmethod
     def from_der(cls, der: bytes) -> "ECDSAP256PrivateKey":
+        _require_crypto()
         return cls(serialization.load_der_private_key(der, password=None))
 
     @classmethod
     def from_pem(cls, pem: bytes) -> "ECDSAP256PrivateKey":
+        _require_crypto()
         return cls(serialization.load_pem_private_key(pem, password=None))
 
 
@@ -172,12 +204,14 @@ class ECDSAP256PrivateKey(Key):
 
 
 def marshal_ecdsa_signature(r: int, s: int) -> bytes:
+    _require_crypto()
     return encode_dss_signature(r, s)
 
 
 def unmarshal_ecdsa_signature(sig: bytes) -> tuple[int, int]:
     """DER-decode a signature. Raises ValueError on malformed input or
     non-positive r/s (reference bccsp/utils/ecdsa.go:47-62)."""
+    _require_crypto()
     try:
         r, s = decode_dss_signature(sig)
     except Exception as exc:  # asn1 errors vary by backend

@@ -17,6 +17,21 @@ _lock = threading.Lock()
 _default: Optional[CSP] = None
 
 
+def _install_default(csp: CSP) -> CSP:
+    """Record the process default AND hand it to the common.crypto hash
+    seam, so seam-routed call sites (protoutil block hashing, snapshot
+    digests, …) ride the same provider as block validation.  The seam's
+    SHA-256 equivalence probe runs FIRST: a provider it rejects must not
+    be left installed as the default, or direct get_default() users
+    would hash through the very backend the probe refused."""
+    global _default
+    from fabric_tpu.common import hashing as _hashing
+
+    _hashing.set_hash_backend(csp)
+    _default = csp
+    return csp
+
+
 def init_factories(provider: str = "sw", force: bool = False, **kwargs) -> CSP:
     """Initialize the process default CSP.
 
@@ -25,21 +40,30 @@ def init_factories(provider: str = "sw", force: bool = False, **kwargs) -> CSP:
     default — replacing the default would orphan keys already stored in the
     previous provider's keystore. Pass force=True to replace anyway (tests).
     """
-    global _default
     with _lock:
         if _default is None or force:
-            _default = _new_csp(provider, **kwargs)
+            _install_default(_new_csp(provider, **kwargs))
         return _default
 
 
 def get_default() -> CSP:
     """Reference bccsp/factory/factory.go:42-62: lazily bootstraps a sw
     provider when nothing was configured."""
-    global _default
     with _lock:
         if _default is None:
-            _default = SWCSP()
+            _install_default(SWCSP())
         return _default
+
+
+def _maybe_install(csp: CSP) -> CSP:
+    """First configured CSP becomes the process default (and the hash
+    seam backend) unless one was already installed — config-built nodes
+    must not leave the seam on the hashlib fallback while validating
+    through a batched provider."""
+    with _lock:
+        if _default is None:
+            _install_default(csp)
+    return csp
 
 
 def _new_csp(provider: str, **kwargs) -> CSP:
@@ -98,7 +122,7 @@ def csp_from_config(cfg, prefix: str = "bccsp") -> CSP:
     if provider == "tpu":
         from fabric_tpu.csp.tpu.provider import TPUCSP
 
-        return TPUCSP(sw=sw, **_tpu_kwargs(cfg, prefix))
+        return _maybe_install(TPUCSP(sw=sw, **_tpu_kwargs(cfg, prefix)))
     if provider == "custody":
         # bccsp.custody: {endpoint: host:port, tokenFile: path,
         # verify: SW|TPU, tls: {certFile, keyFile, caFiles: [...]}} —
@@ -140,10 +164,10 @@ def csp_from_config(cfg, prefix: str = "bccsp") -> CSP:
             from fabric_tpu.csp.tpu.provider import TPUCSP
 
             verify = TPUCSP(sw=sw, **_tpu_kwargs(cfg, prefix))
-        return CustodyCSP(
+        return _maybe_install(CustodyCSP(
             parse_endpoint(str(endpoint)),
             load_token(str(token_file)),
             verify_csp=verify,
             tls=tls,
-        )
-    return sw
+        ))
+    return _maybe_install(sw)

@@ -1,0 +1,15 @@
+"""Developer-facing correctness tooling.
+
+Two parts, both self-gated in tier-1 (tests/test_lint_clean.py):
+
+- fabriclint (devtools/lint.py): an ast-based static pass enforcing the
+  domain invariants reviewer memory cannot — crypto routed through the
+  CSP seam, no silent exception swallows on validation paths, no
+  nondeterminism where peers must agree, lock discipline on the commit
+  path, no host syncs inside per-item device loops.
+
+- lock-order watchdog (devtools/lockwatch.py): an instrumented lock
+  wrapper recording the runtime acquisition-order graph across the
+  commit lock, snapshot manager, and gossip locks; cycles raise under
+  tests (FABRIC_TPU_LOCKWATCH=1, set by tests/conftest.py).
+"""

@@ -1,0 +1,859 @@
+"""fabriclint — domain-aware AST invariant checker.
+
+The north star routes ALL block-validation crypto through the pluggable
+CSP seam so it can batch onto TPU, and PR 2 made lock/fsync discipline
+in the commit path load-bearing.  Those invariants are enforced here by
+machine, not reviewer memory: tier-1 runs this linter over the whole
+tree (tests/test_lint_clean.py) and fails on any unsuppressed violation.
+
+Rules
+-----
+csp-seam
+    No direct ``hashlib`` use outside ``fabric_tpu/csp/`` and
+    ``fabric_tpu/common/crypto.py``.  Everything else must call the CSP
+    hash seam (``common.hashing.sha256``/``sha256_many`` or a CSP's
+    ``hash``/``hash_batch``) so new call sites stay visible to the
+    TPU-batched provider — or carry a reviewed pragma.
+
+exception-discipline
+    No ``except Exception`` (or bare ``except``) in ``peer/``,
+    ``policies/``, ``ledger/`` whose handler swallows without a
+    structured sentinel: a handler consisting only of
+    ``pass``/``continue``/``break``/trivial-constant ``return`` hides
+    failures on the validation path (the ``ERR_UNKNOWN_SKI`` direction
+    from the custody work).  Re-raising, assigning a sentinel, calling a
+    logger, or returning a named error code all count as structured.
+
+determinism
+    In validation/commit/policy paths where peers must agree (``peer/``,
+    ``policies/``, ``ledger/``, ``protoutil/``): ban ``time.time()``,
+    ``datetime.now()``/``utcnow()``, module-level ``random.*`` calls
+    (an injected seeded ``random.Random`` instance is fine), and
+    ``json.dumps`` without ``sort_keys=True`` (dict-order-dependent
+    serialization).
+
+lock-discipline
+    (a) a bare ``x.acquire()`` expression statement outside a
+    try/finally that releases (``__enter__`` methods are exempt — their
+    release lives in ``__exit__``); (b) lexically nested ``with`` lock
+    acquisitions that inverse the canonical order
+    ``commit_lock -> manager _lock -> _idle``; (c) blocking I/O (fsync,
+    sqlite txn flush/execute, sleep) — directly or through a same-class
+    helper method — while lexically holding ``commit_lock``, outside the
+    approved group-commit seam (allowlisted, with reasons).
+
+jax-hygiene
+    No host synchronization (``block_until_ready``, ``device_get``)
+    inside per-item ``for``/``while`` loops: batch paths must make ONE
+    device round-trip per batch, not one per item.
+
+Suppression
+-----------
+Inline pragma: a ``fabriclint: allow[<rule>] <reason>`` comment on the
+offending line, or in the contiguous comment block immediately above it,
+or in the comment block opening the flagged statement's body (so an
+``except Exception:`` can carry its pragma inside the handler, where the
+explanation reads naturally).  Only real comments count — pragma-shaped
+text inside strings and docstrings (like the example in this one) is
+ignored.
+
+A pragma MUST carry a non-empty reason and MUST suppress something —
+reason-less and unused pragmas are violations themselves.  Cross-file
+entries live in ``fabric_tpu/devtools/allowlist.py``; unused entries are
+violations too, so the allowlist can only shrink as code is fixed.
+
+CLI
+---
+``python -m fabric_tpu.devtools.lint [--json] [targets...]`` — exits
+non-zero on any unsuppressed violation; ``--json`` emits one JSON object
+per violation plus a final machine-readable summary line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import dataclasses
+import io
+import json
+import os
+import re
+import sys
+import tokenize
+
+RULES = (
+    "csp-seam",
+    "exception-discipline",
+    "determinism",
+    "lock-discipline",
+    "jax-hygiene",
+)
+
+# meta rules: problems with the suppression machinery itself; never
+# themselves suppressible
+META_RULES = ("pragma", "allowlist")
+
+PRAGMA_RE = re.compile(
+    r"#\s*fabriclint:\s*allow\[([a-z, -]+)\]\s*(.*?)\s*$"
+)
+
+# -- scopes ------------------------------------------------------------------
+
+# modules allowed to touch hashlib directly: the CSP providers (they ARE
+# the seam) and the seam's own stdlib-only host side (re-exported by
+# common/crypto.py for cert-side callers)
+CSP_SEAM_ALLOWED = (
+    "fabric_tpu/csp/",
+    "fabric_tpu/common/hashing.py",
+    "fabric_tpu/common/crypto.py",
+)
+
+EXC_SCOPE = (
+    "fabric_tpu/peer/",
+    "fabric_tpu/policies/",
+    "fabric_tpu/ledger/",
+)
+
+DET_SCOPE = EXC_SCOPE + ("fabric_tpu/protoutil/",)
+
+# generated code is exempt from everything
+SKIP_PREFIXES = ("fabric_tpu/protos/",)
+
+LOCK_RANKS = {
+    # canonical acquisition order: commit lock strictly before any
+    # manager/bookkeeping lock, which come before condition helpers
+    "commit_lock": 0,
+    "_commit_lock": 0,
+    "_lock": 1,
+    "_idle": 2,
+}
+
+COMMIT_LOCK_NAMES = ("commit_lock", "_commit_lock")
+
+BLOCKING_CALLS = frozenset(
+    {"fsync", "sync_files", "sleep", "flush", "execute", "executemany"}
+)
+
+JAX_SYNC_CALLS = frozenset({"block_until_ready", "device_get"})
+
+
+@dataclasses.dataclass
+class Violation:
+    rule: str
+    path: str
+    line: int
+    message: str
+    suppressed: bool = False
+    suppression: str | None = None  # "pragma: <reason>" / "allowlist: <reason>"
+
+    def __str__(self) -> str:
+        tag = f" (suppressed: {self.suppression})" if self.suppressed else ""
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}{tag}"
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass(frozen=True)
+class AllowEntry:
+    """One reviewed cross-file suppression.  `match` must be a substring
+    of the flagged source line, so entries survive line-number drift but
+    die (as unused-entry violations) when the code they covered goes
+    away."""
+
+    rule: str
+    path: str
+    match: str
+    reason: str
+
+
+# -- per-module pre-pass: which class methods (transitively) block -----------
+
+
+def _method_blocking_map(tree: ast.Module) -> dict[str, set[str]]:
+    """class name -> names of its methods that perform a blocking call
+    directly or through other methods of the same class (fixpoint over
+    ``self.x()`` edges)."""
+    out: dict[str, set[str]] = {}
+    for cls in ast.walk(tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        direct: set[str] = set()
+        calls: dict[str, set[str]] = {}
+        for fn in cls.body:
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            calls[fn.name] = set()
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                f = node.func
+                if isinstance(f, ast.Attribute):
+                    if f.attr in BLOCKING_CALLS:
+                        direct.add(fn.name)
+                    if (
+                        isinstance(f.value, ast.Name)
+                        and f.value.id == "self"
+                    ):
+                        calls[fn.name].add(f.attr)
+        blocking = set(direct)
+        changed = True
+        while changed:
+            changed = False
+            for name, callees in calls.items():
+                if name not in blocking and callees & blocking:
+                    blocking.add(name)
+                    changed = True
+        out[cls.name] = blocking
+    return out
+
+
+# -- the checker -------------------------------------------------------------
+
+
+def _in_scope(rel: str, prefixes) -> bool:
+    return any(rel.startswith(p) for p in prefixes)
+
+
+def _is_trivial_return_value(v) -> bool:
+    """True for values whose return carries no information: None,
+    constants, tuples of constants, and empty containers."""
+    if v is None or isinstance(v, ast.Constant):
+        return True
+    if isinstance(v, ast.Tuple):
+        return all(isinstance(e, ast.Constant) for e in v.elts)
+    if isinstance(v, (ast.List, ast.Set)):
+        return not v.elts
+    if isinstance(v, ast.Dict):
+        return not v.keys
+    return False
+
+
+def _swallows(handler: ast.ExceptHandler) -> bool:
+    if any(isinstance(n, ast.Raise) for n in ast.walk(handler)):
+        return False
+    for stmt in handler.body:
+        if isinstance(stmt, (ast.Pass, ast.Continue, ast.Break)):
+            continue
+        if isinstance(stmt, ast.Return) and _is_trivial_return_value(
+            stmt.value
+        ):
+            continue
+        if isinstance(stmt, ast.Expr) and isinstance(
+            stmt.value, ast.Constant
+        ):
+            continue  # docstring / ellipsis
+        return False
+    return True
+
+
+def _catches_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    names = []
+    if isinstance(t, ast.Name):
+        names = [t.id]
+    elif isinstance(t, ast.Tuple):
+        names = [e.id for e in t.elts if isinstance(e, ast.Name)]
+    return any(n in ("Exception", "BaseException") for n in names)
+
+
+def _lock_name(expr) -> str | None:
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    if isinstance(expr, ast.Name):
+        return expr.id
+    return None
+
+
+def _finally_releases(node: ast.Try) -> bool:
+    return any(
+        isinstance(n, ast.Call)
+        and isinstance(n.func, ast.Attribute)
+        and n.func.attr == "release"
+        for stmt in node.finalbody
+        for n in ast.walk(stmt)
+    )
+
+
+def _acquires_before_try_finally(tree: ast.Module) -> set[int]:
+    """Node ids of `x.acquire()` statements whose immediately-following
+    sibling is a try whose finally releases — the canonical safe idiom
+    (acquire OUTSIDE the try: a failed acquire must not reach the
+    finally and release a lock it never took)."""
+    ok: set[int] = set()
+    for node in ast.walk(tree):
+        for field in ("body", "orelse", "finalbody"):
+            stmts = getattr(node, field, None)
+            if not isinstance(stmts, list):
+                continue
+            for a, b in zip(stmts, stmts[1:]):
+                if (
+                    isinstance(a, ast.Expr)
+                    and isinstance(a.value, ast.Call)
+                    and isinstance(a.value.func, ast.Attribute)
+                    and a.value.func.attr == "acquire"
+                    and isinstance(b, ast.Try)
+                    and _finally_releases(b)
+                ):
+                    ok.add(id(a))
+    return ok
+
+
+def _dotted_name(expr) -> str | None:
+    """`a.b.c` as the string "a.b.c"; None for non-Name/Attribute chains."""
+    parts = []
+    while isinstance(expr, ast.Attribute):
+        parts.append(expr.attr)
+        expr = expr.value
+    if not isinstance(expr, ast.Name):
+        return None
+    parts.append(expr.id)
+    return ".".join(reversed(parts))
+
+
+class _FileChecker(ast.NodeVisitor):
+    def __init__(self, rel: str, tree: ast.Module):
+        self.rel = rel
+        self.violations: list[Violation] = []
+        self._seen: set[tuple[str, int]] = set()
+        self._hashlib_aliases: set[str] = set()
+        self._time_fn_aliases: set[str] = set()
+        self._random_fn_aliases: set[str] = set()
+        self._datetime_aliases: set[str] = {"datetime", "date"}
+        self._func_stack: list[str] = []
+        self._class_stack: list[str] = []
+        self._with_locks: list[str] = []
+        self._loop_depth = 0
+        self._protected_depth = 0  # inside a try whose finally releases
+        self._blocking = _method_blocking_map(tree)
+        self._preacquire_ok = _acquires_before_try_finally(tree)
+
+    # -- helpers -----------------------------------------------------------
+
+    def _flag(self, rule: str, node, message: str) -> None:
+        key = (rule, node.lineno)
+        if key in self._seen:
+            return
+        self._seen.add(key)
+        self.violations.append(
+            Violation(rule=rule, path=self.rel, line=node.lineno,
+                      message=message)
+        )
+
+    # -- imports (csp-seam alias tracking) ---------------------------------
+
+    def visit_Import(self, node: ast.Import) -> None:
+        for alias in node.names:
+            if alias.name == "hashlib":
+                self._hashlib_aliases.add(alias.asname or "hashlib")
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        if node.module == "hashlib" and not _in_scope(
+            self.rel, CSP_SEAM_ALLOWED
+        ):
+            self._flag(
+                "csp-seam", node,
+                "from-import of hashlib outside the CSP seam "
+                "(route through common.hashing.sha256/sha256_many or a "
+                "CSP hash/hash_batch)",
+            )
+        if node.module == "time":
+            for alias in node.names:
+                if alias.name == "time":
+                    self._time_fn_aliases.add(alias.asname or "time")
+        if node.module == "random":
+            # module-level functions share the hidden global Random();
+            # the class constructors are fine (callers seed their own)
+            for alias in node.names:
+                if alias.name not in ("Random", "SystemRandom"):
+                    self._random_fn_aliases.add(alias.asname or alias.name)
+        if node.module == "datetime":
+            for alias in node.names:
+                if alias.name in ("datetime", "date"):
+                    self._datetime_aliases.add(alias.asname or alias.name)
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if (
+            isinstance(node.value, ast.Name)
+            and node.value.id in self._hashlib_aliases
+            and not _in_scope(self.rel, CSP_SEAM_ALLOWED)
+        ):
+            self._flag(
+                "csp-seam", node,
+                f"direct hashlib.{node.attr} outside the CSP seam — "
+                "invisible to hash_batch/TPU batching (route through "
+                "common.hashing.sha256/sha256_many or the CSP)",
+            )
+        self.generic_visit(node)
+
+    # -- exception discipline ----------------------------------------------
+
+    def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
+        if (
+            _in_scope(self.rel, EXC_SCOPE)
+            and _catches_broad(node)
+            and _swallows(node)
+        ):
+            self._flag(
+                "exception-discipline", node,
+                "broad except swallows without a structured sentinel, "
+                "re-raise, or logged reason",
+            )
+        self.generic_visit(node)
+
+    # -- calls: determinism + lock blocking + jax hygiene -------------------
+
+    def visit_Call(self, node: ast.Call) -> None:
+        f = node.func
+        attr = f.attr if isinstance(f, ast.Attribute) else None
+        # full dotted base so `datetime.datetime.now()` resolves — a
+        # Name-only base would see None and let the qualified spelling
+        # through the gate
+        base = (
+            _dotted_name(f.value) if isinstance(f, ast.Attribute) else None
+        )
+        base_tail = base.rsplit(".", 1)[-1] if base else None
+
+        if _in_scope(self.rel, DET_SCOPE):
+            if (base == "time" and attr == "time") or (
+                isinstance(f, ast.Name) and f.id in self._time_fn_aliases
+            ):
+                self._flag(
+                    "determinism", node,
+                    "time.time() on a consensus path — wall-clock "
+                    "differs across peers (use an explicit timestamp "
+                    "argument, or time.monotonic/perf_counter for "
+                    "intervals)",
+                )
+            elif (
+                attr in ("now", "utcnow", "today")
+                and base_tail in self._datetime_aliases
+            ):
+                self._flag(
+                    "determinism", node,
+                    f"datetime.{attr}() on a consensus path",
+                )
+            elif (base == "random" and attr not in ("Random", "SystemRandom")
+                  ) or (
+                isinstance(f, ast.Name) and f.id in self._random_fn_aliases
+            ):
+                name = attr if attr is not None else f.id
+                self._flag(
+                    "determinism", node,
+                    f"module-level random.{name}() on a consensus path "
+                    "(inject a seeded random.Random instead)",
+                )
+            elif base == "json" and attr == "dumps":
+                sorted_kw = any(
+                    kw.arg == "sort_keys"
+                    and isinstance(kw.value, ast.Constant)
+                    and kw.value.value is True
+                    for kw in node.keywords
+                )
+                if not sorted_kw:
+                    self._flag(
+                        "determinism", node,
+                        "json.dumps without sort_keys=True on a "
+                        "consensus path — dict order leaks into bytes",
+                    )
+
+        if attr is not None and any(
+            n in COMMIT_LOCK_NAMES for n in self._with_locks
+        ):
+            cls = self._class_stack[-1] if self._class_stack else None
+            if attr in BLOCKING_CALLS:
+                self._flag(
+                    "lock-discipline", node,
+                    f"blocking call .{attr}() while holding the commit "
+                    "lock, outside the approved group-commit seam",
+                )
+            elif (
+                base == "self"
+                and cls is not None
+                and attr in self._blocking.get(cls, ())
+            ):
+                self._flag(
+                    "lock-discipline", node,
+                    f"self.{attr}() performs blocking I/O (transitively) "
+                    "while holding the commit lock, outside the approved "
+                    "group-commit seam",
+                )
+
+        if attr in JAX_SYNC_CALLS and self._loop_depth > 0:
+            self._flag(
+                "jax-hygiene", node,
+                f".{attr}() inside a per-item loop — host sync per "
+                "item serializes the device; sync once per batch",
+            )
+
+        self.generic_visit(node)
+
+    # -- lock discipline: bare acquire + with-order -------------------------
+
+    def visit_Try(self, node: ast.Try) -> None:
+        if _finally_releases(node):
+            self._protected_depth += 1
+            for stmt in node.body + node.orelse:
+                self.visit(stmt)
+            self._protected_depth -= 1
+            for h in node.handlers:
+                self.visit(h)
+            for stmt in node.finalbody:
+                self.visit(stmt)
+        else:
+            self.generic_visit(node)
+
+    def visit_Expr(self, node: ast.Expr) -> None:
+        v = node.value
+        if (
+            isinstance(v, ast.Call)
+            and isinstance(v.func, ast.Attribute)
+            and v.func.attr == "acquire"
+            and self._protected_depth == 0
+            and id(node) not in self._preacquire_ok
+            and (not self._func_stack or self._func_stack[-1] != "__enter__")
+        ):
+            self._flag(
+                "lock-discipline", node,
+                "bare .acquire() without try/finally release "
+                "(use `with`, or release in a finally)",
+            )
+        self.generic_visit(node)
+
+    def visit_With(self, node: ast.With) -> None:
+        names = []
+        for item in node.items:
+            n = _lock_name(item.context_expr)
+            if n is not None and n in LOCK_RANKS:
+                for outer in self._with_locks:
+                    if LOCK_RANKS[n] < LOCK_RANKS[outer]:
+                        self._flag(
+                            "lock-discipline", node,
+                            f"lock-order inversion: {n!r} (rank "
+                            f"{LOCK_RANKS[n]}) acquired while holding "
+                            f"{outer!r} (rank {LOCK_RANKS[outer]}); "
+                            f"canonical order is commit_lock -> _lock "
+                            f"-> _idle",
+                        )
+                names.append(n)
+                self._with_locks.append(n)
+        for item in node.items:
+            self.visit(item)
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in names:
+            self._with_locks.pop()
+
+    # -- structure tracking -------------------------------------------------
+
+    def visit_FunctionDef(self, node) -> None:
+        self._func_stack.append(node.name)
+        self.generic_visit(node)
+        self._func_stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self._class_stack.append(node.name)
+        self.generic_visit(node)
+        self._class_stack.pop()
+
+    def visit_For(self, node) -> None:
+        self._loop_depth += 1
+        self.generic_visit(node)
+        self._loop_depth -= 1
+
+    visit_AsyncFor = visit_For
+    visit_While = visit_For
+
+
+# -- suppression -------------------------------------------------------------
+
+
+def _parse_pragmas(source: str, rel: str):
+    """Tokenize-based pragma scan: only REAL comment tokens count, so
+    pragma-shaped text inside strings/docstrings never registers.
+
+    Returns (pragmas, comment_only, meta) where `pragmas` maps line
+    number -> (rules, reason), `comment_only` is the set of lines whose
+    sole content is a comment (used to associate a pragma with the
+    statement its comment block annotates), and `meta` lists violations
+    for malformed pragmas (unknown rule, missing reason)."""
+    pragmas: dict[int, tuple[set[str], str]] = {}
+    comment_only: set[int] = set()
+    meta: list[Violation] = []
+    try:
+        tokens = list(
+            tokenize.generate_tokens(io.StringIO(source).readline)
+        )
+    except (tokenize.TokenError, IndentationError):
+        tokens = []
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        i = tok.start[0]
+        if not tok.line[: tok.start[1]].strip():
+            comment_only.add(i)
+        m = PRAGMA_RE.search(tok.string)
+        if not m:
+            continue
+        rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+        reason = m.group(2).strip()
+        unknown = rules - set(RULES)
+        if unknown:
+            meta.append(Violation(
+                rule="pragma", path=rel, line=i,
+                message=f"pragma names unknown rule(s): "
+                        f"{', '.join(sorted(unknown))}",
+            ))
+        if not reason:
+            meta.append(Violation(
+                rule="pragma", path=rel, line=i,
+                message="pragma without a reason — every suppression "
+                        "must say why it was reviewed",
+            ))
+        pragmas[i] = (rules, reason)
+    return pragmas, comment_only, meta
+
+
+def _pragma_candidate_lines(line: int, comment_only: set[int],
+                            lines: list[str]):
+    """Lines whose pragma may suppress a violation on `line`: the line
+    itself (trailing comment), the contiguous comment-only block
+    immediately above it (comments wrap; the pragma may sit a couple of
+    lines up), and — ONLY when the flagged line opens a block (``except
+    Exception:``) — the comment block at the top of that block's body.
+    The body scan requires deeper indentation than the opener so a
+    pragma written for the NEXT statement at the same level never leaks
+    upward onto a neighboring, unreviewed violation."""
+    yield line
+    ln = line - 1
+    while ln >= 1 and ln in comment_only:
+        yield ln
+        ln -= 1
+    src = lines[line - 1] if 0 < line <= len(lines) else ""
+    if src.split("#", 1)[0].rstrip().endswith(":"):
+        opener_indent = len(src) - len(src.lstrip())
+        ln = line + 1
+        while ln <= len(lines) and ln in comment_only:
+            body = lines[ln - 1]
+            if len(body) - len(body.lstrip()) <= opener_indent:
+                break
+            yield ln
+            ln += 1
+
+
+def _apply_suppressions(
+    violations: list[Violation],
+    pragmas: dict[int, tuple[set[str], str]],
+    comment_only: set[int],
+    lines: list[str],
+    allowlist: list[AllowEntry],
+    used_entries: set[int],
+) -> set[int]:
+    """Mark violations suppressed in place; returns used pragma lines."""
+    used_pragmas: set[int] = set()
+    for v in violations:
+        for ln in _pragma_candidate_lines(v.line, comment_only, lines):
+            p = pragmas.get(ln)
+            if p and v.rule in p[0]:
+                v.suppressed = True
+                v.suppression = f"pragma: {p[1]}"
+                used_pragmas.add(ln)
+                break
+        if v.suppressed:
+            continue
+        src = lines[v.line - 1] if 0 < v.line <= len(lines) else ""
+        for idx, e in enumerate(allowlist):
+            if e.rule == v.rule and e.path == v.path and e.match in src:
+                v.suppressed = True
+                v.suppression = f"allowlist: {e.reason}"
+                used_entries.add(idx)
+                break
+    return used_pragmas
+
+
+# -- drivers -----------------------------------------------------------------
+
+
+def lint_source(
+    source: str,
+    rel: str,
+    allowlist: list[AllowEntry] | None = None,
+    used_entries: set[int] | None = None,
+) -> list[Violation]:
+    """Lint one module's source as if it lived at repo-relative `rel`."""
+    allowlist = allowlist if allowlist is not None else []
+    used_entries = used_entries if used_entries is not None else set()
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as exc:
+        return [Violation(
+            rule="pragma", path=rel, line=exc.lineno or 0,
+            message=f"file does not parse: {exc.msg}",
+        )]
+    lines = source.splitlines()
+    pragmas, comment_only, meta = _parse_pragmas(source, rel)
+    checker = _FileChecker(rel, tree)
+    checker.visit(tree)
+    violations = checker.violations
+    used_pragmas = _apply_suppressions(
+        violations, pragmas, comment_only, lines, allowlist, used_entries
+    )
+    for ln in sorted(set(pragmas) - used_pragmas):
+        meta.append(Violation(
+            rule="pragma", path=rel, line=ln,
+            message="unused pragma — it suppresses nothing; remove it "
+                    "(or it is masking a rule that moved)",
+        ))
+    return violations + meta
+
+
+def repo_root() -> str:
+    return os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+
+
+def iter_target_files(root: str, targets) -> list[str]:
+    rels: list[str] = []
+    for target in targets:
+        abs_t = os.path.join(root, target)
+        if os.path.isfile(abs_t):
+            rels.append(target.replace(os.sep, "/"))
+            continue
+        # a typo'd / renamed target must not silently report "clean"
+        if not os.path.isdir(abs_t):
+            raise FileNotFoundError(
+                f"lint target {target!r} matches no file or directory "
+                f"under {root}"
+            )
+        before = len(rels)
+        for dirpath, dirnames, filenames in os.walk(abs_t):
+            dirnames[:] = [
+                d for d in sorted(dirnames) if d != "__pycache__"
+            ]
+            for fn in sorted(filenames):
+                if not fn.endswith(".py"):
+                    continue
+                rel = os.path.relpath(
+                    os.path.join(dirpath, fn), root
+                ).replace(os.sep, "/")
+                if not _in_scope(rel, SKIP_PREFIXES):
+                    rels.append(rel)
+        if len(rels) == before:
+            raise FileNotFoundError(
+                f"lint target {target!r} contains no lintable .py files"
+            )
+    return rels
+
+
+@dataclasses.dataclass
+class LintReport:
+    files: int
+    violations: list[Violation]
+
+    @property
+    def unsuppressed(self) -> list[Violation]:
+        return [v for v in self.violations if not v.suppressed]
+
+    @property
+    def suppressed(self) -> list[Violation]:
+        return [v for v in self.violations if v.suppressed]
+
+    def summary(self) -> dict:
+        by_rule: dict[str, int] = {}
+        for v in self.unsuppressed:
+            by_rule[v.rule] = by_rule.get(v.rule, 0) + 1
+        return {
+            "tool": "fabriclint",
+            "files": self.files,
+            "violations": len(self.unsuppressed),
+            "suppressed": len(self.suppressed),
+            "by_rule": dict(sorted(by_rule.items())),
+            "clean": not self.unsuppressed,
+        }
+
+
+def lint_tree(
+    root: str | None = None,
+    targets=("fabric_tpu",),
+    allowlist: list[AllowEntry] | None = None,
+) -> LintReport:
+    root = root or repo_root()
+    if allowlist is None:
+        from fabric_tpu.devtools.allowlist import ALLOWLIST
+
+        allowlist = list(ALLOWLIST)
+    used_entries: set[int] = set()
+    violations: list[Violation] = []
+    rels = iter_target_files(root, targets)
+    for rel in rels:
+        with open(os.path.join(root, rel), "r", encoding="utf-8") as f:
+            source = f.read()
+        violations.extend(
+            lint_source(source, rel, allowlist, used_entries)
+        )
+    # an entry is in this run's scope if its file was linted, or if it
+    # falls under a directory target (so full-tree runs flag entries
+    # whose file was DELETED, while partial runs — one file, one subdir —
+    # don't false-positive on entries they never had a chance to use)
+    linted = set(rels)
+    dir_prefixes = tuple(
+        t.rstrip("/") + "/" for t in targets
+        if not os.path.isfile(os.path.join(root, t))
+    )
+    for idx, e in enumerate(allowlist):
+        in_scope = e.path in linted or e.path.startswith(dir_prefixes)
+        if idx not in used_entries and in_scope:
+            violations.append(Violation(
+                rule="allowlist",
+                path="fabric_tpu/devtools/allowlist.py",
+                line=0,
+                message=f"unused allowlist entry ({e.rule} @ {e.path} "
+                        f"matching {e.match!r}) — the code it covered "
+                        f"is gone; remove the entry",
+            ))
+    return LintReport(files=len(rels), violations=violations)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m fabric_tpu.devtools.lint",
+        description="fabriclint: AST invariant checker for fabric_tpu",
+    )
+    ap.add_argument(
+        "targets", nargs="*", default=["fabric_tpu"],
+        help="repo-relative files/dirs to lint (default: fabric_tpu)",
+    )
+    ap.add_argument("--root", default=None, help="repo root override")
+    ap.add_argument(
+        "--json", action="store_true",
+        help="one JSON object per violation + a JSON summary line",
+    )
+    ap.add_argument(
+        "--show-suppressed", action="store_true",
+        help="also print suppressed violations",
+    )
+    args = ap.parse_args(argv)
+
+    try:
+        report = lint_tree(root=args.root, targets=tuple(args.targets))
+    except FileNotFoundError as exc:
+        print(json.dumps({"tool": "fabriclint", "error": str(exc)})
+              if args.json else f"fabriclint: error: {exc}",
+              file=sys.stderr)
+        return 2
+    shown = report.violations if args.show_suppressed else report.unsuppressed
+    for v in shown:
+        print(json.dumps(v.to_dict()) if args.json else str(v))
+    print(json.dumps(report.summary()))
+    return 0 if not report.unsuppressed else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

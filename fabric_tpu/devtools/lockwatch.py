@@ -1,0 +1,259 @@
+"""Runtime lock-order watchdog.
+
+fabriclint's static lock-order rule only sees LEXICALLY nested `with`
+blocks; real inversions usually span call chains (commit thread holds
+``commit_lock`` and enters the snapshot manager, an RPC thread holds the
+manager lock and enters the ledger).  This module closes that gap at
+runtime: production code creates its coordination locks through
+``named_lock``/``named_rlock``, which return plain ``threading`` locks
+normally (zero overhead) and instrumented wrappers when
+``FABRIC_TPU_LOCKWATCH`` is set (tests/conftest.py sets it, so the whole
+tier-1 suite doubles as a lock-order soak test).
+
+The wrapper maintains a process-wide acquisition-order graph over lock
+ROLES (names, not instances): acquiring B while holding A records the
+edge ``A -> B``; if a path ``B -> ... -> A`` already exists, the
+acquisition is a deadlock-capable inversion — it is recorded in
+``violations`` and raised as ``LockOrderError``.  Mode ``record``
+suppresses the raise and only observes: it deliberately does NOT
+perturb program behavior, so a genuinely live contended inversion will
+still deadlock there (the violation is in ``violations`` for a
+debugger/core dump; use the default raise mode to unwedge).  Re-entrant
+acquisition of the same lock object is fine (RLock semantics); two
+INSTANCES sharing a role name are not ordered against each other (a
+documented approximation — role-level cycles are the deadlocks that
+have bitten this codebase).  Cross-thread release of a watched plain
+Lock (handoff patterns) is unsupported: it raises in the default mode
+so the held-stack bookkeeping can never silently rot; record mode logs
+it and performs the handoff unperturbed.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+_ENV = "FABRIC_TPU_LOCKWATCH"
+
+# guards the graph + violations; a plain lock that is itself never
+# watched, held only for short pure-python critical sections
+_state_lock = threading.Lock()
+_edges: dict[str, set[str]] = {}
+violations: list[dict] = []
+_tls = threading.local()
+
+
+class LockOrderError(RuntimeError):
+    """A lock acquisition that closes a cycle in the order graph."""
+
+
+def enabled() -> bool:
+    return os.environ.get(_ENV, "") not in ("", "0", "false", "off")
+
+
+def _raise_mode() -> bool:
+    return os.environ.get(_ENV, "") != "record"
+
+
+def reset() -> None:
+    """Clear the graph and recorded violations (tests)."""
+    with _state_lock:
+        _edges.clear()
+        violations.clear()
+
+
+def edges() -> dict[str, set[str]]:
+    """Snapshot of the acquisition-order graph (tests/diagnostics)."""
+    with _state_lock:
+        return {k: set(v) for k, v in _edges.items()}
+
+
+def _held():
+    st = getattr(_tls, "stack", None)
+    if st is None:
+        st = _tls.stack = []  # [[WatchedLock, count], ...]
+    return st
+
+
+def _find_path(src: str, dst: str) -> list[str] | None:
+    """DFS path src -> dst over _edges (caller holds _state_lock)."""
+    stack = [(src, [src])]
+    seen = {src}
+    while stack:
+        node, path = stack.pop()
+        if node == dst:
+            return path
+        for nxt in _edges.get(node, ()):
+            if nxt not in seen:
+                seen.add(nxt)
+                stack.append((nxt, path + [nxt]))
+    return None
+
+
+class WatchedLock:
+    """Lock wrapper that feeds the acquisition-order graph.  Wraps a
+    Lock or RLock; re-entrancy is tracked by object identity so RLock
+    recursion never reports against itself."""
+
+    def __init__(self, name: str, factory=threading.Lock):
+        self.name = name
+        self._reentrant = factory is threading.RLock
+        self._inner = factory()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        st = _held()
+        for entry in st:
+            if entry[0] is self:
+                if not self._reentrant and blocking:
+                    # a blocking re-acquire of a plain Lock the SAME
+                    # thread already holds can never succeed — diagnose
+                    # the self-deadlock instead of wedging inside the
+                    # watchdog (a non-blocking try just returns False)
+                    bad = {
+                        "acquiring": self.name,
+                        "holding": self.name,
+                        "cycle": [self.name, self.name],
+                        "thread": threading.current_thread().name,
+                    }
+                    with _state_lock:
+                        violations.append(bad)
+                    if _raise_mode():
+                        raise LockOrderError(
+                            "self-deadlock: blocking re-acquire of "
+                            f"non-reentrant lock {self.name!r}"
+                        )
+                # re-entrant: same object, no new edge (RLock recursion)
+                got = self._inner.acquire(blocking, timeout)
+                if got:
+                    entry[1] += 1
+                return got
+        # Check/record ordering BEFORE the (possibly blocking) inner
+        # acquire: in a live contended inversion both threads would
+        # otherwise sit inside _inner.acquire() forever and the cycle
+        # would never be observed — the watchdog must raise instead of
+        # inheriting the deadlock it exists to diagnose.  Only an
+        # INDEFINITE blocking acquire can wedge forever, so only it
+        # pre-records; a try-lock or timed wait records its edges after
+        # success — a failed attempt must not poison the graph with an
+        # ordering that was never actually held.
+        record_now = blocking and timeout == -1
+        bad = None
+        with _state_lock:
+            pending = []
+            for held, _cnt in st:
+                h = held.name
+                if h == self.name:
+                    # same ROLE, different instance: role-level ordering
+                    # cannot rank an instance against itself; skip
+                    continue
+                path = _find_path(self.name, h)
+                if path is not None:
+                    bad = {
+                        "acquiring": self.name,
+                        "holding": h,
+                        "cycle": path + [self.name],
+                        "thread": threading.current_thread().name,
+                    }
+                    violations.append(bad)
+                    break
+                pending.append(h)
+            if bad is None and record_now:
+                # commit edges only for an acquisition that will really
+                # be attempted — a REFUSED acquisition must not leave
+                # partial edges from the held locks scanned before the
+                # violating one
+                for h in pending:
+                    _edges.setdefault(h, set()).add(self.name)
+        if bad is not None and _raise_mode():
+            raise LockOrderError(
+                "lock-order inversion: acquiring "
+                f"{bad['acquiring']!r} while holding {bad['holding']!r} "
+                f"(established order: {' -> '.join(bad['cycle'])})"
+            )
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            st.append([self, 1])
+            if not record_now:
+                with _state_lock:
+                    for held, _cnt in st[:-1]:
+                        if held.name != self.name:
+                            _edges.setdefault(
+                                held.name, set()
+                            ).add(self.name)
+        return got
+
+    def release(self) -> None:
+        if not self._record_release():
+            # threading.Lock legally allows cross-thread release
+            # (handoff), but under watch the acquirer's held-stack
+            # would keep this lock forever and later acquisitions
+            # would record bogus edges
+            bad = {
+                "event": "cross-thread-release",
+                "lock": self.name,
+                "thread": threading.current_thread().name,
+            }
+            with _state_lock:
+                violations.append(bad)
+            if _raise_mode():
+                # refuse deterministically (inner stays held: the
+                # pattern is unsupported and the test run must fail
+                # here, not on a later bogus-edge inversion)
+                raise LockOrderError(
+                    f"cross-thread release of watched lock {self.name!r} "
+                    "(acquired on a different thread); handoff patterns "
+                    "are unsupported under FABRIC_TPU_LOCKWATCH"
+                )
+            # record mode observes without perturbing: perform the
+            # legal handoff (the acquirer's stale stack entry is a
+            # documented best-effort gap of observe-only mode)
+        self._inner.release()
+
+    def __enter__(self) -> "WatchedLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.release()
+        return False
+
+    def __repr__(self) -> str:
+        return f"<WatchedLock {self.name!r}>"
+
+    def _record_release(self) -> bool:
+        """Pop this lock from the current thread's held-stack; False if
+        it was not acquired on this thread (cross-thread release)."""
+        st = _held()
+        for i in range(len(st) - 1, -1, -1):
+            if st[i][0] is self:
+                st[i][1] -= 1
+                if st[i][1] == 0:
+                    del st[i]
+                return True
+        return False
+
+
+def named_lock(name: str):
+    """A threading.Lock, watched when FABRIC_TPU_LOCKWATCH is set."""
+    if enabled():
+        return WatchedLock(name, threading.Lock)
+    return threading.Lock()
+
+
+def named_rlock(name: str):
+    """A threading.RLock, watched when FABRIC_TPU_LOCKWATCH is set."""
+    if enabled():
+        return WatchedLock(name, threading.RLock)
+    return threading.RLock()
+
+
+__all__ = [
+    "LockOrderError",
+    "WatchedLock",
+    "named_lock",
+    "named_rlock",
+    "enabled",
+    "reset",
+    "edges",
+    "violations",
+]

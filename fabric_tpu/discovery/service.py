@@ -14,9 +14,9 @@ cache like the reference's (discovery/authcache.go).
 
 from __future__ import annotations
 
-import hashlib
 import threading
 
+from fabric_tpu.common.hashing import sha256 as _sha256
 from fabric_tpu.discovery.endorsement import PeerInfo, compute_descriptor
 from fabric_tpu.protos.discovery import protocol_pb2 as dpb
 from fabric_tpu.protoutil.common import SignedData
@@ -60,10 +60,10 @@ class DiscoveryService:
         ident_bytes = bytes(req.authentication.client_identity)
         if not ident_bytes:
             raise DiscoveryError("access denied: no client identity")
-        key = hashlib.sha256(
+        key = _sha256(
             channel.encode() + b"\x00" + ident_bytes + b"\x00"
             + bytes(signed.signature) + bytes(signed.payload)
-        ).digest()
+        )
         with self._lock:
             cached = self._auth_cache.get(key)
         if cached is True:

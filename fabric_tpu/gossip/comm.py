@@ -24,6 +24,7 @@ import socket
 import struct
 import threading
 
+from fabric_tpu.devtools.lockwatch import named_lock
 from fabric_tpu.protos.gossip import message_pb2 as gpb
 
 _LEN = struct.Struct(">I")
@@ -46,9 +47,9 @@ class MessageCryptoService:
     implementation: identity bytes are the pki-id; signatures optional."""
 
     def get_pki_id(self, identity: bytes) -> bytes:
-        import hashlib
+        from fabric_tpu.common.hashing import sha256
 
-        return hashlib.sha256(identity).digest()[:16]
+        return sha256(identity)[:16]
 
     def sign(self, payload: bytes) -> bytes:
         return b""
@@ -88,7 +89,7 @@ class GossipComm:
         self._known_identities: dict[bytes, bytes] = {
             self.pki_id: self_identity
         }
-        self._lock = threading.Lock()
+        self._lock = named_lock("gossip.comm.identities")
 
     def subscribe(self, handler) -> None:
         """handler(ReceivedMessage)"""
@@ -151,7 +152,7 @@ class InProcGossipNet:
     def __init__(self):
         self._peers: dict[str, "InProcGossipComm"] = {}
         self._cut: set[frozenset] = set()
-        self._lock = threading.Lock()
+        self._lock = named_lock("gossip.net")
 
     def register(self, comm: "InProcGossipComm") -> None:
         with self._lock:
@@ -231,7 +232,7 @@ class TCPGossipComm(GossipComm):
         self.addr = self._server.getsockname()
         self.endpoint = f"{self.addr[0]}:{self.addr[1]}"
         self._out: dict[str, queue.Queue] = {}
-        self._lock = threading.Lock()
+        self._lock = named_lock("gossip.comm.out")
         self._stop = threading.Event()
         threading.Thread(target=self._accept, daemon=True).start()
 

@@ -19,6 +19,7 @@ from __future__ import annotations
 import random
 import threading
 
+from fabric_tpu.devtools.lockwatch import named_lock
 from fabric_tpu.protos.gossip import message_pb2 as gpb
 
 
@@ -43,7 +44,7 @@ class MessageStore:
         self._by_seq: dict[int, bytes] = {}
         self._added: dict[int, int] = {}  # seq -> tick stamp
         self._now = 0
-        self._lock = threading.Lock()
+        self._lock = named_lock("gossip.blockcache")
 
     def add(self, seq: int, block_bytes: bytes) -> bool:
         with self._lock:
@@ -119,7 +120,7 @@ class ChannelGossip:
         self._tick_no = 0
         self._heights: dict[bytes, int] = {}  # peer pki -> advertised height
         self._height_eps: dict[bytes, str] = {}
-        self._lock = threading.Lock()
+        self._lock = named_lock("gossip.channel")
         self.ledger_height = lambda: 0  # wired by the state layer
         comm.subscribe(self._handle)
 

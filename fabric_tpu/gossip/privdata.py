@@ -15,10 +15,10 @@ PrivateDataMessage / PrivateDataRequest / PrivateDataResponse
 
 from __future__ import annotations
 
-import hashlib
 import threading
 import time
 
+from fabric_tpu.common.hashing import sha256 as _sha256
 from fabric_tpu.protos.common import common_pb2
 from fabric_tpu.protos.gossip import message_pb2 as gpb
 from fabric_tpu.protos.ledger.rwset import rwset_pb2
@@ -408,7 +408,7 @@ class PrivDataCoordinator:
     @staticmethod
     def _hash_ok(raw: bytes, expected: bytes) -> bool:
         # No endorsed hash -> no endorsed cleartext rwset: reject supply.
-        return bool(expected) and hashlib.sha256(raw).digest() == expected
+        return bool(expected) and _sha256(raw) == expected
 
 
 class Reconciler:
@@ -455,7 +455,7 @@ class Reconciler:
             )
             for (tx, ns, coll), (txid, exp) in expected.items():
                 raw = fetched.get((txid, ns, coll))
-                if raw is None or hashlib.sha256(raw).digest() != exp:
+                if raw is None or _sha256(raw) != exp:
                     continue  # absent or forged: leave as missing
                 self._ledger.commit_old_pvt_data(
                     block_num, tx, assemble_tx_pvt({(ns, coll): raw})

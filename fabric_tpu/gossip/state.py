@@ -13,6 +13,8 @@ from __future__ import annotations
 
 import threading
 
+from fabric_tpu.devtools.lockwatch import named_lock
+
 from fabric_tpu.protos.common import common_pb2
 from fabric_tpu.protos.gossip import message_pb2 as gpb
 
@@ -20,7 +22,7 @@ from fabric_tpu.protos.gossip import message_pb2 as gpb
 class PayloadBuffer:
     def __init__(self):
         self._by_seq: dict[int, bytes] = {}
-        self._lock = threading.Lock()
+        self._lock = named_lock("gossip.state.buffer")
 
     def push(self, seq: int, block_bytes: bytes) -> None:
         with self._lock:
@@ -51,7 +53,10 @@ class StateProvider:
         self._comm = comm
         self._buffer = PayloadBuffer()
         self._max_batch = max_batch
-        self._commit_lock = threading.Lock()
+        # watched under FABRIC_TPU_LOCKWATCH: ordered BEFORE the
+        # ledger commit lock (store_block enters the committer/ledger
+        # while holding it); nothing may take it while holding those
+        self._commit_lock = named_lock("gossip.state.commit")
         channel_gossip.ledger_height = lambda: self._committer.height
         # blocks arriving via gossip land here
         self._gossip._on_block = self._on_gossip_block

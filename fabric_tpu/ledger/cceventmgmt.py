@@ -9,6 +9,8 @@ from __future__ import annotations
 import dataclasses
 import threading
 
+from fabric_tpu.common.flogging import must_get_logger
+
 
 @dataclasses.dataclass(frozen=True)
 class ChaincodeDefinitionEvent:
@@ -44,8 +46,12 @@ class ChaincodeEventMgr:
         for fn in targets:
             try:
                 fn(event)
-            except Exception:
-                pass  # listener errors never poison the commit path
+            except Exception as exc:
+                # listener errors never poison the commit path — but they
+                # are logged, not swallowed
+                must_get_logger("ledger.cceventmgmt").warning(
+                    "chaincode-event listener %r failed: %s", fn, exc
+                )
 
     def handle_definition_committed(
         self, channel_id: str, name: str, version: str, sequence: int

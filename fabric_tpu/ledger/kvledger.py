@@ -12,6 +12,7 @@ import os
 import threading
 import time
 
+from fabric_tpu.devtools.lockwatch import named_rlock
 from fabric_tpu.ledger.blkstorage import BlockStore, BlockStoreError
 from fabric_tpu.ledger.history import HistoryDB
 from fabric_tpu.ledger.kvstore import (
@@ -121,6 +122,8 @@ def _history_writes(
                     (nsrw.namespace, w.key) for w in kvrw.writes
                 )
         except Exception:
+            # fabriclint: allow[exception-discipline] a malformed rwset
+            # contributes no history writes; MVCC already flagged the tx
             continue
     return writes_per_tx
 
@@ -165,8 +168,10 @@ class KVLedger:
         # admin RPC can request an on-demand snapshot concurrently — the
         # export takes this lock so it never reads a half-committed
         # block.  RLock because the commit-time auto-trigger generates
-        # while the committing thread already holds it.
-        self.commit_lock = threading.RLock()
+        # while the committing thread already holds it.  Created through
+        # the lockwatch seam: under FABRIC_TPU_LOCKWATCH (tier-1) every
+        # acquisition feeds the runtime lock-order watchdog.
+        self.commit_lock = named_rlock("kvledger.commit_lock")
         # the CommitGroup currently holding buffered (unflushed) blocks,
         # if any — commits through any OTHER group are rejected while it
         # is open (their collectors would disagree about the checkpoint)
@@ -230,6 +235,8 @@ class KVLedger:
             try:
                 txrw = rwset_pb2.TxReadWriteSet.FromString(raw)
             except Exception:
+                # fabriclint: allow[exception-discipline] unparsable rwset ->
+                # no endorsed collections -> nothing can be missing
                 continue
             for nsrw in txrw.ns_rwset:
                 for ch in nsrw.collection_hashed_rwset:

@@ -74,7 +74,9 @@ class PvtDataStore:
         for tx_num, ns, coll in missing or []:
             by_tx.setdefault(tx_num, []).append((ns, coll))
         for tx_num, pairs in by_tx.items():
-            puts[_mkey(block_num, tx_num)] = json.dumps(pairs).encode()
+            puts[_mkey(block_num, tx_num)] = json.dumps(
+                pairs, sort_keys=True
+            ).encode()
         with self._lock:
             for exp, entries in expiry_adds.items():
                 key = _xkey(exp, block_num)
@@ -82,7 +84,7 @@ class PvtDataStore:
                 if prior:
                     entries = json.loads(prior) + [list(e) for e in entries]
                 puts[key] = json.dumps(
-                    [list(e) for e in entries]
+                    [list(e) for e in entries], sort_keys=True
                 ).encode()
             db.write_batch(puts)
             self._purge_expired(block_num, db)
@@ -91,6 +93,8 @@ class PvtDataStore:
         try:
             txpvt = rwset_pb2.TxPvtReadWriteSet.FromString(raw)
         except Exception:
+            # fabriclint: allow[exception-discipline] unparsable stored pvt
+            # rwset yields no collections (generator's empty-result sentinel)
             return
         for nsp in txpvt.ns_pvt_rwset:
             for cp in nsp.collection_pvt_rwset:
@@ -117,6 +121,9 @@ class PvtDataStore:
                 try:
                     txpvt = rwset_pb2.TxPvtReadWriteSet.FromString(raw)
                 except Exception:
+                    # fabriclint: allow[exception-discipline] a corrupt stored
+                    # entry cannot be BTL-filtered; skip it rather than abort
+                    # the purge sweep
                     continue
                 new = rwset_pb2.TxPvtReadWriteSet(data_model=txpvt.data_model)
                 for nsp in txpvt.ns_pvt_rwset:
@@ -236,7 +243,9 @@ class PvtDataStore:
                     if (ns, coll) not in delivered
                 ]
                 if remaining:
-                    puts[mkey] = json.dumps(remaining).encode()
+                    puts[mkey] = json.dumps(
+                        remaining, sort_keys=True
+                    ).encode()
                 else:
                     deletes.append(mkey)
             self._db.write_batch(puts, deletes)

@@ -393,6 +393,8 @@ def execute_query_indexed(db, ns: str, query: str):
         try:
             doc = json.loads(vv.value.decode("utf-8"))
         except Exception:
+            # fabriclint: allow[exception-discipline] non-JSON values never
+            # match a selector (couchdb attachment semantics)
             continue
         if isinstance(doc, dict) and match_selector(doc, selector):
             out.append((key, vv.value, vv.version))
@@ -413,7 +415,9 @@ def execute_query(
         try:
             doc = json.loads(value.decode("utf-8"))
         except Exception:
-            continue  # non-JSON values never match (couchdb attachments)
+            # fabriclint: allow[exception-discipline] non-JSON values never
+            # match a selector (couchdb attachment semantics)
+            continue
         if not isinstance(doc, dict):
             continue
         if match_selector(doc, selector):

@@ -45,6 +45,7 @@ import struct
 import threading
 import time
 
+from fabric_tpu.devtools.lockwatch import named_lock
 from fabric_tpu.ledger.bookkeeping import (
     SNAPSHOT_REQUEST,
     BookkeepingProvider,
@@ -52,6 +53,7 @@ from fabric_tpu.ledger.bookkeeping import (
 from fabric_tpu.ledger.confighistory import ConfigHistoryMgr
 from fabric_tpu.ledger.kvstore import KVStore
 from fabric_tpu.ledger.pvtdatastorage import PvtDataStore
+from fabric_tpu.ledger.txmgmt import key_hash
 from fabric_tpu.ledger.statedb import Height, VersionedDB
 
 SNAPSHOT_FORMAT_VERSION = 1
@@ -192,7 +194,7 @@ def _hash_files(snapshot_dir: str, names, csp=None, metrics=None,
     batched call covers every file, so on the TPU provider the whole
     snapshot is digested device-side; sw is the host fallback.  When the
     csp package itself is unavailable (hosts without `cryptography`),
-    hashlib produces the identical digests."""
+    the common.hashing seam produces the identical digests."""
     if csp is None:
         try:
             from fabric_tpu.csp.factory import get_default
@@ -211,9 +213,9 @@ def _hash_files(snapshot_dir: str, names, csp=None, metrics=None,
     if csp is not None:
         digests = csp.hash_batch(blobs)
     else:
-        import hashlib
+        from fabric_tpu.common.hashing import sha256_many
 
-        digests = [hashlib.sha256(b).digest() for b in blobs]
+        digests = sha256_many(blobs)
     dt = time.perf_counter() - t0
     total = sum(len(b) for b in blobs)
     if metrics is not None:
@@ -268,8 +270,6 @@ def generate_snapshot(
     # none and must ride the public file.  Misrouting between the two
     # EXPORTED files is harmless — import re-writes raw records
     # verbatim from both.
-    import hashlib as _hashlib
-
     with open(os.path.join(work, PUBLIC_STATE_FILE), "wb") as pub_f, \
             open(os.path.join(work, PVT_HASHES_FILE), "wb") as hash_f:
         for raw_key, raw_val in state.export_records():
@@ -277,7 +277,7 @@ def generate_snapshot(
             parts = ns.split("\x00")
             if len(parts) == 3 and parts[1] == "pvt":
                 hashed_ns = f"{parts[0]}\x00hash\x00{parts[2]}"
-                khash = _hashlib.sha256(key.encode()).hexdigest()
+                khash = key_hash(key).hex()
                 if state.get_state(hashed_ns, khash) is not None:
                     continue  # confirmed cleartext private: never export
             out = hash_f if len(parts) == 3 and parts[1] == "hash" else pub_f
@@ -424,7 +424,9 @@ class SnapshotManager:
         self._requests = SnapshotRequestBookkeeper(
             BookkeepingProvider(kv).get_kv(ledger.ledger_id, SNAPSHOT_REQUEST)
         )
-        self._lock = threading.Lock()
+        # watched under FABRIC_TPU_LOCKWATCH: canonical order is
+        # ledger.commit_lock FIRST, then this manager lock
+        self._lock = named_lock("snapshot.manager")
         # background auto-trigger generations in flight (wait_idle),
         # plus a spawn/ack handshake: _spawn_seq counts generations
         # handed to background threads, _ack_seq counts those that have

@@ -171,6 +171,8 @@ def _doc_field(value: bytes, path: str):
     try:
         doc = json.loads(value.decode("utf-8"))
     except Exception:
+        # fabriclint: allow[exception-discipline] (None, False) is the
+        # documented "no indexable field" sentinel for non-JSON values
         return None, False
     if not isinstance(doc, dict):
         return None, False
@@ -452,7 +454,9 @@ class VersionedDB:
             # the permanently-conservative legacy mode — which disabled
             # the per-tx key-level-endorsement fast path for every
             # ledger right after its genesis commit
-            puts[_META_NS_KEY] = json.dumps(sorted(meta_ns)).encode()
+            puts[_META_NS_KEY] = json.dumps(
+                sorted(meta_ns), sort_keys=True
+            ).encode()
         if height is not None:
             puts[_SAVEPOINT_KEY] = height.pack()
         self._db.write_batch(puts, deletes)
@@ -509,7 +513,9 @@ class VersionedDB:
             if len(puts) >= batch_size:
                 self._db.write_batch(puts, [])
                 puts = {}
-        puts[_META_NS_KEY] = json.dumps(sorted(meta_ns)).encode()
+        puts[_META_NS_KEY] = json.dumps(
+            sorted(meta_ns), sort_keys=True
+        ).encode()
         puts[_SAVEPOINT_KEY] = savepoint.pack()
         self._db.write_batch(puts, [])
         self._meta_ns = None

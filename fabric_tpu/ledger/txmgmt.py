@@ -14,8 +14,8 @@ MVCC, all signature checks already ran as one batch.
 from __future__ import annotations
 
 import dataclasses
-import hashlib
 
+from fabric_tpu.common.hashing import sha256 as _sha256
 from fabric_tpu.ledger.statedb import Height, VersionedDB, VersionedValue
 from fabric_tpu.protos.ledger.rwset import rwset_pb2
 from fabric_tpu.protos.ledger.rwset.kvrwset import kv_rwset_pb2
@@ -40,11 +40,11 @@ def hash_ns(ns: str, coll: str) -> str:
 
 
 def key_hash(key: str) -> bytes:
-    return hashlib.sha256(key.encode()).digest()
+    return _sha256(key.encode())
 
 
 def value_hash(value: bytes) -> bytes:
-    return hashlib.sha256(value).digest()
+    return _sha256(value)
 
 
 # State metadata is a named-entry map; the key-level endorsement policy
@@ -336,7 +336,7 @@ class TxSimulator:
                         collection_name=coll,
                         hashed_rwset=hrw.SerializeToString(),
                         pvt_rwset_hash=(
-                            hashlib.sha256(pvt_bytes).digest()
+                            _sha256(pvt_bytes)
                             if pvt_bytes is not None
                             else b""
                         ),
@@ -602,7 +602,7 @@ class MVCCValidator:
                     raw_kvrw, clear_kvrw = clear
                     if (
                         not expected_hash
-                        or hashlib.sha256(raw_kvrw).digest() != expected_hash
+                        or _sha256(raw_kvrw) != expected_hash
                     ):
                         continue  # bogus supply: treat as missing
                     p_batch = batch.setdefault(pvt_ns(ns, coll), {})
@@ -668,6 +668,9 @@ class MVCCValidator:
                         kv_rwset_pb2.KVRWSet.FromString(cp.rwset),
                     )
         except Exception:
+            # fabriclint: allow[exception-discipline] unparsable supplied pvt
+            # cleartext contributes no writes; the hashed-namespace comparison
+            # independently flags the gap as missing data
             return {}
         return out
 

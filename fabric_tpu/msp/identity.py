@@ -8,12 +8,11 @@ the whole block's items go to one `CSP.verify_batch` call (SURVEY.md §3.4).
 
 from __future__ import annotations
 
-import hashlib
-
 from cryptography import x509
 from cryptography.hazmat.primitives import serialization
 from cryptography.x509.oid import NameOID
 
+from fabric_tpu.common.hashing import sha256 as _sha256
 from fabric_tpu.csp import api as csp_api
 from fabric_tpu.csp.api import ECDSAP256PublicKey, VerifyBatchItem
 from fabric_tpu.protos.msp import identities_pb2
@@ -44,7 +43,7 @@ class Identity:
         der = cert.public_bytes(serialization.Encoding.DER)
         # IdentityIdentifier: (mspid, hash of the raw cert) — reference
         # msp/mspimpl.go getIdentityFromConf.
-        self.id = (mspid, hashlib.sha256(der).hexdigest())
+        self.id = (mspid, _sha256(der).hex())
         self.ous = cert_ous(cert)
 
     def serialize(self) -> bytes:
@@ -70,7 +69,7 @@ class Identity:
 
     def verification_item(self, msg: bytes, sig: bytes) -> VerifyBatchItem:
         """Deferred-verification triple for CSP.verify_batch."""
-        return VerifyBatchItem(self.public_key, hashlib.sha256(msg).digest(), sig)
+        return VerifyBatchItem(self.public_key, _sha256(msg), sig)
 
 
 class SigningIdentity(Identity):

@@ -84,6 +84,8 @@ class DeliverClient:
                     self._sink(blk.header.number, blk.SerializeToString())
                     backoff = 0.1
             except Exception:
+                # fabriclint: allow[exception-discipline] reconnect loop: ANY
+                # endpoint failure routes to backoff + the next endpoint
                 pass
             if self._stop.wait(backoff):
                 return

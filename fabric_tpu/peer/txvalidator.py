@@ -25,7 +25,6 @@ the builtin plugin evaluates the channel/chaincode endorsement policy.
 from __future__ import annotations
 
 import dataclasses
-import hashlib
 
 from fabric_tpu.peer.validation_plugins import (
     IllegalWritesetError,
@@ -281,15 +280,14 @@ class TxValidator:
 
         # endorsement policy: each endorsement signs prp_bytes || endorser.
         # Digests are precomputed so policy prepare hits the plan cache
-        # (and the device path skips host-side re-hashing).
+        # (and the device path skips host-side re-hashing) — and they go
+        # through the CSP seam as ONE hash_batch per tx, so a device
+        # provider batches them instead of the host hashing per lane.
+        msgs = [prp_bytes + e.endorser for e in cap.action.endorsements]
+        digests = self._csp.hash_batch(msgs)
         signed = [
-            SignedData(
-                prp_bytes + e.endorser,
-                e.endorser,
-                e.signature,
-                digest=hashlib.sha256(prp_bytes + e.endorser).digest(),
-            )
-            for e in cap.action.endorsements
+            SignedData(m, e.endorser, e.signature, digest=d)
+            for m, e, d in zip(msgs, cap.action.endorsements, digests)
         ]
         return self._prepare_namespaces(
             work, signed, cc_id, bytes(action.results), sink

@@ -34,6 +34,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Callable, Sequence
 
+from fabric_tpu.common.flogging import must_get_logger
 from fabric_tpu.csp.api import VerifyBatchItem
 from fabric_tpu.ledger.txmgmt import VALIDATION_PARAMETER, hash_ns
 from fabric_tpu.policies.signature_policy import SignaturePolicy
@@ -42,6 +43,9 @@ from fabric_tpu.protos.ledger.rwset.kvrwset import kv_rwset_pb2
 from fabric_tpu.protos.common import policies_pb2
 from fabric_tpu.protos.peer import collection_pb2
 from fabric_tpu.protoutil import SignedData
+
+
+_logger = must_get_logger("peer.validation")
 
 
 class IllegalWritesetError(Exception):
@@ -169,8 +173,14 @@ class PendingValidation:
 
 
 class _FailPending(PendingValidation):
-    def __init__(self):
+    """Structured always-fail result: carries WHY the action can never
+    validate (the reason also goes to the validation logger), so a
+    rejected tx is attributable instead of a silent False."""
+
+    def __init__(self, reason: str):
         super().__init__([], [])
+        self.reason = reason
+        _logger.warning("validation action rejected: %s", reason)
 
     def finish(self, mask) -> bool:
         return False
@@ -263,9 +273,22 @@ class PolicyProvider:
         return pol
 
     def _parse_application_policy(self, raw: bytes):
+        # parse and lookup fail differently: a proto decode error means
+        # bad BYTES, a reference-resolution error means bad channel
+        # CONFIG — the operator must be pointed at the right one
         try:
             ap = collection_pb2.ApplicationPolicy.FromString(raw)
-            which = ap.WhichOneof("type")
+        except Exception as exc:
+            # None is the documented "no usable policy" sentinel the
+            # callers fall back on — but the parse failure itself must
+            # be attributable, not swallowed
+            _logger.warning(
+                "unparsable ApplicationPolicy (%d bytes): %s",
+                len(raw), exc,
+            )
+            return None
+        which = ap.WhichOneof("type")
+        try:
             if which == "signature_policy":
                 return SignaturePolicy(
                     ap.signature_policy, self._deserializer
@@ -274,8 +297,11 @@ class PolicyProvider:
                 return self._pm.get_policy(
                     ap.channel_config_policy_reference
                 )
-        except Exception:
-            pass
+        except Exception as exc:
+            _logger.warning(
+                "ApplicationPolicy %s could not be resolved: %s",
+                which, exc,
+            )
         return None
 
     def from_signature_policy_bytes(self, raw: bytes):
@@ -298,8 +324,11 @@ class PolicyProvider:
             env = policies_pb2.SignaturePolicyEnvelope.FromString(raw)
             if env.rule.ByteSize() or env.identities:
                 return SignaturePolicy(env, self._deserializer)
-        except Exception:
-            pass
+        except Exception as exc:
+            _logger.warning(
+                "unparsable SignaturePolicyEnvelope (%d bytes): %s",
+                len(raw), exc,
+            )
         return None
 
 
@@ -401,7 +430,14 @@ class BuiltinV20Plugin:
                 plan = EndorsementPlan(
                     policies, tuple(uniq), ctx.policy_provider.deserializer
                 )
-            except Exception:
+            except Exception as exc:
+                # fall back to the per-tx generic path; the plan build
+                # failure is logged so a policy that can never be
+                # amortized is visible, not silently slow
+                _logger.warning(
+                    "endorsement-plan build failed for %r (falling back "
+                    "to per-tx evaluation): %s", ctx.namespace, exc,
+                )
                 return None
             if len(self._plans) >= self._PLAN_CAP:
                 self._plans.clear()
@@ -419,8 +455,11 @@ class BuiltinV20Plugin:
     def prepare(self, ctx: ValidationContext) -> PendingValidation:
         try:
             fp = ctx.footprint or parse_footprint(ctx.rwset_bytes)
-        except Exception:
-            return _FailPending()
+        except Exception as exc:
+            return _FailPending(
+                f"tx rwset for namespace {ctx.namespace!r} does not "
+                f"parse: {exc}"
+            )
         entry = fp.per_ns.get(
             ctx.namespace,
             {"pub": [], "meta": [], "coll": [], "coll_meta": [],
@@ -483,7 +522,11 @@ class BuiltinV20Plugin:
                 if pol is None:
                     # unmarshalable key-level policy invalidates the tx
                     # (reference policyErr on Evaluate of broken vp)
-                    return _FailPending()
+                    return _FailPending(
+                        f"key-level VALIDATION_PARAMETER on "
+                        f"({ns!r}, {key!r}) does not parse as a "
+                        f"SignaturePolicyEnvelope"
+                    )
                 policies_by_bytes[raw] = pol
 
         policies = list(policies_by_bytes.values())

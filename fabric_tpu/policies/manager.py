@@ -72,10 +72,14 @@ class ImplicitMetaPolicy:
 
 class RejectPolicy:
     """Stand-in for unparsable/absent policies: always rejects (the
-    reference routes unknown policies to an implicit deny)."""
+    reference routes unknown policies to an implicit deny).  `reason`
+    records WHY the deny exists — an unparsable policy and a missing
+    path are different operator problems, and a silent always-False
+    object made them indistinguishable."""
 
-    def __init__(self, name: str):
+    def __init__(self, name: str, reason: str = ""):
         self.name = name
+        self.reason = reason or f"policy {name!r} is not defined"
 
     def prepare(self, signed_data):
         return _MetaPending([], 1)
@@ -141,12 +145,20 @@ def manager_from_config_group(
             try:
                 env = policies_pb2.SignaturePolicyEnvelope.FromString(pol.value)
                 policies[name] = SignaturePolicy(env, deserializer)
-            except Exception:
-                policies[name] = RejectPolicy(name)
+            except Exception as exc:
+                # structured deny: the config carried a SIGNATURE policy
+                # that does not parse — evaluations fail closed AND the
+                # reject records what broke (reference logs + implicit
+                # deny for unknown policy types)
+                policies[name] = RejectPolicy(
+                    name, reason=f"unparsable SIGNATURE policy: {exc}"
+                )
         elif pol.type == policies_pb2.Policy.IMPLICIT_META:
             metas.append((name, policies_pb2.ImplicitMetaPolicy.FromString(pol.value)))
         else:
-            policies[name] = RejectPolicy(name)
+            policies[name] = RejectPolicy(
+                name, reason=f"unsupported policy type {pol.type}"
+            )
     # implicit metas resolve against sub-managers' policies after they exist
     for name, meta in metas:
         sub_pols = []
@@ -157,7 +169,11 @@ def manager_from_config_group(
         if sub_pols:
             policies[name] = ImplicitMetaPolicy(sub_pols, meta.rule)
         else:
-            policies[name] = RejectPolicy(name)
+            policies[name] = RejectPolicy(
+                name,
+                reason=f"implicit meta policy over {meta.sub_policy!r} "
+                       f"resolved zero sub-policies",
+            )
     return Manager(path, policies, subs)
 
 

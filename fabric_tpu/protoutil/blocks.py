@@ -8,8 +8,7 @@ minimal DER writer (no external asn1 dependency).
 
 from __future__ import annotations
 
-import hashlib
-
+from fabric_tpu.common.hashing import sha256 as _sha256
 from fabric_tpu.protos.common import common_pb2
 
 
@@ -46,12 +45,15 @@ def block_header_bytes(header: common_pb2.BlockHeader) -> bytes:
 
 
 def block_header_hash(header: common_pb2.BlockHeader) -> bytes:
-    return hashlib.sha256(block_header_bytes(header)).digest()
+    # through the CSP hash seam: a TPU default provider digests headers
+    # alongside the rest of the block's crypto, and the call site stays
+    # visible to hash_batch batching (fabriclint csp-seam)
+    return _sha256(block_header_bytes(header))
 
 
 def block_data_hash(data: common_pb2.BlockData) -> bytes:
     """SHA-256 over the concatenation of the serialized envelopes."""
-    return hashlib.sha256(b"".join(data.data)).digest()
+    return _sha256(b"".join(data.data))
 
 
 def init_block_metadata(block: common_pb2.Block) -> None:

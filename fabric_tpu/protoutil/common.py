@@ -4,11 +4,11 @@ proputils.go:368 CheckTxID)."""
 from __future__ import annotations
 
 import dataclasses
-import hashlib
 import os
 import time
 import typing
 
+from fabric_tpu.common.hashing import sha256 as _sha256
 from fabric_tpu.protos.common import common_pb2
 
 
@@ -39,7 +39,7 @@ def random_nonce(n: int = 24) -> bytes:
 def compute_tx_id(nonce: bytes, creator: bytes) -> str:
     """TxID = hex(SHA-256(nonce || creator)) — the binding the reference
     enforces in protoutil CheckTxID."""
-    return hashlib.sha256(nonce + creator).hexdigest()
+    return _sha256(nonce + creator).hex()
 
 
 def check_tx_id(txid: str, nonce: bytes, creator: bytes) -> bool:
@@ -63,6 +63,8 @@ def make_channel_header(
         epoch=epoch,
         extension=extension,
     )
+    # fabriclint: allow[determinism] client-side tx-authoring timestamp;
+    # validators never recompute or compare it against their own clocks
     ts = time.time() if timestamp is None else timestamp
     ch.timestamp.seconds = int(ts)
     return ch

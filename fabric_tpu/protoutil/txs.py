@@ -9,8 +9,8 @@ GetProposalHash2 at :431), and the endorser-side UnpackProposal
 from __future__ import annotations
 
 import dataclasses
-import hashlib
 
+from fabric_tpu.common.hashing import sha256 as _sha256
 from fabric_tpu.protos.common import common_pb2
 from fabric_tpu.protos.peer import (
     chaincode_pb2,
@@ -69,11 +69,7 @@ def proposal_hash(chdr_bytes: bytes, shdr_bytes: bytes, ccpp_bytes: bytes) -> by
     influence the hash since committers never see it)."""
     ccpp = proposal_pb2.ChaincodeProposalPayload.FromString(ccpp_bytes)
     ccpp.ClearField("TransientMap")
-    h = hashlib.sha256()
-    h.update(chdr_bytes)
-    h.update(shdr_bytes)
-    h.update(ccpp.SerializeToString())
-    return h.digest()
+    return _sha256(chdr_bytes + shdr_bytes + ccpp.SerializeToString())
 
 
 def proposal_hash2(chdr_bytes: bytes, shdr_bytes: bytes, ccpp_bytes: bytes) -> bytes:
@@ -86,11 +82,7 @@ def proposal_hash2(chdr_bytes: bytes, shdr_bytes: bytes, ccpp_bytes: bytes) -> b
     are the endorsed preimage — a tx whose committed ccpp still carries
     transient data (or any other byte difference) hashes differently and
     fails the binding, exactly like the reference."""
-    h = hashlib.sha256()
-    h.update(chdr_bytes)
-    h.update(shdr_bytes)
-    h.update(ccpp_bytes)
-    return h.digest()
+    return _sha256(chdr_bytes + shdr_bytes + ccpp_bytes)
 
 
 def create_proposal_response(

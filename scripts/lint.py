@@ -1,0 +1,54 @@
+#!/usr/bin/env python
+"""CI wrapper around fabriclint: run the full-tree pass and emit one
+JSON summary line in the same shape the bench scripts use, so the
+driver/CI can scrape `"experiment": "fabriclint"` next to the bench
+lines.  Exit code mirrors the linter (non-zero on any unsuppressed
+violation).
+
+Usage: python scripts/lint.py [--show-suppressed]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from fabric_tpu.devtools.lint import lint_tree  # noqa: E402
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument(
+        "--show-suppressed", action="store_true",
+        help="also print suppressed violations (with their reasons)",
+    )
+    args = ap.parse_args()
+
+    t0 = time.perf_counter()
+    report = lint_tree()
+    elapsed = time.perf_counter() - t0
+
+    for v in report.unsuppressed:
+        print(str(v), file=sys.stderr)
+    if args.show_suppressed:
+        for v in report.suppressed:
+            print(str(v), file=sys.stderr)
+
+    summary = report.summary()
+    print(json.dumps({
+        "experiment": "fabriclint",
+        "files": summary["files"],
+        "violations": summary["violations"],
+        "suppressed": summary["suppressed"],
+        "by_rule": summary["by_rule"],
+        "clean": summary["clean"],
+        "seconds": round(elapsed, 4),
+    }))
+    return 0 if summary["clean"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -12,6 +12,15 @@ import os
 # TPU platform, but unit tests must be hermetic and run on the virtual CPU
 # mesh even when the TPU tunnel is down.
 os.environ["JAX_PLATFORMS"] = "cpu"
+
+# The whole tier-1 suite doubles as a lock-order soak test: coordination
+# locks created through devtools.lockwatch.named_lock/named_rlock become
+# instrumented wrappers that maintain the process-wide acquisition-order
+# graph and raise LockOrderError on any acquisition that closes a cycle.
+# setdefault so FABRIC_TPU_LOCKWATCH=0 can switch it off (or =record to
+# log without raising) when bisecting a failure.
+os.environ.setdefault("FABRIC_TPU_LOCKWATCH", "1")
+
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -26,9 +35,28 @@ if "xla_force_host_platform_device_count" not in _flags:
 # tunnel misbehaves).  A later config.update wins as long as backends are
 # not initialized yet.
 import jax  # noqa: E402
+import pytest  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 if jax._src.xla_bridge.backends_are_initialized():  # pragma: no cover
     from jax.extend.backend import clear_backends
 
     clear_backends()
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _lockwatch_soak_gate():
+    """Fail the session if ANY lock-order inversion was recorded and not
+    examined-and-cleared by a test.  Without this, a violation raised on
+    a background thread (snapshot export) or inside a broad exception
+    handler dies silently and tier-1 stays green — the suite-wide soak
+    only has teeth if the violation ledger is asserted empty at the end.
+    (tests/test_lockwatch.py injects inversions deliberately; its autouse
+    fixture resets the ledger after each test.)"""
+    yield
+    from fabric_tpu.devtools import lockwatch
+
+    assert not lockwatch.violations, (
+        "lock-order inversions recorded during the test session "
+        f"(likely on a background thread): {lockwatch.violations!r}"
+    )

@@ -1,0 +1,469 @@
+"""fabriclint self-gate (ISSUE 3 tentpole).
+
+Two halves:
+
+1. The GATE: the linter runs over the whole fabric_tpu tree and must
+   report zero unsuppressed violations — so a future PR that hashes
+   outside the CSP seam, swallows an exception on the validation path,
+   or inverts a lock order fails tier-1 here, not in review.  Every
+   allowlist entry must carry a reason and match live code (unused
+   entries are violations, so the allowlist only shrinks).
+
+2. Per-rule unit tests: each rule fires on a crafted violation AND
+   stays quiet on conforming code, pragmas suppress with a reason and
+   are themselves checked (reason-less / unknown-rule / unused pragmas
+   are meta violations), and string-embedded pragma-shaped text is
+   ignored (only real comments count).
+"""
+
+import json
+import subprocess
+import sys
+
+from fabric_tpu.devtools.allowlist import ALLOWLIST
+from fabric_tpu.devtools.lint import (
+    RULES,
+    AllowEntry,
+    lint_source,
+    lint_tree,
+)
+
+# crafted snippets lint as if they lived at these repo-relative paths
+LEDGER = "fabric_tpu/ledger/example.py"
+PEER = "fabric_tpu/peer/example.py"
+CSP = "fabric_tpu/csp/example.py"
+GOSSIP = "fabric_tpu/gossip/example.py"  # outside exc/det scopes
+
+
+def _rules(violations, suppressed=False):
+    return sorted(
+        v.rule for v in violations if v.suppressed == suppressed
+    )
+
+
+# -- the gate ----------------------------------------------------------------
+
+
+def test_full_tree_is_clean():
+    report = lint_tree()
+    assert report.files > 100  # really walked the tree
+    pretty = "\n".join(str(v) for v in report.unsuppressed)
+    assert not report.unsuppressed, f"fabriclint violations:\n{pretty}"
+    assert report.summary()["clean"] is True
+
+
+def test_every_allowlist_entry_has_a_reviewed_reason():
+    for e in ALLOWLIST:
+        assert e.rule in RULES, e
+        assert e.path.startswith("fabric_tpu/"), e
+        assert len(e.reason.strip()) >= 20, (
+            f"allowlist entry for {e.path} needs a real reason, "
+            f"not {e.reason!r}"
+        )
+
+
+def test_cli_json_summary_and_exit_codes(tmp_path):
+    proc = subprocess.run(
+        [sys.executable, "-m", "fabric_tpu.devtools.lint", "--json"],
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    summary = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert summary["tool"] == "fabriclint"
+    assert summary["clean"] is True
+    assert summary["violations"] == 0
+
+    # a deliberately dirty file makes the CLI exit non-zero
+    bad = tmp_path / "bad.py"
+    bad.write_text("import hashlib\nD = hashlib.sha256(b'x').digest()\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "fabric_tpu.devtools.lint", "--json",
+         "--root", str(tmp_path), "bad.py"],
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 1
+    summary = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert summary["clean"] is False
+    assert summary["by_rule"] == {"csp-seam": 1}
+
+
+# -- csp-seam ----------------------------------------------------------------
+
+
+def test_csp_seam_fires_outside_the_seam():
+    src = "import hashlib\nH = hashlib.sha256(b'x').digest()\n"
+    assert _rules(lint_source(src, PEER)) == ["csp-seam"]
+    # from-import counts too
+    src = "from hashlib import sha256\n"
+    assert _rules(lint_source(src, LEDGER)) == ["csp-seam"]
+
+
+def test_csp_seam_quiet_inside_seam_and_through_it():
+    src = "import hashlib\nH = hashlib.sha256(b'x').digest()\n"
+    assert lint_source(src, CSP) == []
+    assert lint_source(src, "fabric_tpu/common/hashing.py") == []
+    routed = (
+        "from fabric_tpu.common.hashing import sha256\n"
+        "H = sha256(b'x')\n"
+    )
+    assert lint_source(routed, PEER) == []
+
+
+# -- exception-discipline ----------------------------------------------------
+
+
+def test_exception_discipline_fires_on_silent_swallow():
+    src = (
+        "def f():\n"
+        "    try:\n"
+        "        g()\n"
+        "    except Exception:\n"
+        "        pass\n"
+    )
+    assert _rules(lint_source(src, PEER)) == ["exception-discipline"]
+    bare = src.replace("except Exception:", "except:")
+    assert _rules(lint_source(bare, LEDGER)) == ["exception-discipline"]
+    trivial_return = src.replace("pass", "return None")
+    assert _rules(lint_source(trivial_return, PEER)) == [
+        "exception-discipline"
+    ]
+
+
+def test_exception_discipline_quiet_when_structured():
+    logged = (
+        "def f():\n"
+        "    try:\n"
+        "        g()\n"
+        "    except Exception as exc:\n"
+        "        log.warning('boom: %s', exc)\n"
+    )
+    assert lint_source(logged, PEER) == []
+    reraise = logged.replace("log.warning('boom: %s', exc)", "raise")
+    assert lint_source(reraise, PEER) == []
+    sentinel = logged.replace(
+        "log.warning('boom: %s', exc)", "return ERR_UNKNOWN_SKI"
+    )
+    assert lint_source(sentinel, PEER) == []
+    narrow = logged.replace("Exception as exc", "ValueError")
+    assert lint_source(narrow, PEER) == []
+    # out of scope: gossip may use its own error style
+    swallow = logged.replace("log.warning('boom: %s', exc)", "pass")
+    assert lint_source(swallow, GOSSIP) == []
+
+
+# -- determinism -------------------------------------------------------------
+
+
+def test_determinism_fires_on_consensus_paths():
+    assert _rules(
+        lint_source("import time\nT = time.time()\n",
+                    "fabric_tpu/protoutil/example.py")
+    ) == ["determinism"]
+    assert _rules(
+        lint_source("from time import time\nT = time()\n", LEDGER)
+    ) == ["determinism"]
+    assert _rules(
+        lint_source("import random\nX = random.random()\n", PEER)
+    ) == ["determinism"]
+    assert _rules(
+        lint_source("import json\nB = json.dumps({'a': 1})\n", LEDGER)
+    ) == ["determinism"]
+    # qualified and from-import spellings must not slip past the gate
+    assert _rules(
+        lint_source("import datetime\nN = datetime.datetime.now()\n",
+                    LEDGER)
+    ) == ["determinism"]
+    assert _rules(
+        lint_source("from datetime import datetime as dt\nN = dt.now()\n",
+                    PEER)
+    ) == ["determinism"]
+    assert _rules(
+        lint_source("from random import shuffle\nshuffle([1])\n", PEER)
+    ) == ["determinism"]
+
+
+def test_determinism_quiet_on_conforming_code():
+    ok = (
+        "import json, random, time, datetime\n"
+        "B = json.dumps({'a': 1}, sort_keys=True)\n"
+        "R = random.Random(7)\n"
+        "from random import Random\n"
+        "R2 = Random(11)\n"
+        "T = time.monotonic()\n"
+        "P = time.perf_counter()\n"
+        "TZ = datetime.timezone.utc\n"
+        "D = datetime.datetime(2020, 1, 1)\n"
+    )
+    assert lint_source(ok, LEDGER) == []
+    # gossip's anti-entropy jitter is outside the consensus scopes
+    assert lint_source("import time\nT = time.time()\n", GOSSIP) == []
+
+
+# -- lock-discipline ---------------------------------------------------------
+
+
+def test_lock_discipline_fires_on_bare_acquire():
+    src = (
+        "def f(lock):\n"
+        "    lock.acquire()\n"
+        "    work()\n"
+        "    lock.release()\n"
+    )
+    assert _rules(lint_source(src, LEDGER)) == ["lock-discipline"]
+
+
+def test_lock_discipline_quiet_with_try_finally_or_enter():
+    # the canonical safe idiom: acquire OUTSIDE the try, immediately
+    # followed by a try whose finally releases (a failed acquire never
+    # reaches the finally) — quiet
+    src = (
+        "def f(lock):\n"
+        "    lock.acquire()\n"
+        "    try:\n"
+        "        work()\n"
+        "    finally:\n"
+        "        lock.release()\n"
+    )
+    assert lint_source(src, LEDGER) == []
+    # acquire inside the try body is also accepted (release is in a
+    # finally either way)
+    src = (
+        "def f(lock):\n"
+        "    try:\n"
+        "        lock.acquire()\n"
+        "        work()\n"
+        "    finally:\n"
+        "        lock.release()\n"
+    )
+    assert lint_source(src, LEDGER) == []
+    enter = (
+        "class L:\n"
+        "    def __enter__(self):\n"
+        "        self._lock.acquire()\n"
+        "        return self\n"
+    )
+    assert lint_source(enter, LEDGER) == []
+
+
+def test_lock_discipline_fires_on_with_order_inversion():
+    src = (
+        "def f(self):\n"
+        "    with self._lock:\n"
+        "        with self.commit_lock:\n"
+        "            pass\n"
+    )
+    assert _rules(lint_source(src, LEDGER)) == ["lock-discipline"]
+    ok = src.replace("self._lock", "X").replace("self.commit_lock", "Y")
+    canonical = (
+        "def f(self):\n"
+        "    with self.commit_lock:\n"
+        "        with self._lock:\n"
+        "            pass\n"
+    )
+    assert lint_source(canonical, LEDGER) == []
+
+
+def test_lock_discipline_fires_on_blocking_io_under_commit_lock():
+    src = (
+        "import os\n"
+        "def f(self, fd):\n"
+        "    with self.commit_lock:\n"
+        "        os.fsync(fd)\n"
+    )
+    assert _rules(lint_source(src, LEDGER)) == ["lock-discipline"]
+    # ...including transitively through a same-class helper
+    helper = (
+        "import os\n"
+        "class Ledger:\n"
+        "    def _flush(self):\n"
+        "        os.fsync(self.fd)\n"
+        "    def commit(self):\n"
+        "        with self.commit_lock:\n"
+        "            self._flush()\n"
+    )
+    assert _rules(lint_source(helper, LEDGER)) == ["lock-discipline"]
+    outside = (
+        "import os\n"
+        "def f(self, fd):\n"
+        "    with self._lock:\n"
+        "        pass\n"
+        "    os.fsync(fd)\n"
+    )
+    assert lint_source(outside, LEDGER) == []
+
+
+# -- jax-hygiene -------------------------------------------------------------
+
+
+def test_jax_hygiene_fires_on_per_item_host_sync():
+    src = (
+        "def f(xs):\n"
+        "    for x in xs:\n"
+        "        x.block_until_ready()\n"
+    )
+    assert _rules(lint_source(src, "fabric_tpu/csp/tpu/example.py")) == [
+        "jax-hygiene"
+    ]
+    batched = (
+        "def f(out):\n"
+        "    out.block_until_ready()\n"
+    )
+    assert lint_source(batched, "fabric_tpu/csp/tpu/example.py") == []
+
+
+# -- suppression machinery ---------------------------------------------------
+
+
+def test_pragma_suppresses_with_reason():
+    src = (
+        "import hashlib\n"
+        "# fabriclint: allow[csp-seam] reviewed: legacy fingerprint\n"
+        "H = hashlib.sha256(b'x').digest()\n"
+    )
+    vs = lint_source(src, PEER)
+    assert _rules(vs) == []  # nothing unsuppressed
+    assert _rules(vs, suppressed=True) == ["csp-seam"]
+    assert all("legacy fingerprint" in v.suppression
+               for v in vs if v.suppressed)
+
+
+def test_pragma_reaches_through_wrapped_comment_blocks():
+    # pragma two comment lines above the flagged line (wrapped reason)
+    above = (
+        "import hashlib\n"
+        "# fabriclint: allow[csp-seam] reviewed: a reason that wraps\n"
+        "# onto a second comment line before the code\n"
+        "H = hashlib.sha256(b'x').digest()\n"
+    )
+    assert _rules(lint_source(above, PEER)) == []
+    # pragma inside the handler body of a flagged `except` opener
+    below = (
+        "def f():\n"
+        "    try:\n"
+        "        g()\n"
+        "    except Exception:\n"
+        "        # fabriclint: allow[exception-discipline] reviewed ok\n"
+        "        pass\n"
+    )
+    assert _rules(lint_source(below, PEER)) == []
+
+
+def test_pragma_does_not_leak_to_the_statement_above():
+    # a pragma written for the NEXT statement must not also grant the
+    # statement ABOVE it — each suppression covers exactly one reviewed
+    # site, so the audit surface never widens by adjacency
+    src = (
+        "import hashlib\n"
+        "A = hashlib.sha256(b'a').digest()\n"
+        "# fabriclint: allow[csp-seam] reviewed: only B\n"
+        "B = hashlib.sha256(b'b').digest()\n"
+    )
+    vs = lint_source(src, PEER)
+    assert [v.line for v in vs if not v.suppressed] == [2]
+    assert [v.line for v in vs if v.suppressed] == [4]
+
+
+def test_pragma_without_reason_is_a_violation():
+    src = (
+        "import hashlib\n"
+        "# fabriclint: allow[csp-seam]\n"
+        "H = hashlib.sha256(b'x').digest()\n"
+    )
+    assert "pragma" in _rules(lint_source(src, PEER))
+
+
+def test_unused_and_unknown_pragmas_are_violations():
+    unused = "# fabriclint: allow[csp-seam] nothing here to suppress\nX = 1\n"
+    assert _rules(lint_source(unused, PEER)) == ["pragma"]
+    unknown = (
+        "# fabriclint: allow[no-such-rule] typo'd rule name\nX = 1\n"
+    )
+    rules = _rules(lint_source(unknown, PEER))
+    assert rules.count("pragma") == 2  # unknown rule AND unused
+
+
+def test_pragma_shaped_text_in_strings_is_ignored():
+    src = (
+        'DOC = "*# fabriclint: allow[csp-seam] example in docs*"\n'
+        "import hashlib\n"
+        "H = hashlib.sha256(b'x').digest()\n"
+    )
+    # the string pragma neither suppresses nor registers as unused
+    assert _rules(lint_source(src, PEER)) == ["csp-seam"]
+
+
+def test_allowlist_entry_suppresses_and_unused_entry_flags():
+    src = "import time\nT = time.time()\n"
+    entry = AllowEntry(
+        rule="determinism", path=LEDGER, match="time.time()",
+        reason="test entry",
+    )
+    used = set()
+    vs = lint_source(src, LEDGER, allowlist=[entry], used_entries=used)
+    assert _rules(vs) == []
+    assert used == {0}
+    # an entry matching nothing is reported by lint_tree as a violation
+    report = lint_tree(allowlist=list(ALLOWLIST) + [AllowEntry(
+        rule="determinism", path="fabric_tpu/peer/nope.py",
+        match="never-matches", reason="dead entry",
+    )])
+    dead = [v for v in report.unsuppressed if v.rule == "allowlist"]
+    assert len(dead) == 1 and "never-matches" in dead[0].message
+
+
+def test_hash_seam_rejects_non_sha256_backend():
+    # the seam feeds consensus bytes: a backend that is not literal
+    # SHA-256 must be refused at install time, not fork the peer later
+    import hashlib
+
+    from fabric_tpu.common import hashing
+
+    class Bad:
+        def hash(self, b):
+            return hashlib.sha1(b).digest()
+
+        def hash_batch(self, bs):
+            return [hashlib.sha1(b).digest() for b in bs]
+
+    class Good:
+        def hash(self, b):
+            return hashlib.sha256(b).digest()
+
+        def hash_batch(self, bs):
+            return [hashlib.sha256(b).digest() for b in bs]
+
+    try:
+        import pytest
+
+        with pytest.raises(ValueError, match="byte-identical"):
+            hashing.set_hash_backend(Bad())
+        hashing.set_hash_backend(Good())
+        assert hashing.sha256(b"x") == hashlib.sha256(b"x").digest()
+    finally:
+        hashing.set_hash_backend(None)
+
+
+def test_rejected_backend_is_not_installed_as_default():
+    # a provider the seam probe refuses must not be left as the process
+    # default — get_default() users would hash through the rejected
+    # backend while the seam stays on hashlib (split-brain digests)
+    import hashlib
+    import importlib.util
+
+    import pytest
+
+    if importlib.util.find_spec("cryptography") is None:
+        pytest.skip("csp.factory needs cryptography; minimal host")
+    from fabric_tpu.csp import factory
+
+    class Sha1CSP:
+        def hash(self, b):
+            return hashlib.sha1(b).digest()
+
+        def hash_batch(self, bs):
+            return [hashlib.sha1(b).digest() for b in bs]
+
+    before = factory._default
+    with pytest.raises(ValueError, match="byte-identical"):
+        factory._install_default(Sha1CSP())
+    assert factory._default is before

@@ -1,0 +1,357 @@
+"""Runtime lock-order watchdog tests (ISSUE 3).
+
+fabriclint's static lock rule only sees lexically nested `with` blocks;
+the watchdog closes the call-chain gap at runtime by recording the
+process-wide acquisition-order graph over lock ROLES.  Here: an injected
+A->B / B->A inversion across two threads is reported deterministically
+(every attempt, with the full cycle and the offending thread), the clean
+ledger commit + snapshot-export path does not trip it, and the
+suppressed corner cases (RLock re-entrancy, two instances of one role)
+stay quiet.
+"""
+
+import threading
+import time
+
+import pytest
+
+from fabric_tpu.devtools import lockwatch
+from fabric_tpu.devtools.lockwatch import (
+    LockOrderError,
+    WatchedLock,
+    named_lock,
+    named_rlock,
+)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_graph(monkeypatch):
+    """Each test starts from an empty order graph (the suite-wide watch
+    keeps accumulating before/after; edges only strengthen detection, so
+    clearing them here cannot cause false positives elsewhere).  The
+    violation ledger is SAVED and restored, not wiped: conftest's
+    session-end soak gate asserts it empty, and an inversion recorded by
+    an earlier test's background thread must still reach that gate."""
+    monkeypatch.setenv("FABRIC_TPU_LOCKWATCH", "1")
+    prior = list(lockwatch.violations)
+    lockwatch.reset()
+    yield
+    lockwatch.reset()
+    lockwatch.violations.extend(prior)
+
+
+def _run_in_thread(fn, name="worker"):
+    """Run fn in a thread, returning the exception it raised (or None)."""
+    box = []
+
+    def wrapper():
+        try:
+            fn()
+        except BaseException as exc:  # noqa: BLE001 - test harness
+            box.append(exc)
+
+    t = threading.Thread(target=wrapper, name=name)
+    t.start()
+    t.join(timeout=10)
+    assert not t.is_alive(), "watchdog test thread wedged"
+    return box[0] if box else None
+
+
+# -- injected inversion ------------------------------------------------------
+
+
+def test_ab_ba_inversion_reported_deterministically():
+    a, b = WatchedLock("A"), WatchedLock("B")
+
+    def establish():
+        with a:
+            with b:
+                pass
+
+    assert _run_in_thread(establish, name="establisher") is None
+    assert lockwatch.edges().get("A") == {"B"}
+
+    # the inverse order must raise EVERY attempt, not just sometimes:
+    # detection is against the persisted graph, not a lucky interleaving
+    for attempt in range(3):
+        def invert():
+            with b:
+                with a:
+                    pass
+
+        exc = _run_in_thread(invert, name=f"inverter-{attempt}")
+        assert isinstance(exc, LockOrderError), f"attempt {attempt}"
+        assert "'A'" in str(exc) and "'B'" in str(exc)
+
+    v = lockwatch.violations[-1]
+    assert v["acquiring"] == "A"
+    assert v["holding"] == "B"
+    assert v["cycle"] == ["A", "B", "A"]
+    assert v["thread"] == "inverter-2"
+    # the refused acquisition never took the inner lock: A is free
+    assert a.acquire(blocking=False)
+    a.release()
+
+
+def test_contended_inversion_raises_instead_of_deadlocking():
+    """A LIVE deadlock: T1 holds A and blocks acquiring B while T2
+    holds B and attempts A.  The order check runs BEFORE the blocking
+    inner acquire, so T2 raises (unwedging T1) rather than both
+    threads inheriting the deadlock the watchdog exists to catch."""
+    a, b = WatchedLock("A"), WatchedLock("B")
+    both_held = threading.Barrier(2, timeout=5)
+    errs: list[BaseException] = []
+
+    def t1():
+        with a:
+            both_held.wait()
+            with b:  # blocks until t2's refused attempt releases B
+                pass
+
+    def t2():
+        with b:
+            both_held.wait()
+            time.sleep(0.05)  # let t1 record A->B and block on B
+            try:
+                with a:
+                    pass
+            except LockOrderError as exc:
+                errs.append(exc)
+
+    th1 = threading.Thread(target=t1, name="holder-A")
+    th2 = threading.Thread(target=t2, name="holder-B")
+    th1.start()
+    th2.start()
+    th2.join(timeout=5)
+    th1.join(timeout=5)
+    assert not th1.is_alive() and not th2.is_alive(), "deadlocked"
+    assert len(errs) == 1 and isinstance(errs[0], LockOrderError)
+
+
+def test_transitive_cycle_detected():
+    a, b, c = WatchedLock("A"), WatchedLock("B"), WatchedLock("C")
+    with a:
+        with b:
+            pass
+    with b:
+        with c:
+            pass
+
+    def close_cycle():
+        with c:
+            with a:
+                pass
+
+    exc = _run_in_thread(close_cycle)
+    assert isinstance(exc, LockOrderError)
+    assert lockwatch.violations[-1]["cycle"] == ["A", "B", "C", "A"]
+
+
+def test_record_mode_logs_without_raising(monkeypatch):
+    monkeypatch.setenv("FABRIC_TPU_LOCKWATCH", "record")
+    a, b = WatchedLock("A"), WatchedLock("B")
+    with a:
+        with b:
+            pass
+
+    def invert():
+        with b:
+            with a:
+                pass
+
+    assert _run_in_thread(invert) is None
+    assert lockwatch.violations[-1]["cycle"] == ["A", "B", "A"]
+
+
+# -- cases that must stay quiet ---------------------------------------------
+
+
+def test_consistent_order_never_trips():
+    a, b = WatchedLock("A"), WatchedLock("B")
+
+    def nest():
+        for _ in range(20):
+            with a:
+                with b:
+                    pass
+
+    threads = [
+        threading.Thread(target=nest, name=f"nester-{i}") for i in range(4)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10)
+    assert not lockwatch.violations
+
+
+def test_rlock_reentrancy_is_not_an_inversion():
+    r = named_rlock("R")
+    assert isinstance(r, WatchedLock)
+    with r:
+        with r:
+            pass
+    assert not lockwatch.violations
+
+
+def test_two_instances_of_one_role_are_unordered():
+    # per-channel locks share a role name; role-level ordering cannot
+    # rank an instance against itself (documented approximation)
+    l1, l2 = WatchedLock("chan"), WatchedLock("chan")
+    with l1:
+        with l2:
+            pass
+    assert not lockwatch.violations
+
+
+def test_failed_try_lock_does_not_poison_the_graph():
+    # a non-blocking acquire that loses the race cannot deadlock, so it
+    # must not record an ordering edge — otherwise the later legitimate
+    # B -> A nesting would raise a false LockOrderError
+    a, b = WatchedLock("A"), WatchedLock("B")
+    held, release = threading.Event(), threading.Event()
+
+    def holder():
+        with b:
+            held.set()
+            release.wait(5)
+
+    t = threading.Thread(target=holder, name="B-holder")
+    t.start()
+    assert held.wait(5)
+    with a:
+        assert not b.acquire(blocking=False)  # busy: must leave no edge
+        assert not b.acquire(True, 0.05)      # timed wait: same rule
+    release.set()
+    t.join(5)
+    assert "A" not in lockwatch.edges()
+    with b:
+        with a:
+            pass
+    assert not lockwatch.violations
+
+
+def test_blocking_self_reacquire_of_plain_lock_is_diagnosed():
+    # a blocking re-acquire of a non-reentrant lock by the SAME thread
+    # can never succeed: the watchdog must raise deterministically, not
+    # wedge inside the wrapper; a non-blocking try stays a plain False
+    lk = named_lock("gossip.net")
+    assert isinstance(lk, WatchedLock)
+    with lk:
+        assert not lk.acquire(blocking=False)  # try-lock: quiet False
+        assert not lockwatch.violations
+        with pytest.raises(LockOrderError, match="self-deadlock"):
+            lk.acquire()
+    assert lockwatch.violations[-1]["cycle"] == ["gossip.net", "gossip.net"]
+    lockwatch.reset()
+    # and a watched RLock keeps full re-entrancy
+    r = named_rlock("mgr")
+    with r:
+        assert r.acquire()
+        r.release()
+    assert not lockwatch.violations
+
+
+def test_successful_try_lock_records_order():
+    a, b = WatchedLock("A"), WatchedLock("B")
+    with a:
+        assert b.acquire(blocking=False)
+        b.release()
+    assert lockwatch.edges().get("A") == {"B"}
+
+    def invert():
+        with b:
+            with a:
+                pass
+
+    assert isinstance(_run_in_thread(invert), LockOrderError)
+
+
+def test_cross_thread_release_is_refused():
+    # threading.Lock permits release on another thread (handoff), but
+    # under watch that would leave a stale held-entry in the acquirer's
+    # stack and later record bogus edges — must refuse, not rot
+    lk = named_lock("handoff")
+    lk.acquire()
+
+    def release_elsewhere():
+        lk.release()
+
+    exc = _run_in_thread(release_elsewhere)
+    assert isinstance(exc, LockOrderError)
+    assert "cross-thread release" in str(exc)
+    lk.release()  # same-thread release still fine
+    assert lk.acquire(blocking=False)
+    lk.release()
+
+
+def test_named_lock_returns_plain_lock_when_disabled(monkeypatch):
+    monkeypatch.setenv("FABRIC_TPU_LOCKWATCH", "0")
+    assert not isinstance(named_lock("x"), WatchedLock)
+    monkeypatch.setenv("FABRIC_TPU_LOCKWATCH", "1")
+    assert isinstance(named_lock("x"), WatchedLock)
+
+
+# -- the real commit + snapshot path ----------------------------------------
+
+
+def test_clean_commit_and_snapshot_path_does_not_trip(tmp_path):
+    """The production path the watchdog exists to protect: group
+    commits interleaved with a commit-time snapshot auto-trigger whose
+    export runs on a background thread, plus a foreground generate() —
+    commit_lock -> manager _lock everywhere, so the graph must stay
+    acyclic and the violation list empty."""
+    import test_snapshot as ts
+
+    provider, ledger = ts._source_ledger(tmp_path, 6)
+    mgr = ledger.snapshots
+    mgr.submit_request(8)
+    ts._commit_blocks(ledger, 6, 3)  # crosses height 8 -> auto-trigger
+    assert mgr.wait_idle(timeout=30)
+    ts._commit_blocks(ledger, 9, 2)
+    mgr.generate()
+    assert not lockwatch.violations
+    assert isinstance(ledger.commit_lock, WatchedLock)
+    assert isinstance(mgr._lock, WatchedLock)
+    provider.close()
+
+def test_refused_acquisition_leaves_no_partial_edges():
+    # holding A then B with X->B established: acquiring X is refused at
+    # the B check, and the A->X edge scanned BEFORE the violation must
+    # not be committed — else the safe X->A nesting below would raise
+    a, b, x = WatchedLock("A"), WatchedLock("B"), WatchedLock("X")
+    with x:
+        with b:
+            pass
+
+    def refused():
+        with a:
+            with b:
+                with x:
+                    pass
+
+    assert isinstance(_run_in_thread(refused), LockOrderError)
+    assert "X" not in lockwatch.edges().get("A", set())
+
+    def safe():
+        with x:
+            with a:
+                pass
+
+    assert _run_in_thread(safe) is None
+    assert len(lockwatch.violations) == 1  # only the injected refusal
+
+
+def test_record_mode_performs_cross_thread_handoff():
+    import os
+
+    os.environ["FABRIC_TPU_LOCKWATCH"] = "record"
+    try:
+        lk = WatchedLock("handoff-rec")
+        lk.acquire()
+        assert _run_in_thread(lambda: lk.release()) is None  # no raise
+        assert lockwatch.violations[-1]["event"] == "cross-thread-release"
+        assert lk.acquire(blocking=False)  # inner really was released
+        lk.release()
+    finally:
+        os.environ["FABRIC_TPU_LOCKWATCH"] = "1"

@@ -1,0 +1,148 @@
+"""Structured-error regressions (ISSUE 3 satellites).
+
+PR 2 established the direction with ERR_UNKNOWN_SKI: a failure on the
+validation path must carry WHY, not vanish into a bare False/None.
+These tests pin the two spots this PR converted from silent `except
+Exception: pass` swallows — policies/manager.py's RejectPolicy and
+peer/validation_plugins.py's _FailPending / PolicyProvider parsers — so
+a refactor cannot quietly reintroduce the swallow (fabriclint's
+exception-discipline rule guards the shape; these guard the semantics).
+"""
+
+import logging
+
+import pytest
+
+from fabric_tpu.peer.validation_plugins import PolicyProvider, _FailPending
+from fabric_tpu.policies.manager import (
+    RejectPolicy,
+    manager_from_config_group,
+)
+from fabric_tpu.protos.common import configtx_pb2, policies_pb2
+
+# invalid protobuf: wire type 7 is reserved, FromString always raises
+GARBAGE = b"\xff\xff\xff\xff"
+
+
+def _group_with_policy(name: str, ptype: int, value: bytes):
+    group = configtx_pb2.ConfigGroup()
+    group.policies[name].policy.type = ptype
+    group.policies[name].policy.value = value
+    return group
+
+
+class _NeverCSP:
+    """A CSP whose verify_batch must not be reached: reject paths
+    carry zero batch items."""
+
+    def verify_batch(self, items):
+        assert not list(items), "reject policy produced verify work"
+        return []
+
+
+def test_unparsable_signature_policy_becomes_structured_reject():
+    group = _group_with_policy(
+        "Admins", policies_pb2.Policy.SIGNATURE, GARBAGE
+    )
+    mgr = manager_from_config_group("Channel", group, deserializer=None)
+    pol = mgr.get_policy("Admins")
+    assert isinstance(pol, RejectPolicy)
+    assert "unparsable SIGNATURE policy" in pol.reason
+    # fails closed, with no verify items handed to the CSP
+    assert pol.evaluate_signed_data([], _NeverCSP()) is False
+    pending = pol.prepare([])
+    assert pending.items == []
+    assert pending.finish([]) is False
+
+
+def test_unsupported_policy_type_reason():
+    group = _group_with_policy("Odd", 99, b"")
+    mgr = manager_from_config_group("Channel", group, deserializer=None)
+    pol = mgr.get_policy("Odd")
+    assert isinstance(pol, RejectPolicy)
+    assert "unsupported policy type 99" in pol.reason
+
+
+def test_implicit_meta_over_zero_subpolicies_reason():
+    meta = policies_pb2.ImplicitMetaPolicy()
+    meta.sub_policy = "Writers"
+    meta.rule = policies_pb2.ImplicitMetaPolicy.ANY
+    group = _group_with_policy(
+        "Writers", policies_pb2.Policy.IMPLICIT_META,
+        meta.SerializeToString(),
+    )
+    mgr = manager_from_config_group("Channel", group, deserializer=None)
+    pol = mgr.get_policy("Writers")
+    assert isinstance(pol, RejectPolicy)
+    assert "zero sub-policies" in pol.reason
+
+
+def test_missing_policy_default_reason():
+    assert "not defined" in RejectPolicy("Readers").reason
+
+
+@pytest.fixture()
+def validation_log():
+    """Capture fabric_tpu's validation logger directly: flogging's
+    package root has propagate=False, so caplog's root handler never
+    sees these records."""
+    records = []
+
+    class _Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record.getMessage())
+
+    logger = logging.getLogger("fabric_tpu.peer.validation")
+    handler = _Capture(level=logging.WARNING)
+    logger.addHandler(handler)
+    yield records
+    logger.removeHandler(handler)
+
+
+def test_fail_pending_carries_and_logs_reason(validation_log):
+    pending = _FailPending("tx rwset for namespace 'cc' does not parse")
+    assert pending.finish([]) is False
+    assert pending.items == []
+    assert "does not parse" in pending.reason
+    assert any("validation action rejected" in m for m in validation_log)
+
+
+def test_policy_provider_logs_unparsable_envelope(validation_log):
+    provider = PolicyProvider(policy_manager=None, deserializer=None)
+    pol = provider.from_signature_policy_bytes(GARBAGE)
+    assert pol is None
+    assert any("SignaturePolicyEnvelope" in m for m in validation_log)
+
+
+def test_policy_provider_logs_unparsable_application_policy(validation_log):
+    provider = PolicyProvider(policy_manager=None, deserializer=None)
+    pol = provider.from_application_policy_bytes(GARBAGE)
+    assert pol is None
+    assert any("ApplicationPolicy" in m for m in validation_log)
+
+
+def test_missing_cryptography_import_error_is_actionable():
+    """On a minimal host the provider names must fail with an error that
+    NAMES the missing dependency, not a bare 'cannot import name'."""
+    import importlib.util
+
+    if importlib.util.find_spec("cryptography") is not None:
+        pytest.skip("cryptography installed; minimal-host path inactive")
+    with pytest.raises(ImportError, match="cryptography"):
+        from fabric_tpu.csp import SWCSP  # noqa: F401
+
+def test_policy_provider_distinguishes_resolution_failure(validation_log):
+    """A well-formed ApplicationPolicy whose channel-config reference
+    cannot be resolved must not be reported as unparsable BYTES — the
+    operator would debug a proto-encoding problem that doesn't exist."""
+    from fabric_tpu.protos.peer import collection_pb2
+
+    ap = collection_pb2.ApplicationPolicy(
+        channel_config_policy_reference="/Channel/Application/Endorsement"
+    )
+    provider = PolicyProvider(policy_manager=None, deserializer=None)
+    assert provider.from_application_policy_bytes(
+        ap.SerializeToString()
+    ) is None
+    assert any("could not be resolved" in m for m in validation_log)
+    assert not any("unparsable" in m for m in validation_log)
