@@ -168,17 +168,22 @@ def main() -> None:
             }
         )
     )
-    tmp.cleanup()  # os._exit below skips finalizers: drop the on-disk
-    # bench ledgers explicitly so repeated runs don't fill /tmp
+    sys.stdout.flush()
+    # quiesce the device provider AFTER the one JSON line is out (a
+    # wedged chip must not discard completed measurements) but BEFORE
+    # interpreter exit: joining the flush waiters is what lets teardown
+    # run cleanly — a tpu-flush-waiter still inside an XLA kernel at
+    # exit is killed mid-unwind and glibc aborts with "FATAL: exception
+    # not rethrown" (the old os._exit(0) workaround this close
+    # replaces).  close() is the indefinite join: exiting under a live
+    # waiter would reproduce the abort, while a genuinely wedged chip
+    # is the harness timeout's problem.
+    close = getattr(csp, "close", None)
+    if close is not None:
+        close()
+    tmp.cleanup()
 
 
 if __name__ == "__main__":
     main()
     sys.stdout.flush()
-    # every measurement is complete and the one JSON line is out; skip
-    # interpreter teardown, which has aborted ("FATAL: exception not
-    # rethrown") in the tunneled-TPU runtime's thread shutdown and
-    # would turn a successful CLI run into a nonzero exit.  Scoped to
-    # the CLI entry so programmatic callers of main() keep their
-    # process.
-    os._exit(0)

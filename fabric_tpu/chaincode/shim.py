@@ -21,6 +21,7 @@ import socket
 import struct
 import threading
 
+from fabric_tpu.devtools.lockwatch import spawn_thread
 from fabric_tpu.protos.peer import chaincode_shim_pb2 as shim_pb
 from fabric_tpu.protos.peer import chaincode_pb2, proposal_pb2
 
@@ -247,8 +248,9 @@ class ShimHandler:
                     q.put(msg)
                 continue
             if msg.type in (M.TRANSACTION, M.INIT):
-                threading.Thread(
-                    target=self._execute, args=(msg,), daemon=True
+                spawn_thread(
+                    target=self._execute, args=(msg,),
+                    name=f"cc-exec-{msg.txid[:8]}", kind="worker",
                 ).start()
 
     def _execute(self, msg: M) -> None:

@@ -26,6 +26,7 @@ import socket
 import struct
 import threading
 
+from fabric_tpu.devtools.lockwatch import spawn_thread
 from fabric_tpu.protos.peer import chaincode_pb2, chaincode_shim_pb2 as shim_pb
 from fabric_tpu.protos.peer import proposal_pb2
 
@@ -163,8 +164,9 @@ class ChaincodeSupport:
                 # stream that must also deliver its COMPLETED (the
                 # reference runs handleMessage in per-tx goroutines,
                 # handler.go:355).
-                threading.Thread(
-                    target=self._dispatch_async, args=(msg, send), daemon=True
+                spawn_thread(
+                    target=self._dispatch_async, args=(msg, send),
+                    name="cc-dispatch", kind="worker",
                 ).start()
         finally:
             if name is not None:
@@ -404,11 +406,13 @@ class InProcStream:
             cc, name, send=self._to_peer.put, recv=lambda: self._to_cc.get()
         )
         self._threads = [
-            threading.Thread(
+            spawn_thread(
                 target=self._serve_peer_side, args=(peer_send, peer_recv),
-                daemon=True,
+                name="cc-peer-side", kind="service",
             ),
-            threading.Thread(target=self._shim.run, daemon=True),
+            spawn_thread(
+                target=self._shim.run, name="cc-shim", kind="service",
+            ),
         ]
 
     def _serve_peer_side(self, send, recv) -> None:
@@ -451,7 +455,9 @@ class TCPChaincodeListener:
         self._server.listen(16)
         self.addr = self._server.getsockname()
         self._stop = threading.Event()
-        threading.Thread(target=self._accept, daemon=True).start()
+        spawn_thread(
+            target=self._accept, name="cc-accept", kind="service"
+        ).start()
 
     def _accept(self) -> None:
         while not self._stop.is_set():
@@ -459,7 +465,10 @@ class TCPChaincodeListener:
                 conn, _ = self._server.accept()
             except OSError:
                 return
-            threading.Thread(target=self._serve, args=(conn,), daemon=True).start()
+            spawn_thread(
+                target=self._serve, args=(conn,),
+                name="cc-serve", kind="service",
+            ).start()
 
     def _serve(self, conn: socket.socket) -> None:
         lock = threading.Lock()
@@ -497,6 +506,12 @@ class TCPChaincodeListener:
             if not self._support.check_launch_token(name, token):
                 return  # unknown/forged credential: drop silently
             self._support.register_stream(send, recv, authorized_name=name)
+        except OSError:
+            # abrupt peer disconnect (ECONNRESET from a client that
+            # closed with frames in flight, EPIPE on send): the same
+            # clean drop as an orderly close — surfaced by threadwatch
+            # as a silent serve-thread death before this handler existed
+            return
         finally:
             try:
                 conn.close()

@@ -33,6 +33,8 @@ import ssl
 import struct
 import threading
 
+from fabric_tpu.devtools.lockwatch import spawn_thread
+
 KIND_DATA = 0
 KIND_END = 1
 KIND_ERR = 2
@@ -274,7 +276,7 @@ def _pump_stream(sock, out, ka: KeepaliveOptions) -> bool:
             return
         put(_END)
 
-    t = threading.Thread(target=pull, daemon=True)
+    t = spawn_thread(target=pull, name="rpc-stream-pull", kind="worker")
     t.start()
     try:
         while True:
@@ -379,8 +381,9 @@ class RPCServer:
 
     def start(self) -> None:
         self._started = True
-        self._thread = threading.Thread(
-            target=self._srv.serve_forever, daemon=True
+        self._thread = spawn_thread(
+            target=self._srv.serve_forever, name="rpc-server",
+            kind="service",
         )
         self._thread.start()
 

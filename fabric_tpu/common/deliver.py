@@ -17,6 +17,7 @@ from __future__ import annotations
 import threading
 
 from fabric_tpu.protos.common import common_pb2
+from fabric_tpu.devtools.lockwatch import named_condition
 from fabric_tpu.protos.orderer import ab_pb2
 from fabric_tpu.protoutil import SignedData
 from fabric_tpu import protoutil
@@ -26,7 +27,7 @@ class BlockNotifier:
     """Height watcher: deliver streams block on it until the chain grows."""
 
     def __init__(self):
-        self._cond = threading.Condition()
+        self._cond = named_condition("deliver.height")
 
     def notify(self) -> None:
         with self._cond:

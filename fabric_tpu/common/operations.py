@@ -11,6 +11,8 @@ import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from fabric_tpu.devtools.lockwatch import spawn_thread
+
 from fabric_tpu.common import flogging
 from fabric_tpu.common.metrics import (
     DisabledProvider,
@@ -109,8 +111,9 @@ class System:
         return self._server.server_address
 
     def start(self) -> None:
-        self._thread = threading.Thread(
-            target=self._server.serve_forever, daemon=True
+        self._thread = spawn_thread(
+            target=self._server.serve_forever, name="operations-server",
+            kind="service",
         )
         self._thread.start()
 

@@ -26,6 +26,8 @@ import sys
 import threading
 import time
 import traceback
+
+from fabric_tpu.devtools.lockwatch import spawn_thread
 from collections import Counter
 from urllib.parse import parse_qs, urlparse
 
@@ -115,9 +117,9 @@ class ProfileServer:
         return self._srv.server_address[:2]
 
     def start(self) -> None:
-        self._thread = threading.Thread(
-            target=self._srv.serve_forever, daemon=True,
-            name="profile-server",
+        self._thread = spawn_thread(
+            target=self._srv.serve_forever, name="profile-server",
+            kind="service",
         )
         self._thread.start()
 

@@ -27,6 +27,7 @@ from typing import Sequence
 import numpy as np
 
 from fabric_tpu.csp import api
+from fabric_tpu.devtools.lockwatch import spawn_thread
 from fabric_tpu.csp.api import (
     CSP,
     ECDSAP256PrivateKey,
@@ -229,11 +230,19 @@ class _FlushResult:
         self._seal_lock = threading.Lock()
         self._wait_lock = threading.Lock()
         self._done = threading.Event()
+        # set by TPUCSP.drain(): the provider is shutting down, so this
+        # flush's wall must not feed the lane-wall EWMA (a drain-time
+        # wall measures teardown contention, not chip speed) and its
+        # waiter is about to be joined
+        self.cancelled = False
+        self._waiter: threading.Thread | None = None
 
     def start_background(self) -> None:
-        threading.Thread(
-            target=self._wait_device, name="tpu-flush-waiter", daemon=True
-        ).start()
+        self._waiter = spawn_thread(
+            target=self._wait_device, name="tpu-flush-waiter",
+            kind="worker",
+        )
+        self._waiter.start()
 
     def _seal(self, mask: list | None, exc: Exception | None = None) -> bool:
         """First writer wins; every consumer wakes.  Drops the input
@@ -296,6 +305,7 @@ class _FlushResult:
                 and self._on_device_wall is not None
                 and self._n_device_lanes
                 and not host_items
+                and not self.cancelled
             ):
                 # feed the provider's flush-wall EWMA — only from walls
                 # the device actually produced (a host-race win says
@@ -430,6 +440,11 @@ class TPUCSP(CSP):
         self._pend_batches: list = []  # list[Sequence[VerifyBatchItem]]
         self._pend_lanes = 0
         self._flushed: dict[int, object] = {}  # gen -> _FlushResult
+        # every dispatched flush, kept until its waiter thread exits —
+        # drain() joins these so NO tpu-flush-waiter can still be parked
+        # inside an XLA kernel when the interpreter exits (the rc=134
+        # "FATAL: exception not rethrown" teardown abort)
+        self._inflight: list = []
         self._gen = 0
         self._max_chunk = max_chunk
         # -- multi-device sharding (SURVEY.md §2.9): chunks place
@@ -438,6 +453,68 @@ class TPUCSP(CSP):
         # collectives is the idiomatic mesh layout, and each device
         # crunches its chunk while the host marshals the next.
         self.last_dispatch_devices: tuple = ()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def drain(self, timeout: float | None = 60.0) -> bool:
+        """Quiesce the provider: flush anything still buffered (so no
+        collector can dangle) and JOIN every in-flight flush waiter.
+
+        This is the missing lifecycle API behind the MULTICHIP rc=134
+        regression: a `tpu-flush-waiter` daemon thread still blocked in
+        an XLA kernel at interpreter exit gets pthread-killed, the
+        forced unwind crosses XLA's catch(...), and glibc aborts with
+        "FATAL: exception not rethrown".  Callers (bench.py, the
+        multichip dryrun, node shutdown) drain before exiting instead
+        of papering over the abort with os._exit(0).
+
+        Every in-flight flush is marked cancelled first so a wall
+        completed during teardown never feeds the lane-wall EWMA.
+        Returns True when every waiter finished inside `timeout`
+        (None = wait indefinitely); False leaves the stragglers
+        running — the caller can report and decide, but should NOT
+        exit the interpreter under them.
+
+        The join loop re-snapshots until it finds nothing alive: a
+        dispatch racing the first snapshot (another thread calling
+        verify_batch while we drain) is caught — and cancelled — by
+        the next pass, so the close() guarantee holds without freezing
+        concurrent verifiers out of the provider."""
+        deadline = (
+            None if timeout is None else time.monotonic() + timeout
+        )
+        while True:
+            with self._pend_lock:
+                if self._pend_batches:
+                    self._flush_locked()
+                for res in self._inflight:
+                    res.cancelled = True
+                live = [
+                    r for r in self._inflight
+                    if r._waiter is not None and r._waiter.is_alive()
+                ]
+                if not live:
+                    self._inflight = []
+                    return True
+            for res in live:
+                th = res._waiter
+                if deadline is None:
+                    th.join()
+                else:
+                    th.join(max(0.0, deadline - time.monotonic()))
+                    if th.is_alive():
+                        with self._pend_lock:
+                            self._inflight = [
+                                r for r in self._inflight
+                                if r._waiter is not None
+                                and r._waiter.is_alive()
+                            ]
+                        return False
+
+    def close(self) -> None:
+        """drain() with the indefinite wait: the provider guarantees no
+        worker thread survives close()."""
+        self.drain(timeout=None)
 
     # -- key management / signing: host side ------------------------------
 
@@ -562,6 +639,11 @@ class TPUCSP(CSP):
             # degrade the whole flush to the host oracle, lazily
             res = _FlushResult([], len(items), host_items=items, sw=self._sw)
         self._flushed[gen] = res
+        self._inflight = [
+            r for r in self._inflight
+            if r._waiter is not None and r._waiter.is_alive()
+        ]
+        self._inflight.append(res)
 
     def _dispatch(self, items) -> "_FlushResult":
         import jax

@@ -1,0 +1,727 @@
+"""fabriclint dataflow — the whole-program half of the invariant checker.
+
+PR 3's fabriclint sees one function at a time, so a digest computed in a
+helper, wall-clock smuggled through two assignments into a marshaled
+header, or an fsync three calls below ``commit_lock`` all slipped past
+the gate.  This module closes that class: it parses every module in the
+lint target set ONCE, resolves module-level imports and aliases
+(``import hashlib as h``, ``from time import time``, relative imports),
+builds a call graph over names it can resolve statically (module-level
+functions, same-module helpers, ``self.`` methods of the enclosing
+class), and computes per-function summaries to a fixpoint:
+
+``uses_hashlib`` / ``uses_hashlib_transitive``
+    touches ``hashlib`` directly / reaches it through helpers whose own
+    modules are outside the CSP seam (propagation STOPS at seam modules:
+    calling ``common.hashing.sha256`` is the fix, not a violation).
+
+``returns_digest``
+    returns a value produced by a hash call (hashlib, the seam's
+    sha256/sha256_many, a CSP ``hash``/``hash_batch``) — directly or via
+    a digest-returning callee.
+
+``blocking`` / ``blocking_transitive``
+    performs blocking I/O (fsync/flush/execute/sleep...) directly / via
+    any resolvable call chain.  lint.py uses this to extend the
+    under-``commit_lock`` rule across function and module boundaries.
+
+``spawns_thread`` / ``acquires_locks``
+    creates ``threading.Thread``s / lexically ``with``-acquires known
+    lock roles — thread-lifecycle and lock-order context for reviewers
+    and the thread-hygiene rule.
+
+``returns_wallclock`` / ``param_to_return`` / ``param_to_sink``
+    the taint summaries: the function returns a wall-clock-derived
+    value; parameter *i* flows to the return value; parameter *i* flows
+    into a consensus-bytes sink (protoutil call, protobuf constructor,
+    ``SerializeToString``).
+
+On top of the summaries run the interprocedural emissions:
+
+taint
+    ``time.time()`` / ``datetime.now()`` / module-level ``random.*``
+    values tracked through assignments, attribute fills
+    (``hdr.timestamp = ts``), f-strings, arithmetic, and resolvable
+    calls, flagged where they ENTER a sink — protoutil marshaling or
+    protobuf (block-header) construction — whichever module that happens
+    in.  Tainted ``self`` attributes propagate across methods of the
+    same class (``self._inc = int(time.time()*1000)`` in ``__init__``
+    taints ``self._inc`` in every other method).
+
+csp-seam (alias half)
+    a local binding to ``hashlib`` (``h = hashlib``;
+    ``digest = h.sha256``) used outside the seam — the spelling the
+    intraprocedural attribute check cannot see.  The helper-call half
+    (callers of hashlib-using helpers) is emitted by lint.py's checker
+    using ``call_resolutions`` + the summaries here.
+
+The engine is deliberately static and approximate: only statically
+resolvable names participate in the call graph, attribute calls on
+foreign objects fall back to the per-name heuristics, and taint is
+flow-insensitively accumulated (two body iterations per round).  The
+approximations are all CONSERVATIVE for the rules built on top, and
+every false positive costs exactly one reviewed pragma — the currency
+this linter already trades in.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+
+# modules allowed to touch hashlib directly — the canonical definition
+# (lint.py imports it from here so the two passes can never disagree)
+CSP_SEAM_ALLOWED = (
+    "fabric_tpu/csp/",
+    "fabric_tpu/common/hashing.py",
+    "fabric_tpu/common/crypto.py",
+)
+
+BLOCKING_CALLS = frozenset(
+    {"fsync", "sync_files", "sleep", "flush", "execute", "executemany"}
+)
+
+# taint sinks: consensus bytes are born in these places
+_SINK_MODULE_PREFIXES = ("fabric_tpu.protoutil", "fabric_tpu.protos.")
+_SINK_ATTRS = frozenset({"SerializeToString", "SerializeToOstream"})
+
+# hash producers for the returns-digest summary
+_SEAM_HASH_FNS = (
+    "fabric_tpu.common.hashing.sha256",
+    "fabric_tpu.common.hashing.sha256_many",
+    "fabric_tpu.common.crypto.sha256",
+    "fabric_tpu.common.crypto.sha256_many",
+)
+_HASH_ATTRS = frozenset({"hash", "hash_batch", "digest", "hexdigest"})
+
+_WALL = "wall"
+_MAX_ROUNDS = 12
+
+
+def _in_seam(rel: str) -> bool:
+    return any(rel.startswith(p) for p in CSP_SEAM_ALLOWED)
+
+
+def _module_dotted(rel: str) -> str:
+    """Repo-relative path -> dotted module name."""
+    if rel.endswith("/__init__.py"):
+        rel = rel[: -len("/__init__.py")]
+    elif rel.endswith(".py"):
+        rel = rel[:-3]
+    return rel.replace("/", ".")
+
+
+def _dotted(expr) -> str | None:
+    """``a.b.c`` as a string; None for anything fancier."""
+    parts = []
+    while isinstance(expr, ast.Attribute):
+        parts.append(expr.attr)
+        expr = expr.value
+    if not isinstance(expr, ast.Name):
+        return None
+    parts.append(expr.id)
+    return ".".join(reversed(parts))
+
+
+@dataclasses.dataclass
+class FunctionInfo:
+    rel: str
+    qname: str  # dotted: module[.Class].name
+    name: str
+    cls: str | None
+    lineno: int
+    params: list[str]
+    node: object  # ast.FunctionDef | ast.AsyncFunctionDef
+    # direct facts
+    uses_hashlib: bool = False
+    blocking: bool = False
+    spawns_thread: bool = False
+    acquires_locks: set = dataclasses.field(default_factory=set)
+    calls: list = dataclasses.field(default_factory=list)  # resolved qnames
+    # fixpoint facts
+    uses_hashlib_transitive: bool = False
+    blocking_transitive: bool = False
+    returns_digest: bool = False
+    returns_wallclock: bool = False
+    param_to_return: set = dataclasses.field(default_factory=set)
+    param_to_sink: set = dataclasses.field(default_factory=set)
+
+    def summary(self) -> dict:
+        """JSON-shaped summary (CLI ``--summaries``, tests)."""
+        return {
+            "function": self.qname,
+            "file": self.rel,
+            "line": self.lineno,
+            "returns_digest": self.returns_digest,
+            "returns_wallclock": self.returns_wallclock,
+            "uses_hashlib": self.uses_hashlib_transitive,
+            "blocking_io": self.blocking_transitive,
+            "spawns_thread": self.spawns_thread,
+            "acquires_locks": sorted(self.acquires_locks),
+            "param_to_sink": sorted(self.param_to_sink),
+        }
+
+
+@dataclasses.dataclass
+class ModuleInfo:
+    rel: str
+    dotted: str
+    tree: ast.Module
+    imports: dict = dataclasses.field(default_factory=dict)  # name -> dotted
+    functions: list = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class TaintFlow:
+    """One wall-clock value entering a consensus-bytes sink."""
+
+    rel: str
+    line: int
+    message: str
+
+
+class Project:
+    """Whole-program model over the lint target set.
+
+    ``sanctioned_sources`` maps rel -> line numbers whose wall-clock
+    source calls are covered by a reviewed ``allow[determinism]`` or
+    ``allow[taint]`` pragma: a REVIEWED source does not propagate —
+    otherwise one sanctioned client-side timestamp would demand a
+    pragma at every downstream marshal site, and the suppression
+    surface would grow instead of shrink."""
+
+    def __init__(self, trees: dict[str, ast.Module],
+                 sanctioned_sources: dict[str, set] | None = None):
+        self.sanctioned_sources = sanctioned_sources or {}
+        # (rel, line) of sanctioned sources the engine actually hit —
+        # lint.py counts their pragmas as used (the pragma's job was to
+        # stop propagation, not to suppress a same-line violation)
+        self.sanctioned_used: set[tuple] = set()
+        self.modules: dict[str, ModuleInfo] = {}
+        self.symbols: dict[str, FunctionInfo] = {}
+        # (rel, lineno, col_offset) of a Call node -> resolved callee qname
+        self.call_resolutions: dict[tuple, str] = {}
+        # csp-seam alias violations found during the facts pass
+        self.alias_violations: list[TaintFlow] = []
+        self.taint_flows: list[TaintFlow] = []
+        # ClassDef qname -> names of self attributes holding wall-clock
+        self._class_taint: dict[str, set] = {}
+        for rel, tree in sorted(trees.items()):
+            self._load_module(rel, tree)
+        self._collect_facts()
+        self._fixpoint_booleans()
+        self._fixpoint_taint()
+
+    # -- module loading ----------------------------------------------------
+
+    def _load_module(self, rel: str, tree: ast.Module) -> None:
+        mod = ModuleInfo(rel=rel, dotted=_module_dotted(rel), tree=tree)
+        pkg = mod.dotted.rsplit(".", 1)[0] if "." in mod.dotted else ""
+        if rel.endswith("/__init__.py"):
+            pkg = mod.dotted
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    mod.imports[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0]
+                    )
+                    if a.asname:
+                        mod.imports[a.asname] = a.name
+            elif isinstance(node, ast.ImportFrom):
+                base = node.module or ""
+                if node.level:
+                    up = pkg.split(".") if pkg else []
+                    up = up[: len(up) - (node.level - 1)]
+                    base = ".".join(up + ([node.module] if node.module else []))
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    mod.imports[a.asname or a.name] = (
+                        f"{base}.{a.name}" if base else a.name
+                    )
+        for stmt in tree.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._add_function(mod, stmt, cls=None)
+            elif isinstance(stmt, ast.ClassDef):
+                for sub in stmt.body:
+                    if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        self._add_function(mod, sub, cls=stmt.name)
+        self.modules[rel] = mod
+
+    def _add_function(self, mod: ModuleInfo, node, cls: str | None) -> None:
+        qname = (
+            f"{mod.dotted}.{cls}.{node.name}" if cls
+            else f"{mod.dotted}.{node.name}"
+        )
+        a = node.args
+        params = [p.arg for p in a.posonlyargs + a.args]
+        fn = FunctionInfo(
+            rel=mod.rel, qname=qname, name=node.name, cls=cls,
+            lineno=node.lineno, params=params, node=node,
+        )
+        mod.functions.append(fn)
+        self.symbols[qname] = fn
+
+    # -- name resolution ---------------------------------------------------
+
+    def _resolve_expr(self, mod: ModuleInfo, expr, cls: str | None,
+                      local: dict) -> str | None:
+        """Resolve a Name/Attribute chain to a dotted target through
+        local bindings and module imports.  ``self.x`` resolves into the
+        enclosing class.  Returns e.g. "hashlib.sha256", "time.time",
+        "fabric_tpu.protoutil.common.make_channel_header"."""
+        dotted = _dotted(expr)
+        if dotted is None:
+            return None
+        head, _, rest = dotted.partition(".")
+        if head == "self" and cls is not None:
+            return f"{mod.dotted}.{cls}.{rest}" if rest else None
+        target = local.get(head) or mod.imports.get(head)
+        if target is None:
+            # same-module symbol?
+            cand = f"{mod.dotted}.{dotted}"
+            if cand in self.symbols:
+                return cand
+            return None
+        return f"{target}.{rest}" if rest else target
+
+    # -- facts pass --------------------------------------------------------
+
+    def _collect_facts(self) -> None:
+        for mod in self.modules.values():
+            for fn in mod.functions:
+                self._facts_for(mod, fn)
+
+    def _facts_for(self, mod: ModuleInfo, fn: FunctionInfo) -> None:
+        local: dict[str, str] = {}
+        seam = _in_seam(mod.rel)
+        for node in ast.walk(fn.node):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                bound = self._resolve_expr(mod, node.value, fn.cls, local)
+                if bound is not None and not isinstance(node.value, ast.Call):
+                    local[node.targets[0].id] = bound
+                    if not seam and (
+                        bound == "hashlib" or bound.startswith("hashlib.")
+                    ):
+                        self.alias_violations.append(TaintFlow(
+                            rel=mod.rel, line=node.lineno,
+                            message=f"local alias "
+                                    f"{node.targets[0].id!r} binds "
+                                    f"{bound} outside the CSP seam — "
+                                    "aliasing does not launder a direct "
+                                    "hashlib dependency (route through "
+                                    "common.hashing or the CSP)",
+                        ))
+            elif isinstance(node, ast.Call):
+                target = self._resolve_expr(mod, node.func, fn.cls, local)
+                if target is not None:
+                    if target in self.symbols:
+                        fn.calls.append(target)
+                        self.call_resolutions[
+                            (mod.rel, node.lineno, node.col_offset)
+                        ] = target
+                    if target == "hashlib" or target.startswith("hashlib."):
+                        fn.uses_hashlib = True
+                    if target in (
+                        "threading.Thread",
+                        "threading.Timer",
+                        "fabric_tpu.devtools.lockwatch.spawn_thread",
+                        "fabric_tpu.devtools.lockwatch.spawn_timer",
+                    ):
+                        fn.spawns_thread = True
+                f = node.func
+                if isinstance(f, ast.Attribute):
+                    if f.attr in BLOCKING_CALLS:
+                        fn.blocking = True
+                    if (
+                        isinstance(f.value, ast.Name)
+                        and local.get(f.value.id, "").startswith("hashlib")
+                    ):
+                        fn.uses_hashlib = True
+            elif isinstance(node, ast.With):
+                for item in node.items:
+                    name = None
+                    ctx = item.context_expr
+                    if isinstance(ctx, ast.Attribute):
+                        name = ctx.attr
+                    elif isinstance(ctx, ast.Name):
+                        name = ctx.id
+                    if name is not None and (
+                        "lock" in name.lower() or name in ("_idle",)
+                    ):
+                        fn.acquires_locks.add(name)
+        fn.uses_hashlib_transitive = fn.uses_hashlib and not seam
+        fn.blocking_transitive = fn.blocking
+        fn.returns_digest = self._returns_digest_direct(mod, fn, local)
+        fn._local_bindings = local  # reused by the taint pass
+        # callee qnames appearing inside Return expressions, computed
+        # once — the returns-digest fixpoint is a set lookup, not a
+        # re-walk of the caller's AST per round
+        ret_calls: set = set()
+        for node in ast.walk(fn.node):
+            if isinstance(node, ast.Return) and node.value is not None:
+                for sub in ast.walk(node.value):
+                    if isinstance(sub, ast.Call):
+                        q = self.call_resolutions.get(
+                            (mod.rel, sub.lineno, sub.col_offset)
+                        )
+                        if q is not None:
+                            ret_calls.add(q)
+        fn._return_callees = ret_calls
+
+    def _returns_digest_direct(self, mod: ModuleInfo, fn: FunctionInfo,
+                               local: dict) -> bool:
+        for node in ast.walk(fn.node):
+            if not isinstance(node, ast.Return) or node.value is None:
+                continue
+            for sub in ast.walk(node.value):
+                if not isinstance(sub, ast.Call):
+                    continue
+                target = self._resolve_expr(mod, sub.func, fn.cls, local)
+                if target is not None and (
+                    target.startswith("hashlib.")
+                    or target in _SEAM_HASH_FNS
+                ):
+                    return True
+                if isinstance(sub.func, ast.Attribute) \
+                        and sub.func.attr in _HASH_ATTRS:
+                    return True
+        return False
+
+    # -- boolean fixpoints -------------------------------------------------
+
+    def _fixpoint_booleans(self) -> None:
+        changed = True
+        rounds = 0
+        while changed and rounds < _MAX_ROUNDS:
+            changed = False
+            rounds += 1
+            for fn in self.symbols.values():
+                for callee_q in fn.calls:
+                    callee = self.symbols.get(callee_q)
+                    if callee is None:
+                        continue
+                    if callee.blocking_transitive and not fn.blocking_transitive:
+                        fn.blocking_transitive = True
+                        changed = True
+                    # hashlib reach propagates only through NON-seam
+                    # callees: calling the seam is the sanctioned route
+                    if (
+                        callee.uses_hashlib_transitive
+                        and not _in_seam(callee.rel)
+                        and not _in_seam(fn.rel)
+                        and not fn.uses_hashlib_transitive
+                    ):
+                        fn.uses_hashlib_transitive = True
+                        changed = True
+                    if (
+                        callee.returns_digest
+                        and not fn.returns_digest
+                        and callee_q in fn._return_callees
+                    ):
+                        fn.returns_digest = True
+                        changed = True
+
+    # -- taint -------------------------------------------------------------
+
+    def _fixpoint_taint(self) -> None:
+        for _ in range(_MAX_ROUNDS):
+            changed = False
+            for mod in self.modules.values():
+                for fn in mod.functions:
+                    if self._taint_pass(mod, fn, emit=False):
+                        changed = True
+            if not changed:
+                break
+        seen = set()
+        for mod in self.modules.values():
+            for fn in mod.functions:
+                self._taint_pass(mod, fn, emit=True, seen=seen)
+
+    def _is_wall_source(self, target: str | None) -> bool:
+        if target is None:
+            return False
+        if target == "time.time":
+            return True
+        if target.startswith("datetime.") and target.rsplit(".", 1)[-1] in (
+            "now", "utcnow", "today"
+        ):
+            return True
+        if target.startswith("random.") and target.rsplit(".", 1)[-1] not in (
+            "Random", "SystemRandom"
+        ):
+            return True
+        return False
+
+    def _sink_for(self, mod: ModuleInfo, node: ast.Call, cls, local):
+        """(kind, detail) when this call consumes its arguments into
+        consensus bytes; None otherwise."""
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr in _SINK_ATTRS:
+            return ("serialize", f.attr)
+        target = self._resolve_expr(mod, f, cls, local)
+        if target is not None and any(
+            target.startswith(p) for p in _SINK_MODULE_PREFIXES
+        ):
+            tail = target.rsplit(".", 1)[-1]
+            kind = "proto-ctor" if tail[:1].isupper() else "protoutil"
+            return (kind, target)
+        return None
+
+    def _taint_pass(self, mod: ModuleInfo, fn: FunctionInfo,
+                    emit: bool, seen: set | None = None) -> bool:
+        env: dict[str, frozenset] = {
+            p: frozenset({("param", i)}) for i, p in enumerate(fn.params)
+        }
+        if fn.cls is not None and fn.params and fn.params[0] == "self":
+            env["self"] = frozenset()
+        cls_q = f"{mod.dotted}.{fn.cls}" if fn.cls else None
+        local = getattr(fn, "_local_bindings", {})
+        changed = [False]
+
+        def note_param_summary(labels, add_to: set) -> None:
+            for lb in labels:
+                if isinstance(lb, tuple) and lb[0] == "param":
+                    if lb[1] not in add_to:
+                        add_to.add(lb[1])
+                        changed[0] = True
+
+        def ev(node) -> frozenset:
+            if isinstance(node, ast.Name):
+                return env.get(node.id, frozenset())
+            if isinstance(node, ast.Constant):
+                return frozenset()
+            if isinstance(node, ast.Call):
+                return ev_call(node)
+            if isinstance(node, ast.Attribute):
+                base = ev(node.value)
+                dotted = _dotted(node)
+                if dotted is not None and dotted in env:
+                    base = base | env[dotted]
+                if (
+                    cls_q is not None
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == "self"
+                    and node.attr in self._class_taint.get(cls_q, ())
+                ):
+                    base = base | frozenset({_WALL})
+                return base
+            if isinstance(node, ast.JoinedStr):
+                out = frozenset()
+                for v in node.values:
+                    out |= ev(v)
+                return out
+            if isinstance(node, ast.FormattedValue):
+                return ev(node.value)
+            out = frozenset()
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.expr):
+                    out |= ev(child)
+            return out
+
+        def arg_labels(node: ast.Call, callee: FunctionInfo | None):
+            """position -> labels, including keywords mapped through the
+            callee's parameter names (methods: skip the self slot)."""
+            out: dict[int, frozenset] = {}
+            shift = 1 if callee is not None and callee.params[:1] == ["self"] \
+                else 0
+            for i, a in enumerate(node.args):
+                out[i + shift] = ev(a)
+            for kw in node.keywords:
+                labels = ev(kw.value)
+                if callee is not None and kw.arg in (callee.params or ()):
+                    out[callee.params.index(kw.arg)] = labels
+                else:
+                    out.setdefault(-1, frozenset())
+                    out[-1] |= labels
+            return out
+
+        def ev_call(node: ast.Call) -> frozenset:
+            callee_q = self.call_resolutions.get(
+                (mod.rel, node.lineno, node.col_offset)
+            )
+            callee = self.symbols.get(callee_q) if callee_q else None
+            target = self._resolve_expr(mod, node.func, fn.cls, local)
+            if self._is_wall_source(target):
+                if node.lineno in self.sanctioned_sources.get(mod.rel, ()):
+                    self.sanctioned_used.add((mod.rel, node.lineno))
+                else:
+                    return frozenset({_WALL})
+            labels_by_pos = arg_labels(node, callee)
+            sink = self._sink_for(mod, node, fn.cls, local)
+            flowing = frozenset()
+            for labels in labels_by_pos.values():
+                flowing |= labels
+            if isinstance(node.func, ast.Attribute) and sink:
+                flowing |= ev(node.func.value)
+                # a proto object filled field-by-field: any tainted
+                # `obj.field` entry counts against `obj.Serialize...()`
+                base_d = _dotted(node.func.value)
+                if base_d is not None:
+                    for k, v in env.items():
+                        if k.startswith(base_d + "."):
+                            flowing |= v
+            if sink is not None:
+                if _WALL in flowing:
+                    if emit:
+                        key = ("taint", mod.rel, node.lineno)
+                        if seen is not None and key not in seen:
+                            seen.add(key)
+                            self.taint_flows.append(TaintFlow(
+                                rel=mod.rel, line=node.lineno,
+                                message=(
+                                    "wall-clock-derived value flows into "
+                                    f"consensus bytes ({sink[0]}: "
+                                    f"{sink[1]}) — peers recomputing "
+                                    "these bytes will disagree; thread "
+                                    "an explicit timestamp argument "
+                                    "instead"
+                                ),
+                            ))
+                note_param_summary(flowing, fn.param_to_sink)
+            if callee is not None:
+                # arguments reaching the callee's sink-flowing params
+                for pos, labels in labels_by_pos.items():
+                    if pos in callee.param_to_sink:
+                        if _WALL in labels and emit:
+                            key = ("taint", mod.rel, node.lineno)
+                            if seen is not None and key not in seen:
+                                seen.add(key)
+                                self.taint_flows.append(TaintFlow(
+                                    rel=mod.rel, line=node.lineno,
+                                    message=(
+                                        "wall-clock-derived argument "
+                                        f"reaches a consensus-bytes sink "
+                                        f"inside {callee.qname} (param "
+                                        f"{pos}) — peers recomputing "
+                                        "these bytes will disagree"
+                                    ),
+                                ))
+                        note_param_summary(labels, fn.param_to_sink)
+                out = frozenset()
+                if callee.returns_wallclock:
+                    out |= frozenset({_WALL})
+                for pos in callee.param_to_return:
+                    out |= labels_by_pos.get(pos, frozenset())
+                return out
+            # unresolved call: conservatively propagate every input
+            out = flowing
+            if isinstance(node.func, ast.Attribute):
+                out |= ev(node.func.value)
+            return out
+
+        def assign_to(target, labels: frozenset) -> None:
+            if isinstance(target, ast.Name):
+                prev = env.get(target.id, frozenset())
+                if labels - prev:
+                    env[target.id] = prev | labels
+            elif isinstance(target, ast.Attribute):
+                dotted = _dotted(target)
+                if dotted is not None:
+                    prev = env.get(dotted, frozenset())
+                    if labels - prev:
+                        env[dotted] = prev | labels
+                # filling a field of a LOCAL object taints the object —
+                # `hdr.timestamp = ts; return hdr` must carry the taint
+                # out.  `self` is the exception: class-level attribute
+                # taint tracks the individual attribute instead, so one
+                # tainted field doesn't poison every self access.
+                base = target.value
+                while isinstance(base, (ast.Attribute, ast.Subscript)):
+                    base = base.value
+                if isinstance(base, ast.Name) and base.id != "self":
+                    prev = env.get(base.id, frozenset())
+                    if labels - prev:
+                        env[base.id] = prev | labels
+                if (
+                    cls_q is not None
+                    and isinstance(target.value, ast.Name)
+                    and target.value.id == "self"
+                    and _WALL in labels
+                ):
+                    attrs = self._class_taint.setdefault(cls_q, set())
+                    if target.attr not in attrs:
+                        attrs.add(target.attr)
+                        changed[0] = True
+            elif isinstance(target, ast.Subscript):
+                assign_to(target.value, labels)
+            elif isinstance(target, (ast.Tuple, ast.List)):
+                for elt in target.elts:
+                    assign_to(elt, labels)
+
+        def walk(stmts) -> None:
+            for stmt in stmts:
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.ClassDef)):
+                    # nested defs are outside the summary model (rare
+                    # on the paths these rules guard)
+                    continue
+                elif isinstance(stmt, ast.Assign):
+                    labels = ev(stmt.value)
+                    for t in stmt.targets:
+                        assign_to(t, labels)
+                elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+                    if stmt.value is not None:
+                        assign_to(stmt.target, ev(stmt.value))
+                elif isinstance(stmt, ast.Return):
+                    if stmt.value is not None:
+                        labels = ev(stmt.value)
+                        if _WALL in labels and not fn.returns_wallclock:
+                            fn.returns_wallclock = True
+                            changed[0] = True
+                        note_param_summary(labels, fn.param_to_return)
+                elif isinstance(stmt, ast.For):
+                    assign_to(stmt.target, ev(stmt.iter))
+                    walk(stmt.body)
+                    walk(stmt.orelse)
+                elif isinstance(stmt, (ast.While, ast.If)):
+                    ev(stmt.test)
+                    walk(stmt.body)
+                    walk(stmt.orelse)
+                elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                    for item in stmt.items:
+                        labels = ev(item.context_expr)
+                        if item.optional_vars is not None:
+                            assign_to(item.optional_vars, labels)
+                    walk(stmt.body)
+                elif isinstance(stmt, ast.Try):
+                    walk(stmt.body)
+                    for h in stmt.handlers:
+                        walk(h.body)
+                    walk(stmt.orelse)
+                    walk(stmt.finalbody)
+                elif isinstance(stmt, ast.Expr):
+                    ev(stmt.value)
+                elif isinstance(stmt, (ast.Raise, ast.Assert)):
+                    for child in ast.iter_child_nodes(stmt):
+                        if isinstance(child, ast.expr):
+                            ev(child)
+
+        # two body iterations: taint born late in a loop body reaches
+        # uses earlier in the (next) iteration; env only grows, so the
+        # second sweep is the loop-closure
+        walk(fn.node.body)
+        walk(fn.node.body)
+        return changed[0]
+
+    # -- public API --------------------------------------------------------
+
+    def function(self, qname: str) -> FunctionInfo | None:
+        return self.symbols.get(qname)
+
+    def summaries(self) -> list[dict]:
+        return [
+            fn.summary()
+            for _, fn in sorted(self.symbols.items())
+        ]
+
+
+__all__ = [
+    "Project",
+    "FunctionInfo",
+    "ModuleInfo",
+    "TaintFlow",
+    "CSP_SEAM_ALLOWED",
+    "BLOCKING_CALLS",
+]

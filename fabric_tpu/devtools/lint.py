@@ -1,4 +1,4 @@
-"""fabriclint — domain-aware AST invariant checker.
+"""fabriclint — domain-aware invariant checker (intra- + interprocedural).
 
 The north star routes ALL block-validation crypto through the pluggable
 CSP seam so it can batch onto TPU, and PR 2 made lock/fsync discipline
@@ -6,67 +6,101 @@ in the commit path load-bearing.  Those invariants are enforced here by
 machine, not reviewer memory: tier-1 runs this linter over the whole
 tree (tests/test_lint_clean.py) and fails on any unsuppressed violation.
 
+Since v2, rules are INTERPROCEDURAL where it matters: a whole-program
+pass (``devtools/dataflow.py``) resolves imports/aliases, builds a call
+graph, and computes per-function summaries (returns-digest,
+sinks-to-consensus-bytes, spawns-thread, acquires-lock,
+performs-blocking-io), so csp-seam sees digests computed via locals and
+helper functions, lock-discipline sees blocking I/O reached through any
+resolvable call chain under ``commit_lock``, and the taint rule follows
+wall-clock values through assignments, calls, and f-strings into
+protoutil marshaling.
+
 Rules
 -----
 csp-seam
     No direct ``hashlib`` use outside ``fabric_tpu/csp/`` and
-    ``fabric_tpu/common/crypto.py``.  Everything else must call the CSP
-    hash seam (``common.hashing.sha256``/``sha256_many`` or a CSP's
-    ``hash``/``hash_batch``) so new call sites stay visible to the
-    TPU-batched provider — or carry a reviewed pragma.
+    ``fabric_tpu/common/hashing.py``/``crypto.py`` — including local
+    aliases (``h = hashlib``) and calls to helper functions whose
+    bodies reach hashlib outside the seam (interprocedural; propagation
+    stops at reviewed/suppressed uses and at the seam itself).
 
 exception-discipline
     No ``except Exception`` (or bare ``except``) in ``peer/``,
     ``policies/``, ``ledger/`` whose handler swallows without a
-    structured sentinel: a handler consisting only of
-    ``pass``/``continue``/``break``/trivial-constant ``return`` hides
-    failures on the validation path (the ``ERR_UNKNOWN_SKI`` direction
-    from the custody work).  Re-raising, assigning a sentinel, calling a
-    logger, or returning a named error code all count as structured.
+    structured sentinel (re-raise, sentinel assignment, logger call, or
+    named error return).
 
 determinism
     In validation/commit/policy paths where peers must agree (``peer/``,
     ``policies/``, ``ledger/``, ``protoutil/``): ban ``time.time()``,
-    ``datetime.now()``/``utcnow()``, module-level ``random.*`` calls
-    (an injected seeded ``random.Random`` instance is fine), and
-    ``json.dumps`` without ``sort_keys=True`` (dict-order-dependent
-    serialization).
+    ``datetime.now()``/``utcnow()``, module-level ``random.*`` calls,
+    and ``json.dumps`` without ``sort_keys=True``.
+
+taint
+    (interprocedural, whole tree) wall-clock/random values —
+    ``time.time()``, ``datetime.now()``, module-level ``random.*`` —
+    tracked through assignments, attribute fills, f-strings, and
+    resolvable calls, flagged where they flow INTO consensus bytes:
+    protoutil marshaling, protobuf (block-header) construction,
+    ``SerializeToString``.  Catches the cross-function smuggle the
+    determinism rule's call-site ban cannot see.
 
 lock-discipline
-    (a) a bare ``x.acquire()`` expression statement outside a
-    try/finally that releases (``__enter__`` methods are exempt — their
-    release lives in ``__exit__``); (b) lexically nested ``with`` lock
-    acquisitions that inverse the canonical order
-    ``commit_lock -> manager _lock -> _idle``; (c) blocking I/O (fsync,
-    sqlite txn flush/execute, sleep) — directly or through a same-class
-    helper method — while lexically holding ``commit_lock``, outside the
-    approved group-commit seam (allowlisted, with reasons).
+    (a) bare ``x.acquire()`` outside try/finally; (b) lexically nested
+    ``with`` acquisitions inverting ``commit_lock -> _lock -> _idle``;
+    (c) blocking I/O (fsync, sqlite execute, sleep) — directly, through
+    a same-class helper, or through ANY statically resolvable call
+    chain (interprocedural) — while lexically holding ``commit_lock``,
+    outside the approved group-commit seam.
+
+thread-hygiene
+    No daemonized ``threading.Thread``/``Timer`` created outside the
+    threadwatch seam (``devtools/lockwatch.spawn_thread``/
+    ``spawn_timer``).  A daemon thread nobody can drain is exactly the
+    `tpu-flush-waiter` that the interpreter killed mid-XLA-kernel
+    (MULTICHIP rc=134): registration makes every worker joinable before
+    interpreter exit, and the runtime threadwatch ledger (see
+    lockwatch.py) asserts they actually drained.
 
 jax-hygiene
     No host synchronization (``block_until_ready``, ``device_get``)
-    inside per-item ``for``/``while`` loops: batch paths must make ONE
-    device round-trip per batch, not one per item.
+    inside per-item ``for``/``while`` loops.
+
+Profiles
+--------
+``fabric_tpu/`` lints under the strict profile (everything at error
+severity).  ``tests/`` and ``scripts/`` lint under the RELAXED profile:
+determinism, taint, and jax-hygiene are off (tests fabricate
+timestamps and sync per-item by design), csp-seam is advisory
+(warning severity — tests hash directly to build expectations), and
+everything else stays at error.  ``tests/lint_fixtures/`` is skipped
+entirely (deliberately-dirty fixtures for the engine's own tests).
 
 Suppression
 -----------
 Inline pragma: a ``fabriclint: allow[<rule>] <reason>`` comment on the
-offending line, or in the contiguous comment block immediately above it,
-or in the comment block opening the flagged statement's body (so an
-``except Exception:`` can carry its pragma inside the handler, where the
-explanation reads naturally).  Only real comments count — pragma-shaped
-text inside strings and docstrings (like the example in this one) is
-ignored.
+offending line, the contiguous comment block above it, or the comment
+block opening the flagged statement's body.  A pragma MUST carry a
+reason and MUST suppress something.  Cross-file entries live in
+``devtools/allowlist.py``; unused entries are violations, so the
+surface only shrinks.
 
-A pragma MUST carry a non-empty reason and MUST suppress something —
-reason-less and unused pragmas are violations themselves.  Cross-file
-entries live in ``fabric_tpu/devtools/allowlist.py``; unused entries are
-violations too, so the allowlist can only shrink as code is fixed.
+Baseline ratchet
+----------------
+``--baseline FILE`` reads a JSON ``{"rule": count}`` budget: up to
+``count`` unsuppressed errors per rule are tolerated (reported, but not
+fatal), so a new rule can land in warn mode and be tightened in the
+same PR once the tree is clean.  ``--write-baseline FILE`` records the
+current per-rule counts.  The ratchet only goes DOWN: a budget above
+the observed count is itself an error, so the carve-out cannot outlive
+the violations it covered.
 
 CLI
 ---
-``python -m fabric_tpu.devtools.lint [--json] [targets...]`` — exits
-non-zero on any unsuppressed violation; ``--json`` emits one JSON object
-per violation plus a final machine-readable summary line.
+``python -m fabric_tpu.devtools.lint [--json] [--baseline FILE]
+[targets...]`` — exits non-zero on any over-budget unsuppressed error;
+``--json`` emits one JSON object per violation plus a summary line.
 """
 
 from __future__ import annotations
@@ -81,11 +115,16 @@ import re
 import sys
 import tokenize
 
+from fabric_tpu.devtools import dataflow
+from fabric_tpu.devtools.dataflow import BLOCKING_CALLS, CSP_SEAM_ALLOWED
+
 RULES = (
     "csp-seam",
     "exception-discipline",
     "determinism",
+    "taint",
     "lock-discipline",
+    "thread-hygiene",
     "jax-hygiene",
 )
 
@@ -99,15 +138,6 @@ PRAGMA_RE = re.compile(
 
 # -- scopes ------------------------------------------------------------------
 
-# modules allowed to touch hashlib directly: the CSP providers (they ARE
-# the seam) and the seam's own stdlib-only host side (re-exported by
-# common/crypto.py for cert-side callers)
-CSP_SEAM_ALLOWED = (
-    "fabric_tpu/csp/",
-    "fabric_tpu/common/hashing.py",
-    "fabric_tpu/common/crypto.py",
-)
-
 EXC_SCOPE = (
     "fabric_tpu/peer/",
     "fabric_tpu/policies/",
@@ -116,12 +146,17 @@ EXC_SCOPE = (
 
 DET_SCOPE = EXC_SCOPE + ("fabric_tpu/protoutil/",)
 
-# generated code is exempt from everything
-SKIP_PREFIXES = ("fabric_tpu/protos/",)
+# generated code is exempt from everything; lint_fixtures are the
+# engine's own deliberately-dirty test corpus
+SKIP_PREFIXES = ("fabric_tpu/protos/", "tests/lint_fixtures/")
+
+# the one module allowed to construct daemon threads directly: it IS
+# the registration seam
+THREADWATCH_SEAM = "fabric_tpu/devtools/lockwatch.py"
+
+DEFAULT_TARGETS = ("fabric_tpu", "tests", "scripts")
 
 LOCK_RANKS = {
-    # canonical acquisition order: commit lock strictly before any
-    # manager/bookkeeping lock, which come before condition helpers
     "commit_lock": 0,
     "_commit_lock": 0,
     "_lock": 1,
@@ -130,11 +165,31 @@ LOCK_RANKS = {
 
 COMMIT_LOCK_NAMES = ("commit_lock", "_commit_lock")
 
-BLOCKING_CALLS = frozenset(
-    {"fsync", "sync_files", "sleep", "flush", "execute", "executemany"}
+JAX_SYNC_CALLS = frozenset({"block_until_ready", "device_get"})
+
+
+# -- profiles ----------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Profile:
+    name: str
+    disabled: tuple = ()
+    advisory: tuple = ()
+
+
+STRICT_PROFILE = Profile("strict")
+RELAXED_PROFILE = Profile(
+    "relaxed",
+    disabled=("determinism", "taint", "jax-hygiene"),
+    advisory=("csp-seam",),
 )
 
-JAX_SYNC_CALLS = frozenset({"block_until_ready", "device_get"})
+
+def profile_for(rel: str) -> Profile:
+    if rel.startswith(("tests/", "scripts/")):
+        return RELAXED_PROFILE
+    return STRICT_PROFILE
 
 
 @dataclasses.dataclass
@@ -145,10 +200,12 @@ class Violation:
     message: str
     suppressed: bool = False
     suppression: str | None = None  # "pragma: <reason>" / "allowlist: <reason>"
+    severity: str = "error"  # "error" | "warning" (advisory profiles)
 
     def __str__(self) -> str:
         tag = f" (suppressed: {self.suppression})" if self.suppressed else ""
-        return f"{self.path}:{self.line}: [{self.rule}] {self.message}{tag}"
+        sev = " [warning]" if self.severity == "warning" else ""
+        return f"{self.path}:{self.line}: [{self.rule}]{sev} {self.message}{tag}"
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -173,7 +230,9 @@ class AllowEntry:
 def _method_blocking_map(tree: ast.Module) -> dict[str, set[str]]:
     """class name -> names of its methods that perform a blocking call
     directly or through other methods of the same class (fixpoint over
-    ``self.x()`` edges)."""
+    ``self.x()`` edges).  The dataflow engine subsumes this for
+    resolvable calls; this lexical map stays as the zero-setup fallback
+    for single-snippet lint_source runs."""
     out: dict[str, set[str]] = {}
     for cls in ast.walk(tree):
         if not isinstance(cls, ast.ClassDef):
@@ -314,11 +373,14 @@ def _dotted_name(expr) -> str | None:
 
 
 class _FileChecker(ast.NodeVisitor):
-    def __init__(self, rel: str, tree: ast.Module):
+    def __init__(self, rel: str, tree: ast.Module,
+                 project: dataflow.Project | None = None):
         self.rel = rel
         self.violations: list[Violation] = []
         self._seen: set[tuple[str, int]] = set()
         self._hashlib_aliases: set[str] = set()
+        self._threading_aliases: set[str] = set()
+        self._thread_ctor_aliases: set[str] = set()
         self._time_fn_aliases: set[str] = set()
         self._random_fn_aliases: set[str] = set()
         self._datetime_aliases: set[str] = {"datetime", "date"}
@@ -329,6 +391,7 @@ class _FileChecker(ast.NodeVisitor):
         self._protected_depth = 0  # inside a try whose finally releases
         self._blocking = _method_blocking_map(tree)
         self._preacquire_ok = _acquires_before_try_finally(tree)
+        self._project = project
 
     # -- helpers -----------------------------------------------------------
 
@@ -342,12 +405,22 @@ class _FileChecker(ast.NodeVisitor):
                       message=message)
         )
 
-    # -- imports (csp-seam alias tracking) ---------------------------------
+    def _resolved_callee(self, node: ast.Call):
+        if self._project is None:
+            return None
+        q = self._project.call_resolutions.get(
+            (self.rel, node.lineno, node.col_offset)
+        )
+        return self._project.symbols.get(q) if q else None
+
+    # -- imports (alias tracking) ------------------------------------------
 
     def visit_Import(self, node: ast.Import) -> None:
         for alias in node.names:
             if alias.name == "hashlib":
                 self._hashlib_aliases.add(alias.asname or "hashlib")
+            if alias.name == "threading":
+                self._threading_aliases.add(alias.asname or "threading")
         self.generic_visit(node)
 
     def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
@@ -360,6 +433,10 @@ class _FileChecker(ast.NodeVisitor):
                 "(route through common.hashing.sha256/sha256_many or a "
                 "CSP hash/hash_batch)",
             )
+        if node.module == "threading":
+            for alias in node.names:
+                if alias.name in ("Thread", "Timer"):
+                    self._thread_ctor_aliases.add(alias.asname or alias.name)
         if node.module == "time":
             for alias in node.names:
                 if alias.name == "time":
@@ -405,7 +482,27 @@ class _FileChecker(ast.NodeVisitor):
             )
         self.generic_visit(node)
 
-    # -- calls: determinism + lock blocking + jax hygiene -------------------
+    # -- assignments: thread-hygiene daemon flips ---------------------------
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        if self.rel != THREADWATCH_SEAM:
+            for t in node.targets:
+                if (
+                    isinstance(t, ast.Attribute)
+                    and t.attr == "daemon"
+                    and isinstance(node.value, ast.Constant)
+                    and node.value.value is True
+                ):
+                    self._flag(
+                        "thread-hygiene", node,
+                        "thread daemonized by attribute flip without "
+                        "threadwatch registration — create it through "
+                        "devtools.lockwatch.spawn_thread/spawn_timer so "
+                        "it can be drained before interpreter exit",
+                    )
+        self.generic_visit(node)
+
+    # -- calls: determinism + lock blocking + threads + jax hygiene ---------
 
     def visit_Call(self, node: ast.Call) -> None:
         f = node.func
@@ -461,6 +558,32 @@ class _FileChecker(ast.NodeVisitor):
                         "consensus path — dict order leaks into bytes",
                     )
 
+        # thread-hygiene: daemonized Thread/Timer outside the seam
+        is_thread_ctor = (
+            base in self._threading_aliases
+            and attr in ("Thread", "Timer")
+        ) or (
+            isinstance(f, ast.Name) and f.id in self._thread_ctor_aliases
+        )
+        if is_thread_ctor and self.rel != THREADWATCH_SEAM:
+            daemonized = any(
+                kw.arg == "daemon"
+                and isinstance(kw.value, ast.Constant)
+                and kw.value.value is True
+                for kw in node.keywords
+            )
+            if daemonized:
+                self._flag(
+                    "thread-hygiene", node,
+                    "daemonized thread created outside the threadwatch "
+                    "seam — a daemon thread nobody registered cannot be "
+                    "drained and dies mid-kernel at interpreter exit "
+                    "(the MULTICHIP rc=134 class); create it through "
+                    "devtools.lockwatch.spawn_thread/spawn_timer",
+                )
+
+        callee = self._resolved_callee(node)
+
         if attr is not None and any(
             n in COMMIT_LOCK_NAMES for n in self._with_locks
         ):
@@ -482,6 +605,17 @@ class _FileChecker(ast.NodeVisitor):
                     "while holding the commit lock, outside the approved "
                     "group-commit seam",
                 )
+        if (
+            callee is not None
+            and callee.blocking_transitive
+            and any(n in COMMIT_LOCK_NAMES for n in self._with_locks)
+        ):
+            self._flag(
+                "lock-discipline", node,
+                f"call to {callee.qname} performs blocking I/O "
+                "(interprocedurally) while holding the commit lock, "
+                "outside the approved group-commit seam",
+            )
 
         if attr in JAX_SYNC_CALLS and self._loop_depth > 0:
             self._flag(
@@ -654,10 +788,13 @@ def _apply_suppressions(
     lines: list[str],
     allowlist: list[AllowEntry],
     used_entries: set[int],
-) -> set[int]:
-    """Mark violations suppressed in place; returns used pragma lines."""
-    used_pragmas: set[int] = set()
+    used_pragmas: set[int],
+) -> None:
+    """Mark violations suppressed in place; accumulates used pragma
+    lines into `used_pragmas`."""
     for v in violations:
+        if v.suppressed:
+            continue
         for ln in _pragma_candidate_lines(v.line, comment_only, lines):
             p = pragmas.get(ln)
             if p and v.rule in p[0]:
@@ -674,10 +811,220 @@ def _apply_suppressions(
                 v.suppression = f"allowlist: {e.reason}"
                 used_entries.add(idx)
                 break
-    return used_pragmas
 
 
 # -- drivers -----------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class _FileState:
+    rel: str
+    source: str
+    lines: list[str]
+    tree: ast.Module | None
+    pragmas: dict
+    comment_only: set
+    meta: list
+    violations: list = dataclasses.field(default_factory=list)
+    used_pragmas: set = dataclasses.field(default_factory=set)
+
+
+def _interprocedural_csp_seam(
+    project: dataflow.Project,
+    states: dict[str, _FileState],
+    allowlist: list[AllowEntry],
+    used_entries: set[int],
+) -> None:
+    """Flag callers of helpers whose bodies reach hashlib outside the
+    seam — but only helpers whose own direct use is UNSUPPRESSED: a
+    reviewed pragma on the helper is the reviewed design decision, and
+    propagating past it would demand a pragma per caller for one
+    reviewed fact.  Runs to a fixpoint so a dirty helper's caller that
+    itself goes unsuppressed taints ITS callers in turn."""
+    # call site index: callee qname -> [(rel, line)]
+    sites: dict[str, list] = {}
+    for (rel, line, col), q in project.call_resolutions.items():
+        sites.setdefault(q, []).append((rel, line))
+    for _ in range(8):
+        dirty: set[str] = set()
+        for q, fn in project.symbols.items():
+            st = states.get(fn.rel)
+            if st is None or dataflow._in_seam(fn.rel):
+                continue
+            end = getattr(fn.node, "end_lineno", fn.lineno)
+            for v in st.violations:
+                if (
+                    v.rule == "csp-seam"
+                    and not v.suppressed
+                    and fn.lineno <= v.line <= end
+                ):
+                    dirty.add(q)
+                    break
+        new = []
+        for q in dirty:
+            for rel, line in sites.get(q, ()):
+                st = states.get(rel)
+                if st is None or dataflow._in_seam(rel):
+                    continue
+                if any(
+                    v.rule == "csp-seam" and v.line == line
+                    for v in st.violations
+                ):
+                    continue
+                v = Violation(
+                    rule="csp-seam", path=rel, line=line,
+                    message=(
+                        f"digest computed via helper {q} whose body "
+                        "uses hashlib outside the CSP seam "
+                        "(interprocedural) — route the helper through "
+                        "common.hashing or the CSP"
+                    ),
+                )
+                prof = profile_for(rel)
+                if "csp-seam" in prof.disabled:
+                    continue
+                if "csp-seam" in prof.advisory:
+                    v.severity = "warning"
+                st.violations.append(v)
+                new.append((st, v))
+        if not new:
+            break
+        for st, v in new:
+            _apply_suppressions(
+                [v], st.pragmas, st.comment_only, st.lines,
+                allowlist, used_entries, st.used_pragmas,
+            )
+
+
+def lint_sources(
+    sources: dict[str, str],
+    allowlist: list[AllowEntry] | None = None,
+    used_entries: set[int] | None = None,
+) -> "LintReport":
+    """Lint a set of modules as one program (keys are repo-relative
+    paths; interprocedural rules see across all of them)."""
+    allowlist = allowlist if allowlist is not None else []
+    used_entries = used_entries if used_entries is not None else set()
+    states: dict[str, _FileState] = {}
+    trees: dict[str, ast.Module] = {}
+    for rel, source in sorted(sources.items()):
+        pragmas, comment_only, meta = _parse_pragmas(source, rel)
+        try:
+            tree = ast.parse(source)
+        except SyntaxError as exc:
+            meta = [Violation(
+                rule="pragma", path=rel, line=exc.lineno or 0,
+                message=f"file does not parse: {exc.msg}",
+            )]
+            states[rel] = _FileState(
+                rel=rel, source=source, lines=source.splitlines(),
+                tree=None, pragmas={}, comment_only=set(), meta=meta,
+            )
+            continue
+        trees[rel] = tree
+        states[rel] = _FileState(
+            rel=rel, source=source, lines=source.splitlines(),
+            tree=tree, pragmas=pragmas, comment_only=comment_only,
+            meta=meta,
+        )
+    # reviewed wall-clock sources: lines covered by an allow[determinism]
+    # / allow[taint] pragma do not SEED taint (see dataflow.Project)
+    sanctioned: dict[str, set] = {}
+    for rel, st in states.items():
+        relevant = {
+            ln for ln, (rules, _r) in st.pragmas.items()
+            if rules & {"determinism", "taint"}
+        }
+        if not relevant:
+            continue
+        covered = set()
+        for v_line in range(1, len(st.lines) + 1):
+            for ln in _pragma_candidate_lines(
+                v_line, st.comment_only, st.lines
+            ):
+                if ln in relevant:
+                    covered.add(v_line)
+                    break
+        sanctioned[rel] = covered
+    project = dataflow.Project(trees, sanctioned_sources=sanctioned)
+
+    for rel, st in states.items():
+        if st.tree is None:
+            continue
+        checker = _FileChecker(rel, st.tree, project)
+        checker.visit(st.tree)
+        st.violations = checker.violations
+
+    # merge whole-program emissions into their files
+    for flow in project.alias_violations:
+        st = states.get(flow.rel)
+        if st is not None and not any(
+            v.rule == "csp-seam" and v.line == flow.line
+            for v in st.violations
+        ):
+            st.violations.append(Violation(
+                rule="csp-seam", path=flow.rel, line=flow.line,
+                message=flow.message,
+            ))
+    for flow in project.taint_flows:
+        st = states.get(flow.rel)
+        if st is not None and not any(
+            v.rule == "taint" and v.line == flow.line
+            for v in st.violations
+        ):
+            st.violations.append(Violation(
+                rule="taint", path=flow.rel, line=flow.line,
+                message=flow.message,
+            ))
+
+    # profiles: drop disabled rules, downgrade advisory ones
+    for rel, st in states.items():
+        prof = profile_for(rel)
+        if prof.disabled or prof.advisory:
+            kept = []
+            for v in st.violations:
+                if v.rule in prof.disabled:
+                    continue
+                if v.rule in prof.advisory:
+                    v.severity = "warning"
+                kept.append(v)
+            st.violations = kept
+
+    for rel, st in states.items():
+        _apply_suppressions(
+            st.violations, st.pragmas, st.comment_only, st.lines,
+            allowlist, used_entries, st.used_pragmas,
+        )
+
+    _interprocedural_csp_seam(project, states, allowlist, used_entries)
+
+    # pragmas whose job was sanctioning a taint source count as used
+    for rel, src_line in project.sanctioned_used:
+        st = states.get(rel)
+        if st is None:
+            continue
+        for ln in _pragma_candidate_lines(
+            src_line, st.comment_only, st.lines
+        ):
+            p = st.pragmas.get(ln)
+            if p and p[0] & {"determinism", "taint"}:
+                st.used_pragmas.add(ln)
+                break
+
+    violations: list[Violation] = []
+    for rel in sorted(states):
+        st = states[rel]
+        for ln in sorted(set(st.pragmas) - st.used_pragmas):
+            st.meta.append(Violation(
+                rule="pragma", path=rel, line=ln,
+                message="unused pragma — it suppresses nothing; remove "
+                        "it (or it is masking a rule that moved)",
+            ))
+        st.violations.sort(key=lambda v: v.line)
+        violations.extend(st.violations + st.meta)
+    return LintReport(
+        files=len(states), violations=violations, project=project,
+    )
 
 
 def lint_source(
@@ -686,31 +1033,13 @@ def lint_source(
     allowlist: list[AllowEntry] | None = None,
     used_entries: set[int] | None = None,
 ) -> list[Violation]:
-    """Lint one module's source as if it lived at repo-relative `rel`."""
-    allowlist = allowlist if allowlist is not None else []
-    used_entries = used_entries if used_entries is not None else set()
-    try:
-        tree = ast.parse(source)
-    except SyntaxError as exc:
-        return [Violation(
-            rule="pragma", path=rel, line=exc.lineno or 0,
-            message=f"file does not parse: {exc.msg}",
-        )]
-    lines = source.splitlines()
-    pragmas, comment_only, meta = _parse_pragmas(source, rel)
-    checker = _FileChecker(rel, tree)
-    checker.visit(tree)
-    violations = checker.violations
-    used_pragmas = _apply_suppressions(
-        violations, pragmas, comment_only, lines, allowlist, used_entries
+    """Lint one module's source as if it lived at repo-relative `rel`
+    (single-module program: interprocedural rules see same-file helpers
+    only)."""
+    report = lint_sources(
+        {rel: source}, allowlist=allowlist, used_entries=used_entries
     )
-    for ln in sorted(set(pragmas) - used_pragmas):
-        meta.append(Violation(
-            rule="pragma", path=rel, line=ln,
-            message="unused pragma — it suppresses nothing; remove it "
-                    "(or it is masking a rule that moved)",
-        ))
-    return violations + meta
+    return report.violations
 
 
 def repo_root() -> str:
@@ -756,10 +1085,23 @@ def iter_target_files(root: str, targets) -> list[str]:
 class LintReport:
     files: int
     violations: list[Violation]
+    project: dataflow.Project | None = None
 
     @property
     def unsuppressed(self) -> list[Violation]:
-        return [v for v in self.violations if not v.suppressed]
+        """Unsuppressed ERROR-severity violations (the gate)."""
+        return [
+            v for v in self.violations
+            if not v.suppressed and v.severity == "error"
+        ]
+
+    @property
+    def warnings(self) -> list[Violation]:
+        """Unsuppressed advisory (warning-severity) violations."""
+        return [
+            v for v in self.violations
+            if not v.suppressed and v.severity == "warning"
+        ]
 
     @property
     def suppressed(self) -> list[Violation]:
@@ -769,19 +1111,24 @@ class LintReport:
         by_rule: dict[str, int] = {}
         for v in self.unsuppressed:
             by_rule[v.rule] = by_rule.get(v.rule, 0) + 1
+        warn_by_rule: dict[str, int] = {}
+        for v in self.warnings:
+            warn_by_rule[v.rule] = warn_by_rule.get(v.rule, 0) + 1
         return {
             "tool": "fabriclint",
             "files": self.files,
             "violations": len(self.unsuppressed),
+            "warnings": len(self.warnings),
             "suppressed": len(self.suppressed),
             "by_rule": dict(sorted(by_rule.items())),
+            "warn_by_rule": dict(sorted(warn_by_rule.items())),
             "clean": not self.unsuppressed,
         }
 
 
 def lint_tree(
     root: str | None = None,
-    targets=("fabric_tpu",),
+    targets=DEFAULT_TARGETS,
     allowlist: list[AllowEntry] | None = None,
 ) -> LintReport:
     root = root or repo_root()
@@ -790,14 +1137,12 @@ def lint_tree(
 
         allowlist = list(ALLOWLIST)
     used_entries: set[int] = set()
-    violations: list[Violation] = []
     rels = iter_target_files(root, targets)
+    sources: dict[str, str] = {}
     for rel in rels:
         with open(os.path.join(root, rel), "r", encoding="utf-8") as f:
-            source = f.read()
-        violations.extend(
-            lint_source(source, rel, allowlist, used_entries)
-        )
+            sources[rel] = f.read()
+    report = lint_sources(sources, allowlist, used_entries)
     # an entry is in this run's scope if its file was linted, or if it
     # falls under a directory target (so full-tree runs flag entries
     # whose file was DELETED, while partial runs — one file, one subdir —
@@ -810,7 +1155,7 @@ def lint_tree(
     for idx, e in enumerate(allowlist):
         in_scope = e.path in linted or e.path.startswith(dir_prefixes)
         if idx not in used_entries and in_scope:
-            violations.append(Violation(
+            report.violations.append(Violation(
                 rule="allowlist",
                 path="fabric_tpu/devtools/allowlist.py",
                 line=0,
@@ -818,17 +1163,60 @@ def lint_tree(
                         f"matching {e.match!r}) — the code it covered "
                         f"is gone; remove the entry",
             ))
-    return LintReport(files=len(rels), violations=violations)
+    return report
+
+
+# -- baseline ratchet --------------------------------------------------------
+
+
+def load_baseline(path: str) -> dict[str, int]:
+    with open(path, "r", encoding="utf-8") as f:
+        budgets = json.load(f)
+    if not isinstance(budgets, dict) or not all(
+        isinstance(k, str) and isinstance(v, int) and v >= 0
+        for k, v in budgets.items()
+    ):
+        raise ValueError(
+            f"baseline {path!r} must be a JSON object of "
+            "non-negative per-rule counts"
+        )
+    return budgets
+
+
+def apply_baseline(report: LintReport, budgets: dict[str, int]) -> dict:
+    """Ratchet evaluation: per-rule unsuppressed-error counts vs the
+    budget.  Over-budget rules fail; a budget LOOSER than reality also
+    fails (the ratchet only tightens — stale carve-outs must die the
+    moment the tree is cleaner than they claim)."""
+    counts = report.summary()["by_rule"]
+    over = {
+        r: c - budgets.get(r, 0)
+        for r, c in counts.items()
+        if c > budgets.get(r, 0)
+    }
+    stale = {
+        r: b for r, b in budgets.items()
+        if b > counts.get(r, 0)
+    }
+    return {
+        "budgets": budgets,
+        "ratcheted": sum(min(counts.get(r, 0), b)
+                         for r, b in budgets.items()),
+        "over_budget": over,
+        "stale_budget": stale,
+        "ok": not over and not stale,
+    }
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m fabric_tpu.devtools.lint",
-        description="fabriclint: AST invariant checker for fabric_tpu",
+        description="fabriclint: invariant checker for fabric_tpu",
     )
     ap.add_argument(
-        "targets", nargs="*", default=["fabric_tpu"],
-        help="repo-relative files/dirs to lint (default: fabric_tpu)",
+        "targets", nargs="*", default=list(DEFAULT_TARGETS),
+        help="repo-relative files/dirs to lint "
+             f"(default: {' '.join(DEFAULT_TARGETS)})",
     )
     ap.add_argument("--root", default=None, help="repo root override")
     ap.add_argument(
@@ -839,6 +1227,20 @@ def main(argv=None) -> int:
         "--show-suppressed", action="store_true",
         help="also print suppressed violations",
     )
+    ap.add_argument(
+        "--baseline", default=None, metavar="FILE",
+        help="JSON {rule: count} ratchet: tolerate up to COUNT "
+             "unsuppressed errors per rule (stale budgets fail)",
+    )
+    ap.add_argument(
+        "--write-baseline", default=None, metavar="FILE",
+        help="record current per-rule unsuppressed-error counts and "
+             "exit 0",
+    )
+    ap.add_argument(
+        "--summaries", action="store_true",
+        help="dump the dataflow engine's per-function summaries (JSON)",
+    )
     args = ap.parse_args(argv)
 
     try:
@@ -848,10 +1250,32 @@ def main(argv=None) -> int:
               if args.json else f"fabriclint: error: {exc}",
               file=sys.stderr)
         return 2
-    shown = report.violations if args.show_suppressed else report.unsuppressed
+
+    if args.summaries and report.project is not None:
+        for s in report.project.summaries():
+            print(json.dumps(s))
+        return 0
+
+    shown = list(report.unsuppressed) + list(report.warnings)
+    if args.show_suppressed:
+        shown += report.suppressed
     for v in shown:
         print(json.dumps(v.to_dict()) if args.json else str(v))
-    print(json.dumps(report.summary()))
+
+    summary = report.summary()
+    if args.write_baseline:
+        with open(args.write_baseline, "w", encoding="utf-8") as f:
+            json.dump(summary["by_rule"], f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(json.dumps({**summary, "baseline_written":
+                          args.write_baseline}))
+        return 0
+    if args.baseline:
+        ratchet = apply_baseline(report, load_baseline(args.baseline))
+        summary["baseline"] = ratchet
+        print(json.dumps(summary))
+        return 0 if ratchet["ok"] else 1
+    print(json.dumps(summary))
     return 0 if not report.unsuppressed else 1
 
 

@@ -1,4 +1,4 @@
-"""Runtime lock-order watchdog.
+"""Runtime lock-order + thread-lifecycle watchdog.
 
 fabriclint's static lock-order rule only sees LEXICALLY nested `with`
 blocks; real inversions usually span call chains (commit thread holds
@@ -26,6 +26,37 @@ have bitten this codebase).  Cross-thread release of a watched plain
 Lock (handoff patterns) is unsupported: it raises in the default mode
 so the held-stack bookkeeping can never silently rot; record mode logs
 it and performs the handoff unperturbed.
+
+THREADWATCH (the thread-lifecycle half): every daemonized worker in the
+tree is created through ``spawn_thread``/``spawn_timer`` (fabriclint's
+thread-hygiene rule enforces this statically).  Normally they return
+plain ``threading.Thread``/``Timer`` objects — zero overhead.  Under
+``FABRIC_TPU_THREADWATCH`` (tests/conftest.py sets it) each spawned
+thread registers itself in a process-wide live registry on entry,
+records any unhandled exception into ``thread_violations`` (a worker
+dying silently on a daemon thread is the failure mode that turned
+MULTICHIP green runs into rc=134 aborts), and deregisters on exit.
+``drain_threads`` joins live registered threads against a deadline and
+records stragglers as violations; the session-end fixture in conftest
+asserts the ledger is empty, so a worker leaked past its owner's
+drain/close fails the suite deterministically instead of aborting the
+interpreter ("FATAL: exception not rethrown") at teardown.
+
+Threads register with a ``kind``: ``"worker"`` for bounded jobs that
+MUST be gone once their owner drains (flush waiters, snapshot exports,
+stream committers) and ``"service"`` for run-until-stopped loops
+(acceptors, gossip, orderer consensus).  ``drain_threads`` drains
+workers by default — a service leaking past its owner's ``stop()`` is
+that owner's bug and is covered by its own close paths, while worker
+drains are the interpreter-exit safety property this module exists to
+enforce.
+
+CONDITION ORDERING: ``named_condition`` wraps a condition variable in
+the same order graph.  ``wait()`` while holding a lock that is an
+order-PREDECESSOR of the condition's own lock is flagged (and raises in
+the default mode): the wait releases only the condition's lock, so a
+waker that follows the canonical order blocks on the held predecessor
+and the wait never ends.
 """
 
 from __future__ import annotations
@@ -247,13 +278,333 @@ def named_rlock(name: str):
     return threading.RLock()
 
 
+# -- condition-variable wait ordering ----------------------------------------
+
+
+class WatchedCondition:
+    """Condition variable whose wait() participates in the order graph.
+
+    Composed of a WatchedLock (enter/exit bookkeeping feeds the same
+    acquisition-order edges as any lock) and a plain Condition sharing
+    the SAME underlying lock object.  ``wait()`` first checks the
+    thread's held-stack: holding any lock with an established path TO
+    this condition's role is a deadlock-capable wait (the waker follows
+    the canonical order, blocks on the held predecessor, and the notify
+    never comes) — recorded and raised like a lock inversion.  During
+    the wait the condition's own entry leaves the held-stack (the wait
+    releases the lock) and returns afterwards."""
+
+    def __init__(self, name: str, factory=threading.RLock):
+        self.name = name
+        self._wlock = WatchedLock(name, factory)
+        self._cond = threading.Condition(self._wlock._inner)
+
+    def acquire(self, *a, **k):
+        return self._wlock.acquire(*a, **k)
+
+    def release(self):
+        self._wlock.release()
+
+    def __enter__(self) -> "WatchedCondition":
+        self._wlock.acquire()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self._wlock.release()
+        return False
+
+    def wait(self, timeout: float | None = None) -> bool:
+        st = _held()
+        bad = None
+        with _state_lock:
+            for held, _cnt in st:
+                if held is self._wlock or held.name == self.name:
+                    continue
+                path = _find_path(held.name, self.name)
+                if path is not None:
+                    bad = {
+                        "event": "wait-while-holding-predecessor",
+                        "condition": self.name,
+                        "holding": held.name,
+                        "cycle": path + [self.name],
+                        "thread": threading.current_thread().name,
+                    }
+                    violations.append(bad)
+                    break
+        if bad is not None and _raise_mode():
+            raise LockOrderError(
+                f"wait on condition {self.name!r} while holding its "
+                f"order-predecessor {bad['holding']!r} (established "
+                f"order: {' -> '.join(bad['cycle'])}); the waker cannot "
+                "reach notify without the held lock"
+            )
+        entry = None
+        for i in range(len(st) - 1, -1, -1):
+            if st[i][0] is self._wlock:
+                entry = st.pop(i)
+                break
+        try:
+            return self._cond.wait(timeout)
+        finally:
+            if entry is not None:
+                st.append(entry)
+
+    def wait_for(self, predicate, timeout: float | None = None):
+        import time as _time
+
+        endtime = None
+        result = predicate()
+        while not result:
+            if timeout is not None:
+                if endtime is None:
+                    endtime = _time.monotonic() + timeout
+                waittime = endtime - _time.monotonic()
+                if waittime <= 0:
+                    break
+                self.wait(waittime)
+            else:
+                self.wait()
+            result = predicate()
+        return result
+
+    def notify(self, n: int = 1) -> None:
+        self._cond.notify(n)
+
+    def notify_all(self) -> None:
+        self._cond.notify_all()
+
+    def __repr__(self) -> str:
+        return f"<WatchedCondition {self.name!r}>"
+
+
+def named_condition(name: str, factory=threading.RLock):
+    """A threading.Condition, wait-order-watched when
+    FABRIC_TPU_LOCKWATCH is set."""
+    if enabled():
+        return WatchedCondition(name, factory)
+    return threading.Condition(factory())
+
+
+# -- threadwatch: thread-lifecycle registry ----------------------------------
+
+_THREAD_ENV = "FABRIC_TPU_THREADWATCH"
+
+_threads_lock = threading.Lock()
+_live_threads: dict[int, dict] = {}  # id(thread) -> info
+thread_violations: list[dict] = []
+
+
+def threads_enabled() -> bool:
+    return os.environ.get(_THREAD_ENV, "") not in ("", "0", "false", "off")
+
+
+def reset_threads() -> None:
+    """Clear recorded thread violations (tests).  The live registry is
+    left alone — threads that exist keep existing."""
+    with _threads_lock:
+        thread_violations.clear()
+
+
+def threads_alive(kinds=None) -> list[dict]:
+    """Snapshot of live registered threads (name/kind/thread).  Entries
+    whose thread ran and finished without the wrapper's deregistration
+    (a timer cancelled after start: its callback — and thus the
+    wrapper — never executes) are pruned here; entries registered but
+    not yet scheduled (ident is None) are kept, which is the whole
+    point of registering before start()."""
+    with _threads_lock:
+        dead = [
+            key for key, info in _live_threads.items()
+            if not info["thread"].is_alive()
+            and info["thread"].ident is not None
+        ]
+        for key in dead:
+            del _live_threads[key]
+        return [
+            dict(info) for info in _live_threads.values()
+            if kinds is None or info["kind"] in kinds
+        ]
+
+
+def _register(t, kind: str) -> None:
+    with _threads_lock:
+        _live_threads[id(t)] = {"name": t.name, "kind": kind, "thread": t}
+
+
+def _deregister(t) -> None:
+    with _threads_lock:
+        _live_threads.pop(id(t), None)
+
+
+def _wrap_target(cell: dict, kind: str, target):
+    """The shared watched-thread body: run the real target, record any
+    unhandled exception into the ledger (a daemon worker dying silently
+    is how green runs become teardown aborts), deregister on exit."""
+
+    def run(*a, **k):
+        t = cell["thread"]
+        try:
+            target(*a, **k)
+        except BaseException as exc:
+            with _threads_lock:
+                thread_violations.append({
+                    "event": "unhandled-exception",
+                    "thread": t.name,
+                    "kind": kind,
+                    "error": repr(exc),
+                })
+            raise
+        finally:
+            _deregister(t)
+
+    return run
+
+
+def _registering_start(t, super_start) -> None:
+    """start() that registers BEFORE the OS thread exists, so a drain
+    sweep can never miss a started-but-not-yet-scheduled worker
+    (registering inside the target would leave exactly that window).
+    A double-start must not touch the registry: the rollback is only
+    for a start() that registered THIS call — deregistering on the
+    'already started' RuntimeError would erase the live thread's entry
+    and hide it from the drain gate."""
+    if t.ident is not None or t.is_alive():
+        super_start()  # raises "threads can only be started once"
+        return
+    _register(t, t._tw_kind)
+    try:
+        super_start()
+    except BaseException:
+        _deregister(t)
+        raise
+
+
+class _WatchedThread(threading.Thread):
+    _tw_kind = "worker"
+
+    def start(self) -> None:
+        _registering_start(self, super().start)
+
+
+class _WatchedTimer(threading.Timer):
+    _tw_kind = "service"
+
+    def start(self) -> None:
+        _registering_start(self, super().start)
+
+
+def spawn_thread(target, *, name: str | None = None, args=(),
+                 kwargs=None, daemon: bool = True,
+                 kind: str = "worker") -> threading.Thread:
+    """Create (NOT start) a daemonized thread through the threadwatch
+    seam — the only sanctioned way to daemonize in this tree
+    (fabriclint thread-hygiene).  Plain Thread normally; under
+    FABRIC_TPU_THREADWATCH the thread registers in the live registry
+    when ``start()`` is called (before the OS thread exists), records
+    unhandled exceptions into ``thread_violations``, and deregisters on
+    exit.
+
+    kind="worker": a bounded job the owner must drain before exit
+    (flush waiter, snapshot export, stream committer).
+    kind="service": a run-until-stopped loop with its own stop/close
+    path (acceptor, gossip, consensus); exempt from the default
+    drain_threads sweep."""
+    if kind not in ("worker", "service"):
+        raise ValueError(f"unknown thread kind {kind!r}")
+    kwargs = kwargs or {}
+    if not threads_enabled():
+        return threading.Thread(
+            target=target, name=name, args=args, kwargs=kwargs,
+            daemon=daemon,
+        )
+    cell: dict = {}
+    run = _wrap_target(cell, kind, target)
+    t = _WatchedThread(
+        target=run, name=name, args=args, kwargs=kwargs, daemon=daemon
+    )
+    t._tw_kind = kind
+    cell["thread"] = t
+    return t
+
+
+def spawn_timer(interval: float, function, *, name: str | None = None,
+                args=(), kwargs=None,
+                kind: str = "service") -> threading.Timer:
+    """threading.Timer through the threadwatch seam (daemonized).  A
+    timer cancelled after start() skips its callback, so the wrapper's
+    deregistration never runs — the registry prunes such dead entries
+    on every read (threads_alive), which is exactly the drain
+    semantics a cancel-on-halt timer needs."""
+    if kind not in ("worker", "service"):
+        raise ValueError(f"unknown thread kind {kind!r}")
+    kwargs = kwargs or {}
+    if not threads_enabled():
+        t = threading.Timer(interval, function, args=args, kwargs=kwargs)
+        t.daemon = True
+        if name:
+            t.name = name
+        return t
+    cell: dict = {}
+    run = _wrap_target(cell, kind, function)
+    t = _WatchedTimer(interval, run, args=args, kwargs=kwargs)
+    t._tw_kind = kind
+    t.daemon = True
+    if name:
+        t.name = name
+    cell["thread"] = t
+    return t
+
+
+def drain_threads(timeout: float = 10.0, kinds=("worker",)) -> list[str]:
+    """Join every live registered thread of the given kinds against one
+    shared deadline.  Stragglers are recorded in ``thread_violations``
+    (event "drain-timeout") and returned — the session-end gate turns
+    them into failures, because a worker still running at interpreter
+    exit is precisely the thread the runtime kills mid-kernel."""
+    import time as _time
+
+    deadline = _time.monotonic() + timeout
+    stragglers: list[str] = []
+    for info in threads_alive(kinds):
+        t = info["thread"]
+        remaining = deadline - _time.monotonic()
+        if remaining > 0:
+            try:
+                t.join(remaining)
+            except RuntimeError:
+                # registered but its start() is still in flight on the
+                # owning thread (registration happens-before start);
+                # give the bootstrap a beat and fall through to the
+                # is_alive check
+                _time.sleep(0.01)
+        if t.is_alive():
+            stragglers.append(info["name"])
+            with _threads_lock:
+                thread_violations.append({
+                    "event": "drain-timeout",
+                    "thread": info["name"],
+                    "kind": info["kind"],
+                    "timeout": timeout,
+                })
+    return stragglers
+
+
 __all__ = [
     "LockOrderError",
     "WatchedLock",
+    "WatchedCondition",
     "named_lock",
     "named_rlock",
+    "named_condition",
     "enabled",
     "reset",
     "edges",
     "violations",
+    "spawn_thread",
+    "spawn_timer",
+    "threads_enabled",
+    "threads_alive",
+    "thread_violations",
+    "reset_threads",
+    "drain_threads",
 ]

@@ -24,7 +24,7 @@ import socket
 import struct
 import threading
 
-from fabric_tpu.devtools.lockwatch import named_lock
+from fabric_tpu.devtools.lockwatch import named_lock, spawn_thread
 from fabric_tpu.protos.gossip import message_pb2 as gpb
 
 _LEN = struct.Struct(">I")
@@ -234,7 +234,9 @@ class TCPGossipComm(GossipComm):
         self._out: dict[str, queue.Queue] = {}
         self._lock = named_lock("gossip.comm.out")
         self._stop = threading.Event()
-        threading.Thread(target=self._accept, daemon=True).start()
+        spawn_thread(
+            target=self._accept, name="gossip-accept", kind="service"
+        ).start()
 
     # -- outbound ----------------------------------------------------------
 
@@ -244,8 +246,9 @@ class TCPGossipComm(GossipComm):
             if q is None:
                 q = queue.Queue(maxsize=1024)
                 self._out[to_endpoint] = q
-                threading.Thread(
-                    target=self._sender, args=(to_endpoint, q), daemon=True
+                spawn_thread(
+                    target=self._sender, args=(to_endpoint, q),
+                    name=f"gossip-send-{to_endpoint}", kind="service",
                 ).start()
         try:
             q.put_nowait(self.wrap(msg).SerializeToString())
@@ -302,7 +305,10 @@ class TCPGossipComm(GossipComm):
                 conn, _ = self._server.accept()
             except OSError:
                 return
-            threading.Thread(target=self._serve, args=(conn,), daemon=True).start()
+            spawn_thread(
+                target=self._serve, args=(conn,),
+                name="gossip-serve", kind="service",
+            ).start()
 
     # same bound as the RPC transport's frame cap: a peer declaring a
     # multi-GB frame must be cut off, not streamed into memory

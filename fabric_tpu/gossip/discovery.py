@@ -13,6 +13,7 @@ from __future__ import annotations
 import threading
 import time
 
+from fabric_tpu.devtools.lockwatch import spawn_thread
 from fabric_tpu.protos.gossip import message_pb2 as gpb
 
 
@@ -165,7 +166,9 @@ class Discovery:
         self.core = core
         self._interval = tick_interval_s
         self._stop = threading.Event()
-        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread = spawn_thread(
+            target=self._run, name="gossip-discovery", kind="service"
+        )
 
     def start(self) -> None:
         self._thread.start()

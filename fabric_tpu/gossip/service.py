@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import threading
 
+from fabric_tpu.devtools.lockwatch import spawn_thread
 from fabric_tpu.gossip.certstore import CertStore
 from fabric_tpu.gossip.core import ChannelGossip
 from fabric_tpu.gossip.discovery import DiscoveryCore
@@ -118,7 +119,9 @@ class GossipRunner:
         self._svc = service
         self._interval = tick_interval_s
         self._stop = threading.Event()
-        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread = spawn_thread(
+            target=self._run, name="gossip-ticker", kind="service"
+        )
 
     def start(self) -> None:
         self._thread.start()

@@ -45,7 +45,11 @@ import struct
 import threading
 import time
 
-from fabric_tpu.devtools.lockwatch import named_lock
+from fabric_tpu.devtools.lockwatch import (
+    named_condition,
+    named_lock,
+    spawn_thread,
+)
 from fabric_tpu.ledger.bookkeeping import (
     SNAPSHOT_REQUEST,
     BookkeepingProvider,
@@ -433,7 +437,7 @@ class SnapshotManager:
         # ACQUIRED the ledger commit lock — commits wait for the two to
         # match so a pinned export runs before state advances past its
         # height (the reference blocks commits during generation too)
-        self._idle = threading.Condition()
+        self._idle = named_condition("snapshot.idle")
         self._inflight = 0
         self._spawn_seq = 0
         self._ack_seq = 0
@@ -548,9 +552,9 @@ class SnapshotManager:
         with self._idle:
             self._inflight += 1
             self._spawn_seq += 1
-        threading.Thread(
+        spawn_thread(
             target=self._bg_generate, args=(block_number,),
-            name=f"snapshot-gen-{self._ledger.ledger_id}", daemon=True,
+            name=f"snapshot-gen-{self._ledger.ledger_id}", kind="worker",
         ).start()
 
     def wait_generation_turn(self, timeout: float = 30.0) -> None:

@@ -26,6 +26,8 @@ import itertools
 import os
 import threading
 
+from fabric_tpu.devtools.lockwatch import spawn_thread
+
 from fabric_tpu.chaincode import ChaincodeSupport, InProcStream
 from fabric_tpu.chaincode.lifecycle import (
     DefinitionProvider,
@@ -729,8 +731,9 @@ class PeerNode:
                         except Exception:
                             pass  # endpoints down; next sweep retries
 
-            self._reconcile_thread = threading.Thread(
-                target=reconcile_loop, daemon=True
+            self._reconcile_thread = spawn_thread(
+                target=reconcile_loop, name="pvtdata-reconciler",
+                kind="service",
             )
             self._reconcile_thread.start()
 

@@ -18,6 +18,8 @@ from __future__ import annotations
 
 import threading
 
+from fabric_tpu.devtools.lockwatch import spawn_thread
+
 from fabric_tpu.protos.common import common_pb2
 
 
@@ -86,8 +88,9 @@ class FollowerChain:
         return None
 
     def start(self) -> None:
-        self._thread = threading.Thread(
-            target=self._run, name=f"follower-{self.channel_id}", daemon=True
+        self._thread = spawn_thread(
+            target=self._run, name=f"follower-{self.channel_id}",
+            kind="service",
         )
         self._thread.start()
 

@@ -20,6 +20,12 @@ from __future__ import annotations
 import json
 import threading
 
+from fabric_tpu.devtools.lockwatch import (
+    named_condition,
+    spawn_thread,
+    spawn_timer,
+)
+
 from fabric_tpu.orderer.blockcutter import BlockCutter
 from fabric_tpu.orderer.blockwriter import BlockWriter
 
@@ -30,7 +36,7 @@ class Partition:
 
     def __init__(self):
         self._log: list[bytes] = []
-        self._cond = threading.Condition()
+        self._cond = named_condition("kafka.partition")
 
     def append(self, msg: bytes) -> int:
         with self._cond:
@@ -122,7 +128,9 @@ class KafkaChain:
         # the same partition starting from the same height agree
         self._pending_block = writer.height
         self._lock = threading.Lock()
-        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread = spawn_thread(
+            target=self._run, name="kafka-consenter", kind="service"
+        )
 
     # -- consensus SPI -----------------------------------------------------
 
@@ -158,13 +166,13 @@ class KafkaChain:
         with self._lock:
             if self._timer is None:
                 block_number = self._pending_block
-                self._timer = threading.Timer(
+                self._timer = spawn_timer(
                     self._timeout,
                     lambda: self._partition.append(
                         _wrap("timetocut", block_number=block_number)
                     ),
+                    name="kafka-batch-timer",
                 )
-                self._timer.daemon = True
                 self._timer.start()
 
     def _cancel_timer(self) -> None:

@@ -14,6 +14,8 @@ from __future__ import annotations
 import os
 import threading
 
+from fabric_tpu.devtools.lockwatch import spawn_thread
+
 from fabric_tpu.common.channelconfig import bundle_from_genesis
 from fabric_tpu.ledger.blkstorage import BlockStore
 from fabric_tpu.orderer.blockcutter import BlockCutter
@@ -238,11 +240,12 @@ class Registrar:
                 new_type != old_type
                 and "type" not in self._consenter_overrides
             ):
-                threading.Thread(
+                spawn_thread(
                     target=self._migrate_consenter,
                     args=(channel_id, new_bundle,
                           BlockCutter.from_orderer_config(oc)),
-                    daemon=True,
+                    name=f"consenter-migrate-{channel_id}",
+                    kind="worker",
                 ).start()
             else:
                 # same consenter keeps running: adopt the new BatchSize

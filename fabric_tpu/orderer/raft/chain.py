@@ -21,6 +21,8 @@ from __future__ import annotations
 
 import queue
 import threading
+
+from fabric_tpu.devtools.lockwatch import spawn_thread
 import time
 
 from fabric_tpu.orderer.blockcutter import BlockCutter
@@ -109,8 +111,9 @@ class RaftChain:
         self._events: queue.Queue = queue.Queue()
         self._halted = threading.Event()
         self._started = threading.Event()
-        self._thread = threading.Thread(
-            target=self._run, daemon=True, name=f"raft-{channel_id}-{node_id}"
+        self._thread = spawn_thread(
+            target=self._run, name=f"raft-{channel_id}-{node_id}",
+            kind="service",
         )
 
     # -- consenter SPI (orderer/consensus/consensus.go) --------------------
@@ -265,10 +268,10 @@ class RaftChain:
         # slow or hanging peer never freezes tick/step processing (the
         # reference likewise runs PeriodicCheck/EvictionSuspector off
         # the consensus goroutine).
-        threading.Thread(
+        spawn_thread(
             target=self._confirm_eviction,
             name=f"raft-eviction-probe-{self.channel_id}",
-            daemon=True,
+            kind="worker",
         ).start()
 
     def _confirm_eviction(self) -> None:

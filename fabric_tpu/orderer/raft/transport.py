@@ -28,6 +28,8 @@ import socket
 import struct
 import threading
 
+from fabric_tpu.devtools.lockwatch import spawn_thread
+
 from fabric_tpu.protos.orderer import raft_pb2 as rpb
 
 _LEN = struct.Struct(">I")
@@ -78,8 +80,10 @@ class _PeerSender:
         self._ssl_ctx = ssl_ctx
         self.q: queue.Queue = queue.Queue(maxsize=4096)
         self._sock: socket.socket | None = None
-        self._thread = threading.Thread(target=self._run, daemon=True)
         self._stop = threading.Event()
+        self._thread = spawn_thread(
+            target=self._run, name="raft-dial", kind="service"
+        )
         self._thread.start()
 
     def send(self, data: bytes) -> None:
@@ -159,7 +163,9 @@ class TCPTransport:
         self._server.listen(32)
         self.addr = self._server.getsockname()
         self._stop = threading.Event()
-        self._accept_thread = threading.Thread(target=self._accept, daemon=True)
+        self._accept_thread = spawn_thread(
+            target=self._accept, name="raft-accept", kind="service"
+        )
         self._accept_thread.start()
 
     def set_handler(self, handler) -> None:
@@ -194,8 +200,9 @@ class TCPTransport:
                 conn, _ = self._server.accept()
             except OSError:
                 return
-            threading.Thread(
-                target=self._serve_conn, args=(conn,), daemon=True
+            spawn_thread(
+                target=self._serve_conn, args=(conn,),
+                name="raft-serve", kind="service",
             ).start()
 
     def set_pinned(self, certs: list) -> None:

@@ -11,6 +11,8 @@ from __future__ import annotations
 import queue
 import threading
 
+from fabric_tpu.devtools.lockwatch import spawn_thread
+
 from fabric_tpu.orderer.blockcutter import BlockCutter
 from fabric_tpu.orderer.blockwriter import BlockWriter
 from fabric_tpu.protos.common import common_pb2
@@ -30,7 +32,9 @@ class SoloChain:
         self._on_block = on_block or (lambda blk: None)
         self._q: queue.Queue = queue.Queue()
         self._halted = threading.Event()
-        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread = spawn_thread(
+            target=self._run, name="solo-consenter", kind="service"
+        )
 
     def start(self) -> None:
         self._thread.start()

@@ -19,6 +19,8 @@ import queue
 import threading
 import time
 
+from fabric_tpu.devtools.lockwatch import spawn_thread
+
 
 class Committer:
     def __init__(self, validator, ledger, metrics=None):
@@ -153,8 +155,8 @@ class Committer:
                     failed = True
                     done_q.put(e)
 
-        th = threading.Thread(
-            target=commit_loop, name="committer-stream", daemon=True
+        th = spawn_thread(
+            target=commit_loop, name="committer-stream", kind="worker"
         )
         th.start()
         n_in = n_out = 0

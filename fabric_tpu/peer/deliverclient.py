@@ -15,6 +15,8 @@ from __future__ import annotations
 
 import random
 import threading
+
+from fabric_tpu.devtools.lockwatch import spawn_thread
 import time
 
 from fabric_tpu.orderer.blockwriter import verify_block_signature
@@ -48,7 +50,9 @@ class DeliverClient:
             if self._thread is not None and self._thread.is_alive():
                 return
             self._stop.clear()
-            self._thread = threading.Thread(target=self._run, daemon=True)
+            self._thread = spawn_thread(
+                target=self._run, name="deliver-client", kind="service"
+            )
             self._thread.start()
 
     def stop(self) -> None:

@@ -1,11 +1,18 @@
 #!/usr/bin/env python
-"""CI wrapper around fabriclint: run the full-tree pass and emit one
-JSON summary line in the same shape the bench scripts use, so the
-driver/CI can scrape `"experiment": "fabriclint"` next to the bench
+"""CI wrapper around fabriclint: run the full-tree pass (fabric_tpu at
+the strict profile, tests/ and scripts/ at the relaxed profile) and
+emit one JSON summary line in the same shape the bench scripts use, so
+the driver/CI can scrape `"experiment": "fabriclint"` next to the bench
 lines.  Exit code mirrors the linter (non-zero on any unsuppressed
-violation).
+error-severity violation, after the optional baseline ratchet).
 
-Usage: python scripts/lint.py [--show-suppressed]
+Usage: python scripts/lint.py [--show-suppressed] [--baseline FILE]
+       [--write-baseline FILE]
+
+The baseline ratchet lets a new rule land loud-but-not-fatal: a JSON
+{"rule": count} file tolerates up to COUNT unsuppressed errors per rule.
+Stale budgets (looser than reality) fail, so the carve-out dies the
+moment the tree is cleaner than it claims — the ratchet only tightens.
 """
 
 import argparse
@@ -16,7 +23,11 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from fabric_tpu.devtools.lint import lint_tree  # noqa: E402
+from fabric_tpu.devtools.lint import (  # noqa: E402
+    apply_baseline,
+    lint_tree,
+    load_baseline,
+)
 
 
 def main() -> int:
@@ -24,6 +35,14 @@ def main() -> int:
     ap.add_argument(
         "--show-suppressed", action="store_true",
         help="also print suppressed violations (with their reasons)",
+    )
+    ap.add_argument(
+        "--baseline", default=None, metavar="FILE",
+        help="JSON {rule: count} ratchet of tolerated per-rule errors",
+    )
+    ap.add_argument(
+        "--write-baseline", default=None, metavar="FILE",
+        help="record current per-rule error counts and exit 0",
     )
     args = ap.parse_args()
 
@@ -33,20 +52,37 @@ def main() -> int:
 
     for v in report.unsuppressed:
         print(str(v), file=sys.stderr)
+    for v in report.warnings:
+        print(str(v), file=sys.stderr)
     if args.show_suppressed:
         for v in report.suppressed:
             print(str(v), file=sys.stderr)
 
     summary = report.summary()
-    print(json.dumps({
+    out = {
         "experiment": "fabriclint",
         "files": summary["files"],
         "violations": summary["violations"],
+        "warnings": summary["warnings"],
         "suppressed": summary["suppressed"],
         "by_rule": summary["by_rule"],
+        "warn_by_rule": summary["warn_by_rule"],
         "clean": summary["clean"],
         "seconds": round(elapsed, 4),
-    }))
+    }
+    if args.write_baseline:
+        with open(args.write_baseline, "w", encoding="utf-8") as f:
+            json.dump(summary["by_rule"], f, indent=2, sort_keys=True)
+            f.write("\n")
+        out["baseline_written"] = args.write_baseline
+        print(json.dumps(out))
+        return 0
+    if args.baseline:
+        ratchet = apply_baseline(report, load_baseline(args.baseline))
+        out["baseline"] = ratchet
+        print(json.dumps(out))
+        return 0 if ratchet["ok"] else 1
+    print(json.dumps(out))
     return 0 if summary["clean"] else 1
 
 

@@ -21,6 +21,17 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 # log without raising) when bisecting a failure.
 os.environ.setdefault("FABRIC_TPU_LOCKWATCH", "1")
 
+# ...and as a thread-lifecycle soak test: every daemonized worker is
+# created through devtools.lockwatch.spawn_thread (fabriclint's
+# thread-hygiene rule enforces this statically), and under
+# FABRIC_TPU_THREADWATCH each spawn registers in a process-wide live
+# registry and records unhandled exceptions.  The session-end fixture
+# below drains worker-kind threads and asserts the violation ledger is
+# empty, so a worker leaked past its owner's drain/close fails the
+# suite here instead of aborting interpreter teardown ("FATAL:
+# exception not rethrown", the MULTICHIP rc=134 class).
+os.environ.setdefault("FABRIC_TPU_THREADWATCH", "1")
+
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -59,4 +70,32 @@ def _lockwatch_soak_gate():
     assert not lockwatch.violations, (
         "lock-order inversions recorded during the test session "
         f"(likely on a background thread): {lockwatch.violations!r}"
+    )
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _threadwatch_drain_gate():
+    """Fail the session if any watched WORKER thread outlives the tests
+    or died with an unhandled exception.  Workers are bounded jobs
+    (flush waiters, snapshot exports, stream committers) whose owners
+    must drain them — a worker still alive here is precisely the daemon
+    thread the interpreter would kill mid-kernel at teardown, and one
+    that died silently is how green runs become rc=134 aborts.
+    Service-kind threads (acceptors, gossip/consensus loops) are
+    covered by their owners' stop()/close() paths and excluded from the
+    sweep."""
+    yield
+    from fabric_tpu.devtools import lockwatch
+
+    if not lockwatch.threads_enabled():
+        return
+    stragglers = lockwatch.drain_threads(timeout=15.0)
+    assert not stragglers, (
+        f"worker threads still alive at session end: {stragglers!r} — "
+        "their owner never drained them; they would be killed "
+        "mid-execution at interpreter exit"
+    )
+    assert not lockwatch.thread_violations, (
+        "threadwatch violations recorded during the test session: "
+        f"{lockwatch.thread_violations!r}"
     )

@@ -1,0 +1,15 @@
+"""CLEAN TWIN of fix_lock_dirty: the same helper, called after the
+commit lock is released."""
+
+from fabric_tpu.ledger.fix_lock_helper import persist
+
+
+class Ledger:
+    def __init__(self, lock, fd):
+        self.commit_lock = lock
+        self._fd = fd
+
+    def commit(self):
+        with self.commit_lock:
+            pass
+        persist(self._fd)

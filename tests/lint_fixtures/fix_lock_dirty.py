@@ -1,0 +1,15 @@
+"""SEEDED VIOLATION (lock-discipline, interprocedural): blocking I/O
+reached through a cross-module call while lexically holding the commit
+lock."""
+
+from fabric_tpu.ledger.fix_lock_helper import persist
+
+
+class Ledger:
+    def __init__(self, lock, fd):
+        self.commit_lock = lock
+        self._fd = fd
+
+    def commit(self):
+        with self.commit_lock:
+            persist(self._fd)  # <- lock-discipline must fire HERE

@@ -1,0 +1,9 @@
+"""Helper half of the cross-module lock fixture: performs blocking I/O.
+Blocking is fine on its own — the violation is REACHING it under the
+commit lock."""
+
+import os
+
+
+def persist(fd: int) -> None:
+    os.fsync(fd)

@@ -1,0 +1,12 @@
+"""CLEAN TWIN of fix_seam_dirty: the same two-function shape routed
+through the CSP hash seam."""
+
+from fabric_tpu.common.hashing import sha256
+
+
+def _fingerprint(data: bytes) -> bytes:
+    return sha256(data)
+
+
+def catalog_key(data: bytes) -> bytes:
+    return _fingerprint(data)

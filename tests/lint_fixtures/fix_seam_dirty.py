@@ -1,0 +1,13 @@
+"""SEEDED VIOLATIONS (csp-seam): a digest computed via a local hashlib
+alias, and a caller reaching hashlib through the helper."""
+
+import hashlib
+
+
+def _fingerprint(data: bytes) -> bytes:
+    h = hashlib  # <- alias violation fires HERE
+    return h.sha256(data).digest()
+
+
+def catalog_key(data: bytes) -> bytes:
+    return _fingerprint(data)  # <- interprocedural violation fires HERE

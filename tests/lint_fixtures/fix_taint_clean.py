@@ -1,0 +1,12 @@
+"""CLEAN TWIN of fix_taint_dirty: identical shape, but the timestamp is
+threaded in as an argument — every peer marshals the same bytes."""
+
+from fabric_tpu.protos.common import common_pb2
+
+
+def build_header(number: int, timestamp: float) -> bytes:
+    stamp = int(timestamp)
+    seconds = stamp + 0
+    hdr = common_pb2.BlockHeader(number=number)
+    hdr.timestamp = seconds
+    return hdr.SerializeToString()

@@ -1,0 +1,15 @@
+"""SEEDED VIOLATION (taint): wall-clock smuggled through two
+assignments and an attribute fill into a protobuf marshal."""
+
+import time
+
+from fabric_tpu.protos.common import common_pb2
+
+
+def build_header(number: int) -> bytes:
+    now = time.time()  # the source
+    stamp = int(now)  # hop 1
+    seconds = stamp + 0  # hop 2
+    hdr = common_pb2.BlockHeader(number=number)
+    hdr.timestamp = seconds  # attribute fill taints `hdr`
+    return hdr.SerializeToString()  # <- taint must fire HERE

@@ -1,0 +1,9 @@
+"""Helper half of the cross-function taint fixture: its parameter flows
+into a protoutil marshal, so the engine must summarize param 0 as
+sink-flowing — the helper itself is NOT a violation."""
+
+from fabric_tpu import protoutil
+
+
+def marshal_at(ts):
+    return protoutil.make_channel_header(3, "tx", "ch", timestamp=ts)

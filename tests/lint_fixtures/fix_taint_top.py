@@ -1,0 +1,12 @@
+"""SEEDED VIOLATION (taint, cross-function): the wall-clock value never
+touches a sink in THIS module — it crosses into fix_taint_helper, whose
+param-to-sink summary carries the flow back to this call site."""
+
+import time
+
+from fabric_tpu.orderer.fix_taint_helper import marshal_at
+
+
+def author_header():
+    now = time.time()
+    return marshal_at(now)  # <- taint must fire HERE (param 0 sinks)

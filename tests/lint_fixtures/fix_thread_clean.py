@@ -1,0 +1,10 @@
+"""CLEAN TWIN of fix_thread_dirty: the same worker through the
+threadwatch seam (registered, drainable)."""
+
+from fabric_tpu.devtools.lockwatch import spawn_thread
+
+
+def start_worker(job):
+    t = spawn_thread(target=job, name="fixture-worker", kind="worker")
+    t.start()
+    return t

@@ -1,0 +1,10 @@
+"""SEEDED VIOLATION (thread-hygiene): a daemonized thread created
+outside the threadwatch seam — undrainable at interpreter exit."""
+
+import threading
+
+
+def start_worker(job):
+    t = threading.Thread(target=job, daemon=True)  # <- fires HERE
+    t.start()
+    return t

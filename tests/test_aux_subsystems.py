@@ -69,10 +69,17 @@ def test_profile_server_endpoints():
     stop = threading.Event()
 
     def spin():
+        # plain loop, no genexpr: the sampler must attribute the hot
+        # frame to `spin` itself, not an inner <genexpr> frame (which
+        # made the "spin in profile" assertion a coin flip)
+        x = 0
         while not stop.is_set():
-            sum(i * i for i in range(1000))
+            for i in range(1000):
+                x += i * i
 
-    t = threading.Thread(target=spin, daemon=True, name="busy-loop")
+    from fabric_tpu.devtools.lockwatch import spawn_thread
+
+    t = spawn_thread(target=spin, name="busy-loop", kind="worker")
     t.start()
     srv = ProfileServer()
     srv.start()
@@ -91,6 +98,7 @@ def test_profile_server_endpoints():
     finally:
         stop.set()
         srv.stop()
+        t.join(timeout=5)
 
 
 def test_peer_profile_config_knob_consumed():

@@ -46,10 +46,14 @@ def _rules(violations, suppressed=False):
 
 def test_full_tree_is_clean():
     report = lint_tree()
-    assert report.files > 100  # really walked the tree
+    assert report.files > 150  # fabric_tpu + tests + scripts
     pretty = "\n".join(str(v) for v in report.unsuppressed)
     assert not report.unsuppressed, f"fabriclint violations:\n{pretty}"
     assert report.summary()["clean"] is True
+    # advisory findings may exist, but only from relaxed-profile scopes
+    assert all(
+        v.path.startswith(("tests/", "scripts/")) for v in report.warnings
+    )
 
 
 def test_every_allowlist_entry_has_a_reviewed_reason():
@@ -409,6 +413,132 @@ def test_allowlist_entry_suppresses_and_unused_entry_flags():
     )])
     dead = [v for v in report.unsuppressed if v.rule == "allowlist"]
     assert len(dead) == 1 and "never-matches" in dead[0].message
+
+
+
+# -- taint (unit; the fixture corpus in test_lint_fixtures.py covers the
+# cross-function and clean-twin cases) ---------------------------------------
+
+
+def test_taint_fires_at_the_sink_not_the_source():
+    src = (
+        "import time\n"
+        "from fabric_tpu.protos.common import common_pb2\n"
+        "def f():\n"
+        "    t = time.time()\n"
+        "    hdr = common_pb2.BlockHeader(number=int(t))\n"
+    )
+    vs = [v for v in lint_source(src, "fabric_tpu/orderer/x.py")
+          if v.rule == "taint" and not v.suppressed]
+    assert [v.line for v in vs] == [5]  # the constructor, not line 4
+
+
+def test_taint_ignores_monotonic_and_seeded_random():
+    src = (
+        "import time, random\n"
+        "from fabric_tpu.protos.common import common_pb2\n"
+        "def f(rng: random.Random):\n"
+        "    t = time.monotonic()\n"
+        "    r = random.Random(7)\n"
+        "    hdr = common_pb2.BlockHeader(number=int(t))\n"
+        "    return hdr.SerializeToString()\n"
+    )
+    assert lint_source(src, "fabric_tpu/orderer/x.py") == []
+
+
+def test_taint_follows_fstrings():
+    src = (
+        "import time\n"
+        "from fabric_tpu.protos.common import common_pb2\n"
+        "def f():\n"
+        "    label = f'at-{time.time()}'\n"
+        "    return common_pb2.ChannelHeader(channel_id=label)\n"
+    )
+    vs = [v for v in lint_source(src, "fabric_tpu/orderer/x.py")
+          if v.rule == "taint"]
+    assert [v.line for v in vs] == [5]
+
+
+# -- profiles ----------------------------------------------------------------
+
+
+def test_relaxed_profile_disables_determinism_and_advisories_seam():
+    # tests/ fabricate timestamps by design: determinism/taint off
+    src = "import time\nT = time.time()\n"
+    assert lint_source(src, "tests/test_example.py") == []
+    # ...and hashing expectations directly is advisory, not an error
+    hsrc = "import hashlib\nH = hashlib.sha256(b'x').digest()\n"
+    vs = lint_source(hsrc, "tests/test_example.py")
+    assert [v.severity for v in vs] == ["warning"]
+    assert [v.rule for v in vs] == ["csp-seam"]
+    # thread-hygiene stays at error even under the relaxed profile
+    tsrc = (
+        "import threading\n"
+        "t = threading.Thread(target=print, daemon=True)\n"
+    )
+    vs = lint_source(tsrc, "scripts/example.py")
+    assert [(v.rule, v.severity) for v in vs] == [
+        ("thread-hygiene", "error")
+    ]
+
+
+# -- baseline ratchet --------------------------------------------------------
+
+
+def test_baseline_ratchet_tolerates_exactly_the_budget(tmp_path):
+    from fabric_tpu.devtools.lint import apply_baseline, lint_sources
+
+    dirty = (
+        "import threading\n"
+        "a = threading.Thread(target=print, daemon=True)\n"
+        "b = threading.Thread(target=print, daemon=True)\n"
+    )
+    report = lint_sources({"fabric_tpu/gossip/x.py": dirty})
+    assert report.summary()["by_rule"] == {"thread-hygiene": 2}
+    assert apply_baseline(report, {"thread-hygiene": 2})["ok"]
+    under = apply_baseline(report, {"thread-hygiene": 1})
+    assert not under["ok"] and under["over_budget"] == {"thread-hygiene": 1}
+    # a budget looser than reality is itself a failure: the ratchet
+    # only tightens, so stale carve-outs die with the violations
+    stale = apply_baseline(report, {"thread-hygiene": 3})
+    assert not stale["ok"] and stale["stale_budget"] == {"thread-hygiene": 3}
+
+
+def test_baseline_cli_roundtrip(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import threading\n"
+        "t = threading.Thread(target=print, daemon=True)\n"
+    )
+    base = tmp_path / "baseline.json"
+    # write the baseline from the dirty state...
+    proc = subprocess.run(
+        [sys.executable, "-m", "fabric_tpu.devtools.lint", "--json",
+         "--root", str(tmp_path), "--write-baseline", str(base), "bad.py"],
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert json.loads(base.read_text()) == {"thread-hygiene": 1}
+    # ...under which the same tree passes (ratcheted, not clean)
+    proc = subprocess.run(
+        [sys.executable, "-m", "fabric_tpu.devtools.lint", "--json",
+         "--root", str(tmp_path), "--baseline", str(base), "bad.py"],
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    summary = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert summary["baseline"]["ok"] is True
+    assert summary["baseline"]["ratcheted"] == 1
+    # fixing the tree makes the stale budget fail until it is deleted
+    bad.write_text("x = 1\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "fabric_tpu.devtools.lint", "--json",
+         "--root", str(tmp_path), "--baseline", str(base), "bad.py"],
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 1
+    summary = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert summary["baseline"]["stale_budget"] == {"thread-hygiene": 1}
 
 
 def test_hash_seam_rejects_non_sha256_backend():

@@ -1,0 +1,232 @@
+"""Fixture-corpus tests for the interprocedural dataflow engine
+(ISSUE 4 tentpole): each seeded violation in tests/lint_fixtures/ must
+fire at its marked line, and each clean twin must stay quiet — the
+false-positive half is what makes the rules deployable at error level.
+
+Fixtures are mapped to synthetic fabric_tpu/ paths so the STRICT
+profile applies (the real tree gate skips lint_fixtures/ entirely)."""
+
+from __future__ import annotations
+
+import os
+
+from fabric_tpu.devtools import dataflow
+from fabric_tpu.devtools.lint import lint_source, lint_sources
+
+FIXDIR = os.path.join(os.path.dirname(__file__), "lint_fixtures")
+
+
+def _load(name: str) -> str:
+    with open(os.path.join(FIXDIR, name), "r", encoding="utf-8") as f:
+        return f.read()
+
+
+def _fires(violations, rule):
+    return [v.line for v in violations
+            if v.rule == rule and not v.suppressed]
+
+
+# -- taint: two assignments + attribute fill into SerializeToString ----------
+
+
+def test_taint_fires_through_assignments_into_marshal():
+    src = _load("fix_taint_dirty.py")
+    vs = lint_source(src, "fabric_tpu/orderer/fix_taint_dirty.py")
+    lines = _fires(vs, "taint")
+    assert len(lines) == 1
+    # the violation lands on the marshal (sink), not the source
+    assert "SerializeToString" in src.splitlines()[lines[0] - 1]
+
+
+def test_taint_quiet_on_clean_twin():
+    src = _load("fix_taint_clean.py")
+    vs = lint_source(src, "fabric_tpu/orderer/fix_taint_clean.py")
+    assert vs == []
+
+
+def test_taint_fires_across_function_boundary():
+    srcs = {
+        "fabric_tpu/orderer/fix_taint_helper.py":
+            _load("fix_taint_helper.py"),
+        "fabric_tpu/orderer/fix_taint_top.py":
+            _load("fix_taint_top.py"),
+    }
+    report = lint_sources(srcs)
+    by_file: dict[str, list] = {}
+    for v in report.unsuppressed:
+        by_file.setdefault(v.path, []).append(v)
+    # the helper is NOT a violation — its param is the flow, not a leak
+    assert "fabric_tpu/orderer/fix_taint_helper.py" not in by_file
+    tops = by_file["fabric_tpu/orderer/fix_taint_top.py"]
+    assert [v.rule for v in tops] == ["taint"]
+    src = srcs["fabric_tpu/orderer/fix_taint_top.py"]
+    assert "marshal_at(now)" in src.splitlines()[tops[0].line - 1]
+    # and the summary that carried the flow is queryable
+    fn = report.project.function(
+        "fabric_tpu.orderer.fix_taint_helper.marshal_at"
+    )
+    assert fn is not None and 0 in fn.param_to_sink
+
+
+def test_taint_source_sanctioned_by_pragma_does_not_propagate():
+    src = _load("fix_taint_dirty.py").replace(
+        "    now = time.time()  # the source",
+        "    # fabriclint: allow[taint] reviewed: fixture demonstrates a\n"
+        "    # sanctioned source stopping propagation\n"
+        "    now = time.time()",
+    )
+    vs = lint_source(src, "fabric_tpu/orderer/fix_taint_dirty.py")
+    assert [v for v in vs if not v.suppressed] == []
+
+
+# -- csp-seam: locals + helpers ----------------------------------------------
+
+
+def test_seam_fires_via_alias_and_helper():
+    src = _load("fix_seam_dirty.py")
+    vs = lint_source(src, "fabric_tpu/peer/fix_seam_dirty.py")
+    lines = _fires(vs, "csp-seam")
+    assert len(lines) == 2
+    src_lines = src.splitlines()
+    assert "h = hashlib" in src_lines[lines[0] - 1]
+    assert "_fingerprint(data)" in src_lines[lines[1] - 1]
+
+
+def test_seam_quiet_on_clean_twin():
+    src = _load("fix_seam_clean.py")
+    vs = lint_source(src, "fabric_tpu/peer/fix_seam_clean.py")
+    assert vs == []
+
+
+def test_seam_helper_summary_reports_digest():
+    src = _load("fix_seam_dirty.py")
+    report = lint_sources({"fabric_tpu/peer/fix_seam_dirty.py": src})
+    fn = report.project.function(
+        "fabric_tpu.peer.fix_seam_dirty._fingerprint"
+    )
+    assert fn is not None
+    assert fn.returns_digest and fn.uses_hashlib_transitive
+
+
+# -- lock-discipline: cross-module blocking under commit_lock ----------------
+
+
+def test_lock_fires_across_modules():
+    srcs = {
+        "fabric_tpu/ledger/fix_lock_helper.py":
+            _load("fix_lock_helper.py"),
+        "fabric_tpu/ledger/fix_lock_dirty.py":
+            _load("fix_lock_dirty.py"),
+    }
+    report = lint_sources(srcs)
+    hits = [v for v in report.unsuppressed
+            if v.rule == "lock-discipline"]
+    assert len(hits) == 1
+    assert hits[0].path == "fabric_tpu/ledger/fix_lock_dirty.py"
+    src = srcs[hits[0].path]
+    assert "persist(self._fd)" in src.splitlines()[hits[0].line - 1]
+
+
+def test_lock_quiet_when_called_outside_the_lock():
+    srcs = {
+        "fabric_tpu/ledger/fix_lock_helper.py":
+            _load("fix_lock_helper.py"),
+        "fabric_tpu/ledger/fix_lock_clean.py":
+            _load("fix_lock_clean.py"),
+    }
+    report = lint_sources(srcs)
+    assert [v for v in report.unsuppressed
+            if v.rule == "lock-discipline"] == []
+    # the helper's summary still knows it blocks — the INFORMATION is
+    # kept; only the reach-under-lock is a violation
+    fn = report.project.function(
+        "fabric_tpu.ledger.fix_lock_helper.persist"
+    )
+    assert fn is not None and fn.blocking_transitive
+
+
+# -- thread-hygiene ----------------------------------------------------------
+
+
+def test_thread_hygiene_fires_on_daemon_outside_seam():
+    src = _load("fix_thread_dirty.py")
+    vs = lint_source(src, "fabric_tpu/gossip/fix_thread_dirty.py")
+    lines = _fires(vs, "thread-hygiene")
+    assert len(lines) == 1
+    assert "threading.Thread" in src.splitlines()[lines[0] - 1]
+
+
+def test_thread_hygiene_quiet_on_spawn_thread():
+    src = _load("fix_thread_clean.py")
+    vs = lint_source(src, "fabric_tpu/gossip/fix_thread_clean.py")
+    assert vs == []
+
+
+def test_thread_hygiene_fires_on_daemon_attribute_flip():
+    src = (
+        "import threading\n"
+        "def start(job):\n"
+        "    t = threading.Thread(target=job)\n"
+        "    t.daemon = True\n"
+        "    t.start()\n"
+    )
+    vs = lint_source(src, "fabric_tpu/gossip/example.py")
+    assert _fires(vs, "thread-hygiene") == [4]
+
+
+def test_thread_hygiene_exempts_the_seam_itself():
+    src = _load("fix_thread_dirty.py")
+    vs = lint_source(src, "fabric_tpu/devtools/lockwatch.py")
+    assert vs == []
+
+
+# -- summaries: the spawns-thread / acquires-lock facts ----------------------
+
+
+def test_summaries_expose_thread_and_lock_facts():
+    src = (
+        "import threading\n"
+        "class W:\n"
+        "    def go(self):\n"
+        "        with self.commit_lock:\n"
+        "            pass\n"
+        "        t = threading.Thread(target=self.go)\n"
+        "        t.start()\n"
+    )
+    report = lint_sources({"fabric_tpu/gossip/facts.py": src})
+    fn = report.project.function("fabric_tpu.gossip.facts.W.go")
+    assert fn.spawns_thread
+    assert "commit_lock" in fn.acquires_locks
+
+
+# -- engine internals: import/alias resolution -------------------------------
+
+
+def test_relative_imports_resolve_into_the_package():
+    srcs = {
+        "fabric_tpu/ledger/helper.py": (
+            "import os\n"
+            "def sync(fd):\n"
+            "    os.fsync(fd)\n"
+        ),
+        "fabric_tpu/ledger/user.py": (
+            "from .helper import sync\n"
+            "class L:\n"
+            "    def commit(self, fd):\n"
+            "        with self.commit_lock:\n"
+            "            sync(fd)\n"
+        ),
+    }
+    report = lint_sources(srcs)
+    hits = [v for v in report.unsuppressed
+            if v.rule == "lock-discipline"]
+    assert [v.path for v in hits] == ["fabric_tpu/ledger/user.py"]
+
+
+def test_module_dotted_mapping():
+    assert dataflow._module_dotted("fabric_tpu/ledger/kvledger.py") == (
+        "fabric_tpu.ledger.kvledger"
+    )
+    assert dataflow._module_dotted("fabric_tpu/csp/__init__.py") == (
+        "fabric_tpu.csp"
+    )

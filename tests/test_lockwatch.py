@@ -241,6 +241,8 @@ def test_blocking_self_reacquire_of_plain_lock_is_diagnosed():
         assert not lk.acquire(blocking=False)  # try-lock: quiet False
         assert not lockwatch.violations
         with pytest.raises(LockOrderError, match="self-deadlock"):
+            # fabriclint: allow[lock-discipline] deliberate blocking
+            # re-acquire: the raised self-deadlock IS the assertion
             lk.acquire()
     assert lockwatch.violations[-1]["cycle"] == ["gossip.net", "gossip.net"]
     lockwatch.reset()
@@ -272,6 +274,8 @@ def test_cross_thread_release_is_refused():
     # under watch that would leave a stale held-entry in the acquirer's
     # stack and later record bogus edges — must refuse, not rot
     lk = named_lock("handoff")
+    # fabriclint: allow[lock-discipline] deliberately unpaired acquire:
+    # the release happens on ANOTHER thread to probe handoff refusal
     lk.acquire()
 
     def release_elsewhere():
@@ -348,6 +352,8 @@ def test_record_mode_performs_cross_thread_handoff():
     os.environ["FABRIC_TPU_LOCKWATCH"] = "record"
     try:
         lk = WatchedLock("handoff-rec")
+        # fabriclint: allow[lock-discipline] deliberately unpaired acquire:
+        # record-mode handoff releases on another thread by design
         lk.acquire()
         assert _run_in_thread(lambda: lk.release()) is None  # no raise
         assert lockwatch.violations[-1]["event"] == "cross-thread-release"
@@ -355,3 +361,252 @@ def test_record_mode_performs_cross_thread_handoff():
         lk.release()
     finally:
         os.environ["FABRIC_TPU_LOCKWATCH"] = "1"
+        # the handoff leaves the documented stale held-entry on THIS
+        # thread (observe-only mode doesn't fix the stack); scrub it so
+        # later main-thread acquisitions/waits don't see a phantom hold
+        st = lockwatch._held()
+        st[:] = [e for e in st if e[0] is not lk]
+
+
+# -- condition-variable wait ordering (ISSUE 4 satellite) --------------------
+
+
+def test_wait_while_holding_order_predecessor_raises():
+    # establish commit -> idle (the canonical snapshot ordering), then
+    # wait on idle while HOLDING commit: the waker needs commit first,
+    # which the waiter holds — a deadlock-capable wait
+    from fabric_tpu.devtools.lockwatch import named_condition
+
+    commit = named_lock("cw.commit")
+    idle = named_condition("cw.idle")
+    assert isinstance(idle, lockwatch.WatchedCondition)
+
+    def establish():
+        with commit:
+            with idle:
+                pass
+
+    assert _run_in_thread(establish) is None
+
+    def bad_wait():
+        with commit:
+            with idle:
+                idle.wait(timeout=0.01)
+
+    exc = _run_in_thread(bad_wait)
+    assert isinstance(exc, LockOrderError)
+    assert "order-predecessor" in str(exc)
+    bad = lockwatch.violations[-1]
+    assert bad["event"] == "wait-while-holding-predecessor"
+    assert bad["condition"] == "cw.idle"
+    assert bad["holding"] == "cw.commit"
+    lockwatch.reset()
+
+
+def test_wait_without_predecessor_is_quiet_and_wakes():
+    from fabric_tpu.devtools.lockwatch import named_condition
+
+    cond = named_condition("cw.plain")
+    got = []
+
+    def waiter():
+        with cond:
+            got.append(cond.wait(timeout=5))
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.05)
+    with cond:
+        cond.notify_all()
+    t.join(timeout=5)
+    assert not t.is_alive() and got == [True]
+    assert not lockwatch.violations
+
+
+def test_wait_for_uses_watched_wait():
+    from fabric_tpu.devtools.lockwatch import named_condition
+
+    cond = named_condition("cw.waitfor")
+    state = {"ready": False}
+
+    def setter():
+        time.sleep(0.05)
+        with cond:
+            state["ready"] = True
+            cond.notify_all()
+
+    t = threading.Thread(target=setter)
+    t.start()
+    with cond:
+        assert cond.wait_for(lambda: state["ready"], timeout=5)
+    t.join(timeout=5)
+
+
+def test_named_condition_plain_when_disabled(monkeypatch):
+    from fabric_tpu.devtools.lockwatch import named_condition
+
+    monkeypatch.setenv("FABRIC_TPU_LOCKWATCH", "")
+    cond = named_condition("cw.off")
+    assert isinstance(cond, threading.Condition)
+
+
+# -- threadwatch: the thread-lifecycle ledger (ISSUE 4) ----------------------
+
+
+@pytest.fixture()
+def _threadwatch(monkeypatch):
+    monkeypatch.setenv("FABRIC_TPU_THREADWATCH", "1")
+    prior = list(lockwatch.thread_violations)
+    lockwatch.reset_threads()
+    yield
+    lockwatch.reset_threads()
+    lockwatch.thread_violations.extend(prior)
+
+
+def test_spawn_thread_registers_and_deregisters(_threadwatch):
+    gate = threading.Event()
+    release = threading.Event()
+
+    def job():
+        gate.set()
+        release.wait(5)
+
+    t = lockwatch.spawn_thread(target=job, name="tw-job", kind="worker")
+    t.start()
+    assert gate.wait(5)
+    alive = lockwatch.threads_alive(kinds=("worker",))
+    assert any(i["name"] == "tw-job" for i in alive)
+    release.set()
+    t.join(5)
+    assert not any(
+        i["name"] == "tw-job" for i in lockwatch.threads_alive()
+    )
+    assert not lockwatch.thread_violations
+
+
+def test_spawn_thread_records_unhandled_exception(
+    _threadwatch, monkeypatch
+):
+    def boom():
+        raise RuntimeError("silent death")
+
+    # the re-raise after recording is deliberate; keep the default
+    # excepthook (and pytest's unhandled-thread warning) out of the way
+    monkeypatch.setattr(threading, "excepthook", lambda args: None)
+    t = lockwatch.spawn_thread(target=boom, name="tw-boom", kind="worker")
+    t.start()
+    t.join(5)
+    assert any(
+        v["event"] == "unhandled-exception" and v["thread"] == "tw-boom"
+        for v in lockwatch.thread_violations
+    )
+    lockwatch.reset_threads()
+
+
+def test_drain_joins_workers_and_flags_stragglers(_threadwatch):
+    release = threading.Event()
+    lockwatch.spawn_thread(
+        target=lambda: release.wait(0.2), name="tw-quick", kind="worker"
+    ).start()
+    # a worker that exits inside the timeout drains cleanly
+    release.set()
+    assert lockwatch.drain_threads(timeout=5.0) == []
+    assert not lockwatch.thread_violations
+
+    # one that outlives the deadline is recorded as a straggler
+    wedge = threading.Event()
+    t = lockwatch.spawn_thread(
+        target=lambda: wedge.wait(10), name="tw-wedged", kind="worker"
+    )
+    t.start()
+    time.sleep(0.05)
+    stragglers = lockwatch.drain_threads(timeout=0.1)
+    assert stragglers == ["tw-wedged"]
+    assert lockwatch.thread_violations[-1]["event"] == "drain-timeout"
+    wedge.set()
+    t.join(5)
+    lockwatch.reset_threads()
+
+
+def test_drain_skips_service_threads(_threadwatch):
+    stop = threading.Event()
+    t = lockwatch.spawn_thread(
+        target=lambda: stop.wait(10), name="tw-service", kind="service"
+    )
+    t.start()
+    time.sleep(0.05)
+    assert lockwatch.drain_threads(timeout=0.1) == []  # workers only
+    assert not lockwatch.thread_violations
+    stop.set()
+    t.join(5)
+
+
+def test_spawn_thread_plain_when_disabled(monkeypatch):
+    monkeypatch.setenv("FABRIC_TPU_THREADWATCH", "")
+    t = lockwatch.spawn_thread(target=lambda: None, name="tw-plain")
+    assert isinstance(t, threading.Thread) and t.daemon
+    t.start()
+    t.join(5)
+    assert not any(
+        i["name"] == "tw-plain" for i in lockwatch.threads_alive()
+    )
+
+
+def test_spawn_timer_fires_and_cancelled_timer_prunes(_threadwatch):
+    fired = threading.Event()
+    t = lockwatch.spawn_timer(0.05, fired.set, name="tw-timer")
+    assert t.daemon
+    t.start()
+    assert fired.wait(5)
+    t.join(5)
+    assert not any(
+        i["name"] == "tw-timer" for i in lockwatch.threads_alive()
+    )
+    # a timer cancelled after start() skips its callback, so the
+    # wrapper's deregistration never runs — the registry must prune
+    # the dead entry on the next read instead of leaking it
+    t2 = lockwatch.spawn_timer(30.0, fired.set, name="tw-timer-cancel")
+    t2.start()
+    t2.cancel()
+    t2.join(5)
+    assert not any(
+        i["name"] == "tw-timer-cancel"
+        for i in lockwatch.threads_alive()
+    )
+    assert not lockwatch.thread_violations
+
+
+def test_spawn_thread_visible_to_drain_immediately_after_start(
+    _threadwatch,
+):
+    # registration happens-before start() returns: a drain sweep racing
+    # a just-started worker must SEE it (the gate's whole guarantee)
+    gate = threading.Event()
+    t = lockwatch.spawn_thread(
+        target=gate.wait, args=(5,), name="tw-early", kind="worker"
+    )
+    t.start()
+    assert any(
+        i["name"] == "tw-early"
+        for i in lockwatch.threads_alive(kinds=("worker",))
+    )
+    gate.set()
+    t.join(5)
+
+
+def test_double_start_does_not_evict_live_registry_entry(_threadwatch):
+    # a second start() raises, but its rollback must not deregister the
+    # RUNNING thread — that would hide it from the drain gate
+    gate = threading.Event()
+    t = lockwatch.spawn_thread(
+        target=gate.wait, args=(5,), name="tw-double", kind="worker"
+    )
+    t.start()
+    with pytest.raises(RuntimeError):
+        t.start()
+    assert any(
+        i["name"] == "tw-double" for i in lockwatch.threads_alive()
+    )
+    gate.set()
+    t.join(5)
+    assert not lockwatch.thread_violations

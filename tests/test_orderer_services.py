@@ -144,7 +144,9 @@ def test_deliver_block_until_ready_waits(registrar, org):
         for kind, item in svc.deliver(env):
             got.append((kind, item))
 
-    t = threading.Thread(target=consume, daemon=True)
+    from fabric_tpu.devtools.lockwatch import spawn_thread
+
+    t = spawn_thread(target=consume, name="deliver-consume", kind="worker")
     t.start()
     time.sleep(0.2)
     assert not got  # waiting for block 1
