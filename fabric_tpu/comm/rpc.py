@@ -33,6 +33,7 @@ import ssl
 import struct
 import threading
 
+from fabric_tpu.devtools import faultline
 from fabric_tpu.devtools.lockwatch import spawn_thread
 
 KIND_DATA = 0
@@ -170,6 +171,11 @@ class _Handler(socketserver.BaseRequestHandler):
         holder = [sock]
         server._track(holder)
         try:
+            try:
+                faultline.point("rpc.accept")
+            except OSError:
+                return  # injected accept fault: drop cleanly — real
+                # handler errors must keep surfacing via handle_error
             self._serve(server, sock, holder)
         finally:
             server._untrack(holder)
@@ -193,6 +199,9 @@ class _Handler(socketserver.BaseRequestHandler):
                 finally:
                     sock.close()
                 return
+        # wrapped AFTER the TLS handshake so injected read/write faults
+        # land on the application byte stream, not inside the handshake
+        sock = faultline.io(sock, "rpc.server")
         try:
             try:
                 frame = read_frame(sock)
@@ -283,6 +292,7 @@ def _pump_stream(sock, out, ka: KeepaliveOptions) -> bool:
             try:
                 item = q.get(timeout=ka.ping_interval)
             except queue.Empty:
+                faultline.point("rpc.ping")
                 write_frame(sock, bytes([KIND_PING]))  # live but idle
                 continue
             if item is _END:
@@ -433,6 +443,7 @@ class RPCClient:
             except RPCError:
                 sock.close()
                 raise
+        sock = faultline.io(sock, "rpc.client")
         m = method.encode("utf-8")
         write_frame(sock, bytes([len(m)]) + m + body)
         return sock

@@ -395,6 +395,71 @@ class CommitMetrics:
         ))
 
 
+class CSPMetrics:
+    """TPU-CSP degraded-mode instrumentation (the faultline tentpole's
+    hardening half): the circuit breaker's state and trip counts, raw
+    device-path failures, and recovery probes — the signals an operator
+    watches to know the node is serving from the host oracle."""
+
+    def __init__(self, provider):
+        self.breaker_state = provider.new_gauge(GaugeOpts(
+            namespace="csp",
+            subsystem="tpu",
+            name="breaker_state",
+            help="1 while the TPU degraded-mode circuit breaker is open "
+                 "(verify/hash served by the host path, no device "
+                 "queuing), 0 when closed.",
+        ))
+        self.breaker_trips = provider.new_counter(CounterOpts(
+            namespace="csp",
+            subsystem="tpu",
+            name="breaker_trips_total",
+            help="Times the breaker opened after consecutive device "
+                 "failures.",
+        ))
+        self.device_failures = provider.new_counter(CounterOpts(
+            namespace="csp",
+            subsystem="tpu",
+            name="device_failures_total",
+            help="Device-path failures observed by the TPU provider "
+                 "(dispatch, collect, or hash).",
+        ))
+        self.probes = provider.new_counter(CounterOpts(
+            namespace="csp",
+            subsystem="tpu",
+            name="breaker_probes_total",
+            help="Recovery probe batches sent while the breaker was "
+                 "open, labeled by result.",
+            statsd_format="%{result}",
+        ))
+        self.breaker_state.set(0)
+
+
+class RaftMetrics:
+    """Raft cluster-comm instrumentation: the silent-loss counters the
+    transport used to drop into the void.  `send_dropped` counts
+    StepRequests discarded on a full outbound queue (raft retransmits,
+    so an occasional drop is benign — sustained growth means a peer is
+    down or a link is saturated); `dials` counts outbound connection
+    attempts, so reconnect storms are visible next to the backoff."""
+
+    def __init__(self, provider):
+        self.send_dropped = provider.new_counter(CounterOpts(
+            namespace="raft",
+            name="send_dropped_total",
+            help="StepRequests dropped because a peer's outbound queue "
+                 "was full.",
+            statsd_format="%{dest}",
+        ))
+        self.dials = provider.new_counter(CounterOpts(
+            namespace="raft",
+            name="dial_total",
+            help="Outbound link connection attempts, labeled by "
+                 "destination node.",
+            statsd_format="%{dest}",
+        ))
+
+
 __all__ = [
     "CounterOpts",
     "GaugeOpts",
@@ -408,4 +473,6 @@ __all__ = [
     "DisabledProvider",
     "SnapshotMetrics",
     "CommitMetrics",
+    "CSPMetrics",
+    "RaftMetrics",
 ]

@@ -37,6 +37,8 @@ class System:
         self._checkers: dict[str, object] = {}
         self._snapshot_metrics = None
         self._commit_metrics = None
+        self._csp_metrics = None
+        self._raft_metrics = None
         self._lock = threading.Lock()
         if provider == "prometheus":
             self.metrics_provider = PrometheusProvider()
@@ -146,6 +148,28 @@ class System:
 
                 self._commit_metrics = CommitMetrics(self.metrics_provider)
             return self._commit_metrics
+
+    def csp_metrics(self):
+        """Lazily-built TPU-CSP degraded-mode metrics (circuit-breaker
+        state/trips, device failures, recovery probes) bound to this
+        system's provider — hand it to TPUCSP(metrics=...) or
+        set_metrics() so breaker transitions surface on /metrics."""
+        with self._lock:
+            if self._csp_metrics is None:
+                from fabric_tpu.common.metrics import CSPMetrics
+
+                self._csp_metrics = CSPMetrics(self.metrics_provider)
+            return self._csp_metrics
+
+    def raft_metrics(self):
+        """Lazily-built raft cluster-comm metrics (dropped sends,
+        dial attempts) for TCPTransport(metrics=...)."""
+        with self._lock:
+            if self._raft_metrics is None:
+                from fabric_tpu.common.metrics import RaftMetrics
+
+                self._raft_metrics = RaftMetrics(self.metrics_provider)
+            return self._raft_metrics
 
     # -- health ------------------------------------------------------------
 

@@ -27,17 +27,12 @@ from typing import Sequence
 try:
     from cryptography.hazmat.primitives import serialization
     from cryptography.hazmat.primitives.asymmetric import ec
-    from cryptography.hazmat.primitives.asymmetric.utils import (
-        decode_dss_signature,
-        encode_dss_signature,
-    )
 except ModuleNotFoundError as _exc:  # pragma: no cover - minimal hosts
     # Same policy as csp/__init__.py: only cryptography ITSELF missing is
     # forgivable; a missing transitive dep (cffi) is a broken install.
     if (_exc.name or "").split(".")[0] != "cryptography":
         raise
     serialization = ec = None
-    decode_dss_signature = encode_dss_signature = None
 
 
 def _require_crypto() -> None:
@@ -203,19 +198,55 @@ class ECDSAP256PrivateKey(Key):
 # ---------------------------------------------------------------------------
 
 
+def _der_int(v: int) -> bytes:
+    """Minimal DER INTEGER content for a positive integer."""
+    raw = v.to_bytes((v.bit_length() + 7) // 8 or 1, "big")
+    if raw[0] & 0x80:
+        raw = b"\x00" + raw
+    return bytes([0x02, len(raw)]) + raw
+
+
+def _der_read_int(sig: bytes, off: int) -> tuple[int, int]:
+    """Strict-DER INTEGER at `off`; returns (value, next offset)."""
+    if off + 2 > len(sig) or sig[off] != 0x02:
+        raise ValueError("invalid DER signature: expected INTEGER")
+    ln = sig[off + 1]
+    off += 2
+    if ln == 0 or ln > 0x7F or off + ln > len(sig):
+        raise ValueError("invalid DER signature: bad integer length")
+    raw = sig[off:off + ln]
+    if raw[0] & 0x80:
+        raise ValueError("invalid DER signature: negative integer")
+    if ln > 1 and raw[0] == 0 and not raw[1] & 0x80:
+        raise ValueError("invalid DER signature: non-minimal integer")
+    return int.from_bytes(raw, "big"), off + ln
+
+
 def marshal_ecdsa_signature(r: int, s: int) -> bytes:
-    _require_crypto()
-    return encode_dss_signature(r, s)
+    """DER ECDSA-Sig-Value encoding — pure stdlib (a P-256 r/s pair
+    fits short-form lengths), so signature marshaling works on minimal
+    hosts without the `cryptography` package."""
+    body = _der_int(r) + _der_int(s)
+    if len(body) > 0x7F:
+        # enforce the short-form assumption instead of silently
+        # emitting malformed DER for oversized integers
+        raise ValueError("r/s too large for short-form DER encoding")
+    return bytes([0x30, len(body)]) + body
 
 
 def unmarshal_ecdsa_signature(sig: bytes) -> tuple[int, int]:
     """DER-decode a signature. Raises ValueError on malformed input or
-    non-positive r/s (reference bccsp/utils/ecdsa.go:47-62)."""
-    _require_crypto()
-    try:
-        r, s = decode_dss_signature(sig)
-    except Exception as exc:  # asn1 errors vary by backend
-        raise ValueError(f"invalid DER signature: {exc}") from exc
+    non-positive r/s (reference bccsp/utils/ecdsa.go:47-62).  Strict:
+    trailing bytes, non-minimal integers, and negatives are rejected,
+    matching the asn1 backends the sw provider verifies with."""
+    if len(sig) < 2 or sig[0] != 0x30:
+        raise ValueError("invalid DER signature: expected SEQUENCE")
+    if sig[1] > 0x7F or 2 + sig[1] != len(sig):
+        raise ValueError("invalid DER signature: bad sequence length")
+    r, off = _der_read_int(sig, 2)
+    s, off = _der_read_int(sig, off)
+    if off != len(sig):
+        raise ValueError("invalid DER signature: trailing bytes")
     if r <= 0 or s <= 0:
         raise ValueError("invalid signature: r and s must be positive")
     return r, s

@@ -20,14 +20,19 @@ a per-lane mask (hard part (4)).
 from __future__ import annotations
 
 import hashlib
+import os
 import threading
 import time
 from typing import Sequence
 
 import numpy as np
 
+from fabric_tpu.common.flogging import must_get_logger
 from fabric_tpu.csp import api
+from fabric_tpu.devtools import faultline
 from fabric_tpu.devtools.lockwatch import spawn_thread
+
+_logger = must_get_logger("csp.tpu")
 from fabric_tpu.csp.api import (
     CSP,
     ECDSAP256PrivateKey,
@@ -35,7 +40,17 @@ from fabric_tpu.csp.api import (
     Key,
     VerifyBatchItem,
 )
-from fabric_tpu.csp.sw import SWCSP
+
+# Guarded like fabric_tpu/csp/__init__: the provider itself only needs
+# SWCSP for the default host oracle — a caller that supplies its own
+# `sw` object (the chaos/degraded-mode tests run one on minimal hosts)
+# can use the full device path without the `cryptography` package.
+try:
+    from fabric_tpu.csp.sw import SWCSP
+except ModuleNotFoundError as _exc:  # pragma: no cover - minimal hosts
+    if (_exc.name or "").split(".")[0] != "cryptography":
+        raise
+    SWCSP = None  # type: ignore[assignment]
 
 _BATCH_BUCKETS = (32, 128, 512, 2048, 4096, 8192, 32768)  # single dispatch
 # for big batches: per-call transport overhead beats chunk-pipelining wins
@@ -171,6 +186,147 @@ def _measured_host_rate(default: float) -> float:
     return r if r else default
 
 
+def _host_verify_batch(sw: SWCSP, items) -> list[bool]:
+    """Host verification preferring the native libcrypto batch
+    (native/ecverify.cc) — GIL-free and a multiple of the
+    python-per-signature rate on hosts with a fast libcrypto; the
+    python engine is the fallback oracle.  Feeds the process-wide
+    measured host rate (deadline budgeting reserves race time from
+    OBSERVED speed, not the configuration hint)."""
+    if not items:
+        return []
+    from fabric_tpu import native
+
+    t0 = time.perf_counter()
+    mask = native.ecdsa_verify_host(items)
+    if mask is None:
+        mask = sw.verify_batch(items)
+    if len(items) >= 256:
+        _note_host_rate(len(items), time.perf_counter() - t0)
+    return mask
+
+
+class _Breaker:
+    """Degraded-mode circuit breaker over the device path (the chaos
+    tentpole's hardening half).  `threshold` CONSECUTIVE device-path
+    failures — dispatch raising, a flush waiter's collect dying, a
+    device hash_batch failing — open it; while open, verify_batch /
+    hash_batch route straight to the host oracle with NO device
+    queuing, and every `probe_every`-th held verify call first sends a
+    tiny probe batch through the device: a probe the DEVICE completes
+    closes the breaker and traffic returns.  Knobs: constructor
+    arguments, else FABRIC_TPU_BREAKER_THRESHOLD /
+    FABRIC_TPU_BREAKER_PROBE_EVERY.  State + trip/probe counts surface
+    through a common.metrics.CSPMetrics on /metrics."""
+
+    def __init__(self, threshold: int | None = None,
+                 probe_every: int | None = None, metrics=None):
+        def env_int(name: str, default: int) -> int:
+            try:
+                return int(os.environ[name])
+            except (KeyError, ValueError):
+                return default
+
+        self.threshold = (
+            threshold if threshold is not None
+            else env_int("FABRIC_TPU_BREAKER_THRESHOLD", 3)
+        )
+        self.probe_every = (
+            probe_every if probe_every is not None
+            else env_int("FABRIC_TPU_BREAKER_PROBE_EVERY", 8)
+        )
+        self._lock = threading.Lock()
+        self._consecutive = 0
+        self._held = 0  # host-served calls since the last probe
+        self.open = False
+        self.trips = 0
+        self.metrics = metrics
+
+    def set_metrics(self, metrics) -> None:
+        self.metrics = metrics
+        if metrics is not None:
+            metrics.breaker_state.set(1 if self.open else 0)
+
+    def record(self, ok: bool) -> None:
+        """One device-path outcome (any thread)."""
+        with self._lock:
+            if ok:
+                self._consecutive = 0
+                return
+            self._consecutive += 1
+            if self.metrics is not None:
+                self.metrics.device_failures.add()
+            if not self.open and self._consecutive >= self.threshold:
+                self.open = True
+                self.trips += 1
+                self._held = 0
+                if self.metrics is not None:
+                    self.metrics.breaker_state.set(1)
+                    self.metrics.breaker_trips.add()
+                _logger.warning(
+                    "TPU circuit breaker OPEN after %d consecutive "
+                    "device failures; verify/hash routed to the host "
+                    "path (probe every %d calls)",
+                    self._consecutive, self.probe_every,
+                )
+
+    def probe_due(self) -> bool:
+        """Count one host-served call while open; True when it is this
+        call's turn to probe the device."""
+        with self._lock:
+            if not self.open:
+                return False
+            self._held += 1
+            if self._held >= self.probe_every:
+                self._held = 0
+                return True
+            return False
+
+    def note_probe(self, ok: bool) -> None:
+        if self.metrics is not None:
+            self.metrics.probes.With(
+                "result", "ok" if ok else "fail"
+            ).add()
+
+    def close(self) -> None:
+        with self._lock:
+            was_open = self.open
+            self.open = False
+            self._consecutive = 0
+            if self.metrics is not None:
+                self.metrics.breaker_state.set(0)
+        if was_open:
+            _logger.warning(
+                "TPU circuit breaker CLOSED: recovery probe completed "
+                "on the device; resuming device dispatch"
+            )
+
+
+class _ProbeKey:
+    """Minimal P-256 public-key duck type for the breaker probe: the
+    device marshallers and the host oracles only touch the coordinate
+    views and the SKI, none of which need the `cryptography` package."""
+
+    def __init__(self, x: int, y: int):
+        self.x = x
+        self.y = y
+        self.x_bytes = x.to_bytes(32, "big")
+        self.y_bytes = y.to_bytes(32, "big")
+        self._ski = hashlib.sha256(
+            b"\x04" + self.x_bytes + self.y_bytes
+        ).digest()
+
+    def ski(self) -> bytes:
+        return self._ski
+
+    def public_key(self) -> "_ProbeKey":
+        return self
+
+    @property
+    def is_private(self) -> bool:
+        return False
+
+
 class _FlushResult:
     """One flushed (coalesced) device dispatch: lazy per-chunk
     collectors plus a consumption count so the provider can drop the
@@ -208,7 +364,7 @@ class _FlushResult:
     def __init__(self, pending, total_lanes: int,
                  host_items=(), sw: SWCSP | None = None,
                  device_items=None, deadline: float | None = None,
-                 on_device_wall=None):
+                 on_device_wall=None, on_device_outcome=None):
         self._pending = pending  # [(collect, kept_lanes)]
         self._mask: list[bool] | None = None
         self._exc: Exception | None = None
@@ -225,6 +381,13 @@ class _FlushResult:
         # deadline-calibration feedback: called (lanes, seconds) when
         # the DEVICE supplied the mask (provider EWMA, see _dispatch)
         self._on_device_wall = on_device_wall
+        # circuit-breaker feedback: called (ok: bool) once per flush
+        # that had a device portion — True when the device materialized
+        # its chunks, False when the device path died mid-flight
+        self._on_device_outcome = on_device_outcome
+        # True once the device (not the host fallback) produced the
+        # device lanes' mask — the breaker probe's success criterion
+        self.device_ok = False
         self._n_device_lanes = len(device_items) if device_items else 0
         self._t0 = time.perf_counter()
         self._seal_lock = threading.Lock()
@@ -271,6 +434,7 @@ class _FlushResult:
                 return
             pending, host_items = self._pending, self._host_items
             device_items = self._device_items
+            device_phase = False
             try:
                 # host tail FIRST: it runs while the device crunches
                 # (that overlap is the whole point of host_fraction);
@@ -278,14 +442,34 @@ class _FlushResult:
                 host_mask = (
                     self._host_verify(host_items) if host_items else []
                 )
+                device_phase = True
+                if pending:
+                    # the device-loss injection seam: a DeviceUnavailable
+                    # raised here exercises the mid-flush failover below
+                    faultline.point(
+                        "tpu.collect", lanes=self._n_device_lanes
+                    )
                 out: list[bool] = []
                 for collect, keep in pending:
                     # pallas chunks hand back a lazy collector; the XLA
                     # fallback hands back the device array itself
                     mask = collect() if callable(collect) else np.asarray(collect)
                     out.extend(bool(v) for v in mask[:keep])
+                if pending:
+                    self.device_ok = True
+                    if self._on_device_outcome is not None:
+                        self._on_device_outcome(True)
                 out.extend(host_mask)
             except Exception as e:
+                # feed the breaker only for DEVICE-phase failures: a
+                # host-tail verify dying must not open the breaker and
+                # route everything onto the very path that just failed
+                if (
+                    pending
+                    and device_phase
+                    and self._on_device_outcome is not None
+                ):
+                    self._on_device_outcome(False)
                 if device_items is not None and self._sw is not None:
                     # device path died mid-flight: the host oracle can
                     # still answer (same degradation _flush_locked
@@ -322,23 +506,9 @@ class _FlushResult:
                 )
 
     def _host_verify(self, items):
-        """Host verification preferring the native libcrypto batch
-        (native/ecverify.cc) — GIL-free and a multiple of the
-        python-per-signature rate on hosts with a fast libcrypto; the
-        python engine is the fallback oracle.  Feeds the process-wide
-        measured host rate (deadline budgeting reserves race time from
-        OBSERVED speed, not the configuration hint)."""
-        if not items:
-            return []
-        from fabric_tpu import native
-
-        t0 = time.perf_counter()
-        mask = native.ecdsa_verify_host(items)
-        if mask is None:
-            mask = self._sw.verify_batch(items)
-        if len(items) >= 256:
-            _note_host_rate(len(items), time.perf_counter() - t0)
-        return mask
+        """Host verification (native libcrypto preferred, python
+        fallback) — see the module-level _host_verify_batch."""
+        return _host_verify_batch(self._sw, items)
 
     def _host_race(self) -> bool:
         """Deadline expired: verify this flush's items on the host,
@@ -398,8 +568,26 @@ class TPUCSP(CSP):
         max_chunk: int = _MAX_CHUNK,
         stall_factor: float | None = 1.0,
         host_rate_hint: float = 9000.0,
+        breaker_threshold: int | None = None,
+        breaker_probe_every: int | None = None,
+        metrics=None,
     ):
-        self._sw = sw or SWCSP()
+        if sw is None:
+            if SWCSP is None:
+                raise ImportError(
+                    "TPUCSP's default host oracle (SWCSP) requires the "
+                    "'cryptography' package; pass an explicit `sw` "
+                    "provider on hosts without it"
+                )
+            sw = SWCSP()
+        self._sw = sw
+        # degraded-mode circuit breaker: consecutive device failures
+        # flip every verify/hash to the host oracle (no device queuing)
+        # until a periodic probe batch sees the device recover
+        self._breaker = _Breaker(
+            breaker_threshold, breaker_probe_every, metrics
+        )
+        self._probe_cache: list | None = None
         # Below this size, host verify wins on latency (device dispatch
         # overhead); the sw provider is also the fallback oracle.
         self._min_device_batch = min_device_batch
@@ -455,6 +643,17 @@ class TPUCSP(CSP):
         self.last_dispatch_devices: tuple = ()
 
     # -- lifecycle ---------------------------------------------------------
+
+    def set_metrics(self, metrics) -> None:
+        """Bind a common.metrics.CSPMetrics (e.g. from
+        operations.System.csp_metrics()) so breaker state/trips and
+        device failures surface on /metrics."""
+        self._breaker.set_metrics(metrics)
+
+    @property
+    def breaker(self) -> "_Breaker":
+        """The degraded-mode circuit breaker (tests/diagnostics)."""
+        return self._breaker
 
     def drain(self, timeout: float | None = 60.0) -> bool:
         """Quiesce the provider: flush anything still buffered (so no
@@ -538,20 +737,40 @@ class TPUCSP(CSP):
     def hash_batch(self, msgs: Sequence[bytes]) -> list[bytes]:
         if len(msgs) < self._min_device_batch:
             return [hashlib.sha256(m).digest() for m in msgs]
+        if self._breaker_gate():
+            # open breaker: the host path IS the oracle for hashing —
+            # _breaker_gate already ran the periodic recovery probe, so
+            # hash-only workloads (snapshot exports) can close the
+            # breaker too, not just verify traffic
+            return [hashlib.sha256(m).digest() for m in msgs]
         from fabric_tpu.csp.tpu import sha256 as dev_sha
 
-        # Bucket by padded block count AND batch size to bound compiles.
-        nb = max((len(m) + 9 + 63) // 64 for m in msgs)
-        nb = 1 << (nb - 1).bit_length()
-        n = len(msgs)
-        bsz = _bucket(n, _HASH_BUCKETS)
-        out: list[bytes] = []
-        for off in range(0, n, bsz):
-            chunk = list(msgs[off : off + bsz])
-            pad = bsz - len(chunk)
-            chunk += [b""] * pad
-            digs = dev_sha.sha256_batch(chunk, n_blocks=nb)
-            out.extend(digs[: bsz - pad])
+        try:
+            faultline.point("tpu.hash", n=len(msgs))
+            # Bucket by padded block count AND batch size to bound
+            # compiles.
+            nb = max((len(m) + 9 + 63) // 64 for m in msgs)
+            nb = 1 << (nb - 1).bit_length()
+            n = len(msgs)
+            bsz = _bucket(n, _HASH_BUCKETS)
+            out: list[bytes] = []
+            for off in range(0, n, bsz):
+                chunk = list(msgs[off : off + bsz])
+                pad = bsz - len(chunk)
+                chunk += [b""] * pad
+                digs = dev_sha.sha256_batch(chunk, n_blocks=nb)
+                out.extend(digs[: bsz - pad])
+        except Exception:
+            # device died mid-hash: the host answers, the breaker
+            # counts — loudly, so a swallowed correctness bug in the
+            # device path cannot hide as a silent perf regression
+            self._breaker.record(False)
+            _logger.warning(
+                "device hash_batch failed; served %d digests from the "
+                "host fallback", len(msgs), exc_info=True,
+            )
+            return [hashlib.sha256(m).digest() for m in msgs]
+        self._breaker.record(True)
         return out
 
     # -- verification ------------------------------------------------------
@@ -576,6 +795,12 @@ class TPUCSP(CSP):
         if len(items) < self._min_device_batch:
             result = self._sw.verify_batch(items)
             return lambda: result
+        if self._breaker_gate():
+            # degraded mode: the device is failing, so serve from the
+            # host oracle with NO device queuing (the gate already ran
+            # this call's recovery probe if it was due)
+            mask = _host_verify_batch(self._sw, list(items))
+            return lambda: mask
         with self._pend_lock:
             gen = self._gen
             seg_start = self._pend_lanes
@@ -637,6 +862,7 @@ class TPUCSP(CSP):
             # a failed dispatch must not strand the other coalesced
             # batches' collectors (their items are already dequeued):
             # degrade the whole flush to the host oracle, lazily
+            self._breaker.record(False)
             res = _FlushResult([], len(items), host_items=items, sw=self._sw)
         self._flushed[gen] = res
         self._inflight = [
@@ -645,8 +871,58 @@ class TPUCSP(CSP):
         ]
         self._inflight.append(res)
 
+    # Fixed known-good P-256 probe vector (key/signature precomputed for
+    # digest = SHA-256("faultline-breaker-probe")): the recovery probe
+    # must work with ANY host oracle, including minimal hosts where the
+    # sw provider (and thus key_gen/sign) is unavailable.
+    _PROBE_QX = 0x46464CED59A558637321A8AB0D957C71C46162990C1311469A8FC24032FEC1E3
+    _PROBE_QY = 0xDE57524FDD4A8DBC03E77BE70FAA656B2F12A7B34BA3CCAADBC042640104E4ED
+    _PROBE_R = 0x2C63F9FD69C2C999966BDF5ACEB3E114A42C852AB7AF88870E7D29CB4C5AC471
+    _PROBE_S = 0x767B9BC011A2EC87635DFEAB8334A15995113A67176CA4D02F706D316C9EB86F
+
+    def _probe_items(self) -> list:
+        """A tiny cached known-good batch for breaker recovery probes
+        (one fixed public key + signature, duplicated to two lanes)."""
+        if self._probe_cache is None:
+            key = _ProbeKey(self._PROBE_QX, self._PROBE_QY)
+            digest = self.hash(b"faultline-breaker-probe")
+            sig = api.marshal_ecdsa_signature(self._PROBE_R, self._PROBE_S)
+            item = VerifyBatchItem(key, digest, sig)
+            self._probe_cache = [item, item]
+        return self._probe_cache
+
+    def _breaker_gate(self) -> bool:
+        """Degraded-mode routing decision: while the breaker is open,
+        run the periodic recovery probe when due; True when this call
+        must be served by the host path (still open afterwards)."""
+        if not self._breaker.open:
+            return False
+        if self._breaker.probe_due():
+            ok = self._probe_device()
+            self._breaker.note_probe(ok)
+            if ok:
+                self._breaker.close()
+        return self._breaker.open
+
+    def _probe_device(self) -> bool:
+        """One probe batch straight through the device path, collected
+        synchronously; True only when the DEVICE (not the host
+        fallback) produced an all-valid mask."""
+        try:
+            res = self._dispatch(list(self._probe_items()))
+        except Exception:
+            return False
+        res._wait_device()
+        try:
+            mask = res.collect()
+        except Exception:
+            return False
+        return res.device_ok and all(mask)
+
     def _dispatch(self, items) -> "_FlushResult":
         import jax
+
+        faultline.point("tpu.dispatch", lanes=len(items))
 
         # local_devices: on a multi-host pod, jax.devices() includes
         # devices other processes own; device_put to those raises
@@ -699,6 +975,7 @@ class TPUCSP(CSP):
                 pending, len(items) + len(host_items),
                 host_items=host_items, sw=self._sw,
                 device_items=list(items),
+                on_device_outcome=self._breaker.record,
             )
 
         from fabric_tpu.csp.tpu import pallas_ec
@@ -798,6 +1075,7 @@ class TPUCSP(CSP):
             device_items=list(items),
             deadline=self._deadline_for(len(items)),
             on_device_wall=self._note_device_wall,
+            on_device_outcome=self._breaker.record,
         )
 
     def _note_device_wall(self, lanes: int, wall: float) -> None:

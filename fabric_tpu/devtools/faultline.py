@@ -1,0 +1,499 @@
+"""faultline — deterministic fault injection across comm/ledger/TPU.
+
+The lockwatch/threadwatch sanitizers (PRs 3-4) proved that robustness
+claims only hold when a machine can exercise them.  This module is the
+failure-side counterpart: named fault points compiled into the
+failure-critical layers (`comm/rpc.py`, `gossip/comm.py`,
+`orderer/raft/transport.py`, `peer/deliverclient.py`,
+`ledger/kvstore.py`+`blkstorage.py`+`kvledger.py`,
+`csp/tpu/provider.py`) that are ZERO-OVERHEAD no-ops unless a plan is
+armed — `point()` is a module-global load and an `is None` test, and
+`io()` hands back the very socket it was given — so production and
+tier-1 hot paths pay nothing.
+
+A PLAN is a JSON document (inline in ``FABRIC_TPU_FAULTLINE``, or
+``@/path/to/plan.json``, or passed to :func:`activate` /
+:func:`use_plan` by tests)::
+
+    {"seed": 7, "faults": [
+        {"point": "kvstore.txn", "action": "crash", "nth": 2},
+        {"point": "raft.conn.write", "action": "raise",
+         "error": "ECONNRESET", "every": 5},
+        {"point": "tpu.collect", "action": "raise",
+         "error": "DeviceUnavailable", "count": 3},
+        {"point": "blkstorage.file_append", "action": "torn",
+         "cut": 0.4, "nth": 1},
+        {"point": "commit.stage", "ctx": {"stage": "pvt"},
+         "action": "crash", "nth": 1},
+        {"point": "rpc.client.read", "action": "partial",
+         "prob": 0.25}
+    ]}
+
+Actions: ``raise`` (named error class, default :class:`FaultInjected`),
+``crash`` (:class:`FaultCrash` — simulated process death, a
+BaseException so no recovery/cleanup handler may swallow it), ``delay``
+(``delay_s`` seconds), ``torn`` (at :func:`write` points: a prefix of
+the payload lands, then FaultCrash — torn-write-then-crash), and
+``partial`` (at :func:`io` read points: a truncated read, then the
+connection is reset).  Triggers: ``nth`` (fire on the Nth matching
+hit), ``every`` (every Kth), ``prob`` (seeded probability), default
+every hit; ``count`` caps total trips (default 1 for ``nth``,
+unlimited otherwise); ``ctx`` restricts to call sites whose keyword
+context matches (e.g. a specific commit stage).  All randomness comes
+from ``random.Random(f"{seed}:{rule_index}")`` — never wall-clock — so a
+chaos run REPLAYS exactly: the same plan over the same workload yields
+an identical trip ledger.
+
+Every fired fault is recorded in a process-wide TRIP LEDGER
+(:func:`trips`), queryable by tests and drained via conftest like the
+threadwatch ledger: :func:`use_plan` clears it on exit, and the
+session-end gate asserts no plan is still armed and no trips were left
+unexamined.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import random
+import threading
+import time
+
+_ENV = "FABRIC_TPU_FAULTLINE"
+
+
+class PlanError(ValueError):
+    """A fault plan that does not validate."""
+
+
+class FaultInjected(OSError):
+    """Generic injected failure.  An OSError so the transports' and
+    storage layers' real error paths route it like the failures it
+    stands in for."""
+
+
+class FaultCrash(BaseException):
+    """Simulated process death.  Deliberately NOT an Exception: a broad
+    ``except Exception`` recovery handler must never swallow it, and the
+    ledger's group-rollback seam explicitly skips cleanup for it
+    (``faultline.is_crash``) — a real crash gets no unwind, so the test
+    that catches this and reopens the store exercises the REAL recovery
+    path, not the graceful one."""
+
+
+class DeviceUnavailable(RuntimeError):
+    """Injected accelerator loss (the TPU device vanished mid-flush)."""
+
+
+_ERRORS = {
+    "FaultInjected": FaultInjected,
+    "FaultCrash": FaultCrash,
+    "OSError": OSError,
+    "IOError": OSError,
+    "ConnectionResetError": ConnectionResetError,
+    "ECONNRESET": ConnectionResetError,
+    "BrokenPipeError": BrokenPipeError,
+    "ConnectionRefusedError": ConnectionRefusedError,
+    "TimeoutError": TimeoutError,
+    "RuntimeError": RuntimeError,
+    "ValueError": ValueError,
+    "DeviceUnavailable": DeviceUnavailable,
+}
+
+_ACTIONS = ("raise", "crash", "delay", "torn", "partial")
+
+# the armed plan; point()/io()/write() fast paths test ONLY this global
+_plan = None
+_state_lock = threading.Lock()
+
+# process-wide trip ledger (survives deactivate; use_plan drains it)
+_trips: list[dict] = []
+_trips_lock = threading.Lock()
+
+# plan consultations — stays 0 while no plan is armed, which is the
+# acceptance test for "every fault point is a no-op when unset"
+_lookups = [0]
+
+
+class _Rule:
+    """One fault specification, with its deterministic trigger state."""
+
+    def __init__(self, index: int, spec: dict, seed: int):
+        if not isinstance(spec, dict):
+            raise PlanError(f"fault #{index} is not an object")
+        point = spec.get("point")
+        if not isinstance(point, str) or not point:
+            raise PlanError(f"fault #{index}: missing point name")
+        self.index = index
+        self.point = point
+        self.action = spec.get("action", "raise")
+        if self.action not in _ACTIONS:
+            raise PlanError(
+                f"fault #{index}: unknown action {self.action!r} "
+                f"(one of {', '.join(_ACTIONS)})"
+            )
+        self.error = spec.get("error", "FaultInjected")
+        if self.error not in _ERRORS:
+            raise PlanError(
+                f"fault #{index}: unknown error {self.error!r} "
+                f"(one of {', '.join(sorted(_ERRORS))})"
+            )
+        self.message = spec.get(
+            "message", f"faultline: injected fault at {point}"
+        )
+        try:
+            self.delay_s = float(spec.get("delay_s", 0.01))
+            self.cut = float(spec.get("cut", 0.5))
+        except (TypeError, ValueError):
+            raise PlanError(
+                f"fault #{index}: delay_s/cut must be numbers"
+            ) from None
+        if not 0.0 <= self.cut <= 1.0:
+            raise PlanError(f"fault #{index}: cut must be in [0, 1]")
+        ctx = spec.get("ctx") or {}
+        if not isinstance(ctx, dict):
+            raise PlanError(f"fault #{index}: ctx must be an object")
+        self.ctx = ctx
+        def typed(key, conv, minimum=None):
+            """Coerce a trigger field at PARSE time — a bad value must
+            be a PlanError at activate(), not a TypeError mid-commit
+            inside the injected production path."""
+            v = spec.get(key)
+            if v is None:
+                return None
+            try:
+                v = conv(v)
+            except (TypeError, ValueError):
+                raise PlanError(
+                    f"fault #{index}: {key} must be a {conv.__name__}"
+                ) from None
+            if minimum is not None and v < minimum:
+                raise PlanError(
+                    f"fault #{index}: {key} must be >= {minimum}"
+                )
+            return v
+
+        self.nth = typed("nth", int, minimum=1)
+        self.every = typed("every", int, minimum=1)
+        self.prob = typed("prob", float)
+        if self.prob is not None and not 0.0 <= self.prob <= 1.0:
+            raise PlanError(f"fault #{index}: prob must be in [0, 1]")
+        if sum(x is not None for x in (self.nth, self.every, self.prob)) > 1:
+            raise PlanError(
+                f"fault #{index}: nth/every/prob are mutually exclusive"
+            )
+        default_count = 1 if self.nth is not None else None
+        self.count = typed("count", int, minimum=1)
+        if self.count is None:
+            self.count = default_count
+        self.hits = 0
+        self.trips = 0
+        # seeded from the PLAN, never wall-clock: chaos runs replay
+        self._rng = random.Random(f"{seed}:{index}")
+
+    def matches(self, ctx: dict) -> bool:
+        return all(ctx.get(k) == v for k, v in self.ctx.items())
+
+    def fire(self) -> bool:
+        """Count a matching hit and decide whether this rule's trigger
+        fires on it (caller holds the plan lock).  Does NOT record the
+        trip — when several rules on one point fire on the same hit,
+        only the first in plan order wins and Plan.visit records it."""
+        self.hits += 1
+        if self.count is not None and self.trips >= self.count:
+            return False
+        if self.nth is not None:
+            return self.hits == self.nth
+        if self.every is not None:
+            return self.hits % self.every == 0
+        if self.prob is not None:
+            return self._rng.random() < self.prob
+        return True
+
+    def execute(self):
+        """Perform the point-level action: raise, crash, or delay.
+        torn/partial reached through a bare point() cannot honor their
+        data-level semantics, so they degrade to a loud raise."""
+        if self.action == "delay":
+            if self.delay_s > 0:
+                time.sleep(self.delay_s)
+            return
+        if self.action == "crash":
+            raise FaultCrash(self.message)
+        if self.action == "raise":
+            raise _ERRORS[self.error](self.message)
+        raise FaultInjected(
+            f"{self.message} ({self.action} fault at a non-data point)"
+        )
+
+    def cut_len(self, n: int) -> int:
+        """Strict-prefix length for torn/partial payloads of n bytes."""
+        if n <= 0:
+            return 0
+        return max(0, min(n - 1, int(n * self.cut)))
+
+
+class Plan:
+    """A parsed, armed fault schedule."""
+
+    def __init__(self, spec):
+        if isinstance(spec, (str, bytes)):
+            try:
+                spec = json.loads(spec)
+            except ValueError as exc:
+                raise PlanError(f"plan is not valid JSON: {exc}") from exc
+        if not isinstance(spec, dict):
+            raise PlanError("plan must be a JSON object")
+        try:
+            self.seed = int(spec.get("seed", 0))
+        except (TypeError, ValueError):
+            raise PlanError("plan seed must be an integer") from None
+        faults = spec.get("faults")
+        if not isinstance(faults, list) or not faults:
+            raise PlanError("plan must carry a non-empty 'faults' list")
+        self.rules: list[_Rule] = [
+            _Rule(i, fs, self.seed) for i, fs in enumerate(faults)
+        ]
+        self._by_point: dict[str, list[_Rule]] = {}
+        for r in self.rules:
+            self._by_point.setdefault(r.point, []).append(r)
+        self._lock = threading.Lock()
+
+    def visit(self, name: str, ctx: dict):
+        """Consult the schedule for one hit of `name`; returns the
+        tripped rule (trip already recorded in the ledger) or None.
+        EVERY matching rule counts the hit — a later rule's nth/every
+        trigger must not drift just because an earlier rule fired on
+        the same hit; when several fire at once the first in plan
+        order wins and only it records a trip."""
+        winner = None
+        with self._lock:
+            _lookups[0] += 1
+            for r in self._by_point.get(name, ()):
+                if r.matches(ctx) and r.fire() and winner is None:
+                    winner = r
+            if winner is not None:
+                winner.trips += 1
+                rec = {
+                    "point": name,
+                    "action": winner.action,
+                    "rule": winner.index,
+                    "hit": winner.hits,
+                    "trip": winner.trips,
+                }
+                if ctx:
+                    rec["ctx"] = dict(ctx)
+                with _trips_lock:
+                    _trips.append(rec)
+        return winner
+
+
+# -- fault points -------------------------------------------------------------
+
+
+def point(name: str, **ctx) -> None:
+    """A named fault point.  No plan armed: a global load + None test.
+    Armed: consult the schedule; a tripped rule raises (raise/crash) or
+    delays in place."""
+    p = _plan
+    if p is None:
+        return
+    r = p.visit(name, ctx)
+    if r is not None:
+        r.execute()
+
+
+def write(name: str, fh, *chunks: bytes, **ctx) -> None:
+    """File-write fault point: honors torn-write-then-crash.  No plan:
+    writes the chunks straight through (no concatenation, no copy).  A
+    tripped ``torn`` rule writes a strict prefix of the joined payload,
+    flushes it so the tear is really on disk, and raises
+    :class:`FaultCrash`; other actions execute BEFORE anything is
+    written (crash-before-write)."""
+    p = _plan
+    if p is None:
+        for c in chunks:
+            fh.write(c)
+        return
+    r = p.visit(name, ctx)
+    if r is None:
+        for c in chunks:
+            fh.write(c)
+        return
+    if r.action == "torn":
+        data = b"".join(chunks)
+        cut = r.cut_len(len(data))
+        fh.write(data[:cut])
+        fh.flush()
+        raise FaultCrash(
+            f"faultline: torn write at {name} "
+            f"({cut}/{len(data)} bytes), then crash"
+        )
+    r.execute()
+    for c in chunks:
+        fh.write(c)
+
+
+class _FaultSocket:
+    """Socket proxy visiting ``<name>.read`` / ``<name>.write`` fault
+    points around recv/send.  A ``partial`` read returns a truncated
+    chunk and marks the connection dead (the next read resets); a
+    ``partial``/``torn`` write sends a prefix then resets.  Everything
+    else passes through untouched."""
+
+    def __init__(self, inner, name: str):
+        self._fl_inner = inner
+        self._fl_name = name
+        self._fl_dead = False
+
+    def __getattr__(self, attr):
+        return getattr(self._fl_inner, attr)
+
+    def _fl_visit(self, kind: str):
+        if self._fl_dead:
+            raise ConnectionResetError(
+                f"faultline: {self._fl_name} connection reset (injected)"
+            )
+        p = _plan
+        if p is None:
+            return None
+        return p.visit(f"{self._fl_name}.{kind}", {})
+
+    def recv(self, bufsize: int, *args):
+        r = self._fl_visit("read")
+        if r is not None:
+            if r.action == "partial":
+                data = self._fl_inner.recv(bufsize, *args)
+                self._fl_dead = True
+                return data[: r.cut_len(len(data))]
+            r.execute()
+        return self._fl_inner.recv(bufsize, *args)
+
+    def _fl_send(self, data, send_fn):
+        r = self._fl_visit("write")
+        if r is not None:
+            if r.action in ("partial", "torn"):
+                cut = r.cut_len(len(data))
+                if cut:
+                    self._fl_inner.sendall(data[:cut])
+                self._fl_dead = True
+                raise ConnectionResetError(
+                    f"faultline: {self._fl_name} write torn at "
+                    f"{cut}/{len(data)} bytes (injected)"
+                )
+            r.execute()
+        return send_fn(data)
+
+    def sendall(self, data):
+        return self._fl_send(data, self._fl_inner.sendall)
+
+    def send(self, data):
+        return self._fl_send(data, self._fl_inner.send)
+
+
+def io(sock, name: str):
+    """Wrap a socket in read/write fault points ``<name>.read`` /
+    ``<name>.write``.  Returns the socket UNCHANGED when no plan is
+    armed — the wrapper only ever exists inside a chaos run."""
+    if _plan is None:
+        return sock
+    return _FaultSocket(sock, name)
+
+
+def is_crash(exc: BaseException) -> bool:
+    """True for the simulated-process-death exception — cleanup/rollback
+    seams skip their unwind for it so reopen exercises real recovery."""
+    return isinstance(exc, FaultCrash)
+
+
+# -- plan lifecycle -----------------------------------------------------------
+
+
+def active() -> bool:
+    return _plan is not None
+
+
+def current_plan():
+    return _plan
+
+
+def lookup_count() -> int:
+    """Total plan consultations so far — provably 0 while no plan has
+    ever been armed (the zero-overhead acceptance probe)."""
+    return _lookups[0]
+
+
+def trips() -> list[dict]:
+    """Snapshot of the process-wide trip ledger."""
+    with _trips_lock:
+        return [dict(t) for t in _trips]
+
+
+def reset_trips() -> None:
+    with _trips_lock:
+        _trips.clear()
+
+
+def activate(plan) -> Plan:
+    """Arm a plan (dict, JSON string, or Plan).  Replaces any armed
+    plan; trigger state starts fresh."""
+    p = plan if isinstance(plan, Plan) else Plan(plan)
+    global _plan
+    with _state_lock:
+        _plan = p
+    return p
+
+
+def deactivate() -> None:
+    global _plan
+    with _state_lock:
+        _plan = None
+
+
+@contextlib.contextmanager
+def use_plan(plan):
+    """Arm a plan for a scope and DRAIN on exit: the plan is disarmed
+    and the trip ledger cleared, so the conftest session gate (which
+    asserts no armed plan and an empty ledger) stays green for every
+    test that keeps its chaos inside this context."""
+    p = activate(plan)
+    try:
+        yield p
+    finally:
+        deactivate()
+        reset_trips()
+
+
+def _init_from_env() -> None:
+    raw = os.environ.get(_ENV, "")
+    if not raw or raw in ("0", "false", "off"):
+        return
+    if raw.startswith("@"):
+        with open(raw[1:], "r", encoding="utf-8") as f:
+            raw = f.read()
+    activate(raw)
+
+
+_init_from_env()
+
+
+__all__ = [
+    "PlanError",
+    "FaultInjected",
+    "FaultCrash",
+    "DeviceUnavailable",
+    "Plan",
+    "point",
+    "write",
+    "io",
+    "is_crash",
+    "active",
+    "current_plan",
+    "lookup_count",
+    "trips",
+    "reset_trips",
+    "activate",
+    "deactivate",
+    "use_plan",
+]

@@ -29,7 +29,9 @@ exception-discipline
     No ``except Exception`` (or bare ``except``) in ``peer/``,
     ``policies/``, ``ledger/`` whose handler swallows without a
     structured sentinel (re-raise, sentinel assignment, logger call, or
-    named error return).
+    named error return).  ``faultline.*`` calls are a reviewed seam and
+    TRANSPARENT to this analysis: an injection point inside a handler
+    neither counts as the sentinel nor fires on its own.
 
 determinism
     In validation/commit/policy paths where peers must agree (``peer/``,
@@ -288,11 +290,30 @@ def _is_trivial_return_value(v) -> bool:
     return False
 
 
+def _is_faultline_stmt(stmt) -> bool:
+    """Expression statements calling the faultline seam
+    (``faultline.point(...)`` etc.) are TRANSPARENT to the swallow
+    analysis: an injection point inside an except handler is a reviewed
+    seam (like the lockwatch seam) — it neither launders the swallow
+    into "handled" (it is not a structured sentinel) nor constitutes a
+    violation of its own."""
+    if not (isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call)):
+        return False
+    f = stmt.value.func
+    return (
+        isinstance(f, ast.Attribute)
+        and isinstance(f.value, ast.Name)
+        and f.value.id == "faultline"
+    )
+
+
 def _swallows(handler: ast.ExceptHandler) -> bool:
     if any(isinstance(n, ast.Raise) for n in ast.walk(handler)):
         return False
     for stmt in handler.body:
         if isinstance(stmt, (ast.Pass, ast.Continue, ast.Break)):
+            continue
+        if _is_faultline_stmt(stmt):
             continue
         if isinstance(stmt, ast.Return) and _is_trivial_return_value(
             stmt.value

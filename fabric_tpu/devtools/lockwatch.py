@@ -555,6 +555,43 @@ def spawn_timer(interval: float, function, *, name: str | None = None,
     return t
 
 
+def tracked_executor(max_workers=None, *, name: str = "executor",
+                     kind: str = "worker", initializer=None,
+                     initargs=()):
+    """A ``concurrent.futures.ThreadPoolExecutor`` through the
+    threadwatch seam.  Pool workers are invisible to the session-end
+    drain gate when created raw — they are plain threads spawned deep
+    inside the executor — so a leaked executor (nobody called
+    ``shutdown``) keeps live threads past the tests without anything
+    noticing.  Under FABRIC_TPU_THREADWATCH each pool worker registers
+    itself (via the executor's initializer hook) in the same live
+    registry as spawn_thread workers: the drain gate then joins them,
+    and an executor whose owner never shut it down fails the session
+    deterministically.  Registry entries of exited workers are pruned
+    on read (threads_alive), so a properly shut-down pool leaves no
+    residue.  Without threadwatch this returns a plain executor —
+    zero overhead."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    if kind not in ("worker", "service"):
+        raise ValueError(f"unknown thread kind {kind!r}")
+    if not threads_enabled():
+        return ThreadPoolExecutor(
+            max_workers, thread_name_prefix=name,
+            initializer=initializer, initargs=initargs,
+        )
+
+    def _register_worker(*args):
+        _register(threading.current_thread(), kind)
+        if initializer is not None:
+            initializer(*args)
+
+    return ThreadPoolExecutor(
+        max_workers, thread_name_prefix=name,
+        initializer=_register_worker, initargs=initargs,
+    )
+
+
 def drain_threads(timeout: float = 10.0, kinds=("worker",)) -> list[str]:
     """Join every live registered thread of the given kinds against one
     shared deadline.  Stragglers are recorded in ``thread_violations``
@@ -602,6 +639,7 @@ __all__ = [
     "violations",
     "spawn_thread",
     "spawn_timer",
+    "tracked_executor",
     "threads_enabled",
     "threads_alive",
     "thread_violations",

@@ -24,6 +24,8 @@ import socket
 import struct
 import threading
 
+from fabric_tpu.comm.backoff import DecorrelatedBackoff
+from fabric_tpu.devtools import faultline
 from fabric_tpu.devtools.lockwatch import named_lock, spawn_thread
 from fabric_tpu.protos.gossip import message_pb2 as gpb
 
@@ -268,6 +270,13 @@ class TCPGossipComm(GossipComm):
 
     def _sender(self, endpoint: str, q: queue.Queue) -> None:
         sock = None
+        # deterministic decorrelated jitter, seeded from stable
+        # local+peer identity: a down peer (including the dial-back
+        # path — responses ride this same sender) is not re-dialed at
+        # message rate, chaos runs replay the exact dial cadence, and
+        # the local half keeps different peers' retry windows from
+        # aligning against one downed node
+        bo = DecorrelatedBackoff.for_key(f"{self.endpoint}->{endpoint}")
         while not self._stop.is_set():
             try:
                 data = q.get(timeout=0.5)
@@ -276,6 +285,7 @@ class TCPGossipComm(GossipComm):
             for _ in range(2):  # one reconnect attempt per message
                 if sock is None:
                     try:
+                        faultline.point("gossip.dial", endpoint=endpoint)
                         host, port = endpoint.rsplit(":", 1)
                         sock = socket.create_connection((host, int(port)), timeout=2)
                         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
@@ -283,12 +293,20 @@ class TCPGossipComm(GossipComm):
                             sock = self._client_ctx.wrap_socket(
                                 sock, server_hostname=host
                             )
+                        sock = faultline.io(sock, "gossip.conn")
                         sock.sendall(self._handshake_frame())
                     except OSError:
                         sock = None
+                        # gossip is loss-tolerant: wait out the backoff
+                        # window here (messages queue or drop meanwhile)
+                        self._stop.wait(bo.next())
                         break
                 try:
                     sock.sendall(_LEN.pack(len(data)) + data)
+                    # only a completed DATA send proves the link: an
+                    # accept-then-reset peer must not restart the
+                    # backoff sequence every flap
+                    bo.reset()
                     break
                 except OSError:
                     try:
@@ -296,6 +314,9 @@ class TCPGossipComm(GossipComm):
                     except OSError:
                         pass
                     sock = None
+                    # same window as a failed dial — without this, a
+                    # connect-ok-send-fail peer is redialed per message
+                    self._stop.wait(bo.next())
 
     # -- inbound -----------------------------------------------------------
 

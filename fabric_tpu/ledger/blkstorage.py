@@ -15,6 +15,7 @@ import os
 import struct
 import threading
 
+from fabric_tpu.devtools import faultline
 from fabric_tpu.ledger.kvstore import KVStore, MemKVStore, NamedDB
 from fabric_tpu.protos.common import common_pb2
 from fabric_tpu import protoutil
@@ -330,8 +331,14 @@ class BlockStore:
                 with open(path, "ab") as f:
                     if f.tell() != offset:
                         f.seek(offset)
-                    f.write(_LEN.pack(len(raw)))
-                    f.write(raw)
+                    # faultline seam: a 'torn' fault writes a prefix of
+                    # the record and crashes — the mid-record tear the
+                    # recovery scan must truncate
+                    faultline.write(
+                        "blkstorage.file_append", f,
+                        _LEN.pack(len(raw)), raw,
+                        block=blk.header.number,
+                    )
                     f.flush()
                     if sync:
                         os.fsync(f.fileno())
@@ -383,6 +390,7 @@ class BlockStore:
         if self._mem_blocks is not None:
             return
         for idx in sorted(file_idxs):
+            faultline.point("blkstorage.fsync", file=idx)
             fd = os.open(self._file_path(idx), os.O_RDONLY)
             try:
                 os.fsync(fd)

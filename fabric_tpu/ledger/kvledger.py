@@ -12,6 +12,7 @@ import os
 import threading
 import time
 
+from fabric_tpu.devtools import faultline
 from fabric_tpu.devtools.lockwatch import named_rlock
 from fabric_tpu.ledger.blkstorage import BlockStore, BlockStoreError
 from fabric_tpu.ledger.history import HistoryDB
@@ -305,16 +306,24 @@ class KVLedger:
                     f"ledger {self.ledger_id!r}"
                 )
             try:
+                # fabriclint: allow[lock-discipline] the faultline stage
+                # points inside may inject delays under the commit lock BY
+                # DESIGN (chaos latency testing); with no plan armed they
+                # are zero-overhead no-ops
                 self._commit_into(
                     block, pvt_data, missing_pvt, rwsets, assist, g
                 )
-            except BaseException:
+            except BaseException as exc:
                 # a failure after add_block would otherwise leave the
                 # live block store advanced (file appended, height
                 # bumped) with its index writes stranded in the
                 # abandoned collector — unwind the WHOLE group (its
-                # blocks were never acknowledged)
-                self._rollback_group(g)
+                # blocks were never acknowledged).  An injected
+                # FaultCrash models PROCESS DEATH: no unwind runs, so
+                # the chaos tests' reopen exercises the real recovery
+                # path, not the graceful rollback.
+                if not faultline.is_crash(exc):
+                    self._rollback_group(g)
                 raise
             if group is None:
                 self._flush_group(g)
@@ -353,6 +362,12 @@ class KVLedger:
             footprints=footprints,
         )
         protoutil.set_tx_filter(block, flags)
+        # stage-boundary fault points: an injected crash lands AFTER the
+        # named stage's work (the any-stage crash matrix in
+        # tests/test_chaos_commit.py drives every one of these)
+        faultline.point(
+            "commit.stage", stage="mvcc", block=block.header.number
+        )
         t1 = t()
         file_idx = self._blocks.add_block(
             block, txids=txids, env_bytes=env_bytes,
@@ -360,6 +375,9 @@ class KVLedger:
         )
         if file_idx is not None:
             group.dirty_files.add(file_idx)
+        faultline.point(
+            "commit.stage", stage="block_append", block=block.header.number
+        )
         t2 = t()
         # Pvt store and state ride the SAME atomic KV transaction (with
         # the savepoint), so recovery never sees state ahead of the pvt
@@ -370,14 +388,23 @@ class KVLedger:
             block.header.number, pvt_data or {}, missing_pvt,
             into=group.collector,
         )
+        faultline.point(
+            "commit.stage", stage="pvt", block=block.header.number
+        )
         t3 = t()
         group.state.apply_updates(
             batch, Height(block.header.number, len(flags))
+        )
+        faultline.point(
+            "commit.stage", stage="state", block=block.header.number
         )
         t4 = t()
         self._history.commit(
             block.header.number, _history_writes(rwsets, flags, footprints),
             into=group.collector,
+        )
+        faultline.point(
+            "commit.stage", stage="history", block=block.header.number
         )
         t5 = t()
         group.blocks += 1
@@ -397,16 +424,21 @@ class KVLedger:
             t0 = time.perf_counter()
             try:
                 self._blocks.sync_files(group.dirty_files)
+                faultline.point("commit.stage", stage="fsync")
                 t1 = time.perf_counter()
                 group.collector.flush()
-            except BaseException:
+                faultline.point("commit.stage", stage="kv_txn")
+            except BaseException as exc:
                 # roll the WHOLE group back so the live ledger stays
                 # consistent with committed storage: the buffered index
                 # data is gone, so the unindexed file appends go with it
                 # and height/hash return to the durable watermark.  The
                 # group's blocks were never acknowledged; callers may
                 # re-commit them into a fresh (or this, now-empty) group.
-                self._rollback_group(group)
+                # An injected FaultCrash (simulated process death) skips
+                # the unwind — reopen must run real recovery instead.
+                if not faultline.is_crash(exc):
+                    self._rollback_group(group)
                 raise
             t2 = time.perf_counter()
             self._observe_stages(fsync=t1 - t0, kv_txn=t2 - t1)

@@ -15,6 +15,8 @@ import sqlite3
 import threading
 from typing import Iterator
 
+from fabric_tpu.devtools import faultline
+
 
 class KVStore:
     """Ordered byte-key store. Iteration is over a half-open [start, end)
@@ -130,6 +132,10 @@ class SqliteKVStore(KVStore):
         return out
 
     def write_batch(self, puts, deletes=()) -> None:
+        # fault point BEFORE the transaction: an injected crash here
+        # models process death between the block-file fsync and the KV
+        # txn (sqlite's own atomicity covers mid-txn death)
+        faultline.point("kvstore.txn", puts=len(puts))
         with self._lock:
             with self._conn:
                 self._conn.executemany(

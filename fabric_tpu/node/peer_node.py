@@ -259,6 +259,10 @@ class PeerNode:
                     ch.ledger.height > 0 for ch in self.channels.values()
                 ) else "empty ledger",
             )
+            if hasattr(csp, "set_metrics"):
+                # TPU provider: surface degraded-mode circuit-breaker
+                # state/trips on this node's /metrics endpoint
+                csp.set_metrics(self.operations.csp_metrics())
         self.provider = LedgerProvider(
             root_dir,
             csp=csp,

@@ -10,8 +10,11 @@ one interface:
                      (the role the reference's in-test network shims play).
   TCPTransport     — length-prefixed StepRequest frames over localhost TCP
                      for real multi-process deployments; per-peer sender
-                     threads with bounded queues (drop-on-overflow, raft
-                     tolerates loss) and automatic reconnect.
+                     threads (OutboundConn) with bounded queues
+                     (drop-on-overflow — raft tolerates loss, but drops
+                     are LOUD: logged once per episode and counted as
+                     raft_send_dropped_total) and automatic reconnect
+                     under deterministic decorrelated-jitter backoff.
 
 TLS: pass a comm.tls.TLSCredentials with `pinned_certs` set to the
 consenter set's TLS leaf DERs — every link is then mutual TLS and BOTH
@@ -27,12 +30,18 @@ import queue
 import socket
 import struct
 import threading
+import time
 
+from fabric_tpu.comm.backoff import DecorrelatedBackoff
+from fabric_tpu.common.flogging import must_get_logger
+from fabric_tpu.devtools import faultline
 from fabric_tpu.devtools.lockwatch import spawn_thread
 
 from fabric_tpu.protos.orderer import raft_pb2 as rpb
 
 _LEN = struct.Struct(">I")
+
+_logger = must_get_logger("orderer.consensus.transport")
 
 
 class InProcTransport:
@@ -73,27 +82,70 @@ class InProcTransport:
             handler(req)
 
 
-class _PeerSender:
-    def __init__(self, addr: tuple[str, int], tls=None, ssl_ctx=None):
+class OutboundConn:
+    """Per-peer sender thread: bounded queue, automatic reconnect with
+    deterministic decorrelated-jitter backoff (a down peer is not
+    hammered at message rate, and chaos runs replay the exact dial
+    cadence), and LOUD overflow drops — queue-full discards used to be
+    fully silent, so a wedged link looked identical to a healthy quiet
+    one; now the first drop of each episode logs and every drop counts
+    toward ``raft_send_dropped_total``."""
+
+    def __init__(self, addr: tuple[str, int], tls=None, ssl_ctx=None,
+                 peer_id: int | None = None, metrics=None,
+                 queue_size: int = 4096, local_key: str = ""):
         self.addr = addr
         self._tls = tls
         self._ssl_ctx = ssl_ctx
-        self.q: queue.Queue = queue.Queue(maxsize=4096)
+        self.peer_id = peer_id
+        self._metrics = metrics
+        self.q: queue.Queue = queue.Queue(maxsize=queue_size)
         self._sock: socket.socket | None = None
         self._stop = threading.Event()
+        self.dropped = 0
+        self._drop_episode = False   # contiguous queue-full drops
+        self._down_episode = False   # contiguous link-down drops (_run)
+        # seeded from stable local+peer identity, never wall-clock:
+        # deterministic per process, decorrelated ACROSS the peers of a
+        # downed node (see DecorrelatedBackoff.for_key)
+        self._backoff = DecorrelatedBackoff.for_key(
+            f"{local_key}->{addr!r}"
+        )
+        self._dial_gate = 0.0  # monotonic time before which dials wait
         self._thread = spawn_thread(
             target=self._run, name="raft-dial", kind="service"
         )
         self._thread.start()
 
+    def _dest(self) -> str:
+        return str(self.peer_id) if self.peer_id is not None else repr(
+            self.addr
+        )
+
     def send(self, data: bytes) -> None:
         try:
             self.q.put_nowait(data)
+            self._drop_episode = False
         except queue.Full:
-            pass  # raft retransmits; dropping beats blocking consensus
+            # raft retransmits, so dropping beats blocking consensus —
+            # but never silently: log once per contiguous episode and
+            # count every drop
+            self.dropped += 1
+            if self._metrics is not None:
+                self._metrics.send_dropped.With("dest", self._dest()).add()
+            if not self._drop_episode:
+                self._drop_episode = True
+                _logger.warning(
+                    "raft outbound queue to node %s full; dropping "
+                    "messages (one log per episode; see "
+                    "raft_send_dropped_total)", self._dest(),
+                )
 
     def _connect(self) -> socket.socket | None:
+        if self._metrics is not None:
+            self._metrics.dials.With("dest", self._dest()).add()
         try:
+            faultline.point("raft.connect", peer=self.peer_id)
             s = socket.create_connection(self.addr, timeout=2.0)
             s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             if self._ssl_ctx is not None:
@@ -105,9 +157,24 @@ class _PeerSender:
                 ):
                     s.close()
                     return None  # counterparty not in the consenter set
-            return s
+            return faultline.io(s, "raft.conn")
         except OSError:
             return None
+
+    def _drop_down(self) -> None:
+        """One message discarded because the link is down (dial gate
+        open or connect failed) — same LOUD accounting as queue-full
+        drops: counted, on /metrics, logged once per episode."""
+        self.dropped += 1
+        if self._metrics is not None:
+            self._metrics.send_dropped.With("dest", self._dest()).add()
+        if not self._down_episode:
+            self._down_episode = True
+            _logger.warning(
+                "raft outbound link to node %s down; dropping queued "
+                "messages during reconnect backoff (one log per "
+                "episode; see raft_send_dropped_total)", self._dest(),
+            )
 
     def _run(self) -> None:
         while not self._stop.is_set():
@@ -116,17 +183,38 @@ class _PeerSender:
             except queue.Empty:
                 continue
             if self._sock is None:
+                now = time.monotonic()
+                if now < self._dial_gate:
+                    self._drop_down()  # backoff window open: peer down
+                    continue
                 self._sock = self._connect()
                 if self._sock is None:
-                    continue  # drop; peer down
+                    # arm the next dial window; messages arriving
+                    # before it drop fast instead of re-dialing
+                    self._dial_gate = now + self._backoff.next()
+                    self._drop_down()
+                    continue
+                self._dial_gate = 0.0
             try:
                 self._sock.sendall(_LEN.pack(len(data)) + data)
+                # only a COMPLETED send proves the link: resetting on
+                # connect alone would let an accept-then-reset peer
+                # restart the backoff sequence every flap
+                self._backoff.reset()
+                self._down_episode = False
             except OSError:
                 try:
                     self._sock.close()
                 except OSError:
                     pass
                 self._sock = None
+                # the dequeued message is lost — count it like every
+                # other drop, and arm the dial gate so an accept-then-
+                # reset peer is not redialed at message rate (connect
+                # success reset the backoff, but the link was NOT
+                # proven: only a completed send is)
+                self._drop_down()
+                self._dial_gate = time.monotonic() + self._backoff.next()
 
     def close(self) -> None:
         self._stop.set()
@@ -140,10 +228,12 @@ class _PeerSender:
 class TCPTransport:
     """One listener per ordering node; senders keyed by node id."""
 
-    def __init__(self, node_id: int, listen_addr: tuple[str, int], tls=None):
+    def __init__(self, node_id: int, listen_addr: tuple[str, int], tls=None,
+                 metrics=None):
         self.node_id = node_id
         self._handler = None
         self._tls = tls
+        self._metrics = metrics  # common.metrics.RaftMetrics | None
         self._server_ctx = tls.server_context() if tls is not None else None
         if tls is not None:
             self._client_ctx = tls.client_context()
@@ -155,7 +245,7 @@ class TCPTransport:
                 self._client_ctx.check_hostname = False
         else:
             self._client_ctx = None
-        self._peers: dict[int, _PeerSender] = {}
+        self._peers: dict[int, OutboundConn] = {}
         self._lock = threading.Lock()
         self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -178,8 +268,10 @@ class TCPTransport:
                 return
             if old is not None:
                 old.close()
-            self._peers[node_id] = _PeerSender(
-                tuple(addr), self._tls, self._client_ctx
+            self._peers[node_id] = OutboundConn(
+                tuple(addr), self._tls, self._client_ctx,
+                peer_id=node_id, metrics=self._metrics,
+                local_key=str(self.node_id),
             )
 
     def remove_peer(self, node_id: int) -> None:
@@ -265,4 +357,4 @@ class TCPTransport:
             self._peers.clear()
 
 
-__all__ = ["InProcTransport", "TCPTransport"]
+__all__ = ["InProcTransport", "OutboundConn", "TCPTransport"]

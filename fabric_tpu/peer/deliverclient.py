@@ -13,9 +13,11 @@ same client drives in-process orderers (tests) and socket transports.
 
 from __future__ import annotations
 
+import collections
 import random
 import threading
 
+from fabric_tpu.devtools import faultline
 from fabric_tpu.devtools.lockwatch import spawn_thread
 import time
 
@@ -44,6 +46,12 @@ class DeliverClient:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._lock = threading.Lock()
+        # recent backoff values actually waited, in order — the rotation
+        # loop's observable contract (tests assert the 0.1s reset after
+        # a delivered block and the max_backoff_s cap against this);
+        # bounded so a long-lived client against a flaky orderer never
+        # grows it without limit
+        self.backoff_log: collections.deque = collections.deque(maxlen=64)
 
     def start(self) -> None:
         with self._lock:
@@ -80,9 +88,13 @@ class DeliverClient:
             connect = endpoints[idx % len(endpoints)]
             idx += 1
             try:
+                faultline.point(
+                    "deliver.connect", endpoint=(idx - 1) % len(endpoints)
+                )
                 for blk in connect(self._height()):
                     if self._stop.is_set():
                         return
+                    faultline.point("deliver.read", block=blk.header.number)
                     if not self._verify(blk):
                         break  # bad orderer: switch endpoints
                     self._sink(blk.header.number, blk.SerializeToString())
@@ -90,7 +102,10 @@ class DeliverClient:
             except Exception:
                 # fabriclint: allow[exception-discipline] reconnect loop: ANY
                 # endpoint failure routes to backoff + the next endpoint
-                pass
+                # (the faultline seam is transparent to the rule; use
+                # action=delay rules here to count reconnects)
+                faultline.point("deliver.reconnect")
+            self.backoff_log.append(backoff)
             if self._stop.wait(backoff):
                 return
             backoff = min(backoff * 2, self._max_backoff)

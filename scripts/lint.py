@@ -44,6 +44,13 @@ def main() -> int:
         "--write-baseline", default=None, metavar="FILE",
         help="record current per-rule error counts and exit 0",
     )
+    ap.add_argument(
+        "--summaries-out", default=None, metavar="PATH",
+        help="write the dataflow engine's per-function summaries as "
+             "JSON lines next to the result line — a reviewable CI "
+             "artifact (what the interprocedural rules believed about "
+             "every function this run)",
+    )
     args = ap.parse_args()
 
     t0 = time.perf_counter()
@@ -59,6 +66,14 @@ def main() -> int:
             print(str(v), file=sys.stderr)
 
     summary = report.summary()
+    summaries_written = None
+    if args.summaries_out and report.project is not None:
+        with open(args.summaries_out, "w", encoding="utf-8") as f:
+            n = 0
+            for s in report.project.summaries():
+                f.write(json.dumps(s, sort_keys=True) + "\n")
+                n += 1
+        summaries_written = {"path": args.summaries_out, "functions": n}
     out = {
         "experiment": "fabriclint",
         "files": summary["files"],
@@ -70,6 +85,8 @@ def main() -> int:
         "clean": summary["clean"],
         "seconds": round(elapsed, 4),
     }
+    if summaries_written is not None:
+        out["summaries"] = summaries_written
     if args.write_baseline:
         with open(args.write_baseline, "w", encoding="utf-8") as f:
             json.dump(summary["by_rule"], f, indent=2, sort_keys=True)

@@ -99,3 +99,25 @@ def _threadwatch_drain_gate():
         "threadwatch violations recorded during the test session: "
         f"{lockwatch.thread_violations!r}"
     )
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _faultline_drain_gate():
+    """Fail the session if a fault plan is still armed or the trip
+    ledger was left undrained.  Chaos tests arm plans through
+    faultline.use_plan, which disarms and clears the ledger on exit —
+    a plan leaking past its test would silently inject faults into
+    every later test, and unexamined trips mean a test fired faults it
+    never asserted on (the same teeth as the threadwatch drain gate)."""
+    yield
+    from fabric_tpu.devtools import faultline
+
+    assert not faultline.active(), (
+        "a faultline plan is still armed at session end — a chaos test "
+        "leaked its plan (use faultline.use_plan)"
+    )
+    assert not faultline.trips(), (
+        "undrained faultline trips at session end: "
+        f"{faultline.trips()!r} — the test that injected them never "
+        "drained the ledger (use faultline.use_plan)"
+    )

@@ -1,0 +1,18 @@
+"""Clean twin: the same injection point next to a REAL structured
+outcome (a logged reason) is fine — the faultline seam neither fires
+the rule on its own nor blocks a genuinely handled failure."""
+
+import logging
+
+from fabric_tpu.devtools import faultline
+
+log = logging.getLogger("fixture")
+
+
+def drop_errors(fetch):
+    try:
+        return fetch()
+    except Exception:
+        faultline.point("fixture.fetch")
+        log.warning("fetch failed", exc_info=True)
+        return None

@@ -1,0 +1,14 @@
+"""Seeded violation: a faultline injection point inside a broad except
+handler does NOT launder the swallow — the seam call is transparent to
+exception-discipline, and the handler still hides the failure from
+every caller.  Expected: exception-discipline fires at the except."""
+
+from fabric_tpu.devtools import faultline
+
+
+def drop_errors(fetch):
+    try:
+        return fetch()
+    except Exception:
+        faultline.point("fixture.fetch")  # transparent to the rule
+        return None

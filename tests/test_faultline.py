@@ -1,0 +1,505 @@
+"""Faultline engine + comm-layer chaos tests (ISSUE 6 tentpole): the
+zero-overhead no-op contract when no plan is armed, deterministic
+triggers/replay, every action kind, the socket io() wrapper, and the
+injected comm failures the transports must survive — RPC partial
+reads, raft link flaps with backoff'd reconnects and LOUD queue-full
+drops, gossip dial backoff, and deliver-stream endpoint rotation."""
+
+import io
+import json
+import socket
+import struct
+import time
+
+import pytest
+
+from fabric_tpu.comm.backoff import DecorrelatedBackoff
+from fabric_tpu.comm.rpc import RPCClient, RPCError, RPCServer
+from fabric_tpu.common.metrics import PrometheusProvider, RaftMetrics
+from fabric_tpu.devtools import faultline
+from fabric_tpu.ledger import LedgerProvider
+from fabric_tpu.orderer.raft.transport import OutboundConn, TCPTransport
+from fabric_tpu.protos.common import common_pb2
+from fabric_tpu.protos.orderer import raft_pb2 as rpb
+
+from test_group_commit import _write_block
+
+
+# -- the no-op contract -------------------------------------------------------
+
+
+def test_unset_means_zero_plan_lookups_on_hot_commit_path(tmp_path):
+    """Acceptance: with no plan armed, every fault point on the commit
+    path is a no-op — not one plan lookup happens, io() returns the
+    socket unchanged, and nothing lands in the trip ledger."""
+    assert not faultline.active()
+    before = faultline.lookup_count()
+    provider = LedgerProvider(str(tmp_path))
+    ledger = provider.open("hot")
+    for n in range(4):
+        ledger.commit(_write_block(ledger, n, [("cc", f"k{n}", b"v")]))
+    provider.close()
+    assert faultline.lookup_count() == before
+    assert faultline.trips() == []
+    sock = object()
+    assert faultline.io(sock, "anything") is sock
+    buf = io.BytesIO()
+    faultline.write("anything", buf, b"ab", b"cd")
+    assert buf.getvalue() == b"abcd"
+
+
+# -- plan parsing & lifecycle -------------------------------------------------
+
+
+def test_plan_validation_errors():
+    for bad in (
+        "not json",
+        json.dumps([1, 2]),
+        {"faults": []},
+        {"faults": [{"action": "raise"}]},  # no point
+        {"faults": [{"point": "x", "action": "meteor"}]},
+        {"faults": [{"point": "x", "error": "NoSuchError"}]},
+        {"faults": [{"point": "x", "nth": 1, "every": 2}]},
+        {"faults": [{"point": "x", "cut": 1.5}]},
+        {"faults": [{"point": "x", "every": 0}]},
+        {"faults": [{"point": "x", "nth": 0}]},      # can never fire
+        {"faults": [{"point": "x", "nth": "three"}]},
+        {"faults": [{"point": "x", "prob": "0.5x"}]},
+        {"faults": [{"point": "x", "prob": 25}]},   # percent, not ratio
+        {"faults": [{"point": "x", "prob": -0.5}]},
+        {"faults": [{"point": "x", "delay_s": "zz"}]},
+        {"faults": [{"point": "x", "count": "many"}]},
+        {"faults": [{"point": "x", "count": 0}]},
+        {"seed": "x", "faults": [{"point": "x"}]},
+    ):
+        with pytest.raises(faultline.PlanError):
+            faultline.Plan(bad)
+
+
+def test_env_activation_inline_and_file(tmp_path, monkeypatch):
+    plan = {"faults": [{"point": "env.x", "action": "delay",
+                        "delay_s": 0.0}]}
+    monkeypatch.setattr(faultline, "_plan", None)
+    monkeypatch.setenv("FABRIC_TPU_FAULTLINE", json.dumps(plan))
+    faultline._init_from_env()
+    assert faultline.active()
+    faultline.deactivate()
+
+    path = tmp_path / "plan.json"
+    path.write_text(json.dumps(plan))
+    monkeypatch.setenv("FABRIC_TPU_FAULTLINE", f"@{path}")
+    faultline._init_from_env()
+    assert faultline.active()
+    faultline.deactivate()
+    faultline.reset_trips()
+
+
+def test_use_plan_drains_on_exit():
+    with faultline.use_plan({"faults": [
+        {"point": "p", "action": "delay", "delay_s": 0.0},
+    ]}):
+        faultline.point("p")
+        assert len(faultline.trips()) == 1
+    assert not faultline.active()
+    assert faultline.trips() == []
+
+
+# -- triggers & actions -------------------------------------------------------
+
+
+def test_nth_every_prob_triggers_deterministic():
+    plan = {"seed": 9, "faults": [
+        {"point": "a", "action": "delay", "delay_s": 0.0, "nth": 3},
+        {"point": "b", "action": "delay", "delay_s": 0.0, "every": 4,
+         "count": 100},
+        {"point": "c", "action": "delay", "delay_s": 0.0, "prob": 0.3,
+         "count": 100},
+    ]}
+
+    def run():
+        with faultline.use_plan(plan):
+            for _ in range(12):
+                faultline.point("a")
+                faultline.point("b")
+                faultline.point("c")
+            return faultline.trips()
+
+    t1, t2 = run(), run()
+    assert t1 == t2  # same plan + workload -> identical ledger
+    assert [t["hit"] for t in t1 if t["point"] == "a"] == [3]  # nth=3 once
+    assert [t["hit"] for t in t1 if t["point"] == "b"] == [4, 8, 12]
+    c_hits = [t["hit"] for t in t1 if t["point"] == "c"]
+    assert c_hits and len(c_hits) < 12  # fired some, not all
+
+
+def test_multiple_rules_on_one_point_all_count_hits():
+    """Every matching rule counts every hit — an earlier rule firing
+    must not make a later rule's nth trigger drift (first-fired wins
+    the trip, the rest keep counting)."""
+    with faultline.use_plan({"faults": [
+        {"point": "mr", "action": "delay", "delay_s": 0.0, "nth": 1},
+        {"point": "mr", "action": "raise", "error": "RuntimeError",
+         "message": "second rule", "nth": 2},
+    ]}):
+        faultline.point("mr")  # hit 1: rule 0 trips; rule 1 counts it
+        with pytest.raises(RuntimeError, match="second rule"):
+            faultline.point("mr")  # hit 2: rule 1's nth=2 fires
+        assert [(t["rule"], t["hit"]) for t in faultline.trips()] == [
+            (0, 1), (1, 2),
+        ]
+
+
+def test_ctx_matching_restricts_rule():
+    with faultline.use_plan({"faults": [
+        {"point": "s", "ctx": {"stage": "pvt"}, "action": "raise",
+         "error": "RuntimeError", "message": "only pvt"},
+    ]}):
+        faultline.point("s", stage="mvcc")
+        faultline.point("s", stage="state")
+        with pytest.raises(RuntimeError, match="only pvt"):
+            faultline.point("s", stage="pvt")
+        [trip] = faultline.trips()
+        assert trip["ctx"] == {"stage": "pvt"}
+
+
+def test_actions_raise_named_errors_and_delay():
+    with faultline.use_plan({"faults": [
+        {"point": "e1", "action": "raise", "error": "ECONNRESET"},
+        {"point": "e2", "action": "raise", "error": "DeviceUnavailable"},
+        {"point": "e3", "action": "crash"},
+        {"point": "e4", "action": "delay", "delay_s": 0.02, "count": 1},
+    ]}):
+        with pytest.raises(ConnectionResetError):
+            faultline.point("e1")
+        with pytest.raises(faultline.DeviceUnavailable):
+            faultline.point("e2")
+        with pytest.raises(faultline.FaultCrash):
+            faultline.point("e3")
+        t0 = time.perf_counter()
+        faultline.point("e4")
+        assert time.perf_counter() - t0 >= 0.015
+        faultline.point("e4")  # count exhausted: no delay, no trip
+        assert len(faultline.trips()) == 4
+    # FaultCrash must NOT be swallowed by broad except Exception
+    assert not issubclass(faultline.FaultCrash, Exception)
+
+
+def test_torn_write_prefix_then_crash():
+    buf = io.BytesIO()
+    with faultline.use_plan({"faults": [
+        {"point": "w", "action": "torn", "cut": 0.25},
+    ]}):
+        with pytest.raises(faultline.FaultCrash, match="torn write"):
+            faultline.write("w", buf, b"AAAA", b"BBBB")
+        assert buf.getvalue() == b"AA"  # strict prefix, 8 * 0.25
+
+
+def test_io_partial_read_then_reset():
+    a, b = socket.socketpair()
+    try:
+        with faultline.use_plan({"faults": [
+            {"point": "x.read", "action": "partial", "cut": 0.5,
+             "nth": 1},
+        ]}):
+            wrapped = faultline.io(a, "x")
+            assert isinstance(wrapped, faultline._FaultSocket)
+            b.sendall(b"0123456789")
+            got = wrapped.recv(10)
+            assert got == b"01234"  # truncated to half
+            with pytest.raises(ConnectionResetError):
+                wrapped.recv(10)  # the wrapper is dead now
+    finally:
+        a.close()
+        b.close()
+
+
+# -- deterministic decorrelated backoff ---------------------------------------
+
+
+def test_decorrelated_backoff_deterministic_capped_and_resets():
+    b1 = DecorrelatedBackoff(base=0.05, cap=1.0, seed=7)
+    b2 = DecorrelatedBackoff(base=0.05, cap=1.0, seed=7)
+    seq1 = [b1.next() for _ in range(40)]
+    seq2 = [b2.next() for _ in range(40)]
+    assert seq1 == seq2  # same seed -> same sequence
+    assert all(0.05 <= v <= 1.0 for v in seq1)
+    # decorrelated jitter may shrink between draws, but trends up:
+    # within 40 draws it must have visited well above the base
+    assert max(seq1) > 0.4
+    b1.reset()
+    assert [b1.next() for _ in range(40)] == seq1  # replay after reset
+    other = [DecorrelatedBackoff(0.05, 1.0, seed=8).next()
+             for _ in range(3)]
+    assert other != seq1[:3]  # different peers decorrelate
+    # the for_key scheme mixes LOCAL identity into the seed: two nodes
+    # dialing the SAME downed peer must not replay identical sequences
+    # (their dial windows would align into synchronized bursts)
+    a = DecorrelatedBackoff.for_key("node-a->peer:7050")
+    b = DecorrelatedBackoff.for_key("node-b->peer:7050")
+    assert [a.next() for _ in range(5)] != [b.next() for _ in range(5)]
+
+
+# -- rpc: injected read faults ------------------------------------------------
+
+
+def test_rpc_client_partial_read_surfaces_as_error():
+    srv = RPCServer()
+    srv.register("echo", lambda body, stream: b"E" * 64)
+    srv.start()
+    try:
+        cli = RPCClient(*srv.addr)
+        assert cli.call("echo") == b"E" * 64  # healthy first
+        with faultline.use_plan({"faults": [
+            {"point": "rpc.client.read", "action": "partial",
+             "cut": 0.5, "nth": 1},
+        ]}):
+            with pytest.raises((RPCError, OSError)):
+                cli.call("echo")
+            assert faultline.trips()
+        assert cli.call("echo") == b"E" * 64  # and recovers
+    finally:
+        srv.stop()
+
+
+def test_rpc_server_read_reset_drops_connection_cleanly():
+    srv = RPCServer()
+    srv.register("echo", lambda body, stream: body)
+    srv.start()
+    try:
+        with faultline.use_plan({"faults": [
+            {"point": "rpc.server.read", "action": "raise",
+             "error": "ECONNRESET", "nth": 1},
+        ]}):
+            cli = RPCClient(*srv.addr, timeout=2.0)
+            with pytest.raises((RPCError, OSError)):
+                cli.call("echo", b"x")
+            assert faultline.trips()
+        # the server loop survived the injected reset
+        assert RPCClient(*srv.addr).call("echo", b"ok") == b"ok"
+    finally:
+        srv.stop()
+
+
+# -- raft transport: flaps, drops, backoff ------------------------------------
+
+
+def _step(n: int) -> rpb.StepRequest:
+    return rpb.StepRequest(
+        channel="ch",
+        submit=rpb.SubmitRequest(channel="ch", envelope=b"m%d" % n),
+    )
+
+
+def test_raft_link_flap_reconnects_and_delivers(tmp_path):
+    t1 = TCPTransport(1, ("127.0.0.1", 0))
+    t2 = TCPTransport(2, ("127.0.0.1", 0))
+    got: list[bytes] = []
+    t2.set_handler(lambda req: got.append(req.submit.envelope))
+    t1.set_peer(2, t2.addr)
+    try:
+        with faultline.use_plan({"faults": [
+            {"point": "raft.conn.write", "action": "raise",
+             "error": "ECONNRESET", "nth": 3},
+        ]}):
+            # keep sending until delivery resumes through the
+            # reconnect: the reset-swallowed message AND messages
+            # falling into the armed backoff window are dropped (and
+            # counted), raft-tolerated losses both
+            deadline = time.monotonic() + 10
+            sent = 0
+            while time.monotonic() < deadline and len(got) < 9:
+                t1.send(1, 2, _step(sent))
+                sent += 1
+                time.sleep(0.05)
+            flapped = [t for t in faultline.trips()
+                       if t["point"] == "raft.conn.write"]
+            assert flapped  # the link really was reset mid-traffic
+        assert len(got) >= 9  # traffic flowed again after the flap
+        # the flap's losses were counted, not silent
+        with t1._lock:
+            conn = t1._peers[2]
+        assert conn.dropped >= 1
+    finally:
+        t1.close()
+        t2.close()
+
+
+def test_raft_send_drop_logs_once_per_episode_and_counts():
+    import logging
+
+    from fabric_tpu.common.flogging import must_get_logger
+
+    prov = PrometheusProvider()
+    metrics = RaftMetrics(prov)
+    # a port from an immediately-closed listener: nothing dials it, and
+    # the sender thread is stopped before the queue is filled
+    probe = socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    dead_addr = probe.getsockname()
+    probe.close()
+    conn = OutboundConn(dead_addr, peer_id=7, metrics=metrics,
+                        queue_size=1)
+    conn._stop.set()
+    conn._thread.join(timeout=3)
+    records: list[logging.LogRecord] = []
+
+    class Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record)
+
+    logger = must_get_logger("orderer.consensus.transport")
+    cap = Capture()
+    logger.addHandler(cap)
+    try:
+        conn.send(b"a")      # fills the queue
+        conn.send(b"b")      # drop 1: logs
+        conn.send(b"c")      # drop 2: same episode, silent
+        assert conn.dropped == 2
+        assert len(records) == 1
+        assert "raft_send_dropped_total" in records[0].getMessage()
+        # episode resets on a successful enqueue
+        conn.q.get_nowait()
+        conn.send(b"d")      # fits: episode over
+        conn.send(b"e")      # drop 3: NEW episode, logs again
+        assert conn.dropped == 3
+        assert len(records) == 2
+        exposed = prov.registry.expose()
+        assert 'raft_send_dropped_total{dest="7"} 3' in exposed
+    finally:
+        logger.removeHandler(cap)
+        conn.close()
+
+
+def test_raft_reconnect_backoff_gates_dials(monkeypatch):
+    """While a peer is down, dials happen per backoff window — not per
+    queued message."""
+    probe = socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    dead_addr = probe.getsockname()
+    probe.close()
+    with faultline.use_plan({"faults": [
+        # counting rule: one zero-delay trip per dial attempt
+        {"point": "raft.connect", "action": "delay", "delay_s": 0.0,
+         "count": 10000},
+    ]}):
+        conn = OutboundConn(dead_addr, peer_id=3)
+        try:
+            for n in range(50):
+                conn.send(b"m%d" % n)
+            time.sleep(1.0)
+            dials = len([t for t in faultline.trips()
+                         if t["point"] == "raft.connect"])
+            # 50 sends in ~1s against a dead peer: without the gate
+            # every message would dial; with backoff (base 50ms,
+            # growing) only a handful of windows fit
+            assert 1 <= dials < 15
+            # and the gate-window discards are NOT silent: every
+            # dropped message counts toward the loud-drop ledger
+            assert conn.dropped > 0
+        finally:
+            conn.close()
+
+
+# -- deliver client: rotation + backoff reset/cap (satellite) -----------------
+
+
+def _block(num: int) -> common_pb2.Block:
+    blk = common_pb2.Block()
+    blk.header.number = num
+    return blk
+
+
+def test_deliver_rotation_backoff_resets_and_caps():
+    """The shuffled-endpoint loop must grow its backoff while injected
+    stream failures persist (capped at max_backoff_s), rotate across
+    endpoints, and reset to 0.1s after a successfully delivered block
+    — driven by faultline-injected stream failures, no monkeypatching."""
+    from fabric_tpu.peer.deliverclient import DeliverClient
+
+    committed: list[int] = []
+    tried: list[str] = []
+
+    def endpoint(name: str):
+        def connect(start: int):
+            tried.append(name)
+            for n in range(start, 3):
+                yield _block(n)
+        return connect
+
+    dc = DeliverClient(
+        "ch",
+        [endpoint("a"), endpoint("b")],
+        height_fn=lambda: len(committed),
+        sink=lambda seq, raw: committed.append(seq),
+        max_backoff_s=0.25,
+    )
+    with faultline.use_plan({"faults": [
+        # the first four read attempts die: forces three backoff
+        # growth steps (0.1 -> 0.2 -> cap 0.25) across rotations
+        {"point": "deliver.read", "action": "raise", "error": "OSError",
+         "every": 1, "count": 4},
+        # zero-delay counting rule: one trip per reconnect episode
+        {"point": "deliver.reconnect", "action": "delay",
+         "delay_s": 0.0, "count": 10000},
+    ]}):
+        dc.start()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and len(committed) < 3:
+            time.sleep(0.02)
+        # let the loop take one more healthy lap so the post-success
+        # backoff value is recorded
+        time.sleep(0.3)
+        dc.stop()
+        reconnects = [t for t in faultline.trips()
+                      if t["point"] == "deliver.reconnect"]
+        assert len(reconnects) >= 4
+    assert committed == [0, 1, 2]
+    assert set(tried) == {"a", "b"}  # rotation really alternated
+    log = dc.backoff_log
+    assert log[0] == 0.1                      # starts at the floor
+    assert max(log) == 0.25                   # capped at max_backoff_s
+    assert 0.2 in log                         # and actually grew
+    # reset after the successful stream: a 0.1 entry right after a
+    # grown one (idle caught-up laps re-grow toward the cap afterwards,
+    # which is the loop's deliberate polling behavior)
+    assert any(
+        log[i] == 0.1 and log[i - 1] >= 0.2 for i in range(1, len(log))
+    )
+
+
+# -- gossip: dial backoff under injected failure ------------------------------
+
+def test_gossip_dial_fault_backs_off_and_recovers():
+    from fabric_tpu.gossip.comm import TCPGossipComm
+    from fabric_tpu.protos.gossip import message_pb2 as gpb
+
+    recv = TCPGossipComm(("127.0.0.1", 0), b"id-recv")
+    send = TCPGossipComm(("127.0.0.1", 0), b"id-send")
+    seen: list[bytes] = []
+    recv.subscribe(lambda rm: seen.append(rm.msg.alive_msg.membership.endpoint))
+    try:
+        msg = gpb.GossipMessage()
+        msg.alive_msg.membership.endpoint = "e0"
+        with faultline.use_plan({"faults": [
+            {"point": "gossip.dial", "action": "raise",
+             "error": "ConnectionRefusedError", "nth": 1},
+        ]}):
+            send.send(recv.endpoint, msg)  # first dial dies
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline and not faultline.trips():
+                time.sleep(0.02)
+            assert faultline.trips()
+        # subsequent messages get through once the fault clears (the
+        # first one may have been consumed by the failed dial attempt)
+        deadline = time.monotonic() + 10
+        n = 1
+        while time.monotonic() < deadline and not seen:
+            m = gpb.GossipMessage()
+            m.alive_msg.membership.endpoint = "e%d" % n
+            send.send(recv.endpoint, m)
+            n += 1
+            time.sleep(0.05)
+        assert seen
+    finally:
+        send.close()
+        recv.close()

@@ -597,3 +597,28 @@ def test_rejected_backend_is_not_installed_as_default():
     with pytest.raises(ValueError, match="byte-identical"):
         factory._install_default(Sha1CSP())
     assert factory._default is before
+
+
+def test_ci_wrapper_summaries_out_writes_artifact(tmp_path):
+    """scripts/lint.py --summaries-out PATH (ISSUE 6 satellite): the
+    per-function dataflow summaries land as a JSON-lines artifact next
+    to the bench-style result line."""
+    import os
+
+    from fabric_tpu.devtools.lint import repo_root
+
+    root = repo_root()
+    out_path = tmp_path / "summaries.jsonl"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(root, "scripts", "lint.py"),
+         "--summaries-out", str(out_path)],
+        capture_output=True, text=True, cwd=root,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    result = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert result["experiment"] == "fabriclint"
+    assert result["summaries"]["path"] == str(out_path)
+    lines = out_path.read_text().strip().splitlines()
+    assert len(lines) == result["summaries"]["functions"] > 100
+    sample = json.loads(lines[0])
+    assert "function" in sample and "file" in sample

@@ -230,3 +230,45 @@ def test_module_dotted_mapping():
     assert dataflow._module_dotted("fabric_tpu/csp/__init__.py") == (
         "fabric_tpu.csp"
     )
+
+
+# -- exception-discipline: the faultline seam is transparent -----------------
+
+
+def test_faultline_point_does_not_launder_swallow():
+    """A handler whose only non-trivial statement is a faultline seam
+    call still SWALLOWS: the injection point is not a structured
+    sentinel, so the violation fires exactly as without it."""
+    src = _load("fix_faultline_dirty.py")
+    vs = lint_source(src, "fabric_tpu/peer/fix_faultline_dirty.py")
+    lines = _fires(vs, "exception-discipline")
+    assert len(lines) == 1
+    assert "except Exception" in src.splitlines()[lines[0] - 1]
+
+
+def test_faultline_clean_twin_stays_quiet():
+    """...and next to a real structured outcome (logged reason) the
+    seam call creates no violation of its own."""
+    src = _load("fix_faultline_clean.py")
+    vs = lint_source(src, "fabric_tpu/peer/fix_faultline_clean.py")
+    assert _fires(vs, "exception-discipline") == []
+
+
+def test_faultline_seam_keeps_reviewed_pragmas_used():
+    """Threading an injection point into an already-pragma'd swallow
+    (the deliverclient reconnect loop shape) must keep the pragma USED
+    — transparency means the handler still counts as swallowing."""
+    src = (
+        "from fabric_tpu.devtools import faultline\n"
+        "def run(step):\n"
+        "    try:\n"
+        "        step()\n"
+        "    except Exception:\n"
+        "        # fabriclint: allow[exception-discipline] reconnect loop\n"
+        "        faultline.point('loop.reconnect')\n"
+    )
+    vs = lint_source(src, "fabric_tpu/peer/fix_inline.py")
+    assert [v for v in vs if not v.suppressed] == []
+    assert any(
+        v.rule == "exception-discipline" and v.suppressed for v in vs
+    )

@@ -610,3 +610,59 @@ def test_double_start_does_not_evict_live_registry_entry(_threadwatch):
     gate.set()
     t.join(5)
     assert not lockwatch.thread_violations
+
+
+# -- threadwatch: concurrent.futures executors (ISSUE 6 satellite) -----------
+
+
+def test_tracked_executor_workers_visible_to_drain_gate(_threadwatch):
+    gate = threading.Event()
+    release = threading.Event()
+    ex = lockwatch.tracked_executor(2, name="tw-pool")
+    try:
+        fut = ex.submit(lambda: (gate.set(), release.wait(5)))
+        assert gate.wait(5)
+        # the pool worker registered itself through the initializer
+        names = [
+            i["name"] for i in lockwatch.threads_alive(kinds=("worker",))
+        ]
+        assert any(n.startswith("tw-pool") for n in names)
+        release.set()
+        assert fut.result(timeout=5)[1] is True
+    finally:
+        release.set()
+        ex.shutdown(wait=True)
+    # after shutdown the workers are dead; the registry prunes on read
+    assert not any(
+        i["name"].startswith("tw-pool")
+        for i in lockwatch.threads_alive(kinds=("worker",))
+    )
+
+
+def test_tracked_executor_chains_caller_initializer(_threadwatch):
+    seen = []
+    ex = lockwatch.tracked_executor(
+        1, name="tw-init", initializer=seen.append, initargs=("hello",)
+    )
+    try:
+        assert ex.submit(lambda: 42).result(timeout=5) == 42
+        assert seen == ["hello"]
+    finally:
+        ex.shutdown(wait=True)
+
+
+def test_tracked_executor_rejects_unknown_kind(_threadwatch):
+    with pytest.raises(ValueError, match="unknown thread kind"):
+        lockwatch.tracked_executor(1, kind="demon")
+
+
+def test_tracked_executor_plain_without_threadwatch(monkeypatch):
+    from concurrent.futures import ThreadPoolExecutor
+
+    monkeypatch.setenv("FABRIC_TPU_THREADWATCH", "0")
+    ex = lockwatch.tracked_executor(1, name="tw-off")
+    try:
+        assert type(ex) is ThreadPoolExecutor
+        assert ex.submit(lambda: 1).result(timeout=5) == 1
+    finally:
+        ex.shutdown(wait=True)
