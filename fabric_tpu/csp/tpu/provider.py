@@ -30,7 +30,7 @@ import numpy as np
 from fabric_tpu.common.flogging import must_get_logger
 from fabric_tpu.csp import api
 from fabric_tpu.devtools import faultline
-from fabric_tpu.devtools.lockwatch import spawn_thread
+from fabric_tpu.devtools.lockwatch import guarded, named_rlock, spawn_thread
 
 _logger = must_get_logger("csp.tpu")
 from fabric_tpu.csp.api import (
@@ -624,7 +624,10 @@ class TPUCSP(CSP):
         self._host_rate = host_rate_hint
         self._lane_wall_ewma: float | None = None  # s/lane, device flushes
         self._ewma_lock = threading.Lock()
-        self._pend_lock = threading.RLock()
+        # the coalescing lane state behind this lock is racecheck's
+        # declared-guard territory (devtools/guards.py): created through
+        # the lockwatch seam so tier-1 cross-checks the guard at runtime
+        self._pend_lock = named_rlock("csp.tpu.pend")
         self._pend_batches: list = []  # list[Sequence[VerifyBatchItem]]
         self._pend_lanes = 0
         self._flushed: dict[int, object] = {}  # gen -> _FlushResult
@@ -845,6 +848,7 @@ class TPUCSP(CSP):
     def _flush_locked(self) -> None:
         """Dispatch every pending batch as one chunked device call and
         advance the generation.  Caller holds _pend_lock."""
+        guarded(self, "_pend_batches", by="csp.tpu.pend")
         items: list = []
         for b in self._pend_batches:
             items.extend(b)

@@ -55,6 +55,34 @@ csp-seam (alias half)
     (callers of hashlib-using helpers) is emitted by lint.py's checker
     using ``call_resolutions`` + the summaries here.
 
+racecheck (v3)
+    whole-program lockset inference + shared-state race detection.  A
+    CLASS REGISTRY records, per class, which ``self.<attr>`` members
+    are locks (``named_lock/named_rlock/named_condition`` roles, or a
+    ``<Class>.<attr>`` pseudo-role for plain ``threading.Lock()``
+    members) and which carry a statically known class type (annotated
+    params/fields, direct constructor assignments) — the latter powers
+    TYPE-INFORMED CALL RESOLUTION, so ``ledger.commit(...)`` on a
+    ``ledger: KVLedger`` parameter lands in the call graph instead of
+    falling off it.  A LOCKSET PASS then records, for every
+    ``self._x`` (and declared module-global) read or write, the set of
+    lock roles lexically held at that point, plus the lockset held at
+    every resolvable call site; an interprocedural meet (set
+    intersection over all incoming call paths) extends those locksets
+    across function boundaries.  Fields acquire a GUARDED-BY role from
+    the reviewed declaration table (``devtools/guards.py``) or, for
+    undeclared mutable fields, by majority inference across their
+    access sites.  Any access on a path from a THREAD ENTRY POINT
+    (``lockwatch.spawn_thread``/``spawn_timer`` targets,
+    ``threading.Thread``/``Timer`` ctors, ``executor.submit``, RPC/
+    gossip ``.register``/``.subscribe`` handlers) whose lockset misses
+    the field's guard is emitted as a racecheck flow.  ``__init__``
+    bodies are excluded (the object is unpublished), a with-context
+    that looks like a lock but cannot be resolved contributes an
+    UNKNOWN token that suppresses rather than fabricates findings, and
+    fields never written outside ``__init__`` are immune — three
+    precision rules that keep the rule deployable at error severity.
+
 The engine is deliberately static and approximate: only statically
 resolvable names participate in the call graph, attribute calls on
 foreign objects fall back to the per-name heuristics, and taint is
@@ -96,6 +124,47 @@ _HASH_ATTRS = frozenset({"hash", "hash_batch", "digest", "hexdigest"})
 
 _WALL = "wall"
 _MAX_ROUNDS = 12
+
+# -- racecheck vocabulary ----------------------------------------------------
+
+# lock constructors recognized on `self.<attr> = ...` / module globals;
+# named_* carry an explicit lockwatch role, plain threading primitives
+# get a `<owner>.<attr>` pseudo-role so their guarded fields still
+# participate in lockset inference
+_NAMED_LOCK_FNS = frozenset({
+    "fabric_tpu.devtools.lockwatch.named_lock",
+    "fabric_tpu.devtools.lockwatch.named_rlock",
+    "fabric_tpu.devtools.lockwatch.named_condition",
+})
+_PLAIN_LOCK_FNS = frozenset({
+    "threading.Lock",
+    "threading.RLock",
+    "threading.Condition",
+})
+
+_SPAWN_THREAD_FNS = frozenset({
+    "fabric_tpu.devtools.lockwatch.spawn_thread",
+    "threading.Thread",
+})
+_SPAWN_TIMER_FNS = frozenset({
+    "fabric_tpu.devtools.lockwatch.spawn_timer",
+    "threading.Timer",
+})
+# attribute calls whose function-valued arguments run on foreign
+# threads: executor submissions and RPC/gossip handler registration
+_SUBMIT_ATTRS = frozenset({"submit"})
+_HANDLER_REG_ATTRS = frozenset({"register", "subscribe"})
+
+# a with-context that names a lock we cannot resolve to a role: it MAY
+# be the guard, so accesses under it are never flagged and never feed
+# majority inference
+_UNKNOWN_LOCK = "?"
+
+# gossip payload digests are consensus-adjacent bytes: peers compare /
+# request private data by these digests, so a wall-clock-derived value
+# entering one forks the gossip view exactly like a forked block header.
+# Sink = the seam hash functions when called from gossip modules.
+_GOSSIP_SINK_SCOPE = "fabric_tpu/gossip/"
 
 
 def _in_seam(rel: str) -> bool:
@@ -145,6 +214,10 @@ class FunctionInfo:
     returns_wallclock: bool = False
     param_to_return: set = dataclasses.field(default_factory=set)
     param_to_sink: set = dataclasses.field(default_factory=set)
+    # racecheck facts: (field qname, "read"|"write", line, frozenset of
+    # lock roles lexically held) and (callee qname, frozenset held)
+    accesses: list = dataclasses.field(default_factory=list)
+    call_locks: list = dataclasses.field(default_factory=list)
 
     def summary(self) -> dict:
         """JSON-shaped summary (CLI ``--summaries``, tests)."""
@@ -159,7 +232,23 @@ class FunctionInfo:
             "spawns_thread": self.spawns_thread,
             "acquires_locks": sorted(self.acquires_locks),
             "param_to_sink": sorted(self.param_to_sink),
+            "accesses": len(self.accesses),
         }
+
+
+@dataclasses.dataclass
+class ClassInfo:
+    """Per-class registry entry for racecheck + typed call resolution."""
+
+    rel: str
+    qname: str
+    name: str
+    # attr -> lock role (lockwatch role string, or qname pseudo-role)
+    lock_roles: dict = dataclasses.field(default_factory=dict)
+    # attr -> class qname (annotated params/fields, ctor assignments)
+    field_types: dict = dataclasses.field(default_factory=dict)
+    # every attr assigned through `self.` anywhere in the class
+    fields: set = dataclasses.field(default_factory=set)
 
 
 @dataclasses.dataclass
@@ -191,7 +280,13 @@ class Project:
     surface would grow instead of shrink."""
 
     def __init__(self, trees: dict[str, ast.Module],
-                 sanctioned_sources: dict[str, set] | None = None):
+                 sanctioned_sources: dict[str, set] | None = None,
+                 declared_guards: dict[str, str] | None = None):
+        if declared_guards is None:
+            from fabric_tpu.devtools.guards import DECLARED_GUARDS
+
+            declared_guards = DECLARED_GUARDS
+        self.declared_guards = dict(declared_guards)
         self.sanctioned_sources = sanctioned_sources or {}
         # (rel, line) of sanctioned sources the engine actually hit —
         # lint.py counts their pragmas as used (the pragma's job was to
@@ -204,13 +299,25 @@ class Project:
         # csp-seam alias violations found during the facts pass
         self.alias_violations: list[TaintFlow] = []
         self.taint_flows: list[TaintFlow] = []
+        # racecheck emissions + the inferred guarded-by map behind them
+        self.race_flows: list[TaintFlow] = []
+        self.guard_map: dict[str, dict] = {}
+        # class registry (racecheck + typed call resolution)
+        self.classes: dict[str, ClassInfo] = {}
+        self.module_lock_roles: dict[str, str] = {}  # dotted name -> role
+        self._attr_role_unique: dict[str, str | None] = {}
+        # fn qname -> how it becomes a thread entry (for messages)
+        self.thread_entries: dict[str, str] = {}
         # ClassDef qname -> names of self attributes holding wall-clock
         self._class_taint: dict[str, set] = {}
         for rel, tree in sorted(trees.items()):
             self._load_module(rel, tree)
+        self._collect_classes()
         self._collect_facts()
         self._fixpoint_booleans()
         self._fixpoint_taint()
+        self._lockset_pass_all()
+        self._racecheck()
 
     # -- module loading ----------------------------------------------------
 
@@ -265,17 +372,32 @@ class Project:
     # -- name resolution ---------------------------------------------------
 
     def _resolve_expr(self, mod: ModuleInfo, expr, cls: str | None,
-                      local: dict) -> str | None:
+                      local: dict, types: dict | None = None) -> str | None:
         """Resolve a Name/Attribute chain to a dotted target through
-        local bindings and module imports.  ``self.x`` resolves into the
-        enclosing class.  Returns e.g. "hashlib.sha256", "time.time",
-        "fabric_tpu.protoutil.common.make_channel_header"."""
+        local bindings, module imports, and (when `types` maps names to
+        class qnames) annotated-parameter/field types.  ``self.x``
+        resolves into the enclosing class; ``self.f.m`` and ``p.m``
+        resolve through the class registry when ``f``/``p`` have a
+        statically known class.  Returns e.g. "hashlib.sha256",
+        "time.time", "fabric_tpu.ledger.kvledger.KVLedger.commit"."""
         dotted = _dotted(expr)
         if dotted is None:
             return None
         head, _, rest = dotted.partition(".")
         if head == "self" and cls is not None:
-            return f"{mod.dotted}.{cls}.{rest}" if rest else None
+            if not rest:
+                return None
+            first, _, tail = rest.partition(".")
+            if tail:
+                # typed self-field chain: self._ledger.commit resolves
+                # through the field's declared/constructed class
+                ci = self.classes.get(f"{mod.dotted}.{cls}")
+                ft = ci.field_types.get(first) if ci else None
+                if ft is not None:
+                    return f"{ft}.{tail}"
+            return f"{mod.dotted}.{cls}.{rest}"
+        if types and rest and head in types:
+            return f"{types[head]}.{rest}"
         target = local.get(head) or mod.imports.get(head)
         if target is None:
             # same-module symbol?
@@ -284,6 +406,149 @@ class Project:
                 return cand
             return None
         return f"{target}.{rest}" if rest else target
+
+    # -- class registry (racecheck + typed resolution) ---------------------
+
+    def _annotation_class(self, mod: ModuleInfo, ann) -> str | None:
+        """The class qname an annotation statically names, or None.
+        Handles Name/Attribute, string annotations, ``X | None`` unions
+        and ``Optional[X]`` — anything fancier is out of model."""
+        if ann is None:
+            return None
+        if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+            try:
+                ann = ast.parse(ann.value, mode="eval").body
+            except SyntaxError:
+                return None
+        if isinstance(ann, ast.BinOp) and isinstance(ann.op, ast.BitOr):
+            return (self._annotation_class(mod, ann.left)
+                    or self._annotation_class(mod, ann.right))
+        if isinstance(ann, ast.Subscript):
+            base = _dotted(ann.value)
+            if base is not None and base.rsplit(".", 1)[-1] == "Optional":
+                return self._annotation_class(mod, ann.slice)
+            return None
+        if not isinstance(ann, (ast.Name, ast.Attribute)):
+            return None
+        target = self._resolve_expr(mod, ann, None, {})
+        if target in self.classes:
+            return target
+        return None
+
+    @staticmethod
+    def _role_from_ctor(target: str | None, call: ast.Call,
+                        pseudo: str) -> str | None:
+        """Lock role for a `<member> = <lock ctor>(...)` assignment:
+        the named_* role string when constant, else the member's own
+        qname as a pseudo-role (plain threading primitives included)."""
+        if target in _NAMED_LOCK_FNS:
+            if (
+                call.args
+                and isinstance(call.args[0], ast.Constant)
+                and isinstance(call.args[0].value, str)
+            ):
+                return call.args[0].value
+            return pseudo
+        if target in _PLAIN_LOCK_FNS:
+            return pseudo
+        return None
+
+    def _collect_classes(self) -> None:
+        # phase 1: every class must exist before any annotation can
+        # resolve to it (cross-module field types)
+        for mod in self.modules.values():
+            for stmt in mod.tree.body:
+                if isinstance(stmt, ast.ClassDef):
+                    q = f"{mod.dotted}.{stmt.name}"
+                    self.classes[q] = ClassInfo(
+                        rel=mod.rel, qname=q, name=stmt.name
+                    )
+                elif (
+                    isinstance(stmt, ast.Assign)
+                    and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)
+                    and isinstance(stmt.value, ast.Call)
+                ):
+                    # module-level locks guard module-level state
+                    name = stmt.targets[0].id
+                    target = self._resolve_expr(mod, stmt.value.func, None, {})
+                    role = self._role_from_ctor(
+                        target, stmt.value, f"{mod.dotted}.{name}"
+                    )
+                    if role is not None:
+                        self.module_lock_roles[f"{mod.dotted}.{name}"] = role
+        # phase 2: member scan (locks, field types, assigned attrs)
+        for mod in self.modules.values():
+            for stmt in mod.tree.body:
+                if not isinstance(stmt, ast.ClassDef):
+                    continue
+                ci = self.classes[f"{mod.dotted}.{stmt.name}"]
+                for fnnode in stmt.body:
+                    if not isinstance(
+                        fnnode, (ast.FunctionDef, ast.AsyncFunctionDef)
+                    ):
+                        continue
+                    a = fnnode.args
+                    ann_params = {
+                        p.arg: p.annotation
+                        for p in a.posonlyargs + a.args + a.kwonlyargs
+                        if p.annotation is not None
+                    }
+                    for node in ast.walk(fnnode):
+                        if (
+                            isinstance(node, ast.Assign)
+                            and len(node.targets) == 1
+                            and isinstance(node.targets[0], ast.Attribute)
+                            and isinstance(node.targets[0].value, ast.Name)
+                            and node.targets[0].value.id == "self"
+                        ):
+                            attr = node.targets[0].attr
+                            ci.fields.add(attr)
+                            v = node.value
+                            if isinstance(v, ast.Call):
+                                target = self._resolve_expr(
+                                    mod, v.func, stmt.name, {}
+                                )
+                                role = self._role_from_ctor(
+                                    target, v, f"{ci.qname}.{attr}"
+                                )
+                                if role is not None:
+                                    ci.lock_roles[attr] = role
+                                elif target in self.classes:
+                                    ci.field_types.setdefault(attr, target)
+                            elif (
+                                isinstance(v, ast.Name)
+                                and v.id in ann_params
+                            ):
+                                tq = self._annotation_class(
+                                    mod, ann_params[v.id]
+                                )
+                                if tq is not None:
+                                    ci.field_types.setdefault(attr, tq)
+                        elif (
+                            isinstance(node, (ast.AnnAssign, ast.AugAssign))
+                            and isinstance(node.target, ast.Attribute)
+                            and isinstance(node.target.value, ast.Name)
+                            and node.target.value.id == "self"
+                        ):
+                            ci.fields.add(node.target.attr)
+                            if isinstance(node, ast.AnnAssign):
+                                tq = self._annotation_class(
+                                    mod, node.annotation
+                                )
+                                if tq is not None:
+                                    ci.field_types[node.target.attr] = tq
+        # attr name -> role when ONE role owns that spelling across the
+        # whole program: lets `with self._ledger.commit_lock:` resolve
+        # even where the field's type is unannotated
+        unique: dict[str, str | None] = {}
+        for ci in self.classes.values():
+            for attr, role in ci.lock_roles.items():
+                if attr in unique and unique[attr] != role:
+                    unique[attr] = None
+                else:
+                    unique[attr] = role
+        self._attr_role_unique = unique
 
     # -- facts pass --------------------------------------------------------
 
@@ -295,6 +560,15 @@ class Project:
     def _facts_for(self, mod: ModuleInfo, fn: FunctionInfo) -> None:
         local: dict[str, str] = {}
         seam = _in_seam(mod.rel)
+        # annotated params with statically known classes: the type env
+        # behind type-informed call resolution
+        a = fn.node.args
+        types: dict[str, str] = {}
+        for p in a.posonlyargs + a.args + a.kwonlyargs:
+            tq = self._annotation_class(mod, p.annotation)
+            if tq is not None:
+                types[p.arg] = tq
+        fn._types = types
         for node in ast.walk(fn.node):
             if isinstance(node, ast.Assign) and len(node.targets) == 1 \
                     and isinstance(node.targets[0], ast.Name):
@@ -314,7 +588,9 @@ class Project:
                                     "common.hashing or the CSP)",
                         ))
             elif isinstance(node, ast.Call):
-                target = self._resolve_expr(mod, node.func, fn.cls, local)
+                target = self._resolve_expr(
+                    mod, node.func, fn.cls, local, types
+                )
                 if target is not None:
                     if target in self.symbols:
                         fn.calls.append(target)
@@ -467,6 +743,15 @@ class Project:
             tail = target.rsplit(".", 1)[-1]
             kind = "proto-ctor" if tail[:1].isupper() else "protoutil"
             return (kind, target)
+        # gossip payload digests: peers dedupe/pull/verify by these
+        # bytes, so a wall-clock-derived input forks the gossip view
+        if (
+            mod.rel.startswith(_GOSSIP_SINK_SCOPE)
+            and target is not None
+            and (target in _SEAM_HASH_FNS
+                 or target.startswith("hashlib."))
+        ):
+            return ("gossip-digest", target)
         return None
 
     def _taint_pass(self, mod: ModuleInfo, fn: FunctionInfo,
@@ -705,6 +990,376 @@ class Project:
         walk(fn.node.body)
         return changed[0]
 
+    # -- racecheck: lockset-at-access + guarded-by inference ---------------
+
+    def _role_of_ctx(self, mod: ModuleInfo, ctx, ci: ClassInfo | None,
+                     types: dict) -> str | None:
+        """Lock role of a with-context expression.  None = not a lock;
+        _UNKNOWN_LOCK = lock-shaped but unresolvable (suppresses rather
+        than fabricates racecheck findings)."""
+        dotted = _dotted(ctx)
+        if dotted is None:
+            return None
+        parts = dotted.split(".")
+        attr = parts[-1]
+        lockish = (
+            "lock" in attr.lower()
+            or "cond" in attr.lower()
+            or attr in ("_idle",)
+        )
+        if len(parts) == 1:
+            role = self.module_lock_roles.get(f"{mod.dotted}.{attr}")
+            if role is not None:
+                return role
+            return _UNKNOWN_LOCK if lockish else None
+        head = parts[0]
+        owner: ClassInfo | None = None
+        if head == "self" and ci is not None:
+            if len(parts) == 2:
+                owner = ci
+            elif len(parts) == 3:
+                ft = ci.field_types.get(parts[1])
+                owner = self.classes.get(ft) if ft else None
+        elif head in types and len(parts) == 2:
+            owner = self.classes.get(types[head])
+        if owner is not None:
+            role = owner.lock_roles.get(attr)
+            if role is not None:
+                return role
+        if lockish:
+            return self._attr_role_unique.get(attr) or _UNKNOWN_LOCK
+        return None
+
+    def _lockset_pass_all(self) -> None:
+        for mod in self.modules.values():
+            for fn in mod.functions:
+                # __init__ still registers spawn targets and call
+                # edges, but its accesses are pre-publication: the
+                # object is not shared yet, so they neither need
+                # guards nor vote in majority inference
+                self._lockset_pass(
+                    mod, fn, record_accesses=fn.name != "__init__"
+                )
+
+    def _lockset_pass(self, mod: ModuleInfo, fn: FunctionInfo,
+                      record_accesses: bool = True) -> None:
+        ci = self.classes.get(f"{mod.dotted}.{fn.cls}") if fn.cls else None
+        types = getattr(fn, "_types", {})
+        local = getattr(fn, "_local_bindings", {})
+        held: list[str] = []
+        seen_access: set = set()
+
+        def note_field(owner: ClassInfo | None, attr: str, kind: str,
+                       line: int) -> None:
+            if owner is None or attr in owner.lock_roles:
+                return
+            if attr not in owner.fields:
+                return  # inherited/foreign attr: out of model
+            q = f"{owner.qname}.{attr}"
+            if q in self.symbols:
+                return  # a method, not state
+            key = (q, kind, line)
+            if key in seen_access:
+                return
+            seen_access.add(key)
+            fn.accesses.append((q, kind, line, frozenset(held)))
+
+        def note_attr(node: ast.Attribute, kind: str) -> None:
+            base = node.value
+            if isinstance(base, ast.Name):
+                if base.id == "self":
+                    note_field(ci, node.attr, kind, node.lineno)
+                elif base.id in types:
+                    note_field(
+                        self.classes.get(types[base.id]), node.attr,
+                        kind, node.lineno,
+                    )
+            elif (
+                isinstance(base, ast.Attribute)
+                and isinstance(base.value, ast.Name)
+                and base.value.id == "self"
+                and ci is not None
+            ):
+                ft = ci.field_types.get(base.attr)
+                if ft is not None:
+                    note_field(
+                        self.classes.get(ft), node.attr, kind, node.lineno
+                    )
+
+        def note_global(node: ast.Name, kind: str) -> None:
+            q = f"{mod.dotted}.{node.id}"
+            if q not in self.declared_guards:
+                return
+            key = (q, kind, node.lineno)
+            if key in seen_access:
+                return
+            seen_access.add(key)
+            fn.accesses.append((q, kind, node.lineno, frozenset(held)))
+
+        def entry(reason: str, expr) -> None:
+            q = self._resolve_expr(mod, expr, fn.cls, local, types)
+            if q is not None and q in self.symbols:
+                self.thread_entries.setdefault(q, reason)
+
+        def handle_call(node: ast.Call) -> None:
+            q = self.call_resolutions.get(
+                (mod.rel, node.lineno, node.col_offset)
+            )
+            if q is not None:
+                fn.call_locks.append((q, frozenset(held)))
+            target = self._resolve_expr(mod, node.func, fn.cls, local, types)
+            if target in _SPAWN_THREAD_FNS:
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        entry("thread target", kw.value)
+                # lockwatch.spawn_thread(target, ...) takes the target
+                # as its first positional (threading.Thread's is
+                # `group` — keyword-only there in practice)
+                if target != "threading.Thread" and node.args:
+                    entry("thread target", node.args[0])
+            elif target in _SPAWN_TIMER_FNS:
+                for kw in node.keywords:
+                    if kw.arg == "function":
+                        entry("timer callback", kw.value)
+                if len(node.args) >= 2:
+                    entry("timer callback", node.args[1])
+            elif isinstance(node.func, ast.Attribute):
+                if node.func.attr in _SUBMIT_ATTRS and node.args:
+                    entry("executor submission", node.args[0])
+                elif node.func.attr in _HANDLER_REG_ATTRS:
+                    for arg in node.args:
+                        if isinstance(arg, (ast.Attribute, ast.Name)):
+                            entry(f".{node.func.attr}() handler", arg)
+
+        def scan_expr(expr) -> None:
+            if expr is None:
+                return
+            for node in ast.walk(expr):
+                if isinstance(node, ast.Call):
+                    handle_call(node)
+                elif isinstance(node, ast.Attribute) and isinstance(
+                    node.ctx, ast.Load
+                ):
+                    if record_accesses:
+                        note_attr(node, "read")
+                elif isinstance(node, ast.Name) and isinstance(
+                    node.ctx, ast.Load
+                ):
+                    if record_accesses:
+                        note_global(node, "read")
+
+        def note_target(t) -> None:
+            if isinstance(t, ast.Attribute):
+                if record_accesses:
+                    note_attr(t, "write")
+                scan_expr(t.value)
+            elif isinstance(t, ast.Subscript):
+                v = t.value
+                if isinstance(v, ast.Attribute):
+                    # mutating a container field IS writing the field
+                    if record_accesses:
+                        note_attr(v, "write")
+                    scan_expr(v.value)
+                elif isinstance(v, ast.Name):
+                    if record_accesses:
+                        note_global(v, "write")
+                else:
+                    scan_expr(v)
+                scan_expr(t.slice)
+            elif isinstance(t, (ast.Tuple, ast.List)):
+                for e in t.elts:
+                    note_target(e)
+            elif isinstance(t, ast.Starred):
+                note_target(t.value)
+            elif isinstance(t, ast.Name):
+                if record_accesses:
+                    note_global(t, "write")
+
+        def walk(stmts) -> None:
+            for stmt in stmts:
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.ClassDef)):
+                    continue
+                if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                    pushed = 0
+                    for item in stmt.items:
+                        scan_expr(item.context_expr)
+                        if item.optional_vars is not None:
+                            note_target(item.optional_vars)
+                        role = self._role_of_ctx(
+                            mod, item.context_expr, ci, types
+                        )
+                        if role is not None:
+                            held.append(role)
+                            pushed += 1
+                    walk(stmt.body)
+                    for _ in range(pushed):
+                        held.pop()
+                elif isinstance(stmt, ast.Assign):
+                    scan_expr(stmt.value)
+                    for t in stmt.targets:
+                        note_target(t)
+                elif isinstance(stmt, ast.AugAssign):
+                    scan_expr(stmt.value)
+                    note_target(stmt.target)
+                elif isinstance(stmt, ast.AnnAssign):
+                    scan_expr(stmt.value)
+                    note_target(stmt.target)
+                elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                    scan_expr(stmt.iter)
+                    note_target(stmt.target)
+                    walk(stmt.body)
+                    walk(stmt.orelse)
+                elif isinstance(stmt, (ast.While, ast.If)):
+                    scan_expr(stmt.test)
+                    walk(stmt.body)
+                    walk(stmt.orelse)
+                elif isinstance(stmt, ast.Try):
+                    walk(stmt.body)
+                    for h in stmt.handlers:
+                        walk(h.body)
+                    walk(stmt.orelse)
+                    walk(stmt.finalbody)
+                else:
+                    for child in ast.iter_child_nodes(stmt):
+                        if isinstance(child, ast.expr):
+                            scan_expr(child)
+
+        walk(fn.node.body)
+
+    def _racecheck(self) -> None:
+        # incoming call edges annotated with the caller's held lockset
+        incoming: dict[str, list] = {q: [] for q in self.symbols}
+        for fn in self.symbols.values():
+            for callee, heldset in fn.call_locks:
+                if callee in incoming:
+                    incoming[callee].append((fn.qname, heldset))
+        # ambient locks: the meet (intersection) over every incoming
+        # call path; roots (no resolvable callers) hold nothing.  Used
+        # by guard INFERENCE so helper bodies reached only under a lock
+        # count as locked sites.
+        ambient: dict[str, frozenset | None] = {
+            q: (frozenset() if not incoming[q] else None)
+            for q in self.symbols
+        }
+        for _ in range(_MAX_ROUNDS * 4):
+            changed = False
+            for q, fn in self.symbols.items():
+                amb = ambient[q]
+                if amb is None:
+                    continue
+                for callee, heldset in fn.call_locks:
+                    if callee not in ambient:
+                        continue
+                    cand = amb | heldset
+                    cur = ambient[callee]
+                    new = cand if cur is None else cur & cand
+                    if new != cur:
+                        ambient[callee] = new
+                        changed = True
+            if not changed:
+                break
+        # thread context: the lockset guaranteed on EVERY path from a
+        # thread entry point (meet again); functions absent from tctx
+        # are not thread-reachable and are never flagged
+        tctx: dict[str, frozenset] = {}
+        origin: dict[str, str] = {}
+        for q, reason in self.thread_entries.items():
+            tctx[q] = frozenset()
+            origin[q] = f"{q} ({reason})"
+        for _ in range(_MAX_ROUNDS * 4):
+            changed = False
+            for q, fn in list(self.symbols.items()):
+                if q not in tctx:
+                    continue
+                for callee, heldset in fn.call_locks:
+                    if callee not in self.symbols:
+                        continue
+                    cand = tctx[q] | heldset
+                    cur = tctx.get(callee)
+                    new = cand if cur is None else cur & cand
+                    if new != cur:
+                        tctx[callee] = new
+                        origin.setdefault(callee, origin[q])
+                        changed = True
+            if not changed:
+                break
+        # guarded-by map: reviewed declarations first, majority next
+        sites: dict[str, list] = {}
+        for fn in self.symbols.values():
+            amb = ambient.get(fn.qname) or frozenset()
+            for field, kind, line, heldset in fn.accesses:
+                sites.setdefault(field, []).append(
+                    (fn, kind, line, amb | heldset)
+                )
+        self.guard_map = {}
+        for field, ss in sorted(sites.items()):
+            declared = self.declared_guards.get(field)
+            if declared is not None:
+                self.guard_map[field] = {
+                    "guard": declared, "source": "declared",
+                    "sites": len(ss),
+                    "held": sum(
+                        1 for _, _, _, ls in ss if declared in ls
+                    ),
+                }
+                continue
+            if not any(kind == "write" for _, kind, _, _ in ss):
+                continue  # never mutated post-init: cannot race
+            counted = [ls for _, _, _, ls in ss if _UNKNOWN_LOCK not in ls]
+            if len(counted) < 2:
+                continue
+            tally: dict[str, int] = {}
+            for ls in counted:
+                for role in ls:
+                    tally[role] = tally.get(role, 0) + 1
+            for role, n in sorted(
+                tally.items(), key=lambda kv: (-kv[1], kv[0])
+            ):
+                if n >= 2 and n * 2 > len(counted):
+                    self.guard_map[field] = {
+                        "guard": role, "source": "inferred",
+                        "sites": len(counted), "held": n,
+                    }
+                break  # only the top role can hold a majority
+        # declared guards with no observed sites still surface in the
+        # artifact so a stale declaration is visible to reviewers
+        for field, role in sorted(self.declared_guards.items()):
+            self.guard_map.setdefault(field, {
+                "guard": role, "source": "declared", "sites": 0, "held": 0,
+            })
+        # emission: thread-reachable accesses whose lockset misses the
+        # field's guard
+        seen: set = set()
+        for fn in self.symbols.values():
+            T = tctx.get(fn.qname)
+            if T is None:
+                continue
+            for field, kind, line, heldset in fn.accesses:
+                g = self.guard_map.get(field)
+                if g is None or not g["sites"]:
+                    continue
+                eff = T | heldset
+                if g["guard"] in eff or _UNKNOWN_LOCK in eff:
+                    continue
+                key = (fn.rel, line)
+                if key in seen:
+                    continue
+                seen.add(key)
+                self.race_flows.append(TaintFlow(
+                    rel=fn.rel, line=line,
+                    message=(
+                        f"{kind} of {field} misses its guard lock "
+                        f"{g['guard']!r} ({g['source']}, held at "
+                        f"{g['held']}/{g['sites']} sites) on a thread "
+                        f"path from {origin.get(fn.qname, fn.qname)} — "
+                        "hold the guard across this access, move the "
+                        "field behind it, or pragma a reviewed benign "
+                        "race"
+                    ),
+                ))
+        self.race_flows.sort(key=lambda f: (f.rel, f.line))
+
     # -- public API --------------------------------------------------------
 
     def function(self, qname: str) -> FunctionInfo | None:
@@ -721,6 +1376,7 @@ __all__ = [
     "Project",
     "FunctionInfo",
     "ModuleInfo",
+    "ClassInfo",
     "TaintFlow",
     "CSP_SEAM_ALLOWED",
     "BLOCKING_CALLS",

@@ -1,0 +1,69 @@
+"""Reviewed guarded-by declarations for fabriclint's racecheck rule.
+
+Each entry pins a shared field to the lock ROLE that must be held at
+every access reachable from a thread entry point.  Declarations beat
+majority inference: they are the reviewed concurrency contract for the
+hot structures (the commit pipeline, the snapshot manager, the TPU
+CSP's coalescing lane state, gossip membership), so a refactor that
+quietly drops the lock around one access fails the lint gate even if
+it also shifts the statistical majority.
+
+Role spellings
+--------------
+* a ``lockwatch`` role string (``named_lock("kvledger.commit_lock")``)
+  for locks created through the lockwatch seam — the runtime
+  ``lockwatch.guarded(obj, field, by=role)`` assertions use the same
+  strings, so the static map and the dynamic cross-check can never
+  drift apart;
+* the member's own qname (``fabric_tpu.csp.tpu.provider.TPUCSP.
+  _ewma_lock``) as a pseudo-role for plain ``threading`` primitives.
+
+Fields NOT listed here still get a guard when a strict majority of
+their access sites hold one lock (see ``dataflow.Project._racecheck``);
+this table exists for the structures where "majority" is not a strong
+enough word for the invariant.
+"""
+
+from __future__ import annotations
+
+DECLARED_GUARDS: dict[str, str] = {
+    # -- commit pipeline (PR 2 group commit) -------------------------------
+    # the open CommitGroup and the durability watermark only move under
+    # the commit lock; a thread reading them lock-free would see a
+    # half-flushed group boundary
+    "fabric_tpu.ledger.kvledger.KVLedger._active_group":
+        "kvledger.commit_lock",
+    "fabric_tpu.ledger.kvledger.KVLedger._durable_height":
+        "kvledger.commit_lock",
+    "fabric_tpu.ledger.kvledger.KVLedger._durable_hash":
+        "kvledger.commit_lock",
+    # -- snapshot manager (PR 1/2) -----------------------------------------
+    "fabric_tpu.ledger.snapshot.SnapshotManager._pending":
+        "snapshot.manager",
+    "fabric_tpu.ledger.snapshot.SnapshotManager._inflight":
+        "snapshot.idle",
+    "fabric_tpu.ledger.snapshot.SnapshotManager._spawn_seq":
+        "snapshot.idle",
+    "fabric_tpu.ledger.snapshot.SnapshotManager._ack_seq":
+        "snapshot.idle",
+    # -- TPU CSP coalescing lane state (PR 2/6) ----------------------------
+    "fabric_tpu.csp.tpu.provider.TPUCSP._pend_batches": "csp.tpu.pend",
+    "fabric_tpu.csp.tpu.provider.TPUCSP._pend_lanes": "csp.tpu.pend",
+    "fabric_tpu.csp.tpu.provider.TPUCSP._flushed": "csp.tpu.pend",
+    "fabric_tpu.csp.tpu.provider.TPUCSP._inflight": "csp.tpu.pend",
+    "fabric_tpu.csp.tpu.provider.TPUCSP._gen": "csp.tpu.pend",
+    "fabric_tpu.csp.tpu.provider.TPUCSP._lane_wall_ewma":
+        "fabric_tpu.csp.tpu.provider.TPUCSP._ewma_lock",
+    # process-wide measured host verify rate (module global)
+    "fabric_tpu.csp.tpu.provider._host_rate_ewma":
+        "fabric_tpu.csp.tpu.provider._host_rate_lock",
+    # -- gossip membership --------------------------------------------------
+    "fabric_tpu.gossip.discovery.DiscoveryCore._peers":
+        "gossip.discovery.members",
+    "fabric_tpu.gossip.discovery.DiscoveryCore._tick":
+        "gossip.discovery.members",
+    "fabric_tpu.gossip.discovery.DiscoveryCore._seq":
+        "gossip.discovery.members",
+}
+
+__all__ = ["DECLARED_GUARDS"]

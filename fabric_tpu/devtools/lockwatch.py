@@ -264,6 +264,36 @@ class WatchedLock:
         return False
 
 
+def guarded(obj, field: str, *, by: str) -> None:
+    """Runtime guard assertion — the dynamic half of fabriclint's
+    racecheck.  Production code states, at a hot access site, which
+    lock ROLE the static guarded-by map (devtools/guards.py) requires
+    for ``obj.field``; a no-op unless FABRIC_TPU_LOCKWATCH, under which
+    (tier-1) the calling thread must hold a watched lock with that role
+    or the violation lands in the same session-drained ledger as lock
+    inversions — so every tier-1 run cross-checks the static guard map
+    against what threads actually hold."""
+    if not enabled():
+        return
+    for entry in _held():
+        if entry[0].name == by:
+            return
+    bad = {
+        "event": "unguarded-access",
+        "object": type(obj).__name__,
+        "field": field,
+        "required": by,
+        "thread": threading.current_thread().name,
+    }
+    with _state_lock:
+        violations.append(bad)
+    if _raise_mode():
+        raise LockOrderError(
+            f"unguarded access: {type(obj).__name__}.{field} requires "
+            f"lock role {by!r}, which this thread does not hold"
+        )
+
+
 def named_lock(name: str):
     """A threading.Lock, watched when FABRIC_TPU_LOCKWATCH is set."""
     if enabled():
@@ -633,6 +663,7 @@ __all__ = [
     "named_lock",
     "named_rlock",
     "named_condition",
+    "guarded",
     "enabled",
     "reset",
     "edges",

@@ -13,7 +13,7 @@ from __future__ import annotations
 import threading
 import time
 
-from fabric_tpu.devtools.lockwatch import spawn_thread
+from fabric_tpu.devtools.lockwatch import guarded, named_lock, spawn_thread
 from fabric_tpu.protos.gossip import message_pb2 as gpb
 
 
@@ -48,7 +48,10 @@ class DiscoveryCore:
         self._inc = int(time.time() * 1000)  # incarnation: process start
         self._seq = 0
         self._tick = 0
-        self._lock = threading.Lock()
+        # guards the membership map AND the logical clock: the tick
+        # driver thread and comm handler threads both touch them
+        # (declared in devtools/guards.py; racecheck enforces it)
+        self._lock = named_lock("gossip.discovery.members")
         self._on_change = on_membership_change or (lambda: None)
         comm.subscribe(self._handle)
 
@@ -70,26 +73,38 @@ class DiscoveryCore:
     # -- protocol ----------------------------------------------------------
 
     def _self_alive(self) -> gpb.GossipMessage:
-        self._seq += 1
+        # the seq counter is a read-modify-write shared by the tick
+        # driver and comm handler threads: two interleaved bumps would
+        # emit one (inc, seq) pair twice and remote peers would drop
+        # the genuinely newer alive as stale
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
         m = gpb.GossipMessage(tag=gpb.GossipMessage.EMPTY)
         m.alive_msg.membership.endpoint = self.endpoint
         m.alive_msg.membership.pki_id = self.pki_id
         m.alive_msg.membership.identity = self._comm.identity
         m.alive_msg.inc_number = self._inc
-        m.alive_msg.seq_num = self._seq
+        m.alive_msg.seq_num = seq
         return m
 
     def tick(self) -> None:
         """One logical time step: broadcast alive, expire silent peers."""
-        self._tick += 1
-        if self._tick % self._alive_every == 0:
+        # advance the logical clock and snapshot membership-emptiness
+        # under the members lock: comm handler threads read _tick in
+        # _learn and mutate _peers concurrently with this driver
+        with self._lock:
+            self._tick += 1
+            now = self._tick
+            know_no_one = not self._peers
+        if now % self._alive_every == 0:
             alive = self._self_alive()
             targets = {p.endpoint for p in self.alive_peers()}
             targets.update(self._bootstrap)
             for ep in targets:
                 self._comm.send(ep, alive)
             # also solicit membership from bootstrap when we know no one
-            if not self._peers:
+            if know_no_one:
                 req = gpb.GossipMessage(tag=gpb.GossipMessage.EMPTY)
                 req.mem_req.self_information.CopyFrom(alive.alive_msg)
                 for ep in self._bootstrap:
@@ -111,6 +126,7 @@ class DiscoveryCore:
         if am.membership.identity:
             self._comm.learn_identity(bytes(am.membership.identity))
         with self._lock:
+            guarded(self, "_peers", by="gossip.discovery.members")
             cur = self._peers.get(pki)
             if cur is None:
                 self._peers[pki] = PeerState(

@@ -13,7 +13,7 @@ import threading
 import time
 
 from fabric_tpu.devtools import faultline
-from fabric_tpu.devtools.lockwatch import named_rlock
+from fabric_tpu.devtools.lockwatch import guarded, named_rlock
 from fabric_tpu.ledger.blkstorage import BlockStore, BlockStoreError
 from fabric_tpu.ledger.history import HistoryDB
 from fabric_tpu.ledger.kvstore import (
@@ -420,6 +420,10 @@ class KVLedger:
         )
 
     def _flush_group(self, group: CommitGroup) -> None:
+        # static guard (devtools/guards.py) cross-checked at runtime:
+        # the open group and durability watermark move only under the
+        # commit lock
+        guarded(self, "_active_group", by="kvledger.commit_lock")
         if group.blocks:
             t0 = time.perf_counter()
             try:

@@ -46,6 +46,7 @@ import threading
 import time
 
 from fabric_tpu.devtools.lockwatch import (
+    guarded,
     named_condition,
     named_lock,
     spawn_thread,
@@ -544,12 +545,14 @@ class SnapshotManager:
         continues the same way).  Tests and operators can wait_idle()
         for the export to finish."""
         with self._lock:
+            guarded(self, "_pending", by="snapshot.manager")
             if not self._requests.has(block_number):
                 return
             self._requests.cancel(block_number)
             self._pending.discard(block_number)
             self._update_gauge()
         with self._idle:
+            guarded(self, "_spawn_seq", by="snapshot.idle")
             self._inflight += 1
             self._spawn_seq += 1
         spawn_thread(

@@ -51,10 +51,22 @@ def main() -> int:
              "artifact (what the interprocedural rules believed about "
              "every function this run)",
     )
+    ap.add_argument(
+        "--guards-out", default=None, metavar="PATH",
+        help="write racecheck's inferred guarded-by map (declared + "
+             "majority-inferred, with per-field site counts) as a JSON "
+             "artifact — reviewers diff guard inference across PRs",
+    )
+    ap.add_argument(
+        "--no-cache", action="store_true",
+        help="bypass the .fabriclint_cache dataflow cache (escape "
+             "hatch; the cache is keyed by file content hashes and "
+             "invalidates per file)",
+    )
     args = ap.parse_args()
 
     t0 = time.perf_counter()
-    report = lint_tree()
+    report = lint_tree(cache=not args.no_cache)
     elapsed = time.perf_counter() - t0
 
     for v in report.unsuppressed:
@@ -67,13 +79,20 @@ def main() -> int:
 
     summary = report.summary()
     summaries_written = None
-    if args.summaries_out and report.project is not None:
+    if args.summaries_out:
         with open(args.summaries_out, "w", encoding="utf-8") as f:
             n = 0
-            for s in report.project.summaries():
+            for s in report.function_summaries():
                 f.write(json.dumps(s, sort_keys=True) + "\n")
                 n += 1
         summaries_written = {"path": args.summaries_out, "functions": n}
+    guards_written = None
+    if args.guards_out:
+        guards = report.guard_map()
+        with open(args.guards_out, "w", encoding="utf-8") as f:
+            json.dump(guards, f, indent=2, sort_keys=True)
+            f.write("\n")
+        guards_written = {"path": args.guards_out, "fields": len(guards)}
     out = {
         "experiment": "fabriclint",
         "files": summary["files"],
@@ -83,10 +102,13 @@ def main() -> int:
         "by_rule": summary["by_rule"],
         "warn_by_rule": summary["warn_by_rule"],
         "clean": summary["clean"],
+        "cache": summary["cache"],
         "seconds": round(elapsed, 4),
     }
     if summaries_written is not None:
         out["summaries"] = summaries_written
+    if guards_written is not None:
+        out["guards"] = guards_written
     if args.write_baseline:
         with open(args.write_baseline, "w", encoding="utf-8") as f:
             json.dump(summary["by_rule"], f, indent=2, sort_keys=True)

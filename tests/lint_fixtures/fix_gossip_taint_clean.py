@@ -1,0 +1,17 @@
+"""CLEAN TWIN of fix_gossip_taint_dirty: the digest covers only the
+payload bytes and the sequence number is threaded in as an explicit
+argument — deterministic on every peer."""
+
+from fabric_tpu.common.hashing import sha256
+from fabric_tpu.protos.gossip import message_pb2 as gpb
+
+
+def payload_digest(payload: bytes) -> bytes:
+    return sha256(payload)
+
+
+def marshal_data_msg(payload: bytes, seq_num: int) -> bytes:
+    msg = gpb.GossipMessage(tag=gpb.GossipMessage.EMPTY)
+    msg.data_msg.payload.data = payload
+    msg.data_msg.payload.seq_num = seq_num
+    return msg.SerializeToString()

@@ -1,0 +1,22 @@
+"""SEEDED VIOLATION (taint, gossip sinks): wall-clock mixed into a
+gossip payload digest, and into a gossip message marshaled for the
+wire — peers compare/pull by exactly these bytes, so both fork the
+gossip view."""
+
+import time
+
+from fabric_tpu.common.hashing import sha256
+from fabric_tpu.protos.gossip import message_pb2 as gpb
+
+
+def payload_digest(payload: bytes) -> bytes:
+    stamp = time.time()  # the source
+    tag = f"{stamp}:{len(payload)}"
+    return sha256(tag.encode() + payload)  # <- gossip-digest: fires HERE
+
+
+def marshal_data_msg(payload: bytes) -> bytes:
+    msg = gpb.GossipMessage(tag=gpb.GossipMessage.EMPTY)
+    msg.data_msg.payload.data = payload
+    msg.data_msg.payload.seq_num = int(time.time())  # attribute fill
+    return msg.SerializeToString()  # <- serialize sink: fires HERE

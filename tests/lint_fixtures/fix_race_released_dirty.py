@@ -1,0 +1,33 @@
+"""SEEDED VIOLATION (racecheck): the lock is released (the `with`
+block ends) BEFORE the field access — lexically adjacent, but outside
+the critical section."""
+
+from fabric_tpu.devtools.lockwatch import named_lock, spawn_thread
+
+
+class DrainQueue:
+    def __init__(self):
+        self._lock = named_lock("fixture.drain")
+        self._jobs = []
+        self._last = None
+
+    def start(self):
+        t = spawn_thread(
+            target=self._drain, name="fixture-drain", kind="worker"
+        )
+        t.start()
+        return t
+
+    def _drain(self):
+        with self._lock:
+            job = self._jobs.pop() if self._jobs else None
+        self._last = job  # <- lock already released: fires HERE
+
+    def submit(self, job):
+        with self._lock:
+            self._jobs.append(job)
+            self._last = job
+
+    def peek_last(self):
+        with self._lock:
+            return self._last

@@ -1,0 +1,26 @@
+"""CLEAN TWIN of fix_race_rw_dirty: read and write share one guarded
+critical section."""
+
+from fabric_tpu.devtools.lockwatch import named_lock, spawn_thread
+
+
+class TickerBoard:
+    def __init__(self):
+        self._lock = named_lock("fixture.ticker")
+        self._quotes = {}
+
+    def start(self):
+        t = spawn_thread(
+            target=self._pump, name="fixture-pump", kind="worker"
+        )
+        t.start()
+        return t
+
+    def _pump(self):
+        with self._lock:
+            n = len(self._quotes)
+            self._quotes["seq"] = n + 1
+
+    def snapshot(self):
+        with self._lock:
+            return dict(self._quotes)

@@ -1,0 +1,26 @@
+"""SEEDED VIOLATION (racecheck): the worker holds the guard for its
+READ but drops it before the WRITE — mixed discipline on one field."""
+
+from fabric_tpu.devtools.lockwatch import named_lock, spawn_thread
+
+
+class TickerBoard:
+    def __init__(self):
+        self._lock = named_lock("fixture.ticker")
+        self._quotes = {}
+
+    def start(self):
+        t = spawn_thread(
+            target=self._pump, name="fixture-pump", kind="worker"
+        )
+        t.start()
+        return t
+
+    def _pump(self):
+        with self._lock:
+            n = len(self._quotes)  # read under the guard...
+        self._quotes["seq"] = n + 1  # <- ...write without it: fires HERE
+
+    def snapshot(self):
+        with self._lock:
+            return dict(self._quotes)

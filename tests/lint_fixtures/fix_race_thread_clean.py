@@ -1,0 +1,29 @@
+"""CLEAN TWIN of fix_race_thread_dirty: the worker takes the guard
+lock around its write, so every access site agrees."""
+
+from fabric_tpu.devtools.lockwatch import named_lock, spawn_thread
+
+
+class OffersCache:
+    def __init__(self):
+        self._lock = named_lock("fixture.offers")
+        self._offers = {}
+
+    def start(self):
+        t = spawn_thread(
+            target=self._refresh, name="fixture-refresh", kind="worker"
+        )
+        t.start()
+        return t
+
+    def _refresh(self):
+        with self._lock:
+            self._offers["latest"] = 1
+
+    def get(self, key):
+        with self._lock:
+            return self._offers.get(key)
+
+    def size(self):
+        with self._lock:
+            return len(self._offers)

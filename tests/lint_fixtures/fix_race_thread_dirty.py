@@ -1,0 +1,29 @@
+"""SEEDED VIOLATION (racecheck): a spawned worker thread writes a
+map whose other access sites all hold the owning lock — the majority
+infers the guard, the thread path misses it."""
+
+from fabric_tpu.devtools.lockwatch import named_lock, spawn_thread
+
+
+class OffersCache:
+    def __init__(self):
+        self._lock = named_lock("fixture.offers")
+        self._offers = {}
+
+    def start(self):
+        t = spawn_thread(
+            target=self._refresh, name="fixture-refresh", kind="worker"
+        )
+        t.start()
+        return t
+
+    def _refresh(self):
+        self._offers["latest"] = 1  # <- racecheck fires HERE
+
+    def get(self, key):
+        with self._lock:
+            return self._offers.get(key)
+
+    def size(self):
+        with self._lock:
+            return len(self._offers)

@@ -1,0 +1,22 @@
+"""CLEAN TWIN of fix_race_typed_dirty: the same typed call chain, but
+into the helper's lock-taking method — the latent ``bump`` stays
+unreached from any thread, so nothing fires."""
+
+from fabric_tpu.devtools.lockwatch import spawn_thread
+
+from .fix_race_typed_ledger import FixLedger
+
+
+class HeightPump:
+    def __init__(self, ledger: FixLedger):
+        self._ledger = ledger
+
+    def start(self):
+        t = spawn_thread(
+            target=self._run, name="fixture-height-pump", kind="worker"
+        )
+        t.start()
+        return t
+
+    def _run(self):
+        self._ledger.sync_bump()

@@ -1,0 +1,23 @@
+"""SEEDED VIOLATION (racecheck, type-informed call resolution): the
+worker thread reaches the ledger's unguarded write ONLY through an
+attribute call on an annotated parameter — without typed resolution
+the call falls off the graph and the race is invisible."""
+
+from fabric_tpu.devtools.lockwatch import spawn_thread
+
+from .fix_race_typed_ledger import FixLedger
+
+
+class HeightPump:
+    def __init__(self, ledger: FixLedger):
+        self._ledger = ledger
+
+    def start(self):
+        t = spawn_thread(
+            target=self._run, name="fixture-height-pump", kind="worker"
+        )
+        t.start()
+        return t
+
+    def _run(self):
+        self._ledger.bump()  # resolves via the FixLedger annotation

@@ -1,0 +1,28 @@
+"""Helper for the typed-call racecheck pair: a ledger-ish object whose
+height is lock-guarded at most sites.  ``bump`` is the latent unguarded
+write — harmless until some THREAD reaches it (fix_race_typed_dirty),
+invisible to a linter that cannot resolve attribute calls on annotated
+parameters."""
+
+from fabric_tpu.devtools.lockwatch import named_lock
+
+
+class FixLedger:
+    def __init__(self):
+        self._lock = named_lock("fixture.typed.ledger")
+        self._height = 0
+
+    def bump(self):
+        self._height += 1  # <- fires HERE (via the typed call chain)
+
+    def sync_bump(self):
+        with self._lock:
+            self._height += 1
+
+    def height(self):
+        with self._lock:
+            return self._height
+
+    def reset(self):
+        with self._lock:
+            self._height = 0

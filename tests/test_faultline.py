@@ -503,3 +503,73 @@ def test_gossip_dial_fault_backs_off_and_recovers():
     finally:
         send.close()
         recv.close()
+
+
+# -- multichip dryrun under a device-loss plan (ISSUE 7 satellite) -----------
+
+
+@pytest.mark.slow
+def test_dryrun_multichip_device_loss_breaker_rc0():
+    """ROADMAP faultline candidate closed: the multichip dryrun with a
+    seeded plan that kills one device's collect mid-dispatch must (a)
+    fail over to the host oracle with correct verdicts, (b) open the
+    TPUCSP circuit breaker and serve follow-up traffic breaker-routed
+    (dryrun asserts both internally when a plan is armed), and (c)
+    still exit rc=0 through NORMAL teardown with the threadwatch
+    ledger empty — chaos must not resurrect the rc=134 class."""
+    import os
+    import subprocess
+    import sys
+    import textwrap
+
+    pytest.importorskip(
+        "cryptography", reason="dryrun builds a 5-org world"
+    )
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    code = textwrap.dedent("""
+        from fabric_tpu.devtools import faultline
+
+        assert faultline.active(), "env fault plan was not armed"
+
+        import __graft_entry__
+
+        __graft_entry__.dryrun_multichip(2)
+
+        trips = faultline.trips()
+        assert any(t["point"] == "tpu.collect" for t in trips), trips
+
+        from fabric_tpu.devtools import lockwatch
+
+        assert not lockwatch.thread_violations, (
+            repr(lockwatch.thread_violations)
+        )
+        stragglers = lockwatch.drain_threads(timeout=30.0)
+        assert not stragglers, repr(stragglers)
+        print("DEVICE-LOSS-OK", flush=True)
+    """)
+    plan = json.dumps({
+        "seed": 7,
+        "faults": [{
+            "point": "tpu.collect", "action": "raise",
+            "error": "DeviceUnavailable", "nth": 1,
+        }],
+    })
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+        "FABRIC_TPU_LOCKWATCH": "1",
+        "FABRIC_TPU_THREADWATCH": "1",
+        "FABRIC_TPU_FAULTLINE": plan,
+        "FABRIC_TPU_BREAKER_THRESHOLD": "1",
+    })
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        cwd=root, env=env, capture_output=True, text=True,
+        timeout=1500.0,
+    )
+    assert proc.returncode == 0, (
+        f"device-loss dryrun exited rc={proc.returncode}\n"
+        f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr[-4000:]}"
+    )
+    assert "DEVICE-LOSS-OK" in proc.stdout

@@ -599,6 +599,122 @@ def test_rejected_backend_is_not_installed_as_default():
     assert factory._default is before
 
 
+def test_racecheck_is_enforced_at_error_with_no_baseline():
+    """ISSUE 7 acceptance: racecheck is a first-class rule, on at error
+    severity in the strict profile, and the tree gate above runs with
+    no baseline file — so any unsuppressed racecheck finding fails
+    tier-1."""
+    from fabric_tpu.devtools.lint import RELAXED_PROFILE, STRICT_PROFILE
+
+    assert "racecheck" in RULES
+    assert "racecheck" not in STRICT_PROFILE.disabled
+    assert "racecheck" not in STRICT_PROFILE.advisory
+    assert "racecheck" in RELAXED_PROFILE.disabled
+    import glob
+    import os
+
+    from fabric_tpu.devtools.lint import repo_root
+
+    assert not glob.glob(os.path.join(repo_root(), "*baseline*.json")), (
+        "the tree must stay clean with NO baseline ratchet file"
+    )
+
+
+# -- dataflow cache (ISSUE 7 satellite) --------------------------------------
+
+
+def _report_json(report) -> str:
+    """Everything observable about a lint run, as canonical JSON —
+    cache hits must be indistinguishable from cold runs."""
+    summary = {k: v for k, v in report.summary().items() if k != "cache"}
+    return json.dumps({
+        "violations": [v.to_dict() for v in report.violations],
+        "summary": summary,
+        "summaries": report.function_summaries(),
+        "guards": report.guard_map(),
+    }, sort_keys=True)
+
+
+def _write_cache_tree(tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir(exist_ok=True)
+    (pkg / "mod.py").write_text(
+        "import threading\n"
+        "def go():\n"
+        "    t = threading.Thread(target=print, daemon=True)\n"
+        "    t.start()\n"
+    )
+    (pkg / "helper.py").write_text(
+        "def double(x):\n"
+        "    return 2 * x\n"
+    )
+
+
+def test_dataflow_cache_hit_matches_cold_run_exactly(tmp_path):
+    from fabric_tpu.devtools.lint import lint_tree
+
+    _write_cache_tree(tmp_path)
+    cold = lint_tree(root=str(tmp_path), targets=("pkg",))
+    assert cold.cache_state == "miss"
+    assert cold.summary()["by_rule"] == {"thread-hygiene": 1}
+    hit = lint_tree(root=str(tmp_path), targets=("pkg",))
+    assert hit.cache_state == "hit"
+    assert hit.project is None  # served without re-analysis
+    assert _report_json(hit) == _report_json(cold)
+    # the escape hatch bypasses the cache entirely
+    off = lint_tree(root=str(tmp_path), targets=("pkg",), cache=False)
+    assert off.cache_state == "off"
+    assert _report_json(off) == _report_json(cold)
+
+
+def test_dataflow_cache_invalidates_on_any_file_edit(tmp_path):
+    from fabric_tpu.devtools.lint import lint_tree
+
+    _write_cache_tree(tmp_path)
+    first = lint_tree(root=str(tmp_path), targets=("pkg",))
+    assert first.cache_state == "miss"
+    # editing ONE file must invalidate (content-hash keyed)
+    (tmp_path / "pkg" / "helper.py").write_text(
+        "def double(x):\n"
+        "    return x + x\n"
+    )
+    second = lint_tree(root=str(tmp_path), targets=("pkg",))
+    assert second.cache_state == "miss"
+    # unchanged tree -> hit again
+    third = lint_tree(root=str(tmp_path), targets=("pkg",))
+    assert third.cache_state == "hit"
+
+
+def test_ci_wrapper_guards_out_writes_artifact(tmp_path):
+    """scripts/lint.py --guards-out PATH (ISSUE 7 satellite): the
+    inferred guarded-by map lands as a JSON artifact next to the
+    result line, declared entries included, so reviewers can diff
+    guard inference across PRs."""
+    import os
+
+    from fabric_tpu.devtools.lint import repo_root
+
+    root = repo_root()
+    out_path = tmp_path / "guards.json"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(root, "scripts", "lint.py"),
+         "--guards-out", str(out_path)],
+        capture_output=True, text=True, cwd=root,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    result = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert result["experiment"] == "fabriclint"
+    assert result["guards"]["path"] == str(out_path)
+    guards = json.loads(out_path.read_text())
+    assert len(guards) == result["guards"]["fields"] > 20
+    active = guards["fabric_tpu.ledger.kvledger.KVLedger._active_group"]
+    assert active["guard"] == "kvledger.commit_lock"
+    assert active["source"] == "declared"
+    assert active["sites"] > 0
+    # majority inference is represented too
+    assert any(g["source"] == "inferred" for g in guards.values())
+
+
 def test_ci_wrapper_summaries_out_writes_artifact(tmp_path):
     """scripts/lint.py --summaries-out PATH (ISSUE 6 satellite): the
     per-function dataflow summaries land as a JSON-lines artifact next

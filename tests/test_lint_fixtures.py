@@ -232,6 +232,188 @@ def test_module_dotted_mapping():
     )
 
 
+# -- racecheck: lockset inference + shared-state race detection --------------
+
+
+def _race_fixture(name: str):
+    src = _load(name)
+    return src, lint_source(src, f"fabric_tpu/gossip/{name}")
+
+
+def test_racecheck_fires_on_unguarded_thread_write():
+    src, vs = _race_fixture("fix_race_thread_dirty.py")
+    lines = _fires(vs, "racecheck")
+    assert len(lines) == 1
+    assert "fires HERE" in src.splitlines()[lines[0] - 1]
+    assert lint_source(
+        _load("fix_race_thread_clean.py"),
+        "fabric_tpu/gossip/fix_race_thread_clean.py",
+    ) == []
+
+
+def test_racecheck_fires_on_write_outside_guarded_read():
+    src, vs = _race_fixture("fix_race_rw_dirty.py")
+    lines = _fires(vs, "racecheck")
+    assert len(lines) == 1
+    assert "fires HERE" in src.splitlines()[lines[0] - 1]
+    assert lint_source(
+        _load("fix_race_rw_clean.py"),
+        "fabric_tpu/gossip/fix_race_rw_clean.py",
+    ) == []
+
+
+def test_racecheck_fires_after_lock_released():
+    src, vs = _race_fixture("fix_race_released_dirty.py")
+    lines = _fires(vs, "racecheck")
+    assert len(lines) == 1
+    assert "fires HERE" in src.splitlines()[lines[0] - 1]
+    assert lint_source(
+        _load("fix_race_released_clean.py"),
+        "fabric_tpu/gossip/fix_race_released_clean.py",
+    ) == []
+
+
+def test_racecheck_resolves_annotated_param_call_chain():
+    """The acceptance fixture: a violation reached ONLY through an
+    attribute call on an annotated parameter is reported — the typed
+    resolver keeps the call on the graph."""
+    ledger_src = _load("fix_race_typed_ledger.py")
+    srcs = {
+        "fabric_tpu/orderer/fix_race_typed_ledger.py": ledger_src,
+        "fabric_tpu/orderer/fix_race_typed_dirty.py":
+            _load("fix_race_typed_dirty.py"),
+    }
+    report = lint_sources(srcs)
+    hits = [v for v in report.unsuppressed if v.rule == "racecheck"]
+    assert len(hits) == 1
+    assert hits[0].path == "fabric_tpu/orderer/fix_race_typed_ledger.py"
+    assert "fires HERE" in ledger_src.splitlines()[hits[0].line - 1]
+    # the typed call really resolved (not just a lucky name match)
+    key = "fabric_tpu.orderer.fix_race_typed_ledger.FixLedger.bump"
+    assert key in report.project.call_resolutions.values()
+    # and the worker is a registered thread entry
+    assert (
+        "fabric_tpu.orderer.fix_race_typed_dirty.HeightPump._run"
+        in report.project.thread_entries
+    )
+
+
+def test_racecheck_typed_clean_twin_stays_quiet():
+    """Same helper, same latent unguarded write — but the thread path
+    goes through the lock-taking method, so nothing fires."""
+    srcs = {
+        "fabric_tpu/orderer/fix_race_typed_ledger.py":
+            _load("fix_race_typed_ledger.py"),
+        "fabric_tpu/orderer/fix_race_typed_clean.py":
+            _load("fix_race_typed_clean.py"),
+    }
+    report = lint_sources(srcs)
+    assert [v for v in report.unsuppressed if v.rule == "racecheck"] == []
+
+
+def test_racecheck_guard_map_exposes_inference():
+    src = _load("fix_race_thread_dirty.py")
+    report = lint_sources({"fabric_tpu/gossip/fix.py": src})
+    g = report.project.guard_map[
+        "fabric_tpu.gossip.fix.OffersCache._offers"
+    ]
+    assert g["guard"] == "fixture.offers"
+    assert g["source"] == "inferred"
+    assert g["held"] == 2 and g["sites"] == 3
+
+
+def test_racecheck_pragma_suppresses_with_reason():
+    src = _load("fix_race_thread_dirty.py").replace(
+        '        self._offers["latest"] = 1  # <- racecheck fires HERE',
+        "        # fabriclint: allow[racecheck] reviewed: benign "
+        "last-write-wins refresh\n"
+        '        self._offers["latest"] = 1',
+    )
+    vs = lint_source(src, "fabric_tpu/gossip/fix.py")
+    assert [v for v in vs if not v.suppressed] == []
+    assert any(v.rule == "racecheck" and v.suppressed for v in vs)
+
+
+def test_racecheck_declared_guard_beats_majority():
+    """A declared guard flags a lone unlocked thread write even when
+    the field has no majority (too few sites for inference)."""
+    from fabric_tpu.devtools import dataflow
+
+    src = (
+        "from fabric_tpu.devtools.lockwatch import named_lock, "
+        "spawn_thread\n"
+        "class Reg:\n"
+        "    def __init__(self):\n"
+        "        self._lock = named_lock('fixture.reg')\n"
+        "        self._rows = {}\n"
+        "    def start(self):\n"
+        "        spawn_thread(target=self._run, kind='worker').start()\n"
+        "    def _run(self):\n"
+        "        self._rows['k'] = 1\n"
+    )
+    import ast
+
+    project = dataflow.Project(
+        {"fabric_tpu/gossip/reg.py": ast.parse(src)},
+        declared_guards={
+            "fabric_tpu.gossip.reg.Reg._rows": "fixture.reg"
+        },
+    )
+    assert [f.line for f in project.race_flows] == [9]
+    # without the declaration there is no majority and no finding
+    project = dataflow.Project(
+        {"fabric_tpu/gossip/reg.py": ast.parse(src)}, declared_guards={}
+    )
+    assert project.race_flows == []
+
+
+def test_racecheck_sees_positional_spawn_target():
+    """spawn_thread(target, ...) without the keyword must still
+    register the thread entry — a spelling change must not exempt a
+    whole thread from the gate."""
+    src = _load("fix_race_thread_dirty.py").replace(
+        "target=self._refresh,", "self._refresh,"
+    )
+    vs = lint_source(src, "fabric_tpu/gossip/fix.py")
+    assert len(_fires(vs, "racecheck")) == 1
+
+
+def test_racecheck_relaxed_profile_exempts_tests():
+    src = _load("fix_race_thread_dirty.py")
+    assert lint_source(src, "tests/fix_race_thread_dirty.py") == []
+
+
+# -- gossip taint sinks (payload digests + message marshal) ------------------
+
+
+def test_gossip_taint_fires_on_digest_and_marshal():
+    src = _load("fix_gossip_taint_dirty.py")
+    vs = lint_source(src, "fabric_tpu/gossip/fix_gossip_taint_dirty.py")
+    lines = _fires(vs, "taint")
+    assert len(lines) == 2
+    src_lines = src.splitlines()
+    assert "sha256(" in src_lines[lines[0] - 1]
+    assert "SerializeToString" in src_lines[lines[1] - 1]
+
+
+def test_gossip_taint_clean_twin_stays_quiet():
+    src = _load("fix_gossip_taint_clean.py")
+    assert lint_source(
+        src, "fabric_tpu/gossip/fix_gossip_taint_clean.py"
+    ) == []
+
+
+def test_gossip_digest_sink_is_scoped_to_gossip():
+    """The same wall-clock->seam-digest flow OUTSIDE gossip is not a
+    gossip-digest sink (other scopes have their own rules)."""
+    src = _load("fix_gossip_taint_dirty.py")
+    vs = lint_source(src, "fabric_tpu/comm/fix_gossip_taint_dirty.py")
+    lines = _fires(vs, "taint")
+    # the serialize sink still fires; the digest line does not
+    assert len(lines) == 1
+    assert "SerializeToString" in src.splitlines()[lines[0] - 1]
+
+
 # -- exception-discipline: the faultline seam is transparent -----------------
 
 

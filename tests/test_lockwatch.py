@@ -450,7 +450,96 @@ def test_named_condition_plain_when_disabled(monkeypatch):
     assert isinstance(cond, threading.Condition)
 
 
-# -- threadwatch: the thread-lifecycle ledger (ISSUE 4) ----------------------
+# -- guarded(): the runtime half of racecheck (ISSUE 7) ----------------------
+
+
+class _Obj:
+    pass
+
+
+def test_guarded_quiet_while_role_held():
+    lock = named_lock("guard.role")
+    with lock:
+        lockwatch.guarded(_Obj(), "field", by="guard.role")
+    assert lockwatch.violations == []
+    # condition roles count too: holding the condition holds its lock
+    from fabric_tpu.devtools.lockwatch import named_condition
+
+    cond = named_condition("guard.cond")
+    with cond:
+        lockwatch.guarded(_Obj(), "field", by="guard.cond")
+    assert lockwatch.violations == []
+
+
+def test_guarded_violation_raises_and_lands_in_drained_ledger():
+    """ISSUE 7 acceptance: an injected unguarded access fails
+    DETERMINISTICALLY — guarded() raises on the spot AND records into
+    lockwatch.violations, the very ledger conftest's session-end soak
+    gate asserts empty, so even a violation swallowed by a broad
+    handler on a background thread still fails the session."""
+    named_lock("guard.other")  # role exists, but is not held
+    with pytest.raises(LockOrderError, match="unguarded access"):
+        lockwatch.guarded(_Obj(), "_peers", by="guard.role")
+    assert len(lockwatch.violations) == 1
+    bad = lockwatch.violations[0]
+    assert bad["event"] == "unguarded-access"
+    assert bad["field"] == "_peers"
+    assert bad["required"] == "guard.role"
+    assert bad["object"] == "_Obj"
+    lockwatch.violations.clear()  # examined: keep the session gate green
+
+
+def test_guarded_wrong_lock_held_still_fires():
+    other = named_lock("guard.wrong")
+    with other:
+        with pytest.raises(LockOrderError, match="unguarded access"):
+            lockwatch.guarded(_Obj(), "field", by="guard.right")
+    lockwatch.violations.clear()
+
+
+def test_guarded_record_mode_observes_without_raising(monkeypatch):
+    monkeypatch.setenv("FABRIC_TPU_LOCKWATCH", "record")
+    lockwatch.guarded(_Obj(), "field", by="guard.role")
+    assert lockwatch.violations[-1]["event"] == "unguarded-access"
+    lockwatch.violations.clear()
+
+
+def test_guarded_noop_when_disabled(monkeypatch):
+    monkeypatch.setenv("FABRIC_TPU_LOCKWATCH", "")
+    lockwatch.guarded(_Obj(), "field", by="guard.role")
+    assert lockwatch.violations == []
+
+
+def test_guarded_sites_in_production_hold_their_declared_roles():
+    """The wired hot sites really run under their guards in tier-1: a
+    discovery _learn and a snapshot submit both pass through guarded()
+    without tripping (the e2e suites exercise the rest)."""
+    from fabric_tpu.gossip.discovery import DiscoveryCore
+
+    class _Comm:
+        endpoint = "h:1"
+        pki_id = b"pki-self"
+        identity = b"id-self"
+
+        def subscribe(self, fn):
+            pass
+
+        def learn_identity(self, ident):
+            pass
+
+    core = DiscoveryCore(_Comm(), bootstrap=[])
+
+    class _AM:
+        class membership:
+            pki_id = b"pki-peer"
+            endpoint = "h:2"
+            identity = b""
+
+        inc_number = 1
+        seq_num = 1
+
+    assert core._learn(_AM()) is True
+    assert lockwatch.violations == []
 
 
 @pytest.fixture()
