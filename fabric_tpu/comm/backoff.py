@@ -15,6 +15,8 @@ from __future__ import annotations
 
 import random
 
+from fabric_tpu.devtools import clockskew
+
 
 class DecorrelatedBackoff:
     """Deterministic decorrelated jitter: same seed -> same sequence."""
@@ -66,4 +68,45 @@ class DecorrelatedBackoff:
         self._dirty = False
 
 
-__all__ = ["DecorrelatedBackoff"]
+class BackoffGate:
+    """A dial/redial gate over a :class:`DecorrelatedBackoff`, clocked
+    through the ``devtools.clockskew`` monotonic source — the one place
+    the "am I still inside the backoff window?" comparison lives, so
+    every transport gates the same way and a virtual clock (or a
+    faultline ``skew`` rule jumping it) drives the window open
+    deterministically in tests with no real sleeps.
+
+    ``ready()`` is True when no window is armed or the armed window has
+    passed; ``arm()`` draws the next jitter interval and opens a new
+    window; ``clear()`` closes it without touching the jitter sequence
+    (a successful dial); ``reset()`` additionally rewinds the jitter rng
+    (a PROVEN-healthy exchange, same contract as
+    :meth:`DecorrelatedBackoff.reset`)."""
+
+    def __init__(self, backoff: DecorrelatedBackoff):
+        self._backoff = backoff
+        self._until = 0.0
+
+    @classmethod
+    def for_key(cls, key: str, base: float = 0.05,
+                cap: float = 2.0) -> "BackoffGate":
+        return cls(DecorrelatedBackoff.for_key(key, base=base, cap=cap))
+
+    def ready(self) -> bool:
+        return clockskew.monotonic() >= self._until
+
+    def arm(self) -> float:
+        """Open the next backoff window; returns its length in seconds."""
+        wait = self._backoff.next()
+        self._until = clockskew.monotonic() + wait
+        return wait
+
+    def clear(self) -> None:
+        self._until = 0.0
+
+    def reset(self) -> None:
+        self._backoff.reset()
+        self._until = 0.0
+
+
+__all__ = ["DecorrelatedBackoff", "BackoffGate"]

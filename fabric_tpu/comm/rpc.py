@@ -33,7 +33,7 @@ import ssl
 import struct
 import threading
 
-from fabric_tpu.devtools import faultline
+from fabric_tpu.devtools import clockskew, faultline
 from fabric_tpu.devtools.lockwatch import spawn_thread
 
 KIND_DATA = 0
@@ -163,8 +163,10 @@ class _Handler(socketserver.BaseRequestHandler):
         # Idle reaping: a connected-but-silent peer must not hold this
         # thread (and later a limiter permit) forever — the handshake
         # and the request read each get the idle window, then the
-        # timeout clears for the handler's own streaming reads.
-        sock.settimeout(ka.idle_timeout)
+        # timeout clears for the handler's own streaming reads.  The
+        # deadline routes through the clockskew seam so chaos tests
+        # compress a 30s idle window into milliseconds of real time.
+        sock.settimeout(clockskew.io_timeout(ka.idle_timeout))
         # the holder is re-pointed at the TLS socket after the wrap
         # (wrap_socket detaches the raw fd — closing the pre-wrap object
         # in stop() would be a no-op for TLS connections)
@@ -290,7 +292,7 @@ def _pump_stream(sock, out, ka: KeepaliveOptions) -> bool:
     try:
         while True:
             try:
-                item = q.get(timeout=ka.ping_interval)
+                item = q.get(timeout=clockskew.io_timeout(ka.ping_interval))
             except queue.Empty:
                 faultline.point("rpc.ping")
                 write_frame(sock, bytes([KIND_PING]))  # live but idle
@@ -479,7 +481,9 @@ class RPCClient:
         sock = self._connect(method, body)
         ka = self._keepalive
         try:
-            sock.settimeout(ka.ping_interval + ka.ping_timeout)
+            sock.settimeout(
+                clockskew.io_timeout(ka.ping_interval + ka.ping_timeout)
+            )
             while True:
                 try:
                     frame = read_frame(sock)

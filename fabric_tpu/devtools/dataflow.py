@@ -167,6 +167,18 @@ _UNKNOWN_LOCK = "?"
 _GOSSIP_SINK_SCOPE = "fabric_tpu/gossip/"
 
 
+# the chaos seams: their blocking calls (faultline.write's torn-path
+# flush, clockskew/faultline injected sleeps) only execute under an
+# armed plan or a virtual clock — with nothing armed every fault point
+# is a no-op, so their blocking-io summaries must not propagate into
+# callers (mirror of the PR 6 decision that faultline.* is transparent
+# to exception-discipline)
+_CHAOS_SEAM = (
+    "fabric_tpu/devtools/faultline.py",
+    "fabric_tpu/devtools/clockskew.py",
+)
+
+
 def _in_seam(rel: str) -> bool:
     return any(rel.startswith(p) for p in CSP_SEAM_ALLOWED)
 
@@ -178,6 +190,27 @@ def _module_dotted(rel: str) -> str:
     elif rel.endswith(".py"):
         rel = rel[:-3]
     return rel.replace("/", ".")
+
+
+def _iter_nested_defs(stmts):
+    """Function definitions nested one level down inside a statement
+    list (descending through control flow but not into the found defs
+    themselves — recursion registers deeper levels — nor into nested
+    classes, which are out of model)."""
+    for s in stmts:
+        if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield s
+        elif isinstance(s, ast.ClassDef):
+            continue
+        else:
+            for attr in ("body", "orelse", "finalbody"):
+                sub = getattr(s, attr, None)
+                if sub:
+                    yield from _iter_nested_defs(sub)
+            for h in getattr(s, "handlers", ()):
+                yield from _iter_nested_defs(h.body)
+            for c in getattr(s, "cases", ()):  # match statements
+                yield from _iter_nested_defs(c.body)
 
 
 def _dotted(expr) -> str | None:
@@ -355,11 +388,15 @@ class Project:
                         self._add_function(mod, sub, cls=stmt.name)
         self.modules[rel] = mod
 
-    def _add_function(self, mod: ModuleInfo, node, cls: str | None) -> None:
-        qname = (
-            f"{mod.dotted}.{cls}.{node.name}" if cls
-            else f"{mod.dotted}.{node.name}"
-        )
+    def _add_function(self, mod: ModuleInfo, node, cls: str | None,
+                      parent: str | None = None) -> None:
+        if parent is not None:
+            qname = f"{parent}.<locals>.{node.name}"
+        else:
+            qname = (
+                f"{mod.dotted}.{cls}.{node.name}" if cls
+                else f"{mod.dotted}.{node.name}"
+            )
         a = node.args
         params = [p.arg for p in a.posonlyargs + a.args]
         fn = FunctionInfo(
@@ -368,6 +405,14 @@ class Project:
         )
         mod.functions.append(fn)
         self.symbols[qname] = fn
+        # locally-defined functions get their own symbols under a
+        # `<qname>.<locals>.` scope: closures passed to spawn_thread /
+        # Thread (the committer's commit_loop, rpc's stream pull) are
+        # real thread entries racecheck must see.  They keep the
+        # enclosing `cls` so closed-over `self.x` accesses resolve into
+        # the class registry.
+        for sub in _iter_nested_defs(node.body):
+            self._add_function(mod, sub, cls=cls, parent=qname)
 
     # -- name resolution ---------------------------------------------------
 
@@ -572,7 +617,13 @@ class Project:
         for node in ast.walk(fn.node):
             if isinstance(node, ast.Assign) and len(node.targets) == 1 \
                     and isinstance(node.targets[0], ast.Name):
-                bound = self._resolve_expr(mod, node.value, fn.cls, local)
+                # `types` rides along so a local bound from an annotated
+                # param's field (`lk = ledger.commit_lock`) resolves to
+                # the field's qname — the lockset pass then maps the
+                # bare `with lk:` to the field's lock role
+                bound = self._resolve_expr(
+                    mod, node.value, fn.cls, local, types
+                )
                 if bound is not None and not isinstance(node.value, ast.Call):
                     local[node.targets[0].id] = bound
                     if not seam and (
@@ -628,9 +679,21 @@ class Project:
                     ):
                         fn.acquires_locks.add(name)
         fn.uses_hashlib_transitive = fn.uses_hashlib and not seam
-        fn.blocking_transitive = fn.blocking
+        fn.blocking_transitive = fn.blocking and fn.rel not in _CHAOS_SEAM
         fn.returns_digest = self._returns_digest_direct(mod, fn, local)
         fn._local_bindings = local  # reused by the taint pass
+        # names stored more than once anywhere in this function: a lock
+        # ALIAS among them is ambiguous — the binding map is flow-
+        # insensitive (last write wins), so crediting it would attach
+        # the WRONG lock's role to earlier with-blocks.  _role_of_ctx
+        # degrades rebound aliases to the UNKNOWN lockset instead.
+        store_counts: dict[str, int] = {}
+        for node in ast.walk(fn.node):
+            if isinstance(node, ast.Name) and isinstance(
+                node.ctx, ast.Store
+            ):
+                store_counts[node.id] = store_counts.get(node.id, 0) + 1
+        fn._rebound = {k for k, c in store_counts.items() if c > 1}
         # callee qnames appearing inside Return expressions, computed
         # once — the returns-digest fixpoint is a set lookup, not a
         # re-walk of the caller's AST per round
@@ -993,7 +1056,8 @@ class Project:
     # -- racecheck: lockset-at-access + guarded-by inference ---------------
 
     def _role_of_ctx(self, mod: ModuleInfo, ctx, ci: ClassInfo | None,
-                     types: dict) -> str | None:
+                     types: dict, local: dict | None = None,
+                     rebound=()) -> str | None:
         """Lock role of a with-context expression.  None = not a lock;
         _UNKNOWN_LOCK = lock-shaped but unresolvable (suppresses rather
         than fabricates racecheck findings)."""
@@ -1008,6 +1072,26 @@ class Project:
             or attr in ("_idle",)
         )
         if len(parts) == 1:
+            # a bare local bound from a field/param chain (`lock =
+            # self._mu; with lock:`): resolve the BINDING's qname to its
+            # owner's lock role, so these scopes stop degrading to the
+            # UNKNOWN lockset (which both hides dirty accesses and
+            # excludes clean ones from majority inference)
+            bound = (local or {}).get(attr)
+            if bound is not None:
+                role = self.module_lock_roles.get(bound)
+                if role is None and "." in bound:
+                    owner_q, _, leaf = bound.rpartition(".")
+                    owner = self.classes.get(owner_q)
+                    if owner is not None:
+                        role = owner.lock_roles.get(leaf)
+                if role is not None:
+                    # a REBOUND alias (the name is stored more than
+                    # once) resolved a lock role through its LAST
+                    # binding — earlier with-blocks may hold a
+                    # different lock, so suppress rather than credit
+                    # the wrong role
+                    return _UNKNOWN_LOCK if attr in rebound else role
             role = self.module_lock_roles.get(f"{mod.dotted}.{attr}")
             if role is not None:
                 return role
@@ -1097,6 +1181,14 @@ class Project:
             fn.accesses.append((q, kind, node.lineno, frozenset(held)))
 
         def entry(reason: str, expr) -> None:
+            # a bare name may be a locally-defined function (the
+            # committer's commit_loop): its symbol lives under this
+            # function's `<locals>` scope, not the module scope
+            if isinstance(expr, ast.Name):
+                scoped = f"{fn.qname}.<locals>.{expr.id}"
+                if scoped in self.symbols:
+                    self.thread_entries.setdefault(scoped, reason)
+                    return
             q = self._resolve_expr(mod, expr, fn.cls, local, types)
             if q is not None and q in self.symbols:
                 self.thread_entries.setdefault(q, reason)
@@ -1187,7 +1279,8 @@ class Project:
                         if item.optional_vars is not None:
                             note_target(item.optional_vars)
                         role = self._role_of_ctx(
-                            mod, item.context_expr, ci, types
+                            mod, item.context_expr, ci, types, local,
+                            getattr(fn, "_rebound", ()),
                         )
                         if role is not None:
                             held.append(role)

@@ -46,9 +46,34 @@ an identical trip ledger.
 
 Every fired fault is recorded in a process-wide TRIP LEDGER
 (:func:`trips`), queryable by tests and drained via conftest like the
-threadwatch ledger: :func:`use_plan` clears it on exit, and the
-session-end gate asserts no plan is still armed and no trips were left
-unexamined.
+threadwatch ledger: :func:`use_plan` drains its own plan's trips on
+exit, and the session-end gate asserts no plan is still armed and no
+trips were left unexamined.
+
+PR 8 additions (the faultfuzz substrate):
+
+- **Registry**: every point consulted while a plan is armed
+  self-registers its name, kind (point/write/io/guard), and a bounded
+  sample of its ctx keys/values; :func:`registry` snapshots it and
+  :func:`observe` arms an empty "observer" plan so a discovery run of a
+  workload enumerates the full injectable surface without firing
+  anything.  The unarmed fast path is untouched — still a global load
+  and an ``is None`` test.
+- **guard points + ``skip``**: :func:`guard` marks an operation the
+  code performs FOR safety (recovery truncation, verify-on-import); a
+  tripped ``skip`` rule returns False and the caller skips the guarded
+  operation — lineage-style "what if this protection were missing"
+  injection, the seeded oracle violations faultfuzz shrinks.
+- **``skew``**: jumps the ``devtools.clockskew`` clock by ``skew_s``
+  (wall additionally by ``skew_wall_s`` when given) at the fault point —
+  deterministic clock skew mid-operation under a virtual clock.
+- **Nesting**: entering :func:`use_plan` while another plan is armed
+  (soak + a test-local plan) arms the inner plan, restores the OUTER
+  plan — trigger state intact — on exit, and drains only the inner
+  plan's trips; every trip record carries its plan's ``label``.
+- **Soak**: ``FABRIC_TPU_SOAK=<seed>`` (or ``use_plan(soak_plan(seed))``)
+  arms a low-probability background plan whose wildcard rules
+  (``"point": "*"`` / ``"rpc.*"`` prefixes) cover the whole registry.
 """
 
 from __future__ import annotations
@@ -58,9 +83,11 @@ import json
 import os
 import random
 import threading
-import time
+
+from fabric_tpu.devtools import clockskew
 
 _ENV = "FABRIC_TPU_FAULTLINE"
+_SOAK_ENV = "FABRIC_TPU_SOAK"
 
 
 class PlanError(ValueError):
@@ -101,15 +128,27 @@ _ERRORS = {
     "DeviceUnavailable": DeviceUnavailable,
 }
 
-_ACTIONS = ("raise", "crash", "delay", "torn", "partial")
+_ACTIONS = ("raise", "crash", "delay", "torn", "partial", "skip", "skew")
 
 # the armed plan; point()/io()/write() fast paths test ONLY this global
 _plan = None
 _state_lock = threading.Lock()
 
-# process-wide trip ledger (survives deactivate; use_plan drains it)
+# process-wide trip ledger (survives deactivate; use_plan drains its own
+# plan's entries).  _trip_owners runs parallel to _trips carrying the
+# recording Plan's id() so nested use_plan scopes drain only their own
+# trips — the ids never appear in the public records (they are not
+# deterministic across runs; the plan LABEL is, and is public).
 _trips: list[dict] = []
+_trip_owners: list[int] = []
 _trips_lock = threading.Lock()
+
+# live fault-point registry: name -> {"kinds": set, "ctx": {key: set of
+# sample values}}.  Populated ONLY while a plan (or observer) is armed,
+# so the unarmed hot path stays a global load + None test.
+_registry: dict[str, dict] = {}
+_registry_lock = threading.Lock()
+_CTX_SAMPLES = 8  # bounded per-key value samples (fuzzer targeting)
 
 # plan consultations — stays 0 while no plan is armed, which is the
 # acceptance test for "every fault point is a no-op when unset"
@@ -145,9 +184,12 @@ class _Rule:
         try:
             self.delay_s = float(spec.get("delay_s", 0.01))
             self.cut = float(spec.get("cut", 0.5))
+            self.skew_s = float(spec.get("skew_s", 5.0))
+            raw_wall = spec.get("skew_wall_s")
+            self.skew_wall_s = None if raw_wall is None else float(raw_wall)
         except (TypeError, ValueError):
             raise PlanError(
-                f"fault #{index}: delay_s/cut must be numbers"
+                f"fault #{index}: delay_s/cut/skew_s must be numbers"
             ) from None
         if not 0.0 <= self.cut <= 1.0:
             raise PlanError(f"fault #{index}: cut must be in [0, 1]")
@@ -195,6 +237,20 @@ class _Rule:
     def matches(self, ctx: dict) -> bool:
         return all(ctx.get(k) == v for k, v in self.ctx.items())
 
+    @property
+    def wildcard(self) -> bool:
+        return self.point == "*" or self.point.endswith(".*")
+
+    def matches_point(self, name: str) -> bool:
+        """Wildcard point matching: ``*`` hits every point, a trailing
+        ``.*`` matches the dotted prefix — how a soak plan covers the
+        whole registry without enumerating it."""
+        if self.point == "*":
+            return True
+        if self.point.endswith(".*"):
+            return name.startswith(self.point[:-1])
+        return name == self.point
+
     def fire(self) -> bool:
         """Count a matching hit and decide whether this rule's trigger
         fires on it (caller holds the plan lock).  Does NOT record the
@@ -212,12 +268,19 @@ class _Rule:
         return True
 
     def execute(self):
-        """Perform the point-level action: raise, crash, or delay.
-        torn/partial reached through a bare point() cannot honor their
-        data-level semantics, so they degrade to a loud raise."""
+        """Perform the point-level action: raise, crash, delay, or skew.
+        torn/partial/skip reached through a point that cannot honor
+        their semantics degrade to a loud raise."""
         if self.action == "delay":
             if self.delay_s > 0:
-                time.sleep(self.delay_s)
+                # through the clockskew seam: under a virtual clock an
+                # injected delay advances time instead of sleeping
+                clockskew.sleep(self.delay_s)
+            return
+        if self.action == "skew":
+            # jump the virtual clock mid-operation (no-op on the system
+            # clock — real time cannot be skewed; the trip still lands)
+            clockskew.advance(self.skew_s, self.skew_wall_s)
             return
         if self.action == "crash":
             raise FaultCrash(self.message)
@@ -234,10 +297,30 @@ class _Rule:
         return max(0, min(n - 1, int(n * self.cut)))
 
 
-class Plan:
-    """A parsed, armed fault schedule."""
+def _register(name: str, kind: str, ctx: dict) -> None:
+    """Self-registration at first (and every) armed hit: the fuzzer's
+    view of the injectable surface.  Bounded ctx value sampling gives
+    the generator concrete targets (e.g. commit.stage stage=pvt)."""
+    with _registry_lock:
+        ent = _registry.get(name)
+        if ent is None:
+            ent = _registry[name] = {"kinds": set(), "ctx": {}}
+        ent["kinds"].add(kind)
+        for k, v in ctx.items():
+            if not isinstance(v, (str, int, bool)):
+                continue
+            vals = ent["ctx"].setdefault(k, set())
+            if len(vals) < _CTX_SAMPLES:
+                vals.add(v)
 
-    def __init__(self, spec):
+
+class Plan:
+    """A parsed, armed fault schedule.  ``label`` (optional in the
+    spec, default ``plan:<seed>``) tags every trip this plan records —
+    how soak-background trips and test-local trips stay attributable
+    when plans nest."""
+
+    def __init__(self, spec, _allow_empty: bool = False):
         if isinstance(spec, (str, bytes)):
             try:
                 spec = json.loads(spec)
@@ -249,33 +332,73 @@ class Plan:
             self.seed = int(spec.get("seed", 0))
         except (TypeError, ValueError):
             raise PlanError("plan seed must be an integer") from None
+        self.label = spec.get("label", f"plan:{self.seed}")
+        if not isinstance(self.label, str) or not self.label:
+            raise PlanError("plan label must be a non-empty string")
+        # registry feeding is opt-out: a session-long soak plan would
+        # otherwise pay a registry-lock acquire + dict mutation on EVERY
+        # hit for data only fuzz discovery ever reads
+        self.register_points = bool(spec.get("register", True))
         faults = spec.get("faults")
-        if not isinstance(faults, list) or not faults:
+        if faults is None and _allow_empty:
+            faults = []
+        if not isinstance(faults, list) or (not faults and not _allow_empty):
             raise PlanError("plan must carry a non-empty 'faults' list")
         self.rules: list[_Rule] = [
             _Rule(i, fs, self.seed) for i, fs in enumerate(faults)
         ]
         self._by_point: dict[str, list[_Rule]] = {}
+        self._wild: list[_Rule] = []
         for r in self.rules:
-            self._by_point.setdefault(r.point, []).append(r)
+            if r.wildcard:
+                self._wild.append(r)
+            else:
+                self._by_point.setdefault(r.point, []).append(r)
+        # merged exact+wildcard rule list per point name, memoized on
+        # first hit: the rule set is static for the plan's lifetime,
+        # and a session-long soak plan must not pay a sort per hit
+        self._merged: dict[str, list[_Rule]] = {}
         self._lock = threading.Lock()
 
-    def visit(self, name: str, ctx: dict):
+    @classmethod
+    def observer(cls) -> "Plan":
+        """A rule-less plan: arming it turns every fault point into a
+        registry-feeding no-op — the discovery pass behind
+        :func:`observe`."""
+        return cls({"seed": 0, "label": "observe"}, _allow_empty=True)
+
+    def visit(self, name: str, ctx: dict, kind: str = "point"):
         """Consult the schedule for one hit of `name`; returns the
         tripped rule (trip already recorded in the ledger) or None.
         EVERY matching rule counts the hit — a later rule's nth/every
         trigger must not drift just because an earlier rule fired on
         the same hit; when several fire at once the first in plan
         order wins and only it records a trip."""
+        if self.register_points:
+            _register(name, kind, ctx)
         winner = None
         with self._lock:
             _lookups[0] += 1
-            for r in self._by_point.get(name, ()):
+            if self._wild:
+                rules = self._merged.get(name)
+                if rules is None:
+                    extra = [
+                        r for r in self._wild if r.matches_point(name)
+                    ]
+                    rules = sorted(
+                        [*self._by_point.get(name, ()), *extra],
+                        key=lambda r: r.index,
+                    )
+                    self._merged[name] = rules
+            else:
+                rules = self._by_point.get(name, ())
+            for r in rules:
                 if r.matches(ctx) and r.fire() and winner is None:
                     winner = r
             if winner is not None:
                 winner.trips += 1
                 rec = {
+                    "plan": self.label,
                     "point": name,
                     "action": winner.action,
                     "rule": winner.index,
@@ -286,6 +409,7 @@ class Plan:
                     rec["ctx"] = dict(ctx)
                 with _trips_lock:
                     _trips.append(rec)
+                    _trip_owners.append(id(self))
         return winner
 
 
@@ -304,6 +428,26 @@ def point(name: str, **ctx) -> None:
         r.execute()
 
 
+def guard(name: str, **ctx) -> bool:
+    """A guarded-operation fault point: the caller performs a SAFETY
+    operation (recovery truncation, verify-on-import, an fsync gate)
+    only when this returns True.  No plan armed: always True, same
+    fast path as :func:`point`.  A tripped ``skip`` rule returns False
+    — the injected absence of a protection, which the faultfuzz
+    invariant oracle must then catch; any other tripped action
+    executes as usual."""
+    p = _plan
+    if p is None:
+        return True
+    r = p.visit(name, ctx, kind="guard")
+    if r is None:
+        return True
+    if r.action == "skip":
+        return False
+    r.execute()
+    return True
+
+
 def write(name: str, fh, *chunks: bytes, **ctx) -> None:
     """File-write fault point: honors torn-write-then-crash.  No plan:
     writes the chunks straight through (no concatenation, no copy).  A
@@ -316,7 +460,7 @@ def write(name: str, fh, *chunks: bytes, **ctx) -> None:
         for c in chunks:
             fh.write(c)
         return
-    r = p.visit(name, ctx)
+    r = p.visit(name, ctx, kind="write")
     if r is None:
         for c in chunks:
             fh.write(c)
@@ -358,7 +502,7 @@ class _FaultSocket:
         p = _plan
         if p is None:
             return None
-        return p.visit(f"{self._fl_name}.{kind}", {})
+        return p.visit(f"{self._fl_name}.{kind}", {}, kind="io")
 
     def recv(self, bufsize: int, *args):
         r = self._fl_visit("read")
@@ -433,6 +577,56 @@ def trips() -> list[dict]:
 def reset_trips() -> None:
     with _trips_lock:
         _trips.clear()
+        _trip_owners.clear()
+
+
+def _drain_plan(p: Plan) -> None:
+    """Remove exactly the trips `p` recorded (nesting-safe: an outer
+    plan's trips survive an inner use_plan scope's exit)."""
+    with _trips_lock:
+        keep = [
+            (t, o) for t, o in zip(_trips, _trip_owners) if o != id(p)
+        ]
+        _trips[:] = [t for t, _ in keep]
+        _trip_owners[:] = [o for _, o in keep]
+
+
+def drain_trips(label: str) -> list[dict]:
+    """Remove (and return) every trip recorded under plans with this
+    label — how a soaked test session clears background-plan residue
+    between tests without touching test-local plans' trips."""
+    with _trips_lock:
+        drained = [t for t in _trips if t.get("plan") == label]
+        keep = [
+            (t, o) for t, o in zip(_trips, _trip_owners)
+            if t.get("plan") != label
+        ]
+        _trips[:] = [t for t, _ in keep]
+        _trip_owners[:] = [o for _, o in keep]
+    return drained
+
+
+def registry() -> dict[str, dict]:
+    """Snapshot of the live fault-point registry: every point name
+    consulted while a plan (or observer) was armed, with the kinds it
+    was hit as and bounded per-key ctx value samples — the surface the
+    faultfuzz generator enumerates."""
+    with _registry_lock:
+        return {
+            name: {
+                "kinds": sorted(ent["kinds"]),
+                "ctx": {
+                    k: sorted(vs, key=repr)
+                    for k, vs in sorted(ent["ctx"].items())
+                },
+            }
+            for name, ent in sorted(_registry.items())
+        }
+
+
+def reset_registry() -> None:
+    with _registry_lock:
+        _registry.clear()
 
 
 def activate(plan) -> Plan:
@@ -454,25 +648,98 @@ def deactivate() -> None:
 @contextlib.contextmanager
 def use_plan(plan):
     """Arm a plan for a scope and DRAIN on exit: the plan is disarmed
-    and the trip ledger cleared, so the conftest session gate (which
-    asserts no armed plan and an empty ledger) stays green for every
-    test that keeps its chaos inside this context."""
-    p = activate(plan)
+    and ITS trips removed from the ledger, so the conftest session gate
+    (which asserts no armed plan and an empty ledger) stays green for
+    every test that keeps its chaos inside this context.
+
+    Nesting/re-arm semantics (the soak + test-local composition): if a
+    plan is already armed on entry, the inner plan WINS for the scope —
+    every point consults only it — and the outer plan is restored on
+    exit with its trigger state intact (hit counters, rng position, and
+    its already-recorded trips all survive; trips are attributed per
+    plan via their ``label``)."""
+    p = plan if isinstance(plan, Plan) else Plan(plan)
+    with _state_lock:
+        global _plan
+        outer, _plan = _plan, p
     try:
         yield p
     finally:
-        deactivate()
-        reset_trips()
+        with _state_lock:
+            _plan = outer
+        _drain_plan(p)
+
+
+@contextlib.contextmanager
+def observe():
+    """Arm a rule-less observer plan for a scope: every fault point hit
+    self-registers (name, kind, ctx samples) into :func:`registry` and
+    nothing ever fires — the discovery pass a fuzzer runs over its
+    workload to enumerate the injectable surface.  Same save/restore
+    nesting semantics as :func:`use_plan`."""
+    with use_plan(Plan.observer()) as p:
+        yield p
+
+
+def soak_plan(seed: int, label: str = "soak") -> dict:
+    """A low-probability background plan over the WHOLE registry
+    (wildcard points), benign by construction: tiny seeded delays that
+    perturb scheduling/timing everywhere without breaking any
+    correctness contract — the tier-1 soak workload must finish with a
+    green invariant oracle under it.  Armed via ``FABRIC_TPU_SOAK=
+    <seed>`` or ``use_plan(soak_plan(seed))``."""
+    return {
+        "seed": int(seed),
+        "label": label,
+        # a session-long background plan skips registry feeding (pure
+        # per-hit overhead for data only fuzz discovery consumes)
+        "register": False,
+        "faults": [
+            # a whisper of latency anywhere, occasionally
+            {"point": "*", "action": "delay", "delay_s": 0.0002,
+             "prob": 0.02, "count": 2000},
+            # commit stages see a slightly hotter rate: the lock-order
+            # and group-flush seams are where timing bugs hide
+            {"point": "commit.stage", "action": "delay", "delay_s": 0.001,
+             "prob": 0.05, "count": 500},
+            # io wrappers stay installed for the whole run (io() only
+            # wraps while armed), so socket paths get coverage too
+            {"point": "rpc.*", "action": "delay", "delay_s": 0.0002,
+             "prob": 0.02, "count": 500},
+        ],
+    }
+
+
+# the plan _init_from_env armed (FABRIC_TPU_FAULTLINE wins over
+# FABRIC_TPU_SOAK) — consumers (the conftest session gate) must key off
+# THIS, not re-parse the environment, or they re-derive the precedence
+# wrong
+_env_plan: Plan | None = None
+
+
+def session_env_plan() -> Plan | None:
+    """The plan the environment armed at import, if any."""
+    return _env_plan
 
 
 def _init_from_env() -> None:
+    global _env_plan
     raw = os.environ.get(_ENV, "")
-    if not raw or raw in ("0", "false", "off"):
+    if raw and raw not in ("0", "false", "off"):
+        if raw.startswith("@"):
+            with open(raw[1:], "r", encoding="utf-8") as f:
+                raw = f.read()
+        _env_plan = activate(raw)
         return
-    if raw.startswith("@"):
-        with open(raw[1:], "r", encoding="utf-8") as f:
-            raw = f.read()
-    activate(raw)
+    soak = os.environ.get(_SOAK_ENV, "")
+    if soak and soak not in ("0", "false", "off"):
+        try:
+            seed = int(soak)
+        except ValueError:
+            raise PlanError(
+                f"{_SOAK_ENV} must be an integer seed, got {soak!r}"
+            ) from None
+        _env_plan = activate(soak_plan(seed))
 
 
 _init_from_env()
@@ -485,6 +752,7 @@ __all__ = [
     "DeviceUnavailable",
     "Plan",
     "point",
+    "guard",
     "write",
     "io",
     "is_crash",
@@ -493,7 +761,13 @@ __all__ = [
     "lookup_count",
     "trips",
     "reset_trips",
+    "drain_trips",
+    "registry",
+    "reset_registry",
     "activate",
     "deactivate",
     "use_plan",
+    "observe",
+    "soak_plan",
+    "session_env_plan",
 ]

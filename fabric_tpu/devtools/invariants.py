@@ -1,0 +1,331 @@
+"""invariants — the reusable consistency oracle behind chaos fuzzing.
+
+PR 6/7 asserted recovery correctness with per-test asserts; faultfuzz
+needs the same judgments as DATA, reusable across thousands of generated
+fault schedules.  Each check here returns a list of :class:`Violation`
+records (empty = invariant holds) instead of raising, so a fuzzing
+campaign can attribute failures to plans, shrink them, and serialize the
+verdict into a repro artifact.
+
+The checks (the consistency contracts the ledger/snapshot stack already
+documents, PR 1/2/6 — now machine-checkable):
+
+- **chain**: every block below the advertised height is readable from
+  the store, numbered contiguously, hash-chained (``previous_hash`` =
+  the previous header's hash), and the store's ``last_block_hash``
+  matches the tail — the block-file-first invariant made observable (a
+  skipped recovery truncation or an index pointing into torn bytes
+  surfaces here as an unreadable/mischained block).
+- **heights**: ``durable_height`` ≤ ``height`` = block-store height,
+  with the state savepoint at ``height - 1`` — and, fed a sequence of
+  watermark samples from the workload, ``durable_height`` monotonicity.
+- **workload state**: given the per-block write model the workload
+  committed, state/history must agree with the RECOVERED height h:
+  every modeled write below h present (with its history entry at
+  ``(n, 0)``), every write at or above h absent — torn state is a
+  violation regardless of where recovery landed.
+- **snapshot**: a completed snapshot directory must verify
+  (``verify_snapshot``); a torn/partial staging directory must REFUSE
+  to verify (the export-side tamper contract).
+- **import**: a channel whose snapshot-import marker is mid-flight must
+  refuse to open; a completed import must agree with the source
+  snapshot's state records byte-for-byte.
+- **breaker**: TPUCSP circuit-breaker metrics sanity (state is a known
+  value, counters non-negative and ordered).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    """One broken invariant: which check, and a human-readable detail
+    (deterministic content only — repro artifacts embed these)."""
+
+    check: str
+    detail: str
+
+    def as_dict(self) -> dict:
+        return {"check": self.check, "detail": self.detail}
+
+    def __str__(self) -> str:  # pragma: no cover - repr convenience
+        return f"[{self.check}] {self.detail}"
+
+
+# -- chain integrity ----------------------------------------------------------
+
+
+def check_chain(ledger) -> list[Violation]:
+    from fabric_tpu import protoutil
+
+    out: list[Violation] = []
+    height = ledger.height
+    prev = None
+    for num in range(height):
+        try:
+            blk = ledger.get_block_by_number(num)
+        except Exception as exc:
+            out.append(Violation(
+                "chain",
+                f"block {num} unreadable below height {height}: "
+                f"{type(exc).__name__}: {exc}",
+            ))
+            return out
+        if blk is None:
+            # snapshot-bootstrapped ledgers legitimately have no blocks
+            # below their bootstrap height
+            boot = getattr(ledger.block_store, "bootstrap_height", 0)
+            if num < boot:
+                continue
+            out.append(Violation(
+                "chain", f"block {num} missing below height {height}"
+            ))
+            return out
+        if blk.header.number != num:
+            out.append(Violation(
+                "chain",
+                f"block at index {num} carries number {blk.header.number}",
+            ))
+            return out
+        if prev is not None and blk.header.previous_hash != \
+                protoutil.block_header_hash(prev.header):
+            out.append(Violation(
+                "chain", f"hash chain broken between {num - 1} and {num}"
+            ))
+            return out
+        if prev is None and num > 0:
+            # the first present block after a snapshot bootstrap: its
+            # previous_hash must anchor on the bootstrap record's hash,
+            # or the oracle would be blind at exactly the
+            # join-by-snapshot seam
+            boot_hash = getattr(
+                ledger.block_store, "bootstrap_hash", b""
+            )
+            if boot_hash and blk.header.previous_hash != boot_hash:
+                out.append(Violation(
+                    "chain",
+                    f"block {num} does not chain onto the snapshot "
+                    "bootstrap hash",
+                ))
+                return out
+        prev = blk
+    if prev is not None:
+        tail = protoutil.block_header_hash(prev.header)
+        if ledger.block_store.last_block_hash != tail:
+            out.append(Violation(
+                "chain",
+                "store last_block_hash disagrees with the tail header "
+                f"at height {height}",
+            ))
+    return out
+
+
+# -- heights & durability -----------------------------------------------------
+
+
+def check_heights(ledger, watermarks=None) -> list[Violation]:
+    out: list[Violation] = []
+    height = ledger.height
+    durable = getattr(ledger, "durable_height", height)
+    if durable > height:
+        out.append(Violation(
+            "heights", f"durable_height {durable} > height {height}"
+        ))
+    if height != ledger.block_store.height:
+        out.append(Violation(
+            "heights",
+            f"ledger height {height} != block store height "
+            f"{ledger.block_store.height}",
+        ))
+    sp = ledger.state_db.savepoint()
+    if height > 0:
+        if sp is None:
+            out.append(Violation(
+                "heights", f"no state savepoint at height {height}"
+            ))
+        elif sp.block_num != height - 1:
+            out.append(Violation(
+                "heights",
+                f"state savepoint at block {sp.block_num}, height is "
+                f"{height}",
+            ))
+    if watermarks:
+        last = None
+        for i, w in enumerate(watermarks):
+            if last is not None and w < last:
+                out.append(Violation(
+                    "heights",
+                    f"durable_height regressed at sample {i}: "
+                    f"{last} -> {w}",
+                ))
+                break
+            last = w
+    return out
+
+
+# -- workload state/history agreement ----------------------------------------
+
+
+def check_workload_state(ledger, writes_by_block) -> list[Violation]:
+    """``writes_by_block[n]`` = [(ns, key, value)] the workload's block
+    `n` wrote.  Judged against the RECOVERED height: below it every
+    write is present with a matching history entry; at/above it absent
+    (recovery must never keep half a block)."""
+    out: list[Violation] = []
+    height = ledger.height
+    for n, writes in enumerate(writes_by_block):
+        expected_present = n < height
+        for ns, key, value in writes:
+            got = ledger.get_state(ns, key)
+            if expected_present and got != value:
+                out.append(Violation(
+                    "state",
+                    f"block {n} write {ns}/{key} expected "
+                    f"{value!r} below height {height}, got {got!r}",
+                ))
+            elif not expected_present and got is not None:
+                out.append(Violation(
+                    "state",
+                    f"block {n} write {ns}/{key} present at {got!r} "
+                    f"but block is AT/ABOVE recovered height {height}",
+                ))
+            hist = ledger.get_history_for_key(ns, key)
+            saw = [h for h in hist if h[0] == n]
+            if expected_present and not saw:
+                out.append(Violation(
+                    "history",
+                    f"no history entry for {ns}/{key} at block {n} "
+                    f"(height {height})",
+                ))
+            elif not expected_present and saw:
+                out.append(Violation(
+                    "history",
+                    f"history entry {saw} for {ns}/{key} above the "
+                    f"recovered height {height}",
+                ))
+    return out
+
+
+# -- snapshots ---------------------------------------------------------------
+
+
+def check_snapshot_verifies(snapshot_dir: str, csp=None) -> list[Violation]:
+    """A COMPLETED snapshot directory must verify."""
+    from fabric_tpu.ledger import snapshot as snap
+
+    try:
+        snap.verify_snapshot(snapshot_dir, csp=csp)
+    except Exception as exc:
+        return [Violation(
+            "snapshot",
+            f"completed snapshot {os.path.basename(snapshot_dir)!r} "
+            f"fails verification: {type(exc).__name__}: {exc}",
+        )]
+    return []
+
+
+def check_completed_snapshots(snapshots_root: str, csp=None) -> list[Violation]:
+    """Every snapshot under <root>/completed/ must verify — staging
+    (in_progress/) directories are exempt: a crash may legitimately
+    leave torn files there, and verify_snapshot REFUSING them is the
+    contract (see check_snapshot_rejected)."""
+    out: list[Violation] = []
+    completed = os.path.join(snapshots_root, "completed")
+    if not os.path.isdir(completed):
+        return out
+    for lid in sorted(os.listdir(completed)):
+        ldir = os.path.join(completed, lid)
+        for h in sorted(os.listdir(ldir)):
+            out.extend(check_snapshot_verifies(os.path.join(ldir, h), csp))
+    return out
+
+
+def check_snapshot_rejected(snapshot_dir: str, csp=None) -> list[Violation]:
+    """The inverse contract: a tampered/torn directory must NOT verify
+    — verification succeeding on it is the violation."""
+    from fabric_tpu.ledger import snapshot as snap
+
+    try:
+        snap.verify_snapshot(snapshot_dir, csp=csp)
+    except Exception:
+        return []
+    return [Violation(
+        "snapshot",
+        f"torn/tampered snapshot {os.path.basename(snapshot_dir)!r} "
+        "passed verification",
+    )]
+
+
+def check_import_state(ledger, snapshot_dir: str) -> list[Violation]:
+    """A COMPLETED import must agree with the source snapshot's state
+    records byte-for-byte: the imported ledger's raw export stream must
+    contain every (key, value) record of the snapshot's public + hashed
+    files (capped at 5 reported mismatches)."""
+    from fabric_tpu.ledger import snapshot as snap
+
+    out: list[Violation] = []
+    imported = dict(ledger.state_db.export_records())
+    for fname in (snap.PUBLIC_STATE_FILE, snap.PVT_HASHES_FILE):
+        path = os.path.join(snapshot_dir, fname)
+        if not os.path.isfile(path):
+            continue
+        for raw_key, raw_val in snap.read_records(path):
+            if imported.get(raw_key) != raw_val:
+                out.append(Violation(
+                    "import",
+                    f"imported ledger disagrees with snapshot record "
+                    f"{raw_key!r} from {fname}",
+                ))
+                if len(out) >= 5:
+                    return out
+    return out
+
+
+# -- TPU breaker sanity -------------------------------------------------------
+
+
+def check_breaker(csp) -> list[Violation]:
+    """Degraded-mode circuit-breaker sanity on a TPUCSP (or anything
+    exposing its metrics shape); no-op for providers without one."""
+    out: list[Violation] = []
+    breaker = getattr(csp, "_breaker", None)
+    if breaker is None:
+        return out
+    state = getattr(breaker, "state", None)
+    if state not in ("open", "closed", None):
+        out.append(Violation("breaker", f"unknown breaker state {state!r}"))
+    for name in ("trips", "failures", "probes"):
+        v = getattr(breaker, name, 0)
+        if isinstance(v, int) and v < 0:
+            out.append(Violation("breaker", f"negative counter {name}={v}"))
+    return out
+
+
+# -- aggregate ----------------------------------------------------------------
+
+
+def check_ledger(ledger, writes_by_block=None,
+                 watermarks=None) -> list[Violation]:
+    """The standard post-chaos judgment over one reopened ledger."""
+    out = check_chain(ledger)
+    out.extend(check_heights(ledger, watermarks))
+    if writes_by_block is not None:
+        out.extend(check_workload_state(ledger, writes_by_block))
+    return out
+
+
+__all__ = [
+    "Violation",
+    "check_chain",
+    "check_heights",
+    "check_workload_state",
+    "check_snapshot_verifies",
+    "check_completed_snapshots",
+    "check_snapshot_rejected",
+    "check_import_state",
+    "check_breaker",
+    "check_ledger",
+]

@@ -25,7 +25,7 @@ import struct
 import threading
 
 from fabric_tpu.comm.backoff import DecorrelatedBackoff
-from fabric_tpu.devtools import faultline
+from fabric_tpu.devtools import clockskew, faultline
 from fabric_tpu.devtools.lockwatch import named_lock, spawn_thread
 from fabric_tpu.protos.gossip import message_pb2 as gpb
 
@@ -298,8 +298,10 @@ class TCPGossipComm(GossipComm):
                     except OSError:
                         sock = None
                         # gossip is loss-tolerant: wait out the backoff
-                        # window here (messages queue or drop meanwhile)
-                        self._stop.wait(bo.next())
+                        # window here (messages queue or drop meanwhile);
+                        # through the clockskew seam like every other
+                        # reconnect wait in the comm stack
+                        clockskew.wait(self._stop, bo.next())
                         break
                 try:
                     sock.sendall(_LEN.pack(len(data)) + data)
@@ -316,7 +318,7 @@ class TCPGossipComm(GossipComm):
                     sock = None
                     # same window as a failed dial — without this, a
                     # connect-ok-send-fail peer is redialed per message
-                    self._stop.wait(bo.next())
+                    clockskew.wait(self._stop, bo.next())
 
     # -- inbound -----------------------------------------------------------
 

@@ -15,20 +15,8 @@ import os
 import shutil
 
 from fabric_tpu.ledger.blkstorage import BlockStore
-from fabric_tpu.ledger.kvstore import open_kvstore
+from fabric_tpu.ledger.kvstore import open_kvstore, wipe_prefix
 from fabric_tpu.ledger.kvledger import LedgerProvider
-
-
-def _wipe_prefix(kv, prefix: bytes) -> None:
-    p = bytearray(prefix)
-    while p and p[-1] == 0xFF:
-        p.pop()
-    end = None
-    if p:
-        p[-1] += 1
-        end = bytes(p)
-    keys = [k for k, _ in kv.iterate(prefix, end)]
-    kv.write_batch({}, deletes=keys)
 
 
 def _derived_prefixes(ledger_id: str) -> list[bytes]:
@@ -81,7 +69,7 @@ def rebuild_dbs(root_dir: str, ledger_id: str | None = None) -> list[str]:
             _check_not_snapshot_bootstrapped(kv, lid, "rebuild-dbs")
         for lid in ids:
             for p in _derived_prefixes(lid):
-                _wipe_prefix(kv, p)
+                wipe_prefix(kv, p)
     finally:
         kv.close()
     return ids
@@ -108,18 +96,18 @@ def rollback(root_dir: str, ledger_id: str, target_block: int) -> int:
         if os.path.isdir(tmp_dir):
             shutil.rmtree(tmp_dir)
         tmp_name = f"{ledger_id}.rollback"
-        _wipe_prefix(kv, _index_prefix(tmp_name))
+        wipe_prefix(kv, _index_prefix(tmp_name))
         store2 = BlockStore(tmp_dir, kv, name=tmp_name)
         for n in range(target_block + 1):
             store2.add_block(store.get_block_by_number(n))
-        _wipe_prefix(kv, _index_prefix(ledger_id))
-        _wipe_prefix(kv, _index_prefix(tmp_name))
+        wipe_prefix(kv, _index_prefix(ledger_id))
+        wipe_prefix(kv, _index_prefix(tmp_name))
         shutil.rmtree(chains_dir)
         os.rename(tmp_dir, chains_dir)
         # reindex under the real name from the swapped files
         store3 = BlockStore(chains_dir, kv, name=ledger_id)
         for p in _derived_prefixes(ledger_id):
-            _wipe_prefix(kv, p)
+            wipe_prefix(kv, p)
         return store3.height
     finally:
         kv.close()

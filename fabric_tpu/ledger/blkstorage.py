@@ -126,8 +126,17 @@ class BlockStore:
                     self._height = blk.header.number + 1
                     scanned.add(file_idx)
             if offset < size:
-                with open(path, "r+b") as f:
-                    f.truncate(offset)
+                # guard-style fault point: a faultfuzz "skip" rule
+                # deletes this protection, leaving the torn tail in
+                # place — the next O_APPEND write then lands AFTER the
+                # garbage while the index records the pre-garbage
+                # offset, exactly the corruption the invariant oracle
+                # must catch (the seeded-violation acceptance case)
+                if faultline.guard(
+                    "blkstorage.recovery_truncate", file=file_idx
+                ):
+                    with open(path, "r+b") as f:
+                        f.truncate(offset)
                 scanned.add(file_idx)
             next_path = self._file_path(file_idx + 1)
             if os.path.exists(next_path):
@@ -233,6 +242,15 @@ class BlockStore:
         exist locally and can never be replayed — repair ops must refuse
         to truncate through it (ledger/admin.py)."""
         return _bsi_height(self._index.get(_BSI_KEY))
+
+    @property
+    def bootstrap_hash(self) -> bytes:
+        """The snapshot's last block hash recorded at bootstrap (b""
+        when not bootstrapped) — the chain anchor the first appended
+        block's previous_hash must match (the invariant oracle checks
+        the join-by-snapshot seam against this)."""
+        raw = self._index.get(_BSI_KEY)
+        return raw[8:] if raw is not None else b""
 
     def bootstrap(
         self,

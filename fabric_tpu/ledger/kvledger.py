@@ -699,6 +699,20 @@ class LedgerProvider:
     def open(self, ledger_id: str) -> KVLedger:
         if ledger_id in self._ledgers:
             return self._ledgers[ledger_id]
+        from fabric_tpu.ledger import snapshot as snap
+
+        # a crashed join-by-snapshot leaves the stores holding an
+        # arbitrary prefix of the snapshot (bootstrap info without
+        # state, or state without config history) — refuse LOUDLY
+        # instead of opening a channel whose reads would silently
+        # disagree with the chain it claims to be at
+        if snap.import_marker(self._kv, ledger_id) == \
+                snap.IMPORT_IN_PROGRESS:
+            raise snap.SnapshotError(
+                f"channel {ledger_id!r} has a half-finished snapshot "
+                "import (the importing process crashed); run "
+                "discard_failed_import() and re-join from the snapshot"
+            )
         block_dir = (
             None if self._root is None else os.path.join(self._root, ledger_id, "chains")
         )
@@ -735,6 +749,12 @@ class LedgerProvider:
             raise snap.SnapshotError(
                 f"ledger {ledger_id!r} already exists"
             )
+        if snap.import_marker(self._kv, ledger_id) == \
+                snap.IMPORT_IN_PROGRESS:
+            raise snap.SnapshotError(
+                f"channel {ledger_id!r} has a half-finished snapshot "
+                "import; run discard_failed_import() before re-joining"
+            )
         block_dir = (
             None if self._root is None
             else os.path.join(self._root, ledger_id, "chains")
@@ -751,6 +771,59 @@ class LedgerProvider:
         self._wire_snapshots(ledger)
         self._ledgers[ledger_id] = ledger
         return ledger
+
+    # every per-channel namespace mounted on the shared KV store — the
+    # discard sweep below must cover ALL of them, or a retried import
+    # would land on residue (bookkeeping is a two-level namespace:
+    # bookkeeping/<lid>/<category>)
+    _CHANNEL_NAMESPACES = (
+        "blkindex/{lid}", "statedb/{lid}", "historydb/{lid}",
+        "pvtdata/{lid}", "confighistory/{lid}", "transient/{lid}",
+        "bookkeeping/{lid}/", "snapimport/{lid}",
+    )
+
+    def discard_failed_import(self, ledger_id: str) -> int:
+        """Clear the debris of a CRASHED snapshot import so the channel
+        can re-join (the recovery path the half-import refusal points
+        operators at).  Deliberately narrow: refuses unless the
+        channel's import marker is IMPORT_IN_PROGRESS — this is a
+        crashed-import cleanup, not a general channel-delete.  Sweeps
+        every per-channel namespace off the shared KV store (the marker
+        goes LAST, so a crash mid-discard leaves the channel still
+        refused, and the discard itself is re-runnable) and removes the
+        channel's block-file directory.  Returns the number of KV keys
+        deleted."""
+        from fabric_tpu.ledger import snapshot as snap
+        from fabric_tpu.ledger.kvstore import NamedDB, wipe_prefix
+
+        if snap.import_marker(self._kv, ledger_id) != \
+                snap.IMPORT_IN_PROGRESS:
+            raise snap.SnapshotError(
+                f"channel {ledger_id!r} has no half-finished snapshot "
+                "import to discard"
+            )
+        deleted = 0
+        marker_prefix = (
+            f"snapimport/{ledger_id}".encode() + NamedDB._SEP
+        )
+        for ns in self._CHANNEL_NAMESPACES:
+            name = ns.format(lid=ledger_id)
+            # bookkeeping/<lid>/ spans its categories' namespaces, so
+            # the raw name (sans separator) is the scan prefix there
+            prefix = name.encode() if name.endswith("/") else (
+                name.encode() + NamedDB._SEP
+            )
+            if prefix == marker_prefix:
+                continue  # the marker falls last, below
+            deleted += wipe_prefix(self._kv, prefix)
+        if self._root is not None:
+            chain_dir = os.path.join(self._root, ledger_id)
+            if os.path.isdir(chain_dir):
+                import shutil
+
+                shutil.rmtree(chain_dir)
+        NamedDB(self._kv, f"snapimport/{ledger_id}").delete(b"state")
+        return deleted
 
     @property
     def kv(self):

@@ -320,6 +320,17 @@ def _prefix_end(prefix: bytes) -> bytes | None:
     return None
 
 
+def wipe_prefix(store: KVStore, prefix: bytes) -> int:
+    """Delete every key under `prefix` in one batch; returns the count.
+    THE range-delete helper — ledger admin repair ops and the crashed-
+    import discard both sweep namespaces through it, so the 0xFF-carry
+    end-key logic lives in exactly one place."""
+    keys = [k for k, _ in store.iterate(prefix, _prefix_end(prefix))]
+    if keys:
+        store.write_batch({}, deletes=keys)
+    return len(keys)
+
+
 def open_kvstore(path: str | None) -> KVStore:
     """None/':memory:' -> MemKVStore, else sqlite at path."""
     if path in (None, ":memory:"):
@@ -334,4 +345,5 @@ __all__ = [
     "NamedDB",
     "WriteBatchCollector",
     "open_kvstore",
+    "wipe_prefix",
 ]

@@ -45,6 +45,7 @@ import struct
 import threading
 import time
 
+from fabric_tpu.devtools import faultline
 from fabric_tpu.devtools.lockwatch import (
     guarded,
     named_condition,
@@ -56,7 +57,7 @@ from fabric_tpu.ledger.bookkeeping import (
     BookkeepingProvider,
 )
 from fabric_tpu.ledger.confighistory import ConfigHistoryMgr
-from fabric_tpu.ledger.kvstore import KVStore
+from fabric_tpu.ledger.kvstore import KVStore, NamedDB
 from fabric_tpu.ledger.pvtdatastorage import PvtDataStore
 from fabric_tpu.ledger.txmgmt import key_hash
 from fabric_tpu.ledger.statedb import Height, VersionedDB
@@ -287,13 +288,22 @@ def generate_snapshot(
                     continue  # confirmed cleartext private: never export
             out = hash_f if len(parts) == 3 and parts[1] == "hash" else pub_f
             _write_record(out, raw_key, raw_val)
+    # export stage fault points (ROADMAP faultline gap): a crash at any
+    # of these leaves only the in_progress/ staging directory — the
+    # atomic-rename contract says completed/ never holds a partial
+    # snapshot, which the faultfuzz oracle verifies
+    faultline.point("snapshot.export.stage", stage="state", channel=lid)
     write_records(
         os.path.join(work, TXIDS_FILE),
         ((t.encode(), b"") for t in store.export_txids()),
     )
+    faultline.point("snapshot.export.stage", stage="txids", channel=lid)
     write_records(
         os.path.join(work, CONFIG_HISTORY_FILE),
         ledger.config_history.export_entries(),
+    )
+    faultline.point(
+        "snapshot.export.stage", stage="confighistory", channel=lid
     )
     cfg_raw = store.config_block_bytes()
     if cfg_raw is None:
@@ -305,8 +315,12 @@ def generate_snapshot(
         cfg_raw = blk0.SerializeToString()
     with open(os.path.join(work, CONFIG_BLOCK_FILE), "wb") as f:
         f.write(cfg_raw)
+    faultline.point(
+        "snapshot.export.stage", stage="config_block", channel=lid
+    )
 
     files = _hash_files(work, DATA_FILES, csp, metrics, channel=lid)
+    faultline.point("snapshot.export.stage", stage="hash", channel=lid)
     last_blk = store.get_block_by_number(last_num)
     sp = state.savepoint()
     last_hash = getattr(ledger, "durable_block_hash", None)
@@ -332,8 +346,16 @@ def generate_snapshot(
         "files": files,
     }
     with open(_metadata_path(work), "wb") as f:
-        f.write(json.dumps(meta, sort_keys=True, indent=2).encode())
+        # torn-manifest seam: a "torn" rule writes a strict prefix of
+        # the signable metadata and crashes — verify_snapshot must then
+        # refuse the staging directory (truncated JSON, missing digests)
+        faultline.write(
+            "snapshot.manifest", f,
+            json.dumps(meta, sort_keys=True, indent=2).encode(),
+            channel=lid,
+        )
 
+    faultline.point("snapshot.export.stage", stage="rename", channel=lid)
     os.makedirs(os.path.dirname(final_dir), exist_ok=True)
     os.replace(work, final_dir)
     if metrics is not None:
@@ -375,6 +397,18 @@ def verify_snapshot(snapshot_dir: str, csp=None) -> dict:
     return meta
 
 
+IMPORT_IN_PROGRESS = b"in_progress"
+IMPORT_DONE = b"done"
+
+
+def import_marker(kv: KVStore, ledger_id: str) -> bytes | None:
+    """The channel's snapshot-import completion marker: None (never
+    imported), IMPORT_IN_PROGRESS (a crashed half-import — the stores
+    hold an arbitrary prefix of the snapshot and must NOT be served),
+    or IMPORT_DONE."""
+    return NamedDB(kv, f"snapimport/{ledger_id}").get(b"state")
+
+
 def import_snapshot(
     meta: dict, snapshot_dir: str, store, kv: KVStore, ledger_id: str
 ) -> None:
@@ -382,17 +416,32 @@ def import_snapshot(
     block-store bootstrap info + txid index, state DB (public + hashed,
     savepoint at the snapshot height so recovery replays nothing),
     config history, and the pvt store's bootstrap marker.  The caller
-    then constructs the KVLedger over the same stores."""
+    then constructs the KVLedger over the same stores.
+
+    Crash safety: an IMPORT_IN_PROGRESS marker lands FIRST and flips to
+    IMPORT_DONE only after every store is populated — a crash anywhere
+    between (the faultline stage points below inject exactly those)
+    leaves the marker mid-flight, and LedgerProvider.open refuses to
+    serve the half-imported channel instead of silently opening partial
+    state."""
+    marker = NamedDB(kv, f"snapimport/{ledger_id}")
+    marker.put(b"state", IMPORT_IN_PROGRESS)
     last_num = int(meta["last_block_number"])
     with open(os.path.join(snapshot_dir, CONFIG_BLOCK_FILE), "rb") as f:
         cfg_raw = f.read()
     store.bootstrap(
         last_num, bytes.fromhex(meta["last_block_hash"]), config_block=cfg_raw
     )
+    faultline.point(
+        "snapshot.import.stage", stage="bootstrap", channel=ledger_id
+    )
     store.import_snapshot_txids(
         k.decode() for k, _ in read_records(
             os.path.join(snapshot_dir, TXIDS_FILE)
         )
+    )
+    faultline.point(
+        "snapshot.import.stage", stage="txids", channel=ledger_id
     )
 
     def state_records():
@@ -403,13 +452,20 @@ def import_snapshot(
     savepoint = Height(sp[0], sp[1]) if sp else Height(last_num, 0)
     state = VersionedDB(kv, f"statedb/{ledger_id}")
     state.import_records(state_records(), savepoint)
+    faultline.point(
+        "snapshot.import.stage", stage="state", channel=ledger_id
+    )
     for ns, specs in (meta.get("index_defs") or {}).items():
         for spec in specs:
             state.define_index(ns, spec)
     ConfigHistoryMgr(kv, ledger_id).import_entries(
         read_records(os.path.join(snapshot_dir, CONFIG_HISTORY_FILE))
     )
+    faultline.point(
+        "snapshot.import.stage", stage="confighistory", channel=ledger_id
+    )
     PvtDataStore(kv, ledger_id).init_bootstrap_height(last_num + 1)
+    marker.put(b"state", IMPORT_DONE)
 
 
 # -- manager -----------------------------------------------------------------
@@ -633,6 +689,9 @@ __all__ = [
     "generate_snapshot",
     "verify_snapshot",
     "import_snapshot",
+    "import_marker",
+    "IMPORT_IN_PROGRESS",
+    "IMPORT_DONE",
     "load_metadata",
     "read_records",
     "write_records",

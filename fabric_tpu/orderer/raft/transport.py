@@ -30,9 +30,8 @@ import queue
 import socket
 import struct
 import threading
-import time
 
-from fabric_tpu.comm.backoff import DecorrelatedBackoff
+from fabric_tpu.comm.backoff import BackoffGate
 from fabric_tpu.common.flogging import must_get_logger
 from fabric_tpu.devtools import faultline
 from fabric_tpu.devtools.lockwatch import spawn_thread
@@ -107,11 +106,10 @@ class OutboundConn:
         self._down_episode = False   # contiguous link-down drops (_run)
         # seeded from stable local+peer identity, never wall-clock:
         # deterministic per process, decorrelated ACROSS the peers of a
-        # downed node (see DecorrelatedBackoff.for_key)
-        self._backoff = DecorrelatedBackoff.for_key(
-            f"{local_key}->{addr!r}"
-        )
-        self._dial_gate = 0.0  # monotonic time before which dials wait
+        # downed node (see DecorrelatedBackoff.for_key); the gate reads
+        # its clock through devtools.clockskew, so a virtual clock (or
+        # an injected skew) moves the dial windows deterministically
+        self._gate = BackoffGate.for_key(f"{local_key}->{addr!r}")
         self._thread = spawn_thread(
             target=self._run, name="raft-dial", kind="service"
         )
@@ -183,24 +181,23 @@ class OutboundConn:
             except queue.Empty:
                 continue
             if self._sock is None:
-                now = time.monotonic()
-                if now < self._dial_gate:
+                if not self._gate.ready():
                     self._drop_down()  # backoff window open: peer down
                     continue
                 self._sock = self._connect()
                 if self._sock is None:
                     # arm the next dial window; messages arriving
                     # before it drop fast instead of re-dialing
-                    self._dial_gate = now + self._backoff.next()
+                    self._gate.arm()
                     self._drop_down()
                     continue
-                self._dial_gate = 0.0
+                self._gate.clear()
             try:
                 self._sock.sendall(_LEN.pack(len(data)) + data)
                 # only a COMPLETED send proves the link: resetting on
                 # connect alone would let an accept-then-reset peer
                 # restart the backoff sequence every flap
-                self._backoff.reset()
+                self._gate.reset()
                 self._down_episode = False
             except OSError:
                 try:
@@ -214,7 +211,7 @@ class OutboundConn:
                 # success reset the backoff, but the link was NOT
                 # proven: only a completed send is)
                 self._drop_down()
-                self._dial_gate = time.monotonic() + self._backoff.next()
+                self._gate.arm()
 
     def close(self) -> None:
         self._stop.set()

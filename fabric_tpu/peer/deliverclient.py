@@ -17,9 +17,8 @@ import collections
 import random
 import threading
 
-from fabric_tpu.devtools import faultline
+from fabric_tpu.devtools import clockskew, faultline
 from fabric_tpu.devtools.lockwatch import spawn_thread
-import time
 
 from fabric_tpu.orderer.blockwriter import verify_block_signature
 from fabric_tpu.protos.common import common_pb2
@@ -106,7 +105,10 @@ class DeliverClient:
                 # action=delay rules here to count reconnects)
                 faultline.point("deliver.reconnect")
             self.backoff_log.append(backoff)
-            if self._stop.wait(backoff):
+            # through the clockskew seam: a virtual clock turns this
+            # reconnect wait into a deterministic clock advance, so the
+            # whole rotation/backoff cycle runs with no real sleeps
+            if clockskew.wait(self._stop, backoff):
                 return
             backoff = min(backoff * 2, self._max_backoff)
 

@@ -1,0 +1,109 @@
+#!/usr/bin/env python
+"""CI wrapper around faultfuzz: run an N-plan chaos campaign (seeded
+plan generation over the live fault-point registry, the invariant
+oracle as the judge, shrinking + replayable repro artifacts for every
+failure) and emit one JSON summary line in the same shape the bench and
+lint scripts use, so the driver/CI can scrape `"experiment":
+"faultfuzz"` next to those lines.
+
+Usage: python scripts/chaos.py [--plans N] [--seed S] [--blocks B]
+       [--out DIR] [--no-shrink] [--no-comm] [--replay FILE]
+
+Exit code: nonzero when ANY plan's oracle verdict is a failure (each
+one has been shrunk and written as a replayable repro JSON under --out,
+default .faultfuzz/, which is gitignored).  `--replay FILE` re-arms a
+repro artifact over a fresh workload directory instead of running a
+campaign: exit 0 when the failure REPRODUCES (the artifact is good),
+nonzero when it does not.
+
+A fixed (--seed, --plans) campaign is deterministic: two runs produce
+identical verdicts and canonical trip ledgers (the printed line carries
+a sha256 over the canonical trip ledger so CI can diff determinism
+cheaply across runs).
+"""
+
+import argparse
+import hashlib
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from fabric_tpu.devtools import faultfuzz  # noqa: E402
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--plans", type=int, default=25,
+                    help="number of generated plans (default 25)")
+    ap.add_argument("--seed", type=int, default=7,
+                    help="campaign seed (default 7)")
+    ap.add_argument("--blocks", type=int, default=faultfuzz.DEFAULT_BLOCKS,
+                    help="single-block commits in the canned workload")
+    ap.add_argument("--out", default=".faultfuzz", metavar="DIR",
+                    help="repro-artifact directory (default .faultfuzz)")
+    ap.add_argument("--no-shrink", action="store_true",
+                    help="skip plan minimization on failures")
+    ap.add_argument("--no-comm", action="store_true",
+                    help="skip the rpc traffic phase of the workload")
+    ap.add_argument("--replay", default=None, metavar="FILE",
+                    help="re-arm a repro artifact instead of fuzzing; "
+                         "exit 0 iff the failure reproduces")
+    args = ap.parse_args()
+
+    t0 = time.perf_counter()
+    if args.replay:
+        import shutil
+        import tempfile
+
+        workdir = tempfile.mkdtemp(prefix="faultfuzz-replay-")
+        try:
+            res = faultfuzz.replay(args.replay, workdir)
+        finally:
+            shutil.rmtree(workdir, ignore_errors=True)
+        out = {
+            "experiment": "faultfuzz-replay",
+            "artifact": args.replay,
+            "reproduced": bool(res["violations"]),
+            "violations": res["violations"],
+            "trips": len(res["trips"]),
+            "seconds": round(time.perf_counter() - t0, 4),
+        }
+        print(json.dumps(out))
+        return 0 if res["violations"] else 1
+
+    campaign = faultfuzz.Campaign(
+        seed=args.seed, plans=args.plans, blocks=args.blocks,
+        out_dir=args.out, shrink=not args.no_shrink,
+        comm=not args.no_comm,
+    )
+    summary = campaign.run()
+    ledger_digest = hashlib.sha256(
+        json.dumps(summary["trip_ledger"], sort_keys=True).encode()
+    ).hexdigest()
+    out = {
+        "experiment": "faultfuzz",
+        "seed": summary["seed"],
+        "plans": summary["plans"],
+        "blocks": summary["blocks"],
+        "registry_points": summary["registry_points"],
+        "failures": summary["failures"],
+        "verdicts": summary["verdicts"],
+        "trips_total": summary["trips_total"],
+        "trip_ledger_sha256": ledger_digest,
+        "repro": summary["repro"],
+        "seconds": round(time.perf_counter() - t0, 4),
+    }
+    print(json.dumps(out))
+    for path in summary["repro"]:
+        print(f"faultfuzz: repro artifact written: {path}",
+              file=sys.stderr)
+    return 1 if summary["failures"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -101,6 +101,23 @@ def _threadwatch_drain_gate():
     )
 
 
+@pytest.fixture(autouse=True)
+def _soak_residue_drain():
+    """Under an ENV-ARMED session plan (``FABRIC_TPU_SOAK``, or a
+    session-wide ``FABRIC_TPU_FAULTLINE``) the background plan fires
+    across EVERY test — drain its trips between tests so tests
+    asserting on the trip ledger see their own plans' trips, not
+    accumulated background residue.  Keys off the plan faultline
+    actually armed (which encodes the FAULTLINE-beats-SOAK precedence),
+    never a re-parse of the environment.  A no-op in unarmed runs."""
+    yield
+    from fabric_tpu.devtools import faultline
+
+    env_plan = faultline.session_env_plan()
+    if env_plan is not None and faultline.current_plan() is env_plan:
+        faultline.drain_trips(env_plan.label)
+
+
 @pytest.fixture(scope="session", autouse=True)
 def _faultline_drain_gate():
     """Fail the session if a fault plan is still armed or the trip
@@ -108,10 +125,38 @@ def _faultline_drain_gate():
     faultline.use_plan, which disarms and clears the ledger on exit —
     a plan leaking past its test would silently inject faults into
     every later test, and unexamined trips mean a test fired faults it
-    never asserted on (the same teeth as the threadwatch drain gate)."""
+    never asserted on (the same teeth as the threadwatch drain gate).
+
+    Exception: an ENV-ARMED session plan (``FABRIC_TPU_SOAK=<seed>``,
+    or a session-wide ``FABRIC_TPU_FAULTLINE``) deliberately stays
+    armed for the WHOLE session (tier-1 as a chaos soak) — exactly that
+    plan is expected to still be armed here and its background trips
+    are drained, not asserted on; test-local plans nested inside it
+    still drain themselves via use_plan.  Identity is checked against
+    ``faultline.session_env_plan()`` (the plan _init_from_env actually
+    armed, encoding the FAULTLINE-beats-SOAK precedence), never a
+    re-parse of the environment."""
     yield
     from fabric_tpu.devtools import faultline
 
+    env_plan = faultline.session_env_plan()
+    if env_plan is not None:
+        plan = faultline.current_plan()
+        assert plan is env_plan, (
+            "an environment plan was armed for this session but the "
+            f"plan at session end is {plan.label if plan else None!r} — "
+            "a chaos test leaked a plan over it (use faultline.use_plan)"
+        )
+        stray = [
+            t for t in faultline.trips() if t["plan"] != env_plan.label
+        ]
+        assert not stray, (
+            f"undrained non-background faultline trips at session end: "
+            f"{stray!r}"
+        )
+        faultline.deactivate()
+        faultline.reset_trips()
+        return
     assert not faultline.active(), (
         "a faultline plan is still armed at session end — a chaos test "
         "leaked its plan (use faultline.use_plan)"

@@ -1,0 +1,29 @@
+"""CLEAN TWIN of fix_race_closure_dirty: the closure thread target
+takes the guard lock around its write, so every access site agrees."""
+
+from fabric_tpu.devtools.lockwatch import named_lock, spawn_thread
+
+
+class StreamPump:
+    def __init__(self):
+        self._lock = named_lock("fixture.pump")
+        self._done = {}
+
+    def start(self):
+        def pump_loop():
+            with self._lock:
+                self._done["n"] = 1
+
+        t = spawn_thread(
+            target=pump_loop, name="fixture-pump", kind="worker"
+        )
+        t.start()
+        return t
+
+    def mark(self):
+        with self._lock:
+            self._done["m"] = 2
+
+    def poll(self):
+        with self._lock:
+            return self._done.get("n")

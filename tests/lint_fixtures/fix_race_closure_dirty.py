@@ -1,0 +1,30 @@
+"""SEEDED VIOLATION (racecheck): the spawned thread target is a
+LOCALLY-DEFINED closure — invisible to the lockset pass until PR 8
+resolved nested defs into the thread-entry set (the committer's
+commit_loop pattern).  Its unguarded write must fire."""
+
+from fabric_tpu.devtools.lockwatch import named_lock, spawn_thread
+
+
+class StreamPump:
+    def __init__(self):
+        self._lock = named_lock("fixture.pump")
+        self._done = {}
+
+    def start(self):
+        def pump_loop():
+            self._done["n"] = 1  # <- racecheck fires HERE
+
+        t = spawn_thread(
+            target=pump_loop, name="fixture-pump", kind="worker"
+        )
+        t.start()
+        return t
+
+    def mark(self):
+        with self._lock:
+            self._done["m"] = 2
+
+    def poll(self):
+        with self._lock:
+            return self._done.get("n")

@@ -1,0 +1,32 @@
+"""CLEAN TWIN of fix_race_lockvar_dirty: the bare local alias binds the
+CORRECT guard lock — the alias resolves to the field's role, so the
+scope counts as guarded instead of degrading to UNKNOWN."""
+
+from fabric_tpu.devtools.lockwatch import named_lock, spawn_thread
+
+
+class SessionTable:
+    def __init__(self):
+        self._lock = named_lock("fixture.sessions")
+        self._aux = named_lock("fixture.sessions.aux")
+        self._sessions = {}
+
+    def start(self):
+        t = spawn_thread(
+            target=self._expire, name="fixture-expire", kind="worker"
+        )
+        t.start()
+        return t
+
+    def _expire(self):
+        lock = self._lock
+        with lock:
+            self._sessions["expired"] = True
+
+    def put(self, key, value):
+        with self._lock:
+            self._sessions[key] = value
+
+    def get(self, key):
+        with self._lock:
+            return self._sessions.get(key)

@@ -1,0 +1,34 @@
+"""SEEDED VIOLATION (racecheck): the worker takes the WRONG lock
+through a bare local alias (``lock = self._aux; with lock:``).  Before
+PR 8 a lock-shaped local degraded to the UNKNOWN lockset, which
+suppressed this finding; resolving the alias through its binding shows
+the held lock is not the field's guard."""
+
+from fabric_tpu.devtools.lockwatch import named_lock, spawn_thread
+
+
+class SessionTable:
+    def __init__(self):
+        self._lock = named_lock("fixture.sessions")
+        self._aux = named_lock("fixture.sessions.aux")
+        self._sessions = {}
+
+    def start(self):
+        t = spawn_thread(
+            target=self._expire, name="fixture-expire", kind="worker"
+        )
+        t.start()
+        return t
+
+    def _expire(self):
+        lock = self._aux
+        with lock:
+            self._sessions["expired"] = True  # <- racecheck fires HERE
+
+    def put(self, key, value):
+        with self._lock:
+            self._sessions[key] = value
+
+    def get(self, key):
+        with self._lock:
+            return self._sessions.get(key)

@@ -153,7 +153,9 @@ def test_torn_file_append_truncated_on_reopen(tmp_path):
     with faultline.use_plan(plan):
         with pytest.raises(faultline.FaultCrash, match="torn write"):
             ledger.commit(blk2)
-        [trip] = faultline.trips()
+        # label filter: under FABRIC_TPU_SOAK the pre-plan commits leave
+        # background delay trips in the ledger
+        [trip] = [t for t in faultline.trips() if t["plan"] != "soak"]
         assert trip["point"] == "blkstorage.file_append"
     provider.close()
 
@@ -220,7 +222,9 @@ def test_same_seed_same_trip_ledger_across_runs(tmp_path):
                 ledger.commit(
                     _write_block(ledger, n, [("cc", f"k{n}", b"v")])
                 )
-            observed = faultline.trips()
+            observed = [
+                t for t in faultline.trips() if t["plan"] != "soak"
+            ]
         provider.close()
         return observed
 

@@ -1,0 +1,200 @@
+"""Clock-skew seam tests (ISSUE 8 tentpole): the virtual clock drives
+every timeout-bearing comm layer deterministically — backoff gates open
+on clock jumps (including faultline ``skew`` rules), rpc idle windows
+compress through io_timeout scaling, and the deliver client's whole
+rotation/backoff cycle runs with no real sleeps."""
+
+import socket
+import threading
+import time
+
+import pytest
+
+from fabric_tpu.comm.backoff import BackoffGate, DecorrelatedBackoff
+from fabric_tpu.comm.rpc import KeepaliveOptions, RPCClient, RPCServer
+from fabric_tpu.devtools import clockskew, faultline
+from fabric_tpu.protos.common import common_pb2
+
+
+# -- the provider contract ----------------------------------------------------
+
+
+def test_system_clock_is_the_default():
+    assert clockskew.installed() is None
+    t0 = clockskew.monotonic()
+    assert abs(t0 - time.monotonic()) < 1.0
+    assert clockskew.io_timeout(30.0) == 30.0
+    assert clockskew.io_timeout(None) is None
+
+
+def test_virtual_clock_monotonic_never_regresses_wall_may():
+    with clockskew.use_virtual(clockskew.VirtualClock(start=100.0,
+                                                      wall=5000.0)) as clk:
+        assert clockskew.monotonic() == 100.0
+        clockskew.advance(-50.0)  # monotonic ignores the regression...
+        assert clockskew.monotonic() == 100.0
+        assert clockskew.wall() == 4950.0  # ...wall takes the NTP step
+        clockskew.advance(10.0, wall_dt=-10.0)
+        assert clockskew.monotonic() == 110.0
+        assert clockskew.wall() == 4940.0
+        # sleeps advance instead of blocking, and are recorded
+        t0 = time.monotonic()
+        clockskew.sleep(3600.0)
+        assert time.monotonic() - t0 < 0.5
+        assert clk.sleeps == [3600.0]
+        assert clockskew.monotonic() == 3710.0
+    assert clockskew.installed() is None  # restored on exit
+
+
+def test_virtual_wait_advances_and_yields():
+    ev = threading.Event()
+    with clockskew.use_virtual() as clk:
+        t0 = time.monotonic()
+        assert clockskew.wait(ev, 30.0) is False
+        assert time.monotonic() - t0 < 0.5
+        assert clk.sleeps == [30.0]
+        ev.set()
+        assert clockskew.wait(ev, 30.0) is True
+        assert clk.sleeps == [30.0]  # a set event consumes no time
+
+
+def test_io_timeout_scaling_floors_at_10ms():
+    with clockskew.use_virtual(
+        clockskew.VirtualClock(timeout_scale=0.005)
+    ):
+        assert clockskew.io_timeout(30.0) == pytest.approx(0.15)
+        assert clockskew.io_timeout(0.5) == pytest.approx(0.01)
+        assert clockskew.io_timeout(None) is None
+
+
+# -- backoff gate -------------------------------------------------------------
+
+
+def test_backoff_gate_opens_on_clock_jump_not_real_time():
+    with clockskew.use_virtual():
+        gate = BackoffGate.for_key("node-a->peer:7050", base=0.5, cap=2.0)
+        assert gate.ready()  # never armed
+        wait = gate.arm()
+        assert 0.5 <= wait <= 2.0
+        assert not gate.ready()  # window armed, clock frozen
+        clockskew.advance(wait / 2)
+        assert not gate.ready()
+        clockskew.advance(wait)  # past the window
+        assert gate.ready()
+        gate.arm()
+        gate.clear()  # successful dial: window closes, jitter keeps going
+        assert gate.ready()
+
+
+def test_backoff_gate_reset_replays_jitter_sequence():
+    b = DecorrelatedBackoff(base=0.05, cap=1.0, seed=9)
+    gate = BackoffGate(b)
+    with clockskew.use_virtual():
+        first = [gate.arm() for _ in range(5)]
+        gate.reset()
+        assert [gate.arm() for _ in range(5)] == first
+        assert gate.ready() is False  # the last arm left a window
+        gate.reset()
+        assert gate.ready()
+
+
+def test_faultline_skew_rule_opens_backoff_gate():
+    """A plan-injected clock jump at a fault point deterministically
+    ends a backoff window — no sleeps, no monkeypatching."""
+    with clockskew.use_virtual():
+        gate = BackoffGate.for_key("x->y", base=0.5, cap=2.0)
+        gate.arm()
+        assert not gate.ready()
+        with faultline.use_plan({"faults": [
+            {"point": "test.skew", "action": "skew", "skew_s": 60.0},
+        ]}):
+            faultline.point("test.skew")
+            [trip] = faultline.trips()
+            assert trip["action"] == "skew"
+            assert gate.ready()  # the 60s jump swallowed the window
+
+
+# -- rpc idle reaping under a compressed clock --------------------------------
+
+
+def test_rpc_idle_timeout_reaps_in_compressed_time():
+    """A connected-but-silent client is reaped after the idle window —
+    30 virtual seconds, ~150ms real under timeout_scale=0.005."""
+    ka = KeepaliveOptions(idle_timeout=30.0)
+    srv = RPCServer(keepalive=ka)
+    srv.register("echo", lambda body, stream: body)
+    srv.start()
+    try:
+        with clockskew.use_virtual(
+            clockskew.VirtualClock(timeout_scale=0.005)
+        ):
+            sock = socket.create_connection(srv.addr, timeout=5.0)
+            try:
+                deadline = time.monotonic() + 5.0
+                while time.monotonic() < deadline and \
+                        srv.connection_count == 0:
+                    time.sleep(0.01)
+                assert srv.connection_count == 1
+                # send NOTHING: the scaled 150ms idle window reaps us
+                deadline = time.monotonic() + 5.0
+                while time.monotonic() < deadline and \
+                        srv.connection_count > 0:
+                    time.sleep(0.02)
+                assert srv.connection_count == 0
+            finally:
+                sock.close()
+        # and a real request still works at full speed afterwards
+        assert RPCClient(*srv.addr).call("echo", b"ok") == b"ok"
+    finally:
+        srv.stop()
+
+
+# -- deliver client: the whole backoff cycle with no real sleeps --------------
+
+
+def _block(num: int) -> common_pb2.Block:
+    blk = common_pb2.Block()
+    blk.header.number = num
+    return blk
+
+
+def test_deliver_backoff_cycle_without_real_sleeps():
+    """Under a virtual clock the reconnect waits become clock advances:
+    injected stream failures walk the backoff to its cap and back to
+    the floor after a delivered block, in a fraction of the >1.5
+    virtual seconds the waits add up to."""
+    from fabric_tpu.peer.deliverclient import DeliverClient
+
+    committed = []
+
+    def endpoint(start):
+        for n in range(start, 3):
+            yield _block(n)
+
+    dc = DeliverClient(
+        "ch", [endpoint], height_fn=lambda: len(committed),
+        sink=lambda seq, raw: committed.append(seq), max_backoff_s=0.8,
+    )
+    t0 = time.monotonic()
+    with clockskew.use_virtual() as clk:
+        with faultline.use_plan({"faults": [
+            {"point": "deliver.read", "action": "raise",
+             "error": "OSError", "every": 1, "count": 5},
+        ]}):
+            dc.start()
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline and len(committed) < 3:
+                time.sleep(0.01)
+            dc.stop()
+            assert len([t for t in faultline.trips()
+                        if t["point"] == "deliver.read"]) == 5
+    elapsed = time.monotonic() - t0
+    assert committed == [0, 1, 2]
+    # the virtual clock recorded EVERY reconnect wait in order (the
+    # client's own backoff_log is a bounded deque the caught-up polling
+    # laps churn through): five consecutive failures walk 0.1 -> 0.2 ->
+    # 0.4 -> cap 0.8 -> 0.8, then delivery resets to the 0.1 floor
+    assert clk.sleeps[:5] == [0.1, 0.2, 0.4, 0.8, 0.8]
+    assert 0.1 in clk.sleeps[5:]
+    assert sum(clk.sleeps) >= 2.0  # >2 virtual seconds of waiting...
+    assert elapsed < 8.0           # ...in well under that real time

@@ -32,6 +32,11 @@ def test_unset_means_zero_plan_lookups_on_hot_commit_path(tmp_path):
     """Acceptance: with no plan armed, every fault point on the commit
     path is a no-op — not one plan lookup happens, io() returns the
     socket unchanged, and nothing lands in the trip ledger."""
+    if faultline.active():
+        pytest.skip(
+            "a session-wide plan is armed (FABRIC_TPU_SOAK) — the "
+            "zero-overhead contract only applies to unarmed sessions"
+        )
     assert not faultline.active()
     before = faultline.lookup_count()
     provider = LedgerProvider(str(tmp_path))
@@ -80,6 +85,7 @@ def test_env_activation_inline_and_file(tmp_path, monkeypatch):
     plan = {"faults": [{"point": "env.x", "action": "delay",
                         "delay_s": 0.0}]}
     monkeypatch.setattr(faultline, "_plan", None)
+    monkeypatch.setattr(faultline, "_env_plan", None)
     monkeypatch.setenv("FABRIC_TPU_FAULTLINE", json.dumps(plan))
     faultline._init_from_env()
     assert faultline.active()
@@ -95,13 +101,17 @@ def test_env_activation_inline_and_file(tmp_path, monkeypatch):
 
 
 def test_use_plan_drains_on_exit():
+    # under FABRIC_TPU_SOAK an ambient plan is legitimately armed:
+    # use_plan must restore exactly that state and drain only its own
+    ambient = faultline.current_plan()
     with faultline.use_plan({"faults": [
         {"point": "p", "action": "delay", "delay_s": 0.0},
-    ]}):
+    ]}) as p:
         faultline.point("p")
-        assert len(faultline.trips()) == 1
-    assert not faultline.active()
-    assert faultline.trips() == []
+        own = [t for t in faultline.trips() if t["plan"] == p.label]
+        assert len(own) == 1
+    assert faultline.current_plan() is ambient
+    assert [t for t in faultline.trips() if t["plan"] == p.label] == []
 
 
 # -- triggers & actions -------------------------------------------------------
@@ -573,3 +583,172 @@ def test_dryrun_multichip_device_loss_breaker_rc0():
         f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr[-4000:]}"
     )
     assert "DEVICE-LOSS-OK" in proc.stdout
+
+
+# -- use_plan nesting / re-arm semantics (ISSUE 8 satellite) ------------------
+
+
+def test_use_plan_nesting_inner_wins_outer_restored_with_state():
+    """Soak + test-local composition: the inner plan wins for its
+    scope, trips are tagged per plan label, and the OUTER plan comes
+    back with its trigger state intact (hit counters keep counting
+    from where they were, not from zero)."""
+    ambient = faultline.current_plan()  # the soak plan, if env-armed
+    outer_plan = {"seed": 1, "label": "outer", "faults": [
+        {"point": "nest", "action": "raise", "error": "RuntimeError",
+         "message": "outer fired", "nth": 2},
+    ]}
+    with faultline.use_plan(outer_plan) as outer:
+        faultline.point("nest")  # outer hit 1: nth=2 not yet
+        with faultline.use_plan({"seed": 2, "label": "inner", "faults": [
+            {"point": "nest", "action": "delay", "delay_s": 0.0,
+             "count": 100},
+        ]}):
+            # the inner plan WINS: outer's nth=2 must not fire here
+            for _ in range(3):
+                faultline.point("nest")
+            labels = [t["plan"] for t in faultline.trips()]
+            assert labels == ["inner", "inner", "inner"]
+        # inner scope exited: its trips drained, outer restored
+        assert faultline.current_plan() is outer
+        assert faultline.trips() == []
+        with pytest.raises(RuntimeError, match="outer fired"):
+            faultline.point("nest")  # outer hit 2: nth=2 fires NOW
+        [trip] = faultline.trips()
+        assert trip["plan"] == "outer" and trip["hit"] == 2
+    assert faultline.current_plan() is ambient
+    assert faultline.trips() == []
+
+
+def test_use_plan_nested_exit_keeps_outer_trips():
+    with faultline.use_plan({"label": "outer", "faults": [
+        {"point": "keep", "action": "delay", "delay_s": 0.0,
+         "count": 10},
+    ]}):
+        faultline.point("keep")
+        with faultline.use_plan({"label": "inner", "faults": [
+            {"point": "keep2", "action": "delay", "delay_s": 0.0},
+        ]}):
+            faultline.point("keep2")
+        # ONLY the inner trips drained on its exit
+        assert [t["plan"] for t in faultline.trips()] == ["outer"]
+
+
+# -- registry + observe + guard (ISSUE 8 tentpole surface) --------------------
+
+
+def test_registry_self_registers_under_observe_and_plans():
+    faultline.reset_registry()
+    with faultline.observe():
+        faultline.point("reg.a", stage="one")
+        faultline.point("reg.a", stage="two")
+        assert faultline.guard("reg.g") is True
+        buf = io.BytesIO()
+        faultline.write("reg.w", buf, b"x")
+        a, b = socket.socketpair()
+        try:
+            wrapped = faultline.io(a, "reg.sock")
+            assert isinstance(wrapped, faultline._FaultSocket)
+            b.sendall(b"z")
+            wrapped.recv(1)
+        finally:
+            a.close()
+            b.close()
+        assert faultline.trips() == []  # observer never fires
+    reg = faultline.registry()
+    assert reg["reg.a"]["kinds"] == ["point"]
+    assert reg["reg.a"]["ctx"]["stage"] == ["one", "two"]
+    assert reg["reg.g"]["kinds"] == ["guard"]
+    assert reg["reg.w"]["kinds"] == ["write"]
+    assert reg["reg.sock.read"]["kinds"] == ["io"]
+    faultline.reset_registry()
+
+
+def test_registry_untouched_while_unarmed():
+    if faultline.active():
+        pytest.skip(
+            "a session-wide plan is armed (FABRIC_TPU_SOAK) — every "
+            "point hit registers by design"
+        )
+    faultline.reset_registry()
+    faultline.point("quiet.a")
+    assert faultline.guard("quiet.g") is True
+    assert faultline.registry() == {}
+
+
+def test_guard_skip_action_and_counts():
+    with faultline.use_plan({"faults": [
+        {"point": "g.trunc", "action": "skip", "count": 2},
+    ]}):
+        assert faultline.guard("g.trunc") is False
+        assert faultline.guard("g.trunc") is False
+        assert faultline.guard("g.trunc") is True  # count exhausted
+        assert len(faultline.trips()) == 2
+    # other actions at a guard point still execute
+    with faultline.use_plan({"faults": [
+        {"point": "g.x", "action": "raise", "error": "OSError"},
+    ]}):
+        with pytest.raises(OSError):
+            faultline.guard("g.x")
+    # a skip rule reaching a bare point() degrades to a loud raise
+    with faultline.use_plan({"faults": [
+        {"point": "g.y", "action": "skip"},
+    ]}):
+        with pytest.raises(faultline.FaultInjected, match="non-data"):
+            faultline.point("g.y")
+
+
+def test_wildcard_points_match_prefixes():
+    with faultline.use_plan({"faults": [
+        {"point": "rpc.*", "action": "delay", "delay_s": 0.0,
+         "count": 100},
+        {"point": "*", "action": "delay", "delay_s": 0.0, "nth": 3},
+    ]}):
+        faultline.point("rpc.accept")   # rpc.* trips; * counts hit 1
+        faultline.point("ledger.x")     # * hit 2
+        faultline.point("other.y")      # * hit 3: fires
+        trips = faultline.trips()
+        assert [(t["point"], t["rule"]) for t in trips] == [
+            ("rpc.accept", 0), ("other.y", 1),
+        ]
+
+
+# -- backoff edge cases (ISSUE 8 satellite) -----------------------------------
+
+
+def test_backoff_cap_saturation_never_exceeds_cap():
+    b = DecorrelatedBackoff(base=0.05, cap=0.4, seed=21)
+    seq = [b.next() for _ in range(200)]
+    assert all(0.05 <= v <= 0.4 for v in seq)
+    # the sequence SATURATES: once grown, draws keep touching the cap
+    assert seq.count(0.4) >= 3
+    # and decorrelated jitter still moves BELOW the cap afterwards
+    # (uniform(base, 3*prev) can undershoot — that is the jitter)
+    first_cap = seq.index(0.4)
+    assert any(v < 0.4 for v in seq[first_cap + 1:])
+
+
+def test_backoff_reset_after_success_is_idempotent():
+    b = DecorrelatedBackoff(base=0.05, cap=1.0, seed=5)
+    first = b.next()
+    b.reset()
+    b.reset()  # pristine: the no-op path
+    assert b.next() == first  # replays from the start
+    b.reset()
+    seq = [b.next() for _ in range(10)]
+    b.reset()
+    assert [b.next() for _ in range(10)] == seq
+
+
+def test_backoff_per_address_seeds_distinct_but_deterministic():
+    addrs = ["peer0:7050", "peer1:7050", "peer2:7050"]
+    seqs = {}
+    for addr in addrs:
+        key = f"node-a->{addr}"
+        s1 = [DecorrelatedBackoff.for_key(key).next() for _ in range(6)]
+        s2 = [DecorrelatedBackoff.for_key(key).next() for _ in range(6)]
+        assert s1 == s2  # same key: deterministic replay
+        seqs[addr] = s1
+    # distinct addresses decorrelate
+    vals = list(seqs.values())
+    assert vals[0] != vals[1] and vals[1] != vals[2] and vals[0] != vals[2]

@@ -454,3 +454,113 @@ def test_faultline_seam_keeps_reviewed_pragmas_used():
     assert any(
         v.rule == "exception-discipline" and v.suppressed for v in vs
     )
+
+
+# -- racecheck PR 8 satellites: closure thread targets + lock aliases --------
+
+
+def test_racecheck_fires_on_closure_thread_target():
+    """A locally-defined function passed to spawn_thread (the
+    committer's commit_loop shape) is a real thread entry: its
+    unguarded write fires, and the nested symbol is registered under
+    the enclosing function's <locals> scope."""
+    src, vs = _race_fixture("fix_race_closure_dirty.py")
+    lines = _fires(vs, "racecheck")
+    assert len(lines) == 1
+    assert "fires HERE" in src.splitlines()[lines[0] - 1]
+    report = lint_sources(
+        {"fabric_tpu/gossip/fix_race_closure_dirty.py": src}
+    )
+    entry = (
+        "fabric_tpu.gossip.fix_race_closure_dirty.StreamPump.start"
+        ".<locals>.pump_loop"
+    )
+    assert entry in report.project.thread_entries
+
+
+def test_racecheck_closure_clean_twin_stays_quiet():
+    assert lint_source(
+        _load("fix_race_closure_clean.py"),
+        "fabric_tpu/gossip/fix_race_closure_clean.py",
+    ) == []
+
+
+def test_racecheck_real_committer_closure_is_an_entry():
+    """The motivating case: the real Committer.store_stream commit_loop
+    closure must be on the thread-entry set (and the real tree stays
+    clean with it there — the full-tree gate in test_lint_clean covers
+    that half)."""
+    with open(
+        os.path.join(
+            os.path.dirname(FIXDIR), "..", "fabric_tpu", "peer",
+            "committer.py",
+        ), "r", encoding="utf-8",
+    ) as f:
+        src = f.read()
+    report = lint_sources({"fabric_tpu/peer/committer.py": src})
+    entry = (
+        "fabric_tpu.peer.committer.Committer.store_stream"
+        ".<locals>.commit_loop"
+    )
+    assert entry in report.project.thread_entries
+
+
+def test_racecheck_fires_on_wrong_lock_through_local_alias():
+    """``lock = self._aux; with lock:`` resolves through the local
+    binding to the WRONG lock's role — previously the lock-shaped local
+    degraded to UNKNOWN and suppressed the finding."""
+    src, vs = _race_fixture("fix_race_lockvar_dirty.py")
+    lines = _fires(vs, "racecheck")
+    assert len(lines) == 1
+    assert "fires HERE" in src.splitlines()[lines[0] - 1]
+
+
+def test_racecheck_lockvar_clean_twin_stays_quiet():
+    """The same alias shape binding the CORRECT lock counts as guarded
+    — no UNKNOWN suppression, no false positive."""
+    assert lint_source(
+        _load("fix_race_lockvar_clean.py"),
+        "fabric_tpu/gossip/fix_race_lockvar_clean.py",
+    ) == []
+
+
+def test_racecheck_rebound_lock_alias_degrades_to_unknown():
+    """A lock alias STORED TWICE is ambiguous (the binding map is
+    flow-insensitive, last write wins): the correctly guarded first
+    with-block must not be flagged just because the alias later binds a
+    different lock — rebound aliases degrade to the UNKNOWN lockset."""
+    src = (
+        "from fabric_tpu.devtools.lockwatch import named_lock, "
+        "spawn_thread\n"
+        "\n\n"
+        "class Table:\n"
+        "    def __init__(self):\n"
+        "        self._lock = named_lock('fixture.rebound')\n"
+        "        self._aux = named_lock('fixture.rebound.aux')\n"
+        "        self._rows = {}\n"
+        "        self._other = {}\n"
+        "\n"
+        "    def start(self):\n"
+        "        t = spawn_thread(target=self._work, name='w', "
+        "kind='worker')\n"
+        "        t.start()\n"
+        "        return t\n"
+        "\n"
+        "    def _work(self):\n"
+        "        lock = self._lock\n"
+        "        with lock:\n"
+        "            self._rows['a'] = 1  # correctly guarded\n"
+        "        lock = self._aux\n"
+        "        with lock:\n"
+        "            self._other['b'] = 2\n"
+        "\n"
+        "    def put(self, k, v):\n"
+        "        with self._lock:\n"
+        "            self._rows[k] = v\n"
+        "\n"
+        "    def get(self, k):\n"
+        "        with self._lock:\n"
+        "            return self._rows.get(k)\n"
+    )
+    vs = lint_source(src, "fabric_tpu/gossip/fix_rebound_inline.py")
+    assert _fires(vs, "racecheck") == []
