@@ -356,6 +356,30 @@ class SnapshotMetrics:
         ))
 
 
+class ValidateMetrics:
+    """Per-stage block-validate timing: host collect (parse + identity
+    + policy prepare, possibly fanned out over the work pool), the wait
+    on the device verify batch, and the host policy finish — the
+    validate-side counterpart of CommitMetrics, so the /metrics reader
+    can see which side of the validate->commit pipeline owns the p99."""
+
+    STAGES = ("collect", "verify_wait", "policy")
+
+    def __init__(self, provider):
+        self.stage_duration = provider.new_histogram(HistogramOpts(
+            namespace="validator",
+            subsystem="block",
+            name="stage_duration",
+            help="Seconds spent in one validate stage for one block "
+                 "(collect/verify_wait/policy).",
+            buckets=(
+                0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                0.1, 0.25, 0.5, 1.0, 2.5,
+            ),
+            statsd_format="%{channel}.%{stage}",
+        ))
+
+
 class CommitMetrics:
     """Per-stage ledger-commit pipeline timing (the group-commit
     tentpole's instrumentation): one histogram labeled (channel, stage)

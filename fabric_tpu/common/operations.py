@@ -37,6 +37,7 @@ class System:
         self._checkers: dict[str, object] = {}
         self._snapshot_metrics = None
         self._commit_metrics = None
+        self._validate_metrics = None
         self._csp_metrics = None
         self._raft_metrics = None
         self._lock = threading.Lock()
@@ -148,6 +149,19 @@ class System:
 
                 self._commit_metrics = CommitMetrics(self.metrics_provider)
             return self._commit_metrics
+
+    def validate_metrics(self):
+        """Lazily-built block-validate stage metrics (the
+        collect/verify_wait/policy split) bound to this system's
+        provider — hand it to TxValidator(metrics=...)."""
+        with self._lock:
+            if self._validate_metrics is None:
+                from fabric_tpu.common.metrics import ValidateMetrics
+
+                self._validate_metrics = ValidateMetrics(
+                    self.metrics_provider
+                )
+            return self._validate_metrics
 
     def csp_metrics(self):
         """Lazily-built TPU-CSP degraded-mode metrics (circuit-breaker

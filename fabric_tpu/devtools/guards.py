@@ -57,6 +57,11 @@ DECLARED_GUARDS: dict[str, str] = {
     # process-wide measured host verify rate (module global)
     "fabric_tpu.csp.tpu.provider._host_rate_ewma":
         "fabric_tpu.csp.tpu.provider._host_rate_lock",
+    # -- shared host work pool (PR 9 parallel collect/prepare) -------------
+    # the lazily-created process-wide executor singleton: creation and
+    # teardown race between first users and shutdown callers
+    "fabric_tpu.common.workpool._pool":
+        "fabric_tpu.common.workpool._pool_lock",
     # -- gossip membership --------------------------------------------------
     "fabric_tpu.gossip.discovery.DiscoveryCore._peers":
         "gossip.discovery.members",

@@ -96,16 +96,74 @@ class MemKVStore(KVStore):
         yield from snapshot
 
 
+_SQLITE_SYNC_LEVELS = ("OFF", "NORMAL", "FULL", "EXTRA")
+
+
+def _sqlite_sync_level(override: str | None) -> str:
+    """PRAGMA synchronous level: ctor override, else
+    FABRIC_TPU_SQLITE_SYNC, else NORMAL — the default the chaos-commit
+    crash matrix and faultfuzz campaigns run against (in WAL mode,
+    NORMAL can lose the last transactions on POWER loss but never
+    corrupts, and the block-file-first invariant makes lost KV txns
+    replayable from the file scan; FULL/EXTRA trade throughput for
+    power-loss durability, OFF is bench-sweep-only)."""
+    raw = (
+        override
+        if override is not None
+        else os.environ.get("FABRIC_TPU_SQLITE_SYNC", "")
+    ).strip().upper()
+    if not raw:
+        return "NORMAL"
+    if raw not in _SQLITE_SYNC_LEVELS:
+        raise ValueError(
+            f"FABRIC_TPU_SQLITE_SYNC={raw!r}: expected one of "
+            f"{'/'.join(_SQLITE_SYNC_LEVELS)}"
+        )
+    return raw
+
+
+def _sqlite_wal_checkpoint(override: int | None) -> int:
+    """wal_autocheckpoint page threshold: ctor override, else
+    FABRIC_TPU_WAL_CHECKPOINT, else sqlite's stock 1000.  Larger values
+    move checkpoint I/O off the commit path at the cost of a longer WAL
+    (recovery still replays it fully); 0 disables auto-checkpointing
+    entirely (operator-driven checkpoints only)."""
+    if override is not None:
+        return max(0, int(override))
+    raw = os.environ.get("FABRIC_TPU_WAL_CHECKPOINT", "").strip()
+    if not raw:
+        return 1000
+    try:
+        return max(0, int(raw))
+    except ValueError:
+        raise ValueError(
+            f"FABRIC_TPU_WAL_CHECKPOINT={raw!r} is not an integer page "
+            "count (0 disables auto-checkpointing)"
+        ) from None
+
+
 class SqliteKVStore(KVStore):
     """Durable backend. One table of BLOB key/value; WAL journaling gives
     atomic batch commits (the recovery property blkstorage/kvledger rely
-    on, reference blockfile checkpoints + leveldb atomicity)."""
+    on, reference blockfile checkpoints + leveldb atomicity).
 
-    def __init__(self, path: str):
+    Durability knobs (`python bench.py --sweep-sqlite` measures the
+    combos; the chaos crash matrix pins the default's safety):
+    `synchronous`/`FABRIC_TPU_SQLITE_SYNC` and
+    `wal_autocheckpoint`/`FABRIC_TPU_WAL_CHECKPOINT` — see
+    _sqlite_sync_level/_sqlite_wal_checkpoint."""
+
+    def __init__(self, path: str, synchronous: str | None = None,
+                 wal_autocheckpoint: int | None = None):
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         self._conn = sqlite3.connect(path, check_same_thread=False)
         self._conn.execute("PRAGMA journal_mode=WAL")
-        self._conn.execute("PRAGMA synchronous=NORMAL")
+        self.sync_level = _sqlite_sync_level(synchronous)
+        self._conn.execute(f"PRAGMA synchronous={self.sync_level}")
+        self.wal_autocheckpoint = _sqlite_wal_checkpoint(wal_autocheckpoint)
+        self._conn.execute(
+            f"PRAGMA wal_autocheckpoint={self.wal_autocheckpoint:d}"
+        )
         self._conn.execute(
             "CREATE TABLE IF NOT EXISTS kv (k BLOB PRIMARY KEY, v BLOB NOT NULL)"
         )

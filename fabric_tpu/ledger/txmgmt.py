@@ -14,8 +14,11 @@ MVCC, all signature checks already ran as one batch.
 from __future__ import annotations
 
 import dataclasses
+import time
 
+from fabric_tpu.common import workpool
 from fabric_tpu.common.hashing import sha256 as _sha256
+from fabric_tpu.devtools import faultline
 from fabric_tpu.ledger.statedb import Height, VersionedDB, VersionedValue
 from fabric_tpu.protos.ledger.rwset import rwset_pb2
 from fabric_tpu.protos.ledger.rwset.kvrwset import kv_rwset_pb2
@@ -372,12 +375,48 @@ class _TxUpdates:
     writes: dict[tuple[str, str], bytes | None]
 
 
+# a block below this many write operations prepares serially even when
+# a fan-out width is configured — chunking overhead would dominate
+_PARALLEL_MIN_WRITES = 32
+
+
 class MVCCValidator:
     """Block-level MVCC validation building the state update batch
-    (reference validation/validator.go:82 validateAndPrepareBatch)."""
+    (reference validation/validator.go:82 validateAndPrepareBatch).
 
-    def __init__(self, db: VersionedDB):
+    Structured as two passes so the write-set prepare can fan out:
+
+    1. **check** (always serial, commit order): read/range/hashed-read
+       conflict detection and the in-block version bookkeeping
+       (``updated_versions``) — the pass whose outputs feed later txs'
+       conflict checks, so it is inherently ordered.
+    2. **prepare** (parallelizable per top-level namespace): building
+       the ``{ns: {key: VersionedValue|None}}`` batch, including
+       metadata retention and cleartext-private application.  Namespaces
+       are disjoint batch keys (derived hash/pvt namespaces embed their
+       parent), so per-namespace workers never share output, and the
+       merge re-assembles the batch in the exact first-encounter
+       namespace order the serial loop would have produced — flags and
+       batch contents are byte-identical to serial at every fan-out
+       width (pinned by tests/test_parallel_commit.py).
+
+    `fanout` chunks the namespace groups across `pool` (default: the
+    process workpool); None reads FABRIC_TPU_MVCC_POOL, 0 keeps prepare
+    serial.  The bulk version preload fans out per namespace under the
+    same width."""
+
+    def __init__(self, db: VersionedDB, pool=None, fanout: int | None = None):
         self._db = db
+        self._pool = pool
+        if fanout is None:
+            fanout = workpool.stage_width("FABRIC_TPU_MVCC_POOL")
+        self._fanout = max(0, fanout)
+        # per-call stage wall seconds {preload, check, prepare} — the
+        # ledger folds these into commit_stage_seconds/os /metrics as
+        # mvcc_preload/mvcc_check/mvcc_prepare
+        self.last_stage_seconds: dict[str, float] = {}
+        # blocks whose prepare actually fanned out (smoke-test probe)
+        self.parallel_prepare_blocks = 0
 
     def _committed_version(
         self, ns: str, key: str, updates: dict, cache: dict | None = None
@@ -438,6 +477,36 @@ class MVCCValidator:
                         )
         if not keys:
             return {}
+        width = self._fanout
+        if width > 1 and len(keys) >= 2 * _PARALLEL_MIN_WRITES:
+            by_ns: dict[str, list] = {}
+            for pair in keys:
+                by_ns.setdefault(pair[0], []).append(pair)
+            if len(by_ns) >= 2:
+                # per-namespace version preload: each group is one
+                # get_state_many round-trip; the merged cache is the
+                # same mapping the single round-trip would produce
+                # (namespace is part of every key, so groups are
+                # disjoint)
+                def _load(off, chunk):
+                    out = []
+                    for pairs in chunk:
+                        faultline.point(
+                            "mvcc.ns_prepare", stage="preload",
+                            ns=pairs[0][0],
+                        )
+                        out.append(self._db.get_state_many(pairs))
+                    return out
+
+                maps = workpool.run_chunked(
+                    self._pool or workpool.default_pool(),
+                    _load, list(by_ns.values()),
+                    min(width, len(by_ns)),
+                )
+                merged: dict = {}
+                for m in maps:
+                    merged.update(m)
+                return merged
         return self._db.get_state_many(keys)
 
     def validate_and_prepare(
@@ -501,9 +570,46 @@ class MVCCValidator:
                 ]
             except Exception:
                 flags[tx_num] = BAD_RWSET
+        t = time.perf_counter
+        t0 = t()
         cache = self._preload(parsed_per_tx)
+        t1 = t()
+
+        # -- pass 1: serial conflict checks + version bookkeeping -------
+        # updated_versions carries every in-block write's version (None
+        # for deletes) — the state later txs' conflict checks read —
+        # and doubles as the "was this key written earlier in the
+        # block" oracle the metadata-write bookkeeping needs.  Work for
+        # pass 2 is grouped by TOP-LEVEL namespace (derived hash/pvt
+        # namespaces ride with their parent), and ns_order records the
+        # exact batch-key first-encounter order of the serial loop.
         updated_versions: dict[tuple[str, str], Height] = {}
-        batch: dict[str, dict[str, VersionedValue | None]] = {}
+        ns_order: list[str] = []
+        ns_owner: dict[str, str] = {}
+        groupwork: dict[str, list] = {}
+        all_items: list = []  # every group item in global (tx, entry)
+        # order — the collision fallback's single serial group
+        collided = [False]
+        n_writes = 0
+
+        def order(ns: str, owner: str) -> None:
+            # `owner` is the TOP-LEVEL group key (the parsed entry's
+            # namespace) recorded explicitly — never re-derived from the
+            # namespace string, because an adversarial rwset may name a
+            # top-level namespace that itself contains the \x00 the
+            # derived hash/pvt encodings use.  If two different groups
+            # ever claim one output namespace (a literal namespace
+            # colliding with another namespace's derived hash/pvt
+            # encoding — only constructible by an adversarial rwset),
+            # the groups are NOT disjoint and pass 2 falls back to one
+            # serial group over all items, which reproduces the old
+            # single-batch-dict semantics exactly.
+            if ns not in ns_owner:
+                ns_owner[ns] = owner
+                ns_order.append(ns)
+            elif ns_owner[ns] != owner:
+                collided[0] = True
+
         for tx_num, parsed in enumerate(parsed_per_tx):
             if parsed is None or flags[tx_num] != VALID:
                 continue
@@ -549,69 +655,192 @@ class MVCCValidator:
                 continue
             h = Height(block_num, tx_num)
             pvt_by_coll = self._parse_pvt(pvt_data.get(tx_num))
+            # cleartext authenticity is decided HERE, once: only
+            # collections whose supplied cleartext hashes to the
+            # endorsed pvt_rwset_hash survive into pvt_ok — pass 2
+            # applies them without re-hashing, forged/absent supplies
+            # are treated as missing (an empty endorsed hash means NO
+            # cleartext was endorsed, so any supply is forged)
+            pvt_ok: dict = {}
             for ns, kvrw, colls in parsed:
-                ns_batch = batch.setdefault(ns, {})
+                order(ns, ns)
+                item = (h, ns, kvrw, colls, pvt_ok)
+                groupwork.setdefault(ns, []).append(item)
+                all_items.append(item)
                 for w in kvrw.writes:
-                    updated_versions[(ns, w.key)] = h
-                    if w.is_delete:
-                        ns_batch[w.key] = None
-                        updated_versions[(ns, w.key)] = None  # type: ignore[assignment]
-                    else:
-                        # A value-only write RETAINS existing metadata
-                        # (key-level endorsement policies survive plain
-                        # puts — reference tx_ops metadata merge).
-                        ns_batch[w.key] = VersionedValue(
-                            w.value, h,
-                            self._existing_metadata(ns, w.key, ns_batch, cache),
-                        )
+                    n_writes += 1
+                    updated_versions[(ns, w.key)] = (
+                        None if w.is_delete else h  # type: ignore[assignment]
+                    )
                 for mw in kvrw.metadata_writes:
-                    self._apply_metadata_write(
-                        ns, mw.key,
-                        {e.name: bytes(e.value) for e in mw.entries},
-                        ns_batch, updated_versions, h, cache,
+                    n_writes += 1
+                    self._meta_write_version(
+                        ns, mw.key, h, updated_versions, cache
                     )
                 for coll, hrw, expected_hash in colls:
                     hns = hash_ns(ns, coll)
-                    h_batch = batch.setdefault(hns, {})
+                    order(hns, ns)
                     for hw in hrw.hashed_writes:
-                        hkey = bytes(hw.key_hash).hex()
-                        if hw.is_delete:
-                            h_batch[hkey] = None
-                            updated_versions[(hns, hkey)] = None  # type: ignore[assignment]
-                        else:
-                            h_batch[hkey] = VersionedValue(
-                                bytes(hw.value_hash), h,
-                                self._existing_metadata(
-                                    hns, hkey, h_batch, cache
-                                ),
-                            )
-                            updated_versions[(hns, hkey)] = h
-                    for mw in hrw.metadata_writes:
-                        self._apply_metadata_write(
-                            hns, bytes(mw.key_hash).hex(),
-                            {e.name: bytes(e.value) for e in mw.entries},
-                            h_batch, updated_versions, h, cache,
+                        n_writes += 1
+                        updated_versions[(hns, bytes(hw.key_hash).hex())] = (
+                            None if hw.is_delete else h  # type: ignore[assignment]
                         )
-                    # Cleartext private writes, if supplied and authentic.
-                    # An empty endorsed hash means NO cleartext rwset was
-                    # endorsed (read-only collection access) — any supply
-                    # is forged and must be rejected, not waved through.
+                    for mw in hrw.metadata_writes:
+                        n_writes += 1
+                        self._meta_write_version(
+                            hns, bytes(mw.key_hash).hex(), h,
+                            updated_versions, cache,
+                        )
                     clear = pvt_by_coll.get((ns, coll))
-                    if clear is None:
-                        continue
-                    raw_kvrw, clear_kvrw = clear
-                    if (
-                        not expected_hash
-                        or _sha256(raw_kvrw) != expected_hash
-                    ):
-                        continue  # bogus supply: treat as missing
-                    p_batch = batch.setdefault(pvt_ns(ns, coll), {})
-                    for w in clear_kvrw.writes:
-                        if w.is_delete:
-                            p_batch[w.key] = None
-                        else:
-                            p_batch[w.key] = VersionedValue(w.value, h)
+                    if clear is not None and expected_hash and \
+                            _sha256(clear[0]) == expected_hash:
+                        pvt_ok[(ns, coll)] = clear
+                        order(pvt_ns(ns, coll), ns)
+        t2 = t()
+
+        # -- pass 2: write-set prepare, fanned out per namespace --------
+        if collided[0]:
+            # non-disjoint groups (see order()): one serial group over
+            # all items in global order — the old single-dict semantics
+            groups = [("", all_items)]
+        else:
+            groups = [(ns, items) for ns, items in groupwork.items()]
+        width = self._fanout
+        if (
+            width > 1 and len(groups) >= 2
+            and n_writes >= _PARALLEL_MIN_WRITES
+        ):
+            # warm the metadata-namespace cache once on this thread so
+            # pool workers only ever read it
+            self._db.may_have_metadata("")
+            width = min(width, len(groups))
+            self.parallel_prepare_blocks += 1
+        else:
+            width = 0
+        pool = None
+        if width:
+            pool = self._pool or workpool.default_pool()
+
+        def _prep(off, chunk, _cache=cache):
+            return self._prepare_groups(chunk, _cache)
+
+        maps = workpool.run_chunked(pool, _prep, groups, width or 1)
+        batch: dict[str, dict[str, VersionedValue | None]] = {}
+        if collided[0]:
+            single = maps[0]
+            for ns in ns_order:
+                batch[ns] = single.get(ns, {})
+        else:
+            # each namespace (top-level or derived) resolves to the
+            # group pass 1 recorded as its owner
+            by_group = {
+                gns: m for (gns, _items), m in zip(groups, maps)
+            }
+            for ns in ns_order:
+                batch[ns] = by_group[ns_owner[ns]].get(ns, {})
+        self.last_stage_seconds = {
+            "preload": t1 - t0, "check": t2 - t1, "prepare": t() - t2,
+        }
         return batch
+
+    def _prepare_groups(self, groups: list, cache: dict) -> list[dict]:
+        """Pass-2 worker: build the batch dicts for a chunk of namespace
+        groups.  Each group's items arrive in commit order, outputs are
+        keyed by exact namespace strings (parent + derived), and no two
+        groups share an output key — so any interleaving of workers
+        merges to the same batch."""
+        out = []
+        for ns_top, items in groups:
+            faultline.point(
+                "mvcc.ns_prepare", stage="prepare", ns=ns_top,
+                txs=len(items),
+            )
+            m: dict[str, dict] = {}
+            for h, ns, kvrw, colls, pvt_by_coll in items:
+                self._build_ns_writes(
+                    ns, kvrw, colls, h, pvt_by_coll, m, cache
+                )
+            out.append(m)
+        return out
+
+    def _build_ns_writes(self, ns, kvrw, colls, h, pvt_by_coll, out,
+                         cache) -> None:
+        """Apply one tx's writes for one parsed namespace entry into the
+        per-group batch maps — the exact write-application the serial
+        loop performed, minus the version bookkeeping pass 1 already
+        did."""
+        ns_batch = out.setdefault(ns, {})
+        for w in kvrw.writes:
+            if w.is_delete:
+                ns_batch[w.key] = None
+            else:
+                # A value-only write RETAINS existing metadata
+                # (key-level endorsement policies survive plain
+                # puts — reference tx_ops metadata merge).
+                ns_batch[w.key] = VersionedValue(
+                    w.value, h,
+                    self._existing_metadata(ns, w.key, ns_batch, cache),
+                )
+        for mw in kvrw.metadata_writes:
+            self._apply_metadata_write(
+                ns, mw.key,
+                {e.name: bytes(e.value) for e in mw.entries},
+                ns_batch, h, cache,
+            )
+        for coll, hrw, expected_hash in colls:
+            hns = hash_ns(ns, coll)
+            h_batch = out.setdefault(hns, {})
+            for hw in hrw.hashed_writes:
+                hkey = bytes(hw.key_hash).hex()
+                if hw.is_delete:
+                    h_batch[hkey] = None
+                else:
+                    h_batch[hkey] = VersionedValue(
+                        bytes(hw.value_hash), h,
+                        self._existing_metadata(hns, hkey, h_batch, cache),
+                    )
+            for mw in hrw.metadata_writes:
+                self._apply_metadata_write(
+                    hns, bytes(mw.key_hash).hex(),
+                    {e.name: bytes(e.value) for e in mw.entries},
+                    h_batch, h, cache,
+                )
+            # Cleartext private writes: pvt_by_coll is pass 1's
+            # ALREADY-AUTHENTICATED map (only entries whose cleartext
+            # hashed to the endorsed pvt_rwset_hash survive), so the
+            # worker applies without re-hashing; forged/absent supplies
+            # were dropped there.
+            clear = pvt_by_coll.get((ns, coll))
+            if clear is None:
+                continue
+            _raw_kvrw, clear_kvrw = clear
+            p_batch = out.setdefault(pvt_ns(ns, coll), {})
+            for w in clear_kvrw.writes:
+                if w.is_delete:
+                    p_batch[w.key] = None
+                else:
+                    p_batch[w.key] = VersionedValue(w.value, h)
+
+    def _meta_write_version(self, ns, key, h, updated_versions, cache) -> None:
+        """Pass-1 version bookkeeping of a metadata write: it bumps the
+        key's version only when the key EXISTS (earlier in-block write
+        that was not a delete, else committed state) — mirroring
+        _apply_metadata_write's early returns."""
+        if (ns, key) in updated_versions:
+            if updated_versions[(ns, key)] is None:
+                return  # deleted earlier in the block: metadata no-op
+        else:
+            if cache is not None and (ns, key) in cache:
+                vv = cache[(ns, key)]
+            else:
+                vv = self._db.get_state(ns, key)
+                if cache is not None:
+                    # stash so the pass-2 worker's _apply_metadata_write
+                    # hits the cache instead of re-probing the store
+                    cache[(ns, key)] = vv
+            if vv is None:
+                return  # key absent: metadata write is a no-op
+        updated_versions[(ns, key)] = h
 
     def _existing_metadata(
         self, ns: str, key: str, ns_batch: dict, cache: dict | None = None
@@ -632,12 +861,12 @@ class MVCCValidator:
 
     def _apply_metadata_write(
         self, ns: str, key: str, entries: dict[str, bytes],
-        ns_batch: dict, updated_versions: dict, h: Height,
-        cache: dict | None = None,
+        ns_batch: dict, h: Height, cache: dict | None = None,
     ) -> None:
         """Replace a key's metadata map, keeping its value; a metadata
         write on a non-existent/deleted key is a no-op (reference
-        statemetadata semantics)."""
+        statemetadata semantics).  Version bookkeeping lives in pass 1
+        (_meta_write_version) — this is pure batch construction."""
         if key in ns_batch:
             base = ns_batch[key]
             if base is None:
@@ -651,7 +880,6 @@ class MVCCValidator:
             if vv is None:
                 return
             ns_batch[key] = VersionedValue(vv.value, h, encode_metadata(entries))
-        updated_versions[(ns, key)] = h
 
     @staticmethod
     def _parse_pvt(raw: bytes | None):

@@ -85,6 +85,10 @@ class _Channel:
         self.validator = TxValidator(
             self.channel_id, self.ledger, self.bundle, node.csp,
             definition_provider=self.definitions,
+            metrics=(
+                node.operations.validate_metrics()
+                if node.operations is not None else None
+            ),
         )
         # private-data stack: collections from committed lifecycle
         # definitions, per-channel transient store, and a commit

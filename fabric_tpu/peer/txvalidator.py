@@ -25,7 +25,12 @@ the builtin plugin evaluates the channel/chaincode endorsement policy.
 from __future__ import annotations
 
 import dataclasses
+import os
+import threading
+import time
 
+from fabric_tpu.common import workpool
+from fabric_tpu.devtools import faultline
 from fabric_tpu.peer.validation_plugins import (
     IllegalWritesetError,
     PluginRegistry,
@@ -44,6 +49,10 @@ from fabric_tpu import protoutil
 from fabric_tpu.protoutil import SignedData
 
 V = transaction_pb2
+
+# blocks below this tx count collect serially even when a pool width is
+# configured — the chunking overhead would outweigh the parse fan-out
+_PARALLEL_MIN_TXS = 32
 
 
 class _ItemSink:
@@ -103,6 +112,35 @@ class _TxWork:
     # VALID, later in-block txs touching them are invalidated
 
 
+@dataclasses.dataclass
+class _ParsedTx:
+    """The shared-state-free half of one tx's collect, produced by
+    ``_parse_tx`` — safe to compute on any pool worker.  Everything
+    order-dependent (sink index assignment, the duplicate-txid window,
+    policy prepare against the per-block plan caches) happens later in
+    ``_integrate_tx``, strictly in tx order, so a parallel collect is
+    byte-identical to the serial one by construction.
+
+    The three flag slots mirror the serial check sequence exactly:
+    ``pre_flag`` fires before the creator item would join the sink,
+    ``mid_flag`` after the creator item but before the duplicate-txid
+    stage (so the txid never registers), and ``post_flag`` after the
+    txid registered (so a later duplicate still collides with it)."""
+
+    hdr_txid: str | None = None  # chdr.tx_id for the block-store index
+    pre_flag: int | None = None
+    creator_item: object | None = None
+    mid_flag: int | None = None
+    txid: str | None = None  # reached the duplicate-check stage
+    dup_checked: bool = False  # serial path: dup probe already ran at
+    # parse time (back-to-back with integrate) — don't re-probe
+    post_flag: int = V.VALID
+    signed: list = dataclasses.field(default_factory=list)
+    cc_id: str = ""
+    rwset: bytes = b""
+    footprint: object | None = None  # parsed RwsetFootprint when usable
+
+
 class TxValidator:
     """Reference TxValidator.Validate equivalent; `Validate` mutates the
     block's TRANSACTIONS_FILTER metadata like the reference does.
@@ -126,6 +164,9 @@ class TxValidator:
         definition_provider=None,
         plugin_registry: PluginRegistry | None = None,
         faithful: bool = False,
+        collect_pool=None,
+        collect_width: int | None = None,
+        metrics=None,
     ):
         """`faithful=True` reproduces the reference's validation cost
         model for baseline measurement: no verify-item interning, no
@@ -134,7 +175,18 @@ class TxValidator:
         common/policies/policy.go:365 does.  (Block digesting still
         runs in the shared native collect pass — hashing cost is
         charged identically to both paths.)  Results are identical;
-        only the work amortization differs."""
+        only the work amortization differs.
+
+        `collect_width` > 1 fans the per-tx collect's parse half across
+        `collect_pool` (default: the process workpool) in that many
+        deterministic chunks; None reads FABRIC_TPU_COLLECT_POOL, 0
+        keeps collect serial.  Faithful mode is always serial — the
+        baseline must reproduce the reference's cost model.
+
+        `metrics` (a common.metrics.ValidateMetrics) adds per-stage
+        collect/verify_wait/policy histograms on /metrics; the
+        cumulative splits are always kept in validate_stage_seconds
+        (bench.py reads them)."""
         self.channel_id = channel_id
         self._ledger = ledger
         self._bundle = bundle
@@ -157,6 +209,38 @@ class TxValidator:
         self._policy_provider = PolicyProvider(
             bundle.policy_manager, bundle.msp_manager, definition_provider
         )
+        # parallel-collect configuration: a width of 0/1 keeps collect
+        # serial; widths are chunk counts over the shared bounded pool
+        # (workpool.run_chunked), so results merge in tx order.
+        # `_collect_explicit` records whether the width was CHOSEN
+        # (ctor arg or env knob) rather than defaulted: the native-
+        # assisted path only fans out when chosen — its remaining
+        # per-tx host work is a GIL-held protobuf decode (the C++
+        # walker already did the GIL-releasing hashing), measured
+        # net-negative under default fan-out — while the pure-Python
+        # path's heavy stages (hash_batch over multi-KB messages,
+        # creator deserialization) release the GIL and win.
+        env_set = bool(
+            os.environ.get("FABRIC_TPU_COLLECT_POOL", "").strip()
+        )
+        self._collect_explicit = collect_width is not None or env_set
+        if faithful:
+            self._collect_width = 0
+        elif collect_width is not None:
+            self._collect_width = max(0, collect_width)
+        else:
+            self._collect_width = workpool.stage_width(
+                "FABRIC_TPU_COLLECT_POOL"
+            )
+        self._collect_pool = collect_pool
+        # cumulative per-stage validate timing (seconds): host collect,
+        # device-verify wait, and host policy/finish — the validate-side
+        # counterpart of KVLedger.commit_stage_seconds
+        self.validate_stage_seconds: dict[str, float] = {}
+        self._metrics = metrics
+        # blocks whose collect actually fanned out (the tier-1 smoke
+        # asserts the parallel path ran, not just that flags matched)
+        self.parallel_collect_blocks = 0
 
     def _committed_metadata(self, ns: str, key: str) -> dict[str, bytes]:
         return self._ledger.get_state_metadata(ns, key)
@@ -171,12 +255,22 @@ class TxValidator:
 
     # -- phase 1: per-tx syntactic validation + collection ----------------
 
-    def _creator_identity(self, creator_bytes: bytes, memo: dict):
+    def _creator_identity(self, creator_bytes: bytes, memo: dict,
+                          lock: threading.Lock | None = None):
         """Deserialize + channel-validate a creator, memoized per block —
         a 1000-tx block typically carries a handful of distinct client
         certs, and the per-call MSP cache still pays a lock + LRU
         shuffle per tx.  Returns None when invalid.  Faithful mode
-        bypasses the memo (the reference pays this per tx)."""
+        bypasses the memo (the reference pays this per tx).
+
+        `lock` guards the memo's WRITE when parallel collect workers
+        share it; the hit-path read is deliberately lock-free (a dict
+        probe is atomic under the GIL, and entries are write-once) so
+        the 99%-hit case costs nothing extra.  Two workers may race to
+        compute the same creator — setdefault keeps the first result,
+        and either result is structurally identical, so downstream sink
+        dedup (which keys on key/digest/signature bytes, never object
+        identity) is unaffected."""
         if not self._faithful and creator_bytes in memo:
             return memo[creator_bytes]
         try:
@@ -184,59 +278,110 @@ class TxValidator:
             self._bundle.msp_manager.validate(ident)
         except Exception:
             ident = None
+        if lock is not None:
+            with lock:
+                return memo.setdefault(creator_bytes, ident)
         memo[creator_bytes] = ident
         return ident
 
     def _collect_tx(self, env_bytes: bytes, seen_txids: set, sink: _ItemSink, work: _TxWork, memo: dict) -> int:
+        """Serial per-tx collect: the pure parse half composed with the
+        order-dependent integration half (the parallel path runs the
+        same two halves with the parses fanned out).  Serial-only
+        optimization: the duplicate-txid probe runs INSIDE the parse,
+        right where the old single-pass code checked it, so a duplicate
+        skips the expensive transaction decode/hash/footprint tail —
+        safe here because parse and integrate run back-to-back with no
+        interleaving, so the window cannot change in between."""
+        return self._integrate_tx(
+            self._parse_tx(
+                env_bytes, memo,
+                dup_check=lambda t: (
+                    t in seen_txids or self._ledger.tx_id_exists(t)
+                ),
+            ),
+            seen_txids, sink, work,
+        )
+
+    def _parse_tx(self, env_bytes: bytes, memo: dict,
+                  memo_lock: threading.Lock | None = None,
+                  dup_check=None) -> _ParsedTx:
+        """The shared-state-free half of one tx's collect — protobuf
+        decode, creator deserialization, digest computation, rwset
+        footprint parse.  Touches no sink, no txid window, and no policy
+        caches, so any pool worker may run it; every check lands in the
+        _ParsedTx flag slot matching its exact position in the serial
+        sequence (see _ParsedTx)."""
+        p = _ParsedTx()
+        # chaos seam: faultfuzz campaigns crash/delay inside the
+        # (possibly pooled) collect stage through this point
+        faultline.point("collect.tx")
         try:
             env = common_pb2.Envelope.FromString(env_bytes)
             if not env.payload:
-                return V.NIL_ENVELOPE
+                p.pre_flag = V.NIL_ENVELOPE
+                return p
             payload = common_pb2.Payload.FromString(env.payload)
             chdr = common_pb2.ChannelHeader.FromString(payload.header.channel_header)
             shdr = common_pb2.SignatureHeader.FromString(payload.header.signature_header)
         except Exception:
-            return V.BAD_PAYLOAD
-        work.txid = chdr.tx_id or None  # for the block store's txid index
+            p.pre_flag = V.BAD_PAYLOAD
+            return p
+        p.hdr_txid = chdr.tx_id or None  # for the block store's txid index
         if not shdr.creator or not shdr.nonce:
-            return V.BAD_COMMON_HEADER
+            p.pre_flag = V.BAD_COMMON_HEADER
+            return p
         if chdr.channel_id != self.channel_id:
-            return V.BAD_CHANNEL_HEADER
+            p.pre_flag = V.BAD_CHANNEL_HEADER
+            return p
         if chdr.epoch != 0:
-            return V.BAD_CHANNEL_HEADER
+            p.pre_flag = V.BAD_CHANNEL_HEADER
+            return p
 
         # creator must deserialize and be valid under a channel MSP
-        creator = self._creator_identity(shdr.creator, memo)
+        creator = self._creator_identity(shdr.creator, memo, memo_lock)
         if creator is None:
-            return V.BAD_CREATOR_SIGNATURE
+            p.pre_flag = V.BAD_CREATOR_SIGNATURE
+            return p
         # creator signature over the payload bytes (checkSignatureFromCreator)
-        work.creator_item = sink.add(
-            creator.verification_item(env.payload, env.signature)
-        )
+        p.creator_item = creator.verification_item(env.payload, env.signature)
 
         if chdr.type == common_pb2.CONFIG:
             # config txs are validated/applied by the channel config engine
-            return V.VALID
+            p.mid_flag = V.VALID
+            return p
         if chdr.type != common_pb2.ENDORSER_TRANSACTION:
-            return V.UNKNOWN_TX_TYPE
+            p.mid_flag = V.UNKNOWN_TX_TYPE
+            return p
 
-        # tx-id binding + duplicate detection (CheckTxID + checkTxIdDupsLedger)
+        # tx-id binding (CheckTxID); the duplicate check itself runs at
+        # integration time, in tx order, against the live window
         if not chdr.tx_id or not protoutil.check_tx_id(chdr.tx_id, shdr.nonce, shdr.creator):
-            return V.BAD_PROPOSAL_TXID
-        if chdr.tx_id in seen_txids or self._ledger.tx_id_exists(chdr.tx_id):
-            return V.DUPLICATE_TXID
-        seen_txids.add(chdr.tx_id)
+            p.mid_flag = V.BAD_PROPOSAL_TXID
+            return p
+        p.txid = chdr.tx_id
+        if dup_check is not None:
+            # serial fast path (see _collect_tx): the one dup probe
+            # runs here — a known duplicate skips the expensive tail
+            # like the old single-pass collect did, and a clean txid is
+            # NOT re-probed at integration
+            p.dup_checked = True
+            if dup_check(chdr.tx_id):
+                p.post_flag = V.DUPLICATE_TXID
+                return p
 
         try:
             tx = transaction_pb2.Transaction.FromString(payload.data)
             if not tx.actions:
-                return V.NIL_TXACTION
+                p.post_flag = V.NIL_TXACTION
+                return p
             cap = transaction_pb2.ChaincodeActionPayload.FromString(tx.actions[0].payload)
             prp_bytes = cap.action.proposal_response_payload
             prp = proposal_response_pb2.ProposalResponsePayload.FromString(prp_bytes)
             action = proposal_pb2.ChaincodeAction.FromString(prp.extension)
         except Exception:
-            return V.BAD_PAYLOAD
+            p.post_flag = V.BAD_PAYLOAD
+            return p
         # proposal-hash binding: endorsers signed over this exact proposal.
         # GetProposalHash2 semantics (reference msgvalidation.go:233,
         # txutils.go:431): hash the committed ccpp bytes RAW, never
@@ -249,9 +394,11 @@ class TxValidator:
             cap.chaincode_proposal_payload,
         )
         if prp.proposal_hash != want:
-            return V.BAD_RESPONSE_PAYLOAD
+            p.post_flag = V.BAD_RESPONSE_PAYLOAD
+            return p
         if not cap.action.endorsements:
-            return V.ENDORSEMENT_POLICY_FAILURE
+            p.post_flag = V.ENDORSEMENT_POLICY_FAILURE
+            return p
 
         # chaincode-id consistency: header extension vs ChaincodeAction
         # (reference dispatcher.go:129-157)
@@ -260,12 +407,15 @@ class TxValidator:
                 chdr.extension
             )
         except Exception:
-            return V.BAD_HEADER_EXTENSION
+            p.post_flag = V.BAD_HEADER_EXTENSION
+            return p
         cc_id = hdr_ext.chaincode_id.name
         if not cc_id:
-            return V.INVALID_CHAINCODE
+            p.post_flag = V.INVALID_CHAINCODE
+            return p
         if action.chaincode_id.name != cc_id:
-            return V.INVALID_CHAINCODE
+            p.post_flag = V.INVALID_CHAINCODE
+            return p
         # a chaincode event must name the invoked chaincode
         # (dispatcher.go:161-169)
         if action.events:
@@ -274,9 +424,11 @@ class TxValidator:
                     action.events
                 )
             except Exception:
-                return V.INVALID_OTHER_REASON
+                p.post_flag = V.INVALID_OTHER_REASON
+                return p
             if ev.chaincode_id != cc_id:
-                return V.INVALID_OTHER_REASON
+                p.post_flag = V.INVALID_OTHER_REASON
+                return p
 
         # endorsement policy: each endorsement signs prp_bytes || endorser.
         # Digests are precomputed so policy prepare hits the plan cache
@@ -285,12 +437,53 @@ class TxValidator:
         # provider batches them instead of the host hashing per lane.
         msgs = [prp_bytes + e.endorser for e in cap.action.endorsements]
         digests = self._csp.hash_batch(msgs)
-        signed = [
+        p.signed = [
             SignedData(m, e.endorser, e.signature, digest=d)
             for m, e, d in zip(msgs, cap.action.endorsements, digests)
         ]
+        p.cc_id = cc_id
+        p.rwset = bytes(action.results)
+        # the rwset decode is the largest single collect cost
+        # (parse_footprint docstring) — do it here, on the worker; the
+        # failure codes land exactly where _prepare_namespaces would
+        # have produced them (after the txid registered)
+        try:
+            p.footprint = parse_footprint(p.rwset)
+        except IllegalWritesetError:
+            p.post_flag = V.ILLEGAL_WRITESET
+        except Exception:
+            p.post_flag = V.BAD_RWSET
+        return p
+
+    def _integrate_tx(self, p: _ParsedTx, seen_txids: set,
+                      sink: _ItemSink, work: _TxWork) -> int:
+        """The order-dependent half: sink index assignment, the
+        duplicate-txid window, and policy prepare — always in tx order
+        on the collecting thread, so flags, sink order, and dedup
+        indices are byte-identical whether the parses ran serial or
+        fanned out."""
+        work.txid = p.hdr_txid
+        if p.pre_flag is not None:
+            return p.pre_flag
+        work.creator_item = sink.add(p.creator_item)
+        if p.mid_flag is not None:
+            return p.mid_flag
+        # duplicate detection (checkTxIdDupsLedger): the txid registers
+        # even when a later stage fails, exactly as the serial path does
+        # (an early serial-path verdict arrives as post_flag and never
+        # registers — the txid is already in the window or the ledger)
+        if p.post_flag == V.DUPLICATE_TXID:
+            return V.DUPLICATE_TXID
+        if not p.dup_checked and (
+            p.txid in seen_txids or self._ledger.tx_id_exists(p.txid)
+        ):
+            return V.DUPLICATE_TXID
+        seen_txids.add(p.txid)
+        if p.post_flag != V.VALID:
+            return p.post_flag
         return self._prepare_namespaces(
-            work, signed, cc_id, bytes(action.results), sink
+            work, p.signed, p.cc_id, p.rwset, sink,
+            footprint=p.footprint,
         )
 
     # -- the three-phase validate -----------------------------------------
@@ -360,8 +553,21 @@ class TxValidator:
         while q:
             yield finish(q.popleft())
 
+    def _collect_fanout(self, n: int, native: bool = False) -> int:
+        """Chunk count for this block's parallel collect; 0/1 = serial.
+        Small blocks stay serial — the fan-out overhead (futures, chunk
+        lists) only amortizes past a few dozen txs.  The native path
+        fans out only on an EXPLICIT width (see __init__)."""
+        width = self._collect_width
+        if width <= 1 or n < _PARALLEL_MIN_TXS:
+            return 0
+        if native and not self._collect_explicit:
+            return 0
+        return min(width, n)
+
     def _start_block(self, block: common_pb2.Block, seen_txids: set):
         """Phases 1+2: collect every tx, dispatch the device verify."""
+        t0 = time.perf_counter()
         envs = list(block.data.data)  # ONE materialization of the
         # envelope byte strings (each repeated-field access copies)
         n = len(envs)
@@ -388,16 +594,36 @@ class TxValidator:
             envs, seen_txids, sink, works, flags, memo
         )
         if not native:
-            for i in range(n):
-                flags[i] = self._collect_tx(
-                    envs[i], seen_txids, sink, works[i], memo
+            width = self._collect_fanout(n)
+            if width:
+                # fan the pure parse half out in deterministic chunks;
+                # integration (sink indices, dup window, policy prepare)
+                # stays on this thread in strict tx order
+                memo_lock = threading.Lock()
+                parsed = workpool.run_chunked(
+                    self._collect_pool or workpool.default_pool(),
+                    lambda off, chunk: [
+                        self._parse_tx(e, memo, memo_lock) for e in chunk
+                    ],
+                    envs, width,
                 )
+                self.parallel_collect_blocks += 1
+                for i in range(n):
+                    flags[i] = self._integrate_tx(
+                        parsed[i], seen_txids, sink, works[i]
+                    )
+            else:
+                for i in range(n):
+                    flags[i] = self._collect_tx(
+                        envs[i], seen_txids, sink, works[i], memo
+                    )
 
         collect = (
             self._csp.verify_batch_async(sink.items)
             if sink.items
             else (lambda: [])
         )
+        self._observe_stage("collect", time.perf_counter() - t0)
         return block, flags, works, collect, envs
 
     def _collect_native(self, data, seen_txids, sink: _ItemSink, works, flags, memo: dict) -> bool:
@@ -479,6 +705,68 @@ class TxValidator:
         es_off = co["e_sig_off"].tolist()
         es_len = co["e_sig_len"].tolist()
 
+        # parallel prefetch over the walker-validated endorser lanes:
+        # the rwset footprint decode — the glue loop's largest per-tx
+        # cost — fans out in deterministic chunks; the glue loop below
+        # then runs unchanged with footprints in hand, so flags/sink
+        # order are byte-identical to the serial pass.  A failed parse
+        # carries its flag code (int) in place of the footprint,
+        # applied at the exact point _prepare_namespaces would have
+        # produced it.  (Creator identities are NOT prefetched: a block
+        # carries a handful of distinct creators, and per-lane memo
+        # locking costs more than the deserializations it would
+        # overlap.)
+        prefetched: list | None = None
+        width = self._collect_fanout(len(data), native=True)
+        if width:
+            def _prefetch(off, lanes):
+                out = []
+                for i in lanes:
+                    # chaos seam: faultfuzz crash/delay inside the
+                    # pooled collect stage
+                    faultline.point("collect.tx")
+                    try:
+                        fp = parse_footprint(
+                            sl(rwset_off_l[i], rwset_len_l[i])
+                        )
+                    except IllegalWritesetError:
+                        fp = V.ILLEGAL_WRITESET
+                    except Exception:
+                        fp = V.BAD_RWSET
+                    out.append(fp)
+                return out
+
+            # endorser lanes only (1 = CONFIG: no rwset), minus lanes
+            # the duplicate-txid stage will discard anyway (window +
+            # the bulk ledger probe above) — the old path never parsed
+            # a duplicate's rwset and the prefetch must not either.
+            # A lane skipped here but clean at glue time (a racing
+            # window release) just parses inline; flags never depend
+            # on prefetch coverage.
+            lanes = []
+            for i in range(len(data)):
+                st = status_l[i]
+                if st < 0 or st == 1:
+                    continue
+                if txid_len_l[i]:
+                    try:
+                        t = buf[
+                            txid_off_l[i]:txid_off_l[i] + txid_len_l[i]
+                        ].decode()
+                    except UnicodeDecodeError:
+                        continue  # glue falls this lane back anyway
+                    if t in seen_txids or txid_known(t):
+                        continue
+                lanes.append(i)
+            got = workpool.run_chunked(
+                self._collect_pool or workpool.default_pool(),
+                _prefetch, lanes, width,
+            )
+            prefetched = [None] * len(data)
+            for i, fp in zip(lanes, got):
+                prefetched[i] = fp
+            self.parallel_collect_blocks += 1
+
         for i in range(len(data)):
             st = status_l[i]
             if st < 0:  # python re-derives every non-valid lane
@@ -547,20 +835,31 @@ class TxValidator:
                 )
                 for k in range(es, es + ec)
             ]
+            fp = prefetched[i] if prefetched is not None else None
+            if isinstance(fp, int):
+                # the prefetch already failed this rwset; the flag lands
+                # here — after the txid registered — exactly where the
+                # inline parse would have failed
+                flags[i] = fp
+                continue
             flags[i] = self._prepare_namespaces(
-                w, signed, cc_id, rwset_bytes, sink
+                w, signed, cc_id, rwset_bytes, sink, footprint=fp
             )
         return True
 
-    def _prepare_namespaces(self, w, signed, cc_id, rwset_bytes, sink: _ItemSink) -> int:
+    def _prepare_namespaces(self, w, signed, cc_id, rwset_bytes,
+                            sink: _ItemSink, footprint=None) -> int:
         """Shared tail of collect: rwset footprint + per-written-namespace
-        plugin prepare (dispatcher.go:158-218 wrNamespace loop)."""
-        try:
-            footprint = parse_footprint(rwset_bytes)
-        except IllegalWritesetError:
-            return V.ILLEGAL_WRITESET
-        except Exception:
-            return V.BAD_RWSET
+        plugin prepare (dispatcher.go:158-218 wrNamespace loop).
+        `footprint` carries a parse the (possibly pooled) prefetch
+        already did; None parses inline."""
+        if footprint is None:
+            try:
+                footprint = parse_footprint(rwset_bytes)
+            except IllegalWritesetError:
+                return V.ILLEGAL_WRITESET
+            except Exception:
+                return V.BAD_RWSET
 
         namespaces = [cc_id] + [
             ns
@@ -590,9 +889,20 @@ class TxValidator:
         w.meta_keys = frozenset(footprint.meta_writes)
         return V.VALID
 
+    def _observe_stage(self, stage: str, dt: float) -> None:
+        acc = self.validate_stage_seconds
+        acc[stage] = acc.get(stage, 0.0) + dt
+        if self._metrics is not None:
+            self._metrics.stage_duration.With(
+                "channel", self.channel_id, "stage", stage
+            ).observe(dt)
+
     def _finish_block(self, block, flags, works, collect) -> list[int]:
         n = len(flags)
+        t0 = time.perf_counter()
         mask = collect()
+        t1 = time.perf_counter()
+        self._observe_stage("verify_wait", t1 - t0)
 
         # phase 3: in-order finish.  All policy evaluations read the
         # COMMITTED (pre-block) metadata — the reference does the same,
@@ -623,6 +933,7 @@ class TxValidator:
             updated.update(w.meta_keys)
 
         protoutil.set_tx_filter(block, bytes(flags))
+        self._observe_stage("policy", time.perf_counter() - t1)
         return flags
 
 

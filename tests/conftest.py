@@ -119,6 +119,21 @@ def _soak_residue_drain():
 
 
 @pytest.fixture(scope="session", autouse=True)
+def _workpool_shutdown():
+    """Shut the shared host work pool down at session end.  The commit
+    path's parallel collect/prepare stages lazily spin up one
+    process-wide tracked executor (common/workpool.py, registered as a
+    service whose stop path is this shutdown) — declared AFTER the
+    gates above so its teardown runs FIRST (fixtures finalize in
+    reverse instantiation order) and the pool is gone before the
+    threadwatch sweep.  A pool nobody started makes this a no-op."""
+    yield
+    from fabric_tpu.common import workpool
+
+    workpool.shutdown()
+
+
+@pytest.fixture(scope="session", autouse=True)
 def _faultline_drain_gate():
     """Fail the session if a fault plan is still armed or the trip
     ledger was left undrained.  Chaos tests arm plans through

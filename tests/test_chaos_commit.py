@@ -203,6 +203,57 @@ def test_crash_before_any_write_loses_nothing(tmp_path):
     provider.close()
 
 
+def test_pinned_parallel_prepare_crash_plan(tmp_path, monkeypatch):
+    """Pinned seeded plan over the PR 9 parallel-stage seam: a crash
+    inside the fanned-out MVCC namespace prepare (mvcc.ns_prepare,
+    targeted at one namespace's group so the trip is deterministic even
+    with pool workers racing) aborts the commit before anything reaches
+    disk; reopen recovers cleanly and the same block re-commits.  Two
+    runs yield identical trip ledgers."""
+    monkeypatch.setenv("FABRIC_TPU_MVCC_POOL", "3")
+    plan = {"seed": 9, "faults": [{
+        "point": "mvcc.ns_prepare", "ctx": {"ns": "ns1"},
+        "action": "crash",
+    }]}
+
+    def run(sub: str) -> list[dict]:
+        provider = LedgerProvider(str(tmp_path / sub))
+        ledger = provider.open("chaos")
+        ledger.commit(_write_block(ledger, 0, [("ns0", "a", b"0")]))
+        # 3 namespaces x 15 writes: past the prepare fan-out threshold
+        items = [
+            (f"ns{j}", f"k{i}", b"v")
+            for j in range(3) for i in range(15)
+        ]
+        blk = _write_block(ledger, 1, items)
+        with faultline.use_plan(plan):
+            with pytest.raises(faultline.FaultCrash):
+                ledger.commit(blk)
+            observed = [
+                t for t in faultline.trips() if t["plan"] != "soak"
+            ]
+        assert observed and all(
+            t["point"] == "mvcc.ns_prepare" and t["ctx"]["ns"] == "ns1"
+            for t in observed
+        )
+        provider.close()
+
+        # the crash hit BEFORE the block-append stage: nothing reached
+        # disk, recovery lands at height 1, the block re-commits
+        provider2 = LedgerProvider(str(tmp_path / sub))
+        led2 = provider2.open("chaos")
+        _assert_consistent(led2, 1, {("ns0", "a"): b"0",
+                                     ("ns1", "k0"): None})
+        led2.commit(_write_block(led2, 1, items))
+        assert led2.get_state("ns1", "k0") == b"v"
+        assert led2.height == 2
+        provider2.close()
+        return observed
+
+    first, second = run("r1"), run("r2")
+    assert first == second
+
+
 def test_same_seed_same_trip_ledger_across_runs(tmp_path):
     """Determinism acceptance: the same plan over the same workload
     yields an IDENTICAL trip ledger across two runs — seeded
