@@ -62,6 +62,12 @@ def main() -> None:
     from fabric_tpu.protos.common import common_pb2
 
     sweep_sqlite = "--sweep-sqlite" in sys.argv
+    trace_out = None
+    if "--trace-out" in sys.argv:
+        i = sys.argv.index("--trace-out")
+        if i + 1 >= len(sys.argv) or sys.argv[i + 1].startswith("--"):
+            sys.exit("bench.py: --trace-out requires a PATH argument")
+        trace_out = sys.argv[i + 1]
 
     # sqlite tuning applied to BOTH sides (baseline and measured): a
     # larger WAL autocheckpoint keeps checkpoint I/O out of the timed
@@ -135,18 +141,25 @@ def main() -> None:
 
     def run_stream(passes: int = 4):
         """Best-of-N pipelined validate+commit stream; returns
-        (best_seconds, commit_stages, validate_stages) of the winning
-        pass.  The provider is drained before every pass for the same
-        reason the p99 loop drains: a prior pass's host-raced flush can
-        leave the device leg still crunching, and that tail must not
-        become the next pass's head."""
+        (best_seconds, commit_stages, validate_stages, trace) of the
+        winning pass.  The provider is drained before every pass for
+        the same reason the p99 loop drains: a prior pass's host-raced
+        flush can leave the device leg still crunching, and that tail
+        must not become the next pass's head.  Under --trace-out the
+        flight recorder resets per pass and the WINNING pass's export
+        is kept — the artifact matches the measured number."""
+        from fabric_tpu.common import tracing
+
         best = float("inf")
         commit_stages: dict = {}
         validate_stages: dict = {}
+        trace: dict | None = None
         stream_drain = getattr(csp, "drain", None)
         for _ in range(passes):
             if stream_drain is not None:
                 stream_drain()
+            if tracing.enabled():
+                tracing.reset()
             led = fresh_ledger()
             validator = TxValidator("benchch", led, bundle, csp)
             committer = Committer(validator, led)
@@ -163,8 +176,10 @@ def main() -> None:
                 # validator_block_stage_duration histograms)
                 commit_stages = dict(led.commit_stage_seconds)
                 validate_stages = dict(validator.validate_stage_seconds)
+                if tracing.enabled():
+                    trace = tracing.export()
             assert led.height == 1 + n_blocks
-        return best, commit_stages, validate_stages
+        return best, commit_stages, validate_stages, trace
 
     if sweep_sqlite:
         # durability sweep: one JSON line per synchronous/checkpoint
@@ -175,7 +190,7 @@ def main() -> None:
             for ckpt in (250, 1000, 4000):
                 os.environ["FABRIC_TPU_SQLITE_SYNC"] = sync
                 os.environ["FABRIC_TPU_WAL_CHECKPOINT"] = str(ckpt)
-                best, stages, _vstages = run_stream(passes=2)
+                best, stages, _vstages, _trace = run_stream(passes=2)
                 print(json.dumps({
                     "metric": "sqlite_sweep_tx_per_s",
                     "synchronous": sync,
@@ -196,7 +211,21 @@ def main() -> None:
         tmp.cleanup()
         return
 
-    best, commit_stages, validate_stages = run_stream()
+    # tracing arms AFTER the baseline measurement so the (already
+    # near-zero) armed-path overhead cannot skew the vs-baseline ratio;
+    # the measured side carries it inside the traced passes by design
+    if trace_out:
+        from fabric_tpu.common import tracing
+
+        if not tracing.enabled():
+            # FABRIC_TPU_TRACE=N may have armed a user-sized ring at
+            # import; only arm the default when nothing is armed yet
+            tracing.arm()
+        from fabric_tpu.common import workpool as _workpool
+
+        _workpool.reset_stats()
+
+    best, commit_stages, validate_stages, trace = run_stream()
     value = n_blocks * n_txs / best
 
     # -- p99 block-validate latency on the measured path ------------------
@@ -222,30 +251,45 @@ def main() -> None:
     lat.sort()
     p99 = lat[min(len(lat) - 1, int(0.99 * len(lat)))]
 
-    print(
-        json.dumps(
-            {
-                "metric": "committed_tx_per_s_1000tx_3of5_stream",
-                "value": round(value, 2),
-                "unit": "tx/s",
-                "vs_baseline": round(value / baseline, 3),
-                "baseline_tx_per_s": round(baseline, 2),
-                "p99_block_validate_ms": round(p99 * 1e3, 2),
-                "commit_stage_ms": {
-                    k: round(v * 1e3, 2)
-                    for k, v in sorted(commit_stages.items())
-                },
-                "validate_stage_ms": {
-                    k: round(v * 1e3, 2)
-                    for k, v in sorted(validate_stages.items())
-                },
-                "sqlite": {
-                    "synchronous": _sync_level(None),
-                    "wal_autocheckpoint": _wal_ckpt(None),
-                },
-            }
-        )
-    )
+    line = {
+        "metric": "committed_tx_per_s_1000tx_3of5_stream",
+        "value": round(value, 2),
+        "unit": "tx/s",
+        "vs_baseline": round(value / baseline, 3),
+        "baseline_tx_per_s": round(baseline, 2),
+        "p99_block_validate_ms": round(p99 * 1e3, 2),
+        "commit_stage_ms": {
+            k: round(v * 1e3, 2)
+            for k, v in sorted(commit_stages.items())
+        },
+        "validate_stage_ms": {
+            k: round(v * 1e3, 2)
+            for k, v in sorted(validate_stages.items())
+        },
+        "sqlite": {
+            "synchronous": _sync_level(None),
+            "wal_autocheckpoint": _wal_ckpt(None),
+        },
+    }
+    if trace_out and trace is not None:
+        from fabric_tpu.common import tracing
+        from fabric_tpu.common import workpool as _workpool
+
+        with open(trace_out, "w", encoding="utf-8") as f:
+            json.dump(trace, f, indent=1, sort_keys=True)
+            f.write("\n")
+        # per-block critical path over the winning pass's stage spans:
+        # which stages actually gated the wall clock (summed ms across
+        # blocks), vs the plain busy-time sums above
+        line["critical_path_ms"] = {
+            k: round(v, 2)
+            for k, v in sorted(tracing.critical_path_ms(
+                trace["traceEvents"]
+            ).items())
+        }
+        line["trace_out"] = trace_out
+        line["workpool"] = _workpool.stats()
+    print(json.dumps(line))
     sys.stdout.flush()
     # quiesce the device provider AFTER the one JSON line is out (a
     # wedged chip must not discard completed measurements) but BEFORE

@@ -36,12 +36,30 @@ import threading
 from fabric_tpu.devtools import clockskew, faultline
 from fabric_tpu.devtools.lockwatch import spawn_thread
 
+from fabric_tpu.common import tracing
+
 KIND_DATA = 0
 KIND_END = 1
 KIND_ERR = 2
 KIND_PING = 3  # server liveness marker on quiet streams; clients skip it
 
 _MAX_FRAME = 100 * 1024 * 1024  # reference default max message size
+
+# Trace-context piggyback: a traced client prefixes the method field
+# with "\x01<token>\x01" (tracing.wire_token, ~35 bytes — method_len
+# stays well under its uint8 bound).  Servers ALWAYS strip the prefix
+# (one startswith on the decoded method) and adopt the context only
+# when tracing is armed; untraced clients emit byte-identical frames.
+_TRACE_MARK = "\x01"
+
+
+def _split_trace(method: str) -> tuple[str, "tracing.SpanContext | None"]:
+    if not method.startswith(_TRACE_MARK):
+        return method, None
+    end = method.find(_TRACE_MARK, 1)
+    if end < 0:
+        return method, None
+    return method[end + 1:], tracing.from_wire(method[1:end])
 
 
 @dataclasses.dataclass(frozen=True)
@@ -227,6 +245,7 @@ class _Handler(socketserver.BaseRequestHandler):
                 write_frame(sock, bytes([KIND_ERR]) + b"malformed request")
                 return
             body = frame[1 + mlen:]
+            method, trace_ctx = _split_trace(method)
             fn = server.methods.get(method)
             if fn is None:
                 write_frame(
@@ -234,7 +253,13 @@ class _Handler(socketserver.BaseRequestHandler):
                 )
                 return
             try:
-                out = fn(body, Stream(sock, peer_cert))
+                # the serve span parents into the CLIENT's rpc.call span
+                # via the frame-carried context — the cross-process hop
+                # the /traces nesting acceptance pins
+                with tracing.span(
+                    "rpc.serve", parent=trace_ctx, method=method,
+                ):
+                    out = fn(body, Stream(sock, peer_cert))
             except Exception as exc:  # noqa: BLE001 — error surface to client
                 try:
                     write_frame(
@@ -446,12 +471,21 @@ class RPCClient:
                 sock.close()
                 raise
         sock = faultline.io(sock, "rpc.client")
+        token = tracing.wire_token()
+        if token is not None:
+            method = f"{_TRACE_MARK}{token}{_TRACE_MARK}{method}"
         m = method.encode("utf-8")
         write_frame(sock, bytes([len(m)]) + m + body)
         return sock
 
     def call(self, method: str, body: bytes = b"") -> bytes:
         """Unary call: returns the single DATA body (b"" when END-only)."""
+        # the span opens BEFORE _connect so the wire token carries ITS
+        # id — the server's rpc.serve span nests under this one
+        with tracing.span("rpc.call", method=method):
+            return self._call(method, body)
+
+    def _call(self, method: str, body: bytes) -> bytes:
         sock = self._connect(method, body)
         try:
             data = b""
@@ -478,7 +512,11 @@ class RPCClient:
         ping_interval + ping_timeout — silence past that means a dead
         peer (RPCError), while a merely idle stream stays up
         indefinitely."""
-        sock = self._connect(method, body)
+        # span covers the connect+request only: the stream body is
+        # consumed lazily by the caller, and a generator must not pin
+        # an open span on this thread across arbitrary yields
+        with tracing.span("rpc.stream", method=method):
+            sock = self._connect(method, body)
         ka = self._keepalive
         try:
             sock.settimeout(

@@ -115,6 +115,26 @@ class _LevelFilter(logging.Filter):
         return ok
 
 
+class _TraceFormatter(logging.Formatter):
+    """The standard line format, plus ``trace=<id> span=<id>`` when
+    tracelens is armed and the emitting thread has an active span — so
+    ``/logspec``-tuned debug logs join against ``/traces`` dumps by id.
+    Disarmed, the emitted bytes are identical to before."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        line = super().format(record)
+        from fabric_tpu.common import tracing
+
+        ctx = tracing.current()
+        if ctx is not None:
+            # ids go on the HEADER line, not after an exc_info
+            # traceback — line-oriented joins grep the message line
+            suffix = f" trace={ctx.trace_id:x} span={ctx.span_id:x}"
+            head, nl, rest = line.partition("\n")
+            line = head + suffix + nl + rest
+        return line
+
+
 class Registry:
     """Global logging state (reference global.go / logging.go Logging)."""
 
@@ -126,7 +146,7 @@ class Registry:
         self._root.propagate = False
         self._handler = logging.StreamHandler(sys.stderr)
         self._handler.setFormatter(
-            logging.Formatter(
+            _TraceFormatter(
                 "%(asctime)s %(levelname).4s [%(name)s] %(message)s",
                 "%Y-%m-%d %H:%M:%S",
             )

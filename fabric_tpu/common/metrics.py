@@ -459,6 +459,35 @@ class CSPMetrics:
         self.breaker_state.set(0)
 
 
+class WorkpoolMetrics:
+    """Shared host-work-pool observability (the PR 9 pool had none):
+    how deep the executor's queue is, how many run_chunked chunks are
+    in flight, and how saturated the worker set is — the signals that
+    say whether FABRIC_TPU_COLLECT_POOL/_MVCC_POOL widths are starving
+    or flooding the one process-wide pool."""
+
+    def __init__(self, provider):
+        self.queue_depth = provider.new_gauge(GaugeOpts(
+            namespace="workpool",
+            name="queue_depth",
+            help="Tasks waiting in the shared host work pool's "
+                 "executor queue at the last fan-out.",
+        ))
+        self.in_flight = provider.new_gauge(GaugeOpts(
+            namespace="workpool",
+            name="in_flight_chunks",
+            help="run_chunked chunks currently submitted and not yet "
+                 "collected.",
+        ))
+        self.saturation = provider.new_gauge(GaugeOpts(
+            namespace="workpool",
+            name="worker_saturation",
+            help="In-flight chunks over the pool's worker cap, capped "
+                 "at 1.0 — sustained 1.0 means fan-outs queue behind "
+                 "each other.",
+        ))
+
+
 class RaftMetrics:
     """Raft cluster-comm instrumentation: the silent-loss counters the
     transport used to drop into the void.  `send_dropped` counts
@@ -499,4 +528,5 @@ __all__ = [
     "CommitMetrics",
     "CSPMetrics",
     "RaftMetrics",
+    "WorkpoolMetrics",
 ]

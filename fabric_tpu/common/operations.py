@@ -1,8 +1,12 @@
-"""Operations HTTP endpoint: /metrics, /healthz, /version, /logspec.
+"""Operations HTTP endpoint: /metrics, /healthz, /version, /logspec,
+/traces.
 
 Reference: core/operations/system.go:75-265 — an HTTP server exposing
 prometheus metrics, health checks with registered checkers, the build
 version, and GET/PUT of the runtime log spec (flogging httpadmin).
+``GET /traces`` goes beyond the reference: it serves the tracelens
+flight recorder as Chrome trace-event JSON (empty, with
+``otherData.armed=false``, while ``FABRIC_TPU_TRACE`` is unset).
 """
 
 from __future__ import annotations
@@ -40,6 +44,7 @@ class System:
         self._validate_metrics = None
         self._csp_metrics = None
         self._raft_metrics = None
+        self._workpool_metrics = None
         self._lock = threading.Lock()
         if provider == "prometheus":
             self.metrics_provider = PrometheusProvider()
@@ -85,6 +90,15 @@ class System:
                 elif self.path == "/logspec":
                     self._reply(
                         200, json.dumps({"spec": flogging.spec()}).encode()
+                    )
+                elif self.path == "/traces":
+                    from fabric_tpu.common import tracing
+
+                    self._reply(
+                        200,
+                        json.dumps(
+                            tracing.export(), sort_keys=True
+                        ).encode(),
                     )
                 else:
                     self._reply(404, b"not found", "text/plain")
@@ -184,6 +198,20 @@ class System:
 
                 self._raft_metrics = RaftMetrics(self.metrics_provider)
             return self._raft_metrics
+
+    def workpool_metrics(self):
+        """Lazily-built shared-host-work-pool metrics (queue depth,
+        in-flight chunks, worker saturation) — hand the bundle to
+        ``workpool.set_metrics`` so the parallel collect/prepare
+        stages' fan-out pressure surfaces on /metrics."""
+        with self._lock:
+            if self._workpool_metrics is None:
+                from fabric_tpu.common.metrics import WorkpoolMetrics
+
+                self._workpool_metrics = WorkpoolMetrics(
+                    self.metrics_provider
+                )
+            return self._workpool_metrics
 
     # -- health ------------------------------------------------------------
 

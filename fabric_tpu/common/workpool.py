@@ -36,12 +36,68 @@ from __future__ import annotations
 import os
 import threading
 
+from fabric_tpu.common import tracing
+
 _FALSY = ("0", "false", "off", "no")
 
 # the shared executor and the width it was created with; both move only
 # under _pool_lock (declared in devtools/guards.py)
 _pool = None
 _pool_lock = threading.Lock()
+
+# observability: an optional WorkpoolMetrics bundle (queue depth /
+# in-flight / saturation gauges, wired by operations.System) plus
+# always-on cheap counters for the bench JSON line; all under one lock
+_metrics = None
+_stats_lock = threading.Lock()
+_stats = {"chunks": 0, "in_flight": 0, "max_in_flight": 0}
+
+
+def set_metrics(metrics) -> None:
+    """Attach a common.metrics.WorkpoolMetrics bundle: run_chunked then
+    keeps its queue-depth / in-flight / saturation gauges current."""
+    global _metrics
+    with _stats_lock:
+        _metrics = metrics
+
+
+def stats() -> dict:
+    """Always-on fan-out counters (chunks submitted, peak concurrent
+    chunks) — bench.py echoes these in its JSON line."""
+    with _stats_lock:
+        return {k: v for k, v in _stats.items() if k != "in_flight"}
+
+
+def reset_stats() -> None:
+    with _stats_lock:
+        _stats["chunks"] = 0
+        _stats["max_in_flight"] = 0
+
+
+def _note_submit(pool, n_chunks: int) -> None:
+    with _stats_lock:
+        _stats["chunks"] += n_chunks
+        _stats["in_flight"] += n_chunks
+        if _stats["in_flight"] > _stats["max_in_flight"]:
+            _stats["max_in_flight"] = _stats["in_flight"]
+        m = _metrics
+        inflight = _stats["in_flight"]
+    if m is not None:
+        m.in_flight.set(inflight)
+        q = getattr(pool, "_work_queue", None)
+        if q is not None:
+            m.queue_depth.set(q.qsize())
+        workers = getattr(pool, "_max_workers", 0) or 1
+        m.saturation.set(min(1.0, inflight / workers))
+
+
+def _note_done(n_chunks: int) -> None:
+    with _stats_lock:
+        _stats["in_flight"] = max(0, _stats["in_flight"] - n_chunks)
+        m = _metrics
+        inflight = _stats["in_flight"]
+    if m is not None:
+        m.in_flight.set(inflight)
 
 
 def _auto_width() -> int:
@@ -142,11 +198,26 @@ def run_chunked(pool, fn, items, width: int):
     width = min(width, n)
     if width <= 1:
         return fn(0, items)
+    ctx = tracing.current() if tracing.enabled() else None
+    if ctx is not None:
+        # the caller's span flows INTO the pooled work: every chunk runs
+        # under a child span, so spans opened inside (collect.tx /
+        # mvcc.ns_prepare stages) parent across the thread hop
+        caller_fn = fn
+
+        def fn(off, chunk, _fn=caller_fn, _ctx=ctx):
+            with tracing.attached(_ctx):
+                with tracing.span(
+                    "workpool.chunk", offset=off, items=len(chunk),
+                ):
+                    return _fn(off, chunk)
+
     per = (n + width - 1) // width
     futures = [
         pool.submit(fn, off, items[off:off + per])
         for off in range(0, n, per)
     ]
+    _note_submit(pool, len(futures))
     out: list = []
     try:
         for f in futures:
@@ -162,7 +233,9 @@ def run_chunked(pool, fn, items, width: int):
         from concurrent.futures import wait as _wait
 
         _wait(futures)
+        _note_done(len(futures))
         raise
+    _note_done(len(futures))
     return out
 
 
@@ -172,4 +245,7 @@ __all__ = [
     "shutdown",
     "stage_width",
     "run_chunked",
+    "set_metrics",
+    "stats",
+    "reset_stats",
 ]

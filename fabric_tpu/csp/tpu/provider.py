@@ -27,6 +27,7 @@ from typing import Sequence
 
 import numpy as np
 
+from fabric_tpu.common import tracing
 from fabric_tpu.common.flogging import must_get_logger
 from fabric_tpu.csp import api
 from fabric_tpu.devtools import faultline
@@ -833,7 +834,16 @@ class TPUCSP(CSP):
             deadline = None
             if sole and res.deadline is not None:
                 deadline = self._sole_deadline_for(res._n_device_lanes)
-            mask = res.collect(deadline)
+            with tracing.span(
+                "tpu.collect", batch=gen, lanes=n,
+                device_lanes=res._n_device_lanes,
+            ):
+                mask = res.collect(deadline)
+                if tracing.enabled():
+                    with self._ewma_lock:
+                        wall = self._lane_wall_ewma
+                    if wall is not None:
+                        tracing.annotate(lane_wall_ewma_us=wall * 1e6)
             out = mask[seg_start:seg_start + n]
             with self._pend_lock:
                 if memo:  # lost a race after collect: keep first result
@@ -857,7 +867,10 @@ class TPUCSP(CSP):
         gen = self._gen
         self._gen += 1
         try:
-            res = self._dispatch(items)
+            with tracing.span(
+                "tpu.dispatch", batch=gen, lanes=len(items),
+            ):
+                res = self._dispatch(items)
             # park a waiter on the device result NOW — the tunneled
             # runtime only drives a queued execution to completion
             # while a host thread blocks in its wait (see _FlushResult)

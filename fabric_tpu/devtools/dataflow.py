@@ -167,15 +167,17 @@ _UNKNOWN_LOCK = "?"
 _GOSSIP_SINK_SCOPE = "fabric_tpu/gossip/"
 
 
-# the chaos seams: their blocking calls (faultline.write's torn-path
-# flush, clockskew/faultline injected sleeps) only execute under an
-# armed plan or a virtual clock — with nothing armed every fault point
-# is a no-op, so their blocking-io summaries must not propagate into
-# callers (mirror of the PR 6 decision that faultline.* is transparent
-# to exception-discipline)
+# the chaos/observability seams: their blocking calls (faultline.
+# write's torn-path flush, clockskew/faultline injected sleeps,
+# tracing's flight-recorder dump/export I/O) only execute under an
+# armed plan / virtual clock / armed tracer — with nothing armed every
+# seam call is a no-op, so their blocking-io summaries must not
+# propagate into callers (mirror of the PR 6 decision that faultline.*
+# is transparent to exception-discipline)
 _CHAOS_SEAM = (
     "fabric_tpu/devtools/faultline.py",
     "fabric_tpu/devtools/clockskew.py",
+    "fabric_tpu/common/tracing.py",
 )
 
 

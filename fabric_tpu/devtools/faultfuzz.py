@@ -393,18 +393,30 @@ def _canonical_trips(trips: list[dict], label: str) -> list[dict]:
 def run_plan(plan: dict, workdir: str, blocks: int = DEFAULT_BLOCKS,
              comm: bool = True) -> dict:
     """Drive the workload under `plan` in `workdir`, then judge with
-    the plan disarmed.  Returns {"trips", "violations", "stats"}."""
+    the plan disarmed.  Returns {"trips", "violations", "stats"} —
+    plus "trace" (the flight-recorder export for THIS plan's run) when
+    tracelens is armed: the recorder and its id counter reset before
+    the drive, so same-seed plans replay to identical span sequences
+    and a failing plan's dump can ship beside its repro artifact."""
+    from fabric_tpu.common import tracing
+
     os.makedirs(workdir, exist_ok=True)
     parsed = faultline.Plan(plan)
+    if tracing.enabled():
+        tracing.reset()
     with faultline.use_plan(parsed):
         stats = _drive(workdir, blocks, comm=comm)
         trips = _canonical_trips(faultline.trips(), parsed.label)
+    trace = tracing.export() if tracing.enabled() else None
     violations = _judge(workdir, stats, workload_writes(blocks))
-    return {
+    out = {
         "trips": trips,
         "violations": [v.as_dict() for v in violations],
         "stats": stats,
     }
+    if trace is not None:
+        out["trace"] = trace
+    return out
 
 
 # -- plan generation ----------------------------------------------------------
@@ -527,6 +539,14 @@ def shrink_plan(plan: dict, still_fails, max_runs: int = 16):
 REPRO_FORMAT = "faultfuzz-repro-v1"
 
 
+def write_trace_doc(path: str, doc: dict) -> str:
+    """Write a flight-recorder export (Chrome trace JSON) beside its
+    repro artifact — one serialization, owned by the tracing module."""
+    from fabric_tpu.common import tracing
+
+    return tracing.dump_doc(path, doc)
+
+
 def write_repro(path: str, plan: dict, original: dict, violations: list,
                 trips: list, seed: int, index: int,
                 blocks: int = DEFAULT_BLOCKS) -> str:
@@ -575,7 +595,7 @@ class Campaign:
     def __init__(self, seed: int = 7, plans: int = 25,
                  workdir: str | None = None, out_dir: str = ".faultfuzz",
                  blocks: int = DEFAULT_BLOCKS, shrink: bool = True,
-                 comm: bool = True):
+                 comm: bool = True, trace_dir: str | None = None):
         self.seed = int(seed)
         self.plans = int(plans)
         self.workdir = workdir
@@ -583,6 +603,9 @@ class Campaign:
         self.blocks = blocks
         self.shrink = shrink
         self.comm = comm
+        # where failing plans' flight-recorder dumps land (next to the
+        # repro JSON by default); only written while tracelens is armed
+        self.trace_dir = trace_dir
 
     def discover(self, root: str) -> dict:
         """Run the workload once under the observer plan to enumerate
@@ -613,6 +636,7 @@ class Campaign:
         results = []
         ledger: list[dict] = []
         repro_paths: list[str] = []
+        trace_paths: list[str] = []
         for i in range(self.plans):
             rng = random.Random(f"{self.seed}:{i}")
             label = f"fuzz:{self.seed}:{i}"
@@ -658,6 +682,19 @@ class Campaign:
                 entry["shrunk"] = shrunk
                 entry["repro"] = path
                 repro_paths.append(path)
+                if res.get("trace") is not None:
+                    # the ORIGINAL failing run's flight recorder, next
+                    # to the repro artifact: what the pipeline was doing
+                    # in the spans before the oracle violation
+                    entry["trace"] = write_trace_doc(
+                        os.path.join(
+                            self.trace_dir or self.out_dir,
+                            f"repro_seed{self.seed}_plan{i:03d}"
+                            ".trace.json",
+                        ),
+                        res["trace"],
+                    )
+                    trace_paths.append(entry["trace"])
             results.append(entry)
             ledger.extend(res["trips"])
         failures = sum(1 for e in results if e["verdict"] == "fail")
@@ -672,6 +709,7 @@ class Campaign:
             "trips_total": len(ledger),
             "trip_ledger": ledger,
             "repro": repro_paths,
+            "trace": trace_paths,
             "results": results,
         }
 
@@ -684,6 +722,7 @@ __all__ = [
     "generate_plan",
     "shrink_plan",
     "write_repro",
+    "write_trace_doc",
     "replay",
     "Campaign",
 ]

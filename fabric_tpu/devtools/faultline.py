@@ -410,6 +410,20 @@ class Plan:
                 with _trips_lock:
                     _trips.append(rec)
                     _trip_owners.append(id(self))
+                # tracelens: a tripped fault annotates the active span
+                # and drops an instant mark, so a flight-recorder dump
+                # shows exactly which stage the injection landed in
+                # (lazy import: tracing is a pure common/devtools leaf,
+                # but faultline must stay importable first)
+                from fabric_tpu.common import tracing
+
+                if tracing.enabled():
+                    tracing.annotate(fault=name, fault_action=winner.action)
+                    tracing.instant(
+                        "fault", point=name, action=winner.action,
+                        plan=self.label, rule=winner.index,
+                        trip=winner.trips,
+                    )
         return winner
 
 

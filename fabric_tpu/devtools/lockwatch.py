@@ -86,6 +86,17 @@ def _raise_mode() -> bool:
     return os.environ.get(_ENV, "") != "record"
 
 
+def _trace_note(kind: str, event: dict) -> None:
+    """Mirror a recorded violation into the tracelens flight recorder
+    (an instant mark on the active span), so a trace dump shows the
+    sanitizer finding in causal context next to the spans that led to
+    it.  No-op unless tracing is armed."""
+    from fabric_tpu.common import tracing
+
+    if tracing.enabled():
+        tracing.instant(kind, **{k: str(v) for k, v in event.items()})
+
+
 def reset() -> None:
     """Clear the graph and recorded violations (tests)."""
     with _state_lock:
@@ -148,6 +159,7 @@ class WatchedLock:
                     }
                     with _state_lock:
                         violations.append(bad)
+                        _trace_note("lockwatch.violation", bad)
                     if _raise_mode():
                         raise LockOrderError(
                             "self-deadlock: blocking re-acquire of "
@@ -186,6 +198,7 @@ class WatchedLock:
                         "thread": threading.current_thread().name,
                     }
                     violations.append(bad)
+                    _trace_note("lockwatch.violation", bad)
                     break
                 pending.append(h)
             if bad is None and record_now:
@@ -226,6 +239,7 @@ class WatchedLock:
             }
             with _state_lock:
                 violations.append(bad)
+                _trace_note("lockwatch.violation", bad)
             if _raise_mode():
                 # refuse deterministically (inner stays held: the
                 # pattern is unsupported and the test run must fail
@@ -287,6 +301,7 @@ def guarded(obj, field: str, *, by: str) -> None:
     }
     with _state_lock:
         violations.append(bad)
+        _trace_note("lockwatch.violation", bad)
     if _raise_mode():
         raise LockOrderError(
             f"unguarded access: {type(obj).__name__}.{field} requires "
@@ -360,6 +375,7 @@ class WatchedCondition:
                         "thread": threading.current_thread().name,
                     }
                     violations.append(bad)
+                    _trace_note("lockwatch.violation", bad)
                     break
         if bad is not None and _raise_mode():
             raise LockOrderError(
@@ -477,12 +493,14 @@ def _wrap_target(cell: dict, kind: str, target):
             target(*a, **k)
         except BaseException as exc:
             with _threads_lock:
-                thread_violations.append({
+                bad = {
                     "event": "unhandled-exception",
                     "thread": t.name,
                     "kind": kind,
                     "error": repr(exc),
-                })
+                }
+                thread_violations.append(bad)
+            _trace_note("threadwatch.violation", bad)
             raise
         finally:
             _deregister(t)

@@ -25,6 +25,7 @@ import struct
 import threading
 
 from fabric_tpu.comm.backoff import DecorrelatedBackoff
+from fabric_tpu.common import tracing
 from fabric_tpu.devtools import clockskew, faultline
 from fabric_tpu.devtools.lockwatch import named_lock, spawn_thread
 from fabric_tpu.protos.gossip import message_pb2 as gpb
@@ -135,17 +136,25 @@ class GossipComm:
         if not self.mcs.verify(ident, signed.signature, signed.payload):
             return  # forged or unsigned
         rm = ReceivedMessage(msg, sender_pki, respond)
-        for h in list(self._subscribers):
-            try:
-                h(rm)
-            except Exception:
-                # one subscriber's bug must not starve the others or
-                # tear down the connection's serving loop
-                from fabric_tpu.common.flogging import must_get_logger
+        # one span per inbound dispatch: in-process transports call
+        # _dispatch on the sender's thread, so it nests under the
+        # sender's span; socket transports root a fresh trace here
+        with tracing.span(
+            "gossip.deliver",
+            content=msg.WhichOneof("content") or "",
+            subscribers=len(self._subscribers),
+        ):
+            for h in list(self._subscribers):
+                try:
+                    h(rm)
+                except Exception:
+                    # one subscriber's bug must not starve the others
+                    # or tear down the connection's serving loop
+                    from fabric_tpu.common.flogging import must_get_logger
 
-                must_get_logger("gossip.comm").warning(
-                    "gossip subscriber raised", exc_info=True
-                )
+                    must_get_logger("gossip.comm").warning(
+                        "gossip subscriber raised", exc_info=True
+                    )
 
 
 class InProcGossipNet:
@@ -253,7 +262,11 @@ class TCPGossipComm(GossipComm):
                     name=f"gossip-send-{to_endpoint}", kind="service",
                 ).start()
         try:
-            q.put_nowait(self.wrap(msg).SerializeToString())
+            # the caller's span context rides the queue item so the
+            # sender thread's gossip.send span joins the caller's trace
+            q.put_nowait(
+                (self.wrap(msg).SerializeToString(), tracing.current())
+            )
         except queue.Full:
             pass  # gossip is loss-tolerant
 
@@ -279,7 +292,7 @@ class TCPGossipComm(GossipComm):
         bo = DecorrelatedBackoff.for_key(f"{self.endpoint}->{endpoint}")
         while not self._stop.is_set():
             try:
-                data = q.get(timeout=0.5)
+                data, trace_ctx = q.get(timeout=0.5)
             except queue.Empty:
                 continue
             for _ in range(2):  # one reconnect attempt per message
@@ -304,7 +317,10 @@ class TCPGossipComm(GossipComm):
                         clockskew.wait(self._stop, bo.next())
                         break
                 try:
-                    sock.sendall(_LEN.pack(len(data)) + data)
+                    with tracing.attached(trace_ctx), tracing.span(
+                        "gossip.send", endpoint=endpoint, n=len(data),
+                    ):
+                        sock.sendall(_LEN.pack(len(data)) + data)
                     # only a completed DATA send proves the link: an
                     # accept-then-reset peer must not restart the
                     # backoff sequence every flap

@@ -12,6 +12,7 @@ import os
 import threading
 import time
 
+from fabric_tpu.common import tracing
 from fabric_tpu.devtools import faultline
 from fabric_tpu.devtools.lockwatch import guarded, named_rlock
 from fabric_tpu.ledger.blkstorage import BlockStore, BlockStoreError
@@ -55,6 +56,10 @@ class CommitAssist:
     footprints: list  # per-tx RwsetFootprint | None
     txids: list  # per-tx txid str | None
     env_bytes: list | None = None  # the block's envelope byte strings
+    # the validator's per-block trace root (tracing.SpanContext | None):
+    # the committer thread attaches it so commit-stage spans join the
+    # block's trace across the pipeline hop
+    trace_ctx: object | None = None
 
 
 @dataclasses.dataclass
@@ -405,58 +410,58 @@ class KVLedger:
             env_bytes = assist.env_bytes
         if rwsets is None or len(rwsets) != len(flags):
             rwsets = extract_rwsets(block)
+        num = block.header.number
         t0 = t()
         # group.mvcc reads through the collector overlay, so a block
-        # sees the buffered writes of earlier blocks in its group
-        batch = group.mvcc.validate_and_prepare(
-            block.header.number, rwsets, flags, pvt_data,
-            footprints=footprints,
-        )
-        protoutil.set_tx_filter(block, flags)
-        # stage-boundary fault points: an injected crash lands AFTER the
-        # named stage's work (the any-stage crash matrix in
-        # tests/test_chaos_commit.py drives every one of these)
-        faultline.point(
-            "commit.stage", stage="mvcc", block=block.header.number
-        )
+        # sees the buffered writes of earlier blocks in its group.
+        # Stage spans join the validator's per-block trace when the
+        # committer thread attached the CommitAssist context; the
+        # stage-boundary fault points stay INSIDE each span so injected
+        # trips annotate the stage they landed in.
+        with tracing.span("mvcc", cat="stage", block=num):
+            batch = group.mvcc.validate_and_prepare(
+                num, rwsets, flags, pvt_data,
+                footprints=footprints,
+            )
+            protoutil.set_tx_filter(block, flags)
+            # stage-boundary fault points: an injected crash lands AFTER
+            # the named stage's work (the any-stage crash matrix in
+            # tests/test_chaos_commit.py drives every one of these)
+            faultline.point("commit.stage", stage="mvcc", block=num)
         t1 = t()
-        file_idx = self._blocks.add_block(
-            block, txids=txids, env_bytes=env_bytes,
-            into=group.collector, sync=False,
-        )
-        if file_idx is not None:
-            group.dirty_files.add(file_idx)
-        faultline.point(
-            "commit.stage", stage="block_append", block=block.header.number
-        )
+        with tracing.span("block_append", cat="stage", block=num):
+            file_idx = self._blocks.add_block(
+                block, txids=txids, env_bytes=env_bytes,
+                into=group.collector, sync=False,
+            )
+            if file_idx is not None:
+                group.dirty_files.add(file_idx)
+            faultline.point(
+                "commit.stage", stage="block_append", block=num
+            )
         t2 = t()
         # Pvt store and state ride the SAME atomic KV transaction (with
         # the savepoint), so recovery never sees state ahead of the pvt
         # store; a crash losing the whole txn loses both together, and
         # _recover's replay records reconciler missing-data entries for
         # cleartext that went down with an unflushed group.
-        self.pvt_store.commit(
-            block.header.number, pvt_data or {}, missing_pvt,
-            into=group.collector,
-        )
-        faultline.point(
-            "commit.stage", stage="pvt", block=block.header.number
-        )
+        with tracing.span("pvt", cat="stage", block=num):
+            self.pvt_store.commit(
+                num, pvt_data or {}, missing_pvt,
+                into=group.collector,
+            )
+            faultline.point("commit.stage", stage="pvt", block=num)
         t3 = t()
-        group.state.apply_updates(
-            batch, Height(block.header.number, len(flags))
-        )
-        faultline.point(
-            "commit.stage", stage="state", block=block.header.number
-        )
+        with tracing.span("state", cat="stage", block=num):
+            group.state.apply_updates(batch, Height(num, len(flags)))
+            faultline.point("commit.stage", stage="state", block=num)
         t4 = t()
-        self._history.commit(
-            block.header.number, _history_writes(rwsets, flags, footprints),
-            into=group.collector,
-        )
-        faultline.point(
-            "commit.stage", stage="history", block=block.header.number
-        )
+        with tracing.span("history", cat="stage", block=num):
+            self._history.commit(
+                num, _history_writes(rwsets, flags, footprints),
+                into=group.collector,
+            )
+            faultline.point("commit.stage", stage="history", block=num)
         t5 = t()
         group.blocks += 1
         group.snap_notify.append(block.header.number)
@@ -483,13 +488,27 @@ class KVLedger:
         # commit lock
         guarded(self, "_active_group", by="kvledger.commit_lock")
         if group.blocks:
+            # flush spans are attributed to the group's boundary block
+            # so the bench critical-path summary can charge the fsync/
+            # kv_txn wall time to the block whose flush paid it
+            boundary = (
+                group.snap_notify[-1] if group.snap_notify else None
+            )
             t0 = time.perf_counter()
             try:
-                self._blocks.sync_files(group.dirty_files)
-                faultline.point("commit.stage", stage="fsync")
+                with tracing.span(
+                    "fsync", cat="stage", block=boundary,
+                    blocks=group.blocks,
+                ):
+                    self._blocks.sync_files(group.dirty_files)
+                    faultline.point("commit.stage", stage="fsync")
                 t1 = time.perf_counter()
-                group.collector.flush()
-                faultline.point("commit.stage", stage="kv_txn")
+                with tracing.span(
+                    "kv_txn", cat="stage", block=boundary,
+                    blocks=group.blocks,
+                ):
+                    group.collector.flush()
+                    faultline.point("commit.stage", stage="kv_txn")
             except BaseException as exc:
                 # roll the WHOLE group back so the live ledger stays
                 # consistent with committed storage: the buffered index

@@ -45,6 +45,7 @@ import struct
 import threading
 import time
 
+from fabric_tpu.common import tracing
 from fabric_tpu.devtools import faultline
 from fabric_tpu.devtools.lockwatch import (
     guarded,
@@ -238,7 +239,21 @@ def generate_snapshot(
 ) -> str:
     """Export the ledger into <snapshots_root>/completed/<id>/<height-1>
     and return the snapshot directory.  Deterministic: same ledger state
-    -> byte-identical files -> identical signable metadata."""
+    -> byte-identical files -> identical signable metadata.  The whole
+    export runs under one trace span (per-stage progress lands as
+    instant marks at the faultline stage points), so a trace shows
+    whether an export overlapped or serialized behind the next commit."""
+    with tracing.span(
+        "snapshot.export", cat="stage",
+        channel=getattr(ledger, "ledger_id", ""),
+        block=max(0, getattr(ledger, "durable_height", ledger.height) - 1),
+    ):
+        return _generate_snapshot(ledger, snapshots_root, csp, metrics)
+
+
+def _generate_snapshot(
+    ledger, snapshots_root: str, csp=None, metrics=None
+) -> str:
     if not snapshots_root:
         raise SnapshotError("ledger provider has no snapshots directory")
     # export the DURABLE height: under group commit the in-memory
@@ -293,11 +308,13 @@ def generate_snapshot(
     # atomic-rename contract says completed/ never holds a partial
     # snapshot, which the faultfuzz oracle verifies
     faultline.point("snapshot.export.stage", stage="state", channel=lid)
+    tracing.instant("snapshot.stage", stage="state", channel=lid)
     write_records(
         os.path.join(work, TXIDS_FILE),
         ((t.encode(), b"") for t in store.export_txids()),
     )
     faultline.point("snapshot.export.stage", stage="txids", channel=lid)
+    tracing.instant("snapshot.stage", stage="txids", channel=lid)
     write_records(
         os.path.join(work, CONFIG_HISTORY_FILE),
         ledger.config_history.export_entries(),
@@ -305,6 +322,7 @@ def generate_snapshot(
     faultline.point(
         "snapshot.export.stage", stage="confighistory", channel=lid
     )
+    tracing.instant("snapshot.stage", stage="confighistory", channel=lid)
     cfg_raw = store.config_block_bytes()
     if cfg_raw is None:
         blk0 = store.get_block_by_number(0)
@@ -318,9 +336,11 @@ def generate_snapshot(
     faultline.point(
         "snapshot.export.stage", stage="config_block", channel=lid
     )
+    tracing.instant("snapshot.stage", stage="config_block", channel=lid)
 
     files = _hash_files(work, DATA_FILES, csp, metrics, channel=lid)
     faultline.point("snapshot.export.stage", stage="hash", channel=lid)
+    tracing.instant("snapshot.stage", stage="hash", channel=lid)
     last_blk = store.get_block_by_number(last_num)
     sp = state.savepoint()
     last_hash = getattr(ledger, "durable_block_hash", None)
@@ -356,6 +376,7 @@ def generate_snapshot(
         )
 
     faultline.point("snapshot.export.stage", stage="rename", channel=lid)
+    tracing.instant("snapshot.stage", stage="rename", channel=lid)
     os.makedirs(os.path.dirname(final_dir), exist_ok=True)
     os.replace(work, final_dir)
     if metrics is not None:

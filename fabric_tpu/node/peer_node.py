@@ -267,6 +267,11 @@ class PeerNode:
                 # TPU provider: surface degraded-mode circuit-breaker
                 # state/trips on this node's /metrics endpoint
                 csp.set_metrics(self.operations.csp_metrics())
+            # shared host work pool: queue-depth / in-flight /
+            # saturation gauges for the parallel collect/prepare stages
+            from fabric_tpu.common import workpool
+
+            workpool.set_metrics(self.operations.workpool_metrics())
         self.provider = LedgerProvider(
             root_dir,
             csp=csp,

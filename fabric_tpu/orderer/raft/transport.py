@@ -32,6 +32,7 @@ import struct
 import threading
 
 from fabric_tpu.comm.backoff import BackoffGate
+from fabric_tpu.common import tracing
 from fabric_tpu.common.flogging import must_get_logger
 from fabric_tpu.devtools import faultline
 from fabric_tpu.devtools.lockwatch import spawn_thread
@@ -122,7 +123,10 @@ class OutboundConn:
 
     def send(self, data: bytes) -> None:
         try:
-            self.q.put_nowait(data)
+            # the enqueuer's span context rides the queue item so the
+            # sender thread's raft.send span joins the caller's trace
+            # (None on the untraced path — one tuple either way)
+            self.q.put_nowait((data, tracing.current()))
             self._drop_episode = False
         except queue.Full:
             # raft retransmits, so dropping beats blocking consensus —
@@ -177,7 +181,7 @@ class OutboundConn:
     def _run(self) -> None:
         while not self._stop.is_set():
             try:
-                data = self.q.get(timeout=0.5)
+                data, trace_ctx = self.q.get(timeout=0.5)
             except queue.Empty:
                 continue
             if self._sock is None:
@@ -193,7 +197,10 @@ class OutboundConn:
                     continue
                 self._gate.clear()
             try:
-                self._sock.sendall(_LEN.pack(len(data)) + data)
+                with tracing.attached(trace_ctx), tracing.span(
+                    "raft.send", peer=self.peer_id, n=len(data),
+                ):
+                    self._sock.sendall(_LEN.pack(len(data)) + data)
                 # only a COMPLETED send proves the link: resetting on
                 # connect alone would let an accept-then-reset peer
                 # restart the backoff sequence every flap

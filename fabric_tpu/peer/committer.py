@@ -19,6 +19,7 @@ import queue
 import threading
 import time
 
+from fabric_tpu.common import tracing
 from fabric_tpu.devtools.lockwatch import spawn_thread
 
 
@@ -132,7 +133,9 @@ class Committer:
                 blk, release_txids, assist = item
                 try:
                     flushed = False
-                    with self._lock:
+                    with self._lock, tracing.attached(
+                        getattr(assist, "trace_ctx", None)
+                    ):
                         self._ledger.commit(blk, assist=assist, group=group)
                         grouped.append((blk, release_txids))
                         # boundary_hint: a buffered block carries a

@@ -17,6 +17,7 @@ import collections
 import random
 import threading
 
+from fabric_tpu.common import tracing
 from fabric_tpu.devtools import clockskew, faultline
 from fabric_tpu.devtools.lockwatch import spawn_thread
 
@@ -94,9 +95,18 @@ class DeliverClient:
                     if self._stop.is_set():
                         return
                     faultline.point("deliver.read", block=blk.header.number)
-                    if not self._verify(blk):
-                        break  # bad orderer: switch endpoints
-                    self._sink(blk.header.number, blk.SerializeToString())
+                    # one span per delivered block: verify + sink hand-
+                    # off (gossip add_payload / direct commit) — the
+                    # deliver leg of the block's journey into the ledger
+                    with tracing.span(
+                        "deliver.block", block=blk.header.number,
+                        channel=self.channel_id,
+                    ):
+                        if not self._verify(blk):
+                            break  # bad orderer: switch endpoints
+                        self._sink(
+                            blk.header.number, blk.SerializeToString()
+                        )
                     backoff = 0.1
             except Exception:
                 # fabriclint: allow[exception-discipline] reconnect loop: ANY

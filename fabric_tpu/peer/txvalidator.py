@@ -29,7 +29,7 @@ import os
 import threading
 import time
 
-from fabric_tpu.common import workpool
+from fabric_tpu.common import tracing, workpool
 from fabric_tpu.devtools import faultline
 from fabric_tpu.peer.validation_plugins import (
     IllegalWritesetError,
@@ -489,8 +489,10 @@ class TxValidator:
     # -- the three-phase validate -----------------------------------------
 
     def validate(self, block: common_pb2.Block) -> list[int]:
-        block, flags, works, collect, _envs = self._start_block(block, set())
-        return self._finish_block(block, flags, works, collect)
+        block, flags, works, collect, _envs, bspan = self._start_block(
+            block, set()
+        )
+        return self._finish_block(block, flags, works, collect, bspan)
 
     def validate_pipeline(self, blocks, depth: int = 2, release=None,
                           rwsets_out=None):
@@ -521,8 +523,8 @@ class TxValidator:
         seen_txids: set[str] = set()
 
         def finish(started):
-            block, flags, works, collect, envs, txids = started
-            flags = self._finish_block(block, flags, works, collect)
+            block, flags, works, collect, envs, bspan, txids = started
+            flags = self._finish_block(block, flags, works, collect, bspan)
             if rwsets_out is not None:
                 # ONE per-block assist bundle: the marshaled rwsets, the
                 # already-decoded footprints (MVCC + history reuse), the
@@ -536,6 +538,10 @@ class TxValidator:
                         footprints=[w.footprint for w in works],
                         txids=[w.txid for w in works],
                         env_bytes=envs,
+                        # carries the block's trace root onto the
+                        # committer thread so the commit stages join
+                        # the same per-block trace
+                        trace_ctx=bspan.ctx,
                     )
                 )
             if release is None:
@@ -568,63 +574,91 @@ class TxValidator:
     def _start_block(self, block: common_pb2.Block, seen_txids: set):
         """Phases 1+2: collect every tx, dispatch the device verify."""
         t0 = time.perf_counter()
-        envs = list(block.data.data)  # ONE materialization of the
-        # envelope byte strings (each repeated-field access copies)
-        n = len(envs)
-        flags = [V.NOT_VALIDATED] * n
-        works = [_TxWork() for _ in range(n)]
-        sink = _ItemSink(dedup=not self._faithful)
-
-        memo: dict = {}  # per-block creator-identity memo
-        self._policy_provider.begin_block()
-        raw_meta = self._ns_meta
-        if raw_meta is not None:
-            meta_memo: dict = {}
-
-            def ns_meta(ns, _memo=meta_memo, _raw=raw_meta):
-                v = _memo.get(ns)
-                if v is None:
-                    v = _memo[ns] = _raw(ns)
-                return v
-
-            self._ns_meta_block = ns_meta
-        else:
-            self._ns_meta_block = None
-        native = self._collect_native(
-            envs, seen_txids, sink, works, flags, memo
+        num = block.header.number
+        # detached per-block root: its children (collect here,
+        # verify_wait/policy in _finish_block, the commit stages on the
+        # committer thread via CommitAssist.trace_ctx) attach explicitly
+        # — blocks overlap in the pipeline, so the root cannot live on
+        # this thread's span stack
+        bspan = tracing.begin(
+            "block", detach=True, cat="pipeline", block=num,
         )
-        if not native:
-            width = self._collect_fanout(n)
-            if width:
-                # fan the pure parse half out in deterministic chunks;
-                # integration (sink indices, dup window, policy prepare)
-                # stays on this thread in strict tx order
-                memo_lock = threading.Lock()
-                parsed = workpool.run_chunked(
-                    self._collect_pool or workpool.default_pool(),
-                    lambda off, chunk: [
-                        self._parse_tx(e, memo, memo_lock) for e in chunk
-                    ],
-                    envs, width,
-                )
-                self.parallel_collect_blocks += 1
-                for i in range(n):
-                    flags[i] = self._integrate_tx(
-                        parsed[i], seen_txids, sink, works[i]
-                    )
+        try:
+            return self._start_block_traced(
+                block, seen_txids, bspan, num, t0
+            )
+        except BaseException:
+            # detached roots are off the stack-repair path: end the
+            # block root here or a crash mid-collect leaves every
+            # recorded stage span pointing at a parent id absent from
+            # the flight-recorder dump — the one trace that matters
+            bspan.annotate(aborted=True)
+            bspan.end()
+            raise
+
+    def _start_block_traced(self, block, seen_txids, bspan, num, t0):
+        with tracing.attached(bspan.ctx), tracing.span(
+            "collect", cat="stage", block=num,
+        ):
+            envs = list(block.data.data)  # ONE materialization of the
+            # envelope byte strings (each repeated-field access copies)
+            n = len(envs)
+            flags = [V.NOT_VALIDATED] * n
+            works = [_TxWork() for _ in range(n)]
+            sink = _ItemSink(dedup=not self._faithful)
+
+            memo: dict = {}  # per-block creator-identity memo
+            self._policy_provider.begin_block()
+            raw_meta = self._ns_meta
+            if raw_meta is not None:
+                meta_memo: dict = {}
+
+                def ns_meta(ns, _memo=meta_memo, _raw=raw_meta):
+                    v = _memo.get(ns)
+                    if v is None:
+                        v = _memo[ns] = _raw(ns)
+                    return v
+
+                self._ns_meta_block = ns_meta
             else:
-                for i in range(n):
-                    flags[i] = self._collect_tx(
-                        envs[i], seen_txids, sink, works[i], memo
+                self._ns_meta_block = None
+            native = self._collect_native(
+                envs, seen_txids, sink, works, flags, memo
+            )
+            if not native:
+                width = self._collect_fanout(n)
+                if width:
+                    # fan the pure parse half out in deterministic
+                    # chunks; integration (sink indices, dup window,
+                    # policy prepare) stays on this thread in strict
+                    # tx order
+                    memo_lock = threading.Lock()
+                    parsed = workpool.run_chunked(
+                        self._collect_pool or workpool.default_pool(),
+                        lambda off, chunk: [
+                            self._parse_tx(e, memo, memo_lock)
+                            for e in chunk
+                        ],
+                        envs, width,
                     )
+                    self.parallel_collect_blocks += 1
+                    for i in range(n):
+                        flags[i] = self._integrate_tx(
+                            parsed[i], seen_txids, sink, works[i]
+                        )
+                else:
+                    for i in range(n):
+                        flags[i] = self._collect_tx(
+                            envs[i], seen_txids, sink, works[i], memo
+                        )
 
-        collect = (
-            self._csp.verify_batch_async(sink.items)
-            if sink.items
-            else (lambda: [])
-        )
+            collect = (
+                self._csp.verify_batch_async(sink.items)
+                if sink.items
+                else (lambda: [])
+            )
         self._observe_stage("collect", time.perf_counter() - t0)
-        return block, flags, works, collect, envs
+        return block, flags, works, collect, envs, bspan
 
     def _collect_native(self, data, seen_txids, sink: _ItemSink, works, flags, memo: dict) -> bool:
         """Native-assisted collect: one C++ pass walks every envelope's
@@ -897,10 +931,33 @@ class TxValidator:
                 "channel", self.channel_id, "stage", stage
             ).observe(dt)
 
-    def _finish_block(self, block, flags, works, collect) -> list[int]:
+    def _finish_block(self, block, flags, works, collect,
+                      bspan=None) -> list[int]:
+        # the per-block root must reach the recorder even when verify
+        # or policy raises (injected crashes included) — crash traces
+        # are exactly where the causal root matters
+        try:
+            return self._finish_block_traced(
+                block, flags, works, collect, bspan
+            )
+        except BaseException:
+            if bspan is not None:
+                bspan.annotate(aborted=True)
+            raise
+        finally:
+            if bspan is not None:
+                bspan.end()
+
+    def _finish_block_traced(self, block, flags, works, collect,
+                             bspan) -> list[int]:
         n = len(flags)
+        ctx = None if bspan is None else bspan.ctx
+        num = block.header.number
         t0 = time.perf_counter()
-        mask = collect()
+        with tracing.attached(ctx), tracing.span(
+            "verify_wait", cat="stage", block=num,
+        ):
+            mask = collect()
         t1 = time.perf_counter()
         self._observe_stage("verify_wait", t1 - t0)
 
@@ -914,25 +971,29 @@ class TxValidator:
         # ENDORSEMENT_POLICY_FAILURE, never re-evaluated under the new
         # policy).
         updated: set[tuple[str, str]] = set()
-        for i in range(n):
-            if flags[i] != V.VALID:
-                continue
-            w = works[i]
-            if w.creator_item is not None and not mask[w.creator_item]:
-                flags[i] = V.BAD_CREATOR_SIGNATURE
-                continue
-            if w.touched_keys & updated:
-                flags[i] = V.ENDORSEMENT_POLICY_FAILURE
-                continue
-            ok = all(
-                p.finish([mask[j] for j in idxs]) for p, idxs in w.pendings
-            )
-            if not ok:
-                flags[i] = V.ENDORSEMENT_POLICY_FAILURE
-                continue
-            updated.update(w.meta_keys)
+        with tracing.attached(ctx), tracing.span(
+            "policy", cat="stage", block=num,
+        ):
+            for i in range(n):
+                if flags[i] != V.VALID:
+                    continue
+                w = works[i]
+                if w.creator_item is not None and not mask[w.creator_item]:
+                    flags[i] = V.BAD_CREATOR_SIGNATURE
+                    continue
+                if w.touched_keys & updated:
+                    flags[i] = V.ENDORSEMENT_POLICY_FAILURE
+                    continue
+                ok = all(
+                    p.finish([mask[j] for j in idxs])
+                    for p, idxs in w.pendings
+                )
+                if not ok:
+                    flags[i] = V.ENDORSEMENT_POLICY_FAILURE
+                    continue
+                updated.update(w.meta_keys)
 
-        protoutil.set_tx_filter(block, bytes(flags))
+            protoutil.set_tx_filter(block, bytes(flags))
         self._observe_stage("policy", time.perf_counter() - t1)
         return flags
 

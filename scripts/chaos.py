@@ -53,7 +53,20 @@ def main() -> int:
     ap.add_argument("--replay", default=None, metavar="FILE",
                     help="re-arm a repro artifact instead of fuzzing; "
                          "exit 0 iff the failure reproduces")
+    ap.add_argument("--trace-dir", default=None, metavar="DIR",
+                    help="arm tracelens for the campaign and write each "
+                         "failing plan's flight-recorder dump (Chrome "
+                         "trace JSON) into DIR beside the repro paths "
+                         "(FABRIC_TPU_TRACE also arms it; dumps then "
+                         "default beside the repro JSON in --out)")
     args = ap.parse_args()
+
+    from fabric_tpu.common import tracing  # noqa: E402
+
+    if args.trace_dir and not tracing.enabled():
+        # don't clobber an env-armed recorder: FABRIC_TPU_TRACE=N may
+        # have sized the ring larger than the default
+        tracing.arm()
 
     t0 = time.perf_counter()
     if args.replay:
@@ -73,13 +86,23 @@ def main() -> int:
             "trips": len(res["trips"]),
             "seconds": round(time.perf_counter() - t0, 4),
         }
+        if res.get("trace") is not None:
+            # same fallback as the campaign path: --trace-dir when
+            # given, else beside the repro artifacts in --out
+            out["trace"] = faultfuzz.write_trace_doc(
+                os.path.join(
+                    args.trace_dir or args.out,
+                    os.path.basename(args.replay) + ".trace.json",
+                ),
+                res["trace"],
+            )
         print(json.dumps(out))
         return 0 if res["violations"] else 1
 
     campaign = faultfuzz.Campaign(
         seed=args.seed, plans=args.plans, blocks=args.blocks,
         out_dir=args.out, shrink=not args.no_shrink,
-        comm=not args.no_comm,
+        comm=not args.no_comm, trace_dir=args.trace_dir,
     )
     summary = campaign.run()
     ledger_digest = hashlib.sha256(
@@ -96,6 +119,7 @@ def main() -> int:
         "trips_total": summary["trips_total"],
         "trip_ledger_sha256": ledger_digest,
         "repro": summary["repro"],
+        "trace": summary.get("trace", []),
         "seconds": round(time.perf_counter() - t0, 4),
     }
     print(json.dumps(out))

@@ -1,0 +1,17 @@
+"""CLEAN TWIN of fix_tracing_dirty: the same commit-lock shape calling
+the REAL tracing seam instead.  tracing.dump_to's flush only runs when
+a caller explicitly dumps the armed flight recorder, and the module is
+a reviewed chaos seam (dataflow._CHAOS_SEAM) — its blocking summary
+must not propagate into lock-discipline for callers."""
+
+from fabric_tpu.common import tracing
+
+
+class Ledger:
+    def __init__(self, lock):
+        self.commit_lock = lock
+
+    def commit(self):
+        with self.commit_lock:
+            tracing.instant("commit.mark", stage="fixture")
+            tracing.dump_to("/tmp/fixture-trace.json")

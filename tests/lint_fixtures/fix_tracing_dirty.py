@@ -1,0 +1,20 @@
+"""SEEDED VIOLATION (lock-discipline): a homegrown "trace dump" helper
+doing real blocking I/O is NOT the reviewed tracing seam — reaching it
+while holding the commit lock must fire.  Paired with
+fix_tracing_clean.py, this pins that the chaos-seam exemption is scoped
+to fabric_tpu/common/tracing.py itself, not to anything trace-shaped."""
+
+
+def dump_spans(fh, doc: str) -> None:
+    fh.write(doc)
+    fh.flush()  # blocking: summarized, and NOT seam-exempt
+
+
+class Ledger:
+    def __init__(self, lock, fh):
+        self.commit_lock = lock
+        self._fh = fh
+
+    def commit(self):
+        with self.commit_lock:
+            dump_spans(self._fh, "{}")  # <- lock-discipline fires HERE

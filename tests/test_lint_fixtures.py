@@ -456,6 +456,52 @@ def test_faultline_seam_keeps_reviewed_pragmas_used():
     )
 
 
+# -- lock-discipline: the tracing seam is transparent ------------------------
+
+
+def _real_tracing_source() -> str:
+    """The REAL tracelens module source, mapped at its true tree path —
+    the transparency being tested is path-scoped to it."""
+    import fabric_tpu.common.tracing as _tr
+
+    with open(_tr.__file__, "r", encoding="utf-8") as f:
+        return f.read()
+
+
+def test_tracing_seam_transparent_to_blocking_under_lock():
+    """Calling the armed-only tracing seam (whose dump path flushes —
+    a blocking summary) while holding the commit lock must NOT fire
+    lock-discipline: with tracing disarmed every seam call is a no-op,
+    like faultline/clockskew."""
+    srcs = {
+        "fabric_tpu/common/tracing.py": _real_tracing_source(),
+        "fabric_tpu/ledger/fix_tracing_clean.py":
+            _load("fix_tracing_clean.py"),
+    }
+    report = lint_sources(srcs)
+    assert [
+        v for v in report.unsuppressed
+        if v.rule == "lock-discipline"
+        and v.path == "fabric_tpu/ledger/fix_tracing_clean.py"
+    ] == []
+    # the exemption lives in the SUMMARY, not in lost information: the
+    # dump path still knows it blocks, it just does not propagate
+    fn = report.project.function("fabric_tpu.common.tracing.dump_doc")
+    assert fn is not None
+    assert fn.blocking and not fn.blocking_transitive
+
+
+def test_trace_shaped_helper_outside_the_seam_still_fires():
+    """The dirty twin: an identically-shaped homegrown dump helper is
+    NOT the reviewed seam — blocking-under-commit-lock fires.  The
+    exemption is scoped by file path, not by looking trace-like."""
+    src = _load("fix_tracing_dirty.py")
+    vs = lint_source(src, "fabric_tpu/ledger/fix_tracing_dirty.py")
+    lines = _fires(vs, "lock-discipline")
+    assert len(lines) == 1
+    assert "dump_spans(self._fh" in src.splitlines()[lines[0] - 1]
+
+
 # -- racecheck PR 8 satellites: closure thread targets + lock aliases --------
 
 

@@ -331,8 +331,8 @@ def _collect_outcome(blk: common_pb2.Block, width: int, pool=None):
         collect_width=width, collect_pool=pool,
     )
     started = v._start_block(_copy(blk), set())
-    block, flags0, works, collect, _envs = started
-    flags = v._finish_block(block, flags0, works, collect)
+    block, flags0, works, collect, _envs, bspan = started
+    flags = v._finish_block(block, flags0, works, collect, bspan)
     items = csp.batches[0] if csp.batches else []
     index_map = [
         (w.creator_item, [ix for _p, idxs in w.pendings for ix in idxs])

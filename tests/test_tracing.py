@@ -1,0 +1,468 @@
+"""Tracelens acceptance: the zero-overhead disarmed contract, span
+nesting across an RPC hop and a pooled (run_chunked) fan-out, byte-
+deterministic traces under a virtual clock, the /traces endpoint,
+flight-recorder dumps on injected crashes, faultfuzz trace artifacts
+with same-seed determinism, and traced-vs-untraced commit parity under
+the invariants oracle."""
+
+from __future__ import annotations
+
+import json
+import logging
+import urllib.request
+
+import pytest
+
+from fabric_tpu.common import flogging, tracing, workpool
+from fabric_tpu.common.operations import System
+from fabric_tpu.comm.rpc import RPCClient, RPCServer
+from fabric_tpu.devtools import clockskew, faultfuzz, faultline, invariants
+
+CHANNEL = faultfuzz.CHANNEL
+
+
+# -- disarmed: the zero-overhead contract ------------------------------------
+
+
+def test_disarmed_span_entry_points_are_noops(tmp_path):
+    """FABRIC_TPU_TRACE unset (tier-1 default): no recorder exists,
+    every entry point returns the shared no-op singleton, and a real
+    RPC round trip plus a pooled fan-out never touch the armed path."""
+    assert not tracing.enabled()
+    assert tracing.recorder() is None
+    before = tracing.lookup_count()
+
+    s = tracing.span("x", anything=1)
+    assert s is tracing._NOOP
+    assert tracing.begin("y") is tracing._NOOP
+    assert s.ctx is None
+    s.annotate(a=1)
+    s.end()
+    assert tracing.current() is None
+    assert tracing.wire_token() is None
+    assert tracing.attached(None) is tracing._NOOP
+    tracing.instant("nope")
+    tracing.annotate(z=1)
+
+    # a live RPC round trip and a pooled fan-out, fully disarmed
+    srv = RPCServer()
+    srv.register("echo", lambda body, stream: body)
+    srv.start()
+    try:
+        assert RPCClient(*srv.addr, timeout=5.0).call(
+            "echo", b"hi"
+        ) == b"hi"
+    finally:
+        srv.stop()
+    with workpool.scoped_pool(2) as pool:
+        out = workpool.run_chunked(
+            pool, lambda off, chunk: [v * 2 for v in chunk],
+            list(range(10)), 2,
+        )
+    assert out == [v * 2 for v in range(10)]
+
+    # nothing above consulted the armed path, and no ring buffer exists
+    assert tracing.lookup_count() == before
+    assert tracing.recorder() is None
+
+
+def test_env_knob_arms_and_sizes_the_recorder(monkeypatch):
+    monkeypatch.setenv("FABRIC_TPU_TRACE", "0")
+    tracing._init_from_env()
+    assert not tracing.enabled()
+    monkeypatch.setenv("FABRIC_TPU_TRACE", "1")
+    tracing._init_from_env()
+    try:
+        assert tracing.enabled()
+        assert tracing.recorder().capacity == tracing.DEFAULT_CAPACITY
+    finally:
+        tracing.disarm()
+    monkeypatch.setenv("FABRIC_TPU_TRACE", "256")
+    tracing._init_from_env()
+    try:
+        assert tracing.recorder().capacity == 256
+    finally:
+        tracing.disarm()
+    assert not tracing.enabled()
+
+
+# -- nesting: RPC hop + pooled fan-out ---------------------------------------
+
+
+def _by_name(doc, name):
+    return [e for e in doc["traceEvents"] if e["name"] == name]
+
+
+def test_span_nesting_across_rpc_round_trip():
+    """The server's rpc.serve span must nest under the client's
+    rpc.call span (same trace, parent=call span id), which itself
+    nests under the caller's span — context crossed the wire inside
+    the frame."""
+    with tracing.scope() as rec:
+        srv = RPCServer()
+        srv.register("echo", lambda body, stream: body)
+        srv.start()
+        try:
+            with tracing.span("client.work") as outer:
+                cli = RPCClient(*srv.addr, timeout=5.0)
+                assert cli.call("echo", b"ping") == b"ping"
+        finally:
+            srv.stop()
+        doc = tracing.export(rec)
+
+    (serve,) = _by_name(doc, "rpc.serve")
+    (call,) = _by_name(doc, "rpc.call")
+    (work,) = _by_name(doc, "client.work")
+    assert serve["args"]["method"] == "echo"
+    assert serve["args"]["trace"] == call["args"]["trace"]
+    assert serve["args"]["parent"] == call["args"]["span"]
+    assert call["args"]["parent"] == work["args"]["span"]
+    assert call["args"]["trace"] == work["args"]["trace"]
+    # the hop really crossed threads
+    assert serve["tid"] != call["tid"]
+
+
+@pytest.mark.parametrize("width", [1, 2, 8])
+def test_pooled_fanout_nests_under_caller(width):
+    """run_chunked flows the caller's span into every chunk: results
+    stay identical to serial at every width, and (at width > 1) each
+    chunk span parents under the calling span on a pool thread."""
+    items = list(range(40))
+    serial = [v * 3 for v in items]
+    with tracing.scope() as rec:
+        with workpool.scoped_pool(4) as pool:
+            with tracing.span("fanout.caller") as caller:
+                got = workpool.run_chunked(
+                    pool, lambda off, chunk: [v * 3 for v in chunk],
+                    items, width,
+                )
+        doc = tracing.export(rec)
+    assert got == serial
+    chunks = _by_name(doc, "workpool.chunk")
+    (call_ev,) = _by_name(doc, "fanout.caller")
+    if width <= 1:
+        assert chunks == []  # serial short-circuit: no fan-out spans
+        return
+    assert len(chunks) == width
+    assert sorted(c["args"]["offset"] for c in chunks) == [
+        i * (len(items) // width) for i in range(width)
+    ]
+    for c in chunks:
+        assert c["args"]["trace"] == call_ev["args"]["trace"]
+        assert c["args"]["parent"] == call_ev["args"]["span"]
+
+
+def test_exception_mid_span_repairs_the_stack():
+    """A BaseException (FaultCrash) escaping an explicit begin() must
+    not corrupt later parenting: ending an outer span closes abandoned
+    children and pops them."""
+    with tracing.scope() as rec:
+        outer = tracing.begin("outer")
+        inner = tracing.begin("inner")
+        assert tracing.current() == inner.ctx
+        # simulate a crash path that never reached inner.end()
+        outer.end()
+        with tracing.span("after") as after:
+            assert after.parent_id is None  # outer is gone from stack
+        doc = tracing.export(rec)
+    (inner_ev,) = _by_name(doc, "inner")
+    assert inner_ev["args"].get("abandoned") is True
+
+
+# -- determinism under VirtualClock ------------------------------------------
+
+
+def _clocked_workload():
+    with tracing.span("root", cat="pipeline", block=0):
+        with tracing.span("stage.a", cat="stage", block=0):
+            clockskew.sleep(0.010)
+        with tracing.span("stage.b", cat="stage", block=0):
+            clockskew.sleep(0.020)
+        tracing.instant("mark", k=1)
+
+
+def test_virtual_clock_traces_are_byte_identical():
+    runs = []
+    for _ in range(2):
+        with clockskew.use_virtual(clockskew.VirtualClock(start=500.0)):
+            with tracing.scope() as rec:
+                _clocked_workload()
+                runs.append(tracing.export(rec))
+    assert runs[0]["traceEvents"] == runs[1]["traceEvents"]
+    # ...including timestamps: the virtual clock IS the time base
+    (a,) = _by_name(runs[0], "stage.a")
+    assert a["dur"] == 10_000  # exactly the virtual 10ms, in µs
+
+
+def test_critical_path_over_stage_spans():
+    with clockskew.use_virtual(clockskew.VirtualClock(start=500.0)):
+        with tracing.scope() as rec:
+            _clocked_workload()
+            doc = tracing.export(rec)
+    cp = tracing.critical_path_ms(doc["traceEvents"])
+    # sequential stages: each contributes its full duration; the
+    # "root" span is cat=pipeline and must not appear
+    assert cp == {"stage.a": pytest.approx(10.0), "stage.b": pytest.approx(20.0)}
+
+
+# -- /traces endpoint --------------------------------------------------------
+
+
+def _get(addr, path):
+    host, port = addr
+    with urllib.request.urlopen(
+        f"http://{host}:{port}{path}", timeout=5
+    ) as r:
+        return r.status, r.read()
+
+
+def test_traces_endpoint_serves_flight_recorder():
+    sys_ = System(("127.0.0.1", 0))
+    sys_.start()
+    try:
+        # disarmed: valid, empty, explicitly not armed
+        status, body = _get(sys_.addr, "/traces")
+        assert status == 200
+        doc = json.loads(body)
+        assert doc["traceEvents"] == []
+        assert doc["otherData"]["armed"] is False
+
+        # armed: drive one RPC hop and one pooled fan-out, then assert
+        # the NESTED spans straight off the endpoint's JSON
+        with tracing.scope():
+            srv = RPCServer()
+            srv.register("echo", lambda body_, stream: body_)
+            srv.start()
+            try:
+                with tracing.span("ops.probe", block=7, cat="stage"):
+                    RPCClient(*srv.addr, timeout=5.0).call("echo", b"x")
+                    with workpool.scoped_pool(2) as pool:
+                        workpool.run_chunked(
+                            pool, lambda off, chunk: list(chunk),
+                            list(range(8)), 2,
+                        )
+            finally:
+                srv.stop()
+            status, body = _get(sys_.addr, "/traces")
+        assert status == 200
+        doc = json.loads(body)
+        assert doc["otherData"]["armed"] is True
+        (probe,) = _by_name(doc, "ops.probe")
+        assert probe["ph"] == "X"
+        assert probe["args"]["block"] == 7
+        # RPC hop: serve nests under call nests under ops.probe
+        (serve,) = _by_name(doc, "rpc.serve")
+        (call,) = _by_name(doc, "rpc.call")
+        assert serve["args"]["parent"] == call["args"]["span"]
+        assert call["args"]["parent"] == probe["args"]["span"]
+        # pooled fan-out: every chunk nests under ops.probe
+        chunks = _by_name(doc, "workpool.chunk")
+        assert len(chunks) == 2
+        assert all(
+            c["args"]["parent"] == probe["args"]["span"] for c in chunks
+        )
+    finally:
+        sys_.stop()
+
+
+# -- flight recorder + faultline ---------------------------------------------
+
+
+def test_injected_crash_annotates_span_and_dumps(tmp_path):
+    """An injected FaultCrash mid-commit lands an instant 'fault' mark,
+    annotates the stage span it interrupted, and the recorder dumps to
+    a loadable Chrome trace file."""
+    from fabric_tpu.ledger import LedgerProvider
+
+    provider = LedgerProvider(str(tmp_path / "src"))
+    ledger = provider.open(CHANNEL)
+    writes = faultfuzz.workload_writes(1)
+    try:
+        with tracing.scope() as rec:
+            with faultline.use_plan({"faults": [
+                {"point": "commit.stage", "ctx": {"stage": "pvt"},
+                 "action": "crash", "nth": 1},
+            ]}):
+                blk = faultfuzz._endorsed_block(ledger, 0, writes[0])
+                with pytest.raises(faultline.FaultCrash):
+                    ledger.commit(blk)
+            doc = tracing.export(rec)
+            path = tracing.dump_to(
+                str(tmp_path / "crash.trace.json"), rec
+            )
+    finally:
+        provider.close()
+
+    (fault,) = _by_name(doc, "fault")
+    assert fault["args"]["point"] == "commit.stage"
+    assert fault["args"]["action"] == "crash"
+    (pvt,) = _by_name(doc, "pvt")
+    assert pvt["args"]["fault"] == "commit.stage"
+    assert fault["args"]["parent"] == pvt["args"]["span"]
+    with open(path, "r", encoding="utf-8") as f:
+        loaded = json.load(f)
+    assert loaded["traceEvents"] == doc["traceEvents"]
+
+
+def test_failing_faultfuzz_plan_ships_trace_and_replays_identically(
+    tmp_path,
+):
+    """The seeded acceptance violation under an armed tracer: run_plan
+    returns the flight-recorder export alongside the violations, and
+    two same-seed runs produce identical span sequences (timestamps
+    aside)."""
+    seeded = {
+        "seed": 3,
+        "label": "seeded",
+        "faults": [
+            {"point": "blkstorage.file_append", "action": "torn",
+             "cut": 0.5, "ctx": {"block": 3}, "count": 1},
+            {"point": "blkstorage.recovery_truncate", "action": "skip",
+             "count": 5},
+        ],
+    }
+    seqs = []
+    for i in range(2):
+        with tracing.scope():
+            res = faultfuzz.run_plan(
+                seeded, str(tmp_path / f"run{i}"), comm=False
+            )
+        assert res["violations"], "seeded violation must fail the oracle"
+        assert res["trace"]["traceEvents"]
+        seqs.append(tracing.span_sequence(res["trace"]))
+    assert seqs[0] == seqs[1]
+
+
+def test_campaign_writes_trace_artifact_next_to_repro(
+    tmp_path, monkeypatch,
+):
+    """A failing campaign plan leaves <repro>.trace.json beside the
+    repro JSON when tracelens is armed."""
+    seeded = {
+        "faults": [
+            {"point": "blkstorage.file_append", "action": "torn",
+             "cut": 0.5, "ctx": {"block": 3}, "count": 1},
+            {"point": "blkstorage.recovery_truncate", "action": "skip",
+             "count": 5},
+        ],
+    }
+    monkeypatch.setattr(
+        faultfuzz, "generate_plan",
+        lambda rng, registry, label: {**seeded, "label": label, "seed": 3},
+    )
+    out_dir = tmp_path / "artifacts"
+    with tracing.scope():
+        summary = faultfuzz.Campaign(
+            seed=11, plans=1, out_dir=str(out_dir),
+            workdir=str(tmp_path / "work"), shrink=False, comm=False,
+        ).run()
+    assert summary["failures"] == 1
+    (repro,) = summary["repro"]
+    (trace,) = summary["trace"]
+    assert trace == repro[: -len(".json")] + ".trace.json"
+    with open(trace, "r", encoding="utf-8") as f:
+        doc = json.load(f)
+    assert doc["traceEvents"]
+    # the dump shows the injected faults in causal context
+    assert any(e["name"] == "fault" for e in doc["traceEvents"])
+
+
+# -- traced vs untraced commit parity ----------------------------------------
+
+
+def _run_commit_workload(root: str, blocks: int = 3):
+    """Commit the canned per-block writes; returns (block bytes list,
+    state records, last hash) with the provider closed after."""
+    from fabric_tpu.ledger import LedgerProvider
+
+    provider = LedgerProvider(root)
+    ledger = provider.open(CHANNEL)
+    writes = faultfuzz.workload_writes(blocks)
+    try:
+        for n in range(blocks + 2):
+            ledger.commit(
+                faultfuzz._endorsed_block(ledger, n, writes[n])
+            )
+        blocks_raw = [
+            ledger.get_block_by_number(n).SerializeToString()
+            for n in range(blocks + 2)
+        ]
+        state = list(ledger.state_db.export_records())
+        return blocks_raw, state, ledger.block_store.last_block_hash
+    finally:
+        provider.close()
+
+
+def test_traced_commit_stream_is_byte_identical_to_untraced(tmp_path):
+    """The parity acceptance: tracing observes, never participates —
+    committed blocks, exported state records, and the chain head hash
+    are byte-identical with and without an armed tracer, and the
+    invariants oracle passes the traced ledger."""
+    plain = _run_commit_workload(str(tmp_path / "plain"))
+    with tracing.scope() as rec:
+        traced = _run_commit_workload(str(tmp_path / "traced"))
+        assert len(rec) > 0  # the tracer really was recording
+    assert traced[0] == plain[0]  # every block, byte for byte
+    assert traced[1] == plain[1]  # every state record
+    assert traced[2] == plain[2]  # chain head
+
+    from fabric_tpu.ledger import LedgerProvider
+
+    provider = LedgerProvider(str(tmp_path / "traced"))
+    try:
+        vs = invariants.check_ledger(
+            provider.open(CHANNEL), faultfuzz.workload_writes(3)
+        )
+        assert vs == []
+    finally:
+        provider.close()
+
+
+# -- satellites: log correlation + workpool metrics --------------------------
+
+
+def test_flogging_emits_trace_ids_when_armed():
+    fmt = flogging._TraceFormatter("%(message)s")
+    record = logging.LogRecord(
+        "fabric_tpu.test", logging.INFO, __file__, 1, "hello", (), None
+    )
+    assert fmt.format(record) == "hello"  # disarmed: unchanged bytes
+    with tracing.scope():
+        with tracing.span("logged.work") as sp:
+            line = fmt.format(record)
+            assert f"trace={sp.trace_id:x}" in line
+            assert f"span={sp.span_id:x}" in line
+        assert fmt.format(record) == "hello"  # no active span
+    assert fmt.format(record) == "hello"
+
+
+def test_workpool_metrics_gauges_and_stats():
+    from fabric_tpu.common.metrics import PrometheusProvider, WorkpoolMetrics
+
+    prov = PrometheusProvider()
+    workpool.reset_stats()
+    workpool.set_metrics(WorkpoolMetrics(prov))
+    try:
+        with workpool.scoped_pool(2) as pool:
+            out = workpool.run_chunked(
+                pool, lambda off, chunk: [v + 1 for v in chunk],
+                list(range(20)), 4,
+            )
+        assert out == [v + 1 for v in range(20)]
+        stats = workpool.stats()
+        assert stats["chunks"] == 4
+        assert 1 <= stats["max_in_flight"] <= 4
+        exposed = prov.registry.expose()
+        assert "workpool_in_flight_chunks 0" in exposed
+        assert "workpool_worker_saturation" in exposed
+        assert "workpool_queue_depth" in exposed
+    finally:
+        workpool.set_metrics(None)
+        workpool.reset_stats()
+
+
+def test_operations_system_builds_workpool_metrics_lazily():
+    sys_ = System(("127.0.0.1", 0), provider="disabled")
+    m = sys_.workpool_metrics()
+    assert m is sys_.workpool_metrics()  # memoized
+    sys_._server.server_close()
