@@ -443,6 +443,29 @@ def cmd_snapshot_listpending(args) -> int:
     return 0
 
 
+def cmd_snapshot_fetch(args) -> int:
+    """Stream a COMPLETED snapshot from a REMOTE peer into a local
+    directory (no shared disk required), then optionally join from it.
+    The fetched directory is verified the same way a local one is:
+    verify-on-import recomputes every file digest, so a torn or
+    tampered stream is refused at join time."""
+    from fabric_tpu.ledger import snapshot as snap
+
+    client = RPCClient(*parse_endpoint(args.frompeer),
+                       tls=tls_from_args(args))
+    dest = snap.fetch_snapshot(
+        client, args.channel, args.block_number, args.out
+    )
+    print(f"fetched snapshot for {args.channel}@{args.block_number} "
+          f"into {dest}")
+    if args.join_via:
+        raw = RPCClient(
+            *parse_endpoint(args.join_via), tls=tls_from_args(args)
+        ).call("admin.JoinBySnapshot", dest.encode())
+        print(f"joined channel {raw.decode()} from fetched snapshot")
+    return 0
+
+
 def cmd_snapshot_joinbysnapshot(args) -> int:
     """Join a channel from a snapshot directory: the peer bootstraps a
     blockless ledger at the snapshot height and catches up from the
@@ -611,6 +634,17 @@ def main(argv=None) -> int:
                      help="completed snapshot directory on the peer host")
     jbs.add_argument("--peer", required=True)
     jbs.set_defaults(fn=cmd_snapshot_joinbysnapshot)
+    sf = snap.add_parser("fetch", parents=[tlsp])
+    sf.add_argument("-c", "--channel", required=True)
+    sf.add_argument("-b", "--block-number", type=int, required=True)
+    sf.add_argument("--frompeer", required=True,
+                    help="remote peer serving admin.SnapshotFetch")
+    sf.add_argument("--out", required=True,
+                    help="local directory to receive the snapshot")
+    sf.add_argument("--join-via", default=None,
+                    help="optionally join a LOCAL peer from the fetched "
+                         "snapshot (its admin endpoint)")
+    sf.set_defaults(fn=cmd_snapshot_fetch)
 
     cc = sub.add_parser("chaincode").add_subparsers(dest="sub", required=True)
     for name, fn, needs_orderer in (

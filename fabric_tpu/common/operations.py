@@ -91,13 +91,31 @@ class System:
                     self._reply(
                         200, json.dumps({"spec": flogging.spec()}).encode()
                     )
-                elif self.path == "/traces":
+                elif self.path == "/traces" or self.path.startswith(
+                    "/traces?"
+                ):
+                    from urllib.parse import parse_qs, urlsplit
+
                     from fabric_tpu.common import tracing
 
+                    qs = parse_qs(urlsplit(self.path).query)
+                    since = None
+                    if "since" in qs:
+                        try:
+                            since = int(qs["since"][0])
+                        except ValueError:
+                            self._reply(
+                                400,
+                                json.dumps(
+                                    {"error": "since must be an integer "
+                                              "event id"}
+                                ).encode(),
+                            )
+                            return
                     self._reply(
                         200,
                         json.dumps(
-                            tracing.export(), sort_keys=True
+                            tracing.export(since=since), sort_keys=True
                         ).encode(),
                     )
                 else:

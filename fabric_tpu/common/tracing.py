@@ -84,18 +84,52 @@ class FlightRecorder:
             maxlen=self.capacity
         )
         self._lock = threading.Lock()
+        # monotonically increasing per-event cursor: `GET
+        # /traces?since=<id>` streams only what landed after a previous
+        # poll (netbench polls live nodes incrementally instead of
+        # re-downloading the whole recorder each time)
+        self._seq = 0
 
     def record(self, event: dict) -> None:
         with self._lock:
+            self._seq += 1
+            event["id"] = self._seq
             self._buf.append(event)
 
-    def snapshot(self) -> list[dict]:
+    def snapshot(self, since: int | None = None) -> list[dict]:
         with self._lock:
-            return list(self._buf)
+            if since is None:
+                return list(self._buf)
+            return [ev for ev in self._buf if ev.get("id", 0) > since]
+
+    def snapshot_with_cursor(
+        self, since: int | None = None
+    ) -> tuple[list[dict], int]:
+        """(events after ``since``, cursor) taken under ONE lock — a
+        cursor read after a separate snapshot() would advertise events
+        recorded in between without containing them, and an incremental
+        poller would skip them forever.  A ``since`` AHEAD of the
+        current cursor means the recorder was cleared since the caller
+        last polled: the stale cursor is invalid, so the full buffer is
+        returned and the caller resyncs on the fresh cursor."""
+        with self._lock:
+            if since is not None and since > self._seq:
+                since = None  # stale cursor from before a clear()
+            events = (
+                list(self._buf) if since is None
+                else [ev for ev in self._buf if ev.get("id", 0) > since]
+            )
+            return events, self._seq
+
+    @property
+    def last_event_id(self) -> int:
+        with self._lock:
+            return self._seq
 
     def clear(self) -> None:
         with self._lock:
             self._buf.clear()
+            self._seq = 0
 
     def __len__(self) -> int:
         with self._lock:
@@ -386,6 +420,40 @@ def from_wire(token: str) -> SpanContext | None:
         return None
 
 
+# Binary-frame piggyback (the gossip TCP transport; the RPC transport
+# carries the same token inside its str method field): a traced sender
+# prefixes the frame with b"\x01<token>\x01".  Serialized protobuf
+# frames always start with a field-tag byte (never 0x01), so receivers
+# can ALWAYS strip; untraced senders emit byte-identical frames.  Kept
+# HERE beside wire_token/from_wire so the token format has one owner.
+FRAME_MARK = b"\x01"
+
+
+def frame_with_token(data: bytes, ctx: SpanContext | None) -> bytes:
+    """Prefix a binary frame with the context's wire token (the frame
+    unchanged when ``ctx`` is None — the untraced path)."""
+    if ctx is None:
+        return data
+    token = f"{ctx.trace_id:x}.{ctx.span_id:x}"
+    return FRAME_MARK + token.encode("ascii") + FRAME_MARK + data
+
+
+def split_frame_token(frame: bytes) -> tuple[bytes, SpanContext | None]:
+    """(payload, SpanContext | None) — strips the optional trace
+    prefix; malformed prefixes fall back to the raw frame so a traced
+    peer can never wedge an untraced server."""
+    if not frame.startswith(FRAME_MARK):
+        return frame, None
+    end = frame.find(FRAME_MARK, 1)
+    if end < 0:
+        return frame, None
+    try:
+        token = frame[1:end].decode("ascii")
+    except UnicodeDecodeError:
+        return frame, None
+    return frame[end + 1:], from_wire(token)
+
+
 # -- lifecycle ----------------------------------------------------------------
 
 
@@ -457,17 +525,27 @@ def scope(capacity: int = DEFAULT_CAPACITY):
 # -- export -------------------------------------------------------------------
 
 
-def export(rec: FlightRecorder | None = None) -> dict:
+def export(rec: FlightRecorder | None = None,
+           since: int | None = None) -> dict:
     """The flight recorder as a Chrome trace-event document
-    (object form: chrome://tracing and Perfetto load it directly)."""
+    (object form: chrome://tracing and Perfetto load it directly).
+    ``since`` is an event-id cursor: only events recorded AFTER it are
+    included, and ``otherData.last_event_id`` is the cursor for the
+    next incremental poll (``GET /traces?since=``); a cursor from
+    before a recorder reset is detected (it is ahead of the fresh
+    cursor) and answered with the full buffer so the poller resyncs."""
     rec = rec if rec is not None else _recorder
-    events = rec.snapshot() if rec is not None else []
+    if rec is not None:
+        events, cursor = rec.snapshot_with_cursor(since)
+    else:
+        events, cursor = [], 0
     return {
         "traceEvents": events,
         "displayTimeUnit": "ms",
         "otherData": {
             "armed": _recorder is not None,
             "source": "fabric_tpu.tracelens",
+            "last_event_id": cursor,
         },
     }
 
@@ -586,6 +664,9 @@ __all__ = [
     "attached",
     "wire_token",
     "from_wire",
+    "frame_with_token",
+    "split_frame_token",
+    "FRAME_MARK",
     "enabled",
     "recorder",
     "lookup_count",

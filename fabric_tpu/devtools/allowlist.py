@@ -15,7 +15,7 @@ ALLOWLIST: list[AllowEntry] = [
     AllowEntry(
         rule="determinism",
         path="fabric_tpu/peer/deliverclient.py",
-        match="random.shuffle(endpoints)",
+        match="random.shuffle(order)",
         reason="endpoint shuffle is deliberately randomized per peer "
                "for orderer load-spreading; connection order never "
                "enters consensus state",

@@ -284,6 +284,26 @@ def check_import_state(ledger, snapshot_dir: str) -> list[Violation]:
     return out
 
 
+# -- cross-peer agreement -----------------------------------------------------
+
+
+def state_digest(ledger) -> str:
+    """Canonical sha256 over the ledger's raw state export — the
+    cross-peer agreement probe: two peers that committed the same chain
+    must produce the identical digest, regardless of which of them was
+    killed and caught up via state transfer or join-by-snapshot (the
+    netharness oracle compares this across every node)."""
+    from fabric_tpu.common.hashing import sha256
+
+    parts = []
+    for k, v in sorted(ledger.state_db.export_records()):
+        parts.append(len(k).to_bytes(4, "big"))
+        parts.append(k)
+        parts.append(len(v).to_bytes(4, "big"))
+        parts.append(v)
+    return sha256(b"".join(parts)).hex()
+
+
 # -- TPU breaker sanity -------------------------------------------------------
 
 
@@ -328,4 +348,5 @@ __all__ = [
     "check_import_state",
     "check_breaker",
     "check_ledger",
+    "state_digest",
 ]

@@ -1,0 +1,905 @@
+"""netharness — a real N-org × M-peer network as separate OS processes,
+with a kill -9 chaos schedule, Jepsen-style.
+
+Every chaos tool before this (faultline -> faultfuzz -> soak) injects
+faults INSIDE one process; real deployments die by losing whole nodes.
+This harness stands up the topology the paper describes — a raft
+orderer cluster and gossiping peers over the real TCP transports, each
+as its own OS process (``devtools/netnode.py``) — drives a heavy
+broadcast -> ordering -> gossip dissemination -> commit stream through
+it, SIGKILLs members mid-stream on a seeded schedule, and judges the
+end state with the invariants oracle ON EVERY NODE plus a cross-peer
+state-digest agreement check.
+
+Pieces:
+
+- :class:`Topology` — the spec (orgs × peers, orderers, channel,
+  batch knobs, per-node FAULTLINE plans, tracing).
+- :class:`KillRule` / :func:`generate_kill_schedule` — the kill-schedule
+  DSL: which node, at what committed height, SIGKILL vs graceful stop,
+  restart vs rejoin-by-snapshot; seeded generation is deterministic, so
+  a failing campaign replays from its repro JSON
+  (``scripts/chaos.py --kill9 --replay``).
+- :class:`Network` — process lifecycle: config/env plumbing, spawn,
+  readiness probing with bounded retries + decorrelated backoff,
+  kill/restart, snapshot-fetch rejoin, control RPCs.
+- :func:`run_stream` — the measured campaign: tx broadcast stream, the
+  kill schedule executor, liveness monitoring, catch-up + cross-peer
+  lag measurement, the network-wide oracle, and the merged tracelens
+  artifact.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import random
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)
+)))
+
+# tracelens ids must not collide across the topology's processes when
+# the per-node dumps are merged into one network trace — each node's id
+# counter starts in its own disjoint band
+TRACE_ID_STRIDE = 1 << 40
+
+
+# Node ports are allocated BELOW the kernel's ephemeral range (checked
+# at import on Linux; 10240+ stays under both the 16000+ and 32768+
+# conventions).  bind(0)-style allocation hands back ephemeral ports
+# that return to the kernel's outbound pool the moment a node dies — a
+# long-lived gossip/raft outbound connection from a SURVIVING node can
+# then squat the killed node's listen port, and the restart fails
+# EADDRINUSE forever (surfaced by the soak's restart path).
+_PORT_BASE = 10240
+_PORT_SPAN = 5600
+_ports_handed: set[int] = set()
+_ports_lock = threading.Lock()
+_ports_rng = random.Random(os.getpid())
+
+
+class NetError(RuntimeError):
+    pass
+
+
+def free_port() -> int:
+    """A bindable 127.0.0.1 port outside the ephemeral range, never
+    handed out twice within this process."""
+    with _ports_lock:
+        for _ in range(4 * _PORT_SPAN):
+            port = _PORT_BASE + _ports_rng.randrange(_PORT_SPAN)
+            if port in _ports_handed:
+                continue
+            s = socket.socket()
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            try:
+                s.bind(("127.0.0.1", port))
+            except OSError:
+                continue
+            finally:
+                s.close()
+            _ports_handed.add(port)
+            return port
+    raise NetError("no bindable port left in the netharness range")
+
+
+@dataclasses.dataclass
+class Topology:
+    orgs: int = 1
+    peers_per_org: int = 2
+    orderers: int = 1
+    channel: str = "netchan"
+    seed: int = 7
+    batch_timeout_s: float = 0.2
+    max_message_count: int = 5
+    gossip_tick_s: float = 0.1
+    trace: int = 0                  # tracelens capacity; 0 = disarmed
+    ops: bool = False               # per-peer operations endpoint
+    faultline: dict | None = None   # node name -> faultline plan dict
+
+    def peer_names(self) -> list[str]:
+        return [
+            f"org{o}-peer{p}"
+            for o in range(1, self.orgs + 1)
+            for p in range(self.peers_per_org)
+        ]
+
+    def orderer_names(self) -> list[str]:
+        return [f"orderer{i}" for i in range(1, self.orderers + 1)]
+
+    def as_dict(self) -> dict:
+        return {
+            "orgs": self.orgs, "peers_per_org": self.peers_per_org,
+            "orderers": self.orderers, "channel": self.channel,
+            "batch_timeout_s": self.batch_timeout_s,
+            "max_message_count": self.max_message_count,
+        }
+
+
+@dataclasses.dataclass
+class KillRule:
+    """One kill-schedule entry: when ``node``'s committed height first
+    reaches ``at_height``, deliver ``sig`` (``kill9`` = SIGKILL,
+    ``term`` = graceful SIGTERM); after ``restart_after_s`` the node
+    comes back — reopening its stores (``rejoin=restart``, real crash
+    recovery) or from a freshly fetched snapshot
+    (``rejoin=snapshot``)."""
+
+    node: str
+    at_height: int
+    sig: str = "kill9"
+    rejoin: str = "restart"
+    restart_after_s: float = 0.5
+
+    def as_dict(self) -> dict:
+        return {
+            "node": self.node, "at_height": self.at_height,
+            "sig": self.sig, "rejoin": self.rejoin,
+            "restart_after_s": self.restart_after_s,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "KillRule":
+        return cls(
+            node=d["node"], at_height=int(d["at_height"]),
+            sig=d.get("sig", "kill9"), rejoin=d.get("rejoin", "restart"),
+            restart_after_s=float(d.get("restart_after_s", 0.5)),
+        )
+
+
+def generate_kill_schedule(seed: int, topo: Topology, max_height: int,
+                           kills: int = 2) -> list[KillRule]:
+    """Seeded, deterministic schedule: peer SIGKILLs at distinct
+    heights, plus (given a 3+ orderer cluster that keeps quorum) one
+    orderer kill.  Heights land in the middle half of the stream so the
+    victim dies with real traffic on both sides."""
+    rng = random.Random(f"netharness:{seed}")
+    peers = topo.peer_names()
+    rules: list[KillRule] = []
+    lo = max(2, max_height // 4)
+    hi = max(lo + 1, (3 * max_height) // 4)
+    heights = rng.sample(range(lo, hi + 1), min(kills, hi - lo + 1))
+    for i, victim in enumerate(rng.sample(peers, min(kills, len(peers)))):
+        rules.append(KillRule(
+            node=victim,
+            at_height=heights[i % len(heights)],
+            sig="kill9" if rng.random() < 0.8 else "term",
+            rejoin="snapshot" if rng.random() < 0.25 else "restart",
+            restart_after_s=round(rng.uniform(0.3, 1.0), 2),
+        ))
+    if topo.orderers >= 3:
+        rules.append(KillRule(
+            node=rng.choice(topo.orderer_names()),
+            at_height=rng.randint(lo, hi),
+            sig="kill9",
+            rejoin="restart",
+            restart_after_s=round(rng.uniform(0.3, 1.0), 2),
+        ))
+    return sorted(rules, key=lambda r: (r.at_height, r.node))
+
+
+class NodeHandle:
+    def __init__(self, name: str, role: str, cfg: dict, cfg_path: str,
+                 log_path: str):
+        self.name = name
+        self.role = role
+        self.cfg = cfg
+        self.cfg_path = cfg_path
+        self.log_path = log_path
+        self.proc: subprocess.Popen | None = None
+        self.generation = 0  # bumped per (re)spawn
+
+    @property
+    def rpc_addr(self) -> tuple[str, int]:
+        return ("127.0.0.1", self.cfg["rpc_port"])
+
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+
+class Network:
+    """Owns the node processes of one topology.  Use as a context
+    manager — ``close()`` SIGKILLs anything still running."""
+
+    def __init__(self, workdir: str, topo: Topology):
+        self.workdir = workdir
+        self.topo = topo
+        os.makedirs(workdir, exist_ok=True)
+        self.secret = b"netharness-secret-%d" % topo.seed
+        self.nodes: dict[str, NodeHandle] = {}
+        self._build_configs()
+
+    # -- config plumbing ---------------------------------------------------
+
+    def _build_configs(self) -> None:
+        topo = self.topo
+        orderer_rpc = {n: free_port() for n in topo.orderer_names()}
+        raft_ports = {n: free_port() for n in topo.orderer_names()}
+        gossip_ports = {n: free_port() for n in topo.peer_names()}
+        consenters = {
+            str(i + 1): ["127.0.0.1", raft_ports[n]]
+            for i, n in enumerate(topo.orderer_names())
+        }
+        orderer_eps = [
+            ["127.0.0.1", orderer_rpc[n]] for n in topo.orderer_names()
+        ]
+        all_names = topo.orderer_names() + topo.peer_names()
+        for idx, name in enumerate(all_names):
+            role = "orderer" if name.startswith("orderer") else "peer"
+            cfg: dict = {
+                "role": role,
+                "name": name,
+                "channel": topo.channel,
+                "orgs": topo.orgs,
+                "root": os.path.join(self.workdir, name, "root"),
+                "rpc_port": free_port(),
+                "ready_file": os.path.join(self.workdir, name, "ready"),
+                "batch_timeout_s": topo.batch_timeout_s,
+                "max_message_count": topo.max_message_count,
+                "secret": self.secret.hex(),
+                "trace": topo.trace,
+                "trace_id_base": (idx + 1) * TRACE_ID_STRIDE,
+                "env": {},
+            }
+            if role == "orderer":
+                cfg["rpc_port"] = orderer_rpc[name]
+                cfg["node_id"] = topo.orderer_names().index(name) + 1
+                cfg["raft_port"] = raft_ports[name]
+                cfg["consenters"] = consenters
+            else:
+                cfg["gossip_port"] = gossip_ports[name]
+                cfg["gossip_bootstrap"] = [
+                    f"127.0.0.1:{p}" for n, p in gossip_ports.items()
+                    if n != name
+                ]
+                cfg["gossip_tick_s"] = topo.gossip_tick_s
+                cfg["orderer_endpoints"] = orderer_eps
+                if topo.ops:
+                    cfg["ops_port"] = free_port()
+            plan = (topo.faultline or {}).get(name)
+            if plan is not None:
+                plan_path = os.path.join(
+                    self.workdir, name, "faultline.json"
+                )
+                os.makedirs(os.path.dirname(plan_path), exist_ok=True)
+                with open(plan_path, "w", encoding="utf-8") as f:
+                    json.dump(plan, f)
+                cfg["env"]["FABRIC_TPU_FAULTLINE"] = "@" + plan_path
+            node_dir = os.path.join(self.workdir, name)
+            os.makedirs(node_dir, exist_ok=True)
+            cfg_path = os.path.join(node_dir, "config.json")
+            with open(cfg_path, "w", encoding="utf-8") as f:
+                json.dump(cfg, f, indent=1, sort_keys=True)
+            self.nodes[name] = NodeHandle(
+                name, role, cfg, cfg_path,
+                os.path.join(node_dir, "node.log"),
+            )
+
+    # -- process lifecycle -------------------------------------------------
+
+    def spawn(self, name: str) -> None:
+        node = self.nodes[name]
+        env = dict(os.environ)
+        env["PYTHONPATH"] = _REPO + (
+            os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else ""
+        )
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        # the child arms its own seams from its config's env block; a
+        # parent-session plan must not leak into every node
+        env.pop("FABRIC_TPU_FAULTLINE", None)
+        env.pop("FABRIC_TPU_SOAK", None)
+        ready = node.cfg.get("ready_file")
+        if ready and os.path.exists(ready):
+            os.unlink(ready)
+        with open(node.cfg_path, "w", encoding="utf-8") as f:
+            json.dump(node.cfg, f, indent=1, sort_keys=True)
+        node.proc = subprocess.Popen(
+            [sys.executable, "-m", "fabric_tpu.devtools.netnode",
+             node.cfg_path],
+            env=env,
+            stdout=open(node.log_path, "ab"),
+            stderr=subprocess.STDOUT,
+            cwd=self.workdir,
+        )
+        node.generation += 1
+
+    def start(self, timeout: float = 60.0) -> None:
+        for name in self.nodes:
+            self.spawn(name)
+        deadline = time.monotonic() + timeout
+        for name in self.nodes:
+            self.wait_ready(name, max(0.5, deadline - time.monotonic()))
+
+    def wait_ready(self, name: str, timeout: float = 30.0) -> None:
+        """Readiness = the control RPC answers net.Status.  Bounded
+        retries under deterministic decorrelated backoff (the comm
+        stack's own policy) rather than a hot poll."""
+        from fabric_tpu.comm.backoff import DecorrelatedBackoff
+
+        node = self.nodes[name]
+        bo = DecorrelatedBackoff.for_key(f"netharness-ready:{name}")
+        deadline = time.monotonic() + timeout
+        last: Exception | None = None
+        while time.monotonic() < deadline:
+            if node.proc is not None and node.proc.poll() is not None:
+                raise NetError(
+                    f"node {name} exited rc={node.proc.returncode} "
+                    f"before ready (log: {node.log_path})"
+                )
+            try:
+                self.status(name)
+                return
+            except Exception as exc:  # not listening yet
+                last = exc
+                time.sleep(min(bo.next(), 0.5))
+        raise NetError(
+            f"node {name} not ready within {timeout}s: {last!r} "
+            f"(log: {node.log_path})"
+        )
+
+    def kill(self, name: str, sig: int = signal.SIGKILL) -> None:
+        node = self.nodes[name]
+        if node.proc is None or node.proc.poll() is not None:
+            return
+        node.proc.send_signal(sig)
+        if sig != signal.SIGKILL:
+            try:
+                node.proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                node.proc.kill()
+        node.proc.wait()
+
+    def restart(self, name: str, join_snapshot: str | None = None,
+                timeout: float = 30.0) -> None:
+        node = self.nodes[name]
+        if node.alive():
+            raise NetError(f"restart of live node {name}")
+        if join_snapshot is not None:
+            # rejoin-by-snapshot bootstraps a FRESH ledger root from the
+            # fetched snapshot (the dead root stays on disk for the
+            # post-mortem) and catches up from the snapshot height
+            node.cfg["join_snapshot"] = join_snapshot
+            node.cfg["root"] = os.path.join(
+                self.workdir, name, f"root-rejoin{node.generation}"
+            )
+        self.spawn(name)
+        self.wait_ready(name, timeout)
+
+    def close(self) -> None:
+        for node in self.nodes.values():
+            if node.alive():
+                node.proc.kill()
+        for node in self.nodes.values():
+            if node.proc is not None:
+                try:
+                    node.proc.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    pass
+
+    def __enter__(self) -> "Network":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False
+
+    # -- control RPCs ------------------------------------------------------
+
+    def _client(self, name: str, timeout: float = 5.0):
+        from fabric_tpu.comm import RPCClient
+
+        return RPCClient(*self.nodes[name].rpc_addr, timeout=timeout)
+
+    def status(self, name: str) -> dict:
+        return json.loads(
+            self._client(name).call("net.Status").decode("utf-8")
+        )
+
+    def check(self, name: str, expect: list | None = None) -> dict:
+        body = json.dumps({"expect": expect or []}).encode()
+        return json.loads(
+            self._client(name, timeout=30.0).call(
+                "net.Check", body
+            ).decode("utf-8")
+        )
+
+    def trace_dump(self, name: str) -> dict:
+        return json.loads(
+            self._client(name, timeout=30.0).call(
+                "net.TraceDump"
+            ).decode("utf-8")
+        )
+
+    def broadcast(self, env_bytes: bytes,
+                  prefer: int = 0) -> None:
+        """Send one envelope to the orderer cluster, rotating endpoints
+        on failure (a SIGKILLed orderer must not stall the stream)."""
+        names = self.topo.orderer_names()
+        last: Exception | None = None
+        for i in range(len(names)):
+            name = names[(prefer + i) % len(names)]
+            try:
+                self._client(name).call("ab.Broadcast", env_bytes)
+                return
+            except Exception as exc:
+                last = exc
+        raise NetError(f"no orderer accepted the envelope: {last!r}")
+
+    def snapshot_submit(self, name: str, block_number: int = 0) -> dict:
+        body = json.dumps({"block_number": block_number}).encode()
+        return json.loads(
+            self._client(name).call(
+                "admin.SnapshotSubmit", body
+            ).decode("utf-8")
+        )
+
+    def snapshot_completed(self, name: str) -> list[int]:
+        return json.loads(
+            self._client(name).call(
+                "admin.SnapshotCompleted"
+            ).decode("utf-8")
+        )
+
+    def fetch_snapshot(self, donor: str, block_number: int,
+                       dest_dir: str) -> str:
+        from fabric_tpu.ledger import snapshot as snap
+
+        return snap.fetch_snapshot(
+            self._client(donor, timeout=30.0), self.topo.channel,
+            block_number, dest_dir,
+        )
+
+
+# -- the measured chaos campaign ----------------------------------------------
+
+
+def _probe_missing(net: "Network", peers: list[str],
+                   writes: list[tuple]) -> list | None:
+    """Ask one live peer which expected writes are absent on-chain;
+    None when no peer answered (keep polling)."""
+    expect = [[ns, k, v.decode("utf-8")] for ns, k, v in writes]
+    for name in peers:
+        if not net.nodes[name].alive():
+            continue
+        try:
+            return net.check(name, expect=expect).get("missing", [])
+        except Exception:
+            continue
+    return None
+
+
+def run_stream(
+    net: Network,
+    txs: int,
+    kill_schedule: list[KillRule] | None = None,
+    poll_interval_s: float = 0.05,
+    tx_value_bytes: int = 64,
+    settle_timeout_s: float = 120.0,
+    sample_keys: int = 32,
+) -> dict:
+    """Drive ``txs`` endorser envelopes through broadcast -> raft
+    ordering -> gossip dissemination -> commit on every peer, executing
+    the kill schedule mid-stream, then wait for network-wide
+    convergence and judge it.  Returns the measurement + verdict dict
+    (see ``scripts/netbench.py`` for the JSON line shape)."""
+    from fabric_tpu.devtools import netident
+
+    topo = net.topo
+    peers = topo.peer_names()
+    rng = random.Random(f"netbench-stream:{topo.seed}")
+    filler = "".join(
+        rng.choice("0123456789abcdef") for _ in range(tx_value_bytes)
+    )
+    writes = [
+        ("netcc", f"k{i:06d}", f"v{i}:{filler}".encode())
+        for i in range(txs)
+    ]
+    schedule = sorted(
+        kill_schedule or [], key=lambda r: (r.at_height, r.node)
+    )
+    pending_kills = list(schedule)
+    down: dict[str, dict] = {}      # name -> {rule, t_kill, t_restart}
+    catch_up: dict[str, float] = {}
+    restarts: list[threading.Timer] = []
+    samples: list[tuple[float, dict[str, int]]] = []
+    errors: list[str] = []
+    lock = threading.Lock()
+
+    t0 = time.monotonic()
+
+    # -- broadcaster -------------------------------------------------------
+    sent = [0]
+    stop_bcast = threading.Event()
+
+    def broadcaster() -> None:
+        for i, (ns, key, val) in enumerate(writes):
+            if stop_bcast.is_set():
+                return
+            env = netident.make_tx(
+                topo.channel, key, val, orgs=topo.orgs, cc=ns,
+            )
+            try:
+                net.broadcast(env, prefer=i)
+            except NetError as exc:
+                errors.append(f"broadcast {key}: {exc}")
+                return
+            sent[0] += 1
+
+    bcast = threading.Thread(target=broadcaster, name="netbench-broadcast")
+    bcast.start()
+
+    # -- snapshot rejoin machinery ----------------------------------------
+    def snapshot_rejoin(rule: KillRule) -> str | None:
+        """Produce + fetch a fresh snapshot from a surviving donor peer
+        (no shared disk: admin.SnapshotFetch streams it)."""
+        donor = next(
+            (p for p in peers if p != rule.node and p not in down), None
+        )
+        if donor is None:
+            return None
+        try:
+            net.snapshot_submit(donor, 0)  # next committed block
+            deadline = time.monotonic() + 30.0
+            heights: list[int] = []
+            while time.monotonic() < deadline:
+                heights = net.snapshot_completed(donor)
+                if heights:
+                    break
+                time.sleep(0.1)
+            if not heights:
+                errors.append(f"no snapshot completed on {donor}")
+                return None
+            dest = os.path.join(
+                net.workdir, rule.node, f"fetched-snap-{heights[-1]}"
+            )
+            return net.fetch_snapshot(donor, heights[-1], dest)
+        except Exception as exc:
+            errors.append(f"snapshot rejoin via {donor}: {exc!r}")
+            return None
+
+    def do_restart(rule: KillRule) -> None:
+        try:
+            join_dir = (
+                snapshot_rejoin(rule) if rule.rejoin == "snapshot" else None
+            )
+            net.restart(rule.node, join_snapshot=join_dir)
+            with lock:
+                down[rule.node]["t_restart"] = time.monotonic()
+        except Exception as exc:
+            errors.append(f"restart {rule.node}: {exc!r}")
+
+    # -- monitor / kill executor ------------------------------------------
+    def poll_heights() -> dict[str, int]:
+        hs: dict[str, int] = {}
+        for name in list(net.nodes):
+            if not net.nodes[name].alive():
+                continue
+            try:
+                hs[name] = net.status(name)["height"]
+            except Exception:
+                pass  # racing a kill or a not-yet-ready restart
+        return hs
+
+    def fire_kill(rule: KillRule) -> None:
+        pending_kills.remove(rule)
+        net.kill(
+            rule.node,
+            signal.SIGKILL if rule.sig == "kill9" else signal.SIGTERM,
+        )
+        with lock:
+            down[rule.node] = {
+                "rule": rule, "t_kill": time.monotonic(),
+                "t_restart": None,
+            }
+        if rule.rejoin != "none":
+            t = threading.Timer(
+                rule.restart_after_s, do_restart, args=(rule,)
+            )
+            t.start()
+            restarts.append(t)
+
+    final_height: int | None = None
+    stable_since = 0.0
+    rebroadcasts = 0
+    deadline = time.monotonic() + settle_timeout_s
+    while time.monotonic() < deadline:
+        now = time.monotonic()
+        heights = poll_heights()
+        samples.append((now, heights))
+        # fire due kills
+        for rule in list(pending_kills):
+            h = heights.get(rule.node)
+            if h is not None and h >= rule.at_height:
+                fire_kill(rule)
+        # catch-up bookkeeping: a restarted node is caught up the first
+        # poll its height matches the live maximum
+        with lock:
+            for name, d in down.items():
+                if (
+                    name not in catch_up
+                    and d["t_restart"] is not None
+                    and heights
+                    and heights.get(name) == max(heights.values())
+                ):
+                    catch_up[name] = round(
+                        time.monotonic() - d["t_restart"], 3
+                    )
+        # convergence: broadcast done, no pending kills/restarts, every
+        # peer exactly at the ORDERER cluster's height, stable for
+        # LONGER than the batch timeout (the cutter's final timeout-cut
+        # partial batch can land late; declaring victory inside that
+        # window races the cross-peer digest check against the last
+        # commit) — THEN a content probe.  An envelope accepted by an
+        # orderer that is SIGKILLed before replicating it is
+        # legitimately lost (the reference contract is client
+        # resubmission), so the driver verifies every write landed and
+        # REBROADCASTS the missing ones: duplicate txids are flagged
+        # invalid by the validator, which makes the retry idempotent.
+        orderer_h = 0
+        settled = False
+        if (
+            not bcast.is_alive()
+            and all(not t.is_alive() for t in restarts)
+            and set(peers) <= set(heights)
+        ):
+            orderer_h = max(
+                (h for n, h in heights.items() if n not in peers),
+                default=0,
+            )
+            peer_heights = {
+                n: h for n, h in heights.items() if n in peers
+            }
+            settled = (
+                orderer_h > 1
+                and set(peer_heights.values()) == {orderer_h}
+            )
+        if settled and final_height == orderer_h:
+            if now - stable_since >= max(3 * topo.batch_timeout_s, 0.5):
+                missing_now = _probe_missing(net, peers, writes)
+                if missing_now is None:
+                    pass  # no peer answered the probe: keep polling
+                elif missing_now and rebroadcasts < 5:
+                    rebroadcasts += 1
+                    by_key = {k: (ns, k, v) for ns, k, v in writes}
+                    for ns, key, val in (
+                        by_key[m[1]] for m in missing_now
+                        if m[1] in by_key
+                    ):
+                        try:
+                            net.broadcast(netident.make_tx(
+                                topo.channel, key, val,
+                                orgs=topo.orgs, cc=ns,
+                            ))
+                        except NetError as exc:
+                            errors.append(f"rebroadcast {key}: {exc}")
+                    final_height = None
+                elif missing_now:
+                    errors.append(
+                        f"{len(missing_now)} writes still missing "
+                        f"after {rebroadcasts} rebroadcast rounds"
+                    )
+                    break
+                elif pending_kills:
+                    # the chain quiesced BELOW a scheduled kill height
+                    # (orderer loss shortened it; rebroadcast dedup
+                    # blocks may still not reach it) — fire the next
+                    # kill now instead of deadlocking the run against
+                    # an unreachable trigger
+                    fire_kill(pending_kills[0])
+                    final_height = None
+                else:
+                    break  # converged: every write on-chain, no kills
+        elif settled:
+            final_height = orderer_h
+            stable_since = now
+        if not settled:
+            final_height = None
+        time.sleep(poll_interval_s)
+    # measure to the instant convergence first HELD, not to the end of
+    # the stability-confirmation window
+    t_end = stable_since if final_height is not None else time.monotonic()
+    stop_bcast.set()
+    bcast.join(timeout=10)
+    for t in restarts:
+        t.cancel()
+
+    # -- cross-peer commit lag from the height samples --------------------
+    lag_ms = 0.0
+    if samples:
+        max_h = max(
+            (max(h.values()) for _, h in samples if h), default=0
+        )
+        first_any: dict[int, float] = {}
+        first_all: dict[int, float] = {}
+        reached: dict[str, int] = {}
+        for ts, hs in samples:
+            for n, h in hs.items():
+                if n in peers:
+                    reached[n] = max(reached.get(n, 0), h)
+            for h in range(1, max_h + 1):
+                if h not in first_any and any(
+                    v >= h for v in reached.values()
+                ):
+                    first_any[h] = ts
+                live = [n for n in peers if n in hs]
+                if h not in first_all and live and all(
+                    reached.get(n, 0) >= h for n in live
+                ):
+                    first_all[h] = ts
+        lags = [
+            (first_all[h] - first_any[h]) * 1000.0
+            for h in first_any if h in first_all
+        ]
+        lag_ms = round(max(lags), 1) if lags else 0.0
+
+    # -- network-wide oracle ----------------------------------------------
+    sample = random.Random(f"netbench-sample:{topo.seed}").sample(
+        writes, min(sample_keys, len(writes))
+    )
+    expect = [[ns, k, v.decode("utf-8")] for ns, k, v in sample]
+    checks: dict[str, dict] = {}
+    for name in peers:
+        try:
+            checks[name] = net.check(name, expect=None)
+        except Exception as exc:
+            checks[name] = {"error": repr(exc)}
+    digests = {
+        checks[n].get("state_digest") for n in peers if "error" not in
+        checks.get(n, {})
+    }
+    presence_missing: list = []
+    probe_peer = peers[0]
+    try:
+        probe = net.check(probe_peer, expect=expect)
+        presence_missing = probe.get("missing", [])
+    except Exception as exc:
+        presence_missing = [["<probe>", probe_peer, repr(exc)]]
+
+    violations = {
+        n: checks[n].get("violations", [{"check": "rpc",
+                                         "detail": checks[n].get("error")}])
+        for n in peers
+    }
+    heights_final = {
+        n: checks[n].get("height") for n in peers
+    }
+    converged = (
+        final_height is not None
+        and len(set(heights_final.values())) == 1
+        and not errors
+    )
+    ok = (
+        converged
+        and len(digests) == 1
+        and not presence_missing
+        and all(not v for v in violations.values())
+        and sent[0] == txs
+    )
+
+    elapsed = max(t_end - t0, 1e-6)
+    result = {
+        "ok": ok,
+        "seed": topo.seed,
+        "topology": topo.as_dict(),
+        "kill_schedule": [r.as_dict() for r in schedule],
+        "txs": txs,
+        "sent": sent[0],
+        "final_height": final_height,
+        "committed_tx_per_s": round(txs / elapsed, 2) if ok else 0.0,
+        "elapsed_s": round(elapsed, 3),
+        "rebroadcasts": rebroadcasts,
+        "catch_up_s": dict(sorted(catch_up.items())),
+        "max_cross_peer_lag_ms": lag_ms,
+        "state_digests_agree": len(digests) == 1,
+        "violations": {n: v for n, v in sorted(violations.items()) if v},
+        "missing": presence_missing,
+        "errors": errors,
+        "heights": dict(sorted(heights_final.items())),
+    }
+    return result
+
+
+def verdict_doc(result: dict) -> dict:
+    """The byte-deterministic verdict view of a run: only seed-derived
+    and pass/fail fields (no timings, no throughput) — two runs of the
+    same seed and topology must serialize identically when they pass."""
+    return {
+        "experiment": "netharness",
+        "seed": result["seed"],
+        "topology": result["topology"],
+        "kill_schedule": result["kill_schedule"],
+        "txs": result["txs"],
+        "ok": bool(result["ok"]),
+        "state_digests_agree": bool(result["state_digests_agree"]),
+        "violations": result["violations"],
+        "missing": result["missing"],
+        "caught_up": sorted(result["catch_up_s"]),
+    }
+
+
+def write_repro(result: dict, path: str) -> str:
+    """A replayable repro artifact for a failing campaign: topology +
+    kill schedule + seed (scripts/chaos.py --kill9 --replay re-runs
+    it)."""
+    doc = {
+        "kind": "netharness-kill9",
+        "seed": result["seed"],
+        "topology": result["topology"],
+        "kill_schedule": result["kill_schedule"],
+        "txs": result["txs"],
+        "verdict": verdict_doc(result),
+    }
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return path
+
+
+def replay_repro(path: str, workdir: str) -> dict:
+    """Re-run a kill9 repro artifact over a fresh workload directory."""
+    with open(path, "r", encoding="utf-8") as f:
+        doc = json.load(f)
+    t = doc["topology"]
+    topo = Topology(
+        orgs=t["orgs"], peers_per_org=t["peers_per_org"],
+        orderers=t["orderers"], channel=t["channel"],
+        seed=doc["seed"], batch_timeout_s=t["batch_timeout_s"],
+        max_message_count=t["max_message_count"],
+    )
+    schedule = [KillRule.from_dict(r) for r in doc["kill_schedule"]]
+    with Network(workdir, topo) as net:
+        net.start()
+        return run_stream(net, doc["txs"], schedule)
+
+
+def merge_traces(net: Network, out_path: str | None = None) -> dict:
+    """Fold every live node's tracelens dump into ONE network trace:
+    each node becomes a Chrome trace pid (with process_name metadata),
+    and the gossip/RPC wire tokens keep cross-process spans causally
+    linked (each node's ids live in a disjoint band, so merged trace
+    ids never collide)."""
+    events: list[dict] = []
+    names = sorted(net.nodes)
+    for pid, name in enumerate(names, start=1):
+        if not net.nodes[name].alive():
+            continue
+        try:
+            doc = net.trace_dump(name)
+        except Exception:
+            continue
+        events.append({
+            "ph": "M", "name": "process_name", "pid": pid, "tid": 0,
+            "args": {"name": name},
+        })
+        for ev in doc.get("traceEvents", []):
+            ev = dict(ev)
+            ev["pid"] = pid
+            events.append(ev)
+    merged = {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {"source": "fabric_tpu.netharness"},
+    }
+    if out_path:
+        from fabric_tpu.common import tracing
+
+        tracing.dump_doc(out_path, merged)
+    return merged
+
+
+__all__ = [
+    "Topology", "KillRule", "Network", "NetError",
+    "generate_kill_schedule", "run_stream", "verdict_doc",
+    "write_repro", "replay_repro", "merge_traces", "free_port",
+]

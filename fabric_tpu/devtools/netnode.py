@@ -1,0 +1,445 @@
+"""netnode — one node of a netharness topology, run as its own OS
+process (``python -m fabric_tpu.devtools.netnode <config.json>``).
+
+The multi-process sibling of ``node/peer_node.py`` / ``node/
+orderer_node.py`` for hosts without the ``cryptography`` package: the
+identity plane comes from :mod:`fabric_tpu.devtools.netident`, but the
+machinery under test is the production stack —
+
+  orderer role: raft consensus over ``TCPTransport`` (WAL recovery on
+    restart), blockcutter/blockwriter, ``ab.Broadcast``/``ab.Deliver``
+    over the framed RPC transport;
+  peer role: ``LedgerProvider`` (sqlite + block files, REAL crash
+    recovery after kill -9), ``TxValidator`` -> ``Committer``, gossip
+    over ``TCPGossipComm`` (push/pull/state transfer/leader election),
+    the ``DeliverClient`` pulling from the orderer cluster, snapshot
+    generation/serving, and the operations endpoint (``/traces``).
+
+Lifecycle contract (what the harness relies on):
+
+- startup is CRASH-TOLERANT: a peer restarted after SIGKILL reopens its
+  ledger through normal recovery; a half-finished snapshot import is
+  discarded (``discard_failed_import``) and the node rejoins from its
+  configured snapshot;
+- SIGTERM is a CLEAN stop: every component's stop path runs and the
+  process exits 0 (the harness's graceful-stop schedule entries);
+- SIGKILL needs no cooperation, which is the point.
+
+The control surface rides the same RPC server the data plane uses:
+``net.Status`` (readiness + heights), ``net.Check`` (the invariants
+oracle run in-process, over THIS node's stores), ``net.TraceDump``,
+``admin.SnapshotSubmit``/``admin.SnapshotFetch``/``admin.Height``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import threading
+
+
+def _configure_env(cfg: dict) -> None:
+    """Arm per-node chaos/trace seams BEFORE fabric imports: the
+    harness plumbs per-node FABRIC_TPU_FAULTLINE / FABRIC_TPU_TRACE
+    through the child environment (faultfuzz's multi-process mode), and
+    those modules read the environment at import time."""
+    for key, val in (cfg.get("env") or {}).items():
+        os.environ.setdefault(key, str(val))
+
+
+def main(argv: list[str]) -> int:
+    with open(argv[0], "r", encoding="utf-8") as f:
+        cfg = json.load(f)
+    _configure_env(cfg)
+
+    # imports AFTER env plumbing (faultline/tracing arm from env)
+    from fabric_tpu.common import tracing
+    from fabric_tpu.devtools import invariants, netident
+
+    if cfg.get("trace"):
+        tracing.arm(int(cfg["trace"]))
+        # per-node id bases keep span/trace ids globally unique across
+        # the topology, so merged network traces stay causally linked
+        # instead of colliding at id 1 in every process
+        tracing.reset_ids(int(cfg.get("trace_id_base", 0)))
+
+    stop_evt = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop_evt.set())
+    signal.signal(signal.SIGINT, lambda *_: stop_evt.set())
+
+    role = cfg["role"]
+    node = (
+        _build_orderer(cfg, netident)
+        if role == "orderer"
+        else _build_peer(cfg, netident, invariants, tracing)
+    )
+    try:
+        node.start()
+        _touch(cfg.get("ready_file"))
+        stop_evt.wait()
+    finally:
+        node.stop()
+    return 0
+
+
+def _touch(path: str | None) -> None:
+    if path:
+        with open(path, "w", encoding="utf-8") as f:
+            f.write("ready\n")
+
+
+# -- orderer role -------------------------------------------------------------
+
+
+class _OrdererSupport:
+    """chain_getter target for the deliver service: the raft-ordered
+    block store behind the fake channel bundle."""
+
+    def __init__(self, store, bundle):
+        self.store = store
+        self.bundle = bundle
+
+
+class NetOrderer:
+    def __init__(self, cfg: dict):
+        from fabric_tpu.comm import RPCServer
+        from fabric_tpu.common.deliver import BlockNotifier, DeliverService
+        from fabric_tpu.devtools import netident
+        from fabric_tpu.ledger.blkstorage import BlockStore
+        from fabric_tpu.ledger.kvstore import open_kvstore
+        from fabric_tpu.orderer.blockcutter import BlockCutter
+        from fabric_tpu.orderer.blockwriter import BlockWriter
+        from fabric_tpu.orderer.raft import RaftChain
+        from fabric_tpu.orderer.raft.transport import TCPTransport
+        from fabric_tpu.protos.orderer import ab_pb2, raft_pb2 as rpb
+        from fabric_tpu.protos.common import common_pb2
+
+        self._ab = ab_pb2
+        self._common = common_pb2
+        self.cfg = cfg
+        self.channel = cfg["channel"]
+        root = cfg["root"]
+        os.makedirs(root, exist_ok=True)
+        self.kv = open_kvstore(os.path.join(root, "index.sqlite"))
+        self.store = BlockStore(
+            os.path.join(root, "chains"), self.kv, name=self.channel
+        )
+        genesis = netident.make_genesis(self.channel)
+        if self.store.height == 0:
+            self.store.add_block(genesis)
+        self.writer = BlockWriter(self.store)
+        node_id = int(cfg["node_id"])
+        self.transport = TCPTransport(
+            node_id, ("127.0.0.1", int(cfg["raft_port"]))
+        )
+        consenters = []
+        for cid, addr in sorted(
+            cfg["consenters"].items(), key=lambda kv: int(kv[0])
+        ):
+            cid = int(cid)
+            consenters.append(rpb.Consenter(id=cid))
+            if cid != node_id:
+                self.transport.set_peer(cid, (addr[0], int(addr[1])))
+        notifier = BlockNotifier()
+        self.chain = RaftChain(
+            self.channel,
+            node_id,
+            consenters,
+            BlockCutter(
+                max_message_count=int(cfg.get("max_message_count", 10))
+            ),
+            self.writer,
+            self.transport,
+            wal_dir=os.path.join(root, "wal"),
+            batch_timeout_s=float(cfg.get("batch_timeout_s", 0.2)),
+            tick_interval_s=float(cfg.get("tick_interval_s", 0.02)),
+            on_block=lambda blk: notifier.notify(),
+        )
+        self.transport.set_handler(self.chain.handle_step)
+        bundle = netident.FakeBundle(k=1)
+        self.deliver = DeliverService(
+            lambda ch: (
+                _OrdererSupport(self.store, bundle)
+                if ch == self.channel else None
+            ),
+            netident.FakeCSP(),
+            notifier=notifier,
+        )
+        self.rpc = RPCServer("127.0.0.1", int(cfg["rpc_port"]))
+        self.rpc.register("ab.Broadcast", self._broadcast)
+        self.rpc.register("ab.Deliver", self._deliver)
+        self.rpc.register("net.Status", self._status)
+        self.rpc.register("net.TraceDump", self._trace_dump)
+
+    def start(self) -> None:
+        self.chain.start()
+        self.rpc.start()
+
+    def stop(self) -> None:
+        self.rpc.stop()
+        self.deliver.stop()
+        self.chain.halt()
+        self.transport.close()
+        self.kv.close()
+
+    def _broadcast(self, body: bytes, stream) -> bytes:
+        env = self._common.Envelope.FromString(body)
+        self.chain.order(env)
+        return self._ab.BroadcastResponse(
+            status=self._common.SUCCESS
+        ).SerializeToString()
+
+    def _deliver(self, body: bytes, stream):
+        from fabric_tpu.common.deliver import deliver_response_frames
+
+        return deliver_response_frames(self.deliver, body)
+
+    def _status(self, body: bytes, stream) -> bytes:
+        return json.dumps({
+            "role": "orderer",
+            "name": self.cfg["name"],
+            "height": self.store.height,
+            "is_leader": self.chain.is_leader,
+            "leader": self.chain.leader,
+        }, sort_keys=True).encode()
+
+    def _trace_dump(self, body: bytes, stream) -> bytes:
+        from fabric_tpu.common import tracing
+
+        return json.dumps(tracing.export(), sort_keys=True).encode()
+
+
+def _build_orderer(cfg: dict, netident) -> NetOrderer:
+    return NetOrderer(cfg)
+
+
+# -- peer role ----------------------------------------------------------------
+
+
+class NetPeer:
+    def __init__(self, cfg: dict, invariants, tracing):
+        from fabric_tpu.comm import RPCClient, RPCServer
+        from fabric_tpu.common.deliver import make_seek_info_envelope
+        from fabric_tpu.devtools import netident
+        from fabric_tpu.gossip import GossipRunner, GossipService
+        from fabric_tpu.gossip.comm import TCPGossipComm
+        from fabric_tpu.ledger import LedgerProvider, snapshot as snap
+        from fabric_tpu.peer.committer import Committer
+        from fabric_tpu.peer.deliverclient import DeliverClient
+        from fabric_tpu.peer.txvalidator import TxValidator
+        from fabric_tpu.protos.common import common_pb2
+        from fabric_tpu.protos.orderer import ab_pb2
+
+        self._invariants = invariants
+        self._tracing = tracing
+        self._netident = netident
+        self._common = common_pb2
+        self.cfg = cfg
+        self.channel = cfg["channel"]
+        self.name = cfg["name"]
+        root = cfg["root"]
+        os.makedirs(root, exist_ok=True)
+        self.provider = LedgerProvider(root)
+        genesis = netident.make_genesis(self.channel)
+        join_dir = cfg.get("join_snapshot")
+        try:
+            if join_dir:
+                self.ledger = self.provider.create_from_snapshot(join_dir)
+            else:
+                self.ledger = self.provider.create(genesis)
+        except snap.SnapshotError:
+            # crash-tolerant reopen: a kill -9 mid-import leaves the
+            # half-import marker; discard the debris and retry (from
+            # the snapshot when one is configured, else from genesis)
+            self.provider.discard_failed_import(self.channel)
+            self.ledger = (
+                self.provider.create_from_snapshot(join_dir)
+                if join_dir else self.provider.create(genesis)
+            )
+        orgs = int(cfg.get("orgs", 1))
+        self.csp = netident.FakeCSP()
+        bundle = netident.FakeBundle(k=1 if orgs < 2 else 2)
+        self.validator = TxValidator(
+            self.channel, self.ledger, bundle, self.csp
+        )
+        self.committer = Committer(self.validator, self.ledger)
+
+        # deliver client over the orderer cluster's ab.Deliver, signed
+        # with this node's fake identity (the orderer's deliver policy
+        # verifies it)
+        signer = netident.sign_as
+        ident = b"cre:" + self.name.encode()
+
+        class _Signer:
+            def serialize(self):
+                return ident
+
+            def sign(self, msg: bytes) -> bytes:
+                from fabric_tpu.common.hashing import sha256
+
+                return signer(ident, sha256(msg))
+
+        def connect_fn(endpoint):
+            def connect(start_num: int):
+                client = RPCClient(endpoint[0], int(endpoint[1]),
+                                   timeout=10.0)
+                env = make_seek_info_envelope(
+                    self.channel, start_num, 0x7FFFFFFFFFFFFFFF,
+                    signer=_Signer(),
+                )
+                for raw in client.stream("ab.Deliver",
+                                         env.SerializeToString()):
+                    resp = ab_pb2.DeliverResponse.FromString(raw)
+                    if resp.WhichOneof("Type") == "block":
+                        yield resp.block
+                    else:
+                        return
+
+            return connect
+
+        self.deliver_client = DeliverClient(
+            self.channel,
+            [connect_fn(ep) for ep in cfg["orderer_endpoints"]],
+            height_fn=lambda: self.ledger.height,
+            sink=self._receive_block,
+            max_backoff_s=2.0,
+        )
+
+        self.comm = TCPGossipComm(
+            ("127.0.0.1", int(cfg["gossip_port"])),
+            self.name.encode(),
+            mcs=netident.NetMCS(bytes.fromhex(cfg["secret"])),
+        )
+        self.gossip = GossipService(
+            self.comm, list(cfg.get("gossip_bootstrap") or [])
+        )
+        self.handle = self.gossip.join_channel(
+            self.channel, self.committer,
+            deliver_client=self.deliver_client,
+        )
+        self.runner = GossipRunner(
+            self.gossip, float(cfg.get("gossip_tick_s", 0.1))
+        )
+
+        self.operations = None
+        if cfg.get("ops_port") is not None:
+            from fabric_tpu.common.operations import System
+
+            self.operations = System(("127.0.0.1", int(cfg["ops_port"])))
+
+        self.rpc = RPCServer("127.0.0.1", int(cfg["rpc_port"]))
+        self.rpc.register("net.Status", self._status)
+        self.rpc.register("net.Check", self._check)
+        self.rpc.register("net.TraceDump", self._trace_dump)
+        self.rpc.register("admin.Height", self._height)
+        self.rpc.register("admin.SnapshotSubmit", self._snapshot_submit)
+        self.rpc.register("admin.SnapshotList", self._snapshot_list)
+        self.rpc.register("admin.SnapshotCompleted", self._snapshot_completed)
+        self.rpc.register("admin.SnapshotFetch", self._snapshot_fetch)
+
+    def _receive_block(self, seq: int, block_bytes: bytes) -> None:
+        self.handle.state.add_payload(seq, block_bytes, from_orderer=True)
+
+    def start(self) -> None:
+        self.runner.start()
+        self.rpc.start()
+        if self.operations is not None:
+            self.operations.start()
+
+    def stop(self) -> None:
+        self.rpc.stop()
+        self.runner.stop()
+        self.deliver_client.stop()
+        self.comm.close()
+        if self.operations is not None:
+            self.operations.stop()
+        if self.ledger.snapshots is not None:
+            self.ledger.snapshots.wait_idle(timeout=5.0)
+        self.provider.close()
+
+    # -- control surface ---------------------------------------------------
+
+    def _status(self, body: bytes, stream) -> bytes:
+        dc = self.deliver_client
+        return json.dumps({
+            "role": "peer",
+            "name": self.name,
+            "height": self.ledger.height,
+            "durable_height": getattr(
+                self.ledger, "durable_height", self.ledger.height
+            ),
+            "gossip_endpoint": self.comm.endpoint,
+            "alive_peers": sorted(
+                p.endpoint for p in self.gossip.discovery.alive_peers()
+            ),
+            "election_leader": self.handle.election.is_leader,
+            "deliver_running": bool(
+                dc._thread is not None and dc._thread.is_alive()
+                and not dc._stop.is_set()
+            ),
+            "delivered": dc.delivered,
+        }, sort_keys=True).encode()
+
+    def _height(self, body: bytes, stream) -> bytes:
+        return str(self.ledger.height).encode()
+
+    def _check(self, body: bytes, stream) -> bytes:
+        """The invariants oracle over THIS node's stores, plus a
+        canonical state digest for cross-peer agreement and presence
+        probes for harness-sampled keys."""
+        req = json.loads(body.decode("utf-8")) if body else {}
+        violations = self._invariants.check_ledger(self.ledger)
+        missing = []
+        for ns, key, value in req.get("expect", []):
+            got = self.ledger.get_state(ns, key)
+            if got != value.encode("utf-8"):
+                missing.append([ns, key, repr(got)])
+        return json.dumps({
+            "name": self.name,
+            "height": self.ledger.height,
+            "violations": [v.as_dict() for v in violations],
+            "missing": missing,
+            "state_digest": self._invariants.state_digest(self.ledger),
+        }, sort_keys=True).encode()
+
+    def _trace_dump(self, body: bytes, stream) -> bytes:
+        return json.dumps(
+            self._tracing.export(), sort_keys=True
+        ).encode()
+
+    def _snapshot_submit(self, body: bytes, stream) -> bytes:
+        req = json.loads(body.decode("utf-8"))
+        res = self.ledger.snapshots.submit_request(
+            int(req.get("block_number", 0))
+        )
+        return json.dumps(res).encode()
+
+    def _snapshot_list(self, body: bytes, stream) -> bytes:
+        return json.dumps(self.ledger.snapshots.list_pending()).encode()
+
+    def _snapshot_completed(self, body: bytes, stream) -> bytes:
+        from fabric_tpu.ledger import snapshot as snap
+
+        return json.dumps(snap.list_completed(
+            self.provider.snapshots_root, self.channel
+        )).encode()
+
+    def _snapshot_fetch(self, body: bytes, stream):
+        from fabric_tpu.ledger import snapshot as snap
+
+        req = json.loads(body.decode("utf-8"))
+        sdir = snap.completed_snapshot_dir(
+            self.provider.snapshots_root, self.channel,
+            int(req["block_number"]),
+        )
+        return snap.stream_snapshot_dir(sdir)
+
+
+def _build_peer(cfg: dict, netident, invariants, tracing) -> NetPeer:
+    return NetPeer(cfg, invariants, tracing)
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

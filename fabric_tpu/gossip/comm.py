@@ -32,6 +32,15 @@ from fabric_tpu.protos.gossip import message_pb2 as gpb
 
 _LEN = struct.Struct(">I")
 
+# Trace-context piggyback on the TCP transport: a traced sender
+# prefixes the frame's SignedGossipMessage bytes with the wire token,
+# so a remote peer's commit spans nest under the DISSEMINATING peer's
+# trace instead of rooting fresh at the hop.  The framing itself lives
+# beside wire_token/from_wire in common/tracing (one owner for the
+# token format); these aliases are this module's seam.
+_frame_with_token = tracing.frame_with_token
+_split_frame_token = tracing.split_frame_token
+
 
 class ReceivedMessage:
     """A deserialized, signature-checked inbound message + reply path."""
@@ -119,7 +128,8 @@ class GossipComm:
             payload=payload, signature=self.mcs.sign(payload)
         )
 
-    def _dispatch(self, signed: gpb.SignedGossipMessage, sender_pki: bytes, respond):
+    def _dispatch(self, signed: gpb.SignedGossipMessage, sender_pki: bytes,
+                  respond, trace_parent=None):
         try:
             msg = gpb.GossipMessage.FromString(signed.payload)
         except Exception:
@@ -138,9 +148,13 @@ class GossipComm:
         rm = ReceivedMessage(msg, sender_pki, respond)
         # one span per inbound dispatch: in-process transports call
         # _dispatch on the sender's thread, so it nests under the
-        # sender's span; socket transports root a fresh trace here
+        # sender's span; the TCP transport carries the sender's context
+        # as a frame token (`trace_parent`), so block/state-transfer
+        # deliveries nest under the disseminating peer's trace instead
+        # of rooting a fresh one at the wire hop
         with tracing.span(
             "gossip.deliver",
+            parent=trace_parent,
             content=msg.WhichOneof("content") or "",
             subscribers=len(self._subscribers),
         ):
@@ -317,10 +331,14 @@ class TCPGossipComm(GossipComm):
                         clockskew.wait(self._stop, bo.next())
                         break
                 try:
+                    # the enqueuer's context also rides the frame itself
+                    # (token prefix) so the REMOTE dispatch joins this
+                    # trace; untraced sends are byte-identical
+                    wire = _frame_with_token(data, trace_ctx)
                     with tracing.attached(trace_ctx), tracing.span(
                         "gossip.send", endpoint=endpoint, n=len(data),
                     ):
-                        sock.sendall(_LEN.pack(len(data)) + data)
+                        sock.sendall(_LEN.pack(len(wire)) + wire)
                     # only a completed DATA send proves the link: an
                     # accept-then-reset peer must not restart the
                     # backoff sequence every flap
@@ -435,11 +453,14 @@ class TCPGossipComm(GossipComm):
                 frame = self._read_frame(conn, buf)
                 if frame is None:
                     return
+                payload, trace_parent = _split_frame_token(frame)
                 try:
-                    sm = gpb.SignedGossipMessage.FromString(frame)
+                    sm = gpb.SignedGossipMessage.FromString(payload)
                 except Exception:
                     continue  # malformed frame: drop it, keep serving
-                self._dispatch(sm, sender_pki, respond)
+                self._dispatch(
+                    sm, sender_pki, respond, trace_parent=trace_parent
+                )
         except OSError:
             return
         finally:

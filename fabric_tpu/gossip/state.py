@@ -57,6 +57,13 @@ class StateProvider:
         # ledger commit lock (store_block enters the committer/ledger
         # while holding it); nothing may take it while holding those
         self._commit_lock = named_lock("gossip.state.commit")
+        # re-entrancy guard for continuous catch-up: the in-process
+        # gossip transport dispatches synchronously on the sender's
+        # stack, so an unguarded request->response->request chain would
+        # RECURSE once per batch and overflow the stack on a peer far
+        # behind; one level of chaining per thread keeps TCP at
+        # transfer rate while in-proc degrades safely to tick rate
+        self._chaining = threading.local()
         channel_gossip.ledger_height = lambda: self._committer.height
         # blocks arriving via gossip land here
         self._gossip._on_block = self._on_gossip_block
@@ -96,16 +103,32 @@ class StateProvider:
 
     def tick(self) -> None:
         """Request the missing range from the best-known peer if we lag."""
+        self._request_missing()
+
+    def _request_missing(self) -> bool:
+        """One state-transfer request for the first missing range; True
+        when a request went out.  Catch-up-under-churn fixes the
+        netharness surfaced: blocks the payload buffer ALREADY holds
+        are skipped (a restarted peer's push/pull traffic pre-fills the
+        buffer — re-requesting those wastes the batch budget exactly
+        when the peer is furthest behind), and the request anchors at
+        the first actual gap."""
         ep, their_height = self._gossip.best_peer_height()
         my_height = self._committer.height
         if ep is None or their_height <= my_height:
-            return
+            return False
+        start = my_height
+        while start < their_height and start in self._buffer:
+            start += 1
+        if start >= their_height:
+            return False  # every missing block is already buffered
         req = gpb.GossipMessage(channel=self._chan)
-        req.state_request.start_seq_num = my_height
+        req.state_request.start_seq_num = start
         req.state_request.end_seq_num = min(
-            their_height - 1, my_height + self._max_batch - 1
+            their_height - 1, start + self._max_batch - 1
         )
         self._comm.send(ep, req)
+        return True
 
     def _handle(self, rm) -> None:
         msg = rm.msg
@@ -127,8 +150,26 @@ class StateProvider:
             if ep and resp.state_response.payloads:
                 self._comm.send(ep, resp)
         elif kind == "state_response":
+            before = self._committer.height
             for dm in msg.state_response.payloads:
                 self.add_payload(dm.seq_num, bytes(dm.block))
+            # continuous catch-up: this batch made real progress and we
+            # are still behind — chain the next request NOW instead of
+            # waiting for the next anti-entropy tick, so a kill -9'd
+            # peer catches up at transfer rate, not tick rate (the
+            # progress guard makes the chain terminate: a batch that
+            # advances nothing stops it; the thread-local depth guard
+            # keeps a synchronous in-proc transport from recursing)
+            if (
+                msg.state_response.payloads
+                and self._committer.height > before
+                and not getattr(self._chaining, "active", False)
+            ):
+                self._chaining.active = True
+                try:
+                    self._request_missing()
+                finally:
+                    self._chaining.active = False
 
     def _read_committed(self, seq: int) -> bytes | None:
         reader = getattr(self._committer, "get_block_by_number", None)

@@ -908,6 +908,13 @@ class LedgerProvider:
         next to the ledgers (transient store) mount namespaces on it."""
         return self._kv
 
+    @property
+    def snapshots_root(self) -> str | None:
+        """The completed/in_progress snapshot tree this provider's
+        ledgers export into — the directory admin.SnapshotFetch serves
+        remote join-by-snapshot from."""
+        return self._snapshots_dir
+
     def list(self) -> list[str]:
         return sorted(self._ledgers)
 

@@ -702,6 +702,122 @@ class SnapshotManager:
         )
 
 
+# -- snapshot serving (remote fetch) ------------------------------------------
+#
+# join_by_snapshot used to require the snapshot directory on SHARED disk.
+# These helpers stream a COMPLETED snapshot directory over any frame
+# transport (the peer's admin.SnapshotFetch RPC): each frame is a JSON
+# header line (file name + eof marker) followed by a raw chunk.  The
+# receiver rebuilds the directory; integrity needs no transport trust —
+# verify-on-import recomputes every file digest, so a torn or tampered
+# stream is refused at join time (pinned by the torn-stream test via the
+# snapshot.fetch.chunk faultline seam).
+
+FETCH_CHUNK = 1 << 20
+
+
+def completed_snapshot_dir(snapshots_root: str, ledger_id: str,
+                           block_number: int) -> str:
+    """The canonical completed/<lid>/<height> path; raises when absent."""
+    path = os.path.join(
+        snapshots_root, "completed", ledger_id, str(int(block_number))
+    )
+    if not os.path.isdir(path):
+        raise SnapshotError(
+            f"no completed snapshot for {ledger_id!r} at height "
+            f"{block_number}"
+        )
+    return path
+
+
+def list_completed(snapshots_root: str, ledger_id: str) -> list[int]:
+    """Completed snapshot heights for a channel, ascending."""
+    ldir = os.path.join(snapshots_root, "completed", ledger_id)
+    if not os.path.isdir(ldir):
+        return []
+    return sorted(int(h) for h in os.listdir(ldir) if h.isdigit())
+
+
+def stream_snapshot_dir(snapshot_dir: str):
+    """Yield the frames of a completed snapshot directory: per chunk, a
+    JSON header line + raw bytes.  The first frame is the manifest."""
+    names = sorted(
+        n for n in os.listdir(snapshot_dir)
+        if os.path.isfile(os.path.join(snapshot_dir, n))
+    )
+    yield json.dumps(
+        {"manifest": names, "snapshot": os.path.basename(snapshot_dir)},
+        sort_keys=True,
+    ).encode() + b"\n"
+    for name in names:
+        path = os.path.join(snapshot_dir, name)
+        index = 0
+        with open(path, "rb") as f:
+            while True:
+                chunk = f.read(FETCH_CHUNK)
+                eof = len(chunk) < FETCH_CHUNK
+                # torn-stream seam: an armed plan raising here cuts the
+                # transfer mid-file; the receiver is left with a partial
+                # directory that verify-on-import must refuse
+                faultline.point(
+                    "snapshot.fetch.chunk", file=name, index=index
+                )
+                header = json.dumps(
+                    {"name": name, "eof": eof}, sort_keys=True
+                ).encode() + b"\n"
+                yield header + chunk
+                index += 1
+                if eof:
+                    break
+
+
+def receive_snapshot_stream(frames, dest_dir: str) -> str:
+    """Rebuild a streamed snapshot directory under ``dest_dir``; returns
+    the directory holding the received files.  Verification is the
+    CALLER's job (create_from_snapshot / verify_snapshot) — a transport
+    error mid-stream leaves a partial directory those refuse."""
+    os.makedirs(dest_dir, exist_ok=True)
+    open_files: dict[str, object] = {}
+    try:
+        it = iter(frames)
+        first = next(it, None)
+        if first is None:
+            raise SnapshotError("empty snapshot stream")
+        manifest = json.loads(first.split(b"\n", 1)[0].decode("utf-8"))
+        if "manifest" not in manifest:
+            raise SnapshotError("snapshot stream missing its manifest")
+        for frame in it:
+            header_line, chunk = frame.split(b"\n", 1)
+            header = json.loads(header_line.decode("utf-8"))
+            name = os.path.basename(header["name"])  # no path escapes
+            f = open_files.get(name)
+            if f is None:
+                f = open_files[name] = open(
+                    os.path.join(dest_dir, name), "wb"
+                )
+            f.write(chunk)
+            if header.get("eof"):
+                open_files.pop(name).close()
+    finally:
+        for f in open_files.values():
+            f.close()
+    return dest_dir
+
+
+def fetch_snapshot(client, channel_id: str, block_number: int,
+                   dest_dir: str) -> str:
+    """Client half of ``admin.SnapshotFetch``: stream a remote peer's
+    completed snapshot into ``dest_dir`` (``client`` is an RPCClient —
+    or anything with .stream(method, body))."""
+    body = json.dumps(
+        {"channel": channel_id, "block_number": int(block_number)},
+        sort_keys=True,
+    ).encode()
+    return receive_snapshot_stream(
+        client.stream("admin.SnapshotFetch", body), dest_dir
+    )
+
+
 __all__ = [
     "SnapshotError",
     "SnapshotExistsError",
@@ -724,4 +840,10 @@ __all__ = [
     "CONFIG_BLOCK_FILE",
     "DATA_FILES",
     "SNAPSHOT_FORMAT_VERSION",
+    "completed_snapshot_dir",
+    "list_completed",
+    "stream_snapshot_dir",
+    "receive_snapshot_stream",
+    "fetch_snapshot",
+    "FETCH_CHUNK",
 ]

@@ -91,6 +91,12 @@ class OrdererNode:
         )
 
     def stop(self) -> None:
+        # idempotent: subprocess drivers reach stop() from BOTH the
+        # signal handler and their finally block — the second call must
+        # be a no-op, not a crash on half-torn-down components
+        if getattr(self, "_stopped", False):
+            return
+        self._stopped = True
         self.rpc.stop()
         self.deliver.stop()
         self.registrar.halt_all()

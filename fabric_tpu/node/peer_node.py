@@ -338,6 +338,7 @@ class PeerNode:
         # internal/peer/node/start.go re-initializes each channel)
         if os.path.isdir(root_dir):
             from fabric_tpu.ledger import admin as ledger_admin
+            from fabric_tpu.ledger.snapshot import SnapshotError
 
             paused = ledger_admin.paused_channels(root_dir)
             for entry in sorted(os.listdir(root_dir)):
@@ -345,7 +346,22 @@ class PeerNode:
                     continue
                 if entry in paused:  # `peer node resume` re-enables
                     continue
-                ledger = self.provider.open(entry)
+                try:
+                    ledger = self.provider.open(entry)
+                except SnapshotError as exc:
+                    # crash-tolerant reopen: a node kill -9'd mid
+                    # join-by-snapshot leaves this channel's half-import
+                    # marker behind.  One broken channel must not keep
+                    # the whole peer down — every other channel serves;
+                    # this one stays refused until the operator runs
+                    # discard_failed_import and rejoins (the netharness
+                    # restart path exercises exactly this).
+                    from fabric_tpu.common.flogging import must_get_logger
+
+                    must_get_logger("peer").error(
+                        "channel %s not reopened: %s", entry, exc,
+                    )
+                    continue
                 genesis = ledger.get_block_by_number(0)
                 if genesis is None:
                     # snapshot-bootstrapped channel: no chain block 0 —
@@ -380,6 +396,7 @@ class PeerNode:
         self.rpc.register("admin.SnapshotSubmit", self._admin_snapshot_submit)
         self.rpc.register("admin.SnapshotCancel", self._admin_snapshot_cancel)
         self.rpc.register("admin.SnapshotList", self._admin_snapshot_list)
+        self.rpc.register("admin.SnapshotFetch", self._admin_snapshot_fetch)
         self.rpc.register("admin.JoinBySnapshot", self._admin_join_by_snapshot)
 
     # -- chaincode wiring --------------------------------------------------
@@ -603,6 +620,22 @@ class PeerNode:
             self._snapshot_mgr(body.decode("utf-8")).list_pending()
         ).encode()
 
+    def _admin_snapshot_fetch(self, body: bytes, stream):
+        """Stream a COMPLETED snapshot directory to a remote peer
+        (reference gap: joinbysnapshot requires shared disk; this is
+        the snapshot-serving RPC that removes it).  Integrity rides on
+        verify-on-import at the receiver, not on the transport."""
+        import json
+
+        from fabric_tpu.ledger import snapshot as snap
+
+        req = json.loads(body.decode("utf-8"))
+        sdir = snap.completed_snapshot_dir(
+            self.provider.snapshots_root, req["channel"],
+            int(req["block_number"]),
+        )
+        return snap.stream_snapshot_dir(sdir)
+
     def _admin_join_by_snapshot(self, body: bytes, stream) -> bytes:
         return self.join_by_snapshot(body.decode("utf-8")).encode("utf-8")
 
@@ -817,6 +850,12 @@ class PeerNode:
         )
 
     def stop(self) -> None:
+        # idempotent: subprocess drivers reach stop() from BOTH the
+        # signal handler and their finally block — the second call must
+        # be a no-op, not a crash on half-torn-down components
+        if getattr(self, "_stopped", False):
+            return
+        self._stopped = True
         self.rpc.stop()
         self.deliver.stop()
         self.deliver_filtered_svc.stop()

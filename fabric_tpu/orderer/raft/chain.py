@@ -376,11 +376,49 @@ class RaftChain:
             return
         if not entry.data:
             return  # leader no-op
+        from fabric_tpu import protoutil
+
         is_config = entry.data[:1] == b"C"
         blk = common_pb2.Block.FromString(entry.data[1:])
         if blk.header.number < self._writer.height:
             return  # already written (replay after restart)
+        last = self._writer.last_block() if self._writer.height else None
+        if last is not None and blk.header.previous_hash != \
+                protoutil.block_header_hash(last.header):
+            # Stale-creator proposal overtaken by another leader's
+            # block (netharness kill -9 campaign finding): a leader
+            # elected with committed-but-unapplied entries in its log
+            # anchors its block creator on a stale tail, and raft then
+            # commits BOTH the old leader's block and the new leader's
+            # same-numbered/descendant proposals — appending the loser
+            # would fork the hash chain identically on every replica.
+            # Drop it deterministically instead (the check depends only
+            # on the applied prefix, so all replicas agree); its
+            # envelopes come back via client resubmission, the
+            # reference's broadcast contract.
+            from fabric_tpu.common.flogging import must_get_logger
+
+            must_get_logger("orderer.consensus.raft").warning(
+                "dropping non-chaining committed block %d on %s "
+                "(stale leader creator); clients must resubmit",
+                blk.header.number, self.channel_id,
+            )
+            if self.node.is_leader:
+                self._reset_creator()
+            return
         self._writer.write_block(blk, is_config=is_config)
+        if self.node.is_leader and hasattr(self, "_creator_number") and (
+            blk.header.number > self._creator_number
+            or (
+                blk.header.number == self._creator_number
+                and protoutil.block_header_hash(blk.header)
+                != self._creator_hash
+            )
+        ):
+            # we just applied a block we did not create past (or at)
+            # our predicted tail: re-anchor the creator so the next
+            # proposal chains onto the REAL tail
+            self._reset_creator()
         self._on_block(blk)
         self._applied_bytes_since_snap += len(entry.data)
         if self._applied_bytes_since_snap >= self._snap_interval:

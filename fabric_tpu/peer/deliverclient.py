@@ -52,20 +52,43 @@ class DeliverClient:
         # bounded so a long-lived client against a flaky orderer never
         # grows it without limit
         self.backoff_log: collections.deque = collections.deque(maxlen=64)
+        # endpoint indices (into the caller's `endpoints` list) this
+        # client actually attempted, in order — the failover contract's
+        # observable: a SIGKILLed orderer must show up as a rotation to
+        # a DIFFERENT index, not a wedge on the dead one
+        self.endpoint_log: collections.deque = collections.deque(maxlen=64)
+        # blocks delivered through the sink since start() — the
+        # liveness probe the failover tests poll
+        self.delivered = 0
 
     def start(self) -> None:
+        """Idempotent while running; safe to call while a PREVIOUS
+        stop() is still draining.  Leadership can flap (relinquish then
+        regain within seconds, netharness churn): the old runner may
+        still be blocked in a stream read when start() is called again,
+        and the old re-used stop flag turned that into a permanent
+        wedge — start() saw a live thread and returned, the live thread
+        saw the stop flag and exited, and nobody ever pulled again.
+        Each start() therefore gets its OWN stop event/generation; a
+        draining runner exits on its own event whenever it unblocks."""
         with self._lock:
-            if self._thread is not None and self._thread.is_alive():
-                return
-            self._stop.clear()
+            if (
+                self._thread is not None
+                and self._thread.is_alive()
+                and not self._stop.is_set()
+            ):
+                return  # current generation is live
+            self._stop = stop = threading.Event()
             self._thread = spawn_thread(
-                target=self._run, name="deliver-client", kind="service"
+                target=self._run, args=(stop,),
+                name="deliver-client", kind="service",
             )
             self._thread.start()
 
     def stop(self) -> None:
-        self._stop.set()
-        t = self._thread
+        with self._lock:
+            self._stop.set()
+            t = self._thread
         if t is not None:
             t.join(timeout=3)
 
@@ -79,20 +102,22 @@ class DeliverClient:
             return True
         return verify_block_signature(blk, policy, self._csp)
 
-    def _run(self) -> None:
+    def _run(self, stop: threading.Event) -> None:
         backoff = 0.1
-        endpoints = self._endpoints[:]
-        random.shuffle(endpoints)
+        # shuffle the ROTATION ORDER, not the endpoint objects, so the
+        # endpoint_log indices stay meaningful to the caller
+        order = list(range(len(self._endpoints)))
+        random.shuffle(order)
         idx = 0
-        while not self._stop.is_set():
-            connect = endpoints[idx % len(endpoints)]
+        while not stop.is_set():
+            pos = order[idx % len(order)]
+            connect = self._endpoints[pos]
             idx += 1
+            self.endpoint_log.append(pos)
             try:
-                faultline.point(
-                    "deliver.connect", endpoint=(idx - 1) % len(endpoints)
-                )
+                faultline.point("deliver.connect", endpoint=pos)
                 for blk in connect(self._height()):
-                    if self._stop.is_set():
+                    if stop.is_set():
                         return
                     faultline.point("deliver.read", block=blk.header.number)
                     # one span per delivered block: verify + sink hand-
@@ -107,6 +132,7 @@ class DeliverClient:
                         self._sink(
                             blk.header.number, blk.SerializeToString()
                         )
+                        self.delivered += 1
                     backoff = 0.1
             except Exception:
                 # fabriclint: allow[exception-discipline] reconnect loop: ANY
@@ -118,7 +144,7 @@ class DeliverClient:
             # through the clockskew seam: a virtual clock turns this
             # reconnect wait into a deterministic clock advance, so the
             # whole rotation/backoff cycle runs with no real sleeps
-            if clockskew.wait(self._stop, backoff):
+            if clockskew.wait(stop, backoff):
                 return
             backoff = min(backoff * 2, self._max_backoff)
 

@@ -7,7 +7,7 @@ lint scripts use, so the driver/CI can scrape `"experiment":
 "faultfuzz"` next to those lines.
 
 Usage: python scripts/chaos.py [--plans N] [--seed S] [--blocks B]
-       [--out DIR] [--no-shrink] [--no-comm] [--replay FILE]
+       [--out DIR] [--no-shrink] [--no-comm] [--replay FILE] [--kill9]
 
 Exit code: nonzero when ANY plan's oracle verdict is a failure (each
 one has been shrunk and written as a replayable repro JSON under --out,
@@ -15,6 +15,15 @@ default .faultfuzz/, which is gitignored).  `--replay FILE` re-arms a
 repro artifact over a fresh workload directory instead of running a
 campaign: exit 0 when the failure REPRODUCES (the artifact is good),
 nonzero when it does not.
+
+`--kill9` is the MULTI-PROCESS campaign mode (the faultfuzz follow-on
+PR 8 filed): each plan stands up a real multi-process topology via
+devtools/netharness, drives a tx stream through broadcast -> raft ->
+gossip -> commit, SIGKILLs nodes on a seeded kill schedule, and judges
+with the network-wide oracle.  Failing campaigns write a kill9 repro
+JSON; `--replay` detects the artifact kind and routes to the right
+replayer, so one CLI replays both in-process fault plans and kill -9
+schedules.
 
 A fixed (--seed, --plans) campaign is deterministic: two runs produce
 identical verdicts and canonical trip ledgers (the printed line carries
@@ -52,7 +61,14 @@ def main() -> int:
                     help="skip the rpc traffic phase of the workload")
     ap.add_argument("--replay", default=None, metavar="FILE",
                     help="re-arm a repro artifact instead of fuzzing; "
-                         "exit 0 iff the failure reproduces")
+                         "exit 0 iff the failure reproduces (kill9 "
+                         "artifacts are auto-detected and re-run "
+                         "through the multi-process harness)")
+    ap.add_argument("--kill9", action="store_true",
+                    help="multi-process campaign: per plan, a real "
+                         "topology with a seeded kill -9 schedule")
+    ap.add_argument("--txs", type=int, default=80,
+                    help="txs per kill9 campaign plan (default 80)")
     ap.add_argument("--trace-dir", default=None, metavar="DIR",
                     help="arm tracelens for the campaign and write each "
                          "failing plan's flight-recorder dump (Chrome "
@@ -72,6 +88,33 @@ def main() -> int:
     if args.replay:
         import shutil
         import tempfile
+
+        with open(args.replay, "r", encoding="utf-8") as f:
+            try:
+                artifact_kind = json.load(f).get("kind", "")
+            except ValueError:
+                artifact_kind = ""
+        if artifact_kind == "netharness-kill9":
+            from fabric_tpu.devtools import netharness as nh
+
+            workdir = tempfile.mkdtemp(prefix="kill9-replay-")
+            result = None
+            try:
+                result = nh.replay_repro(args.replay, workdir)
+            finally:
+                # keep the workdir (node logs) for any non-clean run
+                if result is not None and result["ok"]:
+                    shutil.rmtree(workdir, ignore_errors=True)
+            out = {
+                "experiment": "kill9-replay",
+                "artifact": args.replay,
+                "reproduced": not result["ok"],
+                "verdict": nh.verdict_doc(result),
+                "workdir": None if result["ok"] else workdir,
+                "seconds": round(time.perf_counter() - t0, 4),
+            }
+            print(json.dumps(out, sort_keys=True))
+            return 0 if not result["ok"] else 1
 
         workdir = tempfile.mkdtemp(prefix="faultfuzz-replay-")
         try:
@@ -98,6 +141,52 @@ def main() -> int:
             )
         print(json.dumps(out))
         return 0 if res["violations"] else 1
+
+    if args.kill9:
+        import shutil
+        import tempfile
+
+        from fabric_tpu.devtools import netharness as nh
+
+        failures = 0
+        verdicts = []
+        repro_paths = []
+        for i in range(args.plans):
+            seed = args.seed + i
+            topo = nh.Topology(
+                orgs=1, peers_per_org=2, orderers=1, seed=seed,
+            )
+            expected = 1 + -(-args.txs // topo.max_message_count)
+            schedule = nh.generate_kill_schedule(
+                seed, topo, expected, kills=1
+            )
+            workdir = tempfile.mkdtemp(prefix=f"kill9-s{seed}-")
+            with nh.Network(workdir, topo) as net:
+                net.start()
+                result = nh.run_stream(net, args.txs, schedule)
+            verdicts.append("ok" if result["ok"] else "FAIL")
+            if result["ok"]:
+                shutil.rmtree(workdir, ignore_errors=True)
+            else:
+                failures += 1
+                repro_paths.append(nh.write_repro(result, os.path.join(
+                    args.out, f"kill9_seed{seed}.repro.json"
+                )))
+        out = {
+            "experiment": "chaos-kill9",
+            "seed": args.seed,
+            "plans": args.plans,
+            "txs": args.txs,
+            "failures": failures,
+            "verdicts": verdicts,
+            "repro": repro_paths,
+            "seconds": round(time.perf_counter() - t0, 4),
+        }
+        print(json.dumps(out, sort_keys=True))
+        for path in repro_paths:
+            print(f"kill9: repro artifact written: {path}",
+                  file=sys.stderr)
+        return 1 if failures else 0
 
     campaign = faultfuzz.Campaign(
         seed=args.seed, plans=args.plans, blocks=args.blocks,

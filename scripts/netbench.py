@@ -1,0 +1,156 @@
+#!/usr/bin/env python
+"""netbench — the multi-process network bench + chaos campaign CLI.
+
+Stands up a real N-org × M-peer × K-orderer network as separate OS
+processes (devtools/netharness), drives a broadcast -> raft ordering ->
+gossip dissemination -> commit stream through it, SIGKILLs nodes on a
+seeded kill schedule mid-stream, and emits ONE bench-style JSON line:
+end-to-end committed tx/s, per-killed-node catch-up seconds, and the
+max cross-peer commit lag — the "millions of users" scoreboard next to
+the single-peer headline bench.
+
+Usage:
+  python scripts/netbench.py [--orgs N] [--peers M] [--orderers K]
+      [--txs T] [--seed S] [--kills N | --no-kill] [--trace]
+      [--trace-out PATH] [--workdir DIR] [--out DIR] [--repro FILE]
+
+Exit code: nonzero when the network-wide invariants oracle (per-node
+chain/height checks + cross-peer state-digest agreement + presence
+probes) fails — the failing run's kill schedule is written as a
+replayable repro JSON under --out (scripts/chaos.py --kill9 --replay
+re-runs it).  `--repro FILE` replays such an artifact directly.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from fabric_tpu.devtools import netharness as nh  # noqa: E402
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--orgs", type=int, default=1)
+    ap.add_argument("--peers", type=int, default=2,
+                    help="peers per org")
+    ap.add_argument("--orderers", type=int, default=1)
+    ap.add_argument("--txs", type=int, default=200)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--kills", type=int, default=1,
+                    help="seeded kill-schedule entries (see --no-kill)")
+    ap.add_argument("--no-kill", action="store_true",
+                    help="pure throughput run, no chaos")
+    ap.add_argument("--batch", type=int, default=10,
+                    help="orderer max_message_count")
+    ap.add_argument("--trace", action="store_true",
+                    help="arm tracelens on every node and write the "
+                         "merged network trace")
+    ap.add_argument("--trace-out", default=None, metavar="PATH",
+                    help="merged-trace path (default <out>/netbench."
+                         "trace.json when --trace)")
+    ap.add_argument("--workdir", default=None,
+                    help="node roots/logs live here (default: a "
+                         "temp dir, removed on success)")
+    ap.add_argument("--out", default=".faultfuzz", metavar="DIR",
+                    help="repro-artifact directory (default .faultfuzz)")
+    ap.add_argument("--settle", type=float, default=180.0,
+                    help="network convergence timeout seconds")
+    ap.add_argument("--repro", default=None, metavar="FILE",
+                    help="replay a kill9 repro artifact instead of "
+                         "running a fresh campaign")
+    args = ap.parse_args()
+
+    t0 = time.perf_counter()
+    workdir = args.workdir or tempfile.mkdtemp(prefix="netbench-")
+    keep_workdir = args.workdir is not None
+
+    if args.repro:
+        result = nh.replay_repro(args.repro, workdir)
+        out = {
+            "experiment": "netbench-replay",
+            "artifact": args.repro,
+            "reproduced": not result["ok"],
+            "verdict": nh.verdict_doc(result),
+            "seconds": round(time.perf_counter() - t0, 4),
+        }
+        print(json.dumps(out, sort_keys=True))
+        if result["ok"] and not keep_workdir:
+            shutil.rmtree(workdir, ignore_errors=True)
+        # replay contract mirrors faultfuzz: exit 0 iff it REPRODUCES
+        return 0 if not result["ok"] else 1
+
+    topo = nh.Topology(
+        orgs=args.orgs, peers_per_org=args.peers,
+        orderers=args.orderers, seed=args.seed,
+        max_message_count=args.batch,
+        trace=(1 << 15) if args.trace else 0,
+    )
+    expected_height = 1 + -(-args.txs // args.batch)
+    schedule = (
+        []
+        if args.no_kill
+        else nh.generate_kill_schedule(
+            args.seed, topo, expected_height, kills=args.kills
+        )
+    )
+    with nh.Network(workdir, topo) as net:
+        net.start()
+        result = nh.run_stream(
+            net, args.txs, schedule, settle_timeout_s=args.settle,
+        )
+        trace_path = None
+        if args.trace:
+            trace_path = args.trace_out or os.path.join(
+                args.out, "netbench.trace.json"
+            )
+            nh.merge_traces(net, trace_path)
+
+    repro_path = None
+    if not result["ok"]:
+        repro_path = nh.write_repro(result, os.path.join(
+            args.out, f"netbench_seed{args.seed}.repro.json"
+        ))
+
+    line = {
+        "experiment": "netbench",
+        "seed": args.seed,
+        "topology": result["topology"],
+        "txs": args.txs,
+        "ok": result["ok"],
+        "committed_tx_per_s": result["committed_tx_per_s"],
+        "final_height": result["final_height"],
+        "catch_up_s": result["catch_up_s"],
+        "max_cross_peer_lag_ms": result["max_cross_peer_lag_ms"],
+        "state_digests_agree": result["state_digests_agree"],
+        "kill_schedule": result["kill_schedule"],
+        "violations": result["violations"],
+        "errors": result["errors"],
+        "repro": repro_path,
+        "trace": trace_path,
+        "workdir": workdir if (keep_workdir or not result["ok"]) else None,
+        "seconds": round(time.perf_counter() - t0, 4),
+    }
+    print(json.dumps(line, sort_keys=True))
+    if result["ok"] and not keep_workdir:
+        shutil.rmtree(workdir, ignore_errors=True)
+    elif not result["ok"]:
+        print(f"netbench: FAILED; node logs under {workdir}",
+              file=sys.stderr)
+        if repro_path:
+            print(f"netbench: repro artifact written: {repro_path}",
+                  file=sys.stderr)
+    return 0 if result["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,79 @@
+"""Worker process for the kill -9 mid-flush recovery test
+(test_netharness.py): commit blocks through a REAL commit group in a
+loop, reporting the durable height after every flush, until the parent
+SIGKILLs us — real process death inside ``_flush_group`` (the parent
+arms a FABRIC_TPU_FAULTLINE delay at commit.stage/fsync to hold each
+flush open), not a FaultCrash simulation.
+
+argv: root_dir status_file group_size max_blocks
+
+The workload is deterministic: block n writes
+``("netcc", f"b{n}k{i}", f"v{n}:{i}")`` for i in range(3), so the
+parent can recompute writes_by_block and judge the recovered ledger
+with the full invariants oracle.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from fabric_tpu import protoutil
+from fabric_tpu.devtools import netident
+from fabric_tpu.ledger import LedgerProvider
+from fabric_tpu.protos.common import common_pb2
+
+CHANNEL = "flushch"
+
+
+def block_writes(n: int) -> list[tuple[str, str, bytes]]:
+    return [
+        ("netcc", f"b{n}k{i}", f"v{n}:{i}".encode()) for i in range(3)
+    ]
+
+
+def build_block(n: int, prev_hash: bytes) -> common_pb2.Block:
+    envs = [
+        netident.make_tx(CHANNEL, key, value, orgs=1, cc=ns)
+        for ns, key, value in block_writes(n)
+    ]
+    blk = common_pb2.Block()
+    blk.header.number = n
+    blk.header.previous_hash = prev_hash
+    blk.data.data.extend(envs)
+    blk.header.data_hash = protoutil.block_data_hash(blk.data)
+    protoutil.init_block_metadata(blk)
+    protoutil.set_tx_filter(blk, bytearray(len(envs)))
+    return blk
+
+
+def main(argv) -> int:
+    root, status_file, group_size, max_blocks = (
+        argv[0], argv[1], int(argv[2]), int(argv[3])
+    )
+    provider = LedgerProvider(root)
+    ledger = provider.create(netident.make_genesis(CHANNEL))
+    prev = ledger.block_store.last_block_hash
+    group = ledger.begin_commit_group()
+    for n in range(ledger.height, max_blocks):
+        blk = build_block(n, prev)
+        prev = protoutil.block_header_hash(blk.header)
+        ledger.commit(blk, group=group)
+        if (n % group_size) == group_size - 1:
+            ledger.commit_group_flush(group)
+            # announce the new durable height AFTER the flush — the
+            # parent kills us somewhere inside a later flush's widened
+            # fsync window
+            tmp = status_file + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                f.write(str(ledger.durable_height))
+            os.replace(tmp, status_file)
+    ledger.commit_group_flush(group)
+    provider.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -1,0 +1,464 @@
+"""Netharness: the multi-process N-org × M-peer network with kill -9
+chaos (ISSUE 11 tentpole).
+
+Tier-1 pins:
+- a real 1-org × 2-peer + 1-orderer multi-process topology survives a
+  SIGKILL of one peer mid-stream: the killed peer catches up via gossip
+  state transfer and the invariants oracle is green on every node;
+- the deliver client fails over to another orderer endpoint when the
+  orderer it streams from is SIGKILLed (real process death);
+- ``LedgerProvider.open`` recovers after kill -9 mid-``_flush_group``
+  in a CHILD process (a faultline delay holds the fsync window open so
+  the SIGKILL lands inside the flush);
+- the gossip TCP transport piggybacks the tracelens wire token, so a
+  remote peer's dispatch nests under the disseminating peer's trace;
+- ``GET /traces?since=<event-id>`` serves incremental flight-recorder
+  dumps.
+
+The slow soak scales to 3 orgs × 2 peers × 3 orderers with a seeded
+kill schedule (including an orderer follower) and pins the
+byte-determinism of the verdict JSON for a fixed seed.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from fabric_tpu.devtools import invariants, netharness as nh, netident
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FLUSH_WORKER = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "flush_worker.py"
+)
+
+
+def _wait(pred, timeout=30.0, msg="condition", interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timeout waiting for {msg}")
+
+
+# ---------------------------------------------------------------------------
+# tier-1 smoke: SIGKILL a peer mid-stream, catch up, oracle green
+# ---------------------------------------------------------------------------
+
+
+def test_smoke_kill9_peer_catches_up(tmp_path):
+    topo = nh.Topology(orgs=1, peers_per_org=2, orderers=1, seed=7)
+    schedule = [nh.KillRule(
+        node="org1-peer1", at_height=4, sig="kill9",
+        rejoin="restart", restart_after_s=0.4,
+    )]
+    with nh.Network(str(tmp_path / "net"), topo) as net:
+        net.start()
+        result = nh.run_stream(
+            net, txs=80, kill_schedule=schedule, settle_timeout_s=120,
+        )
+    assert result["errors"] == []
+    assert result["ok"], result
+    # the killed peer was actually down and came back
+    assert "org1-peer1" in result["catch_up_s"], result
+    assert result["state_digests_agree"]
+    assert result["violations"] == {}
+    assert result["missing"] == []
+    heights = set(result["heights"].values())
+    assert len(heights) == 1 and heights.pop() >= 1 + 80 // topo.max_message_count
+    # the verdict view carries NO timing fields — only seed-derived and
+    # pass/fail data, the byte-determinism contract the soak pins
+    verdict = nh.verdict_doc(result)
+    assert set(verdict) == {
+        "experiment", "seed", "topology", "kill_schedule", "txs", "ok",
+        "state_digests_agree", "violations", "missing", "caught_up",
+    }
+    assert verdict["caught_up"] == ["org1-peer1"]
+
+
+def test_kill_schedule_generation_deterministic():
+    topo = nh.Topology(orgs=3, peers_per_org=2, orderers=3, seed=11)
+    a = nh.generate_kill_schedule(11, topo, 30, kills=2)
+    b = nh.generate_kill_schedule(11, topo, 30, kills=2)
+    assert [r.as_dict() for r in a] == [r.as_dict() for r in b]
+    # a 3-orderer cluster keeps quorum through one orderer kill, so the
+    # generator includes one
+    assert any(r.node.startswith("orderer") for r in a)
+    assert all(r.node != r2.node or r is r2 for r in a for r2 in a)
+
+
+# ---------------------------------------------------------------------------
+# tier-1: deliver-client endpoint failover on orderer SIGKILL
+# ---------------------------------------------------------------------------
+
+
+def test_deliver_failover_on_orderer_kill9(tmp_path):
+    from fabric_tpu.comm import RPCClient
+    from fabric_tpu.common.deliver import make_seek_info_envelope
+    from fabric_tpu.common.hashing import sha256
+    from fabric_tpu.peer.deliverclient import DeliverClient
+    from fabric_tpu.protos.orderer import ab_pb2
+
+    topo = nh.Topology(orgs=1, peers_per_org=0, orderers=3, seed=5)
+    with nh.Network(str(tmp_path / "net"), topo) as net:
+        net.start()
+
+        def send(n0, count):
+            for i in range(n0, n0 + count):
+                env = netident.make_tx(
+                    topo.channel, f"fk{i}", b"v%d" % i, orgs=1
+                )
+                net.broadcast(env, prefer=i)
+
+        send(0, 20)
+        _wait(
+            lambda: all(
+                net.status(n)["height"] >= 4
+                for n in topo.orderer_names() if net.nodes[n].alive()
+            ),
+            msg="orderers commit the first batches",
+        )
+
+        ident = b"cre:failover-client"
+
+        class _Signer:
+            def serialize(self):
+                return ident
+
+            def sign(self, msg):
+                return netident.sign_as(ident, sha256(msg))
+
+        def connect_fn(endpoint):
+            def connect(start_num: int):
+                client = RPCClient(endpoint[0], endpoint[1], timeout=5.0)
+                env = make_seek_info_envelope(
+                    topo.channel, start_num, 0x7FFFFFFFFFFFFFFF,
+                    signer=_Signer(),
+                )
+                for raw in client.stream(
+                    "ab.Deliver", env.SerializeToString()
+                ):
+                    resp = ab_pb2.DeliverResponse.FromString(raw)
+                    if resp.WhichOneof("Type") == "block":
+                        yield resp.block
+                    else:
+                        return
+
+            return connect
+
+        got: dict[int, bytes] = {}
+        endpoints = [
+            tuple(net.nodes[n].rpc_addr) for n in topo.orderer_names()
+        ]
+        dc = DeliverClient(
+            topo.channel,
+            [connect_fn(ep) for ep in endpoints],
+            height_fn=lambda: (max(got) + 1) if got else 0,
+            sink=lambda seq, raw: got.__setitem__(seq, raw),
+            max_backoff_s=1.0,
+        )
+        dc.start()
+        try:
+            _wait(lambda: len(got) >= 4, msg="initial deliver stream")
+            # SIGKILL the orderer this client is actually streaming from
+            # — real process death, not a stream error
+            victim_idx = dc.endpoint_log[-1]
+            victim = topo.orderer_names()[victim_idx]
+            net.kill(victim, signal.SIGKILL)
+            before = max(got)
+            send(20, 20)  # net.broadcast rotates off the dead orderer
+            _wait(
+                lambda: max(got) >= before + 3, timeout=30,
+                msg="blocks delivered after orderer SIGKILL",
+            )
+            # the client rotated to a DIFFERENT endpoint after the kill
+            post_kill = [
+                idx for idx in list(dc.endpoint_log)
+            ]
+            assert any(
+                idx != victim_idx
+                for idx in post_kill[post_kill.index(victim_idx):]
+            ), post_kill
+        finally:
+            dc.stop()
+
+
+def test_deliver_client_restart_while_draining():
+    """Leadership flap regression (netharness finding): stop() while
+    the runner is blocked inside a stream, then start() again — the old
+    re-used stop flag left the client permanently wedged (start saw a
+    live thread and returned; the live thread saw the stop flag and
+    exited).  Generations fix it: the new start() must pull blocks even
+    though the old runner is still draining."""
+    import threading
+
+    from fabric_tpu.peer.deliverclient import DeliverClient
+    from fabric_tpu.protos.common import common_pb2
+
+    release = threading.Event()
+    delivered = []
+
+    def blocking_connect(start_num):
+        blk = common_pb2.Block()
+        blk.header.number = start_num
+        yield blk
+        release.wait(20)  # the runner is stuck mid-stream here
+
+    dc = DeliverClient(
+        "ch", [blocking_connect],
+        height_fn=lambda: len(delivered),
+        sink=lambda seq, raw: delivered.append(seq),
+    )
+    dc.start()
+    _wait(lambda: len(delivered) >= 1, msg="first delivery")
+    dc.stop()  # join times out: the runner is blocked in release.wait
+    old_thread = dc._thread
+    assert old_thread.is_alive()
+    dc.start()  # must arm a NEW generation, not no-op against the old
+    try:
+        _wait(
+            lambda: len(delivered) >= 2, timeout=10,
+            msg="new generation delivers despite the draining old one",
+        )
+    finally:
+        release.set()
+        dc.stop()
+        old_thread.join(timeout=5)
+
+
+# ---------------------------------------------------------------------------
+# tier-1: kill -9 mid-_flush_group in a child process, real recovery
+# ---------------------------------------------------------------------------
+
+
+def test_child_kill9_mid_flush_group_recovers(tmp_path):
+    import flush_worker as fw
+
+    from fabric_tpu.ledger import LedgerProvider
+
+    root = str(tmp_path / "ledger-root")
+    status = str(tmp_path / "status")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    # hold each group flush open: a 0.15s delay at every fsync makes
+    # "mid-_flush_group" the overwhelmingly likely place for the
+    # SIGKILL below to land
+    env["FABRIC_TPU_FAULTLINE"] = json.dumps({
+        "seed": 1,
+        "faults": [{
+            "point": "commit.stage", "ctx": {"stage": "fsync"},
+            "action": "delay", "delay_s": 0.15, "every": 1,
+            "count": 1000000,
+        }],
+    })
+    proc = subprocess.Popen(
+        [sys.executable, FLUSH_WORKER, root, status, "3", "200"],
+        env=env,
+        stdout=open(str(tmp_path / "worker.log"), "ab"),
+        stderr=subprocess.STDOUT,
+    )
+    try:
+        _wait(
+            lambda: os.path.exists(status)
+            and int(open(status).read() or 0) >= 4,
+            timeout=60, msg="child reaches durable height 4",
+        )
+        # land inside the NEXT flush's widened fsync window
+        time.sleep(0.08)
+        proc.kill()
+        proc.wait(timeout=10)
+        assert proc.returncode == -signal.SIGKILL
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+
+    # REAL recovery: reopen the kill -9'd stores in this process
+    provider = LedgerProvider(root)
+    ledger = provider.open(fw.CHANNEL)
+    height = ledger.height
+    assert height >= 4
+    writes_by_block = [[]] + [
+        fw.block_writes(n) for n in range(1, height + 8)
+    ]
+    violations = invariants.check_ledger(
+        ledger, writes_by_block=writes_by_block
+    )
+    assert violations == [], [str(v) for v in violations]
+    # continuation: the recovered ledger accepts the next block
+    blk = fw.build_block(height, ledger.block_store.last_block_hash)
+    ledger.commit(blk)
+    assert ledger.height == height + 1
+    assert invariants.check_chain(ledger) == []
+    provider.close()
+
+
+# ---------------------------------------------------------------------------
+# tier-1: gossip TCP wire token (trace-merge satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_gossip_tcp_trace_token():
+    from fabric_tpu.common import tracing
+    from fabric_tpu.gossip.comm import (
+        TCPGossipComm,
+        _frame_with_token,
+        _split_frame_token,
+    )
+    from fabric_tpu.protos.gossip import message_pb2 as gpb
+
+    # helper contract: untraced frames are byte-identical, tokens strip
+    raw = b"\x0a\x05hello"
+    assert _frame_with_token(raw, None) is raw
+    ctx = tracing.SpanContext(0xABC, 0x1)
+    framed = _frame_with_token(raw, ctx)
+    payload, parsed = _split_frame_token(framed)
+    assert payload == raw and parsed == ctx
+    assert _split_frame_token(raw) == (raw, None)
+
+    a = TCPGossipComm(("127.0.0.1", 0), b"nodeA")
+    b = TCPGossipComm(("127.0.0.1", 0), b"nodeB")
+    try:
+        with tracing.scope() as rec:
+            with tracing.span("disseminate") as root:
+                root_trace = root.trace_id
+                msg = gpb.GossipMessage(channel=b"tch")
+                msg.data_msg.seq_num = 1
+                msg.data_msg.block = b"blockbytes"
+                a.send(b.endpoint, msg)
+            _wait(
+                lambda: any(
+                    ev.get("name") == "gossip.deliver"
+                    and ev["args"].get("trace") == f"{root_trace:x}"
+                    for ev in rec.snapshot()
+                ),
+                timeout=10,
+                msg="remote dispatch joins the sender's trace",
+            )
+            deliver = next(
+                ev for ev in rec.snapshot()
+                if ev.get("name") == "gossip.deliver"
+                and ev["args"].get("trace") == f"{root_trace:x}"
+            )
+            # nested under the sender's span, not a fresh root
+            assert "parent" in deliver["args"]
+    finally:
+        a.close()
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# tier-1: GET /traces?since= incremental cursor
+# ---------------------------------------------------------------------------
+
+
+def test_traces_since_cursor():
+    import http.client
+
+    from fabric_tpu.common import tracing
+    from fabric_tpu.common.operations import System
+
+    sysm = System(("127.0.0.1", 0))
+    sysm.start()
+    try:
+        with tracing.scope():
+            with tracing.span("first"):
+                pass
+
+            def get(path):
+                conn = http.client.HTTPConnection(*sysm.addr, timeout=5)
+                conn.request("GET", path)
+                resp = conn.getresponse()
+                body = resp.read()
+                conn.close()
+                return resp.status, json.loads(body)
+
+            status, doc = get("/traces")
+            assert status == 200
+            assert [e["name"] for e in doc["traceEvents"]] == ["first"]
+            cursor = doc["otherData"]["last_event_id"]
+            assert cursor == 1
+
+            with tracing.span("second"):
+                pass
+            status, doc2 = get(f"/traces?since={cursor}")
+            assert status == 200
+            assert [e["name"] for e in doc2["traceEvents"]] == ["second"]
+            assert doc2["otherData"]["last_event_id"] == 2
+            # a fresh cursor poll with nothing new is empty
+            status, doc3 = get("/traces?since=2")
+            assert doc3["traceEvents"] == []
+            # a cursor from BEFORE a recorder reset is stale: the
+            # endpoint detects it (ahead of the fresh cursor) and
+            # answers with the full buffer so the poller resyncs
+            tracing.reset()
+            with tracing.span("post-reset"):
+                pass
+            status, doc4 = get("/traces?since=2")
+            assert [e["name"] for e in doc4["traceEvents"]] == [
+                "post-reset"
+            ]
+            assert doc4["otherData"]["last_event_id"] == 1
+            # malformed cursor: a clean 400, not a server error
+            status, err = get("/traces?since=banana")
+            assert status == 400 and "error" in err
+    finally:
+        sysm.stop()
+
+
+# ---------------------------------------------------------------------------
+# slow soak: 3 orgs × 2 peers × 3 orderers, seeded schedule, verdict
+# byte-determinism
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_soak_multiorg_seeded_schedule(tmp_path):
+    topo = nh.Topology(
+        orgs=3, peers_per_org=2, orderers=3, seed=11,
+        max_message_count=8,
+    )
+    txs = 240
+    expected_height = 1 + -(-txs // topo.max_message_count)
+    schedule = nh.generate_kill_schedule(
+        11, topo, expected_height, kills=2
+    )
+    assert any(r.node.startswith("orderer") for r in schedule)
+    with nh.Network(str(tmp_path / "net"), topo) as net:
+        net.start(timeout=120)
+        result = nh.run_stream(
+            net, txs=txs, kill_schedule=schedule, settle_timeout_s=240,
+        )
+    assert result["errors"] == []
+    assert result["ok"], result
+    assert result["state_digests_agree"]
+    assert len(set(
+        h for n, h in result["heights"].items()
+    )) == 1
+
+    # byte-determinism of the verdict JSON for this fixed seed: the
+    # verdict must be reconstructable from (seed, topology, schedule,
+    # pass) alone — no timings, no throughput, no run-specific state
+    verdict_bytes = json.dumps(
+        nh.verdict_doc(result), sort_keys=True
+    ).encode()
+    expected = {
+        "experiment": "netharness",
+        "seed": 11,
+        "topology": topo.as_dict(),
+        "kill_schedule": [r.as_dict() for r in schedule],
+        "txs": txs,
+        "ok": True,
+        "state_digests_agree": True,
+        "violations": {},
+        "missing": [],
+        "caught_up": sorted({r.node for r in schedule}),
+    }
+    assert verdict_bytes == json.dumps(expected, sort_keys=True).encode()
