@@ -128,9 +128,15 @@ class Histogram(_Metric):
             )
             rec[0] += 1
             rec[1] += value
+            # per-bucket counts are NON-cumulative here; expose()
+            # cumulates once.  (The old form incremented every bucket
+            # >= value AND re-cumulated at exposition, so a rendered
+            # _bucket count could exceed _count — non-monotonic output
+            # that a strict scraper rejects.)
             for i, b in enumerate(self.opts.buckets):
                 if value <= b:
                     rec[2][i] += 1
+                    break
 
 
 class PrometheusRegistry:
@@ -146,10 +152,25 @@ class PrometheusRegistry:
             self._metrics.append(m)
 
     @staticmethod
-    def _fmt_labels(labels) -> str:
+    def _escape_label_value(v) -> str:
+        """Prometheus text-format label-value escaping: backslash,
+        double quote, and newline (exposition format spec) — a label
+        value carrying any of them must not corrupt the line framing
+        the netscope parser (and any real scraper) relies on."""
+        return (
+            str(v)
+            .replace("\\", "\\\\")
+            .replace('"', '\\"')
+            .replace("\n", "\\n")
+        )
+
+    @classmethod
+    def _fmt_labels(cls, labels) -> str:
         if not labels:
             return ""
-        inner = ",".join(f'{k}="{v}"' for k, v in labels)
+        inner = ",".join(
+            f'{k}="{cls._escape_label_value(v)}"' for k, v in labels
+        )
         return "{" + inner + "}"
 
     def expose(self) -> str:
@@ -511,6 +532,162 @@ class RaftMetrics:
                  "destination node.",
             statsd_format="%{dest}",
         ))
+        # netscope gap closure: the consensus-state signals the
+        # telemetry plane reads per scrape round
+        self.term = provider.new_gauge(GaugeOpts(
+            namespace="raft",
+            name="term",
+            help="This node's current raft term.",
+        ))
+        self.leader_changes = provider.new_counter(CounterOpts(
+            namespace="raft",
+            name="leader_changes_total",
+            help="Observed leadership transitions (any leader -> a "
+                 "different nonzero leader).",
+        ))
+        self.committed_index = provider.new_gauge(GaugeOpts(
+            namespace="raft",
+            name="last_committed_index",
+            help="Last raft log index known committed on this node.",
+        ))
+        self.queue_depth = provider.new_gauge(GaugeOpts(
+            namespace="raft",
+            name="outbound_queue_depth",
+            help="Depth of the per-peer outbound send queue at the "
+                 "last enqueue, labeled by destination node.",
+            statsd_format="%{dest}",
+        ))
+        self.wal_append = provider.new_histogram(HistogramOpts(
+            namespace="raft",
+            subsystem="wal",
+            name="append_seconds",
+            help="Seconds writing one WAL record batch (pre-fsync).",
+            buckets=(
+                0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+                0.025, 0.05, 0.1, 0.25,
+            ),
+        ))
+        self.wal_fsync = provider.new_histogram(HistogramOpts(
+            namespace="raft",
+            subsystem="wal",
+            name="fsync_seconds",
+            help="Seconds in one WAL fsync.",
+            buckets=(
+                0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+                0.025, 0.05, 0.1, 0.25,
+            ),
+        ))
+
+
+class GossipMetrics:
+    """Gossip-plane instrumentation (a netscope gap closure: the gossip
+    stack had NO metrics): message flow in/out, the state-transfer
+    request/served-block counters that make catch-up visible, and the
+    membership gauge the health rollup reads."""
+
+    def __init__(self, provider):
+        self.messages_received = provider.new_counter(CounterOpts(
+            namespace="gossip",
+            name="messages_received_total",
+            help="Verified inbound gossip messages dispatched to "
+                 "subscribers, labeled by content kind.",
+            statsd_format="%{content}",
+        ))
+        self.messages_sent = provider.new_counter(CounterOpts(
+            namespace="gossip",
+            name="messages_sent_total",
+            help="Outbound gossip messages signed and handed to a "
+                 "transport.",
+        ))
+        self.state_requests_sent = provider.new_counter(CounterOpts(
+            namespace="gossip",
+            name="state_requests_sent_total",
+            help="Anti-entropy state-transfer requests sent while "
+                 "behind a peer's advertised height.",
+        ))
+        self.state_requests_served = provider.new_counter(CounterOpts(
+            namespace="gossip",
+            name="state_requests_served_total",
+            help="Inbound state-transfer requests answered with at "
+                 "least one block.",
+        ))
+        self.state_blocks_served = provider.new_counter(CounterOpts(
+            namespace="gossip",
+            name="state_blocks_served_total",
+            help="Blocks shipped in state-transfer responses.",
+        ))
+        self.membership = provider.new_gauge(GaugeOpts(
+            namespace="gossip",
+            name="membership_size",
+            help="Alive peers known to discovery at the last tick "
+                 "(excluding self).",
+        ))
+
+
+class DeliverMetrics:
+    """Deliver-client instrumentation (netscope gap closure): blocks
+    pulled from the ordering service, reconnect episodes, and the
+    cumulative backoff the client has waited out — a climbing
+    reconnect counter with a flat block counter is the silent-wedge
+    signature the stall detector confirms from the outside."""
+
+    def __init__(self, provider):
+        self.blocks = provider.new_counter(CounterOpts(
+            namespace="deliver",
+            name="blocks_total",
+            help="Blocks verified and handed to the sink.",
+            statsd_format="%{channel}",
+        ))
+        self.reconnects = provider.new_counter(CounterOpts(
+            namespace="deliver",
+            name="reconnects_total",
+            help="Reconnect/rotation episodes (a stream ended or "
+                 "failed and the client moved to the next endpoint).",
+            statsd_format="%{channel}",
+        ))
+        self.backoff_seconds = provider.new_counter(CounterOpts(
+            namespace="deliver",
+            name="backoff_seconds_total",
+            help="Cumulative seconds the client has spent in "
+                 "reconnect backoff.",
+            statsd_format="%{channel}",
+        ))
+
+
+class LedgerMetrics:
+    """Per-channel ledger progress (netscope gap closure): the height
+    and durability-watermark gauges the telemetry plane derives
+    cross-peer commit lag from, plus committed block/tx counters for
+    sustained-throughput SLO rollups."""
+
+    def __init__(self, provider):
+        self.height = provider.new_gauge(GaugeOpts(
+            namespace="ledger",
+            name="height",
+            help="Committed block height (next block number), per "
+                 "channel.",
+            statsd_format="%{channel}",
+        ))
+        self.durable_height = provider.new_gauge(GaugeOpts(
+            namespace="ledger",
+            name="durable_height",
+            help="Durability watermark: every block at or below it has "
+                 "its block file fsynced and its KV txn committed.",
+            statsd_format="%{channel}",
+        ))
+        self.blocks_committed = provider.new_counter(CounterOpts(
+            namespace="ledger",
+            name="blocks_committed_total",
+            help="Blocks committed since process start, per channel.",
+            statsd_format="%{channel}",
+        ))
+        self.transactions = provider.new_counter(CounterOpts(
+            namespace="ledger",
+            name="transactions_total",
+            help="VALID transactions committed since process start, "
+                 "per channel.",
+            statsd_format="%{channel}",
+        ))
 
 
 __all__ = [
@@ -529,4 +706,7 @@ __all__ = [
     "CSPMetrics",
     "RaftMetrics",
     "WorkpoolMetrics",
+    "GossipMetrics",
+    "DeliverMetrics",
+    "LedgerMetrics",
 ]

@@ -39,12 +39,16 @@ class System:
     ):
         self.version = version
         self._checkers: dict[str, object] = {}
+        self._last_errors: dict[str, str] = {}
         self._snapshot_metrics = None
         self._commit_metrics = None
         self._validate_metrics = None
         self._csp_metrics = None
         self._raft_metrics = None
         self._workpool_metrics = None
+        self._gossip_metrics = None
+        self._deliver_metrics = None
+        self._ledger_metrics = None
         self._lock = threading.Lock()
         if provider == "prometheus":
             self.metrics_provider = PrometheusProvider()
@@ -80,8 +84,14 @@ class System:
                         system._registry.expose().encode(),
                         "text/plain; version=0.0.4",
                     )
-                elif self.path == "/healthz":
-                    status, body = system.health()
+                elif self.path == "/healthz" or self.path.startswith(
+                    "/healthz?"
+                ):
+                    from urllib.parse import parse_qs, urlsplit
+
+                    qs = parse_qs(urlsplit(self.path).query)
+                    detail = qs.get("detail", ["0"])[0] not in ("", "0")
+                    status, body = system.health(detail=detail)
                     self._reply(200 if status else 503, json.dumps(body).encode())
                 elif self.path == "/version":
                     self._reply(
@@ -231,6 +241,43 @@ class System:
                 )
             return self._workpool_metrics
 
+    def gossip_metrics(self):
+        """Lazily-built gossip-plane metrics (message flow, state
+        transfer, membership) — hand the bundle to
+        ``GossipService.set_metrics`` so the netscope scraper sees the
+        dissemination layer."""
+        with self._lock:
+            if self._gossip_metrics is None:
+                from fabric_tpu.common.metrics import GossipMetrics
+
+                self._gossip_metrics = GossipMetrics(self.metrics_provider)
+            return self._gossip_metrics
+
+    def deliver_metrics(self):
+        """Lazily-built deliver-client metrics (blocks pulled,
+        reconnect episodes, cumulative backoff) for
+        ``DeliverClient(metrics=...)``."""
+        with self._lock:
+            if self._deliver_metrics is None:
+                from fabric_tpu.common.metrics import DeliverMetrics
+
+                self._deliver_metrics = DeliverMetrics(
+                    self.metrics_provider
+                )
+            return self._deliver_metrics
+
+    def ledger_metrics(self):
+        """Lazily-built per-channel ledger progress metrics (height /
+        durable_height gauges + block/tx counters) for
+        ``LedgerProvider(ledger_metrics=...)`` — the series netscope
+        derives cross-peer commit lag from."""
+        with self._lock:
+            if self._ledger_metrics is None:
+                from fabric_tpu.common.metrics import LedgerMetrics
+
+                self._ledger_metrics = LedgerMetrics(self.metrics_provider)
+            return self._ledger_metrics
+
     # -- health ------------------------------------------------------------
 
     def register_checker(self, component: str, checker) -> None:
@@ -239,19 +286,49 @@ class System:
         with self._lock:
             self._checkers[component] = checker
 
-    def health(self) -> tuple[bool, dict]:
+    def health(self, detail: bool = False) -> tuple[bool, dict]:
+        """Run every registered checker.  Plain mode keeps the
+        reference healthz body (``status`` + ``failed_checks``);
+        ``detail`` (``GET /healthz?detail=1``) adds one entry per
+        checker with its name, pass/fail status, and the failure
+        reason — the netscope health timeline's per-checker input.
+        ``last_error`` persists across calls: a checker that failed
+        once and recovered still shows what went wrong last."""
         failed = []
+        checks = []
         with self._lock:
             checkers = dict(self._checkers)
-        for name, check in checkers.items():
+        for name, check in sorted(checkers.items()):
+            error = None
             try:
                 if check() is False:
-                    failed.append(name)
+                    error = "check returned False"
             except Exception as exc:
-                failed.append(f"{name}: {exc}")
-        if failed:
-            return False, {"status": "Service Unavailable", "failed_checks": failed}
-        return True, {"status": "OK"}
+                error = str(exc) or type(exc).__name__
+            if error is not None:
+                failed.append(
+                    name if error == "check returned False"
+                    else f"{name}: {error}"
+                )
+                with self._lock:
+                    self._last_errors[name] = error
+                last = error
+            else:
+                with self._lock:
+                    last = self._last_errors.get(name)
+            checks.append({
+                "component": name,
+                "status": "OK" if error is None else "failed",
+                "last_error": last,
+            })
+        ok = not failed
+        body: dict = (
+            {"status": "OK"} if ok
+            else {"status": "Service Unavailable", "failed_checks": failed}
+        )
+        if detail:
+            body["checks"] = checks
+        return ok, body
 
 
 __all__ = ["System", "VERSION"]

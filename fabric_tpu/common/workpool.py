@@ -147,6 +147,41 @@ def default_pool():
         return _pool
 
 
+def saturation() -> tuple[int, int, int]:
+    """Instantaneous pool pressure: ``(in_flight chunks, worker cap,
+    executor queue depth)``.  All zeros while the shared pool has never
+    been created — probing must not spin it up."""
+    with _pool_lock:
+        pool = _pool
+    if pool is None:
+        return 0, 0, 0
+    workers = getattr(pool, "_max_workers", 0) or 0
+    q = getattr(pool, "_work_queue", None)
+    depth = q.qsize() if q is not None else 0
+    with _stats_lock:
+        inflight = _stats["in_flight"]
+    return inflight, workers, depth
+
+
+def health_checker():
+    """A /healthz checker (``operations.System.register_checker``) that
+    fails while fan-outs are queuing behind each other: more chunks in
+    flight than the pool has workers AND tasks actually waiting in the
+    executor queue.  Transient full utilization (in_flight == workers,
+    empty queue) stays healthy — that is the pool doing its job."""
+
+    def check() -> bool:
+        inflight, workers, depth = saturation()
+        if workers and inflight > workers and depth > 0:
+            raise RuntimeError(
+                f"workpool saturated: {inflight} chunks in flight over "
+                f"{workers} workers, {depth} queued"
+            )
+        return True
+
+    return check
+
+
 def shutdown(wait: bool = True) -> None:
     """Shut the shared executor down (idempotent).  Every entry point
     that may have spun it up calls this on the way out — under
@@ -248,4 +283,6 @@ __all__ = [
     "set_metrics",
     "stats",
     "reset_stats",
+    "saturation",
+    "health_checker",
 ]

@@ -659,6 +659,28 @@ class TPUCSP(CSP):
         """The degraded-mode circuit breaker (tests/diagnostics)."""
         return self._breaker
 
+    @property
+    def breaker_open(self) -> bool:
+        """True while verify/hash are served by the host oracle."""
+        return self._breaker.open
+
+    def health_checker(self):
+        """A /healthz checker: the node still SERVES while degraded
+        (the host oracle answers), but an open breaker is exactly what
+        an operator's health rollup should surface — netscope's health
+        timeline reads the failure reason from ?detail=1."""
+
+        def check() -> bool:
+            if self._breaker.open:
+                raise RuntimeError(
+                    "TPU degraded: circuit breaker open after "
+                    f"{self._breaker.trips} trip(s); verify/hash "
+                    "served by the host oracle"
+                )
+            return True
+
+        return check
+
     def drain(self, timeout: float | None = 60.0) -> bool:
         """Quiesce the provider: flush anything still buffered (so no
         collector can dangle) and JOIN every in-flight flush waiter.

@@ -69,6 +69,20 @@ DECLARED_GUARDS: dict[str, str] = {
         "gossip.discovery.members",
     "fabric_tpu.gossip.discovery.DiscoveryCore._seq":
         "gossip.discovery.members",
+    # -- netscope telemetry collector (PR 12) -------------------------------
+    # the scraper thread ingests rounds while the harness thread reads
+    # series/marks events/writes artifacts; every shared structure
+    # moves under one state lock
+    "fabric_tpu.devtools.netscope.Netscope._series": "netscope.state",
+    "fabric_tpu.devtools.netscope.Netscope._health": "netscope.state",
+    "fabric_tpu.devtools.netscope.Netscope._events": "netscope.state",
+    "fabric_tpu.devtools.netscope.Netscope._trace_events":
+        "netscope.state",
+    "fabric_tpu.devtools.netscope.Netscope._trace_cursor":
+        "netscope.state",
+    "fabric_tpu.devtools.netscope.Netscope._stalls": "netscope.state",
+    "fabric_tpu.devtools.netscope.Netscope._height_window":
+        "netscope.state",
 }
 
 __all__ = ["DECLARED_GUARDS"]

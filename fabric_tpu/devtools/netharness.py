@@ -102,7 +102,9 @@ class Topology:
     max_message_count: int = 5
     gossip_tick_s: float = 0.1
     trace: int = 0                  # tracelens capacity; 0 = disarmed
-    ops: bool = False               # per-peer operations endpoint
+    ops: bool = False               # per-NODE operations endpoint
+    #                                 (peers AND orderers — netscope
+    #                                 scrapes the whole topology)
     faultline: dict | None = None   # node name -> faultline plan dict
 
     def peer_names(self) -> list[str]:
@@ -249,6 +251,8 @@ class Network:
                 "trace_id_base": (idx + 1) * TRACE_ID_STRIDE,
                 "env": {},
             }
+            if topo.ops:
+                cfg["ops_port"] = free_port()
             if role == "orderer":
                 cfg["rpc_port"] = orderer_rpc[name]
                 cfg["node_id"] = topo.orderer_names().index(name) + 1
@@ -262,8 +266,6 @@ class Network:
                 ]
                 cfg["gossip_tick_s"] = topo.gossip_tick_s
                 cfg["orderer_endpoints"] = orderer_eps
-                if topo.ops:
-                    cfg["ops_port"] = free_port()
             plan = (topo.faultline or {}).get(name)
             if plan is not None:
                 plan_path = os.path.join(
@@ -403,6 +405,16 @@ class Network:
             self._client(name).call("net.Status").decode("utf-8")
         )
 
+    def ops_addrs(self) -> dict[str, tuple[str, int]]:
+        """Every node's operations-endpoint address (name -> (host,
+        port)) — the netscope scrape-target map.  Empty unless the
+        topology was built with ``ops=True``."""
+        return {
+            name: ("127.0.0.1", node.cfg["ops_port"])
+            for name, node in sorted(self.nodes.items())
+            if node.cfg.get("ops_port") is not None
+        }
+
     def check(self, name: str, expect: list | None = None) -> dict:
         body = json.dumps({"expect": expect or []}).encode()
         return json.loads(
@@ -484,12 +496,18 @@ def run_stream(
     tx_value_bytes: int = 64,
     settle_timeout_s: float = 120.0,
     sample_keys: int = 32,
+    scope=None,
 ) -> dict:
     """Drive ``txs`` endorser envelopes through broadcast -> raft
     ordering -> gossip dissemination -> commit on every peer, executing
     the kill schedule mid-stream, then wait for network-wide
     convergence and judge it.  Returns the measurement + verdict dict
-    (see ``scripts/netbench.py`` for the JSON line shape)."""
+    (see ``scripts/netbench.py`` for the JSON line shape).
+
+    ``scope`` (a running ``devtools.netscope.Netscope``) receives
+    kill/restart markers from the schedule executor, and its stall
+    detector's currently-flagged nodes land in the result/verdict as
+    ``stalled_nodes``."""
     from fabric_tpu.devtools import netident
 
     topo = net.topo
@@ -573,6 +591,8 @@ def run_stream(
             net.restart(rule.node, join_snapshot=join_dir)
             with lock:
                 down[rule.node]["t_restart"] = time.monotonic()
+            if scope is not None:
+                scope.mark("restart", rule.node, rejoin=rule.rejoin)
         except Exception as exc:
             errors.append(f"restart {rule.node}: {exc!r}")
 
@@ -594,6 +614,8 @@ def run_stream(
             rule.node,
             signal.SIGKILL if rule.sig == "kill9" else signal.SIGTERM,
         )
+        if scope is not None:
+            scope.mark("kill", rule.node, sig=rule.sig)
         with lock:
             down[rule.node] = {
                 "rule": rule, "t_kill": time.monotonic(),
@@ -771,6 +793,7 @@ def run_stream(
     heights_final = {
         n: checks[n].get("height") for n in peers
     }
+    stalled_nodes = scope.stalled_nodes() if scope is not None else []
     converged = (
         final_height is not None
         and len(set(heights_final.values())) == 1
@@ -782,6 +805,7 @@ def run_stream(
         and not presence_missing
         and all(not v for v in violations.values())
         and sent[0] == txs
+        and not stalled_nodes
     )
 
     elapsed = max(t_end - t0, 1e-6)
@@ -799,6 +823,7 @@ def run_stream(
         "catch_up_s": dict(sorted(catch_up.items())),
         "max_cross_peer_lag_ms": lag_ms,
         "state_digests_agree": len(digests) == 1,
+        "stalled_nodes": stalled_nodes,
         "violations": {n: v for n, v in sorted(violations.items()) if v},
         "missing": presence_missing,
         "errors": errors,
@@ -819,6 +844,7 @@ def verdict_doc(result: dict) -> dict:
         "txs": result["txs"],
         "ok": bool(result["ok"]),
         "state_digests_agree": bool(result["state_digests_agree"]),
+        "stalled_nodes": sorted(result.get("stalled_nodes") or []),
         "violations": result["violations"],
         "missing": result["missing"],
         "caught_up": sorted(result["catch_up_s"]),
@@ -846,8 +872,34 @@ def write_repro(result: dict, path: str) -> str:
     return path
 
 
-def replay_repro(path: str, workdir: str) -> dict:
-    """Re-run a kill9 repro artifact over a fresh workload directory."""
+def attach_netscope(net: "Network", seed: int | None = None,
+                    interval_s: float = 0.25):
+    """A running netscope collector over every ops endpoint of a
+    started network (requires ``Topology(ops=True)``); caller stops it
+    and writes artifacts via ``netscope.write_artifacts``."""
+    from fabric_tpu.devtools.netscope import Netscope
+
+    targets = net.ops_addrs()
+    if not targets:
+        raise NetError(
+            "netscope needs operations endpoints: build the Topology "
+            "with ops=True"
+        )
+    scope = Netscope(
+        targets,
+        interval_s=interval_s,
+        seed=net.topo.seed if seed is None else seed,
+    )
+    scope.start()
+    return scope
+
+
+def replay_repro(path: str, workdir: str,
+                 metrics_out: str | None = None) -> dict:
+    """Re-run a kill9 repro artifact over a fresh workload directory.
+    With ``metrics_out``, the replay runs under a netscope collector
+    and ships the same jsonl/html telemetry artifacts a live campaign
+    writes — the flag's contract survives replay."""
     with open(path, "r", encoding="utf-8") as f:
         doc = json.load(f)
     t = doc["topology"]
@@ -856,11 +908,24 @@ def replay_repro(path: str, workdir: str) -> dict:
         orderers=t["orderers"], channel=t["channel"],
         seed=doc["seed"], batch_timeout_s=t["batch_timeout_s"],
         max_message_count=t["max_message_count"],
+        ops=metrics_out is not None,
     )
     schedule = [KillRule.from_dict(r) for r in doc["kill_schedule"]]
     with Network(workdir, topo) as net:
         net.start()
-        return run_stream(net, doc["txs"], schedule)
+        scope = (
+            attach_netscope(net) if metrics_out is not None else None
+        )
+        result = run_stream(net, doc["txs"], schedule, scope=scope)
+        if scope is not None:
+            from fabric_tpu.devtools.netscope import write_artifacts
+
+            scope.stop()
+            result["netscope"] = write_artifacts(
+                scope, metrics_out,
+                prefix=f"netscope_replay_seed{topo.seed}",
+            )
+        return result
 
 
 def merge_traces(net: Network, out_path: str | None = None) -> dict:
@@ -902,4 +967,5 @@ __all__ = [
     "Topology", "KillRule", "Network", "NetError",
     "generate_kill_schedule", "run_stream", "verdict_doc",
     "write_repro", "replay_repro", "merge_traces", "free_port",
+    "attach_netscope",
 ]

@@ -122,6 +122,15 @@ class NetOrderer:
         self.channel = cfg["channel"]
         root = cfg["root"]
         os.makedirs(root, exist_ok=True)
+        # operations endpoint FIRST: the raft chain + WAL take their
+        # metrics bundle at construction
+        self.operations = None
+        raft_metrics = None
+        if cfg.get("ops_port") is not None:
+            from fabric_tpu.common.operations import System
+
+            self.operations = System(("127.0.0.1", int(cfg["ops_port"])))
+            raft_metrics = self.operations.raft_metrics()
         self.kv = open_kvstore(os.path.join(root, "index.sqlite"))
         self.store = BlockStore(
             os.path.join(root, "chains"), self.kv, name=self.channel
@@ -132,7 +141,8 @@ class NetOrderer:
         self.writer = BlockWriter(self.store)
         node_id = int(cfg["node_id"])
         self.transport = TCPTransport(
-            node_id, ("127.0.0.1", int(cfg["raft_port"]))
+            node_id, ("127.0.0.1", int(cfg["raft_port"])),
+            metrics=raft_metrics,
         )
         consenters = []
         for cid, addr in sorted(
@@ -155,8 +165,21 @@ class NetOrderer:
             wal_dir=os.path.join(root, "wal"),
             batch_timeout_s=float(cfg.get("batch_timeout_s", 0.2)),
             tick_interval_s=float(cfg.get("tick_interval_s", 0.02)),
-            on_block=lambda blk: notifier.notify(),
+            on_block=lambda blk: (notifier.notify(),
+                                  self._publish_height()),
+            metrics=raft_metrics,
         )
+        if self.operations is not None:
+            # the orderer's height rides the same per-channel gauge
+            # name the peers use, so netscope's lag/stall view sees
+            # the ordering tip beside every peer's commit tip
+            self._ledger_metrics = self.operations.ledger_metrics()
+            self._publish_height()
+            self.operations.register_checker(
+                "raft", lambda: not self.chain._halted.is_set()
+            )
+        else:
+            self._ledger_metrics = None
         self.transport.set_handler(self.chain.handle_step)
         bundle = netident.FakeBundle(k=1)
         self.deliver = DeliverService(
@@ -173,15 +196,30 @@ class NetOrderer:
         self.rpc.register("net.Status", self._status)
         self.rpc.register("net.TraceDump", self._trace_dump)
 
+    def _publish_height(self) -> None:
+        """The ordering tip on the same per-channel ``ledger_height``
+        gauge the peers publish: netscope's derived lag then measures
+        orderer tip minus slowest peer, and the stall detector covers
+        orderers as subjects too."""
+        lm = self._ledger_metrics
+        if lm is not None:
+            lm.height.With("channel", self.channel).set(
+                self.store.height
+            )
+
     def start(self) -> None:
         self.chain.start()
         self.rpc.start()
+        if self.operations is not None:
+            self.operations.start()
 
     def stop(self) -> None:
         self.rpc.stop()
         self.deliver.stop()
         self.chain.halt()
         self.transport.close()
+        if self.operations is not None:
+            self.operations.stop()
         self.kv.close()
 
     def _broadcast(self, body: bytes, stream) -> bytes:
@@ -241,7 +279,31 @@ class NetPeer:
         self.name = cfg["name"]
         root = cfg["root"]
         os.makedirs(root, exist_ok=True)
-        self.provider = LedgerProvider(root)
+        # operations endpoint FIRST (peer_node's ordering): the ledger
+        # provider and validator take their metric bundles at
+        # construction, and the checkers give /healthz?detail=1 real
+        # per-component inputs for netscope's health timeline
+        self.operations = None
+        if cfg.get("ops_port") is not None:
+            from fabric_tpu.common import workpool
+            from fabric_tpu.common.operations import System
+
+            self.operations = System(("127.0.0.1", int(cfg["ops_port"])))
+            workpool.set_metrics(self.operations.workpool_metrics())
+            self.operations.register_checker(
+                "workpool", workpool.health_checker()
+            )
+        self.provider = LedgerProvider(
+            root,
+            commit_metrics=(
+                self.operations.commit_metrics()
+                if self.operations is not None else None
+            ),
+            ledger_metrics=(
+                self.operations.ledger_metrics()
+                if self.operations is not None else None
+            ),
+        )
         genesis = netident.make_genesis(self.channel)
         join_dir = cfg.get("join_snapshot")
         try:
@@ -262,7 +324,11 @@ class NetPeer:
         self.csp = netident.FakeCSP()
         bundle = netident.FakeBundle(k=1 if orgs < 2 else 2)
         self.validator = TxValidator(
-            self.channel, self.ledger, bundle, self.csp
+            self.channel, self.ledger, bundle, self.csp,
+            metrics=(
+                self.operations.validate_metrics()
+                if self.operations is not None else None
+            ),
         )
         self.committer = Committer(self.validator, self.ledger)
 
@@ -305,6 +371,10 @@ class NetPeer:
             height_fn=lambda: self.ledger.height,
             sink=self._receive_block,
             max_backoff_s=2.0,
+            metrics=(
+                self.operations.deliver_metrics()
+                if self.operations is not None else None
+            ),
         )
 
         self.comm = TCPGossipComm(
@@ -315,6 +385,8 @@ class NetPeer:
         self.gossip = GossipService(
             self.comm, list(cfg.get("gossip_bootstrap") or [])
         )
+        if self.operations is not None:
+            self.gossip.set_metrics(self.operations.gossip_metrics())
         self.handle = self.gossip.join_channel(
             self.channel, self.committer,
             deliver_client=self.deliver_client,
@@ -322,12 +394,6 @@ class NetPeer:
         self.runner = GossipRunner(
             self.gossip, float(cfg.get("gossip_tick_s", 0.1))
         )
-
-        self.operations = None
-        if cfg.get("ops_port") is not None:
-            from fabric_tpu.common.operations import System
-
-            self.operations = System(("127.0.0.1", int(cfg["ops_port"])))
 
         self.rpc = RPCServer("127.0.0.1", int(cfg["rpc_port"]))
         self.rpc.register("net.Status", self._status)

@@ -102,6 +102,14 @@ class GossipComm:
             self.pki_id: self_identity
         }
         self._lock = named_lock("gossip.comm.identities")
+        # optional common.metrics.GossipMetrics — published once before
+        # traffic (GossipService.set_metrics), read by hot paths
+        self._metrics = None
+
+    def set_metrics(self, metrics) -> None:
+        """Bind a common.metrics.GossipMetrics bundle so message flow
+        surfaces on /metrics (netscope scrapes it per round)."""
+        self._metrics = metrics
 
     def subscribe(self, handler) -> None:
         """handler(ReceivedMessage)"""
@@ -124,6 +132,9 @@ class GossipComm:
 
     def wrap(self, msg: gpb.GossipMessage) -> gpb.SignedGossipMessage:
         payload = msg.SerializeToString()
+        m = self._metrics
+        if m is not None:
+            m.messages_sent.add()
         return gpb.SignedGossipMessage(
             payload=payload, signature=self.mcs.sign(payload)
         )
@@ -145,6 +156,11 @@ class GossipComm:
             return  # no handshake-learned identity: unauthenticated
         if not self.mcs.verify(ident, signed.signature, signed.payload):
             return  # forged or unsigned
+        m = self._metrics
+        if m is not None:
+            m.messages_received.With(
+                "content", msg.WhichOneof("content") or "unknown"
+            ).add()
         rm = ReceivedMessage(msg, sender_pki, respond)
         # one span per inbound dispatch: in-process transports call
         # _dispatch on the sender's thread, so it nests under the

@@ -59,6 +59,19 @@ class GossipService:
         self._channels: dict[str, ChannelHandle] = {}
         self._lock = threading.Lock()
         self._deliver_starters: dict[str, tuple] = {}
+        self._metrics = None
+
+    def set_metrics(self, metrics) -> None:
+        """Bind a common.metrics.GossipMetrics bundle across the whole
+        gossip stack: comm message flow, every channel's state-transfer
+        counters, and the membership gauge this service keeps current
+        per tick."""
+        self._metrics = metrics
+        self._comm.set_metrics(metrics)
+        with self._lock:
+            handles = list(self._channels.values())
+        for h in handles:
+            h.state.set_metrics(metrics)
 
     @property
     def endpoint(self) -> str:
@@ -79,6 +92,8 @@ class GossipService:
         )
         gossip.endpoint_lookup = self.discovery.endpoint_of
         state = StateProvider(channel_id, gossip, committer, self._comm)
+        if self._metrics is not None:
+            state.set_metrics(self._metrics)
 
         def on_leadership(is_leader: bool) -> None:
             if deliver_client is None:
@@ -106,6 +121,9 @@ class GossipService:
         self.discovery.tick()
         self.certstore.tick()
         self.identities.sweep()
+        m = self._metrics
+        if m is not None:
+            m.membership.set(len(self.discovery.alive_peers()))
         with self._lock:
             handles = list(self._channels.values())
         for h in handles:

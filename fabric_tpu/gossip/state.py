@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import threading
 
+from fabric_tpu.devtools import faultline
 from fabric_tpu.devtools.lockwatch import named_lock
 
 from fabric_tpu.protos.common import common_pb2
@@ -64,10 +65,16 @@ class StateProvider:
         # behind; one level of chaining per thread keeps TCP at
         # transfer rate while in-proc degrades safely to tick rate
         self._chaining = threading.local()
+        # optional common.metrics.GossipMetrics (state-transfer
+        # counters), published by GossipService.set_metrics
+        self._metrics = None
         channel_gossip.ledger_height = lambda: self._committer.height
         # blocks arriving via gossip land here
         self._gossip._on_block = self._on_gossip_block
         comm.subscribe(self._handle)
+
+    def set_metrics(self, metrics) -> None:
+        self._metrics = metrics
 
     # -- ingestion ---------------------------------------------------------
 
@@ -75,6 +82,12 @@ class StateProvider:
         """AddPayload: deliver-client (ordered) or gossip (unordered)."""
         if seq < self._committer.height:
             return  # already committed
+        # EVERY path a block takes into this peer funnels through here
+        # or _on_gossip_block — an armed raise at this point wedges
+        # exactly this node's height while its process stays alive and
+        # chatty (the silent-wedge class netscope's stall detector
+        # exists for; tests/test_netscope.py drives it per-node)
+        faultline.point("gossip.state.payload", seq=seq)
         self._buffer.push(seq, block_bytes)
         if from_orderer:
             # teach the gossip layer so it disseminates to org peers
@@ -84,6 +97,7 @@ class StateProvider:
     def _on_gossip_block(self, seq: int, block_bytes: bytes) -> None:
         if seq < self._committer.height:
             return
+        faultline.point("gossip.state.payload", seq=seq)
         self._buffer.push(seq, block_bytes)
         self._drain()
 
@@ -122,6 +136,9 @@ class StateProvider:
             start += 1
         if start >= their_height:
             return False  # every missing block is already buffered
+        m = self._metrics
+        if m is not None:
+            m.state_requests_sent.add()
         req = gpb.GossipMessage(channel=self._chan)
         req.state_request.start_seq_num = start
         req.state_request.end_seq_num = min(
@@ -148,6 +165,12 @@ class StateProvider:
                 dm.block = raw
             ep = self._gossip._endpoint_for(rm.sender_pki)
             if ep and resp.state_response.payloads:
+                m = self._metrics
+                if m is not None:
+                    m.state_requests_served.add()
+                    m.state_blocks_served.add(
+                        len(resp.state_response.payloads)
+                    )
                 self._comm.send(ep, resp)
         elif kind == "state_response":
             before = self._committer.height

@@ -148,6 +148,7 @@ class KVLedger:
         kv: KVStore,
         btl_policy=None,
         metrics=None,
+        ledger_metrics=None,
     ):
         from fabric_tpu.ledger.confighistory import ConfigHistoryMgr
         from fabric_tpu.ledger.pvtdatastorage import PvtDataStore
@@ -168,6 +169,11 @@ class KVLedger:
         # reads them); `metrics` (a common.metrics.CommitMetrics) also
         # gets per-observation histograms for /metrics.
         self._metrics = metrics
+        # `ledger_metrics` (common.metrics.LedgerMetrics): the
+        # per-channel height / durable_height gauges + block/tx
+        # counters the netscope telemetry plane derives cross-peer
+        # commit lag and sustained throughput from
+        self._lmetrics = ledger_metrics
         self.commit_stage_seconds: dict[str, float] = {}
         # Serializes state mutation against snapshot export: commits are
         # already single-threaded per ledger (one committer), but an
@@ -190,6 +196,17 @@ class KVLedger:
         # exports and the auto-trigger only ever observe the watermark.
         self._durable_height = self._blocks.height
         self._durable_hash = self._blocks.last_block_hash
+        self._publish_heights()
+
+    def _publish_heights(self) -> None:
+        lm = self._lmetrics
+        if lm is not None:
+            lm.height.With("channel", self.ledger_id).set(
+                self._blocks.height
+            )
+            lm.durable_height.With("channel", self.ledger_id).set(
+                self._durable_height
+            )
 
     def set_btl_policy(self, btl_policy) -> None:
         self.pvt_store._btl = btl_policy or (lambda ns, coll: 0)
@@ -466,6 +483,15 @@ class KVLedger:
         group.blocks += 1
         group.snap_notify.append(block.header.number)
         self._active_group = group
+        lm = self._lmetrics
+        if lm is not None:
+            lm.height.With("channel", self.ledger_id).set(
+                self._blocks.height
+            )
+            lm.blocks_committed.With("channel", self.ledger_id).add()
+            lm.transactions.With("channel", self.ledger_id).add(
+                sum(1 for f in flags if f == 0)  # VALID
+            )
         if self.snapshots is not None and self.snapshots.has_pending_request(
             block.header.number
         ):
@@ -531,6 +557,7 @@ class KVLedger:
             self._state.invalidate_caches()
             self._durable_height = self._blocks.height
             self._durable_hash = self._blocks.last_block_hash
+            self._publish_heights()
         notify, group.snap_notify = group.snap_notify, []
         group.blocks = 0
         group.dirty_files.clear()
@@ -554,6 +581,7 @@ class KVLedger:
         group.state.invalidate_caches()
         if self._active_group is group:
             self._active_group = None
+        self._publish_heights()
 
     def _observe_stages(self, **stages: float) -> None:
         acc = self.commit_stage_seconds
@@ -748,11 +776,13 @@ class LedgerProvider:
     <root>/snapshots."""
 
     def __init__(self, root_dir: str | None = None, csp=None, metrics=None,
-                 snapshots_dir: str | None = None, commit_metrics=None):
+                 snapshots_dir: str | None = None, commit_metrics=None,
+                 ledger_metrics=None):
         self._root = root_dir
         self._csp = csp
         self._metrics = metrics
         self._commit_metrics = commit_metrics
+        self._ledger_metrics = ledger_metrics
         if snapshots_dir is None and root_dir is not None:
             snapshots_dir = os.path.join(root_dir, "snapshots")
         self._snapshots_dir = snapshots_dir
@@ -795,7 +825,8 @@ class LedgerProvider:
         )
         store = BlockStore(block_dir, self._kv, name=ledger_id)
         ledger = KVLedger(
-            ledger_id, store, self._kv, metrics=self._commit_metrics
+            ledger_id, store, self._kv, metrics=self._commit_metrics,
+            ledger_metrics=self._ledger_metrics,
         )
         self._wire_snapshots(ledger)
         self._ledgers[ledger_id] = ledger
@@ -843,7 +874,8 @@ class LedgerProvider:
             )
         snap.import_snapshot(meta, snapshot_dir, store, self._kv, ledger_id)
         ledger = KVLedger(
-            ledger_id, store, self._kv, metrics=self._commit_metrics
+            ledger_id, store, self._kv, metrics=self._commit_metrics,
+            ledger_metrics=self._ledger_metrics,
         )
         self._wire_snapshots(ledger)
         self._ledgers[ledger_id] = ledger

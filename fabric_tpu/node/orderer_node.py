@@ -38,8 +38,26 @@ class OrdererNode:
         transport=None,
         tls=None,
         keepalive=None,
+        operations_port: int | None = None,
     ):
         self.tls = tls  # comm.tls.TLSCredentials | None
+        # operations endpoint (reference orderer main.go serves the
+        # same core/operations system): /metrics carries the raft
+        # term/leader/committed-index gauges + WAL histograms netscope
+        # scrapes, /healthz the registrar-halted checker
+        self.operations = None
+        raft_metrics = None
+        if operations_port is not None:
+            from fabric_tpu.common.operations import System
+
+            self.operations = System(("127.0.0.1", operations_port))
+            raft_metrics = self.operations.raft_metrics()
+            if transport is not None and hasattr(transport, "set_metrics"):
+                transport.set_metrics(raft_metrics)
+            self.operations.register_checker(
+                "registrar",
+                lambda: not getattr(self.registrar, "_halted", False),
+            )
         self.registrar = Registrar(
             root_dir,
             csp,
@@ -47,6 +65,7 @@ class OrdererNode:
             node_id=node_id,
             transport=transport,
             consenter_overrides=consenter_overrides,
+            raft_metrics=raft_metrics,
         )
         self._csp = csp
         notifier = BlockNotifier()
@@ -78,6 +97,8 @@ class OrdererNode:
     def start(self) -> None:
         self._warn_expiring_certs()
         self.rpc.start()
+        if self.operations is not None:
+            self.operations.start()
 
     def _warn_expiring_certs(self) -> None:
         """Week-ahead warnings for the orderer's signing and TLS certs
@@ -100,6 +121,8 @@ class OrdererNode:
         self.rpc.stop()
         self.deliver.stop()
         self.registrar.halt_all()
+        if self.operations is not None:
+            self.operations.stop()
 
     # -- handlers ----------------------------------------------------------
 

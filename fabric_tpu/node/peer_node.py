@@ -133,6 +133,10 @@ class _Channel:
                 sink=self._receive_block,
                 bundle=self.bundle,
                 csp=node.csp,
+                metrics=(
+                    node.operations.deliver_metrics()
+                    if node.operations is not None else None
+                ),
             )
             # with gossip enabled, leader election decides which peer
             # runs the orderer deliver client (gossip_service.go:205
@@ -267,11 +271,22 @@ class PeerNode:
                 # TPU provider: surface degraded-mode circuit-breaker
                 # state/trips on this node's /metrics endpoint
                 csp.set_metrics(self.operations.csp_metrics())
+            if hasattr(csp, "health_checker"):
+                # /healthz?detail=1 shows degraded-mode serving with
+                # the breaker's trip count as the failure reason
+                self.operations.register_checker(
+                    "csp.tpu.breaker", csp.health_checker()
+                )
             # shared host work pool: queue-depth / in-flight /
-            # saturation gauges for the parallel collect/prepare stages
+            # saturation gauges for the parallel collect/prepare
+            # stages, plus the saturation health checker (fails while
+            # fan-outs queue behind each other)
             from fabric_tpu.common import workpool
 
             workpool.set_metrics(self.operations.workpool_metrics())
+            self.operations.register_checker(
+                "workpool", workpool.health_checker()
+            )
         self.provider = LedgerProvider(
             root_dir,
             csp=csp,
@@ -281,6 +296,10 @@ class PeerNode:
             ),
             commit_metrics=(
                 self.operations.commit_metrics()
+                if self.operations is not None else None
+            ),
+            ledger_metrics=(
+                self.operations.ledger_metrics()
                 if self.operations is not None else None
             ),
         )
@@ -751,6 +770,9 @@ class PeerNode:
         self.gossip = GossipService(
             self.gossip_comm, bootstrap, identity_ttl_s=identity_ttl_s
         )
+        if self.operations is not None:
+            # message flow / state transfer / membership on /metrics
+            self.gossip.set_metrics(self.operations.gossip_metrics())
         self._gossip_opts = {
             "fanout": fanout, "store_capacity": store_capacity,
         }

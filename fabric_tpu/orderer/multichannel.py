@@ -51,6 +51,7 @@ class Registrar:
         node_id: int = 1,
         transport=None,
         consenter_overrides: dict | None = None,
+        raft_metrics=None,
     ):
         self.root_dir = root_dir
         self.csp = csp
@@ -62,6 +63,10 @@ class Registrar:
         self._halted = False
         self._consenter_overrides = consenter_overrides or {}
         self._on_block_hooks: list = []
+        # common.metrics.RaftMetrics | None — handed to every raft
+        # chain (term/leader/commit gauges, WAL histograms) so multi-
+        # channel orderers report per-process consensus state
+        self.raft_metrics = raft_metrics
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -145,6 +150,7 @@ class Registrar:
                     "eviction_probe"
                 ),
                 on_eviction=lambda: self.demote_evicted(channel_id),
+                metrics=self.raft_metrics,
             )
             if self.transport is not None:
                 self.transport.register_channel(channel_id, chain.handle_step)

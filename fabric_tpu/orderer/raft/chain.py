@@ -25,6 +25,7 @@ import threading
 from fabric_tpu.devtools.lockwatch import spawn_thread
 import time
 
+from fabric_tpu.common import tracing
 from fabric_tpu.orderer.blockcutter import BlockCutter
 from fabric_tpu.orderer.raft.raftcore import RaftNode
 from fabric_tpu.orderer.raft.wal import WAL
@@ -52,6 +53,7 @@ class RaftChain:
         eviction_suspicion_ticks: int | None = None,
         active_consenters_probe=None,
         on_eviction=None,
+        metrics=None,
     ):
         """`active_consenters_probe` () -> set[int] | None and
         `on_eviction` () -> None power EVICTION SUSPICION (reference
@@ -76,8 +78,28 @@ class RaftChain:
         self._on_block = on_block or (lambda blk: None)
         self._block_puller = block_puller
         self.consenters = {c.id: c for c in consenters}
+        # common.metrics.RaftMetrics | None — term/leader-change/
+        # committed-index gauges kept current by the run loop (netscope
+        # scrapes them); WAL append/fsync histograms ride the same
+        # bundle.  All updates happen on the single event-loop thread.
+        self._metrics = metrics
+        self._seen_term = -1
+        self._seen_leader = 0
+        # last NONZERO leader observed: the leader-changes counter only
+        # moves when leadership lands on a DIFFERENT node — a quorum
+        # blip that re-elects the same leader, and the cluster's very
+        # first election, are not churn (matches the metric help text)
+        self._seen_nonzero_leader = 0
+        self._seen_commit = -1
+        # detached per-block trace roots for proposed blocks, keyed by
+        # block number: raft.propose opens under the root at proposal,
+        # raft.apply joins it when the entry commits — the orderer-side
+        # mirror of the validator's per-block pipeline root.  Bounded:
+        # raft keeps at most a few proposals in flight, but a lost
+        # leadership can strand roots, so overflow ends the oldest.
+        self._block_roots: dict[int, object] = {}
 
-        self._wal = WAL(wal_dir) if wal_dir else None
+        self._wal = WAL(wal_dir, metrics=metrics) if wal_dir else None
         hs, log, snap = (
             self._wal.load() if self._wal else (rpb.HardState(), None, None)
         )
@@ -126,8 +148,29 @@ class RaftChain:
         self._halted.set()
         self._events.put(("halt", None))
         self._thread.join(timeout=5)
+        # proposed-but-never-applied block roots must still reach the
+        # flight recorder, or their propose spans dangle off a parent
+        # id absent from the dump.  Only sweep once the loop thread is
+        # really gone — a join that timed out (apply stalled under an
+        # injected delay) leaves it mutating the dict, and iterating
+        # concurrently would raise and skip the WAL close below.
+        if not self._thread.is_alive():
+            roots, self._block_roots = self._block_roots, {}
+            for root in roots.values():
+                root.annotate(abandoned=True)
+                root.end()
         if self._wal:
             self._wal.close()
+
+    def set_metrics(self, metrics) -> None:
+        """Bind a common.metrics.RaftMetrics bundle after construction
+        (nodes that build their operations System later); the WAL's
+        append/fsync histograms ride the same bundle."""
+        self._metrics = metrics
+        self._seen_term = -1
+        self._seen_commit = -1
+        if self._wal is not None:
+            self._wal.set_metrics(metrics)
 
     def wait_ready(self) -> None:
         return
@@ -324,7 +367,29 @@ class RaftChain:
         self._creator_number = blk.header.number
         self._creator_hash = protoutil.block_header_hash(blk.header)
         marker = b"C" if is_config else b"N"
-        self.node.propose(marker + blk.SerializeToString())
+        if tracing.enabled():
+            # detached per-block root (the consensus-loop mirror of
+            # the validator's pipeline root): raft.propose nests here
+            # now, raft.apply joins it when the entry commits
+            num = blk.header.number
+            root = tracing.begin(
+                "raft.block", detach=True, cat="pipeline",
+                block=num, channel=self.channel_id,
+            )
+            while len(self._block_roots) >= 128:
+                stale = self._block_roots.pop(
+                    next(iter(self._block_roots))
+                )
+                stale.annotate(abandoned=True)
+                stale.end()
+            self._block_roots[num] = root
+            with tracing.attached(root.ctx), tracing.span(
+                "raft.propose", cat="stage", block=num,
+                envelopes=len(env_batch), is_config=is_config,
+            ):
+                self.node.propose(marker + blk.SerializeToString())
+        else:
+            self.node.propose(marker + blk.SerializeToString())
 
     def _forward_to_leader(self, env_bytes: bytes, is_config: bool, seq: int) -> None:
         leader = self.node.leader
@@ -351,6 +416,21 @@ class RaftChain:
         if self.node.is_leader and not self._was_leader:
             self._reset_creator()
         self._was_leader = self.node.is_leader
+        m = self._metrics
+        if m is not None:
+            if self.node.term != self._seen_term:
+                self._seen_term = self.node.term
+                m.term.set(self._seen_term)
+            leader = self.node.leader
+            if leader != self._seen_leader:
+                if leader != 0:
+                    if self._seen_nonzero_leader not in (0, leader):
+                        m.leader_changes.add()
+                    self._seen_nonzero_leader = leader
+                self._seen_leader = leader
+            if self.node.commit != self._seen_commit:
+                self._seen_commit = self.node.commit
+                m.committed_index.set(self._seen_commit)
         rd = self.node.ready()
         if rd.empty():
             return
@@ -380,7 +460,27 @@ class RaftChain:
 
         is_config = entry.data[:1] == b"C"
         blk = common_pb2.Block.FromString(entry.data[1:])
+        # raft.apply joins the block's detached root when THIS node
+        # proposed it (followers root a fresh span: they never saw the
+        # proposal); the root ends here — apply is the block's last
+        # consensus-loop stop before the on_block handoff
+        root = self._block_roots.pop(blk.header.number, None)
+        if tracing.enabled():
+            with tracing.attached(
+                root.ctx if root is not None else None
+            ), tracing.span(
+                "raft.apply", cat="stage", block=blk.header.number,
+                index=entry.index,
+            ):
+                self._apply_block(blk, is_config, entry, protoutil)
+            if root is not None:
+                root.end()
+        else:
+            self._apply_block(blk, is_config, entry, protoutil)
+
+    def _apply_block(self, blk, is_config: bool, entry, protoutil) -> None:
         if blk.header.number < self._writer.height:
+            tracing.annotate(replayed=True)
             return  # already written (replay after restart)
         last = self._writer.last_block() if self._writer.height else None
         if last is not None and blk.header.previous_hash != \
@@ -403,6 +503,7 @@ class RaftChain:
                 "(stale leader creator); clients must resubmit",
                 blk.header.number, self.channel_id,
             )
+            tracing.annotate(dropped=True)
             if self.node.is_leader:
                 self._reset_creator()
             return

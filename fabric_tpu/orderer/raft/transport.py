@@ -99,6 +99,12 @@ class OutboundConn:
         self._ssl_ctx = ssl_ctx
         self.peer_id = peer_id
         self._metrics = metrics
+        # labeled gauge child cached once: send() runs per raft
+        # message, and With() re-sorts/allocates per call
+        self._queue_gauge = (
+            metrics.queue_depth.With("dest", self._dest())
+            if metrics is not None else None
+        )
         self.q: queue.Queue = queue.Queue(maxsize=queue_size)
         self._sock: socket.socket | None = None
         self._stop = threading.Event()
@@ -128,6 +134,10 @@ class OutboundConn:
             # (None on the untraced path — one tuple either way)
             self.q.put_nowait((data, tracing.current()))
             self._drop_episode = False
+            if self._queue_gauge is not None:
+                # approximate by design (qsize races the drainer); the
+                # gauge's job is trend, not an exact census
+                self._queue_gauge.set(self.q.qsize())
         except queue.Full:
             # raft retransmits, so dropping beats blocking consensus —
             # but never silently: log once per contiguous episode and
@@ -264,6 +274,19 @@ class TCPTransport:
 
     def set_handler(self, handler) -> None:
         self._handler = handler
+
+    def set_metrics(self, metrics) -> None:
+        """Bind a common.metrics.RaftMetrics after construction —
+        existing senders keep counting into it from their next call;
+        senders created before the bind keep their old bundle (None)."""
+        self._metrics = metrics
+        with self._lock:
+            for conn in self._peers.values():
+                conn._metrics = metrics
+                conn._queue_gauge = (
+                    metrics.queue_depth.With("dest", conn._dest())
+                    if metrics is not None else None
+                )
 
     def set_peer(self, node_id: int, addr: tuple[str, int]) -> None:
         with self._lock:

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import os
 import struct
+import time
 import zlib
 
 from fabric_tpu.orderer.raft.raftcore import MemoryLog
@@ -27,12 +28,18 @@ _HDR = struct.Struct(">II")  # length, crc32
 
 
 class WAL:
-    def __init__(self, dir_path: str):
+    def __init__(self, dir_path: str, metrics=None):
         self.dir = dir_path
         os.makedirs(dir_path, exist_ok=True)
         self.path = os.path.join(dir_path, "raft.wal")
         self._f = None
         self._garbage = 0  # bytes superseded by the latest snapshot
+        # common.metrics.RaftMetrics | None: wal_append / wal_fsync
+        # histograms (netscope's consensus-persistence gap closure)
+        self._metrics = metrics
+
+    def set_metrics(self, metrics) -> None:
+        self._metrics = metrics
 
     # -- recovery ----------------------------------------------------------
 
@@ -94,6 +101,7 @@ class WAL:
 
     def save(self, hard_state: rpb.HardState | None, entries) -> None:
         wrote = False
+        t0 = time.perf_counter()
         for e in entries:
             self._write(rpb.WALRecord(entry=e))
             wrote = True
@@ -103,7 +111,11 @@ class WAL:
         if wrote:
             f = self._open()
             f.flush()
+            t1 = time.perf_counter()
             os.fsync(f.fileno())
+            if self._metrics is not None:
+                self._metrics.wal_append.observe(t1 - t0)
+                self._metrics.wal_fsync.observe(time.perf_counter() - t1)
 
     def save_snapshot(self, snap: rpb.Snapshot) -> None:
         self._write(rpb.WALRecord(snapshot=snap))

@@ -35,8 +35,10 @@ class DeliverClient:
         bundle=None,  # channel config for block signature verification
         csp=None,
         max_backoff_s: float = 10.0,
+        metrics=None,  # common.metrics.DeliverMetrics | None
     ):
         self.channel_id = channel_id
+        self._metrics = metrics
         self._endpoints = list(endpoints)
         self._height = height_fn
         self._sink = sink
@@ -60,6 +62,11 @@ class DeliverClient:
         # blocks delivered through the sink since start() — the
         # liveness probe the failover tests poll
         self.delivered = 0
+
+    def set_metrics(self, metrics) -> None:
+        """Bind a common.metrics.DeliverMetrics bundle (blocks pulled,
+        reconnect episodes, cumulative backoff) for /metrics."""
+        self._metrics = metrics
 
     def start(self) -> None:
         """Idempotent while running; safe to call while a PREVIOUS
@@ -133,6 +140,10 @@ class DeliverClient:
                             blk.header.number, blk.SerializeToString()
                         )
                         self.delivered += 1
+                        if self._metrics is not None:
+                            self._metrics.blocks.With(
+                                "channel", self.channel_id
+                            ).add()
                     backoff = 0.1
             except Exception:
                 # fabriclint: allow[exception-discipline] reconnect loop: ANY
@@ -140,6 +151,15 @@ class DeliverClient:
                 # (the faultline seam is transparent to the rule; use
                 # action=delay rules here to count reconnects)
                 faultline.point("deliver.reconnect")
+            if self._metrics is not None:
+                # every loop iteration that reaches here is a rotation
+                # episode: the stream ended, failed, or never connected
+                self._metrics.reconnects.With(
+                    "channel", self.channel_id
+                ).add()
+                self._metrics.backoff_seconds.With(
+                    "channel", self.channel_id
+                ).add(backoff)
             self.backoff_log.append(backoff)
             # through the clockskew seam: a virtual clock turns this
             # reconnect wait into a deterministic clock advance, so the

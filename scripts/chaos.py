@@ -69,6 +69,13 @@ def main() -> int:
                          "topology with a seeded kill -9 schedule")
     ap.add_argument("--txs", type=int, default=80,
                     help="txs per kill9 campaign plan (default 80)")
+    ap.add_argument("--metrics-out", default=None, metavar="DIR",
+                    help="kill9 mode: run each plan under the netscope "
+                         "collector; FAILING plans ship their "
+                         "netscope_seed<S>.jsonl/.html telemetry "
+                         "artifacts into DIR beside the repro JSON "
+                         "(--replay of a kill9 artifact honors the "
+                         "flag too)")
     ap.add_argument("--trace-dir", default=None, metavar="DIR",
                     help="arm tracelens for the campaign and write each "
                          "failing plan's flight-recorder dump (Chrome "
@@ -100,7 +107,10 @@ def main() -> int:
             workdir = tempfile.mkdtemp(prefix="kill9-replay-")
             result = None
             try:
-                result = nh.replay_repro(args.replay, workdir)
+                result = nh.replay_repro(
+                    args.replay, workdir,
+                    metrics_out=args.metrics_out,
+                )
             finally:
                 # keep the workdir (node logs) for any non-clean run
                 if result is not None and result["ok"]:
@@ -151,10 +161,12 @@ def main() -> int:
         failures = 0
         verdicts = []
         repro_paths = []
+        netscope_paths = []
         for i in range(args.plans):
             seed = args.seed + i
             topo = nh.Topology(
                 orgs=1, peers_per_org=2, orderers=1, seed=seed,
+                ops=args.metrics_out is not None,
             )
             expected = 1 + -(-args.txs // topo.max_message_count)
             schedule = nh.generate_kill_schedule(
@@ -163,7 +175,15 @@ def main() -> int:
             workdir = tempfile.mkdtemp(prefix=f"kill9-s{seed}-")
             with nh.Network(workdir, topo) as net:
                 net.start()
-                result = nh.run_stream(net, args.txs, schedule)
+                scope = (
+                    nh.attach_netscope(net)
+                    if args.metrics_out is not None else None
+                )
+                result = nh.run_stream(
+                    net, args.txs, schedule, scope=scope
+                )
+                if scope is not None:
+                    scope.stop()
             verdicts.append("ok" if result["ok"] else "FAIL")
             if result["ok"]:
                 shutil.rmtree(workdir, ignore_errors=True)
@@ -172,6 +192,18 @@ def main() -> int:
                 repro_paths.append(nh.write_repro(result, os.path.join(
                     args.out, f"kill9_seed{seed}.repro.json"
                 )))
+                if scope is not None:
+                    # evidence rides WITH the repro: the jsonl series
+                    # + HTML timeline of the exact failing run
+                    from fabric_tpu.devtools.netscope import (
+                        write_artifacts,
+                    )
+
+                    paths = write_artifacts(
+                        scope, args.metrics_out,
+                        prefix=f"netscope_seed{seed}",
+                    )
+                    netscope_paths.append(paths)
         out = {
             "experiment": "chaos-kill9",
             "seed": args.seed,
@@ -180,6 +212,7 @@ def main() -> int:
             "failures": failures,
             "verdicts": verdicts,
             "repro": repro_paths,
+            "netscope": netscope_paths,
             "seconds": round(time.perf_counter() - t0, 4),
         }
         print(json.dumps(out, sort_keys=True))

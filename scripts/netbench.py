@@ -58,6 +58,12 @@ def main() -> int:
     ap.add_argument("--trace-out", default=None, metavar="PATH",
                     help="merged-trace path (default <out>/netbench."
                          "trace.json when --trace)")
+    ap.add_argument("--metrics-out", default=None, metavar="DIR",
+                    help="give every node an operations endpoint, run "
+                         "the netscope collector over the topology, "
+                         "and write netscope.jsonl + netscope.html "
+                         "(time series, health timeline, kill markers, "
+                         "SLO rollup) into DIR")
     ap.add_argument("--workdir", default=None,
                     help="node roots/logs live here (default: a "
                          "temp dir, removed on success)")
@@ -75,7 +81,9 @@ def main() -> int:
     keep_workdir = args.workdir is not None
 
     if args.repro:
-        result = nh.replay_repro(args.repro, workdir)
+        result = nh.replay_repro(
+            args.repro, workdir, metrics_out=args.metrics_out
+        )
         out = {
             "experiment": "netbench-replay",
             "artifact": args.repro,
@@ -94,6 +102,7 @@ def main() -> int:
         orderers=args.orderers, seed=args.seed,
         max_message_count=args.batch,
         trace=(1 << 15) if args.trace else 0,
+        ops=args.metrics_out is not None,
     )
     expected_height = 1 + -(-args.txs // args.batch)
     schedule = (
@@ -105,9 +114,35 @@ def main() -> int:
     )
     with nh.Network(workdir, topo) as net:
         net.start()
+        scope = (
+            nh.attach_netscope(net)
+            if args.metrics_out is not None else None
+        )
         result = nh.run_stream(
             net, args.txs, schedule, settle_timeout_s=args.settle,
+            scope=scope,
         )
+        netscope_doc = None
+        if scope is not None:
+            from fabric_tpu.devtools.netscope import write_artifacts
+
+            scope.stop()
+            # SLO thresholds for the verdict: p99 lag is judged
+            # LOOSELY by default (a fast stream legitimately lets the
+            # ordering tip run several batches ahead of peers while
+            # gossip catches up — the stall detector, not this bound,
+            # owns wedge detection); catch-up under the settle budget;
+            # any committed throughput at all.  Tune per deployment.
+            thresholds = {
+                "p99_cross_peer_lag_blocks": 4 * max(2, args.batch),
+                "catch_up_s": args.settle,
+                "min_tx_per_s": 0.1,
+            }
+            paths = write_artifacts(
+                scope, args.metrics_out, thresholds=thresholds
+            )
+            netscope_doc = scope.slo(thresholds)
+            netscope_doc["artifacts"] = paths
         trace_path = None
         if args.trace:
             trace_path = args.trace_out or os.path.join(
@@ -132,6 +167,8 @@ def main() -> int:
         "catch_up_s": result["catch_up_s"],
         "max_cross_peer_lag_ms": result["max_cross_peer_lag_ms"],
         "state_digests_agree": result["state_digests_agree"],
+        "stalled_nodes": result.get("stalled_nodes", []),
+        "netscope": netscope_doc,
         "kill_schedule": result["kill_schedule"],
         "violations": result["violations"],
         "errors": result["errors"],

@@ -1,0 +1,146 @@
+"""Prometheus exposition hardening (ISSUE 12 satellite): label-value
+escaping, histogram bucket monotonicity + _sum/_count agreement, and
+the netscope parser's expose -> parse -> samples round trip."""
+
+from __future__ import annotations
+
+import math
+import re
+
+from fabric_tpu.common.metrics import (
+    CounterOpts,
+    GaugeOpts,
+    HistogramOpts,
+    PrometheusProvider,
+)
+from fabric_tpu.devtools.netscope import parse_prometheus
+
+
+def _sample_map(text):
+    return {
+        (name, labels): value
+        for name, labels, value in parse_prometheus(text)
+    }
+
+
+class TestExpositionHardening:
+    def test_label_value_escaping_round_trips(self):
+        p = PrometheusProvider()
+        g = p.new_gauge(GaugeOpts(namespace="t", name="g"))
+        nasty = 'quote:" backslash:\\ newline:\nend'
+        g.With("channel", nasty).set(3)
+        text = p.registry.expose()
+        # the exposition stays one-sample-per-line: the raw newline
+        # must never split the sample line
+        sample_lines = [
+            ln for ln in text.splitlines()
+            if ln.startswith("t_g{")
+        ]
+        assert len(sample_lines) == 1
+        assert '\\"' in sample_lines[0]
+        assert "\\n" in sample_lines[0]
+        assert "\\\\" in sample_lines[0]
+        samples = parse_prometheus(text)
+        assert samples == [("t_g", (("channel", nasty),), 3.0)]
+
+    def test_histogram_buckets_monotonic_and_sum_count_agree(self):
+        p = PrometheusProvider()
+        h = p.new_histogram(HistogramOpts(
+            namespace="t", name="h", buckets=(0.1, 1.0, 10.0),
+        ))
+        hh = h.With("channel", "c1")
+        observations = (0.05, 0.05, 0.5, 5.0, 50.0)  # one ABOVE +Inf
+        for v in observations:
+            hh.observe(v)
+        text = p.registry.expose()
+        buckets = {}
+        for line in text.splitlines():
+            m = re.match(r't_h_bucket\{.*le="([^"]+)"\} (\d+)', line)
+            if m:
+                buckets[m.group(1)] = int(m.group(2))
+        # cumulative, monotone, exact
+        assert buckets == {"0.1": 2, "1": 3, "10": 4, "+Inf": 5}
+        counts = sorted(buckets.values())
+        assert counts == [buckets["0.1"], buckets["1"], buckets["10"],
+                          buckets["+Inf"]]
+        samples = _sample_map(text)
+        labels = (("channel", "c1"),)
+        assert samples[("t_h_count", labels)] == len(observations)
+        assert math.isclose(
+            samples[("t_h_sum", labels)], sum(observations)
+        )
+        # every rendered bucket count is <= _count (the old exposition
+        # double-cumulated: a single small observation rendered bucket
+        # counts LARGER than _count)
+        assert max(buckets.values()) <= samples[("t_h_count", labels)]
+
+    def test_single_small_observation_regression(self):
+        """One observation below every bucket used to render bucket
+        counts 1,2,3,... (every bucket incremented AND re-cumulated at
+        exposition) — non-monotonic against _bucket{+Inf} = 1."""
+        p = PrometheusProvider()
+        h = p.new_histogram(HistogramOpts(
+            namespace="t", name="h1", buckets=(1, 2, 3),
+        ))
+        h.observe(0.5)
+        text = p.registry.expose()
+        vals = [
+            int(m.group(1))
+            for m in re.finditer(r"t_h1_bucket\{[^}]*\} (\d+)", text)
+        ]
+        assert vals == [1, 1, 1, 1]  # le=1, le=2, le=3, +Inf
+
+    def test_parser_round_trip_is_value_faithful(self):
+        """expose -> parse -> samples carries every series, labelset,
+        and value exactly (the netscope scraper's fidelity contract)."""
+        p = PrometheusProvider()
+        c = p.new_counter(CounterOpts(
+            namespace="ledger", name="transactions_total",
+            help="help text with spaces # and hash",
+        ))
+        c.With("channel", "ch1").add(7)
+        c.With("channel", "ch2").add(0.5)
+        g = p.new_gauge(GaugeOpts(namespace="ledger", name="height"))
+        g.With("channel", "ch1").set(42)
+        g2 = p.new_gauge(GaugeOpts(namespace="gossip",
+                                   name="membership_size"))
+        g2.set(3)  # label-free sample line
+        h = p.new_histogram(HistogramOpts(
+            namespace="v", name="lat", buckets=(0.5, 2.0),
+        ))
+        h.With("stage", "collect").observe(0.25)
+        h.With("stage", "collect").observe(1.5)
+        samples = _sample_map(p.registry.expose())
+        assert samples[
+            ("ledger_transactions_total", (("channel", "ch1"),))
+        ] == 7.0
+        assert samples[
+            ("ledger_transactions_total", (("channel", "ch2"),))
+        ] == 0.5
+        assert samples[("ledger_height", (("channel", "ch1"),))] == 42.0
+        assert samples[("gossip_membership_size", ())] == 3.0
+        st = (("stage", "collect"),)
+        assert samples[("v_lat_count", st)] == 2.0
+        assert math.isclose(samples[("v_lat_sum", st)], 1.75)
+        assert samples[
+            ("v_lat_bucket", (("le", "0.5"), ("stage", "collect")))
+        ] == 1.0
+        assert samples[
+            ("v_lat_bucket", (("le", "2"), ("stage", "collect")))
+        ] == 2.0
+        assert samples[
+            ("v_lat_bucket", (("le", "+Inf"), ("stage", "collect")))
+        ] == 2.0
+
+    def test_parser_skips_malformed_lines(self):
+        text = (
+            "# HELP x y\n# TYPE x counter\n"
+            "x 1\n"
+            "not a sample line at all with words\n"
+            "y{a=\"b\"} notafloat\n"
+            "z{a=\"b\"} 2\n"
+        )
+        assert parse_prometheus(text) == [
+            ("x", (), 1.0),
+            ("z", (("a", "b"),), 2.0),
+        ]

@@ -130,6 +130,92 @@ class TestOperationsServer:
         assert exc.value.code == 503
         assert "statedb" in json.loads(exc.value.read())["failed_checks"]
 
+    def test_healthz_detail_mode(self, system):
+        """ISSUE 12 satellite: ?detail=1 lists every checker with its
+        name, pass/fail status, and a persistent last_error — the
+        netscope health timeline's per-checker input."""
+        host, port = system.addr
+        base = f"http://{host}:{port}"
+        flaky = {"fail": True}
+
+        def flaky_check():
+            if flaky["fail"]:
+                raise RuntimeError("db unreachable")
+            return True
+
+        system.register_checker("statedb", flaky_check)
+        system.register_checker("always", lambda: True)
+
+        req = urllib.request.Request(base + "/healthz?detail=1")
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(req, timeout=3)
+        assert exc.value.code == 503
+        body = json.loads(exc.value.read())
+        assert body["status"] == "Service Unavailable"
+        assert body["failed_checks"] == ["statedb: db unreachable"]
+        checks = {c["component"]: c for c in body["checks"]}
+        assert checks["statedb"]["status"] == "failed"
+        assert checks["statedb"]["last_error"] == "db unreachable"
+        assert checks["always"] == {
+            "component": "always", "status": "OK", "last_error": None,
+        }
+
+        # recovery: healthy again, but last_error persists in detail
+        flaky["fail"] = False
+        status, raw = _get(base + "/healthz?detail=1")
+        assert status == 200
+        body = json.loads(raw)
+        assert body["status"] == "OK"
+        checks = {c["component"]: c for c in body["checks"]}
+        assert checks["statedb"]["status"] == "OK"
+        assert checks["statedb"]["last_error"] == "db unreachable"
+        # plain mode keeps the reference body shape (no checks key)
+        status, raw = _get(base + "/healthz")
+        assert status == 200 and "checks" not in json.loads(raw)
+
+    def test_workpool_saturation_checker(self, monkeypatch):
+        from fabric_tpu.common import workpool
+
+        check = workpool.health_checker()
+        # no pool ever created: healthy, and the probe must not spin
+        # one up
+        assert check() is True
+
+        class _FakeQueue:
+            def __init__(self, n):
+                self._n = n
+
+            def qsize(self):
+                return self._n
+
+        class _FakePool:
+            _max_workers = 2
+            _work_queue = _FakeQueue(3)
+
+        monkeypatch.setattr(workpool, "_pool", _FakePool())
+        monkeypatch.setitem(workpool._stats, "in_flight", 5)
+        with pytest.raises(RuntimeError, match="saturated"):
+            check()
+        # full utilization with an empty queue is NOT unhealthy
+        _FakePool._work_queue = _FakeQueue(0)
+        monkeypatch.setitem(workpool._stats, "in_flight", 2)
+        assert check() is True
+
+    def test_tpu_breaker_checker(self):
+        from fabric_tpu.csp.tpu import provider as tpuprov
+
+        class _Stub:
+            class _breaker:
+                open = False
+                trips = 0
+
+        check = tpuprov.TPUCSP.health_checker(_Stub())
+        assert check() is True
+        _Stub._breaker.open = True
+        _Stub._breaker.trips = 2
+        with pytest.raises(RuntimeError, match="breaker open"):
+            check()
+
     def test_logspec_roundtrip(self, system):
         host, port = system.addr
         base = f"http://{host}:{port}"

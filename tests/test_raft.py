@@ -303,6 +303,60 @@ def test_chain_orders_and_replicates_blocks(chain_cluster):
         )
 
 
+def test_chain_consensus_loop_spans_join_block_root(chain_cluster):
+    """Orderer consensus-loop tracing (ISSUE 12 satellite): on the
+    proposing node, ``raft.propose`` and ``raft.apply`` both nest
+    under ONE detached per-block root (`raft.block`) — the orderer
+    mirror of the validator's pipeline root — and the root itself
+    reaches the recorder when the block applies."""
+    from fabric_tpu.common import tracing
+
+    transport, chains = chain_cluster
+    lead = _leader(chains)
+    leader_chain = chains[lead][0]
+    with tracing.scope() as rec:
+        leader_chain.order(_env(b"span-a"))
+        leader_chain.order(_env(b"span-b"))  # cutter max 2 -> block 1
+        for nid, (c, store, _) in chains.items():
+            _wait(lambda s=store: s.height == 2,
+                  msg=f"block applied on node {nid}")
+
+        def events(name):
+            return [
+                ev for ev in rec.snapshot() if ev.get("name") == name
+            ]
+
+        _wait(lambda: events("raft.block"),
+              msg="block root reaches the recorder")
+        roots = [
+            ev for ev in events("raft.block")
+            if ev["args"].get("block") == 1
+        ]
+        assert len(roots) == 1
+        root = roots[0]
+        assert root["cat"] == "pipeline"
+        trace, span = root["args"]["trace"], root["args"]["span"]
+        proposes = [
+            ev for ev in events("raft.propose")
+            if ev["args"].get("block") == 1
+        ]
+        assert len(proposes) == 1
+        assert proposes[0]["args"]["trace"] == trace
+        assert proposes[0]["args"]["parent"] == span
+        # every node applies the block, but only the PROPOSER's apply
+        # joins the root's trace; follower applies root fresh traces
+        applies = [
+            ev for ev in events("raft.apply")
+            if ev["args"].get("block") == 1
+        ]
+        assert len(applies) == len(chains)
+        joined = [
+            ev for ev in applies if ev["args"]["trace"] == trace
+        ]
+        assert len(joined) == 1
+        assert joined[0]["args"]["parent"] == span
+
+
 def test_chain_follower_forwards_to_leader(chain_cluster):
     transport, chains = chain_cluster
     lead = _leader(chains)
