@@ -422,6 +422,19 @@ class InProcStream:
         for t in self._threads:
             t.start()
 
+    def stop(self, timeout: float = 5.0) -> None:
+        """Unblock both queue loops with a ``None`` sentinel and join
+        the service threads.  Each queue has exactly one consumer
+        (register_stream's recv on ``_to_peer``, the shim's recv on
+        ``_to_cc``), so one sentinel per queue drains both sides; both
+        loops treat ``None`` as EOF.  Idempotent — a second stop adds
+        sentinels to queues nobody reads."""
+        self._to_peer.put(None)
+        self._to_cc.put(None)
+        for t in self._threads:
+            if t.ident is not None:
+                t.join(timeout)
+
     def wait_registered(self, support: ChaincodeSupport, name: str, timeout=5.0):
         import time
 
@@ -455,6 +468,13 @@ class TCPChaincodeListener:
         self._server.listen(16)
         self.addr = self._server.getsockname()
         self._stop = threading.Event()
+        # live (conn, serve-thread) pairs so close() can terminate and
+        # join in-flight streams, not just stop accepting new ones;
+        # _closing flips under the same lock so a conn accepted while
+        # close() drains can never be registered-after-drain and leak
+        self._conn_lock = threading.Lock()
+        self._conns: list = []
+        self._closing = False
         spawn_thread(
             target=self._accept, name="cc-accept", kind="service"
         ).start()
@@ -465,10 +485,22 @@ class TCPChaincodeListener:
                 conn, _ = self._server.accept()
             except OSError:
                 return
-            spawn_thread(
+            t = spawn_thread(
                 target=self._serve, args=(conn,),
                 name="cc-serve", kind="service",
-            ).start()
+            )
+            with self._conn_lock:
+                if self._closing:
+                    # close() already drained the registry: this conn
+                    # would never be shut down or joined — drop it
+                    # instead of serving into a closed listener
+                    try:
+                        conn.close()
+                    except OSError:
+                        pass
+                    return
+                self._conns.append((conn, t))
+            t.start()
 
     def _serve(self, conn: socket.socket) -> None:
         lock = threading.Lock()
@@ -517,13 +549,43 @@ class TCPChaincodeListener:
                 conn.close()
             except OSError:
                 pass
+            # self-prune: a connection that ended naturally must not
+            # pin its socket + dead Thread in the registry for the
+            # listener's lifetime (close() joins whatever remains)
+            with self._conn_lock:
+                self._conns[:] = [
+                    (c, t) for c, t in self._conns if c is not conn
+                ]
 
     def close(self) -> None:
         self._stop.set()
+        # shutdown() BEFORE close(): close() alone does not wake a
+        # thread already blocked in accept()/recv() on the same fd —
+        # the accept loop and every serve thread would park until
+        # their remote end disconnected on its own
+        try:
+            self._server.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             self._server.close()
         except OSError:
             pass
+        with self._conn_lock:
+            self._closing = True
+            conns = list(self._conns)
+            self._conns.clear()
+        for conn, t in conns:
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+            if t.ident is not None:
+                t.join(5.0)
 
 
 __all__ = [

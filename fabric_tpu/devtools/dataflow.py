@@ -83,6 +83,36 @@ racecheck (v3)
     fields never written outside ``__init__`` are immune — three
     precision rules that keep the rule deployable at error severity.
 
+hbcheck (v4)
+    the happens-before layer.  The lockset walk additionally records
+    POSITIONAL synchronization events per function — thread
+    ``start()``/``join()``/``cancel()`` resolved to their entry qnames
+    (through locals, ``self`` attrs, container joins, and chained
+    ``spawn(...).start()``), ``drain_threads`` as a join of every
+    entry, ``Event.set/clear/wait`` and ``Queue.put/get`` resolved to
+    per-object sync tokens (class members and function-locals shared
+    with closures), and ``workpool.run_chunked`` as a start+join pair
+    at the call line.  A thread-entry SET (union over call paths,
+    unlike the lockset meet) tells each access WHO can run it; a
+    pairwise order check then proves accesses safe: same single
+    domain, start-edge before every entry the counterpart runs under,
+    join-edge after it completed, or a matching release→acquire token
+    pair.  Proven-safe sites are exempt from racecheck emission (they
+    still vote in guard inference), fully-ordered fields resolve as
+    ``hb-publish`` in the guard map, and the same machinery emits
+    post-``start()`` writes that race their publication point,
+    cross-thread ``Event`` re-arms, and stale declared guards.  Two
+    more passes ride the recorded facts: the role-level lock
+    ACQUISITION-ORDER GRAPH (lexical held-sets + an interprocedural
+    may-held union over production callers; ``lock_graph()`` exports
+    it, lint.py fails cycles, tier-1 asserts the runtime lockwatch
+    graph is a subgraph) and THREAD-LIFECYCLE reachability (every
+    spawn site is classified by what happens to its handle —
+    attr/local/container binding with an observed join/cancel/
+    shutdown, ownership transfer by return/handoff, a stop-signal
+    probe in the entry, or a bounded worker body — and anything else
+    is an error).
+
 The engine is deliberately static and approximate: only statically
 resolvable names participate in the call graph, attribute calls on
 foreign objects fall back to the per-name heuristics, and taint is
@@ -165,6 +195,48 @@ _UNKNOWN_LOCK = "?"
 # entering one forks the gossip view exactly like a forked block header.
 # Sink = the seam hash functions when called from gossip modules.
 _GOSSIP_SINK_SCOPE = "fabric_tpu/gossip/"
+
+# -- happens-before vocabulary (v4) ------------------------------------------
+
+# synchronization-object constructors recognized on members/locals: an
+# Event's set()->wait() and a Queue's put()->get() are publication
+# edges (everything sequenced before the release side is visible after
+# the matching acquire side)
+_EVENT_CTOR_FNS = frozenset({"threading.Event"})
+_QUEUE_CTOR_FNS = frozenset({
+    "queue.Queue", "queue.SimpleQueue", "queue.LifoQueue",
+    "queue.PriorityQueue",
+})
+# executor factories: their registration is a thread-lifecycle site of
+# its own (shutdown() is the stop path the rule demands)
+_EXECUTOR_FNS = frozenset({
+    "fabric_tpu.devtools.lockwatch.tracked_executor",
+    "concurrent.futures.ThreadPoolExecutor",
+})
+# run_chunked(fn, ...) is a synchronous submit->result fan-out: the
+# chunk callable is a thread entry (it runs on pool workers,
+# concurrently with its sibling chunks), and the CALL SITE is both a
+# start edge (caller's prior writes are published to the workers) and
+# a join edge (workers' writes are published back before the call
+# returns)
+_RUN_CHUNKED_FNS = frozenset({"fabric_tpu.common.workpool.run_chunked"})
+# drain_threads joins every registered worker: a join edge from ALL
+# spawn_thread entries to the statements after it
+_DRAIN_FNS = frozenset({"fabric_tpu.devtools.lockwatch.drain_threads"})
+_CLOCKSKEW_WAIT = "fabric_tpu.devtools.clockskew.wait"
+
+def _own_nodes(root):
+    """AST nodes of `root` excluding nested function subtrees — a
+    closure's statements run on the closure's schedule, not inline in
+    the enclosing function (nested defs get their own scans)."""
+    yield root
+    stack = list(ast.iter_child_nodes(root))
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        yield n
+        stack.extend(ast.iter_child_nodes(n))
 
 
 # the chaos/observability seams: their blocking calls (faultline.
@@ -253,10 +325,29 @@ class FunctionInfo:
     # lock roles lexically held) and (callee qname, frozenset held)
     accesses: list = dataclasses.field(default_factory=list)
     call_locks: list = dataclasses.field(default_factory=list)
+    # lock-order facts (v4): every lexical acquisition with the roles
+    # already held at that point — the static acquisition-order graph
+    # is assembled from these plus the interprocedural may-held set
+    lock_acquires: list = dataclasses.field(default_factory=list)
+    # happens-before events (v4), all positional within this function:
+    # (entry qname | None, line) thread starts; (entry qname | "*",
+    # line) joins; (sync token, line, heldset) event set/clear and
+    # queue put on the release side, event wait and queue get on the
+    # acquire side
+    hb_starts: list = dataclasses.field(default_factory=list)
+    hb_joins: list = dataclasses.field(default_factory=list)
+    hb_rel: list = dataclasses.field(default_factory=list)
+    hb_acq: list = dataclasses.field(default_factory=list)
+    hb_clears: list = dataclasses.field(default_factory=list)
+    # thread-lifecycle facts: this function blocks on a stop signal
+    # (event wait/is_set, queue get on a known queue) / contains an
+    # unbounded while loop
+    stop_probe: bool = False
+    has_while: bool = False
 
     def summary(self) -> dict:
         """JSON-shaped summary (CLI ``--summaries``, tests)."""
-        return {
+        out = {
             "function": self.qname,
             "file": self.rel,
             "line": self.lineno,
@@ -269,6 +360,18 @@ class FunctionInfo:
             "param_to_sink": sorted(self.param_to_sink),
             "accesses": len(self.accesses),
         }
+        # happens-before facts (v4) ride the artifact only where they
+        # exist — most functions have none and the lines stay diffable
+        if (self.hb_starts or self.hb_joins or self.hb_rel
+                or self.hb_acq or self.stop_probe):
+            out["hb"] = {
+                "starts": len(self.hb_starts),
+                "joins": len(self.hb_joins),
+                "releases": len(self.hb_rel),
+                "acquires": len(self.hb_acq),
+                "stop_probe": self.stop_probe,
+            }
+        return out
 
 
 @dataclasses.dataclass
@@ -284,6 +387,15 @@ class ClassInfo:
     field_types: dict = dataclasses.field(default_factory=dict)
     # every attr assigned through `self.` anywhere in the class
     fields: set = dataclasses.field(default_factory=set)
+    # attr -> "event" | "queue" (synchronization members: HB edges)
+    sync_types: dict = dataclasses.field(default_factory=dict)
+    # attr -> thread-entry qname (or None when the target does not
+    # resolve) for members assigned from spawn_thread/spawn_timer/
+    # Thread/Timer — lets `self._thread.start()`/`.join()` in OTHER
+    # methods resolve to the spawned entry
+    spawn_attrs: dict = dataclasses.field(default_factory=dict)
+    # attrs assigned from tracked_executor/ThreadPoolExecutor
+    exec_attrs: set = dataclasses.field(default_factory=set)
 
 
 @dataclasses.dataclass
@@ -337,6 +449,34 @@ class Project:
         # racecheck emissions + the inferred guarded-by map behind them
         self.race_flows: list[TaintFlow] = []
         self.guard_map: dict[str, dict] = {}
+        # v4: thread-lifecycle emissions, stale-guard emissions, the
+        # static lock-order graph ((src role, dst role) -> sorted
+        # acquisition sites), and the spawn-site registry feeding the
+        # lifecycle rule
+        self.lifecycle_flows: list[TaintFlow] = []
+        self.stale_guard_flows: list[TaintFlow] = []
+        self.lock_order_edges: dict[tuple, list] = {}
+        self.spawn_sites: list[dict] = []
+        # (owner qname | None, attr) pairs a join/cancel/shutdown call
+        # is observed on anywhere in the program; None-owner entries
+        # match by attr name (the conservative fallback when the base
+        # object's class cannot be resolved)
+        self._attr_joins: set = set()
+        self._attr_shutdowns: set = set()
+        # local sync objects (events/queues) visible to a function and
+        # its closures: per-fn qname -> {name: (kind, token)}; lookup
+        # walks the enclosing-scope chain, tokens are keyed by the
+        # DEFINING function so sibling closures' same-named locals
+        # never unify
+        self._fn_local_sync: dict[str, dict] = {}
+        # (field, kind, line, fn qname) -> True for accesses proven
+        # safe by happens-before edges (exposed for tests/artifacts)
+        self.hb_safe_sites: set = set()
+        self._spawn_seen: set = set()
+        # entry qnames that can run as several concurrent threads at
+        # once (pool chunks, executor jobs, handlers, loop-spawned
+        # workers): a shared single domain is NOT thread confinement
+        self._multi_entries: set = set()
         # class registry (racecheck + typed call resolution)
         self.classes: dict[str, ClassInfo] = {}
         self.module_lock_roles: dict[str, str] = {}  # dotted name -> role
@@ -352,7 +492,9 @@ class Project:
         self._fixpoint_booleans()
         self._fixpoint_taint()
         self._lockset_pass_all()
+        self._interproc_lock_edges()
         self._racecheck()
+        self._lifecycle()
 
     # -- module loading ----------------------------------------------------
 
@@ -500,6 +642,71 @@ class Project:
             return pseudo
         return None
 
+    @staticmethod
+    def _spawn_api(target: str | None) -> str | None:
+        """"thread" / "timer" / "executor" when `target` is a thread-
+        creating callable; None otherwise."""
+        if target in _SPAWN_THREAD_FNS:
+            return "thread"
+        if target in _SPAWN_TIMER_FNS:
+            return "timer"
+        if target in _EXECUTOR_FNS:
+            return "executor"
+        return None
+
+    @staticmethod
+    def _spawn_kind(target: str | None, call: ast.Call) -> str:
+        """The threadwatch kind of a spawn call (explicit kind= or the
+        seam's default: workers from spawn_thread, services from
+        spawn_timer)."""
+        for k in call.keywords:
+            if k.arg == "kind" and isinstance(k.value, ast.Constant):
+                return str(k.value.value)
+        return "service" if target in _SPAWN_TIMER_FNS else "worker"
+
+    def _scoped_symbol(self, scope: str, name: str) -> str | None:
+        """`name` resolved against `scope`'s ``<locals>`` chain: probe
+        ``scope.<locals>.name``, then each enclosing function scope —
+        the ONE closure-resolution rule (spawn targets, sibling-closure
+        calls, and thread-entry registration all share it)."""
+        while True:
+            cand = f"{scope}.<locals>.{name}"
+            if cand in self.symbols:
+                return cand
+            if ".<locals>." not in scope:
+                return None
+            scope = scope.rsplit(".<locals>.", 1)[0]
+
+    def _spawn_entry(self, mod: ModuleInfo, call: ast.Call, cls,
+                     local: dict, types: dict,
+                     scope: str | None = None) -> str | None:
+        """The thread-entry qname a spawn/Thread/Timer ctor targets (a
+        known symbol, including `<locals>` closures when `scope` gives
+        the enclosing function), or None when unresolvable."""
+        target = self._resolve_expr(mod, call.func, cls, local, types)
+        kw_name = "function" if target in _SPAWN_TIMER_FNS else "target"
+        expr = None
+        for k in call.keywords:
+            if k.arg == kw_name:
+                expr = k.value
+        if expr is None:
+            if target in _SPAWN_TIMER_FNS and len(call.args) >= 2:
+                expr = call.args[1]
+            elif (
+                target in _SPAWN_THREAD_FNS
+                and target != "threading.Thread"
+                and call.args
+            ):
+                expr = call.args[0]
+        if expr is None:
+            return None
+        if isinstance(expr, ast.Name) and scope is not None:
+            scoped = self._scoped_symbol(scope, expr.id)
+            if scoped is not None:
+                return scoped
+        q = self._resolve_expr(mod, expr, cls, local, types)
+        return q if q in self.symbols else None
+
     def _collect_classes(self) -> None:
         # phase 1: every class must exist before any annotation can
         # resolve to it (cross-module field types)
@@ -542,27 +749,49 @@ class Project:
                         if p.annotation is not None
                     }
                     for node in ast.walk(fnnode):
-                        if (
-                            isinstance(node, ast.Assign)
-                            and len(node.targets) == 1
-                            and isinstance(node.targets[0], ast.Attribute)
-                            and isinstance(node.targets[0].value, ast.Name)
-                            and node.targets[0].value.id == "self"
-                        ):
-                            attr = node.targets[0].attr
-                            ci.fields.add(attr)
+                        if isinstance(node, ast.Assign):
+                            # every `self.X = ...` target registers,
+                            # including chained assigns like
+                            # `self._stop = stop = Event()`
+                            attrs = [
+                                t.attr for t in node.targets
+                                if isinstance(t, ast.Attribute)
+                                and isinstance(t.value, ast.Name)
+                                and t.value.id == "self"
+                            ]
+                            if not attrs:
+                                continue
+                            for attr in attrs:
+                                ci.fields.add(attr)
                             v = node.value
                             if isinstance(v, ast.Call):
                                 target = self._resolve_expr(
                                     mod, v.func, stmt.name, {}
                                 )
-                                role = self._role_from_ctor(
-                                    target, v, f"{ci.qname}.{attr}"
-                                )
-                                if role is not None:
-                                    ci.lock_roles[attr] = role
-                                elif target in self.classes:
-                                    ci.field_types.setdefault(attr, target)
+                                for attr in attrs:
+                                    role = self._role_from_ctor(
+                                        target, v, f"{ci.qname}.{attr}"
+                                    )
+                                    if role is not None:
+                                        ci.lock_roles[attr] = role
+                                    elif target in self.classes:
+                                        ci.field_types.setdefault(
+                                            attr, target
+                                        )
+                                    elif target in _EVENT_CTOR_FNS:
+                                        ci.sync_types[attr] = "event"
+                                    elif target in _QUEUE_CTOR_FNS:
+                                        ci.sync_types[attr] = "queue"
+                                    elif target in _EXECUTOR_FNS:
+                                        ci.exec_attrs.add(attr)
+                                    elif self._spawn_api(target) in (
+                                        "thread", "timer"
+                                    ):
+                                        ci.spawn_attrs[attr] = (
+                                            self._spawn_entry(
+                                                mod, v, stmt.name, {}, {}
+                                            )
+                                        )
                             elif (
                                 isinstance(v, ast.Name)
                                 and v.id in ann_params
@@ -571,7 +800,8 @@ class Project:
                                     mod, ann_params[v.id]
                                 )
                                 if tq is not None:
-                                    ci.field_types.setdefault(attr, tq)
+                                    for attr in attrs:
+                                        ci.field_types.setdefault(attr, tq)
                         elif (
                             isinstance(node, (ast.AnnAssign, ast.AugAssign))
                             and isinstance(node.target, ast.Attribute)
@@ -644,6 +874,16 @@ class Project:
                 target = self._resolve_expr(
                     mod, node.func, fn.cls, local, types
                 )
+                if target is None and isinstance(node.func, ast.Name):
+                    # closure-to-closure resolution (v4): a bare-name
+                    # call probes the enclosing `<locals>` scopes, so a
+                    # nested def calling its own nested defs or sibling
+                    # closures stays on the call graph — thread targets
+                    # defined as closures keep their callees' lockset/
+                    # HB facts
+                    nm = node.func.id
+                    if nm not in local and nm not in fn.params:
+                        target = self._scoped_symbol(fn.qname, nm)
                 if target is not None:
                     if target in self.symbols:
                         fn.calls.append(target)
@@ -696,6 +936,20 @@ class Project:
             ):
                 store_counts[node.id] = store_counts.get(node.id, 0) + 1
         fn._rebound = {k for k, c in store_counts.items() if c > 1}
+        # unbounded-loop fact for the thread-lifecycle rule's bounded-
+        # worker heuristic (own statements only: a closure's loop runs
+        # on the closure's thread, not this one)
+        def _has_while(n) -> bool:
+            for child in ast.iter_child_nodes(n):
+                if isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    continue
+                if isinstance(child, ast.While) or _has_while(child):
+                    return True
+            return False
+
+        fn.has_while = _has_while(fn.node)
         # callee qnames appearing inside Return expressions, computed
         # once — the returns-digest fixpoint is a set lookup, not a
         # re-walk of the caller's AST per round
@@ -1116,6 +1370,145 @@ class Project:
             return self._attr_role_unique.get(attr) or _UNKNOWN_LOCK
         return None
 
+    def _spawn_scan(self, mod: ModuleInfo, fn: FunctionInfo, ci,
+                    types: dict, local: dict) -> dict:
+        """Classify every spawn/Thread/Timer/executor creation in this
+        function by what the caller does with the handle — bound to a
+        `self` attr, a local, a container append, returned/handed off,
+        or discarded — registering each as a spawn SITE for the thread-
+        lifecycle rule.  Returns the local-name -> entry-qname map the
+        HB walk uses to resolve `t.start()`/`t.join()`.
+
+        All three scans cover OWN statements only (``_own_nodes``): a
+        nested def's spawns/joins belong to the closure's own scan — a
+        closure-local ``t`` leaking into the parent's map would let an
+        unrelated parent variable of the same name fabricate HB
+        edges."""
+        parent: dict[int, object] = {}
+        for node in ast.walk(fn.node):
+            for child in ast.iter_child_nodes(node):
+                parent[id(child)] = node
+        returned: set[str] = set()
+        attr_of_local: dict[str, tuple] = {}
+        for node in _own_nodes(fn.node):
+            if isinstance(node, ast.Return) and isinstance(
+                node.value, ast.Name
+            ):
+                returned.add(node.value.id)
+            elif isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Name
+            ):
+                for t in node.targets:
+                    if (
+                        isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"
+                        and ci is not None
+                    ):
+                        attr_of_local[node.value.id] = (ci.qname, t.attr)
+        local_spawn: dict[str, str | None] = {}
+        for node in _own_nodes(fn.node):
+            if not isinstance(node, ast.Call):
+                continue
+            target = self._resolve_expr(
+                mod, node.func, fn.cls, local, types
+            )
+            api = self._spawn_api(target)
+            if api is None:
+                continue
+            key = (mod.rel, node.lineno, node.col_offset)
+            entry = None
+            if api != "executor":
+                entry = self._spawn_entry(
+                    mod, node, fn.cls, local, types, scope=fn.qname
+                )
+                if entry is not None:
+                    # a spawn site inside a loop creates N concurrent
+                    # instances of one entry: never thread-confined
+                    anc = parent.get(id(node))
+                    while anc is not None and anc is not fn.node:
+                        if isinstance(
+                            anc, (ast.For, ast.AsyncFor, ast.While)
+                        ):
+                            self._multi_entries.add(entry)
+                            break
+                        anc = parent.get(id(anc))
+            binding: tuple = ("discard",)
+            p = parent.get(id(node))
+            # unwrap `spawn(...).start()` chains — the binding is
+            # decided by what happens to the chain's result
+            if isinstance(p, ast.Attribute) and p.attr == "start":
+                pc = parent.get(id(p))
+                if isinstance(pc, ast.Call):
+                    p = parent.get(id(pc))
+            if isinstance(p, (ast.List, ast.Tuple)):
+                p = parent.get(id(p))
+            if isinstance(p, ast.Assign):
+                for t in p.targets:
+                    if isinstance(t, ast.Name):
+                        if binding[0] == "discard":
+                            binding = ("local", t.id)
+                        if api != "executor":
+                            local_spawn[t.id] = entry
+                    elif (
+                        isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"
+                        and ci is not None
+                    ):
+                        binding = ("attr", ci.qname, t.attr)
+            elif (
+                isinstance(p, ast.Call)
+                and isinstance(p.func, ast.Attribute)
+                and p.func.attr == "append"
+            ):
+                base = p.func.value
+                if (
+                    isinstance(base, ast.Attribute)
+                    and isinstance(base.value, ast.Name)
+                    and base.value.id == "self"
+                    and ci is not None
+                ):
+                    binding = ("attr", ci.qname, base.attr)
+                elif isinstance(base, ast.Name):
+                    # `threads.append(spawn(...))`: the LOCAL list owns
+                    # the handle — a `for t in threads: t.join()` loop
+                    # satisfies it via loop_attr
+                    binding = ("local", base.id)
+                else:
+                    # appended into a container someone else owns:
+                    # ownership transfers with the reference, like the
+                    # generic call-argument case below
+                    binding = ("returned",)
+            elif isinstance(p, ast.Return):
+                binding = ("returned",)
+            elif isinstance(p, (ast.Call, ast.keyword)):
+                # handed to another callable: ownership transfers with
+                # the reference — the receiver owns the stop path
+                binding = ("returned",)
+            if binding[0] == "local" and binding[1] in returned:
+                binding = ("returned",)
+            elif binding[0] == "local" and binding[1] in attr_of_local:
+                # `t = spawn(...); ...; self._thr = t`: the attr owns it
+                binding = ("attr",) + attr_of_local[binding[1]]
+            if key in self._spawn_seen:
+                continue  # parent already registered this closure site
+            self._spawn_seen.add(key)
+            self.spawn_sites.append({
+                "rel": mod.rel, "line": node.lineno, "fn": fn.qname,
+                "entry": entry, "api": api,
+                "kind": self._spawn_kind(target, node),
+                # seam spawns register with threadwatch; raw
+                # threading.Thread/Timer objects are invisible to
+                # drain_threads, so the drain join edge must not
+                # cover them
+                "seam": target not in (
+                    "threading.Thread", "threading.Timer"
+                ),
+                "binding": binding,
+            })
+        return local_spawn
+
     def _lockset_pass_all(self) -> None:
         for mod in self.modules.values():
             for fn in mod.functions:
@@ -1132,8 +1525,104 @@ class Project:
         ci = self.classes.get(f"{mod.dotted}.{fn.cls}") if fn.cls else None
         types = getattr(fn, "_types", {})
         local = getattr(fn, "_local_bindings", {})
+        local_spawn = self._spawn_scan(mod, fn, ci, types, local)
+        # local events/queues are shared with closures: a closure's
+        # lookup walks the ENCLOSING scopes' maps (parents are
+        # processed first — mod.functions is registration order), but
+        # each function REGISTERS into its own map with a token keyed
+        # by its own qname, so same-named locals in sibling closures
+        # stay distinct objects instead of unifying into one token
+        lsync = self._fn_local_sync.setdefault(fn.qname, {})
+
+        def _lookup_local_sync(name):
+            scope = fn.qname
+            while True:
+                ent = self._fn_local_sync.get(scope, {}).get(name)
+                if ent is not None:
+                    return ent
+                if ".<locals>." not in scope:
+                    return None
+                scope = scope.rsplit(".<locals>.", 1)[0]
+        # loop var -> (owner qname, attr) when iterating a self/typed
+        # container field (`for t in self._threads: t.join()`)
+        loop_attr: dict[str, tuple] = {}
         held: list[str] = []
         seen_access: set = set()
+
+        def sync_token(expr):
+            """(kind, token) for an event/queue-valued expression, or
+            None when it is not a known synchronization object."""
+            if isinstance(expr, ast.Attribute) and isinstance(
+                expr.value, ast.Name
+            ):
+                base = expr.value.id
+                owner = None
+                if base == "self" and ci is not None:
+                    owner = ci
+                elif base in types:
+                    owner = self.classes.get(types[base])
+                if owner is not None:
+                    k = owner.sync_types.get(expr.attr)
+                    if k is not None:
+                        return k, f"{owner.qname}.{expr.attr}"
+            elif isinstance(expr, ast.Name):
+                ent = _lookup_local_sync(expr.id)
+                if ent is not None:
+                    return ent
+            return None
+
+        _NOSPAWN = ("<nospawn>",)
+
+        def spawn_subject(expr):
+            """The entry qname behind a `<subject>.start()/join()` —
+            None when the subject IS a spawned thread whose entry did
+            not resolve, _NOSPAWN when it is not a thread at all."""
+            if isinstance(expr, ast.Call):
+                t_ = self._resolve_expr(mod, expr.func, fn.cls, local,
+                                        types)
+                if self._spawn_api(t_) in ("thread", "timer"):
+                    return self._spawn_entry(
+                        mod, expr, fn.cls, local, types, scope=fn.qname
+                    )
+                return _NOSPAWN
+            if isinstance(expr, ast.Name):
+                if expr.id in local_spawn:
+                    return local_spawn[expr.id]
+                return _NOSPAWN
+            if isinstance(expr, ast.Attribute) and isinstance(
+                expr.value, ast.Name
+            ):
+                owner = None
+                if expr.value.id == "self" and ci is not None:
+                    owner = ci
+                elif expr.value.id in types:
+                    owner = self.classes.get(types[expr.value.id])
+                if owner is not None and expr.attr in owner.spawn_attrs:
+                    return owner.spawn_attrs[expr.attr]
+            return _NOSPAWN
+
+        def record_stop_path(base, into: set) -> None:
+            """A join/cancel/shutdown observed on `base`: remember the
+            (owner, attr) — and the conservative by-name fallback — so
+            the lifecycle rule accepts the binding as managed."""
+            if isinstance(base, ast.Attribute) and isinstance(
+                base.value, ast.Name
+            ):
+                owner = None
+                if base.value.id == "self" and ci is not None:
+                    owner = ci.qname
+                elif base.value.id in types:
+                    owner = types[base.value.id]
+                into.add((owner, base.attr))
+                into.add((None, base.attr))
+            elif isinstance(base, ast.Name):
+                la = loop_attr.get(base.id)
+                if la is not None:
+                    into.add(la)
+                    into.add((None, la[1]))
+                # a bare name: local (same-function) management, or the
+                # `global _pool` singleton pattern
+                into.add((None, base.id))
 
         def note_field(owner: ClassInfo | None, attr: str, kind: str,
                        line: int) -> None:
@@ -1182,18 +1671,31 @@ class Project:
             seen_access.add(key)
             fn.accesses.append((q, kind, node.lineno, frozenset(held)))
 
-        def entry(reason: str, expr) -> None:
+        def entry(reason: str, expr) -> str | None:
             # a bare name may be a locally-defined function (the
             # committer's commit_loop): its symbol lives under this
-            # function's `<locals>` scope, not the module scope
+            # function's `<locals>` scope — or an enclosing one when a
+            # closure spawns a sibling closure
+            q = None
             if isinstance(expr, ast.Name):
-                scoped = f"{fn.qname}.<locals>.{expr.id}"
-                if scoped in self.symbols:
-                    self.thread_entries.setdefault(scoped, reason)
-                    return
-            q = self._resolve_expr(mod, expr, fn.cls, local, types)
-            if q is not None and q in self.symbols:
-                self.thread_entries.setdefault(q, reason)
+                q = self._scoped_symbol(fn.qname, expr.id)
+            if q is None:
+                q = self._resolve_expr(mod, expr, fn.cls, local, types)
+                if q is not None and q not in self.symbols:
+                    q = None
+            if q is None:
+                return None
+            self.thread_entries.setdefault(q, reason)
+            # entries that run as MANY concurrent instances of one
+            # qname (pool chunks, executor jobs, RPC/gossip handlers)
+            # must never count as "the same thread" in the HB order
+            # check — two sibling chunks share a domain but race
+            if (
+                reason in ("pool chunk", "executor submission")
+                or reason.endswith("() handler")
+            ):
+                self._multi_entries.add(q)
+            return q
 
         def handle_call(node: ast.Call) -> None:
             q = self.call_resolutions.get(
@@ -1217,6 +1719,17 @@ class Project:
                         entry("timer callback", kw.value)
                 if len(node.args) >= 2:
                     entry("timer callback", node.args[1])
+            elif target in _RUN_CHUNKED_FNS and node.args:
+                # run_chunked is a synchronous fan-out: the chunk fn is
+                # a thread entry, and the call line is both the start
+                # edge (prior writes published to workers) and the join
+                # edge (worker writes published back on return)
+                eq = entry("pool chunk", node.args[0])
+                if eq is not None:
+                    fn.hb_starts.append((eq, node.lineno))
+                    fn.hb_joins.append((eq, node.lineno))
+            elif target in _DRAIN_FNS:
+                fn.hb_joins.append(("*", node.lineno))
             elif isinstance(node.func, ast.Attribute):
                 if node.func.attr in _SUBMIT_ATTRS and node.args:
                     entry("executor submission", node.args[0])
@@ -1224,6 +1737,60 @@ class Project:
                     for arg in node.args:
                         if isinstance(arg, (ast.Attribute, ast.Name)):
                             entry(f".{node.func.attr}() handler", arg)
+            if target == _CLOCKSKEW_WAIT and node.args:
+                st = sync_token(node.args[0])
+                if st is not None and st[0] == "event":
+                    fn.hb_acq.append(
+                        (st[1], node.lineno, frozenset(held))
+                    )
+                fn.stop_probe = True
+            f_ = node.func
+            if not isinstance(f_, ast.Attribute):
+                return
+            a_ = f_.attr
+            if a_ == "start":
+                se = spawn_subject(f_.value)
+                if se != _NOSPAWN:
+                    fn.hb_starts.append((se, node.lineno))
+            elif a_ in ("join", "cancel"):
+                se = spawn_subject(f_.value)
+                if se is not None and se != _NOSPAWN:
+                    # an UNRESOLVED spawned subject (se is None)
+                    # contributes no HB edge: joining one unknown
+                    # thread proves nothing about any particular entry
+                    fn.hb_joins.append((se, node.lineno))
+                record_stop_path(f_.value, self._attr_joins)
+            elif a_ == "shutdown":
+                record_stop_path(f_.value, self._attr_shutdowns)
+            elif a_ in (
+                "set", "clear", "wait", "is_set",
+                "put", "put_nowait", "get", "get_nowait",
+            ):
+                st = sync_token(f_.value)
+                if st is not None:
+                    k_, tok = st
+                    entry_rec = (tok, node.lineno, frozenset(held))
+                    if k_ == "event":
+                        if a_ == "set":
+                            fn.hb_rel.append(entry_rec)
+                        elif a_ == "clear":
+                            fn.hb_clears.append(entry_rec)
+                        elif a_ == "wait":
+                            fn.hb_acq.append(entry_rec)
+                            fn.stop_probe = True
+                        else:  # is_set
+                            fn.stop_probe = True
+                    else:  # queue
+                        if a_ in ("put", "put_nowait"):
+                            fn.hb_rel.append(entry_rec)
+                        elif a_ in ("get", "get_nowait"):
+                            fn.hb_acq.append(entry_rec)
+                            fn.stop_probe = True
+                elif a_ in ("wait", "is_set"):
+                    # a wait/is_set on something we cannot type is
+                    # still a stop-signal probe for the lifecycle rule
+                    # (loose on purpose: false negatives only)
+                    fn.stop_probe = True
 
         def scan_expr(expr) -> None:
             if expr is None:
@@ -1285,12 +1852,62 @@ class Project:
                             getattr(fn, "_rebound", ()),
                         )
                         if role is not None:
+                            # the static acquisition-order graph: every
+                            # role already held orders before the one
+                            # being acquired (UNKNOWN contributes no
+                            # edges — it has no runtime counterpart)
+                            if role != _UNKNOWN_LOCK:
+                                for h in held:
+                                    if h != role and h != _UNKNOWN_LOCK:
+                                        self.lock_order_edges.setdefault(
+                                            (h, role), []
+                                        ).append((mod.rel, stmt.lineno))
+                                fn.lock_acquires.append((
+                                    role,
+                                    frozenset(
+                                        h for h in held
+                                        if h != _UNKNOWN_LOCK
+                                    ),
+                                    stmt.lineno,
+                                ))
                             held.append(role)
                             pushed += 1
                     walk(stmt.body)
                     for _ in range(pushed):
                         held.pop()
                 elif isinstance(stmt, ast.Assign):
+                    if isinstance(stmt.value, ast.Call):
+                        # local Event/Queue ctors register as sync
+                        # objects shared with this function's closures
+                        t_ = self._resolve_expr(
+                            mod, stmt.value.func, fn.cls, local, types
+                        )
+                        k_ = (
+                            "event" if t_ in _EVENT_CTOR_FNS
+                            else "queue" if t_ in _QUEUE_CTOR_FNS
+                            else None
+                        )
+                        if k_ is not None:
+                            for t in stmt.targets:
+                                if isinstance(t, ast.Name):
+                                    lsync[t.id] = (
+                                        k_, f"{fn.qname}::{t.id}"
+                                    )
+                    elif isinstance(stmt.value, ast.Name) and (
+                        stmt.value.id in local_spawn
+                    ):
+                        # `self._thr = t` after `t = spawn_thread(...)`:
+                        # the attr inherits the spawn binding
+                        for t in stmt.targets:
+                            if (
+                                isinstance(t, ast.Attribute)
+                                and isinstance(t.value, ast.Name)
+                                and t.value.id == "self"
+                                and ci is not None
+                            ):
+                                ci.spawn_attrs.setdefault(
+                                    t.attr, local_spawn[stmt.value.id]
+                                )
                     scan_expr(stmt.value)
                     for t in stmt.targets:
                         note_target(t)
@@ -1301,6 +1918,33 @@ class Project:
                     scan_expr(stmt.value)
                     note_target(stmt.target)
                 elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                    it = stmt.iter
+                    if (
+                        isinstance(it, ast.Call)
+                        and isinstance(it.func, ast.Name)
+                        and it.func.id in ("list", "tuple")
+                        and len(it.args) == 1
+                    ):
+                        it = it.args[0]
+                    if (
+                        isinstance(stmt.target, ast.Name)
+                        and isinstance(it, ast.Attribute)
+                        and isinstance(it.value, ast.Name)
+                    ):
+                        owner = None
+                        if it.value.id == "self" and ci is not None:
+                            owner = ci.qname
+                        elif it.value.id in types:
+                            owner = types[it.value.id]
+                        if owner is not None:
+                            loop_attr[stmt.target.id] = (owner, it.attr)
+                    elif isinstance(stmt.target, ast.Name) and isinstance(
+                        it, ast.Name
+                    ):
+                        # `for t in threads:` over a LOCAL container —
+                        # joins on the loop var satisfy a ('local',
+                        # 'threads') spawn binding
+                        loop_attr[stmt.target.id] = (None, it.id)
                     scan_expr(stmt.iter)
                     note_target(stmt.target)
                     walk(stmt.body)
@@ -1321,6 +1965,46 @@ class Project:
                             scan_expr(child)
 
         walk(fn.node.body)
+
+    def _interproc_lock_edges(self) -> None:
+        """Extend the static acquisition-order graph across call
+        boundaries: a MAY-held set (union over every incoming call
+        path — the graph must be a superset of anything runtime
+        lockwatch can observe, or the runtime-⊆-static contract breaks)
+        flows down the call graph, and every recorded acquisition
+        orders each may-held role before itself.  Relaxed-profile
+        callers (tests/scripts) do not contribute: a fixture lock held
+        around a production call must not become a tree-wide ordering
+        edge."""
+        may: dict[str, frozenset] = {q: frozenset() for q in self.symbols}
+        for _ in range(_MAX_ROUNDS * 4):
+            changed = False
+            for q, fn in self.symbols.items():
+                if fn.rel.startswith(("tests/", "scripts/")):
+                    continue
+                for callee, heldset in fn.call_locks:
+                    if callee not in may:
+                        continue
+                    add = (may[q] | heldset) - {_UNKNOWN_LOCK}
+                    if not add <= may[callee]:
+                        may[callee] = may[callee] | add
+                        changed = True
+            if not changed:
+                break
+        for q, fn in self.symbols.items():
+            amb = may.get(q)
+            if not amb:
+                continue
+            for role, _heldb4, line in fn.lock_acquires:
+                for h in amb:
+                    if h != role:
+                        self.lock_order_edges.setdefault(
+                            (h, role), []
+                        ).append((fn.rel, line))
+        for k in list(self.lock_order_edges):
+            self.lock_order_edges[k] = sorted(
+                set(self.lock_order_edges[k])
+            )
 
     def _racecheck(self) -> None:
         # incoming call edges annotated with the caller's held lockset
@@ -1379,7 +2063,111 @@ class Project:
                         changed = True
             if not changed:
                 break
-        # guarded-by map: reviewed declarations first, majority next
+        # entry SETS (union, unlike the tctx meet): which thread
+        # entries can reach each function — the happens-before pass
+        # reasons about WHO runs an access, not just whether someone
+        # does
+        entry_sets: dict[str, frozenset] = {
+            q: frozenset({q}) for q in self.thread_entries
+        }
+        for _ in range(_MAX_ROUNDS * 4):
+            changed = False
+            for q, fn in list(self.symbols.items()):
+                es = entry_sets.get(q)
+                if es is None:
+                    continue
+                for callee, _h in fn.call_locks:
+                    if callee not in self.symbols:
+                        continue
+                    cur = entry_sets.get(callee, frozenset())
+                    if not es <= cur:
+                        entry_sets[callee] = cur | es
+                        changed = True
+            if not changed:
+                break
+        self._entry_sets = entry_sets
+
+        # -- happens-before machinery (v4) ---------------------------------
+
+        def _site_tokens(fn: FunctionInfo, line: int):
+            """(acquire, release) HB tokens positioned around `line` in
+            `fn`: joins/waits/gets BEFORE it order earlier work in,
+            starts/sets/puts AFTER it order this work out."""
+            acq = set()
+            rel = set()
+            for e, l in fn.hb_joins:
+                if l < line:
+                    acq.add(("join", e))
+            for tok, l, _h in fn.hb_acq:
+                if l < line:
+                    acq.add(("sync", tok))
+            for e, l in fn.hb_starts:
+                if l > line and e is not None:
+                    rel.add(("start", e))
+            for tok, l, _h in fn.hb_rel:
+                if l > line:
+                    rel.add(("sync", tok))
+            return acq, rel
+
+        multi = self._multi_entries
+        # the drain_threads wildcard join only covers entries that
+        # register with threadwatch as kind="worker" at EVERY spawn
+        # site — drain_threads(kinds=("worker",)) joins exactly those;
+        # services keep running and raw Thread/Timer objects are
+        # invisible to the registry
+        entry_spawns: dict[str, list] = {}
+        for s in self.spawn_sites:
+            if s["entry"] is not None:
+                entry_spawns.setdefault(s["entry"], []).append(s)
+        drained = {
+            e for e, ss in entry_spawns.items()
+            if all(s["seam"] and s["kind"] == "worker" for s in ss)
+        }
+
+        def _same_thread(da, db) -> bool:
+            """Both main, or the same SINGLE-instance entry — an entry
+            that runs as many concurrent threads (pool chunks, executor
+            jobs, handlers, loop-spawned workers) shares a domain
+            across racing instances and proves nothing."""
+            return da == db and len(da) <= 1 and not (da & multi)
+
+        def _joined(e, acq) -> bool:
+            return ("join", e) in acq or (
+                ("join", "*") in acq and e in drained
+            )
+
+        def _ordered(a, b) -> bool:
+            """True when the two (domain, acq, rel) access profiles are
+            sequenced by a happens-before edge: same single thread,
+            thread start (a precedes every entry b runs under),
+            join/drain (every entry b runs under completed before a),
+            or a matching Event set→wait / Queue put→get publication
+            pair."""
+            da, acqa, rela = a
+            db, acqb, relb = b
+            if _same_thread(da, db):
+                return True
+            if db and all(("start", e) in rela for e in db):
+                return True
+            if da and all(("start", e) in relb for e in da):
+                return True
+            if db and all(_joined(e, acqa) for e in db):
+                return True
+            if da and all(_joined(e, acqb) for e in da):
+                return True
+            if {t for k, t in rela if k == "sync"} & {
+                t for k, t in acqb if k == "sync"
+            }:
+                return True
+            if {t for k, t in relb if k == "sync"} & {
+                t for k, t in acqa if k == "sync"
+            }:
+                return True
+            return False
+
+        # guarded-by map: reviewed declarations first, majority next —
+        # both rebuilt UNDER happens-before: ordered accesses neither
+        # need a guard nor vote in the inference
         sites: dict[str, list] = {}
         for fn in self.symbols.values():
             amb = ambient.get(fn.qname) or frozenset()
@@ -1387,21 +2175,106 @@ class Project:
                 sites.setdefault(field, []).append(
                     (fn, kind, line, amb | heldset)
                 )
+        field_profs: dict[str, list] = {}
+        for field, ss in sites.items():
+            profs = []
+            for fn, kind, line, ls in ss:
+                acq, rel = _site_tokens(fn, line)
+                profs.append({
+                    "fn": fn, "kind": kind, "line": line, "ls": ls,
+                    "dom": entry_sets.get(fn.qname, frozenset()),
+                    "acq": acq, "rel": rel, "safe": False,
+                })
+            # pairwise pruning: an access ordered against every
+            # counterpart write (and, for a write, every counterpart
+            # access) cannot race.  `checked` guards the vacuous case —
+            # an access with NO counterpart pair (a lone write, a read
+            # with no writes) is not "proven" anything and must not
+            # override a declared guard
+            for i, a in enumerate(profs):
+                ok = True
+                checked = False
+                for j, b in enumerate(profs):
+                    if i == j:
+                        continue
+                    if a["kind"] != "write" and b["kind"] != "write":
+                        continue
+                    checked = True
+                    if not _ordered(
+                        (a["dom"], a["acq"], a["rel"]),
+                        (b["dom"], b["acq"], b["rel"]),
+                    ):
+                        ok = False
+                        break
+                if ok and checked:
+                    a["safe"] = True
+                    self.hb_safe_sites.add(
+                        (field, a["kind"], a["line"], a["fn"].qname)
+                    )
+            field_profs[field] = profs
         self.guard_map = {}
-        for field, ss in sorted(sites.items()):
+        for field, profs in sorted(field_profs.items()):
+            n_sites = len(profs)
+            n_safe = sum(1 for p in profs if p["safe"])
+            has_write = any(p["kind"] == "write" for p in profs)
             declared = self.declared_guards.get(field)
             if declared is not None:
-                self.guard_map[field] = {
+                held_n = sum(1 for p in profs if declared in p["ls"])
+                g = {
                     "guard": declared, "source": "declared",
-                    "sites": len(ss),
-                    "held": sum(
-                        1 for _, _, _, ls in ss if declared in ls
-                    ),
+                    "sites": n_sites, "held": held_n,
+                }
+                if n_safe:
+                    g["hb_safe"] = n_safe
+                threaded = any(p["dom"] for p in profs)
+                if (
+                    threaded
+                    and n_safe == n_sites
+                    and has_write
+                    and held_n < n_sites
+                ):
+                    # every access is HB-ordered yet the declaration
+                    # still demands a lock somewhere it is not held:
+                    # racecheck can never fire for this field again, so
+                    # the guards.py entry is dead weight to remove.
+                    # `threaded` gates the call: when NO access is
+                    # thread-entry-reachable the pairwise proof is
+                    # vacuous (the analyzer simply cannot see the
+                    # threads, e.g. a commit path reached through
+                    # unresolvable indirection) and the declaration
+                    # stays as the reviewed contract
+                    g["stale"] = True
+                    first = min(
+                        profs, key=lambda p: (p["fn"].rel, p["line"])
+                    )
+                    self.stale_guard_flows.append(TaintFlow(
+                        rel=first["fn"].rel, line=first["line"],
+                        message=(
+                            f"declared guard {declared!r} on {field} "
+                            "is stale: every access is ordered by "
+                            "happens-before edges (spawn/join/Event/"
+                            "Queue publication) — remove the guards.py "
+                            "declaration"
+                        ),
+                    ))
+                self.guard_map[field] = g
+                continue
+            if not has_write:
+                continue  # never mutated post-init: cannot race
+            if n_safe == n_sites:
+                # fully publication-ordered: no guard needed — named in
+                # the artifact so reviewers see why no inference ran
+                self.guard_map[field] = {
+                    "guard": None, "source": "hb-publish",
+                    "sites": n_sites, "held": 0, "hb_safe": n_safe,
                 }
                 continue
-            if not any(kind == "write" for _, kind, _, _ in ss):
-                continue  # never mutated post-init: cannot race
-            counted = [ls for _, _, _, ls in ss if _UNKNOWN_LOCK not in ls]
+            # HB-safe sites are exempt from EMISSION but still vote in
+            # the inference: a lock-free-but-published reader must not
+            # dissolve the majority its locked siblings establish
+            counted = [
+                p["ls"] for p in profs if _UNKNOWN_LOCK not in p["ls"]
+            ]
             if len(counted) < 2:
                 continue
             tally: dict[str, int] = {}
@@ -1412,10 +2285,13 @@ class Project:
                 tally.items(), key=lambda kv: (-kv[1], kv[0])
             ):
                 if n >= 2 and n * 2 > len(counted):
-                    self.guard_map[field] = {
+                    g = {
                         "guard": role, "source": "inferred",
                         "sites": len(counted), "held": n,
                     }
+                    if n_safe:
+                        g["hb_safe"] = n_safe
+                    self.guard_map[field] = g
                 break  # only the top role can hold a majority
         # declared guards with no observed sites still surface in the
         # artifact so a stale declaration is visible to reviewers
@@ -1424,7 +2300,8 @@ class Project:
                 "guard": role, "source": "declared", "sites": 0, "held": 0,
             })
         # emission: thread-reachable accesses whose lockset misses the
-        # field's guard
+        # field's guard — unless a happens-before edge from every
+        # writer already orders the access
         seen: set = set()
         for fn in self.symbols.values():
             T = tctx.get(fn.qname)
@@ -1432,7 +2309,9 @@ class Project:
                 continue
             for field, kind, line, heldset in fn.accesses:
                 g = self.guard_map.get(field)
-                if g is None or not g["sites"]:
+                if g is None or not g["sites"] or g.get("guard") is None:
+                    continue
+                if (field, kind, line, fn.qname) in self.hb_safe_sites:
                     continue
                 eff = T | heldset
                 if g["guard"] in eff or _UNKNOWN_LOCK in eff:
@@ -1453,9 +2332,203 @@ class Project:
                         "race"
                     ),
                 ))
+        # post-publication writes (v4): a write AFTER this function
+        # started a thread that accesses the same field races with it
+        # unless a lock or a later publication edge covers the pair —
+        # the spawner is concurrent with its target from start() on,
+        # whether or not the spawner is itself thread-reachable
+        for field, profs in sorted(field_profs.items()):
+            for a in profs:
+                if a["kind"] != "write" or a["safe"]:
+                    continue
+                if _UNKNOWN_LOCK in a["ls"]:
+                    continue
+                starts = {
+                    e for e, l in a["fn"].hb_starts
+                    if e is not None and l < a["line"]
+                }
+                if not starts:
+                    continue
+                for b in profs:
+                    if b is a:
+                        continue
+                    common = b["dom"] & starts
+                    if not common or _UNKNOWN_LOCK in b["ls"]:
+                        continue
+                    if a["ls"] & b["ls"]:
+                        continue  # mutual exclusion covers the pair
+                    if _ordered(
+                        (a["dom"], a["acq"], a["rel"]),
+                        (b["dom"], b["acq"], b["rel"]),
+                    ):
+                        continue
+                    key = (a["fn"].rel, a["line"])
+                    if key in seen:
+                        break
+                    seen.add(key)
+                    e = sorted(common)[0]
+                    self.race_flows.append(TaintFlow(
+                        rel=a["fn"].rel, line=a["line"],
+                        message=(
+                            f"write of {field} races past its "
+                            f"publication point: {e} was started "
+                            "earlier in this function and "
+                            f"{b['kind']}s the field at "
+                            f"{b['fn'].rel}:{b['line']} — move the "
+                            "write before start(), hold a common lock "
+                            "on both sides, or publish it through an "
+                            "Event/Queue edge"
+                        ),
+                    ))
+                    break
+        # shared-Event re-arm (v4): clear() re-arms a waiter contract;
+        # doing it concurrently with another thread's set()/clear()
+        # loses wakeups (the deliver-client wedge class) — flag unless
+        # a common lock or an HB edge sequences the pair
+        clear_map: dict[str, list] = {}
+        rel_map: dict[str, list] = {}
+        for fn in self.symbols.values():
+            dom = entry_sets.get(fn.qname, frozenset())
+            for tok, line, heldset in fn.hb_clears:
+                clear_map.setdefault(tok, []).append(
+                    (fn, line, heldset, dom)
+                )
+            for tok, line, heldset in fn.hb_rel:
+                rel_map.setdefault(tok, []).append(
+                    (fn, line, heldset, dom)
+                )
+        for tok, clears in sorted(clear_map.items()):
+            counters = rel_map.get(tok, []) + clears
+            for cfn, cline, cheld, cdom in clears:
+                for sfn, sline, sheld, sdom in counters:
+                    if (sfn.qname, sline) == (cfn.qname, cline):
+                        continue
+                    if _same_thread(cdom, sdom):
+                        continue
+                    if cheld & sheld:
+                        continue
+                    if _UNKNOWN_LOCK in cheld or _UNKNOWN_LOCK in sheld:
+                        continue
+                    ca, cr = _site_tokens(cfn, cline)
+                    sa, sr = _site_tokens(sfn, sline)
+                    if _ordered((cdom, ca, cr), (sdom, sa, sr)):
+                        continue
+                    key = (cfn.rel, cline)
+                    if key in seen:
+                        break
+                    seen.add(key)
+                    self.race_flows.append(TaintFlow(
+                        rel=cfn.rel, line=cline,
+                        message=(
+                            f"re-arming shared Event {tok} (clear) "
+                            "races with its set()/clear() at "
+                            f"{sfn.rel}:{sline} on a different thread "
+                            "— a waiter can miss the set entirely; "
+                            "use a fresh per-generation Event instead "
+                            "of re-arming, or hold one lock around "
+                            "both sides"
+                        ),
+                    ))
+                    break
         self.race_flows.sort(key=lambda f: (f.rel, f.line))
 
+    def _lifecycle(self) -> None:
+        """Thread-lifecycle reachability (v4): every spawn_thread/
+        spawn_timer/Thread/Timer/executor registration needs a
+        statically findable stop path — a join()/cancel()/shutdown()
+        on whatever holds the handle, a stop-signal loop in the
+        spawned entry (Event wait/is_set, queue get, clockskew.wait),
+        or a provably bounded worker body.  A handle that is returned
+        or passed onward transfers ownership with the reference."""
+        # stop-probe reachability as a call-graph FIXPOINT (a DFS with
+        # a memoized-False cycle guard poisons members of a cycle that
+        # only reach their probe through the in-progress node)
+        can_stop = {
+            q for q, fn in self.symbols.items() if fn.stop_probe
+        }
+        changed = True
+        while changed:
+            changed = False
+            for q, fn in self.symbols.items():
+                if q not in can_stop and any(
+                    c in can_stop for c in fn.calls
+                ):
+                    can_stop.add(q)
+                    changed = True
+
+        def probe(q: str) -> bool:
+            return q in can_stop
+
+        for site in self.spawn_sites:
+            api = site["api"]
+            binding = site["binding"]
+            entry = site["entry"]
+            stops = (
+                self._attr_shutdowns if api == "executor"
+                else self._attr_joins
+            )
+            ok = False
+            if binding[0] == "returned":
+                ok = True
+            elif binding[0] == "attr":
+                ok = (
+                    (binding[1], binding[2]) in stops
+                    or (None, binding[2]) in stops
+                )
+            elif binding[0] == "local":
+                ok = (None, binding[1]) in stops
+            if not ok and api != "executor" and entry is not None:
+                ok = probe(entry)
+                if not ok and site["kind"] == "worker":
+                    # a worker whose body provably terminates (no
+                    # unbounded loop) drains on its own; the session
+                    # threadwatch gate covers the long tail
+                    efn = self.symbols.get(entry)
+                    ok = efn is not None and not efn.has_while
+            if ok:
+                continue
+            what = (
+                f"its entry {entry} never blocks on a stop signal "
+                "(Event wait/is_set, queue get)"
+                if entry is not None
+                else "its target does not resolve statically"
+            )
+            self.lifecycle_flows.append(TaintFlow(
+                rel=site["rel"], line=site["line"],
+                message=(
+                    f"{api} spawned here (kind={site['kind']}) has no "
+                    "statically reachable stop/join path: nothing "
+                    "join()s/cancel()s/shutdown()s its handle, and "
+                    f"{what} — keep the handle and join/cancel it on "
+                    "the owner's stop path, loop on a stop Event, or "
+                    "pragma a reviewed exemption"
+                ),
+            ))
+        self.lifecycle_flows.sort(key=lambda f: (f.rel, f.line))
+
     # -- public API --------------------------------------------------------
+
+    def lock_graph(self, strict_only: bool = True) -> dict:
+        """The static role-level acquisition-order graph as a JSON-
+        shaped artifact: ``edges[src][dst]`` lists the [rel, line]
+        acquisition sites establishing src -> dst.  With
+        ``strict_only`` (the default, and what the CI artifact and the
+        runtime-⊆-static cross-check consume) only production sites
+        count — tests may nest fixture locks in orders production
+        never uses."""
+        edges: dict[str, dict] = {}
+        for (src, dst), site_list in sorted(self.lock_order_edges.items()):
+            kept = [
+                [rel, line] for rel, line in site_list
+                if not strict_only
+                or not rel.startswith(("tests/", "scripts/"))
+            ]
+            if kept:
+                edges.setdefault(src, {})[dst] = kept
+        roles = sorted(
+            set(edges) | {d for v in edges.values() for d in v}
+        )
+        return {"edges": edges, "roles": roles}
 
     def function(self, qname: str) -> FunctionInfo | None:
         return self.symbols.get(qname)

@@ -22,6 +22,15 @@ Fields NOT listed here still get a guard when a strict majority of
 their access sites hold one lock (see ``dataflow.Project._racecheck``);
 this table exists for the structures where "majority" is not a strong
 enough word for the invariant.
+
+Since fabriclint v4 the racecheck engine also models happens-before
+edges (thread start/join, Event set->wait, Queue put->get, workpool
+submit->result): a field whose every access is publication-ordered
+needs NO entry here (it resolves as ``hb-publish`` in the guard map),
+and an entry whose every access becomes HB-proven — with at least one
+access genuinely thread-reachable — is flagged STALE so this table
+only shrinks.  Declare a guard when the invariant is the reviewed
+contract (locks); let publication idioms be proven, not declared.
 """
 
 from __future__ import annotations

@@ -75,6 +75,46 @@ racecheck
     by=role)`` at the declared hot fields — tier-1 verifies the static
     guard map against what threads actually hold.
 
+    Since v4 ("hbcheck") the rule is HAPPENS-BEFORE aware: the engine
+    models synchronization edges — ``Thread.start()`` (pre-spawn writes
+    publish to the target), ``join()``/``drain_threads`` (the target's
+    writes publish to the joiner), ``Event.set()→wait()``,
+    ``Queue.put()→get()``, ``workpool.run_chunked`` submit→result —
+    and an access ordered against every counterpart write needs no
+    lock: it neither fires nor votes in guard inference (fields whose
+    every access is publication-ordered show as source ``hb-publish``
+    in the guard map).  Two NEW error classes ride the same machinery:
+    a write that races PAST its publication point (mutating a field
+    after ``start()`` that the spawned thread also touches, with no
+    common lock and no later edge), and re-arming a shared ``Event``
+    (``clear()``) concurrently with another thread's ``set()``/
+    ``clear()`` — the lost-wakeup class behind the PR 11 deliver-client
+    wedge.  A declared guards.py entry whose every access is HB-proven
+    (with at least one access actually thread-reachable) is flagged
+    STALE so the reviewed table only shrinks.
+
+lock-order
+    (v4; whole tree) the static twin of lockwatch's runtime
+    ``LockOrderError``: the lockset pass records every lexical
+    acquisition with the roles already held, an interprocedural
+    MAY-held union extends the edges across resolvable call chains,
+    and any cycle in the resulting role-level acquisition-order graph
+    is an error (one finding per cycle).  The graph is exported as a
+    CI artifact (``scripts/lint.py --lockgraph-out``) and tier-1
+    cross-checks that every edge runtime lockwatch observes during a
+    live commit+snapshot session is present in it (runtime ⊆ static).
+
+thread-lifecycle
+    (v4; whole tree) every ``spawn_thread``/``spawn_timer``/``Thread``/
+    ``Timer``/executor registration needs a statically reachable stop
+    path: a ``join()``/``cancel()``/``shutdown()`` on whatever holds
+    the handle, a stop-signal loop in the spawned entry (``Event``
+    wait/is_set, queue get, ``clockskew.wait``), or a provably bounded
+    worker body (no unbounded loop).  A handle returned or handed to
+    another callable transfers ownership with the reference.  The
+    static rule fails the leak at REVIEW time; the runtime threadwatch
+    drain gate remains the interpreter-exit backstop.
+
 thread-hygiene
     No daemonized ``threading.Thread``/``Timer`` created outside the
     threadwatch seam (``devtools/lockwatch.spawn_thread``/
@@ -158,6 +198,8 @@ RULES = (
     "taint",
     "lock-discipline",
     "racecheck",
+    "lock-order",
+    "thread-lifecycle",
     "thread-hygiene",
     "jax-hygiene",
 )
@@ -215,10 +257,14 @@ class Profile:
 STRICT_PROFILE = Profile("strict")
 RELAXED_PROFILE = Profile(
     "relaxed",
-    # racecheck is off with determinism/taint: tests drive production
-    # objects from the pytest thread without the production locks by
-    # design, and fixtures seed deliberate races
-    disabled=("determinism", "taint", "jax-hygiene", "racecheck"),
+    # racecheck and its v4 siblings are off with determinism/taint:
+    # tests drive production objects from the pytest thread without
+    # the production locks by design, fixtures seed deliberate races
+    # and inversions, and test helpers manage thread lifecycles
+    # dynamically (start/join inline) in shapes the static rule need
+    # not model
+    disabled=("determinism", "taint", "jax-hygiene", "racecheck",
+              "lock-order", "thread-lifecycle"),
     advisory=("csp-seam",),
 )
 
@@ -952,6 +998,106 @@ def _interprocedural_csp_seam(
             )
 
 
+def _lock_order_cycles(graph: dict):
+    """Cycles in the static role-level acquisition-order graph
+    (``dataflow.Project.lock_graph()`` shape).  Yields ``(cycle_roles,
+    anchor_site)`` per strongly connected component with more than one
+    role: the cycle is a deterministic role path around the component,
+    the anchor the lexically-LAST acquisition site contributing to any
+    of its edges (in file order that is the cycle-closing side,
+    mirroring where runtime lockwatch would raise) — one finding per
+    deadlock class, not one per contributing line."""
+    edges = graph.get("edges", {})
+    adj = {s: sorted(d) for s, d in edges.items()}
+    # Tarjan SCC, iterative (the graph is tiny but recursion depth must
+    # not depend on lock count)
+    index: dict[str, int] = {}
+    low: dict[str, int] = {}
+    on: set[str] = set()
+    stack: list[str] = []
+    sccs: list[list[str]] = []
+    counter = [0]
+
+    def strongconnect(v0: str) -> None:
+        work = [(v0, iter(adj.get(v0, ())))]
+        index[v0] = low[v0] = counter[0]
+        counter[0] += 1
+        stack.append(v0)
+        on.add(v0)
+        while work:
+            v, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on.add(w)
+                    work.append((w, iter(adj.get(w, ()))))
+                    advanced = True
+                    break
+                if w in on:
+                    low[v] = min(low[v], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                pv = work[-1][0]
+                low[pv] = min(low[pv], low[v])
+            if low[v] == index[v]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on.discard(w)
+                    comp.append(w)
+                    if w == v:
+                        break
+                sccs.append(comp)
+
+    for v in sorted(set(adj) | {d for ds in adj.values() for d in ds}):
+        if v not in index:
+            strongconnect(v)
+    for comp in sccs:
+        if len(comp) < 2:
+            continue
+        comp_set = set(comp)
+        # a deterministic cycle path: BFS shortest walk from the min
+        # role back to itself inside the component
+        start = min(comp)
+        prev: dict[str, str] = {start: start}
+        queue = [start]
+        path = [start]
+        while queue:
+            node = queue.pop(0)
+            hit = False
+            for d in adj.get(node, ()):
+                if d not in comp_set:
+                    continue
+                if d == start and node != start:
+                    walk = [node]
+                    while walk[-1] != start:
+                        walk.append(prev[walk[-1]])
+                    path = list(reversed(walk))
+                    hit = True
+                    break
+                if d not in prev:
+                    prev[d] = node
+                    queue.append(d)
+            if hit:
+                break
+        # anchor at the lexically-LAST contributing acquisition — in
+        # file order that is the cycle-closing side, mirroring where
+        # runtime lockwatch would raise
+        anchor = max(
+            tuple(site)
+            for i, s in enumerate(path)
+            for site in edges.get(s, {}).get(
+                path[(i + 1) % len(path)], ()
+            )
+        )
+        yield path, anchor
+
+
 def lint_sources(
     sources: dict[str, str],
     allowlist: list[AllowEntry] | None = None,
@@ -1032,7 +1178,7 @@ def lint_sources(
                 rule="taint", path=flow.rel, line=flow.line,
                 message=flow.message,
             ))
-    for flow in project.race_flows:
+    for flow in project.race_flows + project.stale_guard_flows:
         st = states.get(flow.rel)
         if st is not None and not any(
             v.rule == "racecheck" and v.line == flow.line
@@ -1041,6 +1187,35 @@ def lint_sources(
             st.violations.append(Violation(
                 rule="racecheck", path=flow.rel, line=flow.line,
                 message=flow.message,
+            ))
+    for flow in project.lifecycle_flows:
+        st = states.get(flow.rel)
+        if st is not None and not any(
+            v.rule == "thread-lifecycle" and v.line == flow.line
+            for v in st.violations
+        ):
+            st.violations.append(Violation(
+                rule="thread-lifecycle", path=flow.rel, line=flow.line,
+                message=flow.message,
+            ))
+    # static lock-order cycles (v4): one violation per cycle, anchored
+    # at the lexically-last contributing acquisition (the cycle-closing
+    # side in file order)
+    for cycle, site in _lock_order_cycles(project.lock_graph()):
+        rel, line = site
+        st = states.get(rel)
+        if st is not None:
+            st.violations.append(Violation(
+                rule="lock-order", path=rel, line=line,
+                message=(
+                    "static lock-order cycle: "
+                    + " -> ".join(cycle + [cycle[0]])
+                    + " — a thread following one order and a thread "
+                    "following the other can deadlock (the static twin "
+                    "of lockwatch's runtime LockOrderError); pick one "
+                    "canonical order and restructure the off-order "
+                    "acquisition"
+                ),
             ))
 
     # profiles: drop disabled rules, downgrade advisory ones
@@ -1155,6 +1330,7 @@ class LintReport:
     # populated on a dataflow-cache hit (project is None then)
     cached_summaries: list | None = None
     cached_guards: dict | None = None
+    cached_lockgraph: dict | None = None
     cache_state: str = "off"  # "off" | "miss" | "hit"
 
     def function_summaries(self) -> list[dict]:
@@ -1170,6 +1346,14 @@ class LintReport:
         if self.project is not None:
             return dict(self.project.guard_map)
         return dict(self.cached_guards or {})
+
+    def lock_graph(self) -> dict:
+        """The static role-level acquisition-order graph (production
+        sites only — what the CI artifact and the runtime-⊆-static
+        cross-check consume), live or cached."""
+        if self.project is not None:
+            return self.project.lock_graph()
+        return dict(self.cached_lockgraph or {"edges": {}, "roles": []})
 
     @property
     def unsuppressed(self) -> list[Violation]:
@@ -1222,7 +1406,9 @@ class LintReport:
 # changes the key, which IS the per-file invalidation.
 
 _CACHE_DIR_NAME = ".fabriclint_cache"
-_CACHE_SCHEMA = 1
+# v4 (hbcheck): HB facts in the summaries/guard map + the lock-order
+# graph joined the cached report — a v3 cache entry must never serve
+_CACHE_SCHEMA = 2
 _CACHE_KEEP = 8
 _engine_fp_memo: list = []
 
@@ -1335,6 +1521,7 @@ def lint_tree(
                 project=None,
                 cached_summaries=entry["summaries"],
                 cached_guards=entry["guards"],
+                cached_lockgraph=entry["lockgraph"],
                 cache_state="hit",
             )
     report = lint_sources(sources, allowlist, used_entries)
@@ -1365,6 +1552,7 @@ def lint_tree(
             "violations": [v.to_dict() for v in report.violations],
             "summaries": report.function_summaries(),
             "guards": report.guard_map(),
+            "lockgraph": report.lock_graph(),
         })
         report.cache_state = "miss"
     return report
@@ -1451,6 +1639,11 @@ def main(argv=None) -> int:
              "as JSON and exit",
     )
     ap.add_argument(
+        "--lockgraph", action="store_true",
+        help="dump the static role-level lock acquisition-order graph "
+             "(production sites) as JSON and exit",
+    )
+    ap.add_argument(
         "--no-cache", action="store_true",
         help="skip the .fabriclint_cache dataflow cache (escape hatch)",
     )
@@ -1473,6 +1666,9 @@ def main(argv=None) -> int:
         return 0
     if args.guards:
         print(json.dumps(report.guard_map(), indent=2, sort_keys=True))
+        return 0
+    if args.lockgraph:
+        print(json.dumps(report.lock_graph(), indent=2, sort_keys=True))
         return 0
 
     shown = list(report.unsuppressed) + list(report.warnings)

@@ -1,14 +1,19 @@
 """Runtime lock-order + thread-lifecycle watchdog.
 
-fabriclint's static lock-order rule only sees LEXICALLY nested `with`
-blocks; real inversions usually span call chains (commit thread holds
-``commit_lock`` and enters the snapshot manager, an RPC thread holds the
-manager lock and enters the ledger).  This module closes that gap at
-runtime: production code creates its coordination locks through
+Since fabriclint v4 the STATIC lock-order rule covers call chains too
+(an interprocedural may-held graph; see ``dataflow.Project.lock_graph``
+and the ``lock-order`` rule), but it only sees statically resolvable
+calls — an acquisition reached through a callback or other unresolvable
+indirection still needs a runtime witness.  This module is that
+witness: production code creates its coordination locks through
 ``named_lock``/``named_rlock``, which return plain ``threading`` locks
 normally (zero overhead) and instrumented wrappers when
 ``FABRIC_TPU_LOCKWATCH`` is set (tests/conftest.py sets it, so the whole
-tier-1 suite doubles as a lock-order soak test).
+tier-1 suite doubles as a lock-order soak test).  The two graphs are
+tied together in tier-1: every edge this watchdog observes during a
+live commit+snapshot session must be present in the static graph
+(runtime ⊆ static, tests/test_lockwatch.py), so the static pass
+provably covers what tier-1 exercises.
 
 The wrapper maintains a process-wide acquisition-order graph over lock
 ROLES (names, not instances): acquiring B while holding A records the

@@ -316,6 +316,7 @@ class PeerNode:
         self.package_store = PackageStore(os.path.join(root_dir, "chaincodes"))
         self._txid = itertools.count()
         self.chaincodes: dict = {}
+        self._cc_streams: list = []
         self._launch_scc("qscc", QSCC(self._ledger_of))
         self._launch_scc(
             "cscc",
@@ -422,6 +423,9 @@ class PeerNode:
 
     def _launch_scc(self, name: str, cc) -> None:
         stream = InProcStream(self.support, cc, name)
+        # track BEFORE start/wait: a registration timeout must leave
+        # the stream stoppable by stop(), not leak its service threads
+        self._cc_streams.append(stream)
         stream.start()
         stream.wait_registered(self.support, name)
         self.chaincodes[name] = self._shim_adapter(name)
@@ -889,6 +893,8 @@ class PeerNode:
             self.gossip_comm.close()
         if self.operations is not None:
             self.operations.stop()
+        for stream in self._cc_streams:
+            stream.stop()
         for ch in self.channels.values():
             ch.stop()
 

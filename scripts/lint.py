@@ -58,6 +58,13 @@ def main() -> int:
              "artifact — reviewers diff guard inference across PRs",
     )
     ap.add_argument(
+        "--lockgraph-out", default=None, metavar="PATH",
+        help="write the static role-level lock acquisition-order graph "
+             "(production sites, edges[src][dst] -> [[file, line], ...]) "
+             "as a JSON artifact — the static twin of lockwatch's "
+             "runtime graph; tier-1 asserts runtime ⊆ static",
+    )
+    ap.add_argument(
         "--no-cache", action="store_true",
         help="bypass the .fabriclint_cache dataflow cache (escape "
              "hatch; the cache is keyed by file content hashes and "
@@ -93,6 +100,17 @@ def main() -> int:
             json.dump(guards, f, indent=2, sort_keys=True)
             f.write("\n")
         guards_written = {"path": args.guards_out, "fields": len(guards)}
+    lockgraph_written = None
+    if args.lockgraph_out:
+        graph = report.lock_graph()
+        with open(args.lockgraph_out, "w", encoding="utf-8") as f:
+            json.dump(graph, f, indent=2, sort_keys=True)
+            f.write("\n")
+        lockgraph_written = {
+            "path": args.lockgraph_out,
+            "roles": len(graph["roles"]),
+            "edges": sum(len(d) for d in graph["edges"].values()),
+        }
     out = {
         "experiment": "fabriclint",
         "files": summary["files"],
@@ -109,6 +127,8 @@ def main() -> int:
         out["summaries"] = summaries_written
     if guards_written is not None:
         out["guards"] = guards_written
+    if lockgraph_written is not None:
+        out["lockgraph"] = lockgraph_written
     if args.write_baseline:
         with open(args.write_baseline, "w", encoding="utf-8") as f:
             json.dump(summary["by_rule"], f, indent=2, sort_keys=True)

@@ -1,0 +1,32 @@
+"""Clean twin of fix_closure_sibling_dirty: the sibling closure takes
+the lock around its write, so the resolved call chain carries a
+correct lockset and nothing fires."""
+
+from fabric_tpu.devtools.lockwatch import named_lock, spawn_thread
+
+
+class Roller:
+    def __init__(self):
+        self._lock = named_lock("fixture.roller")
+        self._height = 0
+
+    def launch(self):
+        def bump():
+            with self._lock:
+                self._height += 1
+
+        def pump_loop():
+            for _ in range(4):
+                bump()
+
+        t = spawn_thread(target=pump_loop, name="roller", kind="worker")
+        t.start()
+        return t
+
+    def read(self):
+        with self._lock:
+            return self._height
+
+    def write(self, h):
+        with self._lock:
+            self._height = h

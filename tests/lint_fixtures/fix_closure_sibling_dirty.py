@@ -1,0 +1,32 @@
+"""Seeded violation: the thread target is a closure that calls a
+SIBLING closure, and the sibling performs the unguarded write —
+reachable only through closure-to-closure call resolution (the v4
+dataflow satellite).  v3 lost the call edge and stayed silent."""
+
+from fabric_tpu.devtools.lockwatch import named_lock, spawn_thread
+
+
+class Roller:
+    def __init__(self):
+        self._lock = named_lock("fixture.roller")
+        self._height = 0
+
+    def launch(self):
+        def bump():
+            self._height += 1  # <- racecheck fires HERE
+
+        def pump_loop():
+            for _ in range(4):
+                bump()
+
+        t = spawn_thread(target=pump_loop, name="roller", kind="worker")
+        t.start()
+        return t
+
+    def read(self):
+        with self._lock:
+            return self._height
+
+    def write(self, h):
+        with self._lock:
+            self._height = h

@@ -1,0 +1,28 @@
+"""Clean twin of fix_hb_event_dirty: the re-arm and the set() both
+run under one lock, so the pair is sequenced and no waiter can miss a
+wakeup — quiet."""
+
+import threading
+
+from fabric_tpu.devtools.lockwatch import named_lock, spawn_thread
+
+
+class Gate:
+    def __init__(self):
+        self._lock = named_lock("fixture.gate")
+        self._pulse = threading.Event()
+        self._a = spawn_thread(target=self._ping, name="a", kind="worker")
+        self._b = spawn_thread(target=self._pong, name="b", kind="worker")
+
+    def start(self):
+        self._a.start()
+        self._b.start()
+
+    def _ping(self):
+        with self._lock:
+            self._pulse.set()
+
+    def _pong(self):
+        self._pulse.wait()
+        with self._lock:
+            self._pulse.clear()

@@ -1,0 +1,26 @@
+"""Seeded violation: re-arming a shared Event (clear()) on one thread
+concurrently with another thread's set() — a waiter can miss the set
+entirely (the lost-wakeup class behind the PR 11 deliver-client
+wedge).  racecheck, v4 happens-before pass."""
+
+import threading
+
+from fabric_tpu.devtools.lockwatch import spawn_thread
+
+
+class Gate:
+    def __init__(self):
+        self._pulse = threading.Event()
+        self._a = spawn_thread(target=self._ping, name="a", kind="worker")
+        self._b = spawn_thread(target=self._pong, name="b", kind="worker")
+
+    def start(self):
+        self._a.start()
+        self._b.start()
+
+    def _ping(self):
+        self._pulse.set()
+
+    def _pong(self):
+        self._pulse.wait()
+        self._pulse.clear()  # <- racecheck fires HERE

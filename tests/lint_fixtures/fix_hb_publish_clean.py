@@ -1,0 +1,71 @@
+"""Clean twin of fix_hb_publish_dirty: the lock-free worker reads are
+credited by publication edges — Event set()->wait() for Feed, queue
+put()->get() for Line — so neither fires even though both fields carry
+an inferred lock guard the workers do not hold.  This is the v4
+acceptance shape: a site v3 could only handle with a guards.py entry
+or an UNKNOWN hole is now PROVEN safe."""
+
+import queue
+
+import threading
+
+from fabric_tpu.devtools.lockwatch import named_lock, spawn_thread
+
+
+def use(x):
+    return x
+
+
+class Feed:
+    def __init__(self):
+        self._lock = named_lock("fixture.feed")
+        self._ready = threading.Event()
+        self._snapshot = None
+        self._thread = spawn_thread(
+            target=self._consume, name="feed", kind="worker"
+        )
+
+    def start(self):
+        self._thread.start()
+
+    def refresh(self, rows):
+        with self._lock:
+            self._snapshot = rows
+        self._ready.set()
+
+    def peek(self):
+        with self._lock:
+            return self._snapshot
+
+    def _consume(self):
+        self._ready.wait()
+        use(self._snapshot)  # lock-free, credited by set()->wait()
+
+
+class Line:
+    def __init__(self):
+        self._lock = named_lock("fixture.line")
+        self._q = queue.Queue()
+        self._wm = 0
+        self._thread = spawn_thread(
+            target=self._drain, name="line", kind="worker"
+        )
+
+    def start(self):
+        self._thread.start()
+
+    def push(self, n):
+        with self._lock:
+            self._wm = n
+        self._q.put(n)
+
+    def watermark(self):
+        with self._lock:
+            return self._wm
+
+    def _drain(self):
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            use(self._wm)  # lock-free, credited by put()->get()

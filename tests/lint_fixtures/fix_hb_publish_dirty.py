@@ -1,0 +1,34 @@
+"""Seeded violation: the worker reads the guarded field lock-free and
+WITHOUT a publication edge (no Event wait, no queue get), so the
+inferred guard is really missed — racecheck fires exactly as in v3.
+The clean twin adds the set()->wait() / put()->get() edges and v4
+credits them."""
+
+from fabric_tpu.devtools.lockwatch import named_lock, spawn_thread
+
+
+def use(x):
+    return x
+
+
+class Feed:
+    def __init__(self):
+        self._lock = named_lock("fixture.feed")
+        self._snapshot = None
+        self._thread = spawn_thread(
+            target=self._consume, name="feed", kind="worker"
+        )
+
+    def start(self):
+        self._thread.start()
+
+    def refresh(self, rows):
+        with self._lock:
+            self._snapshot = rows
+
+    def peek(self):
+        with self._lock:
+            return self._snapshot
+
+    def _consume(self):
+        use(self._snapshot)  # <- racecheck fires HERE

@@ -1,0 +1,25 @@
+"""Clean twin of fix_hb_start_dirty: every write happens BEFORE
+start(), so the spawn edge publishes them to the worker — no lock
+needed, no finding, and the field resolves as ``hb-publish`` in the
+guard map instead of demanding a guards.py entry."""
+
+from fabric_tpu.devtools.lockwatch import spawn_thread
+
+
+def handle(item):
+    return item
+
+
+class Pump:
+    def __init__(self):
+        self._batch = []
+
+    def start(self):
+        self._batch = ["seed", "late"]  # pre-start: published by spawn
+        t = spawn_thread(target=self._run, name="pump", kind="worker")
+        t.start()
+        return t
+
+    def _run(self):
+        for item in self._batch:
+            handle(item)

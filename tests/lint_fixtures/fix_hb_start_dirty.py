@@ -1,0 +1,25 @@
+"""Seeded violation: a write AFTER start() races with the spawned
+thread's read of the same field — the write slipped past its
+publication point (racecheck, v4 happens-before pass)."""
+
+from fabric_tpu.devtools.lockwatch import spawn_thread
+
+
+def handle(item):
+    return item
+
+
+class Pump:
+    def __init__(self):
+        self._batch = []
+
+    def start(self):
+        self._batch = ["seed"]  # before start(): published by the spawn
+        t = spawn_thread(target=self._run, name="pump", kind="worker")
+        t.start()
+        self._batch = ["late"]  # <- racecheck fires HERE
+        return t
+
+    def _run(self):
+        for item in self._batch:
+            handle(item)

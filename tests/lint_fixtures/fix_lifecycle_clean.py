@@ -1,0 +1,30 @@
+"""Clean twin of fix_lifecycle_dirty: the handle is kept on the
+owner, the loop blocks on a stop Event, and stop() sets it and joins —
+a statically reachable stop path on both the handle and the entry."""
+
+import threading
+
+from fabric_tpu.devtools.lockwatch import spawn_thread
+
+
+def emit():
+    return None
+
+
+class Beacon:
+    def __init__(self):
+        self._stop = threading.Event()
+        self._thread = spawn_thread(
+            target=self._loop, name="beacon", kind="service"
+        )
+
+    def start(self):
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+
+    def _loop(self):
+        while not self._stop.is_set():
+            emit()

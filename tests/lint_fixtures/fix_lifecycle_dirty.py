@@ -1,0 +1,23 @@
+"""Seeded violation: a run-until-stopped service thread whose handle
+is discarded and whose loop never blocks on a stop signal — nothing
+can ever stop or join it (thread-lifecycle)."""
+
+from fabric_tpu.devtools.lockwatch import spawn_thread
+
+
+def emit():
+    return None
+
+
+class Beacon:
+    def __init__(self):
+        self._running = True
+
+    def start(self):
+        spawn_thread(  # <- thread-lifecycle fires HERE
+            target=self._loop, name="beacon", kind="service",
+        ).start()
+
+    def _loop(self):
+        while self._running:
+            emit()

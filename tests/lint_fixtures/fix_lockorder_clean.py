@@ -1,0 +1,24 @@
+"""Clean twin of fix_lockorder_dirty: both methods follow one
+canonical order (a -> b), so the graph stays acyclic."""
+
+from fabric_tpu.devtools.lockwatch import named_lock
+
+
+def touch():
+    return None
+
+
+class Pair:
+    def __init__(self):
+        self._a = named_lock("fixture.order.a")
+        self._b = named_lock("fixture.order.b")
+
+    def forward(self):
+        with self._a:
+            with self._b:
+                touch()
+
+    def backward(self):
+        with self._a:
+            with self._b:
+                touch()

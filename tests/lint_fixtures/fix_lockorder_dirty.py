@@ -1,0 +1,25 @@
+"""Seeded violation: two methods acquire the same two lock roles in
+opposite orders — a role-level cycle in the static acquisition-order
+graph (lock-order, the static twin of lockwatch's LockOrderError)."""
+
+from fabric_tpu.devtools.lockwatch import named_lock
+
+
+def touch():
+    return None
+
+
+class Pair:
+    def __init__(self):
+        self._a = named_lock("fixture.order.a")
+        self._b = named_lock("fixture.order.b")
+
+    def forward(self):
+        with self._a:
+            with self._b:  # establishes a -> b
+                touch()
+
+    def backward(self):
+        with self._b:
+            with self._a:  # <- lock-order fires HERE
+                touch()

@@ -625,13 +625,16 @@ def test_racecheck_is_enforced_at_error_with_no_baseline():
 
 def _report_json(report) -> str:
     """Everything observable about a lint run, as canonical JSON —
-    cache hits must be indistinguishable from cold runs."""
+    cache hits must be indistinguishable from cold runs.  Since v4 the
+    observable surface includes the lock-order graph (and the HB facts
+    folded into the guard map), so the identity pin covers them too."""
     summary = {k: v for k, v in report.summary().items() if k != "cache"}
     return json.dumps({
         "violations": [v.to_dict() for v in report.violations],
         "summary": summary,
         "summaries": report.function_summaries(),
         "guards": report.guard_map(),
+        "lockgraph": report.lock_graph(),
     }, sort_keys=True)
 
 
@@ -643,10 +646,24 @@ def _write_cache_tree(tmp_path):
         "def go():\n"
         "    t = threading.Thread(target=print, daemon=True)\n"
         "    t.start()\n"
+        "    t.join()\n"  # lifecycle-quiet: only thread-hygiene fires
     )
     (pkg / "helper.py").write_text(
         "def double(x):\n"
         "    return 2 * x\n"
+    )
+    # a nested named-lock acquisition so the cached lock-order graph is
+    # non-empty — the identity pin must cover real lockgraph content
+    (pkg / "locks.py").write_text(
+        "from fabric_tpu.devtools.lockwatch import named_lock\n"
+        "class P:\n"
+        "    def __init__(self):\n"
+        "        self._a = named_lock('cachefix.a')\n"
+        "        self._b = named_lock('cachefix.b')\n"
+        "    def go(self):\n"
+        "        with self._a:\n"
+        "            with self._b:\n"
+        "                pass\n"
     )
 
 
@@ -661,6 +678,8 @@ def test_dataflow_cache_hit_matches_cold_run_exactly(tmp_path):
     assert hit.cache_state == "hit"
     assert hit.project is None  # served without re-analysis
     assert _report_json(hit) == _report_json(cold)
+    # the lockgraph served from cache is the real graph, not a stub
+    assert hit.lock_graph()["edges"]["cachefix.a"]["cachefix.b"]
     # the escape hatch bypasses the cache entirely
     off = lint_tree(root=str(tmp_path), targets=("pkg",), cache=False)
     assert off.cache_state == "off"
@@ -713,6 +732,107 @@ def test_ci_wrapper_guards_out_writes_artifact(tmp_path):
     assert active["sites"] > 0
     # majority inference is represented too
     assert any(g["source"] == "inferred" for g in guards.values())
+
+
+def test_v4_rules_enforced_at_error_with_no_baseline():
+    """ISSUE 13 acceptance: lock-order and thread-lifecycle are
+    first-class rules, on at error severity in the strict profile, off
+    under the relaxed profile like racecheck, and the tree gate runs
+    with no baseline file."""
+    from fabric_tpu.devtools.lint import RELAXED_PROFILE, STRICT_PROFILE
+
+    for rule in ("lock-order", "thread-lifecycle"):
+        assert rule in RULES
+        assert rule not in STRICT_PROFILE.disabled
+        assert rule not in STRICT_PROFILE.advisory
+        assert rule in RELAXED_PROFILE.disabled
+    import glob
+    import os
+
+    from fabric_tpu.devtools.lint import repo_root
+
+    assert not glob.glob(os.path.join(repo_root(), "*baseline*.json")), (
+        "the tree must stay clean with NO baseline ratchet file"
+    )
+
+
+def test_static_lock_graph_is_cycle_free_and_covers_commit_path():
+    """The whole-tree acquisition-order graph has no cycles (the gate
+    would fail otherwise — this pins the property by name) and contains
+    the canonical commit-path ordering the runtime watchdog enforces:
+    commit_lock before the snapshot manager/idle locks."""
+    from fabric_tpu.devtools.lint import _lock_order_cycles
+
+    report = lint_tree()
+    graph = report.lock_graph()
+    assert list(_lock_order_cycles(graph)) == []
+    commit_succ = graph["edges"]["kvledger.commit_lock"]
+    assert "snapshot.manager" in commit_succ
+    assert "snapshot.idle" in commit_succ
+    # every recorded site is a production site (tests/scripts excluded)
+    for _src, dsts in graph["edges"].items():
+        for _dst, sites in dsts.items():
+            for rel, _line in sites:
+                assert not rel.startswith(("tests/", "scripts/")), rel
+
+
+def test_hb_edges_prove_production_sites_safe():
+    """ISSUE 13 acceptance pin: accesses that v3 could only cover with
+    a guards.py declaration (or leave in the no-guard/UNKNOWN hole) are
+    now positively proven by happens-before edges.
+
+    * ``SnapshotManager._inflight`` is guards.py-DECLARED, and the
+      background-export write is additionally HB-proven (``hb_safe``
+      rides the declared entry).
+    * ``RaftChain._probe_inflight`` (consensus loop vs eviction
+      confirm) and ``RPCServer._thread`` (start/join lifecycle) carry
+      NO lock anywhere — v4 resolves them as ``hb-publish``: every
+      access publication-ordered, no guard needed, racecheck can still
+      fire if a future edit adds an unordered access."""
+    guards = lint_tree().guard_map()
+    inflight = guards["fabric_tpu.ledger.snapshot.SnapshotManager._inflight"]
+    assert inflight["source"] == "declared"
+    assert inflight.get("hb_safe", 0) >= 1
+    for field in (
+        "fabric_tpu.orderer.raft.chain.RaftChain._probe_inflight",
+        "fabric_tpu.comm.rpc.RPCServer._thread",
+    ):
+        g = guards[field]
+        assert g["source"] == "hb-publish"
+        assert g["guard"] is None
+        assert g["hb_safe"] == g["sites"] > 0
+
+
+def test_ci_wrapper_lockgraph_out_writes_artifact(tmp_path):
+    """scripts/lint.py --lockgraph-out PATH (ISSUE 13 satellite): the
+    static acquisition-order graph lands as a JSON artifact next to the
+    result line, in the exact shape the runtime-⊆-static cross-check
+    consumes."""
+    import os
+
+    from fabric_tpu.devtools.lint import repo_root
+
+    root = repo_root()
+    out_path = tmp_path / "lockgraph.json"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(root, "scripts", "lint.py"),
+         "--lockgraph-out", str(out_path)],
+        capture_output=True, text=True, cwd=root,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    result = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert result["experiment"] == "fabriclint"
+    assert result["lockgraph"]["path"] == str(out_path)
+    graph = json.loads(out_path.read_text())
+    assert result["lockgraph"]["roles"] == len(graph["roles"])
+    assert result["lockgraph"]["edges"] == sum(
+        len(d) for d in graph["edges"].values()
+    ) > 10
+    sites = graph["edges"]["kvledger.commit_lock"]["snapshot.manager"]
+    assert all(
+        isinstance(rel, str) and isinstance(line, int)
+        for rel, line in sites
+    )
 
 
 def test_ci_wrapper_summaries_out_writes_artifact(tmp_path):

@@ -570,6 +570,189 @@ def test_racecheck_lockvar_clean_twin_stays_quiet():
     ) == []
 
 
+# -- hbcheck (v4): happens-before racecheck, lock-order, lifecycle -----------
+
+
+def test_hb_post_start_write_fires():
+    """A write AFTER start() races with the spawned thread's read of
+    the same field — the new publication-point finding."""
+    src, vs = _race_fixture("fix_hb_start_dirty.py")
+    lines = _fires(vs, "racecheck")
+    assert len(lines) == 1
+    assert "fires HERE" in src.splitlines()[lines[0] - 1]
+    msg = next(v.message for v in vs
+               if v.rule == "racecheck" and not v.suppressed)
+    assert "publication point" in msg
+
+
+def test_hb_pre_start_writes_publish_and_stay_quiet():
+    """The clean twin: the same writes BEFORE start() are published by
+    the spawn edge — no finding, and the field needs NO guard (source
+    ``hb-publish`` in the guard map, every site credited)."""
+    src = _load("fix_hb_start_clean.py")
+    rel = "fabric_tpu/gossip/fix_hb_start_clean.py"
+    assert lint_source(src, rel) == []
+    report = lint_sources({rel: src})
+    g = report.project.guard_map[
+        "fabric_tpu.gossip.fix_hb_start_clean.Pump._batch"
+    ]
+    assert g["source"] == "hb-publish" and g["guard"] is None
+    assert g["hb_safe"] == g["sites"]
+
+
+def test_hb_event_rearm_fires():
+    """clear() on one thread racing a set() on another loses wakeups
+    (the PR 11 deliver-client wedge class) — error."""
+    src, vs = _race_fixture("fix_hb_event_dirty.py")
+    lines = _fires(vs, "racecheck")
+    assert len(lines) == 1
+    assert "fires HERE" in src.splitlines()[lines[0] - 1]
+    msg = next(v.message for v in vs
+               if v.rule == "racecheck" and not v.suppressed)
+    assert "re-arming" in msg
+
+
+def test_hb_event_rearm_under_common_lock_stays_quiet():
+    assert lint_source(
+        _load("fix_hb_event_clean.py"),
+        "fabric_tpu/gossip/fix_hb_event_clean.py",
+    ) == []
+
+
+def test_hb_publication_missing_edge_still_fires():
+    """The worker's lock-free read with NO publication edge misses the
+    inferred guard exactly as in v3 — crediting edges must not blind
+    the rule to reads that really are unordered."""
+    src, vs = _race_fixture("fix_hb_publish_dirty.py")
+    lines = _fires(vs, "racecheck")
+    assert len(lines) == 1
+    assert "fires HERE" in src.splitlines()[lines[0] - 1]
+
+
+def test_hb_event_and_queue_publication_credited():
+    """The clean twin: the same lock-free worker reads are credited by
+    Event set()->wait() and Queue put()->get() edges — quiet, pinned
+    down to the exact hb-safe sites."""
+    src = _load("fix_hb_publish_clean.py")
+    rel = "fabric_tpu/gossip/fix_hb_publish_clean.py"
+    assert lint_source(src, rel) == []
+    report = lint_sources({rel: src})
+    p = report.project
+    mod = "fabric_tpu.gossip.fix_hb_publish_clean"
+    safe_reads = {
+        (field, q.rsplit(".", 1)[-1])
+        for (field, kind, _line, q) in p.hb_safe_sites
+        if kind == "read" and field.startswith(mod)
+    }
+    assert (f"{mod}.Feed._snapshot", "_consume") in safe_reads
+    assert (f"{mod}.Line._wm", "_drain") in safe_reads
+    for field in (f"{mod}.Feed._snapshot", f"{mod}.Line._wm"):
+        g = p.guard_map[field]
+        assert g["hb_safe"] == g["sites"]
+
+
+def test_lock_order_cycle_fires_and_names_the_cycle():
+    src, vs = _race_fixture("fix_lockorder_dirty.py")
+    lines = _fires(vs, "lock-order")
+    assert len(lines) == 1
+    assert "fires HERE" in src.splitlines()[lines[0] - 1]
+    msg = next(v.message for v in vs
+               if v.rule == "lock-order" and not v.suppressed)
+    assert "fixture.order.a -> fixture.order.b -> fixture.order.a" in msg
+
+
+def test_lock_order_consistent_order_stays_quiet_with_graph():
+    src = _load("fix_lockorder_clean.py")
+    rel = "fabric_tpu/gossip/fix_lockorder_clean.py"
+    assert lint_source(src, rel) == []
+    # the acyclic edge is still IN the graph artifact
+    report = lint_sources({rel: src})
+    g = report.lock_graph()
+    assert "fixture.order.b" in g["edges"]["fixture.order.a"]
+    assert "fixture.order.a" not in g["edges"].get("fixture.order.b", {})
+
+
+def test_lifecycle_unjoined_service_thread_fires():
+    src, vs = _race_fixture("fix_lifecycle_dirty.py")
+    lines = _fires(vs, "thread-lifecycle")
+    assert len(lines) == 1
+    assert "fires HERE" in src.splitlines()[lines[0] - 1]
+
+
+def test_lifecycle_stop_event_and_join_stay_quiet():
+    assert lint_source(
+        _load("fix_lifecycle_clean.py"),
+        "fabric_tpu/gossip/fix_lifecycle_clean.py",
+    ) == []
+
+
+def test_lifecycle_local_list_fan_out_join_is_clean():
+    """The joined local-list fan-out (spawn into a local list, join in
+    a loop) is a correct pattern the rule must accept — the append
+    binds the handle to the LOCAL container and the join loop's loop
+    var resolves back to it."""
+    src = (
+        "from fabric_tpu.devtools.lockwatch import spawn_thread\n"
+        "def fan_out(jobs):\n"
+        "    threads = []\n"
+        "    for job in jobs:\n"
+        "        threads.append(spawn_thread(target=job, kind='worker'))\n"
+        "    for t in threads:\n"
+        "        t.start()\n"
+        "    for t in threads:\n"
+        "        t.join()\n"
+    )
+    assert lint_source(src, "fabric_tpu/gossip/fanout.py") == []
+
+
+def test_lifecycle_pragma_suppresses_with_reason():
+    src = _load("fix_lifecycle_dirty.py").replace(
+        "        spawn_thread(  # <- thread-lifecycle fires HERE",
+        "        # fabriclint: allow[thread-lifecycle] reviewed: fixture\n"
+        "        # demonstrates a sanctioned run-forever beacon\n"
+        "        spawn_thread(",
+    )
+    vs = lint_source(src, "fabric_tpu/gossip/fix_lifecycle_dirty.py")
+    assert [v for v in vs if not v.suppressed] == []
+    assert any(v.rule == "thread-lifecycle" and v.suppressed for v in vs)
+
+
+def test_closure_sibling_call_resolves_and_fires():
+    """ROADMAP satellite: a nested def calling a SIBLING nested def
+    stays on the call graph, so the thread target's callees keep their
+    lockset facts — the sibling's unguarded write fires."""
+    src, vs = _race_fixture("fix_closure_sibling_dirty.py")
+    lines = _fires(vs, "racecheck")
+    assert len(lines) == 1
+    assert "fires HERE" in src.splitlines()[lines[0] - 1]
+    report = lint_sources(
+        {"fabric_tpu/gossip/fix_closure_sibling_dirty.py": src}
+    )
+    scope = (
+        "fabric_tpu.gossip.fix_closure_sibling_dirty.Roller.launch"
+        ".<locals>."
+    )
+    # the spawn target registered AND the sibling call resolved
+    assert f"{scope}pump_loop" in report.project.thread_entries
+    assert f"{scope}bump" in report.project.call_resolutions.values()
+
+
+def test_closure_sibling_clean_twin_stays_quiet():
+    assert lint_source(
+        _load("fix_closure_sibling_clean.py"),
+        "fabric_tpu/gossip/fix_closure_sibling_clean.py",
+    ) == []
+
+
+def test_v4_rules_relaxed_profile_exempts_tests_and_scripts():
+    """Tests manage thread lifecycles inline and fixtures seed
+    inversions by design: lock-order and thread-lifecycle are off
+    under the relaxed profile like racecheck."""
+    for name in ("fix_lockorder_dirty.py", "fix_lifecycle_dirty.py",
+                 "fix_hb_start_dirty.py"):
+        assert lint_source(_load(name), f"tests/{name}") == []
+
+
 def test_racecheck_rebound_lock_alias_degrades_to_unknown():
     """A lock alias STORED TWICE is ambiguous (the binding map is
     flow-insensitive, last write wins): the correctly guarded first

@@ -319,6 +319,44 @@ def test_clean_commit_and_snapshot_path_does_not_trip(tmp_path):
     assert isinstance(mgr._lock, WatchedLock)
     provider.close()
 
+
+def test_runtime_lock_graph_is_subgraph_of_static(tmp_path):
+    """ISSUE 13 cross-check (runtime ⊆ static): every acquisition-order
+    edge the runtime watchdog observes during a live commit+snapshot
+    session must be present in fabriclint's whole-program lock-order
+    graph — so the static pass provably covers what tier-1 exercises,
+    and a call-chain ordering the static analysis cannot see would
+    fail HERE instead of silently narrowing the lock-order rule's
+    coverage."""
+    import test_snapshot as ts
+
+    from fabric_tpu.devtools.lint import lint_tree
+
+    assert lockwatch.enabled()  # conftest arms tier-1
+    provider, ledger = ts._source_ledger(tmp_path, 6)
+    mgr = ledger.snapshots
+    mgr.submit_request(8)
+    ts._commit_blocks(ledger, 6, 3)  # crosses height 8 -> auto-trigger
+    assert mgr.wait_idle(timeout=30)
+    ts._commit_blocks(ledger, 9, 2)
+    mgr.generate()
+    provider.close()
+    runtime = lockwatch.edges()
+    observed = [(s, d) for s, ds in sorted(runtime.items())
+                for d in sorted(ds)]
+    # the session really exercised the commit -> snapshot ordering
+    assert ("kvledger.commit_lock", "snapshot.manager") in observed
+    static = lint_tree().lock_graph()["edges"]
+    missing = [
+        (s, d) for s, d in observed if d not in static.get(s, {})
+    ]
+    assert not missing, (
+        f"runtime lockwatch edges missing from the static graph: "
+        f"{missing} — the static pass lost a call chain the runtime "
+        f"exercises"
+    )
+
+
 def test_refused_acquisition_leaves_no_partial_edges():
     # holding A then B with X->B established: acquiring X is refused at
     # the B check, and the A->X edge scanned BEFORE the violation must
