@@ -68,6 +68,12 @@ def main() -> None:
         if i + 1 >= len(sys.argv) or sys.argv[i + 1].startswith("--"):
             sys.exit("bench.py: --trace-out requires a PATH argument")
         trace_out = sys.argv[i + 1]
+    profile_out = None
+    if "--profile-out" in sys.argv:
+        i = sys.argv.index("--profile-out")
+        if i + 1 >= len(sys.argv) or sys.argv[i + 1].startswith("--"):
+            sys.exit("bench.py: --profile-out requires a PATH argument")
+        profile_out = sys.argv[i + 1]
 
     # sqlite tuning applied to BOTH sides (baseline and measured): a
     # larger WAL autocheckpoint keeps checkpoint I/O out of the timed
@@ -141,25 +147,30 @@ def main() -> None:
 
     def run_stream(passes: int = 4):
         """Best-of-N pipelined validate+commit stream; returns
-        (best_seconds, commit_stages, validate_stages, trace) of the
-        winning pass.  The provider is drained before every pass for
-        the same reason the p99 loop drains: a prior pass's host-raced
-        flush can leave the device leg still crunching, and that tail
-        must not become the next pass's head.  Under --trace-out the
-        flight recorder resets per pass and the WINNING pass's export
-        is kept — the artifact matches the measured number."""
-        from fabric_tpu.common import tracing
+        (best_seconds, commit_stages, validate_stages, trace, prof) of
+        the winning pass.  The provider is drained before every pass
+        for the same reason the p99 loop drains: a prior pass's
+        host-raced flush can leave the device leg still crunching, and
+        that tail must not become the next pass's head.  Under
+        --trace-out the flight recorder resets per pass and the WINNING
+        pass's export is kept — the artifact matches the measured
+        number; --profile-out holds profscope's aggregate to the same
+        contract."""
+        from fabric_tpu.common import profile, tracing
 
         best = float("inf")
         commit_stages: dict = {}
         validate_stages: dict = {}
         trace: dict | None = None
+        prof: dict | None = None
         stream_drain = getattr(csp, "drain", None)
         for _ in range(passes):
             if stream_drain is not None:
                 stream_drain()
             if tracing.enabled():
                 tracing.reset()
+            if profile.enabled():
+                profile.reset()
             led = fresh_ledger()
             validator = TxValidator("benchch", led, bundle, csp)
             committer = Committer(validator, led)
@@ -178,8 +189,10 @@ def main() -> None:
                 validate_stages = dict(validator.validate_stage_seconds)
                 if tracing.enabled():
                     trace = tracing.export()
+                if profile.enabled():
+                    prof = profile.export("bench.stream")
             assert led.height == 1 + n_blocks
-        return best, commit_stages, validate_stages, trace
+        return best, commit_stages, validate_stages, trace, prof
 
     if sweep_sqlite:
         # durability sweep: one JSON line per synchronous/checkpoint
@@ -190,7 +203,9 @@ def main() -> None:
             for ckpt in (250, 1000, 4000):
                 os.environ["FABRIC_TPU_SQLITE_SYNC"] = sync
                 os.environ["FABRIC_TPU_WAL_CHECKPOINT"] = str(ckpt)
-                best, stages, _vstages, _trace = run_stream(passes=2)
+                best, stages, _vstages, _trace, _prof = run_stream(
+                    passes=2
+                )
                 print(json.dumps({
                     "metric": "sqlite_sweep_tx_per_s",
                     "synchronous": sync,
@@ -211,21 +226,30 @@ def main() -> None:
         tmp.cleanup()
         return
 
-    # tracing arms AFTER the baseline measurement so the (already
-    # near-zero) armed-path overhead cannot skew the vs-baseline ratio;
-    # the measured side carries it inside the traced passes by design
-    if trace_out:
+    # tracing/profiling arm AFTER the baseline measurement so the
+    # (already near-zero) armed-path overhead cannot skew the
+    # vs-baseline ratio; the measured side carries it inside the
+    # traced/profiled passes by design
+    if trace_out or profile_out:
         from fabric_tpu.common import tracing
 
         if not tracing.enabled():
             # FABRIC_TPU_TRACE=N may have armed a user-sized ring at
-            # import; only arm the default when nothing is armed yet
+            # import; only arm the default when nothing is armed yet.
+            # --profile-out arms it too: the sampler attributes CPU to
+            # live tracelens spans (self_cpu_ms), which needs spans
             tracing.arm()
         from fabric_tpu.common import workpool as _workpool
 
         _workpool.reset_stats()
+    if profile_out:
+        from fabric_tpu.common import profile
 
-    best, commit_stages, validate_stages, trace = run_stream()
+        if not profile.enabled():
+            # FABRIC_TPU_PROFILE may have armed a tuned cadence
+            profile.arm()
+
+    best, commit_stages, validate_stages, trace, prof = run_stream()
     value = n_blocks * n_txs / best
 
     # -- p99 block-validate latency on the measured path ------------------
@@ -289,6 +313,18 @@ def main() -> None:
         }
         line["trace_out"] = trace_out
         line["workpool"] = _workpool.stats()
+    if profile_out and prof is not None:
+        from fabric_tpu.common import profile
+
+        profile.dump_to(profile_out, prof)
+        # per-stage CPU attribution of the winning pass (sampler time
+        # inside each live span) — read next to critical_path_ms:
+        # busy-CPU vs wall-gating per stage
+        line["self_cpu_ms"] = prof["otherData"]["self_cpu_ms"]
+        line["profile_out"] = profile_out
+        # stop the sampler service thread before teardown (same
+        # reasoning as _quiesce joining the flush waiters)
+        profile.disarm()
     print(json.dumps(line))
     sys.stdout.flush()
     # quiesce the device provider AFTER the one JSON line is out (a

@@ -65,26 +65,28 @@ def main(argv=None) -> int:
         genesis_blocks=blocks, tls=tls_from_args(args),
     )
     node.start()
-    profile_srv = None
     if cfg.get_bool("general.profile.enabled", False):
-        # reference orderer/common/server/main.go:410-412 initializeProfiling
-        from fabric_tpu.common.profile import ProfileServer
+        # reference orderer/common/server/main.go:410-412
+        # initializeProfiling — here the continuous profscope sampler;
+        # the speedscope doc is served from the operations endpoint
+        # (GET /profile) instead of a standalone pprof listener
+        from fabric_tpu.common import profile
 
-        phost, pport = parse_endpoint(
-            str(cfg.get("general.profile.address", "127.0.0.1:6060"))
-        )
-        profile_srv = ProfileServer(phost, pport)
-        profile_srv.start()
-        print(f"profiling on {profile_srv.addr[0]}:{profile_srv.addr[1]}",
-              flush=True)
+        if not profile.enabled():
+            profile.arm()
+        if node.operations is not None:
+            profile.set_lock_metrics(node.operations.lock_metrics())
+        print("profiling armed: GET /profile on the operations "
+              "endpoint", flush=True)
     print(f"orderer listening on {node.addr[0]}:{node.addr[1]}", flush=True)
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *a: stop.set())
     signal.signal(signal.SIGINT, lambda *a: stop.set())
     stop.wait()
     node.stop()
-    if profile_srv is not None:
-        profile_srv.stop()
+    from fabric_tpu.common import profile as _profile
+
+    _profile.disarm()  # joins the sampler thread; no-op when disarmed
     return 0
 
 

@@ -74,19 +74,27 @@ def cmd_node_start(args) -> int:
         tls=tls_from_args(args),
         keepalive=KeepaliveOptions.from_config(cfg),
     )
-    profile_srv = None
     if cfg.get_bool("peer.profile.enabled", False):
-        # pprof equivalent (reference cmd/peer/main.go:10 +
-        # core/peer/config.go:83-85 ProfileEnabled/ProfileListenAddress)
-        from fabric_tpu.common.profile import ProfileServer
+        # continuous profscope sampling (reference cmd/peer/main.go:10 +
+        # core/peer/config.go:83-85 ProfileEnabled gates pprof the same
+        # way).  The speedscope document is served from the operations
+        # endpoint (GET /profile, /profile/heap) — the old standalone
+        # ProfileServer listener is retired
+        from fabric_tpu.common import profile
 
-        phost, pport = parse_endpoint(
-            str(cfg.get("peer.profile.listenAddress", "127.0.0.1:6060"))
-        )
-        profile_srv = ProfileServer(phost, pport)
-        profile_srv.start()
-        print(f"profiling on {profile_srv.addr[0]}:{profile_srv.addr[1]}",
-              flush=True)
+        if not profile.enabled():
+            # FABRIC_TPU_PROFILE may already have armed a tuned cadence
+            profile.arm()
+        if node.operations is not None:
+            profile.set_lock_metrics(node.operations.lock_metrics())
+            print(
+                f"profiling armed: GET /profile on operations port "
+                f"{args.operations_port}",
+                flush=True,
+            )
+        else:
+            print("profiling armed (no operations port: export via "
+                  "fabric_tpu.common.profile.dump_to)", flush=True)
     gossip_bootstrap = list(args.gossip_bootstrap) or [
         str(b) for b in (cfg.get("peer.gossip.bootstrap") or [])
     ]
@@ -115,8 +123,9 @@ def cmd_node_start(args) -> int:
     signal.signal(signal.SIGINT, lambda *a: stop.set())
     stop.wait()
     node.stop()
-    if profile_srv is not None:
-        profile_srv.stop()
+    from fabric_tpu.common import profile as _profile
+
+    _profile.disarm()  # joins the sampler thread; no-op when disarmed
     return 0
 
 

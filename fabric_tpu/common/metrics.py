@@ -145,11 +145,21 @@ class PrometheusRegistry:
 
     def __init__(self):
         self._metrics: list[_Metric] = []
+        self._collectors: list = []
         self._lock = threading.Lock()
 
     def _register(self, m: _Metric) -> None:
         with self._lock:
             self._metrics.append(m)
+
+    def register_collector(self, fn) -> None:
+        """Register a zero-arg callable invoked at the top of every
+        expose() — the prometheus Collector idiom for values that are
+        READ at scrape time rather than observed as they change
+        (process CPU/RSS/fds, GC totals).  A collector that raises is
+        skipped for that scrape, never fails the endpoint."""
+        with self._lock:
+            self._collectors.append(fn)
 
     @staticmethod
     def _escape_label_value(v) -> str:
@@ -177,6 +187,12 @@ class PrometheusRegistry:
         lines: list[str] = []
         with self._lock:
             metrics = list(self._metrics)
+            collectors = list(self._collectors)
+        for fn in collectors:
+            try:
+                fn()
+            except Exception:
+                pass
         for m in metrics:
             kind = (
                 "counter" if isinstance(m, Counter)
@@ -690,6 +706,121 @@ class LedgerMetrics:
         ))
 
 
+class LockMetrics:
+    """Lock-contention observability (profscope, PR 15): per-role
+    acquire-wait and hold-time histograms — the runtime complement to
+    fabriclint's static lock-order graph.  Fed by
+    ``profile.note_lock_wait/note_lock_hold`` (lockwatch's watched and
+    profiled lock wrappers) only while profiling is armed, so a
+    disarmed node's /metrics is unchanged."""
+
+    # lock waits live in the microsecond..second range, far below the
+    # default request buckets
+    _BUCKETS = (
+        1e-6, 1e-5, 1e-4, 1e-3, 5e-3, 0.025, 0.1, 0.5, 2.0,
+    )
+
+    def __init__(self, provider):
+        self.wait = provider.new_histogram(HistogramOpts(
+            namespace="lock",
+            name="wait_seconds",
+            help="Seconds a thread spent blocked acquiring the lock "
+                 "with this role (profscope armed only).",
+            buckets=self._BUCKETS,
+            statsd_format="%{role}",
+        ))
+        self.hold = provider.new_histogram(HistogramOpts(
+            namespace="lock",
+            name="hold_seconds",
+            help="Seconds the lock with this role was held, outermost "
+                 "acquire to final release (profscope armed only).",
+            buckets=self._BUCKETS,
+            statsd_format="%{role}",
+        ))
+
+
+# process-wide GC pause accounting for ProcessMetrics: one idempotent
+# gc callback accumulates collection time; plain float adds are
+# GIL-atomic enough for a monotone scrape-time read
+_gc_pause_total = [0.0]
+_gc_cb_state = {"installed": False, "t0": None}
+
+
+def _install_gc_callback() -> None:
+    if _gc_cb_state["installed"]:
+        return
+    _gc_cb_state["installed"] = True
+    import gc
+    import time
+
+    def _cb(phase, info):
+        if phase == "start":
+            _gc_cb_state["t0"] = time.monotonic()
+        else:
+            t0 = _gc_cb_state["t0"]
+            if t0 is not None:
+                _gc_pause_total[0] += time.monotonic() - t0
+                _gc_cb_state["t0"] = None
+
+    gc.callbacks.append(_cb)
+
+
+class ProcessMetrics:
+    """Standard process-level gauges (the prometheus client-library
+    conventions) so netscope series can correlate node saturation with
+    commit lag: CPU seconds, RSS, open fds, GC collections and pause
+    time.  Values are read at scrape time — register :meth:`collect`
+    with ``PrometheusRegistry.register_collector``."""
+
+    def __init__(self, provider):
+        self.cpu_seconds = provider.new_gauge(GaugeOpts(
+            name="process_cpu_seconds_total",
+            help="Total user+system CPU seconds of this process "
+                 "(monotone; exposed as a scrape-time gauge).",
+        ))
+        self.rss_bytes = provider.new_gauge(GaugeOpts(
+            name="process_resident_memory_bytes",
+            help="Resident set size in bytes.",
+        ))
+        self.open_fds = provider.new_gauge(GaugeOpts(
+            name="process_open_fds",
+            help="Open file descriptors.",
+        ))
+        self.gc_collections = provider.new_gauge(GaugeOpts(
+            name="process_gc_collections_total",
+            help="Cyclic GC collections since process start, per "
+                 "generation.",
+        ))
+        self.gc_pause_seconds = provider.new_gauge(GaugeOpts(
+            name="process_gc_pause_seconds_total",
+            help="Cumulative seconds spent inside cyclic GC "
+                 "collections (gc callback timing).",
+        ))
+        _install_gc_callback()
+
+    def collect(self) -> None:
+        import gc
+        import os
+
+        t = os.times()
+        self.cpu_seconds.set(t.user + t.system)
+        try:
+            with open("/proc/self/statm", "r", encoding="ascii") as f:
+                pages = int(f.read().split()[1])
+            self.rss_bytes.set(pages * (os.sysconf("SC_PAGE_SIZE")))
+        except (OSError, ValueError, IndexError):
+            pass
+        try:
+            self.open_fds.set(len(os.listdir("/proc/self/fd")))
+        except OSError:
+            pass
+        for gen, st in enumerate(gc.get_stats()):
+            self.gc_collections.With(
+                "generation", str(gen)
+            ).set(st.get("collections", 0))
+        self.gc_pause_seconds.set(_gc_pause_total[0])
+
+
 __all__ = [
     "CounterOpts",
     "GaugeOpts",
@@ -709,4 +840,6 @@ __all__ = [
     "GossipMetrics",
     "DeliverMetrics",
     "LedgerMetrics",
+    "LockMetrics",
+    "ProcessMetrics",
 ]

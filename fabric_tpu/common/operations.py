@@ -36,6 +36,7 @@ class System:
         provider: str = "prometheus",
         version: str = VERSION,
         statsd_send=None,
+        process_metrics: bool = False,
     ):
         self.version = version
         self._checkers: dict[str, object] = {}
@@ -49,10 +50,25 @@ class System:
         self._gossip_metrics = None
         self._deliver_metrics = None
         self._ledger_metrics = None
+        self._lock_metrics = None
+        self._process_metrics = None
         self._lock = threading.Lock()
         if provider == "prometheus":
             self.metrics_provider = PrometheusProvider()
             self._registry = self.metrics_provider.registry
+            if process_metrics:
+                # standard process gauges (CPU seconds, RSS, open fds,
+                # GC collections/pauses) read at scrape time — opt-in
+                # because their values track the real process clock,
+                # which would break virtual-clock scrape determinism
+                from fabric_tpu.common.metrics import ProcessMetrics
+
+                self._process_metrics = ProcessMetrics(
+                    self.metrics_provider
+                )
+                self._registry.register_collector(
+                    self._process_metrics.collect
+                )
         elif provider == "statsd":
             self.metrics_provider = StatsdProvider(
                 statsd_send or (lambda line: None)
@@ -127,6 +143,46 @@ class System:
                         json.dumps(
                             tracing.export(since=since), sort_keys=True
                         ).encode(),
+                    )
+                elif self.path == "/profile/heap":
+                    from fabric_tpu.common import profile
+
+                    self._reply(
+                        200,
+                        json.dumps(
+                            profile.heap_doc(), sort_keys=True
+                        ).encode(),
+                    )
+                elif self.path == "/profile" or self.path.startswith(
+                    "/profile?"
+                ):
+                    from urllib.parse import parse_qs, urlsplit
+
+                    from fabric_tpu.common import profile
+
+                    qs = parse_qs(urlsplit(self.path).query)
+                    try:
+                        seconds = float(qs.get("seconds", ["0"])[0])
+                    except ValueError:
+                        self._reply(
+                            400,
+                            json.dumps(
+                                {"error": "seconds must be a number"}
+                            ).encode(),
+                        )
+                        return
+                    if seconds > 0:
+                        # on-demand session sampled inline in THIS
+                        # handler thread (the server is threading, so
+                        # other endpoints stay live); capped like the
+                        # old pprof listener
+                        doc = profile.sample_for(min(seconds, 120.0))
+                    else:
+                        # the armed profiler's accumulated aggregate
+                        # (or the valid disarmed doc)
+                        doc = profile.export()
+                    self._reply(
+                        200, json.dumps(doc, sort_keys=True).encode()
                     )
                 else:
                     self._reply(404, b"not found", "text/plain")
@@ -277,6 +333,20 @@ class System:
 
                 self._ledger_metrics = LedgerMetrics(self.metrics_provider)
             return self._ledger_metrics
+
+    def lock_metrics(self):
+        """Lazily-built lock-contention histograms
+        (``lock_wait_seconds{role}`` / ``lock_hold_seconds{role}``) —
+        hand the bundle to ``profile.set_lock_metrics`` so an armed
+        profscope's acquire-wait/hold observations surface on
+        /metrics (the runtime complement to fabriclint's static
+        lock-order graph)."""
+        with self._lock:
+            if self._lock_metrics is None:
+                from fabric_tpu.common.metrics import LockMetrics
+
+                self._lock_metrics = LockMetrics(self.metrics_provider)
+            return self._lock_metrics
 
     # -- health ------------------------------------------------------------
 

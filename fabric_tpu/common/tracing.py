@@ -152,12 +152,35 @@ _lookups = [0]
 
 _tls = threading.local()  # .stack: list[Span | _Remote]
 
+# Cross-thread view of the per-thread span stacks, keyed by thread
+# ident: the SAME list objects as _tls.stack, so the profscope sampler
+# can read another thread's innermost span under the GIL without that
+# thread's cooperation.  Only ever populated from _stack(), which runs
+# exclusively on armed paths — the disarmed zero-overhead pin holds.
+_stacks_by_thread: dict[int, list] = {}
+
 
 def _stack() -> list:
     s = getattr(_tls, "stack", None)
     if s is None:
         s = _tls.stack = []
+        _stacks_by_thread[threading.get_ident()] = s
     return s
+
+
+def active_span_of(tid: int) -> "Span | None":
+    """Innermost live span on thread ``tid``, or None.  A cross-thread
+    read for samplers: list snapshot + attribute reads are GIL-atomic,
+    and a span that ended between reads reports ``_ended`` and is
+    skipped — worst case a sample lands on the parent span, never on a
+    corrupt one."""
+    stack = _stacks_by_thread.get(tid)
+    if not stack:
+        return None
+    for item in reversed(list(stack)):
+        if isinstance(item, Span) and not item._ended:
+            return item
+    return None
 
 
 def _next_id() -> int:
@@ -667,6 +690,7 @@ __all__ = [
     "frame_with_token",
     "split_frame_token",
     "FRAME_MARK",
+    "active_span_of",
     "enabled",
     "recorder",
     "lookup_count",

@@ -36,7 +36,8 @@ from __future__ import annotations
 import os
 import threading
 
-from fabric_tpu.common import tracing
+from fabric_tpu.common import profile, tracing
+from fabric_tpu.devtools import clockskew
 
 _FALSY = ("0", "false", "off", "no")
 
@@ -246,6 +247,23 @@ def run_chunked(pool, fn, items, width: int):
                     "workpool.chunk", offset=off, items=len(chunk),
                 ):
                     return _fn(off, chunk)
+
+    if profile.enabled():
+        # profscope queue-wait vs run-time attribution: all chunks are
+        # submitted within the loop below, so one submit timestamp
+        # serves every chunk; the wrapper wraps OUTSIDE the tracing
+        # wrapper so run time covers the chunk span too
+        submitted_fn = fn
+        t_submit = clockskew.monotonic()
+
+        def fn(off, chunk, _fn=submitted_fn, _ts=t_submit):
+            t_start = clockskew.monotonic()
+            try:
+                return _fn(off, chunk)
+            finally:
+                profile.note_chunk(
+                    t_start - _ts, clockskew.monotonic() - t_start
+                )
 
     per = (n + width - 1) // width
     futures = [

@@ -397,17 +397,23 @@ def run_plan(plan: dict, workdir: str, blocks: int = DEFAULT_BLOCKS,
     plus "trace" (the flight-recorder export for THIS plan's run) when
     tracelens is armed: the recorder and its id counter reset before
     the drive, so same-seed plans replay to identical span sequences
-    and a failing plan's dump can ship beside its repro artifact."""
-    from fabric_tpu.common import tracing
+    and a failing plan's dump can ship beside its repro artifact.
+    With profscope armed the same contract holds for "profile": the
+    profiler's aggregate resets before the drive, so the returned
+    speedscope doc covers exactly this plan's workload."""
+    from fabric_tpu.common import profile, tracing
 
     os.makedirs(workdir, exist_ok=True)
     parsed = faultline.Plan(plan)
     if tracing.enabled():
         tracing.reset()
+    if profile.enabled():
+        profile.reset()
     with faultline.use_plan(parsed):
         stats = _drive(workdir, blocks, comm=comm)
         trips = _canonical_trips(faultline.trips(), parsed.label)
     trace = tracing.export() if tracing.enabled() else None
+    prof = profile.export() if profile.enabled() else None
     violations = _judge(workdir, stats, workload_writes(blocks))
     out = {
         "trips": trips,
@@ -416,6 +422,8 @@ def run_plan(plan: dict, workdir: str, blocks: int = DEFAULT_BLOCKS,
     }
     if trace is not None:
         out["trace"] = trace
+    if prof is not None:
+        out["profile"] = prof
     return out
 
 
@@ -547,6 +555,14 @@ def write_trace_doc(path: str, doc: dict) -> str:
     return tracing.dump_doc(path, doc)
 
 
+def write_profile_doc(path: str, doc: dict) -> str:
+    """Write a profscope export (speedscope JSON) beside its repro
+    artifact — one serialization, owned by the profile module."""
+    from fabric_tpu.common import profile
+
+    return profile.dump_to(path, doc)
+
+
 def write_repro(path: str, plan: dict, original: dict, violations: list,
                 trips: list, seed: int, index: int,
                 blocks: int = DEFAULT_BLOCKS) -> str:
@@ -595,7 +611,8 @@ class Campaign:
     def __init__(self, seed: int = 7, plans: int = 25,
                  workdir: str | None = None, out_dir: str = ".faultfuzz",
                  blocks: int = DEFAULT_BLOCKS, shrink: bool = True,
-                 comm: bool = True, trace_dir: str | None = None):
+                 comm: bool = True, trace_dir: str | None = None,
+                 profile_dir: str | None = None):
         self.seed = int(seed)
         self.plans = int(plans)
         self.workdir = workdir
@@ -606,6 +623,10 @@ class Campaign:
         # where failing plans' flight-recorder dumps land (next to the
         # repro JSON by default); only written while tracelens is armed
         self.trace_dir = trace_dir
+        # where failing plans' profscope speedscope docs land (next to
+        # the repro JSON by default); only written while profiling is
+        # armed — same contract as trace_dir
+        self.profile_dir = profile_dir
 
     def discover(self, root: str) -> dict:
         """Run the workload once under the observer plan to enumerate
@@ -637,6 +658,7 @@ class Campaign:
         ledger: list[dict] = []
         repro_paths: list[str] = []
         trace_paths: list[str] = []
+        profile_paths: list[str] = []
         for i in range(self.plans):
             rng = random.Random(f"{self.seed}:{i}")
             label = f"fuzz:{self.seed}:{i}"
@@ -695,6 +717,19 @@ class Campaign:
                         res["trace"],
                     )
                     trace_paths.append(entry["trace"])
+                if res.get("profile") is not None:
+                    # the ORIGINAL failing run's CPU/lock profile, next
+                    # to the repro artifact: where the pipeline spent
+                    # its time in the run the oracle failed
+                    entry["profile"] = write_profile_doc(
+                        os.path.join(
+                            self.profile_dir or self.out_dir,
+                            f"repro_seed{self.seed}_plan{i:03d}"
+                            ".profile.json",
+                        ),
+                        res["profile"],
+                    )
+                    profile_paths.append(entry["profile"])
             results.append(entry)
             ledger.extend(res["trips"])
         failures = sum(1 for e in results if e["verdict"] == "fail")
@@ -710,6 +745,7 @@ class Campaign:
             "trip_ledger": ledger,
             "repro": repro_paths,
             "trace": trace_paths,
+            "profile": profile_paths,
             "results": results,
         }
 
@@ -723,6 +759,7 @@ __all__ = [
     "shrink_plan",
     "write_repro",
     "write_trace_doc",
+    "write_profile_doc",
     "replay",
     "Campaign",
 ]

@@ -92,6 +92,27 @@ DECLARED_GUARDS: dict[str, str] = {
     "fabric_tpu.devtools.netscope.Netscope._stalls": "netscope.state",
     "fabric_tpu.devtools.netscope.Netscope._height_window":
         "netscope.state",
+    # -- profscope profiling plane (PR 15) ----------------------------------
+    # the sampler service thread folds sweeps into the aggregates while
+    # feed points (lockwatch contention, workpool chunks) write from
+    # arbitrary threads and export() snapshots from the harness thread;
+    # everything shared moves under the profiler's own plain lock (a
+    # plain primitive on purpose: a watched lock here would recurse
+    # through the very note_lock_wait hook it feeds)
+    "fabric_tpu.common.profile.Profiler._stacks":
+        "fabric_tpu.common.profile.Profiler._lock",
+    "fabric_tpu.common.profile.Profiler._spans":
+        "fabric_tpu.common.profile.Profiler._lock",
+    "fabric_tpu.common.profile.Profiler._locks":
+        "fabric_tpu.common.profile.Profiler._lock",
+    "fabric_tpu.common.profile.Profiler._chunks":
+        "fabric_tpu.common.profile.Profiler._lock",
+    "fabric_tpu.common.profile.Profiler._samples":
+        "fabric_tpu.common.profile.Profiler._lock",
+    "fabric_tpu.common.profile.Profiler._dropped":
+        "fabric_tpu.common.profile.Profiler._lock",
+    "fabric_tpu.common.profile.Profiler._t0":
+        "fabric_tpu.common.profile.Profiler._lock",
 }
 
 __all__ = ["DECLARED_GUARDS"]

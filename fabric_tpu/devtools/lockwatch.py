@@ -67,9 +67,13 @@ and the wait never ends.
 from __future__ import annotations
 
 import os
+import sys
 import threading
+import time
 
 _ENV = "FABRIC_TPU_LOCKWATCH"
+_PROFILE_ENV = "FABRIC_TPU_PROFILE"
+_PROFILE_FALSY = ("", "0", "false", "off", "no")
 
 # guards the graph + violations; a plain lock that is itself never
 # watched, held only for short pure-python critical sections
@@ -89,6 +93,35 @@ def enabled() -> bool:
 
 def _raise_mode() -> bool:
     return os.environ.get(_ENV, "") != "record"
+
+
+_profmod = None
+
+
+def _profile_mod():
+    """profscope, bound lazily — profile imports spawn_thread from
+    this module, so a top-level import would be circular (the
+    _trace_note pattern)."""
+    global _profmod
+    if _profmod is None:
+        from fabric_tpu.common import profile
+
+        _profmod = profile
+    return _profmod
+
+
+def _profile_on() -> bool:
+    """Is profscope armed (or about to be, via its env knob)?  Checked
+    at lock CREATION only; never imports profile on the disarmed
+    path."""
+    mod = sys.modules.get("fabric_tpu.common.profile")
+    if mod is not None:
+        try:
+            return bool(mod.enabled())
+        except Exception:
+            return False
+    raw = os.environ.get(_PROFILE_ENV, "")
+    return raw.strip().lower() not in _PROFILE_FALSY
 
 
 def _trace_note(kind: str, event: dict) -> None:
@@ -188,8 +221,8 @@ class WatchedLock:
         bad = None
         with _state_lock:
             pending = []
-            for held, _cnt in st:
-                h = held.name
+            for held_entry in st:
+                h = held_entry[0].name
                 if h == self.name:
                     # same ROLE, different instance: role-level ordering
                     # cannot rank an instance against itself; skip
@@ -219,16 +252,29 @@ class WatchedLock:
                 f"{bad['acquiring']!r} while holding {bad['holding']!r} "
                 f"(established order: {' -> '.join(bad['cycle'])})"
             )
-        got = self._inner.acquire(blocking, timeout)
-        if got:
-            st.append([self, 1])
-            if not record_now:
-                with _state_lock:
-                    for held, _cnt in st[:-1]:
-                        if held.name != self.name:
-                            _edges.setdefault(
-                                held.name, set()
-                            ).add(self.name)
+        # profscope contention timing: wall time blocked inside the
+        # inner acquire (the wait), plus an acquire timestamp on the
+        # held-stack entry so _record_release can report hold time.
+        # One enabled() check per acquire when profiling is disarmed.
+        prof = _profile_mod() if _profile_on() else None
+        if prof is not None and prof.enabled():
+            t0 = time.monotonic()
+            got = self._inner.acquire(blocking, timeout)
+            t1 = time.monotonic()
+            if got:
+                prof.note_lock_wait(self.name, t1 - t0)
+                st.append([self, 1, t1])
+        else:
+            got = self._inner.acquire(blocking, timeout)
+            if got:
+                st.append([self, 1])
+        if got and not record_now:
+            with _state_lock:
+                for held_entry in st[:-1]:
+                    if held_entry[0].name != self.name:
+                        _edges.setdefault(
+                            held_entry[0].name, set()
+                        ).add(self.name)
         return got
 
     def release(self) -> None:
@@ -272,13 +318,22 @@ class WatchedLock:
 
     def _record_release(self) -> bool:
         """Pop this lock from the current thread's held-stack; False if
-        it was not acquired on this thread (cross-thread release)."""
+        it was not acquired on this thread (cross-thread release).
+        Entries carrying an acquire timestamp (profiling was armed at
+        acquire) report hold time on the final release."""
         st = _held()
         for i in range(len(st) - 1, -1, -1):
-            if st[i][0] is self:
-                st[i][1] -= 1
-                if st[i][1] == 0:
+            entry = st[i]
+            if entry[0] is self:
+                entry[1] -= 1
+                if entry[1] == 0:
                     del st[i]
+                    if len(entry) == 3:
+                        prof = _profile_mod()
+                        if prof.enabled():
+                            prof.note_lock_hold(
+                                self.name, time.monotonic() - entry[2]
+                            )
                 return True
         return False
 
@@ -314,17 +369,82 @@ def guarded(obj, field: str, *, by: str) -> None:
         )
 
 
+class _ProfiledLock:
+    """Plain lock plus profscope contention timing — what named_lock
+    returns when profiling is armed but lockwatch is off (production
+    profiling runs), so ``lock_wait_seconds{role}`` exists without the
+    order-graph overhead.  Per-thread acquire timestamps live in
+    ``_tacq`` keyed by thread ident; each thread only ever touches its
+    own key, and it does so while HOLDING the inner lock."""
+
+    __slots__ = ("name", "_inner", "_tacq")
+
+    def __init__(self, name: str, factory=threading.Lock):
+        self.name = name
+        self._inner = factory()
+        self._tacq: dict[int, list] = {}
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        prof = _profile_mod()
+        if not prof.enabled():
+            return self._inner.acquire(blocking, timeout)
+        t0 = time.monotonic()
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            t1 = time.monotonic()
+            prof.note_lock_wait(self.name, t1 - t0)
+            self._tacq.setdefault(
+                threading.get_ident(), []
+            ).append(t1)
+        return got
+
+    def release(self) -> None:
+        ident = threading.get_ident()
+        stack = self._tacq.get(ident)
+        if stack:
+            t1 = stack.pop()
+            if not stack:
+                self._tacq.pop(ident, None)
+            prof = _profile_mod()
+            if prof.enabled():
+                prof.note_lock_hold(
+                    self.name, time.monotonic() - t1
+                )
+        self._inner.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self) -> "_ProfiledLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.release()
+        return False
+
+    def __repr__(self) -> str:
+        return f"<_ProfiledLock {self.name!r}>"
+
+
 def named_lock(name: str):
-    """A threading.Lock, watched when FABRIC_TPU_LOCKWATCH is set."""
+    """A threading.Lock, watched when FABRIC_TPU_LOCKWATCH is set;
+    contention-timed (wrapper only, no order graph) when profscope is
+    armed without lockwatch."""
     if enabled():
         return WatchedLock(name, threading.Lock)
+    if _profile_on():
+        return _ProfiledLock(name, threading.Lock)
     return threading.Lock()
 
 
 def named_rlock(name: str):
-    """A threading.RLock, watched when FABRIC_TPU_LOCKWATCH is set."""
+    """A threading.RLock, watched when FABRIC_TPU_LOCKWATCH is set;
+    contention-timed when profscope is armed without lockwatch."""
     if enabled():
         return WatchedLock(name, threading.RLock)
+    if _profile_on():
+        return _ProfiledLock(name, threading.RLock)
     return threading.RLock()
 
 
@@ -367,7 +487,8 @@ class WatchedCondition:
         st = _held()
         bad = None
         with _state_lock:
-            for held, _cnt in st:
+            for held_entry in st:
+                held = held_entry[0]
                 if held is self._wlock or held.name == self.name:
                     continue
                 path = _find_path(held.name, self.name)

@@ -105,6 +105,10 @@ class Topology:
     ops: bool = False               # per-NODE operations endpoint
     #                                 (peers AND orderers — netscope
     #                                 scrapes the whole topology)
+    profile: bool = False           # arm profscope in every node (the
+    #                                 per-node /profile endpoint rides
+    #                                 on the ops System, so pair with
+    #                                 ops=True to fetch artifacts)
     faultline: dict | None = None   # node name -> faultline plan dict
 
     def peer_names(self) -> list[str]:
@@ -253,6 +257,8 @@ class Network:
             }
             if topo.ops:
                 cfg["ops_port"] = free_port()
+            if topo.profile:
+                cfg["env"]["FABRIC_TPU_PROFILE"] = "1"
             if role == "orderer":
                 cfg["rpc_port"] = orderer_rpc[name]
                 cfg["node_id"] = topo.orderer_names().index(name) + 1
@@ -298,6 +304,7 @@ class Network:
         # parent-session plan must not leak into every node
         env.pop("FABRIC_TPU_FAULTLINE", None)
         env.pop("FABRIC_TPU_SOAK", None)
+        env.pop("FABRIC_TPU_PROFILE", None)
         ready = node.cfg.get("ready_file")
         if ready and os.path.exists(ready):
             os.unlink(ready)
@@ -909,6 +916,7 @@ def replay_repro(path: str, workdir: str,
         seed=doc["seed"], batch_timeout_s=t["batch_timeout_s"],
         max_message_count=t["max_message_count"],
         ops=metrics_out is not None,
+        profile=metrics_out is not None,
     )
     schedule = [KillRule.from_dict(r) for r in doc["kill_schedule"]]
     with Network(workdir, topo) as net:
@@ -924,6 +932,7 @@ def replay_repro(path: str, workdir: str,
             result["netscope"] = write_artifacts(
                 scope, metrics_out,
                 prefix=f"netscope_replay_seed{topo.seed}",
+                fetch_profiles=True,
             )
         return result
 

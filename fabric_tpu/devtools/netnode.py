@@ -129,8 +129,14 @@ class NetOrderer:
         if cfg.get("ops_port") is not None:
             from fabric_tpu.common.operations import System
 
-            self.operations = System(("127.0.0.1", int(cfg["ops_port"])))
+            self.operations = System(
+                ("127.0.0.1", int(cfg["ops_port"])), process_metrics=True
+            )
             raft_metrics = self.operations.raft_metrics()
+            from fabric_tpu.common import profile
+
+            if profile.enabled():
+                profile.set_lock_metrics(self.operations.lock_metrics())
         self.kv = open_kvstore(os.path.join(root, "index.sqlite"))
         self.store = BlockStore(
             os.path.join(root, "chains"), self.kv, name=self.channel
@@ -288,8 +294,14 @@ class NetPeer:
             from fabric_tpu.common import workpool
             from fabric_tpu.common.operations import System
 
-            self.operations = System(("127.0.0.1", int(cfg["ops_port"])))
+            self.operations = System(
+                ("127.0.0.1", int(cfg["ops_port"])), process_metrics=True
+            )
             workpool.set_metrics(self.operations.workpool_metrics())
+            from fabric_tpu.common import profile
+
+            if profile.enabled():
+                profile.set_lock_metrics(self.operations.lock_metrics())
             self.operations.register_checker(
                 "workpool", workpool.health_checker()
             )

@@ -588,6 +588,35 @@ class Netscope:
 
     # -- artifacts ---------------------------------------------------------
 
+    def fetch_profiles(self, out_dir: str,
+                       prefix: str = "netscope") -> dict[str, str]:
+        """Pull each live node's profscope aggregate (``GET /profile``
+        on its operations endpoint — the continuous sampler's collapsed
+        stacks, span CPU attribution, lock contention and workpool
+        rows as one speedscope document) and write it beside the other
+        artifacts as ``<prefix>.profile.<node>.json``.  Nodes that are
+        down, have no ops endpoint, or run with profiling disarmed
+        (``otherData.armed`` false) are skipped — a disarmed doc has no
+        samples to render.  Returns ``{node: path}`` for the HTML
+        report's profile links."""
+        os.makedirs(out_dir, exist_ok=True)
+        paths: dict[str, str] = {}
+        for node in sorted(self.targets):
+            raw = self._get(node, "/profile")
+            if raw is None or raw[0] != 200:
+                continue
+            try:
+                doc = json.loads(raw[1])
+            except ValueError:
+                continue
+            if not doc.get("otherData", {}).get("armed"):
+                continue
+            path = os.path.join(out_dir, f"{prefix}.profile.{node}.json")
+            with open(path, "w", encoding="utf-8") as f:
+                json.dump(doc, f, indent=1, sort_keys=True)
+            paths[node] = path
+        return paths
+
     def write_jsonl(self, path: str,
                     thresholds: dict | None = None) -> str:
         """The replayable time-series artifact: one JSON line per
@@ -750,11 +779,14 @@ class Netscope:
         return "".join(parts)
 
     def write_html(self, path: str,
-                   thresholds: dict | None = None) -> str:
+                   thresholds: dict | None = None,
+                   profiles: dict[str, str] | None = None) -> str:
         """Self-contained single-file report: per-series sparklines
         grouped by node, a per-node health timeline, and kill/restart/
         stall markers from the run — openable from the artifact
-        directory with no server and no external assets."""
+        directory with no server and no external assets.  ``profiles``
+        (``{node: artifact path}`` from :meth:`fetch_profiles`) adds a
+        per-node link to the speedscope CPU/lock profile document."""
         with self._lock:
             series = {
                 k: list(ring) for k, ring in self._series.items()
@@ -817,6 +849,14 @@ class Netscope:
             out.append("</table>")
         for node in sorted(set(by_node) | set(health)):
             out.append(f"<h2>{_html.escape(node)}</h2>")
+            prof_path = (profiles or {}).get(node)
+            if prof_path:
+                rel = os.path.basename(prof_path)
+                out.append(
+                    f"<p>profscope: <a href='{_html.escape(rel)}'>"
+                    f"{_html.escape(rel)}</a> (speedscope CPU/lock "
+                    "profile)</p>"
+                )
             if node in health:
                 out.append(
                     "<div>health "
@@ -856,23 +896,36 @@ class Netscope:
 
 def write_artifacts(scope: Netscope, out_dir: str,
                     thresholds: dict | None = None,
-                    prefix: str = "netscope") -> dict:
+                    prefix: str = "netscope",
+                    profiles: dict[str, str] | None = None,
+                    fetch_profiles: bool = False) -> dict:
     """The standard artifact bundle beside a bench/chaos JSON line:
     ``<prefix>.jsonl`` + ``<prefix>.html`` (+ ``<prefix>.trace.json``
-    when any trace events were collected)."""
+    when any trace events were collected, + per-node
+    ``<prefix>.profile.<node>.json`` speedscope docs when profiling
+    was armed).  ``fetch_profiles=True`` pulls the profiles live —
+    only valid while the nodes are still up, so callers that write
+    artifacts after network teardown must fetch inside their ``with
+    Network`` block and pass the result as ``profiles`` instead."""
     os.makedirs(out_dir, exist_ok=True)
+    if fetch_profiles:
+        fetched = scope.fetch_profiles(out_dir, prefix)
+        profiles = {**fetched, **(profiles or {})}
     paths = {
         "jsonl": scope.write_jsonl(
             os.path.join(out_dir, f"{prefix}.jsonl"), thresholds
         ),
         "html": scope.write_html(
-            os.path.join(out_dir, f"{prefix}.html"), thresholds
+            os.path.join(out_dir, f"{prefix}.html"), thresholds,
+            profiles=profiles,
         ),
     }
     if scope.trace_event_count():
         paths["trace"] = scope.write_trace(
             os.path.join(out_dir, f"{prefix}.trace.json")
         )
+    if profiles:
+        paths["profiles"] = dict(profiles)
     return paths
 
 

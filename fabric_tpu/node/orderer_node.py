@@ -50,7 +50,9 @@ class OrdererNode:
         if operations_port is not None:
             from fabric_tpu.common.operations import System
 
-            self.operations = System(("127.0.0.1", operations_port))
+            self.operations = System(
+                ("127.0.0.1", operations_port), process_metrics=True
+            )
             raft_metrics = self.operations.raft_metrics()
             if transport is not None and hasattr(transport, "set_metrics"):
                 transport.set_metrics(raft_metrics)
@@ -58,6 +60,10 @@ class OrdererNode:
                 "registrar",
                 lambda: not getattr(self.registrar, "_halted", False),
             )
+            from fabric_tpu.common import profile
+
+            if profile.enabled():
+                profile.set_lock_metrics(self.operations.lock_metrics())
         self.registrar = Registrar(
             root_dir,
             csp,

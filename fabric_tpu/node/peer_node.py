@@ -260,7 +260,9 @@ class PeerNode:
         if operations_port is not None:
             from fabric_tpu.common.operations import System
 
-            self.operations = System(("127.0.0.1", operations_port))
+            self.operations = System(
+                ("127.0.0.1", operations_port), process_metrics=True
+            )
             self.operations.register_checker(
                 "ledgers",
                 lambda: None if all(
@@ -287,6 +289,12 @@ class PeerNode:
             self.operations.register_checker(
                 "workpool", workpool.health_checker()
             )
+            # profscope: route lock-contention samples to this node's
+            # /metrics as lock_wait_seconds{role} when profiling is on
+            from fabric_tpu.common import profile
+
+            if profile.enabled():
+                profile.set_lock_metrics(self.operations.lock_metrics())
         self.provider = LedgerProvider(
             root_dir,
             csp=csp,

@@ -70,26 +70,37 @@ def main() -> int:
     ap.add_argument("--txs", type=int, default=80,
                     help="txs per kill9 campaign plan (default 80)")
     ap.add_argument("--metrics-out", default=None, metavar="DIR",
-                    help="kill9 mode: run each plan under the netscope "
-                         "collector; FAILING plans ship their "
-                         "netscope_seed<S>.jsonl/.html telemetry "
-                         "artifacts into DIR beside the repro JSON "
-                         "(--replay of a kill9 artifact honors the "
-                         "flag too)")
+                    help="kill9 mode: arm profscope in every node and "
+                         "run each plan under the netscope collector; "
+                         "FAILING plans ship their netscope_seed<S>"
+                         ".jsonl/.html telemetry artifacts plus "
+                         "per-node CPU/lock profiles into DIR beside "
+                         "the repro JSON (--replay of a kill9 artifact "
+                         "honors the flag too)")
     ap.add_argument("--trace-dir", default=None, metavar="DIR",
                     help="arm tracelens for the campaign and write each "
                          "failing plan's flight-recorder dump (Chrome "
                          "trace JSON) into DIR beside the repro paths "
                          "(FABRIC_TPU_TRACE also arms it; dumps then "
                          "default beside the repro JSON in --out)")
+    ap.add_argument("--profile-dir", default=None, metavar="DIR",
+                    help="arm profscope for the campaign and write each "
+                         "failing plan's CPU/lock profile (speedscope "
+                         "JSON) into DIR beside the repro paths "
+                         "(FABRIC_TPU_PROFILE also arms it; docs then "
+                         "default beside the repro JSON in --out)")
     args = ap.parse_args()
 
-    from fabric_tpu.common import tracing  # noqa: E402
+    from fabric_tpu.common import profile, tracing  # noqa: E402
 
     if args.trace_dir and not tracing.enabled():
         # don't clobber an env-armed recorder: FABRIC_TPU_TRACE=N may
         # have sized the ring larger than the default
         tracing.arm()
+    if args.profile_dir and not profile.enabled():
+        # same contract as --trace-dir: FABRIC_TPU_PROFILE may already
+        # have armed the sampler with a tuned cadence
+        profile.arm()
 
     t0 = time.perf_counter()
     if args.replay:
@@ -149,6 +160,14 @@ def main() -> int:
                 ),
                 res["trace"],
             )
+        if res.get("profile") is not None:
+            out["profile"] = faultfuzz.write_profile_doc(
+                os.path.join(
+                    args.profile_dir or args.out,
+                    os.path.basename(args.replay) + ".profile.json",
+                ),
+                res["profile"],
+            )
         print(json.dumps(out))
         return 0 if res["violations"] else 1
 
@@ -167,6 +186,7 @@ def main() -> int:
             topo = nh.Topology(
                 orgs=1, peers_per_org=2, orderers=1, seed=seed,
                 ops=args.metrics_out is not None,
+                profile=args.metrics_out is not None,
             )
             expected = 1 + -(-args.txs // topo.max_message_count)
             schedule = nh.generate_kill_schedule(
@@ -182,8 +202,18 @@ def main() -> int:
                 result = nh.run_stream(
                     net, args.txs, schedule, scope=scope
                 )
+                profiles = None
                 if scope is not None:
                     scope.stop()
+                    if not result["ok"]:
+                        # per-node profscope docs must be pulled HERE,
+                        # while the failing plan's nodes still answer
+                        # GET /profile — outside this block they are
+                        # already dead
+                        profiles = scope.fetch_profiles(
+                            args.metrics_out,
+                            prefix=f"netscope_seed{seed}",
+                        )
             verdicts.append("ok" if result["ok"] else "FAIL")
             if result["ok"]:
                 shutil.rmtree(workdir, ignore_errors=True)
@@ -194,7 +224,8 @@ def main() -> int:
                 )))
                 if scope is not None:
                     # evidence rides WITH the repro: the jsonl series
-                    # + HTML timeline of the exact failing run
+                    # + HTML timeline + per-node CPU/lock profiles of
+                    # the exact failing run
                     from fabric_tpu.devtools.netscope import (
                         write_artifacts,
                     )
@@ -202,6 +233,7 @@ def main() -> int:
                     paths = write_artifacts(
                         scope, args.metrics_out,
                         prefix=f"netscope_seed{seed}",
+                        profiles=profiles,
                     )
                     netscope_paths.append(paths)
         out = {
@@ -225,6 +257,7 @@ def main() -> int:
         seed=args.seed, plans=args.plans, blocks=args.blocks,
         out_dir=args.out, shrink=not args.no_shrink,
         comm=not args.no_comm, trace_dir=args.trace_dir,
+        profile_dir=args.profile_dir,
     )
     summary = campaign.run()
     ledger_digest = hashlib.sha256(
@@ -242,6 +275,7 @@ def main() -> int:
         "trip_ledger_sha256": ledger_digest,
         "repro": summary["repro"],
         "trace": summary.get("trace", []),
+        "profile": summary.get("profile", []),
         "seconds": round(time.perf_counter() - t0, 4),
     }
     print(json.dumps(out))

@@ -63,7 +63,8 @@ def main() -> int:
                          "the netscope collector over the topology, "
                          "and write netscope.jsonl + netscope.html "
                          "(time series, health timeline, kill markers, "
-                         "SLO rollup) into DIR")
+                         "SLO rollup) plus per-node profscope "
+                         "speedscope docs into DIR")
     ap.add_argument("--workdir", default=None,
                     help="node roots/logs live here (default: a "
                          "temp dir, removed on success)")
@@ -103,6 +104,10 @@ def main() -> int:
         max_message_count=args.batch,
         trace=(1 << 15) if args.trace else 0,
         ops=args.metrics_out is not None,
+        # profscope rides along with the metrics bundle: every node
+        # runs the continuous sampler and its speedscope doc lands
+        # beside netscope.html (which links to it per node)
+        profile=args.metrics_out is not None,
     )
     expected_height = 1 + -(-args.txs // args.batch)
     schedule = (
@@ -138,8 +143,11 @@ def main() -> int:
                 "catch_up_s": args.settle,
                 "min_tx_per_s": 0.1,
             }
+            # fetch per-node profiles HERE, inside the with block —
+            # the nodes must still be up to answer GET /profile
             paths = write_artifacts(
-                scope, args.metrics_out, thresholds=thresholds
+                scope, args.metrics_out, thresholds=thresholds,
+                fetch_profiles=True,
             )
             netscope_doc = scope.slo(thresholds)
             netscope_doc["artifacts"] = paths

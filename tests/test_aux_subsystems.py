@@ -55,15 +55,17 @@ def test_cceventmgmt_dispatch_and_isolation():
                    if hasattr(e, "channel_id"))
 
 
-# -- profiling endpoint (reference net/http/pprof wiring) ------------------
+# -- profiling endpoints (profscope on the operations System; the old
+# standalone ProfileServer/pprof listener is retired) ----------------------
 
 
-def test_profile_server_endpoints():
+def test_profile_endpoints_on_operations_system():
+    import json
     import threading
-    import time
     import urllib.request
 
-    from fabric_tpu.common.profile import ProfileServer
+    from fabric_tpu.common import profile
+    from fabric_tpu.common.operations import System
 
     # a busy thread so the CPU profile has something to sample
     stop = threading.Event()
@@ -81,29 +83,42 @@ def test_profile_server_endpoints():
 
     t = spawn_thread(target=spin, name="busy-loop", kind="worker")
     t.start()
-    srv = ProfileServer()
-    srv.start()
+    sys_ = System()
+    sys_.start()
     try:
-        base = f"http://{srv.addr[0]}:{srv.addr[1]}/debug/pprof"
-        idx = urllib.request.urlopen(base + "/").read().decode()
-        assert "goroutine" in idx and "profile" in idx
-        g = urllib.request.urlopen(base + "/goroutine").read().decode()
-        assert "busy-loop" in g and "MainThread" in g
-        prof = urllib.request.urlopen(
-            base + "/profile?seconds=0.3"
-        ).read().decode()
-        assert "spin" in prof  # collapsed stacks name the hot frame
-        h = urllib.request.urlopen(base + "/heap").read().decode()
-        assert h  # first call starts tracemalloc or returns stats
+        base = f"http://{sys_.addr[0]}:{sys_.addr[1]}"
+        # disarmed: still a valid (empty) speedscope doc, armed: false
+        doc = json.loads(
+            urllib.request.urlopen(base + "/profile").read()
+        )
+        assert doc["otherData"]["armed"] is False
+        assert doc["profiles"] == []
+        # ?seconds=N samples inline in the handler thread — works with
+        # no profiler armed, and the hot frame lands in the stacks
+        doc = json.loads(
+            urllib.request.urlopen(
+                base + "/profile?seconds=0.3"
+            ).read()
+        )
+        assert doc["$schema"] == profile.SPEEDSCOPE_SCHEMA
+        # frame names carry the source site: "spin (test_aux_...py:N)"
+        frames = [f["name"] for f in doc["shared"]["frames"]]
+        assert any(f.startswith("spin ") for f in frames)
+        assert doc["profiles"][0]["samples"]
+        h = json.loads(
+            urllib.request.urlopen(base + "/profile/heap").read()
+        )
+        assert "top" in h and "current_bytes" in h
     finally:
         stop.set()
-        srv.stop()
+        sys_.stop()
         t.join(timeout=5)
 
 
 def test_peer_profile_config_knob_consumed():
-    """core.yaml peer.profile.enabled actually starts the listener when
-    the peer CLI boots (the knob must not be dead)."""
+    """core.yaml peer.profile.enabled still gates profiling when the
+    peer CLI boots (the knob must not be dead now that it arms the
+    profscope sampler instead of a standalone listener)."""
     from fabric_tpu.common.config import Config
 
     cfg = Config(
