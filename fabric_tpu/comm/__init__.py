@@ -1,8 +1,10 @@
 """Process-level communication substrate (reference internal/pkg/comm):
-framed TCP RPC with unary and server-streaming calls, used by the peer
-and orderer daemons and their CLI clients."""
+framed TCP RPC with unary, server-streaming, and bidirectional
+(duplex) calls, used by the peer and orderer daemons, the gateway's
+pipelined broadcast streams, and their CLI clients."""
 
 from fabric_tpu.comm.rpc import (  # noqa: F401
+    DuplexStream,
     RPCClient,
     RPCError,
     RPCServer,

@@ -172,6 +172,66 @@ class Stream:
         return read_frame(self._sock)
 
 
+class DuplexStream:
+    """Client-side handle for a bidirectional-streaming method (e.g. the
+    gateway's pipelined ``ab.BroadcastStream``): `send` writes raw
+    request frames the server handler reads via ``Stream.recv``, and
+    `recv` returns the DATA bodies the handler writes via
+    ``Stream.send``.  The two directions are independent, so a writer
+    thread and a reader thread may share the handle — but each
+    direction must stay single-threaded.
+
+    By convention an EMPTY ``send`` frame marks graceful end-of-stream
+    (``finish()``); the handler answers by returning, which surfaces
+    here as ``recv() -> None`` (END)."""
+
+    def __init__(self, sock, keepalive: "KeepaliveOptions"):
+        self._sock = sock
+        self._ka = keepalive
+        # recv() owns the socket timeout; sends rely on TCP buffering +
+        # kernel keepalive (set_tcp_keepalive) to detect a dead peer
+        sock.settimeout(
+            clockskew.io_timeout(
+                keepalive.ping_interval + keepalive.ping_timeout
+            )
+        )
+
+    def send(self, body: bytes) -> None:
+        write_frame(self._sock, body)
+
+    def finish(self) -> None:
+        """Signal graceful end-of-stream to the handler."""
+        write_frame(self._sock, b"")
+
+    def recv(self) -> bytes | None:
+        """Next DATA body from the server; None on END.  PING frames
+        are skipped; ERR raises RPCError, as does silence past the
+        keepalive deadline or a torn connection."""
+        while True:
+            try:
+                frame = read_frame(self._sock)
+            except socket.timeout:
+                raise RPCError(
+                    "stream silent past the keepalive deadline"
+                ) from None
+            if frame is None:
+                raise RPCError("connection closed mid-stream")
+            kind, rest = frame[0], frame[1:]
+            if kind == KIND_PING:
+                continue  # live-idle stream
+            if kind == KIND_ERR:
+                raise RPCError(rest.decode("utf-8", "replace"))
+            if kind == KIND_END:
+                return None
+            return rest
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
 class _Handler(socketserver.BaseRequestHandler):
     def handle(self):
         server: RPCServer = self.server.rpc  # type: ignore[attr-defined]
@@ -504,6 +564,15 @@ class RPCClient:
         finally:
             sock.close()
 
+    def duplex(self, method: str, body: bytes = b"") -> DuplexStream:
+        """Open a bidirectional stream: the returned handle's `send`
+        frames arrive at the server handler's ``Stream.recv`` and the
+        handler's ``Stream.send`` bodies come back through `recv`.
+        The caller owns the handle's lifecycle (``finish``/``close``)."""
+        with tracing.span("rpc.duplex", method=method):
+            sock = self._connect(method, body)
+        return DuplexStream(sock, self._keepalive)
+
     def stream(self, method: str, body: bytes = b""):
         """Server-streaming call: yields DATA bodies until END.
 
@@ -544,5 +613,5 @@ class RPCClient:
 
 
 __all__ = ["RPCServer", "RPCClient", "RPCError", "Stream",
-           "KeepaliveOptions", "set_tcp_keepalive", "read_frame",
-           "write_frame"]
+           "DuplexStream", "KeepaliveOptions", "set_tcp_keepalive",
+           "read_frame", "write_frame"]

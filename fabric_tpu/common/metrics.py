@@ -670,6 +670,72 @@ class DeliverMetrics:
         ))
 
 
+class GatewayMetrics:
+    """Gateway submission front-end instrumentation: admission queue
+    depth and the adaptive in-flight window (the backpressure pair —
+    depth pinned at the window with zero resolutions is the
+    stuck-gateway signature), dedup hits, backpressure rejections,
+    orderer failover episodes, per-status resolution counters, and the
+    submit→commit latency histogram netscope's SLO rollup reads."""
+
+    def __init__(self, provider):
+        self.queue_depth = provider.new_gauge(GaugeOpts(
+            namespace="gateway",
+            name="queue_depth",
+            help="Envelopes accepted but not yet written to an "
+                 "orderer broadcast stream.",
+            statsd_format="%{channel}",
+        ))
+        self.in_flight = provider.new_gauge(GaugeOpts(
+            namespace="gateway",
+            name="in_flight",
+            help="Accepted txids not yet resolved to a commit status.",
+            statsd_format="%{channel}",
+        ))
+        self.window = provider.new_gauge(GaugeOpts(
+            namespace="gateway",
+            name="window",
+            help="Current admission window (max unresolved txids), "
+                 "adapted to the deliver-observed commit rate.",
+            statsd_format="%{channel}",
+        ))
+        self.dedup_hits = provider.new_counter(CounterOpts(
+            namespace="gateway",
+            name="dedup_hits_total",
+            help="Resubmissions answered idempotently from the txid "
+                 "dedup map.",
+            statsd_format="%{channel}",
+        ))
+        self.rejections = provider.new_counter(CounterOpts(
+            namespace="gateway",
+            name="rejections_total",
+            help="Submissions rejected with retry-after because the "
+                 "admission window was full.",
+            statsd_format="%{channel}",
+        ))
+        self.failovers = provider.new_counter(CounterOpts(
+            namespace="gateway",
+            name="failovers_total",
+            help="Orderer stream failover episodes (connection loss "
+                 "-> rotation + in-flight resubmission).",
+            statsd_format="%{channel}",
+        ))
+        self.resolved = provider.new_counter(CounterOpts(
+            namespace="gateway",
+            name="resolved_total",
+            help="Txids resolved to a definitive commit status, by "
+                 "status (VALID/INVALID/TIMEOUT).",
+            statsd_format="%{channel}.%{status}",
+        ))
+        self.submit_to_commit_seconds = provider.new_histogram(HistogramOpts(
+            namespace="gateway",
+            name="submit_to_commit_seconds",
+            help="Latency from gateway admission to commit-status "
+                 "resolution via the deliver tail.",
+            statsd_format="%{channel}",
+        ))
+
+
 class LedgerMetrics:
     """Per-channel ledger progress (netscope gap closure): the height
     and durability-watermark gauges the telemetry plane derives
@@ -839,6 +905,7 @@ __all__ = [
     "WorkpoolMetrics",
     "GossipMetrics",
     "DeliverMetrics",
+    "GatewayMetrics",
     "LedgerMetrics",
     "LockMetrics",
     "ProcessMetrics",

@@ -49,6 +49,7 @@ class System:
         self._workpool_metrics = None
         self._gossip_metrics = None
         self._deliver_metrics = None
+        self._gateway_metrics = None
         self._ledger_metrics = None
         self._lock_metrics = None
         self._process_metrics = None
@@ -321,6 +322,21 @@ class System:
                     self.metrics_provider
                 )
             return self._deliver_metrics
+
+    def gateway_metrics(self):
+        """Lazily-built gateway front-end metrics (admission queue
+        depth, adaptive in-flight window, dedup hits, rejections,
+        failover episodes, submit→commit latency) for
+        ``Gateway(metrics=...)`` — the series netscope's scraper and
+        SLO rollup read off the gateway's /metrics."""
+        with self._lock:
+            if self._gateway_metrics is None:
+                from fabric_tpu.common.metrics import GatewayMetrics
+
+                self._gateway_metrics = GatewayMetrics(
+                    self.metrics_provider
+                )
+            return self._gateway_metrics
 
     def ledger_metrics(self):
         """Lazily-built per-channel ledger progress metrics (height /

@@ -495,6 +495,43 @@ def _probe_missing(net: "Network", peers: list[str],
     return None
 
 
+def _peer_deliver_connect(net: "Network", peer_name: str, channel: str):
+    """A DeliverClient-style connect callable over one PEER's
+    ``ab.Deliver`` — the gateway's commit-status tail reads peers, not
+    orderers, because peer block metadata carries the post-validation
+    flags a VALID/INVALID verdict needs."""
+    from fabric_tpu.comm import RPCClient
+    from fabric_tpu.common.deliver import make_seek_info_envelope
+    from fabric_tpu.devtools import netident
+    from fabric_tpu.protos.orderer import ab_pb2
+
+    ident = b"cre:gateway"
+
+    class _Signer:
+        def serialize(self):
+            return ident
+
+        def sign(self, msg: bytes) -> bytes:
+            from fabric_tpu.common.hashing import sha256
+
+            return netident.sign_as(ident, sha256(msg))
+
+    def connect(start_num: int):
+        addr = net.nodes[peer_name].rpc_addr
+        client = RPCClient(addr[0], int(addr[1]), timeout=10.0)
+        env = make_seek_info_envelope(
+            channel, start_num, 0x7FFFFFFFFFFFFFFF, signer=_Signer()
+        )
+        for raw in client.stream("ab.Deliver", env.SerializeToString()):
+            resp = ab_pb2.DeliverResponse.FromString(raw)
+            if resp.WhichOneof("Type") == "block":
+                yield resp.block
+            else:
+                return
+
+    return connect
+
+
 def run_stream(
     net: Network,
     txs: int,
@@ -504,6 +541,7 @@ def run_stream(
     settle_timeout_s: float = 120.0,
     sample_keys: int = 32,
     scope=None,
+    driver: str = "serial",
 ) -> dict:
     """Drive ``txs`` endorser envelopes through broadcast -> raft
     ordering -> gossip dissemination -> commit on every peer, executing
@@ -514,7 +552,16 @@ def run_stream(
     ``scope`` (a running ``devtools.netscope.Netscope``) receives
     kill/restart markers from the schedule executor, and its stall
     detector's currently-flagged nodes land in the result/verdict as
-    ``stalled_nodes``."""
+    ``stalled_nodes``.
+
+    ``driver`` selects the submission front-end: ``"serial"`` is the
+    original one-unary-RPC-per-tx loop; ``"gateway"`` embeds a
+    :class:`fabric_tpu.gateway.Gateway` in the driver process —
+    pipelined broadcast streams to the orderers, admission
+    backpressure, failover, and a commit-status tail over the peers'
+    ``ab.Deliver`` (convergence additionally waits for every accepted
+    tx to resolve).  With ``scope`` set, the gateway's metrics ride a
+    driver-local operations endpoint scraped as node ``gateway0``."""
     from fabric_tpu.devtools import netident
 
     topo = net.topo
@@ -543,6 +590,36 @@ def run_stream(
     # -- broadcaster -------------------------------------------------------
     sent = [0]
     stop_bcast = threading.Event()
+    gateway = None
+    gw_ops = None
+    if driver == "gateway":
+        from fabric_tpu.gateway import Gateway
+        from fabric_tpu.gateway.core import orderer_stream_connect
+
+        gw_metrics = None
+        if scope is not None:
+            from fabric_tpu.common.operations import System
+
+            gw_ops = System(("127.0.0.1", 0))
+            gw_metrics = gw_ops.gateway_metrics()
+            gw_ops.start()
+            scope.add_target("gateway0", gw_ops.addr)
+        gateway = Gateway(
+            topo.channel,
+            [
+                orderer_stream_connect(net.nodes[n].rpc_addr)
+                for n in topo.orderer_names()
+            ],
+            deliver_endpoints=[
+                _peer_deliver_connect(net, p, topo.channel)
+                for p in peers
+            ],
+            metrics=gw_metrics,
+            max_unacked=512,
+        )
+        gateway.start()
+    elif driver != "serial":
+        raise NetError(f"unknown driver {driver!r}")
 
     def broadcaster() -> None:
         for i, (ns, key, val) in enumerate(writes):
@@ -551,6 +628,16 @@ def run_stream(
             env = netident.make_tx(
                 topo.channel, key, val, orgs=topo.orgs, cc=ns,
             )
+            if gateway is not None:
+                # admission backpressure: a rejection is an invitation
+                # to retry after the hinted delay, not an error
+                while not stop_bcast.is_set():
+                    res = gateway.submit(env)
+                    if res.accepted:
+                        sent[0] += 1
+                        break
+                    time.sleep(min(max(res.retry_after_s, 0.001), 0.25))
+                continue
             try:
                 net.broadcast(env, prefer=i)
             except NetError as exc:
@@ -678,6 +765,11 @@ def run_stream(
             not bcast.is_alive()
             and all(not t.is_alive() for t in restarts)
             and set(peers) <= set(heights)
+            # gateway driver: convergence additionally means every
+            # accepted tx has a resolved commit status (the tail keeps
+            # the admission window honest; a lull mid-drain must not
+            # read as settled)
+            and (gateway is None or gateway.in_flight == 0)
         ):
             orderer_h = max(
                 (h for n, h in heights.items() if n not in peers),
@@ -739,6 +831,17 @@ def run_stream(
     bcast.join(timeout=10)
     for t in restarts:
         t.cancel()
+    gw_doc = None
+    if gateway is not None:
+        gw_doc = {
+            "failovers": gateway.failovers,
+            "endpoint_log": list(gateway.endpoint_log),
+            "window": gateway.window,
+            "unresolved_at_stop": gateway.in_flight,
+        }
+        gateway.stop()
+    if gw_ops is not None:
+        gw_ops.stop()
 
     # -- cross-peer commit lag from the height samples --------------------
     lag_ms = 0.0
@@ -823,6 +926,8 @@ def run_stream(
         "kill_schedule": [r.as_dict() for r in schedule],
         "txs": txs,
         "sent": sent[0],
+        "driver": driver,
+        "gateway": gw_doc,
         "final_height": final_height,
         "committed_tx_per_s": round(txs / elapsed, 2) if ok else 0.0,
         "elapsed_s": round(elapsed, 3),

@@ -198,6 +198,7 @@ class NetOrderer:
         )
         self.rpc = RPCServer("127.0.0.1", int(cfg["rpc_port"]))
         self.rpc.register("ab.Broadcast", self._broadcast)
+        self.rpc.register("ab.BroadcastStream", self._broadcast_stream)
         self.rpc.register("ab.Deliver", self._deliver)
         self.rpc.register("net.Status", self._status)
         self.rpc.register("net.TraceDump", self._trace_dump)
@@ -235,6 +236,23 @@ class NetOrderer:
             status=self._common.SUCCESS
         ).SerializeToString()
 
+    def _broadcast_stream(self, body: bytes, stream):
+        """The gateway's pipelined submission path: client-streamed
+        envelopes, one ack frame per ordered envelope (FIFO credits,
+        not per-txid receipts), an empty frame ends the stream.  An
+        ordering failure surfaces as the connection's ERR frame — the
+        gateway fails over and resubmits its unresolved window."""
+        ack = self._ab.BroadcastResponse(
+            status=self._common.SUCCESS
+        ).SerializeToString()
+        while True:
+            frame = stream.recv()
+            if not frame:
+                return None
+            env = self._common.Envelope.FromString(frame)
+            self.chain.order(env)
+            stream.send(ack)
+
     def _deliver(self, body: bytes, stream):
         from fabric_tpu.common.deliver import deliver_response_frames
 
@@ -260,6 +278,25 @@ def _build_orderer(cfg: dict, netident) -> NetOrderer:
 
 
 # -- peer role ----------------------------------------------------------------
+
+
+class _PeerDeliverStore:
+    """Durable-height view of the peer ledger for the deliver service:
+    a gateway tailing this peer for commit statuses must only see
+    blocks that are flushed and announced — a buffered group-commit
+    block is neither readable nor guaranteed to survive a crash."""
+
+    def __init__(self, ledger):
+        self._ledger = ledger
+
+    @property
+    def height(self) -> int:
+        return getattr(
+            self._ledger, "durable_height", self._ledger.height
+        )
+
+    def get_block_by_number(self, num: int):
+        return self._ledger.get_block_by_number(num)
 
 
 class NetPeer:
@@ -407,7 +444,30 @@ class NetPeer:
             self.gossip, float(cfg.get("gossip_tick_s", 0.1))
         )
 
+        # peer-served ab.Deliver: the gateway's commit-status tail
+        # reads blocks HERE, not from the orderer — peer blocks carry
+        # the post-validation flags a VALID/INVALID verdict needs.
+        # Access is 1-of-any (k=1) like the orderer's deliver gate;
+        # the notifier fires from the commit listener, which runs
+        # post-flush, so BLOCK_UNTIL_READY wakes only for durable
+        # blocks (matching _PeerDeliverStore's height).
+        from fabric_tpu.common.deliver import BlockNotifier, DeliverService
+
+        self._deliver_notifier = BlockNotifier()
+        self.committer.add_commit_listener(
+            lambda blk, flags: self._deliver_notifier.notify()
+        )
+        deliver_support = _OrdererSupport(
+            _PeerDeliverStore(self.ledger), netident.FakeBundle(k=1)
+        )
+        self.deliver_service = DeliverService(
+            lambda ch: deliver_support if ch == self.channel else None,
+            self.csp,
+            notifier=self._deliver_notifier,
+        )
+
         self.rpc = RPCServer("127.0.0.1", int(cfg["rpc_port"]))
+        self.rpc.register("ab.Deliver", self._deliver)
         self.rpc.register("net.Status", self._status)
         self.rpc.register("net.Check", self._check)
         self.rpc.register("net.TraceDump", self._trace_dump)
@@ -420,6 +480,11 @@ class NetPeer:
     def _receive_block(self, seq: int, block_bytes: bytes) -> None:
         self.handle.state.add_payload(seq, block_bytes, from_orderer=True)
 
+    def _deliver(self, body: bytes, stream):
+        from fabric_tpu.common.deliver import deliver_response_frames
+
+        return deliver_response_frames(self.deliver_service, body)
+
     def start(self) -> None:
         self.runner.start()
         self.rpc.start()
@@ -428,6 +493,7 @@ class NetPeer:
 
     def stop(self) -> None:
         self.rpc.stop()
+        self.deliver_service.stop()
         self.runner.stop()
         self.deliver_client.stop()
         self.comm.close()

@@ -190,6 +190,7 @@ class Netscope:
         # markers: kill/restart (from the harness), stall/stall_clear
         self._events: list[dict] = []
         # incremental trace collection (bounded, newest kept)
+        self._trace_capacity = trace_capacity
         self._trace_events: dict[str, collections.deque] = {
             n: collections.deque(maxlen=trace_capacity) for n in targets
         }
@@ -202,6 +203,19 @@ class Netscope:
         self.rounds = 0
         self._stop = None
         self._thread = None
+
+    def add_target(self, name: str, addr: tuple[str, int]) -> None:
+        """Register an extra scrape target after construction — e.g.
+        the driver-embedded gateway's operations endpoint, which is not
+        a topology node but publishes the gateway_* series the SLO
+        rollup and html render like any other node's.  Safe while the
+        collector thread runs (scrape rounds snapshot the target set)."""
+        with self._lock:
+            self.targets[name] = addr
+            self._trace_events.setdefault(
+                name, collections.deque(maxlen=self._trace_capacity)
+            )
+            self._trace_cursor.setdefault(name, 0)
 
     # -- time & cadence ----------------------------------------------------
 
@@ -238,8 +252,9 @@ class Netscope:
         t = self._now()
         with self._lock:
             cursors = dict(self._trace_cursor)
+            round_targets = sorted(self.targets)
         fetched: dict[str, dict] = {}
-        for node in sorted(self.targets):
+        for node in round_targets:
             got: dict = {"metrics": None, "health": None, "traces": None}
             raw = self._get(node, "/metrics")
             if raw is not None and raw[0] == 200:

@@ -1,0 +1,20 @@
+"""Gateway: the pipelined submission front-end for the ordering
+service (reference gateway/gateway.go + gateway/api — the Fabric
+Gateway service that fronts broadcast/deliver for SDK clients).
+
+Many concurrent clients multiplex onto a small number of pipelined
+broadcast streams to the orderer cluster; the gateway dedups txids,
+applies bounded admission with backpressure, fails over between
+orderers deterministically (resubmitting in-flight envelopes), and
+tails blocks through the deliver client to resolve every accepted tx
+to a definitive VALID/INVALID/TIMEOUT status (`submit_and_wait`, the
+reference's SubmitTransaction+CommitStatus in one call)."""
+
+from fabric_tpu.gateway.core import (  # noqa: F401
+    Gateway,
+    SubmitResult,
+    STATUS_PENDING,
+    STATUS_VALID,
+    STATUS_INVALID,
+    STATUS_TIMEOUT,
+)

@@ -12,7 +12,8 @@ the single-peer headline bench.
 Usage:
   python scripts/netbench.py [--orgs N] [--peers M] [--orderers K]
       [--txs T] [--seed S] [--kills N | --no-kill] [--trace]
-      [--trace-out PATH] [--workdir DIR] [--out DIR] [--repro FILE]
+      [--driver serial|gateway] [--trace-out PATH] [--workdir DIR]
+      [--out DIR] [--repro FILE]
 
 Exit code: nonzero when the network-wide invariants oracle (per-node
 chain/height checks + cross-peer state-digest agreement + presence
@@ -52,6 +53,12 @@ def main() -> int:
                     help="pure throughput run, no chaos")
     ap.add_argument("--batch", type=int, default=10,
                     help="orderer max_message_count")
+    ap.add_argument("--driver", choices=("serial", "gateway"),
+                    default="serial",
+                    help="submission front-end: the original serial "
+                         "unary-RPC loop, or the pipelined gateway "
+                         "(fabric_tpu/gateway) with backpressure, "
+                         "failover, and commit-status tracking")
     ap.add_argument("--trace", action="store_true",
                     help="arm tracelens on every node and write the "
                          "merged network trace")
@@ -125,7 +132,7 @@ def main() -> int:
         )
         result = nh.run_stream(
             net, args.txs, schedule, settle_timeout_s=args.settle,
-            scope=scope,
+            scope=scope, driver=args.driver,
         )
         netscope_doc = None
         if scope is not None:
@@ -168,6 +175,8 @@ def main() -> int:
         "experiment": "netbench",
         "seed": args.seed,
         "topology": result["topology"],
+        "driver": args.driver,
+        "gateway": result.get("gateway"),
         "txs": args.txs,
         "ok": result["ok"],
         "committed_tx_per_s": result["committed_tx_per_s"],

@@ -1,0 +1,468 @@
+"""Gateway submission front-end (ISSUE 16 tentpole): pipelined
+broadcast with commit-status tracking, txid dedup, adaptive-window
+backpressure, and deterministic orderer failover.
+
+Tier-1 pins:
+- resubmitting a txid is idempotent: the orderer sees ONE copy while
+  the first is in flight, and a resolved txid answers from the dedup
+  map with its final status;
+- a full admission window rejects with a retry-after hint and recovers
+  as the deliver tail resolves records;
+- mid-stream orderer death (deterministic handler kill in-proc, real
+  SIGKILL in the netharness case) triggers ONE failover to the next
+  endpoint in index order and every accepted tx still reaches a
+  definitive status — zero lost-and-unreported;
+- a `wait` that expires resolves the record to TIMEOUT under the
+  virtual clock (no real sleeps), and later commits cannot flip it;
+- `stop()` resolves leftover PENDING records to TIMEOUT;
+- every gateway.* faultline point self-registers under an observer
+  plan, and a seeded raise at gateway.stream.write takes the same
+  requeue-and-failover path a real torn write does.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from fabric_tpu.comm import RPCServer
+from fabric_tpu.common.metrics import GatewayMetrics, PrometheusProvider
+from fabric_tpu.devtools.netscope import parse_prometheus
+from fabric_tpu.devtools import clockskew, faultline, netident
+from fabric_tpu.gateway import (
+    Gateway,
+    STATUS_INVALID,
+    STATUS_PENDING,
+    STATUS_TIMEOUT,
+    STATUS_VALID,
+)
+from fabric_tpu.gateway.core import txid_of
+
+from fabric_tpu import protoutil
+from fabric_tpu.protos.common import common_pb2
+
+CHANNEL = "netchan"
+
+
+def _wait_until(pred, timeout=10.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.01)
+    raise AssertionError(f"timeout waiting for {msg}")
+
+
+def _env(key: str, val: bytes = b"v") -> bytes:
+    return netident.make_tx(CHANNEL, key, val, orgs=1)
+
+
+def _block(envs, flags, num=0) -> bytes:
+    blk = common_pb2.Block()
+    blk.header.number = num
+    for e in envs:
+        blk.data.data.append(e)
+    protoutil.set_tx_filter(blk, bytes(flags))
+    return blk.SerializeToString()
+
+
+class _MiniOrderer:
+    """An in-proc ab.BroadcastStream endpoint over the REAL framed-RPC
+    transport.  ``die_after`` kills the stream (handler raise -> ERR
+    frame + close) after N envelopes — a deterministic mid-stream
+    orderer death."""
+
+    def __init__(self, die_after: int | None = None):
+        self.received: list[bytes] = []
+        self._lock = threading.Lock()
+        self._die_after = die_after
+        self.srv = RPCServer("127.0.0.1", 0)
+        self.srv.register("ab.BroadcastStream", self._handle)
+        self.srv.start()
+
+    def _handle(self, body, stream):
+        while True:
+            frame = stream.recv()
+            if not frame:
+                return None
+            with self._lock:
+                self.received.append(frame)
+                n = len(self.received)
+            if self._die_after is not None and n >= self._die_after:
+                raise OSError("orderer died mid-stream (test)")
+            stream.send(b"\x00")
+
+    def count(self) -> int:
+        with self._lock:
+            return len(self.received)
+
+    def txids(self) -> set:
+        with self._lock:
+            return {txid_of(f) for f in self.received}
+
+    def connect_factory(self):
+        from fabric_tpu.comm import RPCClient
+
+        host, port = self.srv.addr
+        return lambda: RPCClient(host, port, timeout=5).duplex(
+            "ab.BroadcastStream"
+        )
+
+    def stop(self):
+        self.srv.stop()
+
+
+class _FakeStream:
+    """Socket-free duplex stream for virtual-clock tests: swallows
+    sends, recv blocks until close."""
+
+    def __init__(self, sent: list):
+        self._sent = sent
+        self._closed = threading.Event()
+
+    def send(self, body):
+        self._sent.append(body)
+
+    def finish(self):
+        pass
+
+    def recv(self):
+        self._closed.wait()
+        return None
+
+    def close(self):
+        self._closed.set()
+
+
+# ---------------------------------------------------------------------------
+# dedup idempotency
+# ---------------------------------------------------------------------------
+
+
+def test_dedup_idempotent_resubmission():
+    ord0 = _MiniOrderer()
+    provider = PrometheusProvider()
+    gw = Gateway(
+        CHANNEL, [ord0.connect_factory()],
+        metrics=GatewayMetrics(provider),
+    )
+    gw.start()
+    try:
+        env_a, env_b = _env("da"), _env("db")
+        tx_a = txid_of(env_a)
+        r1 = gw.submit(env_a)
+        assert r1.accepted and not r1.dedup and r1.txid == tx_a
+        # in-flight resubmission: answered from the dedup map, nothing
+        # new enters the send queue
+        r2 = gw.submit(env_a)
+        assert r2.accepted and r2.dedup and r2.status == STATUS_PENDING
+        r3 = gw.submit(env_b)
+        assert r3.accepted and not r3.dedup
+        _wait_until(lambda: ord0.count() == 2, msg="both txs ordered")
+        time.sleep(0.05)  # grace: a duplicate write would land now
+        assert ord0.count() == 2, "dedup let a duplicate through"
+        assert ord0.txids() == {tx_a, txid_of(env_b)}
+        # resolve A valid, B invalid; a resolved txid answers
+        # idempotently with its FINAL status
+        gw.observe_block(0, _block([env_a, env_b], [0, 1]))
+        r4 = gw.submit(env_a)
+        assert r4.accepted and r4.dedup and r4.status == STATUS_VALID
+        assert gw.submit_and_wait(env_a, timeout=1.0) == STATUS_VALID
+        assert gw.status(txid_of(env_b)) == STATUS_INVALID
+        assert gw.in_flight == 0
+        samples = parse_prometheus(provider.registry.expose())
+        hits = [v for n, _, v in samples
+                if n == "gateway_dedup_hits_total"]
+        assert hits and hits[0] >= 3.0
+    finally:
+        gw.stop()
+        ord0.stop()
+
+
+# ---------------------------------------------------------------------------
+# backpressure: reject with retry-after, recover on resolution
+# ---------------------------------------------------------------------------
+
+
+def test_backpressure_reject_and_recover():
+    ord0 = _MiniOrderer()
+    provider = PrometheusProvider()
+    gw = Gateway(
+        CHANNEL, [ord0.connect_factory()],
+        metrics=GatewayMetrics(provider),
+        min_window=1, max_window=4, initial_window=2,
+    )
+    gw.start()
+    try:
+        envs = [_env(f"bp{i}") for i in range(3)]
+        assert gw.submit(envs[0]).accepted
+        assert gw.submit(envs[1]).accepted
+        rej = gw.submit(envs[2])
+        assert not rej.accepted
+        assert rej.retry_after_s > 0.0
+        assert rej.status == STATUS_PENDING
+        # the rejected envelope was NOT admitted
+        assert gw.in_flight == 2
+        # deliver-observed resolution frees the window
+        gw.observe_block(0, _block(envs[:2], [0, 0]))
+        assert gw.in_flight == 0
+        ok = gw.submit(envs[2])
+        assert ok.accepted and not ok.dedup
+        samples = parse_prometheus(provider.registry.expose())
+        rej_n = [v for n, _, v in samples
+                 if n == "gateway_rejections_total"]
+        assert rej_n == [1.0]
+    finally:
+        gw.stop()
+        ord0.stop()
+
+
+def test_adaptive_window_follows_commit_rate():
+    gw = Gateway(
+        CHANNEL, [lambda: _FakeStream([])],
+        min_window=2, max_window=64, initial_window=8,
+        window_horizon_s=1.0,
+    )
+    # no threads needed: observe_block drives the window directly
+    with clockskew.use_virtual(clockskew.VirtualClock(start=100.0)) as clk:
+        envs = [_env(f"aw{i}") for i in range(4)]
+        gw.observe_block(0, _block(envs[:2], [0, 0], num=0))
+        clk.advance(0.1)  # 2 txs / 0.1s -> 20 tx/s
+        gw.observe_block(1, _block(envs[2:], [0, 0], num=1))
+    assert 2 <= gw.window <= 20  # EWMA-clamped, far below max
+    # a replayed block is idempotent: tail height holds
+    before = gw.window
+    gw.observe_block(0, _block(envs[:2], [0, 0], num=0))
+    assert gw.window == before
+
+
+# ---------------------------------------------------------------------------
+# failover: mid-stream orderer death, zero lost-and-unreported
+# ---------------------------------------------------------------------------
+
+
+def test_failover_orderer_death_mid_stream_zero_lost():
+    # orderer A dies deterministically after 3 envelopes; B survives
+    ord_a = _MiniOrderer(die_after=3)
+    ord_b = _MiniOrderer()
+    gw = Gateway(
+        CHANNEL,
+        [ord_a.connect_factory(), ord_b.connect_factory()],
+        max_backoff_s=0.05,
+    )
+    gw.start()
+    try:
+        envs = [_env(f"fo{i}") for i in range(10)]
+        for e in envs:
+            assert gw.submit(e).accepted
+        all_txids = {txid_of(e) for e in envs}
+        # every accepted envelope must reach the SURVIVING orderer:
+        # the dead one may have dropped any of its 3, so all
+        # sent-but-unresolved envelopes are resubmitted
+        _wait_until(
+            lambda: ord_b.txids() >= all_txids,
+            msg="survivor ordered every accepted tx",
+        )
+        assert gw.failovers >= 1
+        # deterministic rotation: index 0 first, then index 1
+        log = list(gw.endpoint_log)
+        assert log[0] == 0 and 1 in log
+        # commit everything -> every accepted tx has a definitive
+        # status (zero lost-and-unreported)
+        gw.observe_block(0, _block(envs, [0] * len(envs)))
+        assert gw.in_flight == 0
+        assert all(
+            gw.status(t) == STATUS_VALID for t in all_txids
+        )
+    finally:
+        gw.stop()
+        ord_a.stop()
+        ord_b.stop()
+
+
+def test_submit_after_stream_loss_still_delivers():
+    # death between submissions: the gateway reconnects lazily on the
+    # next write, not only when traffic is already flowing
+    ord_a = _MiniOrderer(die_after=1)
+    ord_b = _MiniOrderer()
+    gw = Gateway(
+        CHANNEL,
+        [ord_a.connect_factory(), ord_b.connect_factory()],
+        max_backoff_s=0.05,
+    )
+    gw.start()
+    try:
+        e0 = _env("ls0")
+        gw.submit(e0)
+        _wait_until(lambda: ord_a.count() >= 1, msg="first tx ordered")
+        _wait_until(lambda: gw.failovers >= 1, msg="stream loss noticed")
+        e1 = _env("ls1")
+        gw.submit(e1)
+        _wait_until(
+            lambda: ord_b.txids() >= {txid_of(e0), txid_of(e1)},
+            msg="both txs on the survivor",
+        )
+    finally:
+        gw.stop()
+        ord_a.stop()
+        ord_b.stop()
+
+
+# ---------------------------------------------------------------------------
+# commit-status timeout under the virtual clock (no real sleeps)
+# ---------------------------------------------------------------------------
+
+
+def test_wait_timeout_resolves_definitively_virtual_clock():
+    sent: list = []
+    gw = Gateway(CHANNEL, [lambda: _FakeStream(sent)])
+    gw.start()
+    try:
+        with clockskew.use_virtual(clockskew.VirtualClock(start=50.0)):
+            env = _env("to0")
+            txid = txid_of(env)
+            assert gw.submit(env).accepted
+            t0 = time.monotonic()
+            st = gw.wait(txid, timeout=30.0)
+            real = time.monotonic() - t0
+            assert st == STATUS_TIMEOUT
+            assert real < 5.0, "virtual-clock wait slept for real"
+            # the expiry RESOLVED the record: window freed, status
+            # definitive, a late commit cannot flip it
+            assert gw.in_flight == 0
+            gw.observe_block(0, _block([env], [0]))
+            assert gw.status(txid) == STATUS_TIMEOUT
+            assert gw.submit(env).status == STATUS_TIMEOUT
+    finally:
+        gw.stop()
+
+
+def test_stop_resolves_pending_to_timeout():
+    sent: list = []
+    gw = Gateway(CHANNEL, [lambda: _FakeStream(sent)])
+    gw.start()
+    envs = [_env(f"sp{i}") for i in range(3)]
+    for e in envs:
+        assert gw.submit(e).accepted
+    gw.stop()
+    # shutdown reports, it never silently drops
+    assert gw.in_flight == 0
+    for e in envs:
+        assert gw.status(txid_of(e)) == STATUS_TIMEOUT
+
+
+# ---------------------------------------------------------------------------
+# faultline: observer-plan discovery + seeded mid-stream loss
+# ---------------------------------------------------------------------------
+
+
+def test_observer_plan_discovers_gateway_points():
+    faultline.reset_registry()
+    ord_a = _MiniOrderer(die_after=2)
+    ord_b = _MiniOrderer()
+    with faultline.observe():
+        gw = Gateway(
+            CHANNEL,
+            [ord_a.connect_factory(), ord_b.connect_factory()],
+            max_backoff_s=0.05,
+        )
+        gw.start()
+        try:
+            envs = [_env(f"ob{i}") for i in range(4)]
+            for e in envs:
+                gw.submit(e)
+            _wait_until(lambda: gw.failovers >= 1, msg="failover")
+            _wait_until(
+                lambda: ord_b.txids() >= {txid_of(e) for e in envs},
+                msg="survivor ordered everything",
+            )
+            gw.observe_block(0, _block(envs, [0] * 4))
+        finally:
+            gw.stop()
+            ord_a.stop()
+            ord_b.stop()
+        assert faultline.trips() == []  # observer never fires
+    reg = faultline.registry()
+    for point in (
+        "gateway.admission",
+        "gateway.stream.write",
+        "gateway.failover",
+        "gateway.status.resolve",
+    ):
+        assert point in reg, f"{point} missing from discovery"
+        assert reg[point]["kinds"] == ["point"]
+    faultline.reset_registry()
+
+
+def test_seeded_raise_at_stream_write_takes_failover_path():
+    # an armed raise at gateway.stream.write IS a torn mid-stream
+    # write: same requeue + failover + resubmit path, and the tx still
+    # reaches a definitive status
+    ord_a = _MiniOrderer()
+    ord_b = _MiniOrderer()
+    gw = Gateway(
+        CHANNEL,
+        [ord_a.connect_factory(), ord_b.connect_factory()],
+        max_backoff_s=0.05,
+    )
+    gw.start()
+    try:
+        with faultline.use_plan({"label": "gw-loss", "faults": [
+            {"point": "gateway.stream.write", "action": "raise",
+             "error": "OSError", "count": 1},
+        ]}):
+            envs = [_env(f"sr{i}") for i in range(5)]
+            for e in envs:
+                assert gw.submit(e).accepted
+            all_txids = {txid_of(e) for e in envs}
+            _wait_until(lambda: gw.failovers >= 1, msg="injected loss")
+            _wait_until(
+                lambda: ord_a.txids() | ord_b.txids() >= all_txids,
+                msg="every tx ordered despite the injected loss",
+            )
+            trips = faultline.trips()
+            assert any(
+                t["point"] == "gateway.stream.write" for t in trips
+            )
+        gw.observe_block(0, _block(envs, [0] * 5))
+        assert all(gw.status(t) == STATUS_VALID for t in all_txids)
+        assert gw.in_flight == 0
+    finally:
+        gw.stop()
+        ord_a.stop()
+        ord_b.stop()
+
+
+# ---------------------------------------------------------------------------
+# the real thing: orderer SIGKILL mid-stream under the netharness
+# ---------------------------------------------------------------------------
+
+
+def test_gateway_survives_orderer_kill9_multiprocess(tmp_path):
+    from fabric_tpu.devtools import netharness as nh
+
+    topo = nh.Topology(orgs=1, peers_per_org=2, orderers=3, seed=7)
+    # the gateway's deterministic rotation starts at index 0 — SIGKILL
+    # exactly the orderer it is streaming to, mid-stream
+    schedule = [nh.KillRule(
+        node=topo.orderer_names()[0], at_height=3, sig="kill9",
+        rejoin="restart", restart_after_s=0.5,
+    )]
+    with nh.Network(str(tmp_path / "net"), topo) as net:
+        net.start()
+        result = nh.run_stream(
+            net, txs=80, kill_schedule=schedule, settle_timeout_s=120,
+            driver="gateway",
+        )
+    assert result["errors"] == []
+    assert result["ok"], result
+    assert result["state_digests_agree"]
+    assert result["missing"] == []
+    gwd = result["gateway"]
+    # the SIGKILL produced at least one failover to a DIFFERENT index,
+    # and every accepted tx resolved before stop (zero unreported)
+    assert gwd["failovers"] >= 1, gwd
+    assert len(set(gwd["endpoint_log"])) >= 2, gwd
+    assert gwd["unresolved_at_stop"] == 0, gwd
