@@ -1,12 +1,23 @@
-"""Block storage: append-only block files + KV index.
+"""Block storage: preallocated-segment block files + KV index.
 
 Reference: common/ledger/blkstorage (blockfile_mgr.go append-only files,
 blockindex.go number/hash/txid indexes, restart recovery via checkpoint +
 tail scan, blocks_itr.go iterators).  Same design: length-prefixed
 serialized blocks in rolling .dat files, an index in the KVStore SPI, and
-crash recovery that re-indexes complete trailing records and truncates a
+crash recovery that re-indexes complete trailing records and erases a
 torn final write.  `dir=None` keeps blocks in memory (test/ephemeral
 ledgers, the reference's ramledger role).
+
+Storage engine v2 (the segment writer): each .dat file is PREALLOCATED
+to a fixed segment size (fallocate-style, FABRIC_TPU_STORE_SEGMENT) when
+it is created — a temp-file + rename + directory fsync, the only
+metadata fsync on the commit path.  Records then land INSIDE already-
+allocated space at the checkpoint offset, so the group-boundary
+durability barrier is fdatasync (data pages only; the inode's size never
+moves per append), not the grow-on-append fsync stream the v1 writer
+paid.  A zero length-header marks the clean preallocated tail during
+recovery; segment roll trims the sealed file to its data and starts the
+next preallocated segment.
 """
 
 from __future__ import annotations
@@ -21,7 +32,35 @@ from fabric_tpu.protos.common import common_pb2
 from fabric_tpu import protoutil
 
 _LEN = struct.Struct(">I")
-ROLL_SIZE = 64 * 1024 * 1024
+
+DEFAULT_SEGMENT = 16 * 1024 * 1024
+_MIN_SEGMENT = 4096
+
+
+def segment_size(override: int | None = None) -> int:
+    """FABRIC_TPU_STORE_SEGMENT: block-file segment prealloc size in
+    bytes (k/m suffixes accepted, e.g. ``64k`` / ``16m``; default
+    16 MiB, floor 4 KiB).  Larger segments amortize the prealloc +
+    rename metadata cost over more blocks; smaller ones bound the zero
+    tail a mostly-idle channel keeps on disk."""
+    if override is not None:
+        return max(_MIN_SEGMENT, int(override))
+    raw = os.environ.get("FABRIC_TPU_STORE_SEGMENT", "").strip().lower()
+    if not raw:
+        return DEFAULT_SEGMENT
+    mult = 1
+    if raw.endswith("k"):
+        mult, raw = 1024, raw[:-1]
+    elif raw.endswith("m"):
+        mult, raw = 1024 * 1024, raw[:-1]
+    try:
+        n = int(raw) * mult
+    except ValueError:
+        raise ValueError(
+            f"FABRIC_TPU_STORE_SEGMENT={raw!r} is not a byte size "
+            "(integer, optionally with a k/m suffix)"
+        ) from None
+    return max(_MIN_SEGMENT, n)
 
 # bootstrap-from-snapshot info: ">Q" last snapshot block number + its
 # header hash (reference blkstorage bootstrappingSnapshotInfo)
@@ -50,13 +89,19 @@ def read_bootstrap_height(index_store: KVStore, name: str) -> int:
 
 
 class BlockStore:
-    def __init__(self, dir: str | None, index_store: KVStore | None = None, name: str = "chain"):
+    def __init__(self, dir: str | None, index_store: KVStore | None = None,
+                 name: str = "chain", segment: int | None = None):
         self._dir = dir
         self._index = NamedDB(index_store or MemKVStore(), f"blkindex/{name}")
         self._lock = threading.RLock()
         self._mem_blocks: list[bytes] | None = [] if dir is None else None
         self._height = 0
         self._last_hash = b""
+        self._segment = segment_size(segment)
+        # cached writer handle for the active segment (r+b: writes land
+        # inside preallocated space at the checkpoint offset)
+        self._fh = None
+        self._fh_idx = -1
         if dir is not None:
             os.makedirs(dir, exist_ok=True)
             self._recover()
@@ -85,58 +130,68 @@ class BlockStore:
                 self._last_hash = raw[8:]
 
     def _recover(self) -> None:
-        """Re-index any blocks appended after the last checkpoint;
-        truncate from the first damaged record on (reference
-        blockfile_helper scanForLastCompleteBlock).  Group commits
-        append several records between fsyncs, so a crash can tear a
-        NON-tail record (writeback order is not guaranteed): any record
-        that fails to parse, or whose number breaks the contiguous
-        chain (a hole's garbage can "parse" — e.g. zeroed pages decode
-        to an empty block 0), ends the replayable prefix — everything
-        from there on was never acknowledged durable and is dropped."""
+        """Re-index any blocks appended after the last checkpoint; erase
+        from the first damaged record on (reference blockfile_helper
+        scanForLastCompleteBlock).  Group commits append several records
+        between data barriers, so a crash can tear a NON-tail record
+        (writeback order is not guaranteed): any record that fails to
+        parse, or whose number breaks the contiguous chain (a hole's
+        garbage can "parse" — e.g. zeroed pages decode to an empty
+        block 0), ends the replayable prefix — everything from there on
+        was never acknowledged durable and is dropped.  A ZERO length
+        header is the clean preallocated tail (fallocated space no
+        record ever reached), not damage: the scan stops there without
+        erasing anything."""
         file_idx, offset, height = self._checkpoint()
         self._height = height
         scanned: set[int] = set()
+        # stray prealloc temp: a crash between fallocate and rename
+        # left a segment that never atomically appeared — discard it
+        for fn in os.listdir(self._dir):
+            if fn.endswith(".pre"):
+                os.remove(os.path.join(self._dir, fn))
         while True:
             path = self._file_path(file_idx)
             if not os.path.exists(path):
                 break
             size = os.path.getsize(path)
+            torn = False
             with open(path, "rb") as f:
                 f.seek(offset)
                 while True:
                     hdr = f.read(_LEN.size)
                     if len(hdr) < _LEN.size:
+                        torn = len(hdr) > 0
                         break
                     (n,) = _LEN.unpack(hdr)
+                    if n == 0:
+                        break  # clean preallocated tail
                     raw = f.read(n)
-                    if n == 0 or len(raw) < n:
+                    if len(raw) < n:
+                        torn = True  # length header promises absent bytes
                         break
                     try:
                         blk = common_pb2.Block.FromString(raw)
                     except Exception:
-                        # fabriclint: allow[exception-discipline] break IS the
-                        # structured outcome: a torn record delimits the
-                        # recoverable prefix during crash recovery
+                        torn = True
                         break  # torn mid-file record: prefix ends here
                     if blk.header.number != self._height:
+                        torn = True
                         break  # non-contiguous: damaged or stale bytes
                     self._index_block(blk, file_idx, offset)
                     offset += _LEN.size + n
                     self._height = blk.header.number + 1
                     scanned.add(file_idx)
-            if offset < size:
+            if torn:
                 # guard-style fault point: a faultfuzz "skip" rule
-                # deletes this protection, leaving the torn tail in
-                # place — the next O_APPEND write then lands AFTER the
-                # garbage while the index records the pre-garbage
-                # offset, exactly the corruption the invariant oracle
-                # must catch (the seeded-violation acceptance case)
+                # deletes this protection, leaving the torn bytes past
+                # the checkpoint — defense in depth the campaign may
+                # probe (the next in-segment write overwrites from the
+                # checkpoint offset, so the scan never trusts them)
                 if faultline.guard(
                     "blkstorage.recovery_truncate", file=file_idx
                 ):
-                    with open(path, "r+b") as f:
-                        f.truncate(offset)
+                    self._erase_tail(path, offset, size)
                 scanned.add(file_idx)
             next_path = self._file_path(file_idx + 1)
             if os.path.exists(next_path):
@@ -163,6 +218,85 @@ class BlockStore:
 
     def _write_checkpoint(self, file_idx: int, offset: int) -> None:
         self._index.put(b"cp", struct.pack(">QQQ", file_idx, offset, self._height))
+
+    # -- segment plumbing (storage engine v2) ------------------------------
+
+    def _erase_tail(self, path: str, offset: int, size: int) -> None:
+        """Zero a damaged tail: truncate away everything past the last
+        complete record, then re-extend to the segment floor so the
+        file stays preallocated (extension fills with zeros — the clean
+        tail the scan recognizes)."""
+        with open(path, "r+b") as f:
+            f.truncate(offset)
+            if offset < self._segment and size >= self._segment:
+                f.truncate(self._segment)
+
+    def _sync_dir(self) -> None:
+        fd = os.open(self._dir, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+
+    def _prealloc_segment(self, idx: int, size: int) -> None:
+        """Create segment `idx` atomically: allocate + fsync a temp
+        file, then rename it into place and fsync the directory — the
+        only metadata fsync the write path ever pays.  A crash before
+        the rename leaves a stray .pre that recovery discards; after
+        it, an all-zero segment (a clean tail at offset 0)."""
+        path = self._file_path(idx)
+        tmp = path + ".pre"
+        fd = os.open(tmp, os.O_CREAT | os.O_WRONLY | os.O_TRUNC, 0o644)
+        try:
+            try:
+                os.posix_fallocate(fd, 0, size)
+            except (AttributeError, OSError):
+                os.ftruncate(fd, size)  # sparse fallback
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+        faultline.point("blkstorage.segment_prealloc", file=idx, size=size)
+        os.rename(tmp, path)
+        self._sync_dir()
+
+    def _segment_fh(self, idx: int):
+        """The cached r+b handle for segment `idx`, preallocating the
+        file on first touch."""
+        if self._fh is not None and self._fh_idx == idx:
+            return self._fh
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+        path = self._file_path(idx)
+        if not os.path.exists(path):
+            self._prealloc_segment(idx, self._segment)
+        self._fh = open(path, "r+b")
+        self._fh_idx = idx
+        return self._fh
+
+    def _seal_segment(self, idx: int, data_size: int) -> None:
+        """Segment roll: trim the sealed file to exactly its records
+        (dropping the preallocated zero tail) and make the new size
+        durable; the successor segment is preallocated on first write.
+        Crash-idempotent — rerolling recomputes the same trim from the
+        committed checkpoint."""
+        faultline.point("blkstorage.segment_roll", file=idx, size=data_size)
+        f = self._segment_fh(idx)
+        f.truncate(data_size)
+        f.flush()
+        os.fsync(f.fileno())  # size change: metadata must be durable
+        self._fh.close()
+        self._fh = None
+        self._fh_idx = -1
+
+    def close(self) -> None:
+        """Release the cached segment writer handle (providers close
+        their ledgers' stores on shutdown; in-memory stores no-op)."""
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+                self._fh_idx = -1
 
     @staticmethod
     def _parse_txid(raw_env: bytes) -> str | None:
@@ -342,24 +476,24 @@ class BlockStore:
                 file_idx = None
             else:
                 file_idx, offset, _ = self._checkpoint(index)
-                if offset > ROLL_SIZE:
+                rec = _LEN.size + len(raw)
+                if offset > 0 and offset + rec > self._segment:
+                    self._seal_segment(file_idx, offset)
                     file_idx += 1
                     offset = 0
-                path = self._file_path(file_idx)
-                with open(path, "ab") as f:
-                    if f.tell() != offset:
-                        f.seek(offset)
-                    # faultline seam: a 'torn' fault writes a prefix of
-                    # the record and crashes — the mid-record tear the
-                    # recovery scan must truncate
-                    faultline.write(
-                        "blkstorage.file_append", f,
-                        _LEN.pack(len(raw)), raw,
-                        block=blk.header.number,
-                    )
-                    f.flush()
-                    if sync:
-                        os.fsync(f.fileno())
+                f = self._segment_fh(file_idx)
+                f.seek(offset)
+                # faultline seam: a 'torn' fault writes a prefix of
+                # the record and crashes — the mid-record tear the
+                # recovery scan must erase
+                faultline.write(
+                    "blkstorage.file_append", f,
+                    _LEN.pack(len(raw)), raw,
+                    block=blk.header.number,
+                )
+                f.flush()
+                if sync:
+                    os.fdatasync(f.fileno())
                 self._height += 1
                 self._index_block(
                     blk, file_idx, offset, txids,
@@ -383,14 +517,21 @@ class BlockStore:
             if self._mem_blocks is not None:
                 del self._mem_blocks[offset:]
             else:
+                if self._fh is not None:
+                    self._fh.close()
+                    self._fh = None
+                    self._fh_idx = -1
                 i = file_idx + 1
                 while os.path.exists(self._file_path(i)):
                     os.remove(self._file_path(i))
                     i += 1
                 path = self._file_path(file_idx)
                 if os.path.exists(path):
-                    with open(path, "r+b") as f:
-                        f.truncate(offset)
+                    # zero the unindexed appends but keep the segment
+                    # preallocated (re-extension fills with zeros)
+                    self._erase_tail(
+                        path, offset, os.path.getsize(path)
+                    )
             self._height = height
             self._last_hash = b""
             if height > 0:
@@ -402,16 +543,18 @@ class BlockStore:
                     self._last_hash = raw[8:] if raw is not None else b""
 
     def sync_files(self, file_idxs) -> None:
-        """fsync the given block files — the group-commit boundary call
-        that makes every append since the last sync durable in one
-        device flush per touched file (usually exactly one)."""
+        """Make every append since the last barrier durable — ONE
+        coalesced fdatasync per touched segment per group (usually
+        exactly one).  fdatasync suffices: appends land inside
+        preallocated space, so the inode's size/metadata never moves on
+        the commit path (prealloc and roll pay the metadata fsyncs)."""
         if self._mem_blocks is not None:
             return
         for idx in sorted(file_idxs):
             faultline.point("blkstorage.fsync", file=idx)
             fd = os.open(self._file_path(idx), os.O_RDONLY)
             try:
-                os.fsync(fd)
+                os.fdatasync(fd)
             finally:
                 os.close(fd)
 
@@ -472,4 +615,10 @@ class BlockStore:
             num += 1
 
 
-__all__ = ["BlockStore", "BlockStoreError", "read_bootstrap_height"]
+__all__ = [
+    "BlockStore",
+    "BlockStoreError",
+    "read_bootstrap_height",
+    "segment_size",
+    "DEFAULT_SEGMENT",
+]

@@ -254,6 +254,179 @@ def test_pinned_parallel_prepare_crash_plan(tmp_path, monkeypatch):
     assert first == second
 
 
+# -- storage engine v2: the two-phase group-flush torn points ----------------
+
+
+@pytest.mark.parametrize("stage", ["prepare", "commit", "apply"])
+def test_crash_at_every_shard_flush_stage_recovers(
+    tmp_path, stage, monkeypatch
+):
+    """The sharded statedb's two-phase flush, crashed at each of its
+    three torn points.  The block record is durable BEFORE the kv flush
+    starts, so every arm must land at the same height 3 — what differs
+    is the recovery arm: a crash at prepare or at the coordinator-commit
+    point leaves a pending epoch AHEAD of the committed one (roll back
+    ALL shards, replay block 2 from the file), while a crash at apply
+    leaves pending == committed (roll the staged writes FORWARD — the
+    coordinator savepoint already acknowledged them)."""
+    monkeypatch.setenv("FABRIC_TPU_STORE_SHARDS", "2")
+    monkeypatch.setenv("FABRIC_TPU_STORE_POOL", "0")
+    provider = LedgerProvider(str(tmp_path))
+    ledger = provider.open("chaos")
+    ledger.commit(_write_block(ledger, 0, [("cc", "a", b"0")]))
+    ledger.commit(_write_block(ledger, 1, [("qscc", "b", b"1")]))
+
+    # two namespaces so both shards carry staged writes at the crash
+    blk2 = _write_block(
+        ledger, 2, [("cc", "c", b"2"), ("qscc", "d", b"3")]
+    )
+    with faultline.use_plan(
+        _crash_plan("store.shard_flush", {"stage": stage})
+    ):
+        with pytest.raises(faultline.FaultCrash):
+            ledger.commit(blk2)
+        assert faultline.trips(), "the plan never fired"
+    provider.close()
+
+    # reopen under the observer: recovery's own seam tells the two arms
+    # apart — only the apply crash leaves a committed-but-unapplied
+    # epoch for the roll-forward guard to resolve
+    faultline.reset_registry()
+    with faultline.observe():
+        provider2 = LedgerProvider(str(tmp_path))
+        led2 = provider2.open("chaos")
+    rolled_forward = "store.shard_recover" in faultline.registry()
+    assert rolled_forward == (stage == "apply"), faultline.registry()
+
+    _assert_consistent(led2, 3, {
+        ("cc", "a"): b"0", ("qscc", "b"): b"1",
+        ("cc", "c"): b"2", ("qscc", "d"): b"3",
+    })
+    led2.commit(_write_block(led2, 3, [("cc", "next", b"n")]))
+    assert led2.get_state("cc", "next") == b"n"
+    provider2.close()
+
+
+def test_graceful_raise_at_coordinator_txn_rolls_back_shards(
+    tmp_path, monkeypatch
+):
+    """A raise-style fault (graceful failure) at the coordinator txn
+    AFTER both shards staged their pending writes: the ledger rolls the
+    group back, the staged epochs stay invisible to reads, and the next
+    commit's prepare sweeps them — no reopen required."""
+    monkeypatch.setenv("FABRIC_TPU_STORE_SHARDS", "2")
+    monkeypatch.setenv("FABRIC_TPU_STORE_POOL", "0")
+    provider = LedgerProvider(str(tmp_path))
+    ledger = provider.open("chaos")
+    ledger.commit(_write_block(ledger, 0, [("cc", "a", b"0")]))
+    blk1 = _write_block(
+        ledger, 1, [("cc", "b", b"1"), ("qscc", "c", b"2")]
+    )
+    with faultline.use_plan({"faults": [{
+        "point": "kvstore.txn", "action": "raise", "error": "OSError",
+        "message": "injected disk full",
+    }]}):
+        with pytest.raises(OSError, match="injected disk full"):
+            ledger.commit(blk1)
+        assert faultline.trips()
+    assert ledger.height == ledger.durable_height == 1
+    assert ledger.get_state("cc", "b") is None
+    assert ledger.get_state("qscc", "c") is None
+    ledger.commit(_write_block(
+        ledger, 1, [("cc", "b", b"1"), ("qscc", "c", b"2")]
+    ))
+    assert ledger.get_state("qscc", "c") == b"2"
+    provider.close()
+
+
+def test_pinned_shard_flush_crash_plan_deterministic(tmp_path, monkeypatch):
+    """Pinned seeded plan over the storage-v2 seams: a crash inside the
+    fanned-out shard prepare (store.shard_flush, targeted at one shard's
+    prepare so the trip is deterministic even with pool workers racing)
+    aborts the kv flush after the block record is durable; reopen rolls
+    the staged epochs back and replays the block from the file.  Two
+    runs yield identical trip ledgers — the chaos determinism contract
+    extended to the new seams."""
+    monkeypatch.setenv("FABRIC_TPU_STORE_SHARDS", "4")
+    monkeypatch.setenv("FABRIC_TPU_STORE_POOL", "3")
+    plan = {"seed": 17, "faults": [{
+        "point": "store.shard_flush",
+        "ctx": {"stage": "prepare", "shard": 2},
+        "action": "crash",
+    }]}
+    # namespaces spread across all 4 shards so the fan-out is real
+    items = [
+        (f"ns{j}", f"k{i}", b"v") for j in range(8) for i in range(4)
+    ]
+
+    def run(sub: str) -> list[dict]:
+        provider = LedgerProvider(str(tmp_path / sub))
+        ledger = provider.open("chaos")
+        ledger.commit(_write_block(ledger, 0, [("ns0", "a", b"0")]))
+        blk = _write_block(ledger, 1, items)
+        with faultline.use_plan(plan):
+            with pytest.raises(faultline.FaultCrash):
+                ledger.commit(blk)
+            observed = [
+                t for t in faultline.trips() if t["plan"] != "soak"
+            ]
+        assert observed and all(
+            t["point"] == "store.shard_flush"
+            and t["ctx"]["shard"] == 2
+            for t in observed
+        )
+        provider.close()
+
+        provider2 = LedgerProvider(str(tmp_path / sub))
+        led2 = provider2.open("chaos")
+        _assert_consistent(led2, 2, {
+            ("ns0", "a"): b"0", ("ns1", "k0"): b"v",
+        })
+        led2.commit(_write_block(led2, 2, [("ns2", "z", b"z")]))
+        assert led2.get_state("ns2", "z") == b"z"
+        provider2.close()
+        return observed
+
+    first, second = run("r1"), run("r2")
+    assert first == second
+
+
+@pytest.mark.parametrize(
+    "point", ["blkstorage.segment_prealloc", "blkstorage.segment_roll"],
+)
+def test_crash_at_segment_lifecycle_points_recovers(
+    tmp_path, point, monkeypatch
+):
+    """The preallocated-segment writer's metadata seams: a crash while
+    preallocating the next segment (before its rename publishes it) or
+    while sealing a full one must leave the committed chain fully
+    replayable — segment lifecycle is bookkeeping, never data loss.  A
+    tiny segment floor forces a roll on the second block."""
+    monkeypatch.setenv("FABRIC_TPU_STORE_SEGMENT", "4096")
+    provider = LedgerProvider(str(tmp_path))
+    ledger = provider.open("chaos")
+    big = b"x" * 3000  # ~3KB payload: two records cannot share 4KB
+    ledger.commit(_write_block(ledger, 0, [("cc", "a", big)]))
+
+    blk1 = _write_block(ledger, 1, [("cc", "b", big)])
+    with faultline.use_plan(_crash_plan(point)):
+        with pytest.raises(faultline.FaultCrash):
+            ledger.commit(blk1)
+        assert faultline.trips(), "the plan never fired"
+    provider.close()
+
+    provider2 = LedgerProvider(str(tmp_path))
+    led2 = provider2.open("chaos")
+    # block 1 never reached the (unpublished or mid-seal) segment —
+    # recovery lands at height 1 and the same block re-commits into a
+    # freshly preallocated segment
+    _assert_consistent(led2, 1, {("cc", "a"): big, ("cc", "b"): None})
+    led2.commit(_write_block(led2, 1, [("cc", "b", big)]))
+    assert led2.get_state("cc", "b") == big
+    assert led2.height == 2
+    provider2.close()
+
+
 def test_same_seed_same_trip_ledger_across_runs(tmp_path):
     """Determinism acceptance: the same plan over the same workload
     yields an IDENTICAL trip ledger across two runs — seeded

@@ -2,7 +2,7 @@
 canned workload, fixed-seed campaign determinism (the acceptance pin:
 two 25-plan seed-7 campaigns produce byte-identical verdicts and
 canonical trip ledgers), an intentionally-seeded oracle violation
-(torn append + skipped recovery truncation) caught, shrunk to its
+(shard-apply crash + skipped shard roll-forward) caught, shrunk to its
 2-rule minimum, and replayable from the repro artifact, the snapshot
 export/import fault points (torn manifest refused, half-import refused
 loudly), and the tier-1 soak mode (slow): the commit+snapshot workload
@@ -97,14 +97,16 @@ _SEEDED_PLAN = {
     "seed": 3,
     "label": "seeded",
     "faults": [
-        # a torn append crashes block 3's commit once...
-        {"point": "blkstorage.file_append", "action": "torn",
-         "cut": 0.5, "ctx": {"block": 3}, "count": 1},
-        # ...and the recovery scan's truncation guard is SKIPPED, so
-        # the torn tail stays and the re-commit lands after it while
-        # the index records the pre-garbage offset
-        {"point": "blkstorage.recovery_truncate", "action": "skip",
-         "count": 5},
+        # a crash at the first shard-apply: the coordinator txn
+        # (savepoint + block index + epoch record) is already durable,
+        # the shard's staged writes are not yet folded in...
+        {"point": "store.shard_flush", "action": "crash",
+         "ctx": {"stage": "apply"}, "count": 1},
+        # ...and the reopen roll-forward guard is SKIPPED, so the
+        # committed-but-unapplied pending writes are silently dropped
+        # while the savepoint says the block committed — lost state
+        # below the recovered height
+        {"point": "store.shard_recover", "action": "skip", "count": 5},
     ],
 }
 
@@ -117,7 +119,7 @@ def test_seeded_violation_caught_shrunk_and_replayable(tmp_path):
     res = faultfuzz.run_plan(_SEEDED_PLAN, str(tmp_path / "run"))
     assert res["violations"], "the seeded violation was not caught"
     checks = {v["check"] for v in res["violations"]}
-    assert checks & {"reopen", "chain"}, res["violations"]
+    assert checks & {"state", "reopen"}, res["violations"]
 
     # dropping either rule individually passes — the pair is minimal
     counter = [0]
@@ -131,7 +133,7 @@ def test_seeded_violation_caught_shrunk_and_replayable(tmp_path):
     shrunk, runs = faultfuzz.shrink_plan(_SEEDED_PLAN, still_fails)
     assert len(shrunk["faults"]) == 2
     assert {f["point"] for f in shrunk["faults"]} == {
-        "blkstorage.file_append", "blkstorage.recovery_truncate",
+        "store.shard_flush", "store.shard_recover",
     }
     assert runs >= 2  # it really tried to drop both
 
@@ -144,7 +146,7 @@ def test_seeded_violation_caught_shrunk_and_replayable(tmp_path):
     replayed = faultfuzz.replay(path, str(tmp_path / "replay"))
     assert replayed["violations"], "the repro artifact did not reproduce"
     assert {v["check"] for v in replayed["violations"]} & \
-        {"reopen", "chain"}
+        {"state", "reopen"}
 
 
 def test_campaign_writes_repro_for_failing_plan(tmp_path):

@@ -37,13 +37,17 @@ def _write_block(ledger, num, items):
 
 class _Counts:
     """Count base-store KV transactions (every SqliteKVStore write
-    entrypoint is one sqlite txn) and block-file fsyncs."""
+    entrypoint is one sqlite txn) and block-file data barriers (the
+    segment writer's fdatasync; segment prealloc/roll metadata fsyncs
+    are NOT commit-path barriers and are counted separately)."""
 
     def __init__(self, monkeypatch):
         self.txns = 0
         self.fsyncs = 0
+        self.meta_fsyncs = 0
         real_wb = SqliteKVStore.write_batch
         real_wba = SqliteKVStore.write_batch_if_absent
+        real_fdatasync = blkstorage.os.fdatasync
         real_fsync = blkstorage.os.fsync
 
         def wb(store, puts, deletes=()):
@@ -54,16 +58,21 @@ class _Counts:
             self.txns += 1
             return real_wba(store, puts)
 
-        def fs(fd):
+        def fds(fd):
             self.fsyncs += 1
+            return real_fdatasync(fd)
+
+        def fs(fd):
+            self.meta_fsyncs += 1
             return real_fsync(fd)
 
         monkeypatch.setattr(SqliteKVStore, "write_batch", wb)
         monkeypatch.setattr(SqliteKVStore, "write_batch_if_absent", wba)
+        monkeypatch.setattr(blkstorage.os, "fdatasync", fds)
         monkeypatch.setattr(blkstorage.os, "fsync", fs)
 
     def reset(self):
-        self.txns = self.fsyncs = 0
+        self.txns = self.fsyncs = self.meta_fsyncs = 0
 
 
 def test_write_batch_collector_contract():

@@ -334,9 +334,9 @@ def test_campaign_writes_profile_artifact_next_to_repro(
     repro JSON when profscope is armed (the trace-artifact contract)."""
     seeded = {
         "faults": [
-            {"point": "blkstorage.file_append", "action": "torn",
-             "cut": 0.5, "ctx": {"block": 3}, "count": 1},
-            {"point": "blkstorage.recovery_truncate", "action": "skip",
+            {"point": "store.shard_flush", "action": "crash",
+             "ctx": {"stage": "apply"}, "count": 1},
+            {"point": "store.shard_recover", "action": "skip",
              "count": 5},
         ],
     }

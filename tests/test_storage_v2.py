@@ -1,0 +1,345 @@
+"""Storage engine v2 (ISSUE 17 tentpole): the namespace-sharded statedb
+behind the KVStore SPI and the preallocated-segment block writer.
+
+The acceptance contracts pinned here:
+
+* **serial parity** — the sharded store is an implementation detail:
+  the same workload at shard widths 1 / 2 / 4 (and at every flush
+  fan-out width) produces a byte-identical ``invariants.state_digest``
+  and identical chain tails;
+* **recovery idempotence** — reopening after a crash is a fixed point:
+  a second reopen changes nothing, at every shard width;
+* **snapshot portability** — export from a sharded store imports into
+  a store of a DIFFERENT width and the digests agree (the snapshot
+  stream is the canonical form, not the shard layout);
+* **persisted layout wins** — the shard count recorded at creation
+  overrides the env knob on reopen, so routing never drifts;
+* **segment hygiene** — a clean preallocated (zero) tail is NOT
+  recovery damage; sealed segments are trimmed to data size; records
+  larger than a segment still land and replay.
+"""
+
+import os
+import struct
+
+import pytest
+
+from fabric_tpu.devtools import faultline, invariants
+from fabric_tpu.ledger import LedgerProvider
+from fabric_tpu.ledger.blkstorage import DEFAULT_SEGMENT, segment_size
+from fabric_tpu.ledger.kvstore import (
+    ShardedKVStore,
+    SqliteKVStore,
+    open_store_root,
+    shard_of_namespace,
+    state_shard,
+    store_shards,
+)
+
+from test_group_commit import _write_block
+
+
+WORKLOAD = [
+    [("cc", "a", b"0"), ("qscc", "q", b"config")],
+    [("cc", "b", b"1"), ("lscc", "l", b"deploy")],
+    [("cc\x00pvt\x00col", "p", b"private"), ("cc", "c", b"2")],
+    [("basic", "k", b"3"), ("qscc", "q", b"config2")],
+]
+
+
+def _build(root, monkeypatch, shards, pool="0"):
+    monkeypatch.setenv("FABRIC_TPU_STORE_SHARDS", str(shards))
+    monkeypatch.setenv("FABRIC_TPU_STORE_POOL", pool)
+    provider = LedgerProvider(str(root))
+    ledger = provider.open("v2")
+    for n, items in enumerate(WORKLOAD):
+        ledger.commit(_write_block(ledger, n, items))
+    return provider, ledger
+
+
+# -- serial parity ------------------------------------------------------------
+
+
+def test_serial_vs_sharded_parity_byte_identical(tmp_path, monkeypatch):
+    """Shard width (and flush fan-out width) never changes RESULTS:
+    state digest, chain tail, and raw state export are byte-identical
+    at widths 1 / 2 / 4, serial and pooled."""
+    outputs = []
+    for name, shards, pool in (
+        ("w1", 1, "0"), ("w2", 2, "0"), ("w4", 4, "0"), ("w4p", 4, "3"),
+    ):
+        provider, ledger = _build(tmp_path / name, monkeypatch,
+                                  shards, pool)
+        # chain hashes carry wall-clock header timestamps, so parity is
+        # judged on the STORE: digest, raw export stream, height
+        outputs.append((
+            invariants.state_digest(ledger),
+            list(ledger.state_db.export_records()),
+            ledger.height,
+        ))
+        assert invariants.check_ledger(ledger) == []
+        provider.close()
+    first = outputs[0]
+    for other in outputs[1:]:
+        assert other == first
+
+
+def test_sharded_reads_match_routing(tmp_path, monkeypatch):
+    """Point reads, range iteration, and history agree with the write
+    model over a sharded store — and derived pvt/hash namespaces ride
+    with their parent chaincode's shard."""
+    provider, ledger = _build(tmp_path, monkeypatch, shards=4)
+    assert ledger.get_state("cc", "c") == b"2"
+    assert ledger.get_state("qscc", "q") == b"config2"
+    assert ledger.get_state("cc\x00pvt\x00col", "p") == b"private"
+    assert ledger.get_history_for_key("qscc", "q") == [(0, 0), (3, 0)]
+    assert shard_of_namespace("cc\x00pvt\x00col", 4) == \
+        shard_of_namespace("cc", 4)
+    provider.close()
+
+
+# -- recovery idempotence -----------------------------------------------------
+
+
+@pytest.mark.parametrize("shards", [1, 2, 4])
+def test_recovery_is_idempotent_at_every_width(tmp_path, monkeypatch,
+                                               shards):
+    """Crash mid-flush, then reopen TWICE: the second reopen is a
+    no-op (same digest, same height) — recovery is a fixed point at
+    every shard width."""
+    monkeypatch.setenv("FABRIC_TPU_STORE_SHARDS", str(shards))
+    monkeypatch.setenv("FABRIC_TPU_STORE_POOL", "0")
+    provider = LedgerProvider(str(tmp_path))
+    ledger = provider.open("v2")
+    ledger.commit(_write_block(ledger, 0, WORKLOAD[0]))
+    blk1 = _write_block(ledger, 1, WORKLOAD[1])
+    point = "store.shard_flush" if shards > 1 else "kvstore.txn"
+    ctx = {"stage": "apply"} if shards > 1 else None
+    fault = {"point": point, "action": "crash"}
+    if ctx:
+        fault["ctx"] = ctx
+    with faultline.use_plan({"seed": 1, "faults": [fault]}):
+        with pytest.raises(faultline.FaultCrash):
+            ledger.commit(blk1)
+        assert faultline.trips()
+    provider.close()
+
+    snaps = []
+    for _ in range(2):
+        p2 = LedgerProvider(str(tmp_path))
+        led2 = p2.open("v2")
+        snaps.append((invariants.state_digest(led2), led2.height,
+                      led2.durable_height))
+        assert invariants.check_ledger(led2) == []
+        p2.close()
+    assert snaps[0] == snaps[1]
+    assert snaps[0][1] == 2  # the block record was durable: replayed
+
+
+# -- snapshot portability -----------------------------------------------------
+
+
+def test_snapshot_round_trip_across_shard_widths(tmp_path, monkeypatch):
+    """Export from a 2-way sharded store, import into a 4-way one: the
+    snapshot stream is the canonical form — digests agree, the
+    invariants oracle accepts the import, and the destination really is
+    sharded at ITS OWN width."""
+    provider, ledger = _build(tmp_path / "src", monkeypatch, shards=2)
+    export_dir = ledger.snapshots.generate()
+    src_digest = invariants.state_digest(ledger)
+    provider.close()
+
+    monkeypatch.setenv("FABRIC_TPU_STORE_SHARDS", "4")
+    dst = LedgerProvider(str(tmp_path / "dst"))
+    led2 = dst.create_from_snapshot(export_dir)
+    assert invariants.check_import_state(led2, export_dir) == []
+    assert invariants.state_digest(led2) == src_digest
+    assert isinstance(dst.kv, ShardedKVStore) and dst.kv.shards == 4
+    # and the imported ledger keeps committing
+    led2.commit(_write_block(led2, led2.height,
+                             [("cc", "post", b"import")]))
+    assert led2.get_state("cc", "post") == b"import"
+    dst.close()
+
+
+# -- persisted layout wins ----------------------------------------------------
+
+
+def test_persisted_shard_count_wins_over_env(tmp_path, monkeypatch):
+    """A store created 4-way reopens 4-way no matter what the env says
+    — routing is a property of the files on disk, not the process."""
+    provider, ledger = _build(tmp_path, monkeypatch, shards=4)
+    digest = invariants.state_digest(ledger)
+    provider.close()
+
+    monkeypatch.setenv("FABRIC_TPU_STORE_SHARDS", "2")
+    p2 = LedgerProvider(str(tmp_path))
+    led2 = p2.open("v2")
+    assert isinstance(p2.kv, ShardedKVStore) and p2.kv.shards == 4
+    assert invariants.state_digest(led2) == digest
+    p2.close()
+
+    # even with the knob unset (default 1) the sharded layout is
+    # detected and reopened sharded
+    monkeypatch.delenv("FABRIC_TPU_STORE_SHARDS")
+    p3 = LedgerProvider(str(tmp_path))
+    led3 = p3.open("v2")
+    assert isinstance(p3.kv, ShardedKVStore) and p3.kv.shards == 4
+    assert invariants.state_digest(led3) == digest
+    p3.close()
+
+
+def test_unsharded_root_stays_plain_sqlite(tmp_path, monkeypatch):
+    """shards=1 (the default) opens the exact pre-v2 layout: one
+    index.sqlite, no shard files, plain SqliteKVStore — zero migration
+    for existing stores."""
+    monkeypatch.delenv("FABRIC_TPU_STORE_SHARDS", raising=False)
+    kv = open_store_root(str(tmp_path))
+    try:
+        assert isinstance(kv, SqliteKVStore)
+        assert not isinstance(kv, ShardedKVStore)
+        kv.write_batch({b"statedb/ch\x00\xff\x02cc\x00k": b"v"})
+        assert kv.get(b"statedb/ch\x00\xff\x02cc\x00k") == b"v"
+    finally:
+        kv.close()
+    assert sorted(
+        f for f in os.listdir(str(tmp_path)) if f.endswith(".sqlite")
+    ) == ["index.sqlite"]
+
+
+def test_key_routing_surface():
+    """The routing function's edges: non-statedb keys and savepoint /
+    index / metans records stay in the coordinator; only \\x02-encoded
+    state entries shard."""
+    assert state_shard(b"blkindex/ch\x00\xffn5", 4) is None
+    assert state_shard(b"statedb/ch\x00\xff\x01", 4) is None  # savepoint
+    assert state_shard(b"statedb/ch\x00\xff\x03idx", 4) is None
+    k = b"statedb/ch\x00\xff\x02cc\x00key"
+    assert state_shard(k, 1) is None  # width 1: no routing at all
+    assert state_shard(k, 4) == shard_of_namespace("cc", 4)
+    with pytest.raises(ValueError):
+        store_shards("nope")
+
+
+# -- segment hygiene ----------------------------------------------------------
+
+
+def test_clean_prealloc_tail_is_not_recovery_damage(tmp_path):
+    """The block file is preallocated past its data: the zero tail must
+    read as CLEAN on reopen (no truncation, no lost blocks) — the
+    whole point of paying prealloc is not re-extending per append."""
+    provider = LedgerProvider(str(tmp_path))
+    ledger = provider.open("v2")
+    ledger.commit(_write_block(ledger, 0, [("cc", "a", b"0")]))
+    ledger.commit(_write_block(ledger, 1, [("cc", "b", b"1")]))
+    provider.close()
+
+    path = os.path.join(str(tmp_path), "v2", "chains",
+                        "blocks_000000.dat")
+    size = os.path.getsize(path)
+    assert size == segment_size(None) == DEFAULT_SEGMENT
+
+    p2 = LedgerProvider(str(tmp_path))
+    led2 = p2.open("v2")
+    assert led2.height == 2
+    assert led2.get_state("cc", "b") == b"1"
+    # recovery did NOT shrink the preallocated tail
+    assert os.path.getsize(path) == size
+    p2.close()
+
+
+def test_segment_roll_seals_to_data_size(tmp_path, monkeypatch):
+    """A full segment is sealed (trimmed to its data) before the writer
+    advances; the live tail segment keeps its preallocation."""
+    monkeypatch.setenv("FABRIC_TPU_STORE_SEGMENT", "4k")
+    provider = LedgerProvider(str(tmp_path))
+    ledger = provider.open("v2")
+    big = b"x" * 3000
+    for n in range(3):
+        ledger.commit(_write_block(ledger, n, [("cc", f"k{n}", big)]))
+    chains = os.path.join(str(tmp_path), "v2", "chains")
+    files = sorted(f for f in os.listdir(chains) if f.endswith(".dat"))
+    assert len(files) == 3
+    for sealed in files[:-1]:
+        sz = os.path.getsize(os.path.join(chains, sealed))
+        assert sz < 4096, f"{sealed} was not trimmed ({sz})"
+    assert os.path.getsize(os.path.join(chains, files[-1])) == 4096
+    provider.close()
+
+    p2 = LedgerProvider(str(tmp_path))
+    led2 = p2.open("v2")
+    assert led2.height == 3
+    for n in range(3):
+        assert led2.get_state("cc", f"k{n}") == big
+    p2.close()
+
+
+def test_oversized_record_extends_past_segment(tmp_path, monkeypatch):
+    """A record larger than the whole segment still lands (the file
+    just grows past its preallocation) and replays on reopen — the
+    segment floor is a hint, never a cap."""
+    monkeypatch.setenv("FABRIC_TPU_STORE_SEGMENT", "4096")
+    provider = LedgerProvider(str(tmp_path))
+    ledger = provider.open("v2")
+    huge = b"y" * 10_000
+    ledger.commit(_write_block(ledger, 0, [("cc", "huge", huge)]))
+    provider.close()
+
+    p2 = LedgerProvider(str(tmp_path))
+    led2 = p2.open("v2")
+    assert led2.height == 1
+    assert led2.get_state("cc", "huge") == huge
+    led2.commit(_write_block(led2, 1, [("cc", "next", b"n")]))
+    assert led2.height == 2
+    p2.close()
+
+
+def test_torn_tail_in_prealloc_zone_is_erased(tmp_path, monkeypatch):
+    """Garbage AFTER the committed data but INSIDE the preallocated
+    zone (a torn header whose length field promises bytes that never
+    made it) is recognized as damage — erased back to zeros, committed
+    blocks intact, and the next append lands over it."""
+    monkeypatch.setenv("FABRIC_TPU_STORE_SEGMENT", "65536")
+    provider = LedgerProvider(str(tmp_path))
+    ledger = provider.open("v2")
+    ledger.commit(_write_block(ledger, 0, [("cc", "a", b"0")]))
+    provider.close()
+
+    path = os.path.join(str(tmp_path), "v2", "chains",
+                        "blocks_000000.dat")
+    with open(path, "rb") as f:
+        data = f.read()
+    (n,) = struct.unpack(">I", data[:4])
+    tail = 4 + n
+    with open(path, "r+b") as f:  # a torn header: promises 500 bytes
+        f.seek(tail)
+        f.write(struct.pack(">I", 500) + b"GARBAGE")
+
+    p2 = LedgerProvider(str(tmp_path))
+    led2 = p2.open("v2")
+    assert led2.height == 1
+    assert led2.get_state("cc", "a") == b"0"
+    led2.commit(_write_block(led2, 1, [("cc", "b", b"1")]))
+    assert led2.height == 2
+    p2.close()
+
+    p3 = LedgerProvider(str(tmp_path))
+    led3 = p3.open("v2")
+    assert led3.height == 2
+    assert led3.get_state("cc", "b") == b"1"
+    p3.close()
+
+
+def test_segment_size_knob_parsing(monkeypatch):
+    monkeypatch.delenv("FABRIC_TPU_STORE_SEGMENT", raising=False)
+    assert segment_size(None) == DEFAULT_SEGMENT
+    monkeypatch.setenv("FABRIC_TPU_STORE_SEGMENT", "64k")
+    assert segment_size(None) == 64 * 1024
+    monkeypatch.setenv("FABRIC_TPU_STORE_SEGMENT", "8m")
+    assert segment_size(None) == 8 * 1024 * 1024
+    monkeypatch.setenv("FABRIC_TPU_STORE_SEGMENT", "17")
+    assert segment_size(None) == 4096  # floor
+    assert segment_size(1 << 20) == 1 << 20  # explicit override
+    monkeypatch.setenv("FABRIC_TPU_STORE_SEGMENT", "banana")
+    with pytest.raises(ValueError):
+        segment_size(None)

@@ -315,9 +315,9 @@ def test_failing_faultfuzz_plan_ships_trace_and_replays_identically(
         "seed": 3,
         "label": "seeded",
         "faults": [
-            {"point": "blkstorage.file_append", "action": "torn",
-             "cut": 0.5, "ctx": {"block": 3}, "count": 1},
-            {"point": "blkstorage.recovery_truncate", "action": "skip",
+            {"point": "store.shard_flush", "action": "crash",
+             "ctx": {"stage": "apply"}, "count": 1},
+            {"point": "store.shard_recover", "action": "skip",
              "count": 5},
         ],
     }
@@ -340,9 +340,9 @@ def test_campaign_writes_trace_artifact_next_to_repro(
     repro JSON when tracelens is armed."""
     seeded = {
         "faults": [
-            {"point": "blkstorage.file_append", "action": "torn",
-             "cut": 0.5, "ctx": {"block": 3}, "count": 1},
-            {"point": "blkstorage.recovery_truncate", "action": "skip",
+            {"point": "store.shard_flush", "action": "crash",
+             "ctx": {"stage": "apply"}, "count": 1},
+            {"point": "store.shard_recover", "action": "skip",
              "count": 5},
         ],
     }
