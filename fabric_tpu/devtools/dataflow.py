@@ -225,6 +225,23 @@ _RUN_CHUNKED_FNS = frozenset({"fabric_tpu.common.workpool.run_chunked"})
 _DRAIN_FNS = frozenset({"fabric_tpu.devtools.lockwatch.drain_threads"})
 _CLOCKSKEW_WAIT = "fabric_tpu.devtools.clockskew.wait"
 
+# the faultline injection API: callee qname -> seam kind.  `io` wraps a
+# socket and registers TWO derived points (`<name>.read`/`<name>.write`)
+_FAULTLINE_FNS = {
+    "fabric_tpu.devtools.faultline.point": "point",
+    "fabric_tpu.devtools.faultline.guard": "guard",
+    "fabric_tpu.devtools.faultline.write": "write",
+    "fabric_tpu.devtools.faultline.io": "io",
+}
+# the chaos seam's own implementation files: their faultline calls are
+# plumbing, not production injection points (mirrors lint._CHAOS_SEAM)
+_FAULTLINE_IMPL = (
+    "fabric_tpu/devtools/faultline.py",
+    "fabric_tpu/devtools/faultfuzz.py",
+    "fabric_tpu/devtools/clockskew.py",
+    "fabric_tpu/common/tracing.py",
+)
+
 def _own_nodes(root):
     """AST nodes of `root` excluding nested function subtrees — a
     closure's statements run on the closure's schedule, not inline in
@@ -344,6 +361,11 @@ class FunctionInfo:
     # unbounded while loop
     stop_probe: bool = False
     has_while: bool = False
+    # v5 "flowcheck": the function's control-flow graph (built during
+    # the lockset pass) and the lock roles proven held somewhere by the
+    # explicit acquire/release dataflow rather than a `with` scope
+    cfg: object = None
+    flow_lock_roles: set = dataclasses.field(default_factory=set)
 
     def summary(self) -> dict:
         """JSON-shaped summary (CLI ``--summaries``, tests)."""
@@ -371,6 +393,12 @@ class FunctionInfo:
                 "acquires": len(self.hb_acq),
                 "stop_probe": self.stop_probe,
             }
+        # CFG shape facts (v5) ride wherever a graph was built and is
+        # non-trivial — straight-line helpers stay one diffable line
+        if self.cfg is not None and getattr(self.cfg, "n", 0) > 1:
+            out["cfg"] = self.cfg.stats()
+            if self.flow_lock_roles:
+                out["cfg"]["flow_locks"] = sorted(self.flow_lock_roles)
         return out
 
 
@@ -414,6 +442,362 @@ class TaintFlow:
     rel: str
     line: int
     message: str
+
+
+# -- per-function control-flow graph (v5 "flowcheck") ----------------------
+
+_TRY_STAR = getattr(ast, "TryStar", ())
+_MATCH_STMT = getattr(ast, "Match", ())
+
+
+class _CFG:
+    """Basic blocks + edges over ONE function's own statements.
+
+    Built once per function during the lockset pass; every source line
+    of the function maps to a program point ``(block, stmt)`` so the
+    happens-before engine can ask order questions that respect branch
+    structure and loop back edges instead of comparing line numbers:
+
+    * ``event_precedes(e, a)`` — the HB event at line ``e`` (a join,
+      ``Event.wait``, ``Queue.get``) is sequenced before the access at
+      line ``a`` on every execution that reaches the access: same
+      block in statement order, a dominating block, or a block that
+      strictly precedes the access block (reaches it, never reached
+      back).  Per-iteration order inside one block of a loop counts —
+      a consumer that gets then reads each iteration is ordered.
+    * ``access_precedes(a, e)`` — the access at ``a`` runs strictly
+      before the HB event at ``e`` on EVERY execution containing both.
+      A back edge defeats this: a write and a thread start in the same
+      loop body are NOT ordered, because iteration 2's write races
+      iteration 1's started thread.
+    * ``may_follow(e, a)`` — some execution performs the event at
+      ``e`` and later reaches ``a`` (the post-publication direction).
+
+    ``with`` bodies stay inline (no branching — the lexical lockset
+    scan already IS the meet-over-paths answer for them); ``try``
+    bodies edge into every handler and into ``finally``; ``while
+    True`` loops exit only through ``break``.  Lines the builder could
+    not map (decorators, nested defs) fall back to positional order,
+    so a partial graph can only make the analysis more conservative.
+    """
+
+    __slots__ = ("n", "succs", "preds", "back_edges", "_counts",
+                 "_points", "_reach_memo", "_dom")
+
+    def __init__(self):
+        self.n = 0
+        self.succs: list[set] = []
+        self.preds: list[set] = []
+        self.back_edges: set = set()
+        self._counts: list[int] = []
+        self._points: dict[int, tuple] = {}
+        self._reach_memo: dict[int, frozenset] = {}
+        self._dom: list | None = None
+
+    # -- construction ------------------------------------------------------
+
+    @classmethod
+    def build(cls, fnnode) -> "_CFG":
+        cfg = cls()
+        try:
+            entry = cfg._new_block()
+            cfg._seq(fnnode.body, entry, [])
+        except RecursionError:  # pragma: no cover - pathological nesting
+            cfg._points.clear()
+        return cfg
+
+    def _new_block(self) -> int:
+        self.succs.append(set())
+        self.preds.append(set())
+        self._counts.append(0)
+        self.n += 1
+        return self.n - 1
+
+    def _edge(self, a: int, b: int, back: bool = False) -> None:
+        self.succs[a].add(b)
+        self.preds[b].add(a)
+        if back:
+            self.back_edges.add((a, b))
+
+    def _place(self, block: int, stmt, hi: int | None = None) -> None:
+        """Assign ``stmt``'s lines to the next point of ``block``.
+
+        ``hi`` caps the claimed range for compound statements so body
+        lines stay claimable by the body's own blocks (first writer
+        wins via setdefault)."""
+        idx = self._counts[block]
+        self._counts[block] += 1
+        lo = stmt.lineno
+        if hi is None:
+            hi = getattr(stmt, "end_lineno", None) or lo
+        for ln in range(lo, max(lo, hi) + 1):
+            self._points.setdefault(ln, (block, idx))
+
+    def _join(self, outs: list) -> int | None:
+        outs = [b for b in dict.fromkeys(outs) if b is not None]
+        if not outs:
+            return None
+        if len(outs) == 1:
+            return outs[0]
+        j = self._new_block()
+        for b in outs:
+            self._edge(b, j)
+        return j
+
+    def _seq(self, stmts, cur: int | None, loops: list) -> int | None:
+        """Thread ``stmts`` through the graph; returns the fallthrough
+        block, or None when every path ended (return/raise/break)."""
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue  # nested scopes own their lines
+            if cur is None:
+                cur = self._new_block()  # unreachable tail: orphan block
+            if isinstance(stmt, ast.If):
+                self._place(cur, stmt, stmt.test.end_lineno)
+                t0 = self._new_block()
+                self._edge(cur, t0)
+                t_end = self._seq(stmt.body, t0, loops)
+                if stmt.orelse:
+                    e0 = self._new_block()
+                    self._edge(cur, e0)
+                    e_end = self._seq(stmt.orelse, e0, loops)
+                    cur = self._join([t_end, e_end])
+                else:
+                    cur = self._join([t_end, cur])
+            elif isinstance(stmt, (ast.While, ast.For, ast.AsyncFor)):
+                hdr = self._new_block()
+                self._edge(cur, hdr)
+                cond = stmt.test if isinstance(stmt, ast.While) else stmt.iter
+                self._place(hdr, stmt, cond.end_lineno)
+                b0 = self._new_block()
+                self._edge(hdr, b0)
+                breaks: list = []
+                b_end = self._seq(stmt.body, b0, loops + [(hdr, breaks)])
+                if b_end is not None:
+                    self._edge(b_end, hdr, back=True)
+                infinite = (isinstance(stmt, ast.While)
+                            and isinstance(stmt.test, ast.Constant)
+                            and bool(stmt.test.value))
+                outs = list(breaks)
+                if not infinite:
+                    o_end: int | None = hdr
+                    if stmt.orelse:
+                        o0 = self._new_block()
+                        self._edge(hdr, o0)
+                        o_end = self._seq(stmt.orelse, o0, loops)
+                    outs.append(o_end)
+                cur = self._join(outs)
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                hi = max((it.context_expr.end_lineno or stmt.lineno)
+                        for it in stmt.items)
+                self._place(cur, stmt, max(stmt.lineno, hi))
+                cur = self._seq(stmt.body, cur, loops)
+            elif isinstance(stmt, ast.Try) or isinstance(stmt, _TRY_STAR):
+                self._place(cur, stmt, stmt.lineno)
+                b0 = self._new_block()
+                self._edge(cur, b0)
+                b_end = self._seq(stmt.body, b0, loops)
+                body_hi = self.n  # blocks [b0, body_hi) can raise
+                h_entries = []
+                for h in stmt.handlers:
+                    h0 = self._new_block()
+                    self._place(h0, h, h.lineno)
+                    h_entries.append(h0)
+                    self._edge(cur, h0)
+                    for bb in range(b0, body_hi):
+                        self._edge(bb, h0)
+                h_ends = [self._seq(h.body, h0, loops)
+                          for h, h0 in zip(stmt.handlers, h_entries)]
+                o_end = b_end
+                if stmt.orelse and b_end is not None:
+                    o_end = self._seq(stmt.orelse, b_end, loops)
+                outs = [o_end] + h_ends
+                if stmt.finalbody:
+                    f0 = self._new_block()
+                    for b in outs:
+                        if b is not None:
+                            self._edge(b, f0)
+                    # exceptional entry: any body/handler block may
+                    # unwind straight into the finally suite
+                    for bb in range(b0, body_hi):
+                        self._edge(bb, f0)
+                    for h0 in h_entries:
+                        self._edge(h0, f0)
+                    self._edge(cur, f0)
+                    cur = self._seq(stmt.finalbody, f0, loops)
+                else:
+                    cur = self._join(outs)
+            elif isinstance(stmt, _MATCH_STMT):
+                self._place(cur, stmt, stmt.subject.end_lineno)
+                outs = [cur]  # conservative no-match fallthrough
+                for case in stmt.cases:
+                    c0 = self._new_block()
+                    self._edge(cur, c0)
+                    outs.append(self._seq(case.body, c0, loops))
+                cur = self._join(outs)
+            elif isinstance(stmt, (ast.Return, ast.Raise)):
+                self._place(cur, stmt)
+                cur = None
+            elif isinstance(stmt, ast.Break):
+                self._place(cur, stmt)
+                if loops:
+                    loops[-1][1].append(cur)
+                cur = None
+            elif isinstance(stmt, ast.Continue):
+                self._place(cur, stmt)
+                if loops:
+                    self._edge(cur, loops[-1][0], back=True)
+                cur = None
+            else:
+                self._place(cur, stmt)
+        return cur
+
+    # -- queries -----------------------------------------------------------
+
+    def point(self, line: int) -> tuple | None:
+        return self._points.get(line)
+
+    def _reach(self, b: int) -> frozenset:
+        """Blocks reachable from ``b`` through one or more edges."""
+        memo = self._reach_memo.get(b)
+        if memo is None:
+            seen: set = set()
+            stack = list(self.succs[b])
+            while stack:
+                x = stack.pop()
+                if x not in seen:
+                    seen.add(x)
+                    stack.extend(self.succs[x] - seen)
+            memo = self._reach_memo[b] = frozenset(seen)
+        return memo
+
+    def _cyclic(self, b: int) -> bool:
+        return b in self._reach(b)
+
+    def _dominators(self) -> list:
+        if self._dom is None:
+            every = frozenset(range(self.n))
+            dom = [every] * self.n
+            if self.n:
+                dom[0] = frozenset([0])
+            changed = True
+            while changed:
+                changed = False
+                for b in range(1, self.n):
+                    ps = self.preds[b]
+                    if ps:
+                        new = frozenset.intersection(
+                            *[dom[p] for p in ps]) | {b}
+                    else:
+                        new = frozenset([b])  # orphan: its own entry
+                    if new != dom[b]:
+                        dom[b] = new
+                        changed = True
+            self._dom = dom
+        return self._dom
+
+    def event_precedes(self, event_line: int, access_line: int) -> bool:
+        pe, pa = self.point(event_line), self.point(access_line)
+        if pe is None or pa is None:
+            return event_line < access_line  # positional fallback
+        (be, se), (ba, sa) = pe, pa
+        if be == ba:
+            return se < sa  # per-iteration order holds in a cycle too
+        if be in self._dominators()[ba]:
+            return True
+        return ba in self._reach(be) and be not in self._reach(ba)
+
+    def access_precedes(self, access_line: int, event_line: int) -> bool:
+        pe, pa = self.point(event_line), self.point(access_line)
+        if pe is None or pa is None:
+            return access_line < event_line
+        (be, se), (ba, sa) = pe, pa
+        if be == ba:
+            return sa < se and not self._cyclic(ba)
+        return be in self._reach(ba) and ba not in self._reach(be)
+
+    def may_follow(self, event_line: int, access_line: int) -> bool:
+        pe, pa = self.point(event_line), self.point(access_line)
+        if pe is None or pa is None:
+            return event_line < access_line
+        (be, se), (ba, sa) = pe, pa
+        if be == ba:
+            return se < sa or self._cyclic(ba)
+        return ba in self._reach(be)
+
+    def stats(self) -> dict:
+        return {
+            "blocks": self.n,
+            "edges": sum(len(s) for s in self.succs),
+            "back_edges": len(self.back_edges),
+        }
+
+
+def _flow_locksets(cfg: _CFG, ops: list):
+    """Forward must-hold dataflow over explicit ``.acquire()`` /
+    ``.release()`` calls (``ops``: ``(line, "acq"|"rel", role)``).
+
+    Returns ``at(line) -> frozenset(roles)`` — the roles PROVEN held at
+    that program point on every path from the function entry.  IN of a
+    block is the meet (intersection) over predecessor OUTs; within a
+    block, ops apply in statement order, and an op's effect becomes
+    visible from the NEXT statement (the acquire call itself does not
+    guard its own line).  Lines outside the graph prove nothing."""
+    if not ops or not cfg.n:
+        empty = frozenset()
+        return lambda line: empty
+    block_ops: dict[int, list] = {}
+    for i, (line, op, role) in enumerate(ops):
+        p = cfg.point(line)
+        if p is not None:
+            block_ops.setdefault(p[0], []).append((p[1], i, op, role))
+    for v in block_ops.values():
+        v.sort()
+
+    def transfer(b: int, held: frozenset) -> frozenset:
+        for _s, _i, op, role in block_ops.get(b, ()):
+            held = held | {role} if op == "acq" else held - {role}
+        return held
+
+    n = cfg.n
+    in_sets: list = [None] * n
+    out_sets: list = [None] * n
+    for _round in range(4 * n + 8):
+        changed = False
+        for b in range(n):
+            preds = cfg.preds[b]
+            if b == 0 or not preds:
+                inb: frozenset | None = frozenset()
+            else:
+                pouts = [out_sets[p] for p in preds
+                         if out_sets[p] is not None]
+                inb = frozenset.intersection(*pouts) if pouts else None
+            if inb is None:
+                continue
+            in_sets[b] = inb
+            ob = transfer(b, inb)
+            if ob != out_sets[b]:
+                out_sets[b] = ob
+                changed = True
+        if not changed:
+            break
+
+    empty = frozenset()
+
+    def at(line: int) -> frozenset:
+        p = cfg.point(line)
+        if p is None or in_sets[p[0]] is None:
+            return empty
+        b, s = p
+        held = in_sets[b]
+        for si, _i, op, role in block_ops.get(b, ()):
+            if si >= s:
+                break
+            held = held | {role} if op == "acq" else held - {role}
+        return held
+
+    return at
 
 
 class Project:
@@ -485,6 +869,12 @@ class Project:
         self.thread_entries: dict[str, str] = {}
         # ClassDef qname -> names of self attributes holding wall-clock
         self._class_taint: dict[str, set] = {}
+        # v5 chaos-coverage raw facts: every faultline seam call in
+        # production code, seam calls whose name is not a string
+        # literal, and every literal fault-plan rule anywhere
+        self.faultline_seams: list[dict] = []
+        self.faultline_dynamic: list[dict] = []
+        self.faultline_plans: list[dict] = []
         for rel, tree in sorted(trees.items()):
             self._load_module(rel, tree)
         self._collect_classes()
@@ -495,6 +885,7 @@ class Project:
         self._interproc_lock_edges()
         self._racecheck()
         self._lifecycle()
+        self._chaos_scan()
 
     # -- module loading ----------------------------------------------------
 
@@ -1548,6 +1939,35 @@ class Project:
         loop_attr: dict[str, tuple] = {}
         held: list[str] = []
         seen_access: set = set()
+        # v5 flowcheck: the function's CFG plus a forward must-hold
+        # dataflow over explicit .acquire()/.release() calls — `with`
+        # scoping stays lexical (its push/pop IS the meet-over-paths
+        # answer), while conditional acquires, early-return releases
+        # and try/finally pairs resolve per program point
+        cfg = _CFG.build(fn.node)
+        fn.cfg = cfg
+        flow_ops: list = []
+        for fnode in _own_nodes(fn.node):
+            if (
+                isinstance(fnode, ast.Call)
+                and isinstance(fnode.func, ast.Attribute)
+                and fnode.func.attr in ("acquire", "release")
+            ):
+                role = self._role_of_ctx(
+                    mod, fnode.func.value, ci, types, local,
+                    getattr(fn, "_rebound", ()),
+                )
+                if role is not None:
+                    op = "acq" if fnode.func.attr == "acquire" else "rel"
+                    flow_ops.append((fnode.lineno, op, role))
+                    if role != _UNKNOWN_LOCK:
+                        fn.flow_lock_roles.add(role)
+        flow_ops.sort()
+        flow_at = _flow_locksets(cfg, flow_ops)
+
+        def fs_held(line: int) -> frozenset:
+            extra = flow_at(line)
+            return frozenset(held) | extra if extra else frozenset(held)
 
         def sync_token(expr):
             """(kind, token) for an event/queue-valued expression, or
@@ -1637,7 +2057,7 @@ class Project:
             if key in seen_access:
                 return
             seen_access.add(key)
-            fn.accesses.append((q, kind, line, frozenset(held)))
+            fn.accesses.append((q, kind, line, fs_held(line)))
 
         def note_attr(node: ast.Attribute, kind: str) -> None:
             base = node.value
@@ -1669,7 +2089,7 @@ class Project:
             if key in seen_access:
                 return
             seen_access.add(key)
-            fn.accesses.append((q, kind, node.lineno, frozenset(held)))
+            fn.accesses.append((q, kind, node.lineno, fs_held(node.lineno)))
 
         def entry(reason: str, expr) -> str | None:
             # a bare name may be a locally-defined function (the
@@ -1702,7 +2122,7 @@ class Project:
                 (mod.rel, node.lineno, node.col_offset)
             )
             if q is not None:
-                fn.call_locks.append((q, frozenset(held)))
+                fn.call_locks.append((q, fs_held(node.lineno)))
             target = self._resolve_expr(mod, node.func, fn.cls, local, types)
             if target in _SPAWN_THREAD_FNS:
                 for kw in node.keywords:
@@ -1741,13 +2161,35 @@ class Project:
                 st = sync_token(node.args[0])
                 if st is not None and st[0] == "event":
                     fn.hb_acq.append(
-                        (st[1], node.lineno, frozenset(held))
+                        (st[1], node.lineno, fs_held(node.lineno))
                     )
                 fn.stop_probe = True
             f_ = node.func
             if not isinstance(f_, ast.Attribute):
                 return
             a_ = f_.attr
+            if a_ == "acquire":
+                # an explicit acquire joins the static acquisition-order
+                # graph exactly like a `with` scope: every role already
+                # held (lexically or flow-proven) orders before it
+                role = self._role_of_ctx(
+                    mod, f_.value, ci, types, local,
+                    getattr(fn, "_rebound", ()),
+                )
+                if role is not None and role != _UNKNOWN_LOCK:
+                    already = (set(held) | flow_at(node.lineno)) - {role}
+                    for h in sorted(already):
+                        if h != _UNKNOWN_LOCK:
+                            self.lock_order_edges.setdefault(
+                                (h, role), []
+                            ).append((mod.rel, node.lineno))
+                    fn.lock_acquires.append((
+                        role,
+                        frozenset(
+                            h for h in already if h != _UNKNOWN_LOCK
+                        ),
+                        node.lineno,
+                    ))
             if a_ == "start":
                 se = spawn_subject(f_.value)
                 if se != _NOSPAWN:
@@ -1769,7 +2211,7 @@ class Project:
                 st = sync_token(f_.value)
                 if st is not None:
                     k_, tok = st
-                    entry_rec = (tok, node.lineno, frozenset(held))
+                    entry_rec = (tok, node.lineno, fs_held(node.lineno))
                     if k_ == "event":
                         if a_ == "set":
                             fn.hb_rel.append(entry_rec)
@@ -1857,7 +2299,10 @@ class Project:
                             # being acquired (UNKNOWN contributes no
                             # edges — it has no runtime counterpart)
                             if role != _UNKNOWN_LOCK:
-                                for h in held:
+                                already = (
+                                    set(held) | flow_at(stmt.lineno)
+                                )
+                                for h in sorted(already):
                                     if h != role and h != _UNKNOWN_LOCK:
                                         self.lock_order_edges.setdefault(
                                             (h, role), []
@@ -1865,8 +2310,9 @@ class Project:
                                 fn.lock_acquires.append((
                                     role,
                                     frozenset(
-                                        h for h in held
+                                        h for h in already
                                         if h != _UNKNOWN_LOCK
+                                        and h != role
                                     ),
                                     stmt.lineno,
                                 ))
@@ -2090,22 +2536,35 @@ class Project:
         # -- happens-before machinery (v4) ---------------------------------
 
         def _site_tokens(fn: FunctionInfo, line: int):
-            """(acquire, release) HB tokens positioned around `line` in
-            `fn`: joins/waits/gets BEFORE it order earlier work in,
-            starts/sets/puts AFTER it order this work out."""
+            """(acquire, release) HB tokens ordered around `line` in
+            `fn`: joins/waits/gets sequenced BEFORE it (on every path
+            reaching it) order earlier work in, starts/sets/puts the
+            access strictly precedes (on EVERY path — a loop back edge
+            that could replay the event first defeats the claim, v5)
+            order this work out."""
             acq = set()
             rel = set()
+            cfg = fn.cfg if isinstance(fn.cfg, _CFG) else None
+
+            def before(l):  # event at l precedes the access
+                return (cfg.event_precedes(l, line) if cfg is not None
+                        else l < line)
+
+            def after(l):  # the access strictly precedes the event at l
+                return (cfg.access_precedes(line, l) if cfg is not None
+                        else line < l)
+
             for e, l in fn.hb_joins:
-                if l < line:
+                if before(l):
                     acq.add(("join", e))
             for tok, l, _h in fn.hb_acq:
-                if l < line:
+                if before(l):
                     acq.add(("sync", tok))
             for e, l in fn.hb_starts:
-                if l > line and e is not None:
+                if e is not None and after(l):
                     rel.add(("start", e))
             for tok, l, _h in fn.hb_rel:
-                if l > line:
+                if after(l):
                     rel.add(("sync", tok))
             return acq, rel
 
@@ -2343,9 +2802,13 @@ class Project:
                     continue
                 if _UNKNOWN_LOCK in a["ls"]:
                     continue
+                a_cfg = a["fn"].cfg if isinstance(a["fn"].cfg, _CFG) else None
                 starts = {
                     e for e, l in a["fn"].hb_starts
-                    if e is not None and l < a["line"]
+                    if e is not None and (
+                        a_cfg.may_follow(l, a["line"])
+                        if a_cfg is not None else l < a["line"]
+                    )
                 }
                 if not starts:
                     continue
@@ -2505,6 +2968,97 @@ class Project:
                 ),
             ))
         self.lifecycle_flows.sort(key=lambda f: (f.rel, f.line))
+
+    # -- chaos-coverage raw facts (v5) -------------------------------------
+
+    def _chaos_scan(self) -> None:
+        """Statically enumerate every faultline seam in production code
+        and every literal fault-plan rule anywhere in the target set —
+        the raw facts behind the chaos-coverage rule and the
+        ``--faultmap-out`` artifact.
+
+        A seam is an ``ast.Call`` resolving to ``faultline.point/guard/
+        write/io`` in a strict-profile file outside the seam's own
+        implementation; its name must be a string literal (``io`` takes
+        the name second and derives ``<name>.read``/``<name>.write``).
+        A plan rule is any dict literal with a ``"point"`` string key —
+        test plans count: a pinned chaos test IS coverage."""
+        seams: list[dict] = []
+        dynamic: list[dict] = []
+        plans: list[dict] = []
+        for mod in self.modules.values():
+            production = (
+                mod.rel.startswith("fabric_tpu/")
+                and mod.rel not in _FAULTLINE_IMPL
+            )
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.Call) and production:
+                    q = self._resolve_expr(mod, node.func, None, {}, {})
+                    kind = _FAULTLINE_FNS.get(q)
+                    if kind is None:
+                        continue
+                    idx = 1 if kind == "io" else 0
+                    arg = node.args[idx] if len(node.args) > idx else None
+                    if isinstance(arg, ast.Constant) and isinstance(
+                        arg.value, str
+                    ):
+                        names = (
+                            [f"{arg.value}.read", f"{arg.value}.write"]
+                            if kind == "io" else [arg.value]
+                        )
+                        for name in names:
+                            seams.append({
+                                "name": name, "kind": kind,
+                                "module": mod.rel, "line": node.lineno,
+                            })
+                    else:
+                        dynamic.append({
+                            "kind": kind, "module": mod.rel,
+                            "line": node.lineno,
+                        })
+                elif isinstance(node, ast.Dict):
+                    point = action = None
+                    for k, v in zip(node.keys, node.values):
+                        if isinstance(k, ast.Constant):
+                            if k.value == "point":
+                                point = v
+                            elif k.value == "action":
+                                action = v
+                    if not (
+                        isinstance(point, ast.Constant)
+                        and isinstance(point.value, str)
+                    ):
+                        continue  # no/computed point key: not a pin
+                    act = (
+                        action.value
+                        if isinstance(action, ast.Constant)
+                        and isinstance(action.value, str)
+                        else "raise"
+                    )
+                    plans.append({
+                        "point": point.value, "action": act,
+                        "module": mod.rel, "line": node.lineno,
+                        "wildcard": (
+                            point.value == "*"
+                            or point.value.endswith(".*")
+                        ),
+                    })
+        seams.sort(key=lambda s: (s["name"], s["module"], s["line"]))
+        dynamic.sort(key=lambda d: (d["module"], d["line"]))
+        plans.sort(key=lambda p: (p["module"], p["line"], p["point"]))
+        self.faultline_seams = seams
+        self.faultline_dynamic = dynamic
+        self.faultline_plans = plans
+
+    def faultmap(self) -> dict:
+        """The JSON-shaped chaos-coverage artifact (``--faultmap-out``):
+        every production injection seam and every pinned plan rule, both
+        in deterministic order."""
+        return {
+            "seams": self.faultline_seams,
+            "dynamic": self.faultline_dynamic,
+            "plans": self.faultline_plans,
+        }
 
     # -- public API --------------------------------------------------------
 

@@ -127,8 +127,13 @@ def _endorsed_block(ledger, num: int, writes) -> object:
     tx = transaction_pb2.Transaction(actions=[
         transaction_pb2.TransactionAction(payload=cap.SerializeToString())
     ])
+    # fixed authoring timestamp: canned-workload blocks must be
+    # byte-identical across runs (same-seed campaign replays, the
+    # profiled-vs-unprofiled parity test), and a wall-clock second
+    # boundary between two runs would poison the hash chain
     chdr = protoutil.make_channel_header(
-        common_pb2.ENDORSER_TRANSACTION, CHANNEL, tx_id=f"fuzz-tx-{num}"
+        common_pb2.ENDORSER_TRANSACTION, CHANNEL, tx_id=f"fuzz-tx-{num}",
+        timestamp=1_700_000_000.0,
     )
     shdr = protoutil.make_signature_header(b"fuzzer", b"nonce%d" % num)
     env = common_pb2.Envelope(
@@ -459,19 +464,28 @@ def run_plan(plan: dict, workdir: str, blocks: int = DEFAULT_BLOCKS,
 # -- plan generation ----------------------------------------------------------
 
 
-def generate_plan(rng: random.Random, registry: dict, label: str) -> dict:
+def generate_plan(rng: random.Random, registry: dict, label: str,
+                  tripped=frozenset()) -> dict:
     """Sample one plan from the discovered fault-point registry: 1-3
     rules, action pool matched to the point's kind (no crash on rpc
     points — a dead handler thread is noise, not signal; torn only at
     write/io points; skip only at guard points), trigger mix of
     nth/every/prob/always with bounded counts, and 50% ctx targeting
-    from the registry's sampled ctx values."""
+    from the registry's sampled ctx values.
+
+    ``tripped`` is the set of point names already tripped earlier in
+    the campaign: selection is coverage-weighted toward the cold
+    remainder (all-cold → unchanged v4 behavior).  The weighting costs
+    exactly one ``rng.choice`` draw either way, so two same-seed
+    campaigns — whose trip ledgers are themselves deterministic — stay
+    byte-identical."""
     points = sorted(registry)
     if not points:
         raise ValueError("empty fault-point registry: run discovery first")
     faults = []
     for _ in range(rng.randint(1, 3)):
-        name = rng.choice(points)
+        cold = [p for p in points if p not in tripped]
+        name = rng.choice(cold or points)
         ent = registry[name]
         kinds = ent.get("kinds", [])
         if "io" in kinds:
@@ -688,10 +702,11 @@ class Campaign:
         repro_paths: list[str] = []
         trace_paths: list[str] = []
         profile_paths: list[str] = []
+        tripped: set = set()
         for i in range(self.plans):
             rng = random.Random(f"{self.seed}:{i}")
             label = f"fuzz:{self.seed}:{i}"
-            plan = generate_plan(rng, registry, label)
+            plan = generate_plan(rng, registry, label, tripped=tripped)
             res = run_plan(
                 plan, os.path.join(root, f"plan{i:03d}"),
                 blocks=self.blocks, comm=self.comm,
@@ -761,6 +776,9 @@ class Campaign:
                     profile_paths.append(entry["profile"])
             results.append(entry)
             ledger.extend(res["trips"])
+            # feed the coverage weighting: the NEXT plan prefers points
+            # this campaign has not yet tripped
+            tripped.update(t["point"] for t in res["trips"])
         failures = sum(1 for e in results if e["verdict"] == "fail")
         return {
             "experiment": "faultfuzz",
@@ -779,6 +797,58 @@ class Campaign:
         }
 
 
+# -- chaos-coverage registry export -------------------------------------------
+
+
+def export_registry(blocks: int = DEFAULT_BLOCKS, comm: bool = True) -> dict:
+    """Build the pinned chaos-coverage registry that fabriclint's
+    chaos-coverage rule cross-checks the static faultmap against:
+    observer-plan discovery on the canned campaign workload, unioned
+    with every seam some pinned plan rule in the tree (exact name or
+    prefix wildcard — the bare ``"*"`` soak rule deliberately proves
+    nothing) can arm.
+
+    Only statically enumerated seams are eligible, so the registry is
+    a subset of the faultmap by construction — the export can record
+    coverage, never invent it.  Refresh with
+    ``scripts/chaos.py --export-registry`` after adding a seam plus
+    the chaos test that arms it."""
+    import shutil
+    import tempfile
+
+    from . import lint as lintmod
+
+    root = tempfile.mkdtemp(prefix="faultmap-")
+    try:
+        runtime = Campaign(
+            seed=0, plans=0, blocks=blocks, comm=comm
+        ).discover(root)
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    fm = lintmod.lint_tree(cache=False).faultmap()
+    seam_kinds: dict = {}
+    for s in fm["seams"]:
+        seam_kinds.setdefault(s["name"], set()).add(s["kind"])
+    exact = set()
+    prefixes = []
+    for rule in fm["plans"]:
+        if rule["wildcard"]:
+            if rule["point"] != "*":
+                prefixes.append(rule["point"][:-1])  # "x.*" -> "x."
+        else:
+            exact.add(rule["point"])
+    points = {}
+    for name, kinds in sorted(seam_kinds.items()):
+        armable = (
+            name in runtime
+            or name in exact
+            or any(name.startswith(p) for p in prefixes)
+        )
+        if armable:
+            points[name] = {"kinds": sorted(kinds)}
+    return {"points": points}
+
+
 __all__ = [
     "CHANNEL",
     "DEFAULT_BLOCKS",
@@ -791,4 +861,5 @@ __all__ = [
     "write_profile_doc",
     "replay",
     "Campaign",
+    "export_registry",
 ]

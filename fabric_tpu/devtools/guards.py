@@ -31,6 +31,14 @@ and an entry whose every access becomes HB-proven — with at least one
 access genuinely thread-reachable — is flagged STALE so this table
 only shrinks.  Declare a guard when the invariant is the reviewed
 contract (locks); let publication idioms be proven, not declared.
+
+v5 sharpened both sides of that bargain: events and accesses are
+ordered by CFG dominance/reachability rather than line position (a
+back edge that carries a write after a previous iteration's start is
+a finding, a start that dominates every access path is a proof), and
+the lockset consulted at each access is the flow-sensitive must-hold
+meet over paths — so a conditional acquire or early-return release
+can neither fake a guard here nor hide from one.
 """
 
 from __future__ import annotations

@@ -202,6 +202,7 @@ RULES = (
     "thread-lifecycle",
     "thread-hygiene",
     "jax-hygiene",
+    "chaos-coverage",
 )
 
 # meta rules: problems with the suppression machinery itself; never
@@ -242,6 +243,140 @@ LOCK_RANKS = {
 COMMIT_LOCK_NAMES = ("commit_lock", "_commit_lock")
 
 JAX_SYNC_CALLS = frozenset({"block_until_ready", "device_get"})
+
+# -- chaos-coverage (v5) -----------------------------------------------------
+
+# fault actions that only make sense against particular seam kinds: a
+# pinned plan wiring `torn` to a plain point can never tear anything
+CHAOS_ACTION_KINDS = {
+    "torn": frozenset({"write"}),
+    "partial": frozenset({"io"}),
+    "skip": frozenset({"guard"}),
+}
+
+# the checked-in campaign-registry export (scripts/chaos.py
+# --export-registry): every production seam the chaos campaign can arm
+# — via observer-plan discovery on the canned workload or a pinned plan
+# somewhere in the tree at export time
+FAULTMAP_REGISTRY_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "faultmap_registry.json"
+)
+
+
+def load_faultmap_registry(path: str | None = None) -> dict:
+    """``{point name: {"kinds": [...]}}`` from the checked-in registry
+    export; empty when the artifact is absent (fixture projects and
+    bootstrap runs check only their own plan rules then)."""
+    try:
+        with open(path or FAULTMAP_REGISTRY_PATH, "r",
+                  encoding="utf-8") as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    pts = data.get("points") if isinstance(data, dict) else None
+    return pts if isinstance(pts, dict) else {}
+
+
+def _chaos_coverage(
+    project: "dataflow.Project",
+    pinned_registry: dict | None,
+) -> list["Violation"]:
+    """Cross-check the statically enumerated faultline seams against
+    everything that could ever arm them: exact plan rules and prefix
+    wildcards pinned anywhere in the tree (a chaos test IS coverage),
+    plus the checked-in campaign-registry export.  The bare ``"*"``
+    soak wildcard proves nothing by itself — it arms only what the
+    workload reaches, which is exactly what the registry records."""
+    pinned = pinned_registry or {}
+    seam_kinds: dict[str, set] = {}
+    for s in project.faultline_seams:
+        seam_kinds.setdefault(s["name"], set()).add(s["kind"])
+    exact: set = set()
+    prefixes: list = []
+    for p in project.faultline_plans:
+        if p["wildcard"]:
+            if p["point"] != "*":
+                prefixes.append(p["point"][:-1])  # keep trailing dot
+        else:
+            exact.add(p["point"])
+    known = set(seam_kinds) | set(pinned)
+    out: list[Violation] = []
+    for d in project.faultline_dynamic:
+        out.append(Violation(
+            rule="chaos-coverage", path=d["module"], line=d["line"],
+            message=(
+                f"faultline.{d['kind']}() name is not a string literal "
+                "— the seam cannot be enumerated into the faultmap or "
+                "targeted by any pinned plan; use a literal dotted name"
+            ),
+        ))
+    for s in project.faultline_seams:
+        nm = s["name"]
+        if (
+            nm in exact
+            or nm in pinned
+            or any(nm.startswith(pre) for pre in prefixes)
+        ):
+            continue
+        out.append(Violation(
+            rule="chaos-coverage", path=s["module"], line=s["line"],
+            message=(
+                f"fault seam {nm!r} ({s['kind']}) can never be armed: "
+                "no pinned plan rule, prefix wildcard, or campaign-"
+                "registry entry matches it — add a chaos test / plan "
+                "that arms it, then refresh the registry export "
+                "(scripts/chaos.py --export-registry)"
+            ),
+        ))
+    for p in project.faultline_plans:
+        strict_file = profile_for(p["module"]) is STRICT_PROFILE
+        if p["wildcard"]:
+            if (
+                strict_file
+                and p["point"] != "*"
+                and not any(
+                    n.startswith(p["point"][:-1]) for n in known
+                )
+            ):
+                out.append(Violation(
+                    rule="chaos-coverage", path=p["module"],
+                    line=p["line"],
+                    message=(
+                        f"prefix wildcard {p['point']!r} matches no "
+                        "known fault seam — the rule is an orphan "
+                        "(the seams it covered were renamed or "
+                        "removed); fix the prefix or delete the rule"
+                    ),
+                ))
+            continue
+        kinds = set(seam_kinds.get(p["point"], ()))
+        kinds.update((pinned.get(p["point"]) or {}).get("kinds", ()))
+        if not kinds:
+            if strict_file:
+                out.append(Violation(
+                    rule="chaos-coverage", path=p["module"],
+                    line=p["line"],
+                    message=(
+                        f"plan rule names dead point {p['point']!r}: "
+                        "no fault seam or campaign-registry entry has "
+                        "that name — the injection it pinned has "
+                        "rotted; fix the name or delete the rule"
+                    ),
+                ))
+            continue
+        need = CHAOS_ACTION_KINDS.get(p["action"])
+        if need is not None and not (kinds & need):
+            out.append(Violation(
+                rule="chaos-coverage", path=p["module"], line=p["line"],
+                message=(
+                    f"action {p['action']!r} cannot fire at "
+                    f"{p['point']!r} (kind "
+                    f"{'/'.join(sorted(kinds))}): it only applies to "
+                    f"{'/'.join(sorted(need))} seams — the plan can "
+                    "never trip; fix the action or the point"
+                ),
+            ))
+    return out
 
 
 # -- profiles ----------------------------------------------------------------
@@ -1102,9 +1237,15 @@ def lint_sources(
     sources: dict[str, str],
     allowlist: list[AllowEntry] | None = None,
     used_entries: set[int] | None = None,
+    pinned_registry: dict | None = None,
 ) -> "LintReport":
     """Lint a set of modules as one program (keys are repo-relative
-    paths; interprocedural rules see across all of them)."""
+    paths; interprocedural rules see across all of them).
+
+    ``pinned_registry`` is the campaign-registry export consulted by
+    chaos-coverage; ``lint_tree`` passes the checked-in artifact, while
+    direct callers (fixture tests) default to None so a fixture project
+    is judged against its own plan rules only."""
     allowlist = allowlist if allowlist is not None else []
     used_entries = used_entries if used_entries is not None else set()
     states: dict[str, _FileState] = {}
@@ -1198,6 +1339,11 @@ def lint_sources(
                 rule="thread-lifecycle", path=flow.rel, line=flow.line,
                 message=flow.message,
             ))
+    # chaos-coverage (v5): seams nothing can arm, rotted plan rules
+    for v in _chaos_coverage(project, pinned_registry):
+        st = states.get(v.path)
+        if st is not None:
+            st.violations.append(v)
     # static lock-order cycles (v4): one violation per cycle, anchored
     # at the lexically-last contributing acquisition (the cycle-closing
     # side in file order)
@@ -1331,6 +1477,7 @@ class LintReport:
     cached_summaries: list | None = None
     cached_guards: dict | None = None
     cached_lockgraph: dict | None = None
+    cached_faultmap: dict | None = None
     cache_state: str = "off"  # "off" | "miss" | "hit"
 
     def function_summaries(self) -> list[dict]:
@@ -1354,6 +1501,16 @@ class LintReport:
         if self.project is not None:
             return self.project.lock_graph()
         return dict(self.cached_lockgraph or {"edges": {}, "roles": []})
+
+    def faultmap(self) -> dict:
+        """The chaos-coverage faultmap artifact (every production
+        injection seam + every pinned plan rule), live or cached."""
+        if self.project is not None:
+            return self.project.faultmap()
+        return dict(
+            self.cached_faultmap
+            or {"seams": [], "dynamic": [], "plans": []}
+        )
 
     @property
     def unsuppressed(self) -> list[Violation]:
@@ -1406,9 +1563,10 @@ class LintReport:
 # changes the key, which IS the per-file invalidation.
 
 _CACHE_DIR_NAME = ".fabriclint_cache"
-# v4 (hbcheck): HB facts in the summaries/guard map + the lock-order
-# graph joined the cached report — a v3 cache entry must never serve
-_CACHE_SCHEMA = 2
+# v5 (flowcheck): CFG facts in the summaries, flow-sensitive locksets
+# behind the guard map, and the chaos-coverage faultmap joined the
+# cached report — an earlier-schema entry must never serve
+_CACHE_SCHEMA = 3
 _CACHE_KEEP = 8
 _engine_fp_memo: list = []
 
@@ -1437,6 +1595,14 @@ def _engine_fingerprint() -> str:
     with open(os.path.abspath(__file__), "rb") as f:
         # fabriclint: allow[csp-seam] cache-key fingerprint (see above)
         h.update(hashlib.sha256(f.read()).digest())
+    # the campaign-registry export feeds chaos-coverage verdicts: a
+    # refreshed export must invalidate cached reports
+    try:
+        with open(FAULTMAP_REGISTRY_PATH, "rb") as f:
+            # fabriclint: allow[csp-seam] cache-key fingerprint (see above)
+            h.update(hashlib.sha256(f.read()).digest())
+    except OSError:
+        h.update(b"no-faultmap-registry")
     _engine_fp_memo.append(h.hexdigest())
     return _engine_fp_memo[0]
 
@@ -1522,9 +1688,13 @@ def lint_tree(
                 cached_summaries=entry["summaries"],
                 cached_guards=entry["guards"],
                 cached_lockgraph=entry["lockgraph"],
+                cached_faultmap=entry["faultmap"],
                 cache_state="hit",
             )
-    report = lint_sources(sources, allowlist, used_entries)
+    report = lint_sources(
+        sources, allowlist, used_entries,
+        pinned_registry=load_faultmap_registry(),
+    )
     # an entry is in this run's scope if its file was linted, or if it
     # falls under a directory target (so full-tree runs flag entries
     # whose file was DELETED, while partial runs — one file, one subdir —
@@ -1553,6 +1723,7 @@ def lint_tree(
             "summaries": report.function_summaries(),
             "guards": report.guard_map(),
             "lockgraph": report.lock_graph(),
+            "faultmap": report.faultmap(),
         })
         report.cache_state = "miss"
     return report
@@ -1644,6 +1815,11 @@ def main(argv=None) -> int:
              "(production sites) as JSON and exit",
     )
     ap.add_argument(
+        "--faultmap", action="store_true",
+        help="dump the chaos-coverage faultmap (every production "
+             "faultline seam + every pinned plan rule) as JSON and exit",
+    )
+    ap.add_argument(
         "--no-cache", action="store_true",
         help="skip the .fabriclint_cache dataflow cache (escape hatch)",
     )
@@ -1669,6 +1845,9 @@ def main(argv=None) -> int:
         return 0
     if args.lockgraph:
         print(json.dumps(report.lock_graph(), indent=2, sort_keys=True))
+        return 0
+    if args.faultmap:
+        print(json.dumps(report.faultmap(), indent=2, sort_keys=True))
         return 0
 
     shown = list(report.unsuppressed) + list(report.warnings)

@@ -211,8 +211,12 @@ class Gateway:
         """Stop threads and resolve every still-pending record to
         TIMEOUT — shutdown reports, it never silently drops."""
         self._stop.set()
-        self._work.set()
-        self._ack_event.set()
+        # wakeups move under the state lock (as everywhere): re-arms in
+        # the sender/credit loops clear-then-recheck under the same
+        # lock, so no set() can fall into a clear window
+        with self._lock:
+            self._work.set()
+            self._ack_event.set()
         if self._sender is not None:
             self._sender.join(timeout=5)
         if self._deliver is not None:
@@ -260,7 +264,7 @@ class Gateway:
                 m.queue_depth.With("channel", self.channel_id).set(
                     len(self._sendq)
                 )
-        self._work.set()
+            self._work.set()
         return SubmitResult(True, txid, STATUS_PENDING)
 
     def wait(self, txid: str, timeout: float) -> str:
@@ -408,15 +412,11 @@ class Gateway:
                         continue  # stop set, or backoff window armed
                 rec = self._next_record()
                 if rec is None:
-                    # fabriclint: allow[racecheck] bounded poll: the
-                    # loop re-waits with a 0.05s timeout and re-checks
-                    # _stop/_sendq every tick, so a set() lost to this
-                    # clear costs one tick, never a hang; the sendq
-                    # race is re-checked under the lock right below
-                    self._work.clear()
-                    # re-check under the race: a submit may have landed
-                    # between the pop miss and the clear
+                    # clear-then-recheck atomically under the state
+                    # lock: submit()'s append+set holds the same lock,
+                    # so a set() can never fall into the clear window
                     with self._lock:
+                        self._work.clear()
                         if self._sendq:
                             self._work.set()
                     continue
@@ -430,7 +430,7 @@ class Gateway:
                     # torn stream: requeue THIS record with the rest
                     with self._lock:
                         rec.sent = True
-                    self._stream_dead.set()
+                        self._stream_dead.set()
                     continue
                 with self._lock:
                     rec.sent = True
@@ -465,10 +465,8 @@ class Gateway:
             with self._lock:
                 if self._unacked < self._max_unacked:
                     return
-                # fabriclint: allow[racecheck] bounded poll: the wait
-                # below has a 0.05s timeout and every tick re-reads
-                # _unacked under the lock plus _stream_dead/_stop, so
-                # a set() lost to this clear costs one tick
+                # every _ack_event.set() holds this same lock, so the
+                # re-arm cannot swallow a wakeup
                 self._ack_event.clear()
             if self._stream_dead.is_set():
                 return
@@ -492,8 +490,12 @@ class Gateway:
                 self._gate.arm()
                 continue
             self._gate.reset()
-            self._stream_dead.clear()
             with self._lock:
+                # clear + generation bump are atomic: a superseded
+                # reader that still passes its gen check has done so
+                # under this lock BEFORE the bump, so its dead-mark
+                # lands before the clear, never after
+                self._stream_dead.clear()
                 self._gen += 1
                 gen = self._gen
                 self._unacked = 0
@@ -516,15 +518,16 @@ class Gateway:
                         return  # superseded stream: credits are void
                     if self._unacked > 0:
                         self._unacked -= 1
-                self._ack_event.set()
+                    self._ack_event.set()
         except Exception:
             pass  # torn stream: surfaced via _stream_dead below
         with self._lock:
-            current = self._gen == gen
-        if current:
-            self._stream_dead.set()
-            self._ack_event.set()
-            self._work.set()  # wake the sender to fail over promptly
+            if self._gen == gen:
+                # still the live stream: mark it dead and wake the
+                # sender to fail over promptly
+                self._stream_dead.set()
+                self._ack_event.set()
+                self._work.set()
 
     def _failover(self, stream, reader):
         """Stream loss: count the episode, requeue every sent-but-
@@ -553,7 +556,7 @@ class Gateway:
             for r in resub:
                 r.sent = False
             self._unacked = 0
-        self._work.set()
+            self._work.set()
         return None, None
 
 

@@ -67,6 +67,16 @@ def main() -> int:
     ap.add_argument("--kill9", action="store_true",
                     help="multi-process campaign: per plan, a real "
                          "topology with a seeded kill -9 schedule")
+    ap.add_argument("--export-registry", nargs="?", default=None,
+                    const="", metavar="PATH",
+                    help="refresh the pinned chaos-coverage registry "
+                         "(observer-plan discovery on the canned "
+                         "workload unioned with every seam a pinned "
+                         "plan rule can arm, restricted to statically "
+                         "enumerated seams) and exit; PATH defaults to "
+                         "the in-tree fabric_tpu/devtools/"
+                         "faultmap_registry.json that fabriclint's "
+                         "chaos-coverage rule cross-checks")
     ap.add_argument("--txs", type=int, default=80,
                     help="txs per kill9 campaign plan (default 80)")
     ap.add_argument("--metrics-out", default=None, metavar="DIR",
@@ -101,6 +111,22 @@ def main() -> int:
         # same contract as --trace-dir: FABRIC_TPU_PROFILE may already
         # have armed the sampler with a tuned cadence
         profile.arm()
+
+    if args.export_registry is not None:
+        from fabric_tpu.devtools import lint as lintmod  # noqa: E402
+
+        path = args.export_registry or lintmod.FAULTMAP_REGISTRY_PATH
+        reg = faultfuzz.export_registry(
+            blocks=args.blocks, comm=not args.no_comm
+        )
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(json.dumps(reg, indent=2, sort_keys=True) + "\n")
+        print(json.dumps({
+            "experiment": "faultmap-registry",
+            "path": path,
+            "points": len(reg["points"]),
+        }, sort_keys=True))
+        return 0
 
     t0 = time.perf_counter()
     if args.replay:

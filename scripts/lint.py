@@ -7,7 +7,8 @@ lines.  Exit code mirrors the linter (non-zero on any unsuppressed
 error-severity violation, after the optional baseline ratchet).
 
 Usage: python scripts/lint.py [--show-suppressed] [--baseline FILE]
-       [--write-baseline FILE]
+       [--write-baseline FILE] [--summaries-out P] [--guards-out P]
+       [--lockgraph-out P] [--faultmap-out P] [--budget-s S]
 
 The baseline ratchet lets a new rule land loud-but-not-fatal: a JSON
 {"rule": count} file tolerates up to COUNT unsuppressed errors per rule.
@@ -65,10 +66,25 @@ def main() -> int:
              "runtime graph; tier-1 asserts runtime ⊆ static",
     )
     ap.add_argument(
+        "--faultmap-out", default=None, metavar="PATH",
+        help="write the chaos-coverage faultmap (every statically "
+             "enumerated faultline seam + every pinned plan rule, "
+             "deterministic order) as a JSON artifact — what the "
+             "chaos-coverage rule cross-checked against the pinned "
+             "campaign registry this run",
+    )
+    ap.add_argument(
         "--no-cache", action="store_true",
         help="bypass the .fabriclint_cache dataflow cache (escape "
              "hatch; the cache is keyed by file content hashes and "
              "invalidates per file)",
+    )
+    ap.add_argument(
+        "--budget-s", type=float, default=None, metavar="SECONDS",
+        help="fail (exit 1) when the lint pass exceeds this wall-time "
+             "budget — CI asserts a warm-cache full-tree pass stays "
+             "under 1.5s so the CFG pass cannot quietly double tier-1 "
+             "setup cost",
     )
     args = ap.parse_args()
 
@@ -111,6 +127,17 @@ def main() -> int:
             "roles": len(graph["roles"]),
             "edges": sum(len(d) for d in graph["edges"].values()),
         }
+    faultmap_written = None
+    if args.faultmap_out:
+        fm = report.faultmap()
+        with open(args.faultmap_out, "w", encoding="utf-8") as f:
+            json.dump(fm, f, indent=2, sort_keys=True)
+            f.write("\n")
+        faultmap_written = {
+            "path": args.faultmap_out,
+            "seams": len(fm["seams"]),
+            "plans": len(fm["plans"]),
+        }
     out = {
         "experiment": "fabriclint",
         "files": summary["files"],
@@ -129,6 +156,12 @@ def main() -> int:
         out["guards"] = guards_written
     if lockgraph_written is not None:
         out["lockgraph"] = lockgraph_written
+    if faultmap_written is not None:
+        out["faultmap"] = faultmap_written
+    budget_ok = True
+    if args.budget_s is not None:
+        budget_ok = elapsed <= args.budget_s
+        out["budget"] = {"budget_s": args.budget_s, "ok": budget_ok}
     if args.write_baseline:
         with open(args.write_baseline, "w", encoding="utf-8") as f:
             json.dump(summary["by_rule"], f, indent=2, sort_keys=True)
@@ -140,9 +173,9 @@ def main() -> int:
         ratchet = apply_baseline(report, load_baseline(args.baseline))
         out["baseline"] = ratchet
         print(json.dumps(out))
-        return 0 if ratchet["ok"] else 1
+        return 0 if ratchet["ok"] and budget_ok else 1
     print(json.dumps(out))
-    return 0 if summary["clean"] else 1
+    return 0 if summary["clean"] and budget_ok else 1
 
 
 if __name__ == "__main__":

@@ -1,0 +1,17 @@
+"""Clean twin of fix_coverage_orphan_dirty: the plan rule pins the
+seam by its exact name, so the seam is armable and the rule is not an
+orphan — chaos-coverage stays quiet."""
+
+from fabric_tpu.devtools import faultline
+
+RELAY_PLAN = {
+    "seed": 3,
+    "faults": [
+        {"point": "relay.send", "action": "raise", "error": "OSError"},
+    ],
+}
+
+
+def forward(batch):
+    faultline.point("relay.send", n=len(batch))
+    return list(batch)

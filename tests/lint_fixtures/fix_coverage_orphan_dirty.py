@@ -1,0 +1,20 @@
+"""Seeded violation (chaos-coverage): the module's only plan rule is a
+prefix wildcard that matches NOTHING the seam is named — the seam can
+never be armed (uncovered) and the wildcard arms nothing (prefix
+orphan).  Expected: chaos-coverage fires at the seam AND at the plan
+rule."""
+
+from fabric_tpu.devtools import faultline
+
+RELAY_PLAN = {
+    "seed": 3,
+    "faults": [
+        # <- prefix orphan: no static seam starts with "relay.hop."
+        {"point": "relay.hop.*", "action": "delay", "delay_s": 0.0},
+    ],
+}
+
+
+def forward(batch):
+    faultline.point("relay.send", n=len(batch))  # <- uncovered: HERE
+    return list(batch)

@@ -1,0 +1,44 @@
+"""Clean twin of fix_flow_branchlock_dirty: the explicit
+acquire/try/finally-release straddles the write on EVERY path — no
+``with`` statement, so only the flow-sensitive lockset (must-hold meet
+over paths) can prove the critical section and stay quiet."""
+
+import threading
+
+from fabric_tpu.devtools.lockwatch import spawn_thread
+
+
+class TallyBoard:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+        self._stop = threading.Event()
+
+    def serve(self):
+        t = spawn_thread(
+            target=self._run, name="tally", kind="service"
+        )
+        t.start()
+        return t
+
+    def stop(self):
+        self._stop.set()
+
+    def _run(self):
+        while not self._stop.is_set():
+            self.bump()
+
+    def bump(self):
+        self._lock.acquire()
+        try:
+            self._count += 1  # held on every path: proven quiet
+        finally:
+            self._lock.release()
+
+    def read(self):
+        with self._lock:
+            return self._count
+
+    def reset(self):
+        with self._lock:
+            self._count = 0

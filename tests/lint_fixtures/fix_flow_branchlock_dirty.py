@@ -1,0 +1,45 @@
+"""Seeded violation (racecheck, v5 CFG pass): the lock is acquired on
+only ONE branch into a shared write — the meet over the two paths is
+the empty lockset, so the write is unguarded whenever ``fast`` is
+false.  A lexical scan sees acquire-then-write and stays silent; the
+flow-sensitive lockset does not."""
+
+import threading
+
+from fabric_tpu.devtools.lockwatch import spawn_thread
+
+
+class TallyBoard:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+        self._stop = threading.Event()
+
+    def serve(self):
+        t = spawn_thread(
+            target=self._run, name="tally", kind="service"
+        )
+        t.start()
+        return t
+
+    def stop(self):
+        self._stop.set()
+
+    def _run(self):
+        while not self._stop.is_set():
+            self.bump(True)
+
+    def bump(self, fast):
+        if fast:
+            self._lock.acquire()
+        self._count += 1  # <- one path holds nothing: fires HERE
+        if fast:
+            self._lock.release()
+
+    def read(self):
+        with self._lock:
+            return self._count
+
+    def reset(self):
+        with self._lock:
+            self._count = 0

@@ -1,0 +1,48 @@
+"""Clean twin of fix_flow_earlyret_dirty: every access — the empty
+check, the snapshot, the reset — happens before the release on its
+path (try/finally), so the flow-sensitive lockset proves the whole
+function and stays quiet."""
+
+import threading
+
+from fabric_tpu.devtools.lockwatch import spawn_thread
+
+
+class Spool:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._buf = []
+        self._stop = threading.Event()
+
+    def serve(self):
+        t = spawn_thread(
+            target=self._run, name="spool", kind="service"
+        )
+        t.start()
+        return t
+
+    def stop(self):
+        self._stop.set()
+
+    def _run(self):
+        while not self._stop.is_set():
+            self.drain()
+
+    def drain(self):
+        self._lock.acquire()
+        try:
+            if not self._buf:
+                return []
+            items = list(self._buf)
+            self._buf = []
+            return items
+        finally:
+            self._lock.release()
+
+    def push(self, item):
+        with self._lock:
+            self._buf.append(item)
+
+    def peek(self):
+        with self._lock:
+            return list(self._buf)

@@ -1,0 +1,48 @@
+"""Seeded violation (racecheck, v5 CFG pass): the empty-buffer early
+path releases the lock and THEN writes the shared field — lexically
+inside the acquire/release span, but past the release on its own path.
+Only the per-program-point lockset sees the hole."""
+
+import threading
+
+from fabric_tpu.devtools.lockwatch import spawn_thread
+
+
+class Spool:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._buf = []
+        self._stop = threading.Event()
+
+    def serve(self):
+        t = spawn_thread(
+            target=self._run, name="spool", kind="service"
+        )
+        t.start()
+        return t
+
+    def stop(self):
+        self._stop.set()
+
+    def _run(self):
+        while not self._stop.is_set():
+            self.drain()
+
+    def drain(self):
+        self._lock.acquire()
+        if not self._buf:
+            self._lock.release()
+            self._buf = []  # <- released on this path: fires HERE
+            return []
+        items = list(self._buf)
+        self._buf = []
+        self._lock.release()
+        return items
+
+    def push(self, item):
+        with self._lock:
+            self._buf.append(item)
+
+    def peek(self):
+        with self._lock:
+            return list(self._buf)

@@ -1,0 +1,32 @@
+"""Clean twin of fix_flow_loopstart_dirty: the shared field is fully
+published BEFORE the loop, so every start() in the loop dominates no
+later write — the CFG pass proves the publication and stays quiet."""
+
+from fabric_tpu.devtools.lockwatch import spawn_thread
+
+
+def handle(item):
+    return item
+
+
+class BatchPump:
+    def __init__(self):
+        self._batch = []
+        self._threads = []
+
+    def launch(self, specs):
+        # publish once, before any worker exists: every path from a
+        # start() sees only reads of the field
+        self._batch = list(specs)
+        for _spec in specs:
+            t = spawn_thread(
+                target=self._run, name="pump", kind="worker"
+            )
+            t.start()
+            self._threads.append(t)
+        for t in self._threads:
+            t.join()
+
+    def _run(self):
+        for item in list(self._batch):
+            handle(item)

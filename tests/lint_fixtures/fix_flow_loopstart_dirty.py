@@ -1,0 +1,34 @@
+"""Seeded violation (racecheck, v5 CFG pass): the loop body writes the
+shared field and THEN starts the worker — on iteration 2 the write
+races with the thread started on iteration 1.  Line numbers say
+write-before-start; the back edge says otherwise, and only the
+flow-sensitive happens-before pass sees it."""
+
+from fabric_tpu.devtools.lockwatch import spawn_thread
+
+
+def handle(item):
+    return item
+
+
+class BatchPump:
+    def __init__(self):
+        self._batch = []
+        self._threads = []
+
+    def launch(self, specs):
+        for spec in specs:
+            # iteration 2 rebinds the field the iteration-1 worker is
+            # reading: the back edge carries this write AFTER a start
+            self._batch = [spec]  # <- racecheck fires HERE
+            t = spawn_thread(
+                target=self._run, name="pump", kind="worker"
+            )
+            t.start()
+            self._threads.append(t)
+        for t in self._threads:
+            t.join()
+
+    def _run(self):
+        for item in list(self._batch):
+            handle(item)

@@ -391,11 +391,25 @@ def test_pinned_shard_flush_crash_plan_deterministic(tmp_path, monkeypatch):
     assert first == second
 
 
+# literal plan rules (not a name parametrized through _crash_plan):
+# these pins are what the chaos-coverage faultmap cross-check counts
+# as arming the two segment-lifecycle seams
+SEGMENT_LIFECYCLE_PLANS = [
+    {"seed": 1, "faults": [
+        {"point": "blkstorage.segment_prealloc", "action": "crash"},
+    ]},
+    {"seed": 1, "faults": [
+        {"point": "blkstorage.segment_roll", "action": "crash"},
+    ]},
+]
+
+
 @pytest.mark.parametrize(
-    "point", ["blkstorage.segment_prealloc", "blkstorage.segment_roll"],
+    "plan", SEGMENT_LIFECYCLE_PLANS,
+    ids=[p["faults"][0]["point"] for p in SEGMENT_LIFECYCLE_PLANS],
 )
 def test_crash_at_segment_lifecycle_points_recovers(
-    tmp_path, point, monkeypatch
+    tmp_path, plan, monkeypatch
 ):
     """The preallocated-segment writer's metadata seams: a crash while
     preallocating the next segment (before its rename publishes it) or
@@ -409,7 +423,7 @@ def test_crash_at_segment_lifecycle_points_recovers(
     ledger.commit(_write_block(ledger, 0, [("cc", "a", big)]))
 
     blk1 = _write_block(ledger, 1, [("cc", "b", big)])
-    with faultline.use_plan(_crash_plan(point)):
+    with faultline.use_plan(plan):
         with pytest.raises(faultline.FaultCrash):
             ledger.commit(blk1)
         assert faultline.trips(), "the plan never fired"

@@ -338,3 +338,67 @@ def test_soak_tier1_workload_green_oracle(tmp_path):
         str(tmp_path), stats, faultfuzz.workload_writes(12)
     )
     assert violations == [], [str(v) for v in violations]
+
+
+# -- coverage-weighted generation (ISSUE 18 satellite) ------------------------
+
+
+def test_generate_plan_prefers_cold_points_same_draw_count():
+    """Selection is biased toward registry entries with zero trips so
+    far: with every point but one marked tripped, every fault rule
+    lands on the cold one — and the weighting consumes the same RNG
+    draws as the unweighted path, so an empty tripped set reproduces
+    the v4 stream exactly (the same-seed campaign byte-identity pin
+    rides on this)."""
+    import random
+
+    reg = {
+        "a.one": {"kinds": []},
+        "b.two": {"kinds": []},
+        "c.three": {"kinds": []},
+    }
+    for i in range(20):
+        rng = random.Random(f"w:{i}")
+        plan = faultfuzz.generate_plan(
+            rng, reg, "w", tripped={"a.one", "c.three"}
+        )
+        assert all(f["point"] == "b.two" for f in plan["faults"])
+    # empty tripped set == the unweighted stream, draw for draw
+    for i in range(20):
+        p0 = faultfuzz.generate_plan(
+            random.Random(f"s:{i}"), reg, "s"
+        )
+        p1 = faultfuzz.generate_plan(
+            random.Random(f"s:{i}"), reg, "s", tripped=frozenset()
+        )
+        assert p0 == p1
+    # fully-tripped registry degrades to uniform, never to an error
+    p = faultfuzz.generate_plan(
+        random.Random("t"), reg, "t", tripped=set(reg)
+    )
+    assert all(f["point"] in reg for f in p["faults"])
+
+
+# -- chaos-coverage registry cross-check (ISSUE 18 tentpole) ------------------
+
+
+def test_pinned_registry_contains_fresh_discovery(tmp_path):
+    """The pinned faultmap registry (fabric_tpu/devtools/
+    faultmap_registry.json, refreshed via scripts/chaos.py
+    --export-registry) must contain every point a fresh observer-plan
+    discovery finds — discovery ⊆ registry, the runtime half of the
+    containment chain (lint pins registry ⊆ static faultmap)."""
+    from fabric_tpu.devtools.lint import load_faultmap_registry
+
+    pinned = load_faultmap_registry()
+    assert pinned, "faultmap_registry.json missing or empty"
+    c = faultfuzz.Campaign(
+        seed=1, plans=0, workdir=str(tmp_path), out_dir=str(tmp_path)
+    )
+    fresh = c.discover(str(tmp_path))
+    for name, ent in fresh.items():
+        assert name in pinned, (
+            f"discovery found {name!r} missing from the pinned "
+            "registry — refresh with scripts/chaos.py --export-registry"
+        )
+        assert set(ent["kinds"]) <= set(pinned[name]["kinds"]), name

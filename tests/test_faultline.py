@@ -307,8 +307,13 @@ def test_raft_link_flap_reconnects_and_delivers(tmp_path):
     t2.set_handler(lambda req: got.append(req.submit.envelope))
     t1.set_peer(2, t2.addr)
     try:
+        # prefix wildcard: arms BOTH halves of the io pair — on this
+        # outbound link only writes happen, but the chaos-coverage
+        # faultmap counts the pin for raft.conn.read too (a wildcard
+        # arms whatever the runtime reaches, which is what the pinned
+        # registry records)
         with faultline.use_plan({"faults": [
-            {"point": "raft.conn.write", "action": "raise",
+            {"point": "raft.conn.*", "action": "raise",
              "error": "ECONNRESET", "nth": 3},
         ]}):
             # keep sending until delivery resumes through the
@@ -510,6 +515,44 @@ def test_gossip_dial_fault_backs_off_and_recovers():
             n += 1
             time.sleep(0.05)
         assert seen
+    finally:
+        send.close()
+        recv.close()
+
+
+def test_gossip_conn_fault_mid_stream_reconnects():
+    """A reset INSIDE an established gossip link (the ``gossip.conn``
+    io pair, armed by prefix wildcard — same rationale as the raft
+    link-flap plan: the wildcard arms whichever half the runtime
+    reaches) — the sender's reconnect-per-message loop restores
+    delivery, gossip's loss tolerance absorbing the reset-swallowed
+    frame."""
+    from fabric_tpu.gossip.comm import TCPGossipComm
+    from fabric_tpu.protos.gossip import message_pb2 as gpb
+
+    recv = TCPGossipComm(("127.0.0.1", 0), b"id-recv")
+    send = TCPGossipComm(("127.0.0.1", 0), b"id-send")
+    seen: list[str] = []
+    recv.subscribe(lambda rm: seen.append(rm.msg.alive_msg.membership.endpoint))
+    try:
+        with faultline.use_plan({"faults": [
+            {"point": "gossip.conn.*", "action": "raise",
+             "error": "ECONNRESET", "nth": 2},
+        ]}):
+            deadline = time.monotonic() + 10
+            n = 0
+            while time.monotonic() < deadline and (
+                not faultline.trips() or len(seen) < 3
+            ):
+                m = gpb.GossipMessage()
+                m.alive_msg.membership.endpoint = "e%d" % n
+                send.send(recv.endpoint, m)
+                n += 1
+                time.sleep(0.05)
+            tripped = [t for t in faultline.trips()
+                       if t["point"].startswith("gossip.conn.")]
+            assert tripped, "the link was never reset"
+        assert len(seen) >= 3  # traffic flowed again after the reset
     finally:
         send.close()
         recv.close()

@@ -435,6 +435,52 @@ def test_seeded_raise_at_stream_write_takes_failover_path():
         ord_b.stop()
 
 
+def test_armed_plan_trips_every_gateway_point():
+    """Pinned arming plan for the gateway's three bare ``point`` seams
+    (admission / status.resolve / failover): zero-delay counting rules
+    plus a seeded mid-stream loss to force the failover path, asserting
+    each seam actually trips.  This is the plan the chaos-coverage
+    faultmap cross-check counts as coverage for these names."""
+    ord_a = _MiniOrderer()
+    ord_b = _MiniOrderer()
+    gw = Gateway(
+        CHANNEL,
+        [ord_a.connect_factory(), ord_b.connect_factory()],
+        max_backoff_s=0.05,
+    )
+    gw.start()
+    try:
+        with faultline.use_plan({"seed": 1, "label": "gw-arm", "faults": [
+            {"point": "gateway.admission", "action": "delay",
+             "delay_s": 0.0, "count": 100},
+            {"point": "gateway.status.resolve", "action": "delay",
+             "delay_s": 0.0, "count": 100},
+            {"point": "gateway.failover", "action": "delay",
+             "delay_s": 0.0, "count": 100},
+            {"point": "gateway.stream.write", "action": "raise",
+             "error": "OSError", "count": 1},
+        ]}):
+            envs = [_env(f"ap{i}") for i in range(5)]
+            for e in envs:
+                assert gw.submit(e).accepted
+            all_txids = {txid_of(e) for e in envs}
+            _wait_until(lambda: gw.failovers >= 1, msg="injected loss")
+            _wait_until(
+                lambda: ord_a.txids() | ord_b.txids() >= all_txids,
+                msg="every tx ordered despite the injected loss",
+            )
+            gw.observe_block(0, _block(envs, [0] * 5))
+            assert all(gw.status(t) == STATUS_VALID for t in all_txids)
+            tripped = {t["point"] for t in faultline.trips()}
+        for point in ("gateway.admission", "gateway.status.resolve",
+                      "gateway.failover"):
+            assert point in tripped, f"{point} never tripped"
+    finally:
+        gw.stop()
+        ord_a.stop()
+        ord_b.stop()
+
+
 # ---------------------------------------------------------------------------
 # the real thing: orderer SIGKILL mid-stream under the netharness
 # ---------------------------------------------------------------------------

@@ -635,6 +635,9 @@ def _report_json(report) -> str:
         "summaries": report.function_summaries(),
         "guards": report.guard_map(),
         "lockgraph": report.lock_graph(),
+        # v5: the chaos-coverage faultmap and the CFG facts riding the
+        # function summaries are cached artifacts too
+        "faultmap": report.faultmap(),
     }, sort_keys=True)
 
 
@@ -665,6 +668,16 @@ def _write_cache_tree(tmp_path):
         "            with self._b:\n"
         "                pass\n"
     )
+    # a branchy function so the cached summaries carry a real CFG-facts
+    # block (v5) — the identity pin must cover it
+    (pkg / "branchy.py").write_text(
+        "def walk(items):\n"
+        "    total = 0\n"
+        "    for it in items:\n"
+        "        if it:\n"
+        "            total += 1\n"
+        "    return total\n"
+    )
 
 
 def test_dataflow_cache_hit_matches_cold_run_exactly(tmp_path):
@@ -674,6 +687,10 @@ def test_dataflow_cache_hit_matches_cold_run_exactly(tmp_path):
     cold = lint_tree(root=str(tmp_path), targets=("pkg",))
     assert cold.cache_state == "miss"
     assert cold.summary()["by_rule"] == {"thread-hygiene": 1}
+    # the cold summaries carry real CFG facts for the identity pin
+    assert any(
+        s.get("cfg", {}).get("back_edges") for s in cold.function_summaries()
+    )
     hit = lint_tree(root=str(tmp_path), targets=("pkg",))
     assert hit.cache_state == "hit"
     assert hit.project is None  # served without re-analysis
@@ -858,3 +875,116 @@ def test_ci_wrapper_summaries_out_writes_artifact(tmp_path):
     assert len(lines) == result["summaries"]["functions"] > 100
     sample = json.loads(lines[0])
     assert "function" in sample and "file" in sample
+
+
+# -- v5 "flowcheck": CFG facts, hb-publish floor, chaos-coverage -------------
+
+
+def test_v5_chaos_coverage_enforced_at_error_in_both_profiles():
+    """ISSUE 18 acceptance: chaos-coverage is the 11th rule, on at
+    error severity in BOTH profiles (a test plan is coverage, so tests
+    must lint it), and the tree gate still runs with no baseline."""
+    from fabric_tpu.devtools.lint import RELAXED_PROFILE, STRICT_PROFILE
+
+    assert len(RULES) == 11
+    assert "chaos-coverage" in RULES
+    for prof in (STRICT_PROFILE, RELAXED_PROFILE):
+        assert "chaos-coverage" not in prof.disabled
+        assert "chaos-coverage" not in prof.advisory
+    import glob
+    import os
+
+    from fabric_tpu.devtools.lint import repo_root
+
+    assert not glob.glob(os.path.join(repo_root(), "*baseline*.json")), (
+        "the tree must stay clean with NO baseline ratchet file"
+    )
+
+
+def test_hb_publish_count_does_not_decrease_vs_v4():
+    """ISSUE 18 acceptance: the CFG-ordered happens-before pass must
+    convert conservative silences into proofs, never lose them — the
+    v4 guard map carried 171 hb-publish resolutions; v5 holds the
+    floor (and production sites gained flow-sensitive CFG facts)."""
+    report = lint_tree()
+    guards = report.guard_map()
+    hb = [g for g in guards.values() if g["source"] == "hb-publish"]
+    assert len(hb) >= 171
+    # per-function CFG facts are live on the production tree: loops
+    # produce back edges, branches produce multi-block functions
+    summaries = report.function_summaries()
+    cfgs = [s["cfg"] for s in summaries if "cfg" in s]
+    assert len(cfgs) > 200
+    assert any(c["back_edges"] for c in cfgs)
+    # no production function uses a bare acquire/release pair (all
+    # critical sections are `with`-scoped), so flow_locks stays empty
+    # tree-wide — the explicit-pair half of the flow lockset is pinned
+    # by the fix_flow_branchlock / fix_flow_earlyret fixtures
+    assert not any(c.get("flow_locks") for c in cfgs)
+
+
+def test_faultmap_matches_pinned_registry_and_is_deterministic():
+    """ISSUE 18 acceptance: the tree's chaos-coverage cross-check is
+    green — every statically enumerated seam is armable (exact pin,
+    prefix wildcard, or pinned campaign-registry entry) — and the
+    pinned registry never names a seam the static scan cannot see
+    (registry ⊆ faultmap, the same containment direction tier-1 pins
+    for runtime-lockgraph ⊆ static)."""
+    from fabric_tpu.devtools.lint import load_faultmap_registry
+
+    report = lint_tree()
+    fm = report.faultmap()
+    assert not [v for v in report.unsuppressed
+                if v.rule == "chaos-coverage"]
+    seam_names = {s["name"] for s in fm["seams"]}
+    assert len(seam_names) > 30
+    assert not fm["dynamic"], "every production seam name is a literal"
+    registry = load_faultmap_registry()
+    assert len(registry) > 30
+    for name, ent in registry.items():
+        assert name in seam_names, (
+            f"pinned registry names unknown seam {name!r} — stale "
+            "export; refresh with scripts/chaos.py --export-registry"
+        )
+        kinds = {s["kind"] for s in fm["seams"] if s["name"] == name}
+        assert set(ent["kinds"]) <= kinds, name
+    # the faultmap artifact is byte-deterministic across runs
+    a = json.dumps(fm, sort_keys=True)
+    b = json.dumps(lint_tree(cache=False).faultmap(), sort_keys=True)
+    assert a == b
+
+
+def test_ci_wrapper_faultmap_out_and_warm_cache_budget(tmp_path):
+    """scripts/lint.py --faultmap-out PATH + --budget-s S (ISSUE 18
+    satellite): the faultmap lands as a JSON artifact beside the
+    result line, and a warm-cache full-tree pass fits the 1.5 s budget
+    the CI gate asserts — the CFG pass cannot quietly double tier-1
+    setup cost."""
+    import os
+
+    from fabric_tpu.devtools.lint import repo_root
+
+    root = repo_root()
+    out_path = tmp_path / "faultmap.json"
+    # first run warms the cache (no budget: it may be a cold miss)
+    warm = subprocess.run(
+        [sys.executable, os.path.join(root, "scripts", "lint.py")],
+        capture_output=True, text=True, cwd=root,
+    )
+    assert warm.returncode == 0, warm.stdout + warm.stderr
+    proc = subprocess.run(
+        [sys.executable, os.path.join(root, "scripts", "lint.py"),
+         "--faultmap-out", str(out_path), "--budget-s", "1.5"],
+        capture_output=True, text=True, cwd=root,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    result = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert result["experiment"] == "fabriclint"
+    assert result["cache"] == "hit"
+    assert result["budget"] == {"budget_s": 1.5, "ok": True}
+    assert result["faultmap"]["path"] == str(out_path)
+    fm = json.loads(out_path.read_text())
+    assert result["faultmap"]["seams"] == len(fm["seams"]) > 50
+    assert result["faultmap"]["plans"] == len(fm["plans"]) > 50
+    sample = fm["seams"][0]
+    assert {"name", "kind", "module", "line"} <= set(sample)

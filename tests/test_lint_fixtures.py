@@ -442,6 +442,9 @@ def test_faultline_seam_keeps_reviewed_pragmas_used():
     — transparency means the handler still counts as swallowing."""
     src = (
         "from fabric_tpu.devtools import faultline\n"
+        "# the arming pin chaos-coverage demands for any new seam\n"
+        "PLAN = {'faults': [{'point': 'loop.reconnect',"
+        " 'action': 'raise'}]}\n"
         "def run(step):\n"
         "    try:\n"
         "        step()\n"
@@ -793,3 +796,96 @@ def test_racecheck_rebound_lock_alias_degrades_to_unknown():
     )
     vs = lint_source(src, "fabric_tpu/gossip/fix_rebound_inline.py")
     assert _fires(vs, "racecheck") == []
+
+
+# -- v5 CFG pass: loop-carried start, branch-dependent lock, early return ----
+
+
+def test_flow_loopstart_back_edge_write_fires():
+    """Start on iteration 1, write on iteration 2: positionally the
+    write precedes the start, but the back edge carries it after — the
+    v5 acceptance fixture for CFG-ordered happens-before."""
+    src = _load("fix_flow_loopstart_dirty.py")
+    vs = lint_source(
+        src, "fabric_tpu/gossip/fix_flow_loopstart_dirty.py"
+    )
+    lines = _fires(vs, "racecheck")
+    assert len(lines) == 1
+    assert "fires HERE" in src.splitlines()[lines[0] - 1]
+
+
+def test_flow_loopstart_hoisted_publication_quiet():
+    src = _load("fix_flow_loopstart_clean.py")
+    vs = lint_source(
+        src, "fabric_tpu/gossip/fix_flow_loopstart_clean.py"
+    )
+    assert _fires(vs, "racecheck") == []
+
+
+def test_flow_branchlock_one_armed_acquire_fires():
+    src = _load("fix_flow_branchlock_dirty.py")
+    vs = lint_source(
+        src, "fabric_tpu/gossip/fix_flow_branchlock_dirty.py"
+    )
+    lines = _fires(vs, "racecheck")
+    assert len(lines) == 1
+    assert "fires HERE" in src.splitlines()[lines[0] - 1]
+
+
+def test_flow_branchlock_try_finally_proven_quiet():
+    """The clean twin has NO `with` statement: only the flow lockset
+    (explicit acquire → try/finally release as a must-hold dataflow)
+    can prove the critical section."""
+    src = _load("fix_flow_branchlock_clean.py")
+    vs = lint_source(
+        src, "fabric_tpu/gossip/fix_flow_branchlock_clean.py"
+    )
+    assert _fires(vs, "racecheck") == []
+    assert _fires(vs, "lock-discipline") == []
+
+
+def test_flow_earlyret_post_release_write_fires():
+    src = _load("fix_flow_earlyret_dirty.py")
+    vs = lint_source(
+        src, "fabric_tpu/gossip/fix_flow_earlyret_dirty.py"
+    )
+    lines = _fires(vs, "racecheck")
+    assert len(lines) == 1
+    assert "fires HERE" in src.splitlines()[lines[0] - 1]
+
+
+def test_flow_earlyret_try_finally_proven_quiet():
+    src = _load("fix_flow_earlyret_clean.py")
+    vs = lint_source(
+        src, "fabric_tpu/gossip/fix_flow_earlyret_clean.py"
+    )
+    assert _fires(vs, "racecheck") == []
+    assert _fires(vs, "lock-discipline") == []
+
+
+# -- chaos-coverage: orphaned seam + dead prefix wildcard --------------------
+
+
+def test_coverage_orphan_seam_and_dead_wildcard_fire():
+    """The seeded orphan: a seam no rule can arm fires at the seam
+    line, and the wildcard that matches nothing fires at its rule."""
+    src = _load("fix_coverage_orphan_dirty.py")
+    vs = lint_source(
+        src, "fabric_tpu/gossip/fix_coverage_orphan_dirty.py"
+    )
+    lines = _fires(vs, "chaos-coverage")
+    assert len(lines) == 2
+    marked = [ln for ln in lines
+              if "uncovered: HERE" in src.splitlines()[ln - 1]]
+    assert len(marked) == 1
+    msgs = [v.message for v in vs
+            if v.rule == "chaos-coverage" and not v.suppressed]
+    assert any("orphan" in m for m in msgs)
+
+
+def test_coverage_orphan_exact_pin_quiet():
+    src = _load("fix_coverage_orphan_clean.py")
+    vs = lint_source(
+        src, "fabric_tpu/gossip/fix_coverage_orphan_clean.py"
+    )
+    assert _fires(vs, "chaos-coverage") == []

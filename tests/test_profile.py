@@ -342,7 +342,8 @@ def test_campaign_writes_profile_artifact_next_to_repro(
     }
     monkeypatch.setattr(
         faultfuzz, "generate_plan",
-        lambda rng, registry, label: {**seeded, "label": label, "seed": 3},
+        lambda rng, registry, label, tripped=frozenset():
+            {**seeded, "label": label, "seed": 3},
     )
     out_dir = tmp_path / "artifacts"
     with profile.scope(sampler=False):

@@ -330,6 +330,50 @@ def test_torn_tail_in_prealloc_zone_is_erased(tmp_path, monkeypatch):
     p3.close()
 
 
+def test_skipped_recovery_truncate_guard_is_defense_in_depth(
+    tmp_path, monkeypatch
+):
+    """A faultfuzz "skip" at the ``blkstorage.recovery_truncate`` guard
+    deletes the torn-tail erase — and recovery must STILL be correct,
+    because the scan never trusts bytes past the checkpoint and the
+    next in-segment append overwrites from the checkpoint offset.  The
+    guard is defense in depth, not a correctness crutch; this pinned
+    plan is also what proves the seam armable to chaos-coverage."""
+    monkeypatch.setenv("FABRIC_TPU_STORE_SEGMENT", "65536")
+    provider = LedgerProvider(str(tmp_path))
+    ledger = provider.open("v2")
+    ledger.commit(_write_block(ledger, 0, [("cc", "a", b"0")]))
+    provider.close()
+
+    path = os.path.join(str(tmp_path), "v2", "chains",
+                        "blocks_000000.dat")
+    with open(path, "rb") as f:
+        data = f.read()
+    (n,) = struct.unpack(">I", data[:4])
+    tail = 4 + n
+    with open(path, "r+b") as f:  # a torn header: promises 500 bytes
+        f.seek(tail)
+        f.write(struct.pack(">I", 500) + b"GARBAGE")
+
+    with faultline.use_plan({"seed": 1, "faults": [
+        {"point": "blkstorage.recovery_truncate", "action": "skip"},
+    ]}):
+        p2 = LedgerProvider(str(tmp_path))
+        led2 = p2.open("v2")
+        assert faultline.trips(), "the skip rule never fired"
+        # torn bytes were NOT erased, yet recovery ignores them
+        assert led2.height == 1
+        assert led2.get_state("cc", "a") == b"0"
+        led2.commit(_write_block(led2, 1, [("cc", "b", b"1")]))
+        p2.close()
+
+    p3 = LedgerProvider(str(tmp_path))
+    led3 = p3.open("v2")
+    assert led3.height == 2
+    assert led3.get_state("cc", "b") == b"1"
+    p3.close()
+
+
 def test_segment_size_knob_parsing(monkeypatch):
     monkeypatch.delenv("FABRIC_TPU_STORE_SEGMENT", raising=False)
     assert segment_size(None) == DEFAULT_SEGMENT
