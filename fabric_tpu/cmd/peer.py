@@ -166,9 +166,21 @@ def cmd_channel_join(args) -> int:
 
 
 def cmd_channel_list(args) -> int:
-    raw = RPCClient(
-        *parse_endpoint(args.peer), tls=tls_from_args(args)
-    ).call("admin.Channels")
+    """List channels from a peer (admin.Channels) or, with --orderer,
+    from the orderer's channel-participation API (reference osnadmin
+    channel list / channelparticipation restapi.go)."""
+    if bool(args.peer) == bool(args.orderer):
+        print("channel list requires exactly one of --peer/--orderer",
+              file=sys.stderr)
+        return 2
+    if args.peer:
+        raw = RPCClient(
+            *parse_endpoint(args.peer), tls=tls_from_args(args)
+        ).call("admin.Channels")
+    else:
+        raw = RPCClient(
+            *parse_endpoint(args.orderer), tls=tls_from_args(args)
+        ).call("participation.List")
     resp = peer_cfg.ChannelQueryResponse.FromString(raw)
     for ch in resp.channels:
         print(ch.channel_id)
@@ -189,11 +201,17 @@ def cmd_channel_fetch(args) -> int:
     if not args.peer and not args.orderer:
         print("channel fetch requires --peer or --orderer", file=sys.stderr)
         return 2
+    if args.filtered and not args.peer:
+        print("channel fetch --filtered requires --peer (the filtered "
+              "deliver service is peer-side)", file=sys.stderr)
+        return 2
     signer = _signer(args) if args.msp_dir else None
     pos = args.position
     start = stop = pos if pos in ("newest", "oldest") else int(pos)
     env = make_seek_info_envelope(args.channel, start, stop, signer=signer)
     target = args.peer or args.orderer
+    if args.filtered:
+        return _fetch_filtered(args, env)
     method = "deliver.Deliver" if args.peer else "ab.Deliver"
     blk = None
     for raw in RPCClient(*parse_endpoint(target), tls=tls_from_args(args)).stream(
@@ -208,6 +226,30 @@ def cmd_channel_fetch(args) -> int:
     with open(args.out, "wb") as f:
         f.write(blk.SerializeToString())
     print(f"wrote block {blk.header.number} to {args.out}")
+    return 0
+
+
+def _fetch_filtered(args, env) -> int:
+    """`channel fetch --filtered`: pull through the peer's filtered
+    deliver service (reference peer/deliverevents.go DeliverFiltered) —
+    txids + validation codes, no payloads."""
+    from fabric_tpu.protos.peer import events_pb2
+
+    fblk = None
+    for raw in RPCClient(
+        *parse_endpoint(args.peer), tls=tls_from_args(args)
+    ).stream("deliver.DeliverFiltered", env.SerializeToString()):
+        resp = events_pb2.DeliverResponse.FromString(raw)
+        if resp.WhichOneof("Type") == "filtered_block":
+            fblk = resp.filtered_block
+    if fblk is None:
+        print("no filtered block received", file=sys.stderr)
+        return 1
+    with open(args.out, "wb") as f:
+        f.write(fblk.SerializeToString())
+    for ftx in fblk.filtered_transactions:
+        print(f"{ftx.txid or '-'} {ftx.tx_validation_code}")
+    print(f"wrote filtered block {fblk.number} to {args.out}")
     return 0
 
 
@@ -606,7 +648,8 @@ def main(argv=None) -> int:
     join.add_argument("--peer", required=True)
     join.set_defaults(fn=cmd_channel_join)
     lst = chan.add_parser("list", parents=[tlsp])
-    lst.add_argument("--peer", required=True)
+    lst.add_argument("--peer")
+    lst.add_argument("--orderer")
     lst.set_defaults(fn=cmd_channel_list)
     info = chan.add_parser("getinfo", parents=[tlsp])
     info.add_argument("-c", "--channel", required=True)
@@ -620,6 +663,8 @@ def main(argv=None) -> int:
     fetch.add_argument("--orderer")
     fetch.add_argument("--mspid")
     fetch.add_argument("--msp-dir")
+    fetch.add_argument("--filtered", action="store_true",
+                       help="use the peer's filtered deliver service")
     fetch.set_defaults(fn=cmd_channel_fetch)
 
     snap = sub.add_parser("snapshot").add_subparsers(dest="sub", required=True)

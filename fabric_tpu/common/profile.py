@@ -47,7 +47,7 @@ import sys
 import threading
 
 from fabric_tpu.common import tracing
-from fabric_tpu.devtools import clockskew
+from fabric_tpu.devtools import clockskew, knob_registry
 from fabric_tpu.devtools.lockwatch import spawn_thread
 
 _ENV = "FABRIC_TPU_PROFILE"
@@ -556,8 +556,8 @@ def _init_from_env() -> None:
     """FABRIC_TPU_PROFILE: unset/falsy = disarmed; truthy = armed at
     the default 100 Hz; a number > 1 = that sampling rate in Hz (the
     FABRIC_TPU_TRACE sizing convention)."""
-    raw = os.environ.get(_ENV)
-    if raw is None or raw.strip().lower() in _FALSY:
+    raw = knob_registry.raw(_ENV)
+    if raw.strip().lower() in _FALSY:
         if _profiler is not None:
             disarm()
         return

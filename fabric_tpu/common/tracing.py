@@ -40,7 +40,7 @@ import json
 import os
 import threading
 
-from fabric_tpu.devtools import clockskew
+from fabric_tpu.devtools import clockskew, knob_registry
 
 _ENV = "FABRIC_TPU_TRACE"
 _FALSY = ("", "0", "false", "off", "no")
@@ -662,7 +662,7 @@ def critical_path_ms(events, group_attr: str = "block",
 
 
 def _init_from_env() -> None:
-    raw = os.environ.get(_ENV, "").strip().lower()
+    raw = knob_registry.raw(_ENV).strip().lower()
     if raw in _FALSY:
         return
     try:

@@ -37,7 +37,7 @@ import os
 import threading
 
 from fabric_tpu.common import profile, tracing
-from fabric_tpu.devtools import clockskew
+from fabric_tpu.devtools import clockskew, knob_registry
 
 _FALSY = ("0", "false", "off", "no")
 
@@ -109,7 +109,7 @@ def _auto_width() -> int:
 def stage_width(env: str) -> int:
     """Fan-out width for a stage: its env knob, else auto; 0 = stage
     runs serial (the knob's falsy spellings all map to 0)."""
-    raw = os.environ.get(env, "").strip().lower()
+    raw = knob_registry.raw(env).strip().lower()
     if not raw:
         return _auto_width()
     if raw in _FALSY:

@@ -30,7 +30,7 @@ import numpy as np
 from fabric_tpu.common import tracing
 from fabric_tpu.common.flogging import must_get_logger
 from fabric_tpu.csp import api
-from fabric_tpu.devtools import faultline
+from fabric_tpu.devtools import faultline, knob_registry
 from fabric_tpu.devtools.lockwatch import guarded, named_rlock, spawn_thread
 
 _logger = must_get_logger("csp.tpu")
@@ -207,6 +207,17 @@ def _host_verify_batch(sw: SWCSP, items) -> list[bool]:
     return mask
 
 
+def _knob_int(name: str, default: int) -> int:
+    """A registered int knob's value, `default` when unset or
+    unparsable (the breaker tolerates garbage rather than refusing to
+    start a node over a tuning knob)."""
+    raw = knob_registry.raw(name).strip()
+    try:
+        return int(raw)
+    except ValueError:
+        return default
+
+
 class _Breaker:
     """Degraded-mode circuit breaker over the device path (the chaos
     tentpole's hardening half).  `threshold` CONSECUTIVE device-path
@@ -222,19 +233,13 @@ class _Breaker:
 
     def __init__(self, threshold: int | None = None,
                  probe_every: int | None = None, metrics=None):
-        def env_int(name: str, default: int) -> int:
-            try:
-                return int(os.environ[name])
-            except (KeyError, ValueError):
-                return default
-
         self.threshold = (
             threshold if threshold is not None
-            else env_int("FABRIC_TPU_BREAKER_THRESHOLD", 3)
+            else _knob_int("FABRIC_TPU_BREAKER_THRESHOLD", 3)
         )
         self.probe_every = (
             probe_every if probe_every is not None
-            else env_int("FABRIC_TPU_BREAKER_PROBE_EVERY", 8)
+            else _knob_int("FABRIC_TPU_BREAKER_PROBE_EVERY", 8)
         )
         self._lock = threading.Lock()
         self._consecutive = 0

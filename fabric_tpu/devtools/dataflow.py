@@ -126,6 +126,7 @@ from __future__ import annotations
 
 import ast
 import dataclasses
+import re
 
 # modules allowed to touch hashlib directly — the canonical definition
 # (lint.py imports it from here so the two passes can never disagree)
@@ -241,6 +242,113 @@ _FAULTLINE_IMPL = (
     "fabric_tpu/devtools/clockskew.py",
     "fabric_tpu/common/tracing.py",
 )
+
+# -- v6 surface scans (rpc / knob / metric conformance raw facts) ------------
+
+# RPC method names are `svc.Method` — lowercase service, capitalized
+# method (the reference's gRPC naming).  The regex is the discriminator
+# that keeps unrelated `.register(...)`/`.call(...)` attribute calls
+# (atexit.register, plan.call, ...) out of the map.
+_RPC_METHOD_RE = re.compile(r"^[a-z][A-Za-z0-9]*\.[A-Z][A-Za-z0-9]*$")
+_RPC_VERBS = ("call", "stream", "duplex")
+# verb a client must use per statically inferred handler shape
+_RPC_SHAPE_FOR_VERB = {"call": "unary", "stream": "stream",
+                       "duplex": "duplex"}
+# returned-call attr names that are bytes-producing, not
+# iterator-producing: a handler `return X.SerializeToString()` is
+# unary even though the callee does not resolve statically
+_RPC_BYTES_ATTRS = ("encode", "SerializeToString", "digest", "dumps",
+                    "to_bytes", "pack", "getvalue", "join")
+# component classification for rpcmap sites: exact rels first, then
+# path prefixes, else the file's package segment
+_RPC_COMPONENT_FILES = {
+    "fabric_tpu/node/peer_node.py": "peer",
+    "fabric_tpu/node/orderer_node.py": "orderer",
+    "fabric_tpu/node/devnode.py": "devnode",
+    "fabric_tpu/devtools/netnode.py": "netnode",
+    "fabric_tpu/devtools/netharness.py": "netharness",
+    "fabric_tpu/csp/custody.py": "custody",
+}
+_RPC_COMPONENT_PREFIXES = (
+    ("tests/", "tests"),
+    ("scripts/", "scripts"),
+    ("fabric_tpu/cmd/", "cli"),
+    ("fabric_tpu/gateway/", "gateway"),
+)
+
+_KNOB_PREFIX = "FABRIC_TPU_"
+# the one sanctioned env-read path (devtools/knob_registry.py) and the
+# raw reads every other site must not use
+_KNOB_IMPL = ("fabric_tpu/devtools/knob_registry.py",)
+_KNOB_HELPER_FNS = (
+    "fabric_tpu.devtools.knob_registry.raw",
+    "fabric_tpu.devtools.knob_registry.spec",
+)
+_ENV_READ_FNS = ("os.environ.get", "os.getenv")
+
+_METRIC_OPTS = {
+    "fabric_tpu.common.metrics.CounterOpts": "counter",
+    "fabric_tpu.common.metrics.GaugeOpts": "gauge",
+    "fabric_tpu.common.metrics.HistogramOpts": "histogram",
+}
+_METRIC_NEW_FNS = ("new_counter", "new_gauge", "new_histogram")
+# netscope's rollup/SLO code consumes series by name through string
+# comparisons and `("_derived", name, ...)` ring keys; only there do
+# bare snake_case literals count as metric-name consumption
+_NETSCOPE_REL = "fabric_tpu/devtools/netscope.py"
+_METRIC_NAME_RE = re.compile(r"^[a-z][a-z0-9]*(?:_[a-z0-9]+)+$")
+_HISTOGRAM_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+def _rpc_component(rel: str) -> str:
+    comp = _RPC_COMPONENT_FILES.get(rel)
+    if comp is not None:
+        return comp
+    for prefix, name in _RPC_COMPONENT_PREFIXES:
+        if rel.startswith(prefix):
+            return name
+    parts = rel.split("/")
+    return parts[1] if len(parts) > 2 else parts[-1].rsplit(".", 1)[0]
+
+
+def _literal_strs(expr) -> set:
+    """The string values `expr` can statically take: a literal, or an
+    IfExp whose both branches are literals (cmd/peer.py picks
+    `deliver.Deliver` vs `ab.Deliver` that way)."""
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        return {expr.value}
+    if isinstance(expr, ast.IfExp):
+        a, b = _literal_strs(expr.body), _literal_strs(expr.orelse)
+        if a and b:
+            return a | b
+    return set()
+
+
+def _str_consts(nodes) -> dict:
+    """name -> possible string literal values, from single-target
+    assignments in a scope's own statements (flow-insensitive)."""
+    out: dict[str, set] = {}
+    for n in nodes:
+        if (
+            isinstance(n, ast.Assign)
+            and len(n.targets) == 1
+            and isinstance(n.targets[0], ast.Name)
+        ):
+            vals = _literal_strs(n.value)
+            if vals:
+                out.setdefault(n.targets[0].id, set()).update(vals)
+    return out
+
+
+def _resolve_str_arg(expr, local_consts: dict, mod_consts: dict) -> set:
+    vals = _literal_strs(expr)
+    if vals:
+        return vals
+    if isinstance(expr, ast.Name):
+        return set(
+            local_consts.get(expr.id) or mod_consts.get(expr.id) or ()
+        )
+    return set()
 
 def _own_nodes(root):
     """AST nodes of `root` excluding nested function subtrees — a
@@ -875,6 +983,18 @@ class Project:
         self.faultline_seams: list[dict] = []
         self.faultline_dynamic: list[dict] = []
         self.faultline_plans: list[dict] = []
+        # v6 surface-scan raw facts: the RPC register/call planes, the
+        # FABRIC_TPU env-knob read sites, and the metric producer/
+        # consumer planes (rules 12-14 + the --rpcmap/--knobs/
+        # --metricmap artifacts consume these)
+        self.rpc_registers: list[dict] = []
+        self.rpc_calls: list[dict] = []
+        self.knob_sites: list[dict] = []
+        self.knob_dynamic: list[dict] = []
+        self.metric_producers: list[dict] = []
+        self.metric_derived: list[dict] = []
+        self.metric_consumers: list[dict] = []
+        self.metric_dynamic: list[dict] = []
         for rel, tree in sorted(trees.items()):
             self._load_module(rel, tree)
         self._collect_classes()
@@ -886,6 +1006,9 @@ class Project:
         self._racecheck()
         self._lifecycle()
         self._chaos_scan()
+        self._rpc_scan()
+        self._knob_scan()
+        self._metric_scan()
 
     # -- module loading ----------------------------------------------------
 
@@ -3058,6 +3181,457 @@ class Project:
             "seams": self.faultline_seams,
             "dynamic": self.faultline_dynamic,
             "plans": self.faultline_plans,
+        }
+
+    # -- surface scans (v6): rpc / knob / metric raw facts -----------------
+
+    def _scope_items(self):
+        """(mod, fn|None, cls, params, own nodes) per lexical scope:
+        each module's top level (function bodies excluded — they get
+        their own entries), then every function including closures.
+        The shared walk for the three surface scans."""
+        for mod in sorted(self.modules.values(), key=lambda m: m.rel):
+            yield mod, None, None, [], list(_own_nodes(mod.tree))
+            for fn in mod.functions:
+                yield mod, fn, fn.cls, fn.params, list(_own_nodes(fn.node))
+
+    def _mod_consts(self, mod) -> dict:
+        cached = getattr(self, "_mod_consts_cache", None)
+        if cached is None:
+            cached = self._mod_consts_cache = {}
+        if mod.rel not in cached:
+            cached[mod.rel] = _str_consts(list(_own_nodes(mod.tree)))
+        return cached[mod.rel]
+
+    def _handler_shape(self, qname: str | None) -> str:
+        """The statically inferred wire shape of a registered handler:
+        ``duplex`` (reads its stream param), ``stream`` (yields, or
+        returns a call to a resolvable generator), ``unary`` (returns
+        bytes/None), or ``unknown`` — which never fires a mismatch."""
+        fn = self.symbols.get(qname) if qname else None
+        if fn is None:
+            return "unknown"
+        params = [p for p in fn.params if p != "self"]
+        stream_param = params[1] if len(params) > 1 else None
+        own = list(_own_nodes(fn.node))
+        for n in own:
+            if (
+                isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and n.func.attr == "recv"
+                and isinstance(n.func.value, ast.Name)
+                and n.func.value.id == stream_param
+            ):
+                return "duplex"
+        if any(isinstance(n, (ast.Yield, ast.YieldFrom)) for n in own):
+            return "stream"
+        mod = self.modules[fn.rel]
+        for n in own:
+            if not (isinstance(n, ast.Return)
+                    and isinstance(n.value, ast.Call)):
+                continue
+            ret = n.value
+            q = self._resolve_expr(mod, ret.func, fn.cls, {}, {})
+            callee = self.symbols.get(q) if q else None
+            if callee is not None:
+                if any(
+                    isinstance(x, (ast.Yield, ast.YieldFrom))
+                    for x in _own_nodes(callee.node)
+                ):
+                    return "stream"
+                continue  # resolvable non-generator helper: unary-ish
+            if (
+                isinstance(ret.func, ast.Attribute)
+                and ret.func.attr in _RPC_BYTES_ATTRS
+            ):
+                continue  # bytes-producing call: not iterator evidence
+            return "unknown"
+        return "unary"
+
+    def _rpc_scan(self) -> None:
+        """Every `register("svc.Method", handler)` site and every
+        `call/stream/duplex("svc.Method", ...)` site in the tree —
+        through function-local literal bindings (including IfExp
+        branches) and one-level forwarders (a method passing its own
+        param into a verb call, e.g. custody's `_call`)."""
+        registers: list[dict] = []
+        calls: list[dict] = []
+        # fn qname -> (verb, call-site arg index of the method name)
+        forwarders: dict[str, tuple] = {}
+        for mod, fn, cls, params, nodes in self._scope_items():
+            mconsts = self._mod_consts(mod)
+            local = _str_consts(nodes) if fn is not None else {}
+            comp = _rpc_component(mod.rel)
+            for n in nodes:
+                if not (
+                    isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Attribute)
+                    and n.args
+                ):
+                    continue
+                attr = n.func.attr
+                if attr == "register":
+                    methods = [
+                        m for m in sorted(
+                            _resolve_str_arg(n.args[0], local, mconsts)
+                        )
+                        if _RPC_METHOD_RE.match(m)
+                    ]
+                    handler_q = (
+                        self._resolve_expr(
+                            mod, n.args[1], cls, {}, {}
+                        ) if len(n.args) > 1 else None
+                    )
+                    for m in methods:
+                        registers.append({
+                            "method": m, "component": comp,
+                            "module": mod.rel, "line": n.lineno,
+                            "handler": handler_q,
+                            "shape": self._handler_shape(handler_q),
+                        })
+                elif attr in _RPC_VERBS:
+                    methods = [
+                        m for m in sorted(
+                            _resolve_str_arg(n.args[0], local, mconsts)
+                        )
+                        if _RPC_METHOD_RE.match(m)
+                    ]
+                    for m in methods:
+                        calls.append({
+                            "method": m, "verb": attr,
+                            "component": comp,
+                            "module": mod.rel, "line": n.lineno,
+                        })
+                    if (
+                        not methods
+                        and isinstance(n.args[0], ast.Name)
+                        and fn is not None
+                        and n.args[0].id in params
+                    ):
+                        idx = params.index(n.args[0].id)
+                        if cls is not None and params[:1] == ["self"]:
+                            idx -= 1
+                        forwarders[fn.qname] = (attr, idx)
+        # second pass: literal call sites of the forwarders count as
+        # RPC sites with the forwarded verb
+        for mod, fn, cls, params, nodes in self._scope_items():
+            mconsts = self._mod_consts(mod)
+            local = _str_consts(nodes) if fn is not None else {}
+            comp = _rpc_component(mod.rel)
+            for n in nodes:
+                if not (isinstance(n, ast.Call) and n.args):
+                    continue
+                q = self._resolve_expr(mod, n.func, cls, {}, {})
+                fwd = forwarders.get(q) if q else None
+                if fwd is None:
+                    continue
+                verb, idx = fwd
+                if idx >= len(n.args):
+                    continue
+                for m in sorted(
+                    _resolve_str_arg(n.args[idx], local, mconsts)
+                ):
+                    if _RPC_METHOD_RE.match(m):
+                        calls.append({
+                            "method": m, "verb": verb,
+                            "component": comp,
+                            "module": mod.rel, "line": n.lineno,
+                        })
+        registers.sort(
+            key=lambda r: (r["method"], r["module"], r["line"])
+        )
+        calls.sort(key=lambda c: (c["method"], c["module"], c["line"]))
+        self.rpc_registers = registers
+        self.rpc_calls = calls
+
+    def _knob_scan(self) -> None:
+        """Every FABRIC_TPU env read: through the knob registry
+        (``via: registry``), or raw (``via: environ`` — a bypass the
+        knob-conformance rule fails).  Names resolve through literals,
+        module/function string constants (the ``_ENV = "..."``
+        convention), and one-level forwarders passing a param into
+        ``knob_registry.raw`` (workpool's ``stage_width``)."""
+        sites: list[dict] = []
+        dynamic: list[dict] = []
+        forwarders: dict[str, int] = {}
+        for mod, fn, cls, params, nodes in self._scope_items():
+            if mod.rel in _KNOB_IMPL:
+                continue  # the registry's own environ read is the seam
+            mconsts = self._mod_consts(mod)
+            local = _str_consts(nodes) if fn is not None else {}
+            for n in nodes:
+                via = arg = None
+                if isinstance(n, ast.Call) and n.args:
+                    q = self._resolve_expr(mod, n.func, cls, {}, {})
+                    if q in _ENV_READ_FNS:
+                        via, arg = "environ", n.args[0]
+                    elif q in _KNOB_HELPER_FNS:
+                        via, arg = "registry", n.args[0]
+                elif (
+                    isinstance(n, ast.Subscript)
+                    and isinstance(n.ctx, ast.Load)
+                ):
+                    base = self._resolve_expr(mod, n.value, cls, {}, {})
+                    if base == "os.environ":
+                        via, arg = "environ", n.slice
+                if via is None:
+                    continue
+                names = _resolve_str_arg(arg, local, mconsts)
+                if names:
+                    for name in sorted(names):
+                        if name.startswith(_KNOB_PREFIX):
+                            sites.append({
+                                "name": name, "via": via,
+                                "module": mod.rel, "line": n.lineno,
+                            })
+                elif via == "registry":
+                    if (
+                        isinstance(arg, ast.Name)
+                        and fn is not None
+                        and arg.id in params
+                    ):
+                        idx = params.index(arg.id)
+                        if cls is not None and params[:1] == ["self"]:
+                            idx -= 1
+                        forwarders[fn.qname] = idx
+                    else:
+                        dynamic.append({
+                            "module": mod.rel, "line": n.lineno,
+                        })
+        for mod, fn, cls, params, nodes in self._scope_items():
+            mconsts = self._mod_consts(mod)
+            local = _str_consts(nodes) if fn is not None else {}
+            for n in nodes:
+                if not (isinstance(n, ast.Call) and n.args):
+                    continue
+                q = self._resolve_expr(mod, n.func, cls, {}, {})
+                idx = forwarders.get(q) if q else None
+                if idx is None or idx >= len(n.args):
+                    continue
+                for name in sorted(
+                    _resolve_str_arg(n.args[idx], local, mconsts)
+                ):
+                    if name.startswith(_KNOB_PREFIX):
+                        sites.append({
+                            "name": name, "via": "registry",
+                            "module": mod.rel, "line": n.lineno,
+                        })
+        sites.sort(key=lambda s: (s["name"], s["module"], s["line"]))
+        dynamic.sort(key=lambda d: (d["module"], d["line"]))
+        self.knob_sites = sites
+        self.knob_dynamic = dynamic
+
+    def _metric_scan(self) -> None:
+        """Metric producers (Counter/Gauge/HistogramOpts constructions
+        in production code, with whether each is registered through a
+        provider ``new_*`` call and which class/function owns it),
+        netscope's derived series, and every consumer site — literal
+        names passed to ``.series(...)`` anywhere, plus rollup-code
+        string comparisons and ``*_series`` parameter defaults inside
+        netscope itself."""
+        producers: list[dict] = []
+        derived: list[dict] = []
+        consumers: list[dict] = []
+        dynamic: list[dict] = []
+        opts_sites: list[tuple] = []  # (mod, node, kind, owner)
+        wrapped: set = set()  # id() of Opts calls passed to new_*
+        for mod, fn, cls, params, nodes in self._scope_items():
+            production = mod.rel.startswith("fabric_tpu/")
+            owner = None
+            if cls is not None:
+                owner = f"{mod.dotted}.{cls}"
+            elif fn is not None:
+                owner = fn.qname
+            for n in nodes:
+                if isinstance(n, ast.Call):
+                    if (
+                        isinstance(n.func, ast.Attribute)
+                        and n.func.attr in _METRIC_NEW_FNS
+                    ):
+                        for a in list(n.args) + [
+                            kw.value for kw in n.keywords
+                        ]:
+                            if isinstance(a, ast.Call):
+                                wrapped.add(id(a))
+                    kind = self._opts_kind(mod, n)
+                    if kind is not None and production:
+                        opts_sites.append((mod, n, kind, owner))
+                    if (
+                        isinstance(n.func, ast.Attribute)
+                        and n.func.attr == "series"
+                        and len(n.args) >= 2
+                    ):
+                        for name in sorted(_literal_strs(n.args[1])):
+                            consumers.append({
+                                "name": name, "context": "series",
+                                "module": mod.rel, "line": n.lineno,
+                            })
+                elif mod.rel == _NETSCOPE_REL and isinstance(
+                    n, ast.Tuple
+                ):
+                    if (
+                        len(n.elts) >= 2
+                        and isinstance(n.elts[0], ast.Constant)
+                        and n.elts[0].value == "_derived"
+                        and isinstance(n.elts[1], ast.Constant)
+                        and isinstance(n.elts[1].value, str)
+                    ):
+                        derived.append({
+                            "name": n.elts[1].value,
+                            "module": mod.rel, "line": n.lineno,
+                        })
+                elif mod.rel == _NETSCOPE_REL and isinstance(
+                    n, ast.Compare
+                ):
+                    if not all(
+                        isinstance(op, (ast.Eq, ast.NotEq))
+                        for op in n.ops
+                    ):
+                        continue
+                    for side in [n.left] + list(n.comparators):
+                        if (
+                            isinstance(side, ast.Constant)
+                            and isinstance(side.value, str)
+                            and _METRIC_NAME_RE.match(side.value)
+                        ):
+                            consumers.append({
+                                "name": side.value,
+                                "context": "rollup",
+                                "module": mod.rel, "line": n.lineno,
+                            })
+            if fn is not None and mod.rel == _NETSCOPE_REL:
+                # `height_series: str = "ledger_height"`-style defaults
+                a = fn.node.args
+                pos = a.posonlyargs + a.args
+                for p, d in zip(pos[len(pos) - len(a.defaults):],
+                                a.defaults):
+                    if (
+                        p.arg.endswith("_series")
+                        and isinstance(d, ast.Constant)
+                        and isinstance(d.value, str)
+                    ):
+                        consumers.append({
+                            "name": d.value, "context": "default",
+                            "module": mod.rel, "line": fn.lineno,
+                        })
+        for mod, n, kind, owner in opts_sites:
+            kwargs = {
+                kw.arg: kw.value for kw in n.keywords
+                if kw.arg is not None
+            }
+            parts = []
+            literal = True
+            for key in ("namespace", "subsystem", "name"):
+                v = kwargs.get(key)
+                if v is None:
+                    continue
+                if isinstance(v, ast.Constant) and isinstance(
+                    v.value, str
+                ):
+                    if v.value:
+                        parts.append(v.value)
+                else:
+                    literal = False
+            if not literal or "name" not in kwargs:
+                dynamic.append({
+                    "kind": kind, "module": mod.rel, "line": n.lineno,
+                })
+                continue
+            producers.append({
+                "name": "_".join(parts), "kind": kind,
+                "module": mod.rel, "line": n.lineno,
+                "registered": id(n) in wrapped, "owner": owner,
+            })
+        # owner reachability: an Opts-owning class/function nothing in
+        # PRODUCTION instantiates/calls is dead instrumentation — its
+        # metrics can never appear on a real node's scrape (orphan
+        # producers; a test-only reference does not count)
+        owners = {p["owner"] for p in producers if p["owner"]}
+        referenced: set = set()
+        if owners:
+            for mod in self.modules.values():
+                if not mod.rel.startswith("fabric_tpu/"):
+                    continue
+                for n in ast.walk(mod.tree):
+                    if isinstance(n, ast.Call):
+                        q = self._resolve_expr(mod, n.func, None, {}, {})
+                        if q in owners:
+                            referenced.add(q)
+        for p in producers:
+            p["reachable"] = p["owner"] is None or p["owner"] in referenced
+        producers.sort(
+            key=lambda p: (p["name"], p["module"], p["line"])
+        )
+        derived.sort(key=lambda d: (d["name"], d["module"], d["line"]))
+        consumers.sort(
+            key=lambda c: (c["name"], c["module"], c["line"],
+                           c["context"])
+        )
+        dynamic.sort(key=lambda d: (d["module"], d["line"]))
+        self.metric_producers = producers
+        self.metric_derived = derived
+        self.metric_consumers = consumers
+        self.metric_dynamic = dynamic
+
+    def _opts_kind(self, mod, call: ast.Call) -> str | None:
+        """counter/gauge/histogram when `call` constructs a metric
+        Opts (by import or same-module class reference); else None."""
+        q = self._resolve_expr(mod, call.func, None, {}, {})
+        if q is None:
+            dotted = _dotted(call.func)
+            if dotted is not None:
+                cand = f"{mod.dotted}.{dotted}"
+                if cand in _METRIC_OPTS:
+                    q = cand
+        return _METRIC_OPTS.get(q) if q else None
+
+    def rpcmap(self) -> dict:
+        """The JSON-shaped RPC-conformance artifact (``--rpcmap-out``):
+        every method with its register and call sites, both in
+        deterministic order."""
+        methods: dict[str, dict] = {}
+        for r in self.rpc_registers:
+            m = methods.setdefault(
+                r["method"], {"registers": [], "calls": []}
+            )
+            m["registers"].append({
+                k: r[k] for k in
+                ("component", "module", "line", "handler", "shape")
+            })
+        for c in self.rpc_calls:
+            m = methods.setdefault(
+                c["method"], {"registers": [], "calls": []}
+            )
+            m["calls"].append({
+                k: c[k] for k in ("component", "module", "line", "verb")
+            })
+        return {"methods": {k: methods[k] for k in sorted(methods)}}
+
+    def knob_map(self) -> dict:
+        """The read-site half of the ``--knobs-out`` artifact (lint.py
+        joins it with the registry entries)."""
+        return {"reads": self.knob_sites, "dynamic": self.knob_dynamic}
+
+    def metricmap(self) -> dict:
+        """The JSON-shaped metrics-conformance artifact
+        (``--metricmap-out``).  ``exposed`` is every series name a
+        scrape can legally produce: registered producers (histograms
+        expanded to their ``_bucket``/``_sum``/``_count`` series) plus
+        netscope's derived series."""
+        exposed: set = set()
+        for p in self.metric_producers:
+            exposed.add(p["name"])
+            if p["kind"] == "histogram":
+                for suf in _HISTOGRAM_SUFFIXES:
+                    exposed.add(p["name"] + suf)
+        for d in self.metric_derived:
+            exposed.add(d["name"])
+        return {
+            "producers": self.metric_producers,
+            "derived": self.metric_derived,
+            "consumers": self.metric_consumers,
+            "dynamic": self.metric_dynamic,
+            "exposed": sorted(exposed),
         }
 
     # -- public API --------------------------------------------------------

@@ -464,6 +464,63 @@ def run_plan(plan: dict, workdir: str, blocks: int = DEFAULT_BLOCKS,
 # -- plan generation ----------------------------------------------------------
 
 
+def _action_pool(name: str, kinds) -> list[str]:
+    """The fault-action pool matched to a point's kind (no crash on rpc
+    points — a dead handler thread is noise, not signal; torn only at
+    write points, partial only at io points, skip only at guard
+    points).  Shared by generate_plan and mutate_plan so a mutant's
+    swapped action is always one the generator itself could draw."""
+    if "io" in kinds:
+        return ["raise", "delay", "partial"]
+    if "write" in kinds:
+        return ["torn", "raise", "crash", "delay"]
+    if "guard" in kinds:
+        return ["skip", "raise", "delay"]
+    if name.startswith("rpc."):
+        return ["raise", "delay"]
+    # no "skew" here: the campaign workload runs on the system clock,
+    # where a skew rule is a recorded no-op — generating one would
+    # waste a fuzz slot (skew plans are exercised under
+    # clockskew.use_virtual in tests/test_clockskew.py)
+    return ["raise", "crash", "delay"]
+
+
+_TRIGGER_KEYS = ("nth", "every", "prob", "count")
+_ACTION_PARAM_KEYS = ("error", "delay_s", "cut")
+
+
+def _set_action(f: dict, action: str, rng: random.Random) -> None:
+    """Install `action` (and its freshly sampled parameters) on a fault
+    rule, dropping any previous action's parameters."""
+    for k in _ACTION_PARAM_KEYS:
+        f.pop(k, None)
+    f["action"] = action
+    if action == "raise":
+        f["error"] = rng.choice(_RAISE_ERRORS)
+    elif action == "delay":
+        f["delay_s"] = rng.choice([0.0, 0.001, 0.003])
+    elif action == "torn":
+        f["cut"] = round(rng.uniform(0.1, 0.9), 2)
+
+
+def _set_trigger(f: dict, rng: random.Random) -> None:
+    """Sample a fresh trigger (nth/every/prob/always with bounded
+    counts) onto a fault rule, dropping the previous trigger keys."""
+    for k in _TRIGGER_KEYS:
+        f.pop(k, None)
+    trig = rng.choice(["nth", "every", "prob", "always"])
+    if trig == "nth":
+        f["nth"] = rng.randint(1, 6)
+    elif trig == "every":
+        f["every"] = rng.randint(2, 4)
+        f["count"] = rng.randint(1, 4)
+    elif trig == "prob":
+        f["prob"] = round(rng.uniform(0.05, 0.4), 3)
+        f["count"] = rng.randint(1, 4)
+    else:
+        f["count"] = rng.randint(1, 3)
+
+
 def generate_plan(rng: random.Random, registry: dict, label: str,
                   tripped=frozenset()) -> dict:
     """Sample one plan from the discovered fault-point registry: 1-3
@@ -487,40 +544,11 @@ def generate_plan(rng: random.Random, registry: dict, label: str,
         cold = [p for p in points if p not in tripped]
         name = rng.choice(cold or points)
         ent = registry[name]
-        kinds = ent.get("kinds", [])
-        if "io" in kinds:
-            actions = ["raise", "delay", "partial"]
-        elif "write" in kinds:
-            actions = ["torn", "raise", "crash", "delay"]
-        elif "guard" in kinds:
-            actions = ["skip", "raise", "delay"]
-        elif name.startswith("rpc."):
-            actions = ["raise", "delay"]
-        else:
-            # no "skew" here: the campaign workload runs on the system
-            # clock, where a skew rule is a recorded no-op — generating
-            # one would waste a fuzz slot (skew plans are exercised
-            # under clockskew.use_virtual in tests/test_clockskew.py)
-            actions = ["raise", "crash", "delay"]
-        action = rng.choice(actions)
-        f: dict = {"point": name, "action": action}
-        if action == "raise":
-            f["error"] = rng.choice(_RAISE_ERRORS)
-        elif action == "delay":
-            f["delay_s"] = rng.choice([0.0, 0.001, 0.003])
-        elif action == "torn":
-            f["cut"] = round(rng.uniform(0.1, 0.9), 2)
-        trig = rng.choice(["nth", "every", "prob", "always"])
-        if trig == "nth":
-            f["nth"] = rng.randint(1, 6)
-        elif trig == "every":
-            f["every"] = rng.randint(2, 4)
-            f["count"] = rng.randint(1, 4)
-        elif trig == "prob":
-            f["prob"] = round(rng.uniform(0.05, 0.4), 3)
-            f["count"] = rng.randint(1, 4)
-        else:
-            f["count"] = rng.randint(1, 3)
+        f: dict = {"point": name}
+        _set_action(
+            f, rng.choice(_action_pool(name, ent.get("kinds", []))), rng
+        )
+        _set_trigger(f, rng)
         ctx = ent.get("ctx") or {}
         if ctx and rng.random() < 0.5:
             k = rng.choice(sorted(ctx))
@@ -536,6 +564,41 @@ def generate_plan(rng: random.Random, registry: dict, label: str,
         "register": False,
         "faults": faults,
     }
+
+
+def mutate_plan(rng: random.Random, plan: dict, registry: dict,
+                label: str) -> dict:
+    """One seeded single-edit mutant of a failing plan: tweak one
+    rule's trigger, swap one rule's action within its point's pool, or
+    drop one rule.  Everything else — the plan seed included — carries
+    over verbatim, so a mutant isolates exactly one variable against
+    its parent: does the failure need THIS trigger cadence, THIS
+    action, THIS rule?  Mutants ride the same run/judge/shrink/repro
+    path as generated plans, and the same (campaign seed, plan index,
+    mutant index) always derives the same mutant."""
+    mut = copy.deepcopy(plan)
+    mut["label"] = label
+    faults = mut["faults"]
+    edits = ["trigger", "action"] + (["drop"] if len(faults) > 1 else [])
+    edit = rng.choice(edits)
+    i = rng.randrange(len(faults))
+    if edit == "drop":
+        del faults[i]
+        return mut
+    f = faults[i]
+    if edit == "action":
+        kinds = (registry.get(f["point"]) or {}).get("kinds", [])
+        pool = [
+            a for a in _action_pool(f["point"], kinds)
+            if a != f["action"]
+        ]
+        if pool:
+            _set_action(f, rng.choice(pool), rng)
+            return mut
+        # single-action pool: fall through to a trigger tweak so the
+        # edit never silently degenerates into a no-op
+    _set_trigger(f, rng)
+    return mut
 
 
 # -- shrinking ----------------------------------------------------------------
@@ -655,7 +718,7 @@ class Campaign:
                  workdir: str | None = None, out_dir: str = ".faultfuzz",
                  blocks: int = DEFAULT_BLOCKS, shrink: bool = True,
                  comm: bool = True, trace_dir: str | None = None,
-                 profile_dir: str | None = None):
+                 profile_dir: str | None = None, mutants: int = 0):
         self.seed = int(seed)
         self.plans = int(plans)
         self.workdir = workdir
@@ -663,6 +726,10 @@ class Campaign:
         self.blocks = blocks
         self.shrink = shrink
         self.comm = comm
+        # single-edit mutants derived from each FAILING plan (0 = off,
+        # the v5-compatible default): does the failure survive a
+        # trigger tweak, an action swap, a dropped rule?
+        self.mutants = int(mutants)
         # where failing plans' flight-recorder dumps land (next to the
         # repro JSON by default); only written while tracelens is armed
         self.trace_dir = trace_dir
@@ -774,12 +841,77 @@ class Campaign:
                         res["profile"],
                     )
                     profile_paths.append(entry["profile"])
+            if res["violations"] and self.mutants:
+                # single-edit mutants of the failing plan, each fully
+                # seed-derived from (campaign seed, plan index, mutant
+                # index) and riding the same judge/shrink/repro path
+                mutant_entries = []
+                for j in range(self.mutants):
+                    mrng = random.Random(f"{self.seed}:{i}:m{j}")
+                    mplan = mutate_plan(
+                        mrng, plan, registry, f"{label}:m{j}"
+                    )
+                    mres = run_plan(
+                        mplan, os.path.join(root, f"plan{i:03d}_m{j}"),
+                        blocks=self.blocks, comm=self.comm,
+                    )
+                    mentry: dict = {
+                        "index": j,
+                        "plan": mplan,
+                        "verdict":
+                            "fail" if mres["violations"] else "pass",
+                        "violations": mres["violations"],
+                        "trips": mres["trips"],
+                    }
+                    if mres["violations"]:
+                        mshrunk = mplan
+                        if self.shrink:
+                            mroot = os.path.join(
+                                root, f"shrink{i:03d}_m{j}"
+                            )
+                            mcounter = [0]
+
+                            def m_still_fails(cand, _mr=mroot,
+                                              _mc=mcounter):
+                                _mc[0] += 1
+                                sub = os.path.join(
+                                    _mr, f"s{_mc[0]:03d}"
+                                )
+                                return bool(run_plan(
+                                    cand, sub, blocks=self.blocks,
+                                    comm=self.comm,
+                                )["violations"])
+
+                            mshrunk, mentry["shrink_runs"] = \
+                                shrink_plan(mplan, m_still_fails)
+                        mpath = write_repro(
+                            os.path.join(
+                                self.out_dir,
+                                f"repro_seed{self.seed}"
+                                f"_plan{i:03d}_m{j}.json",
+                            ),
+                            mshrunk, mplan, mres["violations"],
+                            mres["trips"], self.seed, i, self.blocks,
+                        )
+                        mentry["shrunk"] = mshrunk
+                        mentry["repro"] = mpath
+                        repro_paths.append(mpath)
+                    mutant_entries.append(mentry)
+                    ledger.extend(mres["trips"])
+                    tripped.update(
+                        t["point"] for t in mres["trips"]
+                    )
+                entry["mutants"] = mutant_entries
             results.append(entry)
             ledger.extend(res["trips"])
             # feed the coverage weighting: the NEXT plan prefers points
             # this campaign has not yet tripped
             tripped.update(t["point"] for t in res["trips"])
         failures = sum(1 for e in results if e["verdict"] == "fail")
+        mutant_failures = sum(
+            1 for e in results for m in e.get("mutants", ())
+            if m["verdict"] == "fail"
+        )
         return {
             "experiment": "faultfuzz",
             "seed": self.seed,
@@ -788,6 +920,8 @@ class Campaign:
             "registry_points": len(registry),
             "verdicts": [e["verdict"] for e in results],
             "failures": failures,
+            "mutants_per_failure": self.mutants,
+            "mutant_failures": mutant_failures,
             "trips_total": len(ledger),
             "trip_ledger": ledger,
             "repro": repro_paths,
@@ -855,6 +989,7 @@ __all__ = [
     "workload_writes",
     "run_plan",
     "generate_plan",
+    "mutate_plan",
     "shrink_plan",
     "write_repro",
     "write_trace_doc",

@@ -84,7 +84,7 @@ import os
 import random
 import threading
 
-from fabric_tpu.devtools import clockskew
+from fabric_tpu.devtools import clockskew, knob_registry
 
 _ENV = "FABRIC_TPU_FAULTLINE"
 _SOAK_ENV = "FABRIC_TPU_SOAK"
@@ -738,14 +738,14 @@ def session_env_plan() -> Plan | None:
 
 def _init_from_env() -> None:
     global _env_plan
-    raw = os.environ.get(_ENV, "")
+    raw = knob_registry.raw(_ENV)
     if raw and raw not in ("0", "false", "off"):
         if raw.startswith("@"):
             with open(raw[1:], "r", encoding="utf-8") as f:
                 raw = f.read()
         _env_plan = activate(raw)
         return
-    soak = os.environ.get(_SOAK_ENV, "")
+    soak = knob_registry.raw(_SOAK_ENV)
     if soak and soak not in ("0", "false", "off"):
         try:
             seed = int(soak)

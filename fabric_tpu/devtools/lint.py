@@ -203,6 +203,9 @@ RULES = (
     "thread-hygiene",
     "jax-hygiene",
     "chaos-coverage",
+    "rpc-conformance",
+    "knob-conformance",
+    "metrics-conformance",
 )
 
 # meta rules: problems with the suppression machinery itself; never
@@ -379,6 +382,264 @@ def _chaos_coverage(
     return out
 
 
+# -- surface conformance (v6) ------------------------------------------------
+
+# the response shape each client verb commits to; a register site whose
+# handler provably has a different shape can never satisfy the call
+RPC_VERB_SHAPES = {"call": "unary", "stream": "stream", "duplex": "duplex"}
+
+KNOB_REGISTRY_REL = "fabric_tpu/devtools/knob_registry.py"
+KNOB_TABLE_BEGIN = "<!-- knob-table:begin -->"
+KNOB_TABLE_END = "<!-- knob-table:end -->"
+
+
+def _rpc_conformance(project: "dataflow.Project") -> list["Violation"]:
+    """Cross-check the RPC register plane against the call plane: every
+    statically-resolvable call site must hit a registered method, every
+    registered handler must have at least one caller (tests count — a
+    harness driving a handler IS its consumer), and a call verb must be
+    satisfiable by at least one register site's inferred handler shape."""
+    methods: dict[str, dict] = {}
+    for r in project.rpc_registers:
+        m = methods.setdefault(r["method"], {"regs": [], "calls": []})
+        m["regs"].append(r)
+    for c in project.rpc_calls:
+        m = methods.setdefault(c["method"], {"regs": [], "calls": []})
+        m["calls"].append(c)
+    out: list[Violation] = []
+    for name in sorted(methods):
+        regs, calls = methods[name]["regs"], methods[name]["calls"]
+        if calls and not regs:
+            for c in calls:
+                out.append(Violation(
+                    rule="rpc-conformance", path=c["module"],
+                    line=c["line"],
+                    message=(
+                        f"RPC {c['verb']} site targets {name!r} but no "
+                        "component registers that method — the call can "
+                        "only ever raise method-not-found; fix the name "
+                        "or register the handler"
+                    ),
+                ))
+        if regs and not calls:
+            for r in regs:
+                out.append(Violation(
+                    rule="rpc-conformance", path=r["module"],
+                    line=r["line"],
+                    message=(
+                        f"RPC handler {name!r} ({r['component']}) has "
+                        "no caller anywhere in the tree — dead service "
+                        "surface; add a consumer (CLI subcommand, "
+                        "harness probe, or test) or delete the handler"
+                    ),
+                ))
+        shapes = {r["shape"] for r in regs} - {"unknown"}
+        if not shapes:
+            continue
+        for c in calls:
+            want = RPC_VERB_SHAPES[c["verb"]]
+            if want not in shapes:
+                out.append(Violation(
+                    rule="rpc-conformance", path=c["module"],
+                    line=c["line"],
+                    message=(
+                        f"client {c['verb']}s {name!r} (a {want} "
+                        "exchange) but every register site's handler "
+                        f"is {'/'.join(sorted(shapes))}-shaped — the "
+                        "framing can never line up; match the client "
+                        "verb to the handler shape"
+                    ),
+                ))
+    return out
+
+
+def _knob_conformance(
+    project: "dataflow.Project",
+    sources: dict[str, str],
+    readme_text: str | None,
+) -> list["Violation"]:
+    """Close the env-knob loop: every ``FABRIC_TPU_*`` read resolves to
+    a knob_registry entry AND routes through the registry helper; every
+    entry has a read site; the README table between the ``knob-table``
+    markers is byte-identical to ``render_table()``.  The dead-entry and
+    README checks only run when the registry module itself is in the
+    linted set (partial runs can't see the whole read plane)."""
+    from fabric_tpu.devtools import knob_registry
+
+    out: list[Violation] = []
+    for d in project.knob_dynamic:
+        out.append(Violation(
+            rule="knob-conformance", path=d["module"], line=d["line"],
+            message=(
+                "knob name is not a string literal — the read cannot "
+                "be checked against the registry or enumerated into "
+                "the --knobs artifact; use a literal FABRIC_TPU_* name"
+            ),
+        ))
+    for s in project.knob_sites:
+        if s["name"] not in knob_registry.KNOBS:
+            out.append(Violation(
+                rule="knob-conformance", path=s["module"],
+                line=s["line"],
+                message=(
+                    f"env read of unregistered knob {s['name']!r} — "
+                    "every FABRIC_TPU_* knob ships with a reviewed "
+                    "entry (name/type/default/subsystem/doc) in "
+                    "devtools/knob_registry.py; register it"
+                ),
+            ))
+        elif s["via"] == "environ":
+            out.append(Violation(
+                rule="knob-conformance", path=s["module"],
+                line=s["line"],
+                message=(
+                    f"{s['name']} read bypasses knob_registry.raw() — "
+                    "direct os.environ reads skip the registration "
+                    "check that keeps the knob table honest; route "
+                    "the read through the registry helper"
+                ),
+            ))
+    if KNOB_REGISTRY_REL not in sources:
+        return out
+    reg_lines = sources[KNOB_REGISTRY_REL].splitlines()
+
+    def _entry_line(name: str) -> int:
+        needle = f'"{name}"'
+        for i, ln in enumerate(reg_lines):
+            if needle in ln:
+                return i + 1
+        return 0
+
+    read_names = {s["name"] for s in project.knob_sites}
+    for name in sorted(set(knob_registry.KNOBS) - read_names):
+        out.append(Violation(
+            rule="knob-conformance", path=KNOB_REGISTRY_REL,
+            line=_entry_line(name),
+            message=(
+                f"registry entry {name!r} has no read site anywhere "
+                "in the tree — the knob is dead (its reader was "
+                "removed or renamed); delete the entry or fix the "
+                "reader"
+            ),
+        ))
+    if readme_text is not None:
+        i = readme_text.find(KNOB_TABLE_BEGIN)
+        j = readme_text.find(KNOB_TABLE_END)
+        if i < 0 or j < i:
+            out.append(Violation(
+                rule="knob-conformance", path=KNOB_REGISTRY_REL,
+                line=0,
+                message=(
+                    "README.md has no knob-table marker block "
+                    f"({KNOB_TABLE_BEGIN} … {KNOB_TABLE_END}) — the "
+                    "generated env-knob table is part of the "
+                    "registry's contract; add the block"
+                ),
+            ))
+        else:
+            block = readme_text[i + len(KNOB_TABLE_BEGIN):j]
+            if block != "\n" + knob_registry.render_table():
+                out.append(Violation(
+                    rule="knob-conformance", path=KNOB_REGISTRY_REL,
+                    line=0,
+                    message=(
+                        "README.md knob table has drifted from "
+                        "knob_registry.render_table() — regenerate the "
+                        "block between the knob-table markers "
+                        "(python -c 'from fabric_tpu.devtools import "
+                        "knob_registry; print(knob_registry."
+                        "render_table(), end=\"\")')"
+                    ),
+                ))
+    return out
+
+
+def _metrics_conformance(project: "dataflow.Project") -> list["Violation"]:
+    """Cross-check the metric producer plane against its consumers:
+    every Counter/Gauge/Histogram Opts lands in a provider ``new_*``
+    call (else the series silently never exists), every series name a
+    rollup/SLO/bench consumes is one a scrape can expose, and every
+    producer is constructed on some production path (orphan producers
+    are advisory — instrumentation wired ahead of its consumer)."""
+    out: list[Violation] = []
+    for d in project.metric_dynamic:
+        out.append(Violation(
+            rule="metrics-conformance", path=d["module"], line=d["line"],
+            message=(
+                "metric name is not resolvable to a string literal — "
+                "the series cannot be checked against its consumers "
+                "or enumerated into the --metricmap artifact; use "
+                "literal namespace/subsystem/name parts"
+            ),
+        ))
+    exposed: set = set()
+    for p in project.metric_producers:
+        if not p["registered"]:
+            out.append(Violation(
+                rule="metrics-conformance", path=p["module"],
+                line=p["line"],
+                message=(
+                    f"{p['kind']} Opts for {p['name']!r} never reaches "
+                    "a provider new_* call — the series is configured "
+                    "but never constructed, so no scrape will ever "
+                    "carry it; register it with a provider"
+                ),
+            ))
+        exposed.add(p["name"])
+        if p["kind"] == "histogram":
+            for suf in dataflow._HISTOGRAM_SUFFIXES:
+                exposed.add(p["name"] + suf)
+    for d in project.metric_derived:
+        exposed.add(d["name"])
+    for c in project.metric_consumers:
+        if c["name"] not in exposed:
+            out.append(Violation(
+                rule="metrics-conformance", path=c["module"],
+                line=c["line"],
+                message=(
+                    f"consumer reads series {c['name']!r} "
+                    f"({c['context']}) but no producer or derived "
+                    "series carries that name — the rollup/threshold "
+                    "can only ever see an absent series; fix the name "
+                    "or add the producer"
+                ),
+            ))
+    for p in project.metric_producers:
+        if p["registered"] and not p["reachable"]:
+            out.append(Violation(
+                rule="metrics-conformance", path=p["module"],
+                line=p["line"], severity="warning",
+                message=(
+                    f"producer {p['name']!r} is only constructed from "
+                    f"{p['owner']} which no production path "
+                    "instantiates — the series exists in code but no "
+                    "deployed process exposes it; wire the owner into "
+                    "a node/harness path (advisory)"
+                ),
+            ))
+    return out
+
+
+def build_knob_artifact(knob_map: dict) -> dict:
+    """The ``--knobs-out`` artifact: the reviewed registry joined with
+    the statically-enumerated read plane."""
+    from fabric_tpu.devtools import knob_registry
+
+    registry = {
+        name: {
+            "kind": k.kind, "default": k.default,
+            "subsystem": k.subsystem, "doc": k.doc,
+            "choices": list(k.choices),
+        }
+        for name, k in sorted(knob_registry.KNOBS.items())
+    }
+    return {
+        "registry": registry,
+        "reads": knob_map["reads"],
+        "dynamic": knob_map["dynamic"],
+    }
+
+
 # -- profiles ----------------------------------------------------------------
 
 
@@ -398,8 +659,13 @@ RELAXED_PROFILE = Profile(
     # and inversions, and test helpers manage thread lifecycles
     # dynamically (start/join inline) in shapes the static rule need
     # not model
+    # the v6 surface-conformance rules are whole-program checks over
+    # the PRODUCTION surface: their violations anchor at production
+    # sites (tests count as consumers/callers, never as the surface),
+    # so test/script files carry none of their own
     disabled=("determinism", "taint", "jax-hygiene", "racecheck",
-              "lock-order", "thread-lifecycle"),
+              "lock-order", "thread-lifecycle", "rpc-conformance",
+              "knob-conformance", "metrics-conformance"),
     advisory=("csp-seam",),
 )
 
@@ -1238,6 +1504,7 @@ def lint_sources(
     allowlist: list[AllowEntry] | None = None,
     used_entries: set[int] | None = None,
     pinned_registry: dict | None = None,
+    readme_text: str | None = None,
 ) -> "LintReport":
     """Lint a set of modules as one program (keys are repo-relative
     paths; interprocedural rules see across all of them).
@@ -1245,7 +1512,9 @@ def lint_sources(
     ``pinned_registry`` is the campaign-registry export consulted by
     chaos-coverage; ``lint_tree`` passes the checked-in artifact, while
     direct callers (fixture tests) default to None so a fixture project
-    is judged against its own plan rules only."""
+    is judged against its own plan rules only.  ``readme_text`` is the
+    README contents for knob-conformance's table-drift check — None
+    (the direct-caller default) skips it."""
     allowlist = allowlist if allowlist is not None else []
     used_entries = used_entries if used_entries is not None else set()
     states: dict[str, _FileState] = {}
@@ -1341,6 +1610,17 @@ def lint_sources(
             ))
     # chaos-coverage (v5): seams nothing can arm, rotted plan rules
     for v in _chaos_coverage(project, pinned_registry):
+        st = states.get(v.path)
+        if st is not None:
+            st.violations.append(v)
+    # surface conformance (v6): the RPC register/call planes, the env-
+    # knob read plane vs the reviewed registry, and the metric
+    # producer/consumer planes
+    for v in (
+        _rpc_conformance(project)
+        + _knob_conformance(project, sources, readme_text)
+        + _metrics_conformance(project)
+    ):
         st = states.get(v.path)
         if st is not None:
             st.violations.append(v)
@@ -1478,6 +1758,9 @@ class LintReport:
     cached_guards: dict | None = None
     cached_lockgraph: dict | None = None
     cached_faultmap: dict | None = None
+    cached_rpcmap: dict | None = None
+    cached_knobmap: dict | None = None
+    cached_metricmap: dict | None = None
     cache_state: str = "off"  # "off" | "miss" | "hit"
 
     def function_summaries(self) -> list[dict]:
@@ -1510,6 +1793,34 @@ class LintReport:
         return dict(
             self.cached_faultmap
             or {"seams": [], "dynamic": [], "plans": []}
+        )
+
+    def rpcmap(self) -> dict:
+        """The rpc-conformance artifact (every method with its register
+        and call sites), live or cached."""
+        if self.project is not None:
+            return self.project.rpcmap()
+        return dict(self.cached_rpcmap or {"methods": {}})
+
+    def knobmap(self) -> dict:
+        """The knob-conformance artifact (the reviewed registry joined
+        with the read plane), live or cached."""
+        if self.project is not None:
+            return build_knob_artifact(self.project.knob_map())
+        return dict(
+            self.cached_knobmap
+            or {"registry": {}, "reads": [], "dynamic": []}
+        )
+
+    def metricmap(self) -> dict:
+        """The metrics-conformance artifact (producer/derived/consumer
+        planes + the exposable series set), live or cached."""
+        if self.project is not None:
+            return self.project.metricmap()
+        return dict(
+            self.cached_metricmap
+            or {"producers": [], "derived": [], "consumers": [],
+                "dynamic": [], "exposed": []}
         )
 
     @property
@@ -1563,10 +1874,11 @@ class LintReport:
 # changes the key, which IS the per-file invalidation.
 
 _CACHE_DIR_NAME = ".fabriclint_cache"
-# v5 (flowcheck): CFG facts in the summaries, flow-sensitive locksets
-# behind the guard map, and the chaos-coverage faultmap joined the
-# cached report — an earlier-schema entry must never serve
-_CACHE_SCHEMA = 3
+# v6 (surfcheck): the rpcmap/knobs/metricmap conformance artifacts
+# joined the cached report (v5 added CFG summaries, flow-sensitive
+# locksets, and the faultmap) — an earlier-schema entry must never
+# serve
+_CACHE_SCHEMA = 4
 _CACHE_KEEP = 8
 _engine_fp_memo: list = []
 
@@ -1580,6 +1892,7 @@ def _engine_fingerprint() -> str:
 
     from fabric_tpu.devtools import allowlist as _al
     from fabric_tpu.devtools import guards as _guards
+    from fabric_tpu.devtools import knob_registry as _kr
 
     # fabriclint: allow[csp-seam] cache-key fingerprint of the linter's
     # own sources — tooling metadata, not consensus bytes; routing it
@@ -1588,7 +1901,7 @@ def _engine_fingerprint() -> str:
     # ast/parsing behavior shifts across interpreter versions: a cached
     # verdict must not outlive the interpreter that computed it
     h.update(repr(sys.version_info).encode())
-    for m in (dataflow, _guards, _al):
+    for m in (dataflow, _guards, _al, _kr):
         with open(m.__file__, "rb") as f:
             # fabriclint: allow[csp-seam] cache-key fingerprint (see above)
             h.update(hashlib.sha256(f.read()).digest())
@@ -1603,6 +1916,14 @@ def _engine_fingerprint() -> str:
             h.update(hashlib.sha256(f.read()).digest())
     except OSError:
         h.update(b"no-faultmap-registry")
+    # knob-conformance's table-drift verdict depends on README bytes,
+    # which are not in the linted source set
+    try:
+        with open(os.path.join(repo_root(), "README.md"), "rb") as f:
+            # fabriclint: allow[csp-seam] cache-key fingerprint (see above)
+            h.update(hashlib.sha256(f.read()).digest())
+    except OSError:
+        h.update(b"no-readme")
     _engine_fp_memo.append(h.hexdigest())
     return _engine_fp_memo[0]
 
@@ -1689,11 +2010,21 @@ def lint_tree(
                 cached_guards=entry["guards"],
                 cached_lockgraph=entry["lockgraph"],
                 cached_faultmap=entry["faultmap"],
+                cached_rpcmap=entry["rpcmap"],
+                cached_knobmap=entry["knobs"],
+                cached_metricmap=entry["metricmap"],
                 cache_state="hit",
             )
+    try:
+        with open(os.path.join(root, "README.md"), "r",
+                  encoding="utf-8") as f:
+            readme_text = f.read()
+    except OSError:
+        readme_text = None
     report = lint_sources(
         sources, allowlist, used_entries,
         pinned_registry=load_faultmap_registry(),
+        readme_text=readme_text,
     )
     # an entry is in this run's scope if its file was linted, or if it
     # falls under a directory target (so full-tree runs flag entries
@@ -1724,6 +2055,9 @@ def lint_tree(
             "guards": report.guard_map(),
             "lockgraph": report.lock_graph(),
             "faultmap": report.faultmap(),
+            "rpcmap": report.rpcmap(),
+            "knobs": report.knobmap(),
+            "metricmap": report.metricmap(),
         })
         report.cache_state = "miss"
     return report
@@ -1820,6 +2154,22 @@ def main(argv=None) -> int:
              "faultline seam + every pinned plan rule) as JSON and exit",
     )
     ap.add_argument(
+        "--rpcmap", action="store_true",
+        help="dump the rpc-conformance map (every RPC method with its "
+             "register and call sites) as JSON and exit",
+    )
+    ap.add_argument(
+        "--knobs", action="store_true",
+        help="dump the knob-conformance map (the reviewed FABRIC_TPU_* "
+             "registry joined with every read site) as JSON and exit",
+    )
+    ap.add_argument(
+        "--metricmap", action="store_true",
+        help="dump the metrics-conformance map (producer/derived/"
+             "consumer planes + the exposable series set) as JSON and "
+             "exit",
+    )
+    ap.add_argument(
         "--no-cache", action="store_true",
         help="skip the .fabriclint_cache dataflow cache (escape hatch)",
     )
@@ -1848,6 +2198,15 @@ def main(argv=None) -> int:
         return 0
     if args.faultmap:
         print(json.dumps(report.faultmap(), indent=2, sort_keys=True))
+        return 0
+    if args.rpcmap:
+        print(json.dumps(report.rpcmap(), indent=2, sort_keys=True))
+        return 0
+    if args.knobs:
+        print(json.dumps(report.knobmap(), indent=2, sort_keys=True))
+        return 0
+    if args.metricmap:
+        print(json.dumps(report.metricmap(), indent=2, sort_keys=True))
         return 0
 
     shown = list(report.unsuppressed) + list(report.warnings)

@@ -71,6 +71,8 @@ import sys
 import threading
 import time
 
+from fabric_tpu.devtools import knob_registry
+
 _ENV = "FABRIC_TPU_LOCKWATCH"
 _PROFILE_ENV = "FABRIC_TPU_PROFILE"
 _PROFILE_FALSY = ("", "0", "false", "off", "no")
@@ -88,11 +90,11 @@ class LockOrderError(RuntimeError):
 
 
 def enabled() -> bool:
-    return os.environ.get(_ENV, "") not in ("", "0", "false", "off")
+    return knob_registry.raw(_ENV) not in ("", "0", "false", "off")
 
 
 def _raise_mode() -> bool:
-    return os.environ.get(_ENV, "") != "record"
+    return knob_registry.raw(_ENV) != "record"
 
 
 _profmod = None
@@ -120,7 +122,7 @@ def _profile_on() -> bool:
             return bool(mod.enabled())
         except Exception:
             return False
-    raw = os.environ.get(_PROFILE_ENV, "")
+    raw = knob_registry.raw(_PROFILE_ENV)
     return raw.strip().lower() not in _PROFILE_FALSY
 
 
@@ -567,7 +569,7 @@ thread_violations: list[dict] = []
 
 
 def threads_enabled() -> bool:
-    return os.environ.get(_THREAD_ENV, "") not in ("", "0", "false", "off")
+    return knob_registry.raw(_THREAD_ENV) not in ("", "0", "false", "off")
 
 
 def reset_threads() -> None:

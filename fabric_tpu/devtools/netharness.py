@@ -944,12 +944,38 @@ def run_stream(
     return result
 
 
+_rpcmap_hash_memo: list = []
+
+
+def rpcmap_hash() -> str:
+    """sha256 over the canonical-JSON static rpcmap (fabriclint's
+    rpc-conformance artifact), memoized per process.  Embedded in every
+    verdict so a replayed repro fails loudly when the RPC surface it
+    certified has drifted — the method a kill schedule exercised may
+    simply no longer exist."""
+    if not _rpcmap_hash_memo:
+        import hashlib
+
+        from fabric_tpu.devtools.lint import lint_tree
+
+        doc = json.dumps(
+            lint_tree().rpcmap(), sort_keys=True, separators=(",", ":")
+        )
+        # fabriclint: allow[csp-seam] artifact fingerprint of the
+        # static rpcmap — tooling metadata, not consensus bytes
+        digest = hashlib.sha256(doc.encode()).hexdigest()
+        _rpcmap_hash_memo.append(digest)
+    return _rpcmap_hash_memo[0]
+
+
 def verdict_doc(result: dict) -> dict:
     """The byte-deterministic verdict view of a run: only seed-derived
     and pass/fail fields (no timings, no throughput) — two runs of the
-    same seed and topology must serialize identically when they pass."""
+    same seed and topology must serialize identically when they pass.
+    ``rpcmap_sha256`` pins the static RPC surface the run certified."""
     return {
         "experiment": "netharness",
+        "rpcmap_sha256": rpcmap_hash(),
         "seed": result["seed"],
         "topology": result["topology"],
         "kill_schedule": result["kill_schedule"],
@@ -1080,6 +1106,7 @@ def merge_traces(net: Network, out_path: str | None = None) -> dict:
 __all__ = [
     "Topology", "KillRule", "Network", "NetError",
     "generate_kill_schedule", "run_stream", "verdict_doc",
+    "rpcmap_hash",
     "write_repro", "replay_repro", "merge_traces", "free_port",
     "attach_netscope",
 ]

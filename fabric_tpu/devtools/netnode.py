@@ -197,6 +197,12 @@ class NetOrderer:
             notifier=notifier,
         )
         self.rpc = RPCServer("127.0.0.1", int(cfg["rpc_port"]))
+        if self.operations is not None:
+            # same shape as the reference's grpc server interceptors:
+            # per-method completed/duration series on the ops registry
+            from fabric_tpu.comm.instrument import instrument
+
+            instrument(self.rpc, self.operations.metrics_provider)
         self.rpc.register("ab.Broadcast", self._broadcast)
         self.rpc.register("ab.BroadcastStream", self._broadcast_stream)
         self.rpc.register("ab.Deliver", self._deliver)
@@ -467,6 +473,10 @@ class NetPeer:
         )
 
         self.rpc = RPCServer("127.0.0.1", int(cfg["rpc_port"]))
+        if self.operations is not None:
+            from fabric_tpu.comm.instrument import instrument
+
+            instrument(self.rpc, self.operations.metrics_provider)
         self.rpc.register("ab.Deliver", self._deliver)
         self.rpc.register("net.Status", self._status)
         self.rpc.register("net.Check", self._check)

@@ -26,7 +26,7 @@ import os
 import struct
 import threading
 
-from fabric_tpu.devtools import faultline
+from fabric_tpu.devtools import faultline, knob_registry
 from fabric_tpu.ledger.kvstore import KVStore, MemKVStore, NamedDB
 from fabric_tpu.protos.common import common_pb2
 from fabric_tpu import protoutil
@@ -45,7 +45,7 @@ def segment_size(override: int | None = None) -> int:
     tail a mostly-idle channel keeps on disk."""
     if override is not None:
         return max(_MIN_SEGMENT, int(override))
-    raw = os.environ.get("FABRIC_TPU_STORE_SEGMENT", "").strip().lower()
+    raw = knob_registry.raw("FABRIC_TPU_STORE_SEGMENT").strip().lower()
     if not raw:
         return DEFAULT_SEGMENT
     mult = 1

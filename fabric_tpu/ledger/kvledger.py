@@ -13,7 +13,7 @@ import threading
 import time
 
 from fabric_tpu.common import tracing
-from fabric_tpu.devtools import faultline
+from fabric_tpu.devtools import faultline, knob_registry
 from fabric_tpu.devtools.lockwatch import guarded, named_rlock
 from fabric_tpu.ledger.blkstorage import BlockStore, BlockStoreError
 from fabric_tpu.ledger.history import HistoryDB
@@ -217,7 +217,7 @@ class KVLedger:
         """Blocks replayed per recovery KV transaction
         (FABRIC_TPU_RECOVERY_GROUP, default 32; values below 1 restore
         the old per-block-txn behavior)."""
-        raw = os.environ.get("FABRIC_TPU_RECOVERY_GROUP", "").strip()
+        raw = knob_registry.raw("FABRIC_TPU_RECOVERY_GROUP").strip()
         if not raw:
             return 32
         try:

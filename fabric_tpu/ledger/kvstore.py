@@ -19,7 +19,7 @@ import time
 import zlib
 from typing import Iterator
 
-from fabric_tpu.devtools import faultline
+from fabric_tpu.devtools import faultline, knob_registry
 from fabric_tpu.devtools.lockwatch import guarded, named_lock, named_rlock
 
 
@@ -115,7 +115,7 @@ def _sqlite_sync_level(override: str | None) -> str:
     raw = (
         override
         if override is not None
-        else os.environ.get("FABRIC_TPU_SQLITE_SYNC", "")
+        else knob_registry.raw("FABRIC_TPU_SQLITE_SYNC")
     ).strip().upper()
     if not raw:
         return "NORMAL"
@@ -135,7 +135,7 @@ def _sqlite_wal_checkpoint(override: int | None) -> int:
     entirely (operator-driven checkpoints only)."""
     if override is not None:
         return max(0, int(override))
-    raw = os.environ.get("FABRIC_TPU_WAL_CHECKPOINT", "").strip()
+    raw = knob_registry.raw("FABRIC_TPU_WAL_CHECKPOINT").strip()
     if not raw:
         return 1000
     try:
@@ -435,7 +435,7 @@ def store_shards(override: int | None = None) -> int:
     key routing can never drift across restarts."""
     if override is not None:
         return max(1, min(int(override), _MAX_SHARDS))
-    raw = os.environ.get("FABRIC_TPU_STORE_SHARDS", "").strip()
+    raw = knob_registry.raw("FABRIC_TPU_STORE_SHARDS").strip()
     if not raw:
         return 1
     try:

@@ -30,7 +30,7 @@ import threading
 import time
 
 from fabric_tpu.common import tracing, workpool
-from fabric_tpu.devtools import faultline
+from fabric_tpu.devtools import faultline, knob_registry
 from fabric_tpu.peer.validation_plugins import (
     IllegalWritesetError,
     PluginRegistry,
@@ -221,7 +221,7 @@ class TxValidator:
         # path's heavy stages (hash_batch over multi-KB messages,
         # creator deserialization) release the GIL and win.
         env_set = bool(
-            os.environ.get("FABRIC_TPU_COLLECT_POOL", "").strip()
+            knob_registry.raw("FABRIC_TPU_COLLECT_POOL").strip()
         )
         self._collect_explicit = collect_width is not None or env_set
         if faithful:

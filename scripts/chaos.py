@@ -7,7 +7,14 @@ lint scripts use, so the driver/CI can scrape `"experiment":
 "faultfuzz"` next to those lines.
 
 Usage: python scripts/chaos.py [--plans N] [--seed S] [--blocks B]
-       [--out DIR] [--no-shrink] [--no-comm] [--replay FILE] [--kill9]
+       [--out DIR] [--no-shrink] [--no-comm] [--mutants K]
+       [--replay FILE] [--kill9]
+
+`--mutants K` derives K seeded single-edit mutants (trigger tweak,
+action swap within the point's pool, or dropped rule) from every
+FAILING plan and runs them through the same judge/shrink/repro path —
+probing how brittle the failure is to exactly one variable.  Mutants
+are fully seed-derived, so same-seed campaigns stay byte-identical.
 
 Exit code: nonzero when ANY plan's oracle verdict is a failure (each
 one has been shrunk and written as a replayable repro JSON under --out,
@@ -57,6 +64,11 @@ def main() -> int:
                     help="repro-artifact directory (default .faultfuzz)")
     ap.add_argument("--no-shrink", action="store_true",
                     help="skip plan minimization on failures")
+    ap.add_argument("--mutants", type=int, default=0, metavar="K",
+                    help="per FAILING plan, derive K seeded single-"
+                         "edit mutants (trigger tweak / action swap / "
+                         "dropped rule) and run them through the same "
+                         "judge/shrink/repro path (default 0)")
     ap.add_argument("--no-comm", action="store_true",
                     help="skip the rpc traffic phase of the workload")
     ap.add_argument("--replay", default=None, metavar="FILE",
@@ -283,7 +295,7 @@ def main() -> int:
         seed=args.seed, plans=args.plans, blocks=args.blocks,
         out_dir=args.out, shrink=not args.no_shrink,
         comm=not args.no_comm, trace_dir=args.trace_dir,
-        profile_dir=args.profile_dir,
+        profile_dir=args.profile_dir, mutants=args.mutants,
     )
     summary = campaign.run()
     ledger_digest = hashlib.sha256(
@@ -296,6 +308,8 @@ def main() -> int:
         "blocks": summary["blocks"],
         "registry_points": summary["registry_points"],
         "failures": summary["failures"],
+        "mutants_per_failure": summary["mutants_per_failure"],
+        "mutant_failures": summary["mutant_failures"],
         "verdicts": summary["verdicts"],
         "trips_total": summary["trips_total"],
         "trip_ledger_sha256": ledger_digest,

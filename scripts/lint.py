@@ -8,7 +8,8 @@ error-severity violation, after the optional baseline ratchet).
 
 Usage: python scripts/lint.py [--show-suppressed] [--baseline FILE]
        [--write-baseline FILE] [--summaries-out P] [--guards-out P]
-       [--lockgraph-out P] [--faultmap-out P] [--budget-s S]
+       [--lockgraph-out P] [--faultmap-out P] [--rpcmap-out P]
+       [--knobs-out P] [--metricmap-out P] [--budget-s S]
 
 The baseline ratchet lets a new rule land loud-but-not-fatal: a JSON
 {"rule": count} file tolerates up to COUNT unsuppressed errors per rule.
@@ -72,6 +73,25 @@ def main() -> int:
              "deterministic order) as a JSON artifact — what the "
              "chaos-coverage rule cross-checked against the pinned "
              "campaign registry this run",
+    )
+    ap.add_argument(
+        "--rpcmap-out", default=None, metavar="PATH",
+        help="write the rpc-conformance map (every RPC method with its "
+             "register and call sites, component-classified, handler "
+             "shapes inferred) as a JSON artifact — tier-1 asserts "
+             "observed methods ⊆ this map",
+    )
+    ap.add_argument(
+        "--knobs-out", default=None, metavar="PATH",
+        help="write the knob-conformance map (the reviewed FABRIC_TPU_* "
+             "registry joined with every statically enumerated read "
+             "site) as a JSON artifact",
+    )
+    ap.add_argument(
+        "--metricmap-out", default=None, metavar="PATH",
+        help="write the metrics-conformance map (producer/derived/"
+             "consumer planes + the exposable series set) as a JSON "
+             "artifact — tier-1 asserts scraped series ⊆ exposed",
     )
     ap.add_argument(
         "--no-cache", action="store_true",
@@ -138,6 +158,38 @@ def main() -> int:
             "seams": len(fm["seams"]),
             "plans": len(fm["plans"]),
         }
+    rpcmap_written = None
+    if args.rpcmap_out:
+        rm = report.rpcmap()
+        with open(args.rpcmap_out, "w", encoding="utf-8") as f:
+            json.dump(rm, f, indent=2, sort_keys=True)
+            f.write("\n")
+        rpcmap_written = {
+            "path": args.rpcmap_out,
+            "methods": len(rm["methods"]),
+        }
+    knobs_written = None
+    if args.knobs_out:
+        km = report.knobmap()
+        with open(args.knobs_out, "w", encoding="utf-8") as f:
+            json.dump(km, f, indent=2, sort_keys=True)
+            f.write("\n")
+        knobs_written = {
+            "path": args.knobs_out,
+            "knobs": len(km["registry"]),
+            "reads": len(km["reads"]),
+        }
+    metricmap_written = None
+    if args.metricmap_out:
+        mm = report.metricmap()
+        with open(args.metricmap_out, "w", encoding="utf-8") as f:
+            json.dump(mm, f, indent=2, sort_keys=True)
+            f.write("\n")
+        metricmap_written = {
+            "path": args.metricmap_out,
+            "producers": len(mm["producers"]),
+            "exposed": len(mm["exposed"]),
+        }
     out = {
         "experiment": "fabriclint",
         "files": summary["files"],
@@ -158,6 +210,12 @@ def main() -> int:
         out["lockgraph"] = lockgraph_written
     if faultmap_written is not None:
         out["faultmap"] = faultmap_written
+    if rpcmap_written is not None:
+        out["rpcmap"] = rpcmap_written
+    if knobs_written is not None:
+        out["knobs"] = knobs_written
+    if metricmap_written is not None:
+        out["metricmap"] = metricmap_written
     budget_ok = True
     if args.budget_s is not None:
         budget_ok = elapsed <= args.budget_s

@@ -1,0 +1,11 @@
+"""Clean twin of fix_knob_unregistered_dirty: both reads resolve to
+registered knobs THROUGH the registry helper — knob-conformance stays
+quiet."""
+
+from fabric_tpu.devtools import knob_registry
+
+
+def tuning():
+    trace = knob_registry.raw("FABRIC_TPU_TRACE")
+    soak = knob_registry.raw("FABRIC_TPU_SOAK")
+    return trace, soak

@@ -1,0 +1,14 @@
+"""Seeded violations (knob-conformance): one read of a FABRIC_TPU_*
+name that has NO knob_registry entry, and one read of a registered
+name that BYPASSES the registry helper with a raw ``os.environ.get``.
+Expected: both fire, each at its read site."""
+
+import os
+
+from fabric_tpu.devtools import knob_registry
+
+
+def tuning():
+    ghost = knob_registry.raw("FABRIC_TPU_FIXTURE_GHOST")  # <- unregistered
+    raw = os.environ.get("FABRIC_TPU_TRACE", "")  # <- helper bypass
+    return ghost, raw

@@ -1,0 +1,19 @@
+"""Clean twin of fix_metric_consumer_dirty: every consumed series name
+has a registered producer — metrics-conformance stays quiet."""
+
+from fabric_tpu.common.metrics import CounterOpts
+
+
+def wire(provider):
+    return provider.new_counter(
+        CounterOpts(namespace="fix", name="events_total")
+    )
+
+
+def watch(scope, node):
+    return scope.series(node, "fix_events_total")
+
+
+def boot(provider, scope, node):
+    wire(provider)
+    return watch(scope, node)

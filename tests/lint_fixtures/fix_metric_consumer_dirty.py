@@ -1,0 +1,24 @@
+"""Seeded violation (metrics-conformance): the rollup consumes series
+``fix_missing_total`` but no producer or derived series carries that
+name — the threshold can only ever see an absent series.  The module's
+actual producer (``fix_events_total``) is registered and consumed, so
+the only violation is the orphan consumer."""
+
+from fabric_tpu.common.metrics import CounterOpts
+
+
+def wire(provider):
+    return provider.new_counter(
+        CounterOpts(namespace="fix", name="events_total")
+    )
+
+
+def watch(scope, node):
+    good = scope.series(node, "fix_events_total")
+    bad = scope.series(node, "fix_missing_total")  # <- orphan consumer
+    return good, bad
+
+
+def boot(provider, scope, node):
+    wire(provider)
+    return watch(scope, node)

@@ -1,0 +1,16 @@
+"""Clean twin of fix_rpc_orphan_dirty: every call site targets a
+registered method and every registered handler has a caller —
+rpc-conformance stays quiet."""
+
+
+class FixServer:
+    def __init__(self, rpc):
+        self.rpc = rpc
+        self.rpc.register("fix.Ping", self._ping)
+
+    def _ping(self, body, stream):
+        return b"pong"
+
+
+def probe(conn):
+    return conn.call("fix.Ping", b"")

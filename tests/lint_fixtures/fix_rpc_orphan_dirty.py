@@ -1,0 +1,18 @@
+"""Seeded violation (rpc-conformance): the client calls
+``fix.Missing`` but NO component registers that method — the call can
+only ever raise method-not-found.  ``fix.Ping`` is registered AND
+called, so the only violation is the orphan call site."""
+
+
+class FixServer:
+    def __init__(self, rpc):
+        self.rpc = rpc
+        self.rpc.register("fix.Ping", self._ping)
+
+    def _ping(self, body, stream):
+        return b"pong"
+
+
+def probe(conn):
+    conn.call("fix.Ping", b"")
+    return conn.call("fix.Missing", b"")  # <- orphan call site: HERE

@@ -1,0 +1,17 @@
+"""Clean twin of fix_rpc_shape_dirty: the client ``stream``s the
+generator-backed method, so the verb matches the handler shape and
+rpc-conformance stays quiet."""
+
+
+class FixServer:
+    def __init__(self, rpc):
+        self.rpc = rpc
+        self.rpc.register("fix.Feed", self._feed)
+
+    def _feed(self, body, stream):
+        for chunk in (b"a", b"b"):
+            yield chunk
+
+
+def drain(conn):
+    return list(conn.stream("fix.Feed", b""))

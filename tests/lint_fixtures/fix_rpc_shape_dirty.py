@@ -1,0 +1,18 @@
+"""Seeded violation (rpc-conformance): the only register site for
+``fix.Feed`` binds a GENERATOR handler (stream-shaped), but the client
+unary-``call``s it — the framing can never line up.  Expected: the
+shape mismatch fires at the call site."""
+
+
+class FixServer:
+    def __init__(self, rpc):
+        self.rpc = rpc
+        self.rpc.register("fix.Feed", self._feed)
+
+    def _feed(self, body, stream):
+        for chunk in (b"a", b"b"):
+            yield chunk
+
+
+def drain(conn):
+    return conn.call("fix.Feed", b"")  # <- verb/shape mismatch: HERE

@@ -8,8 +8,10 @@ export/import fault points (torn manifest refused, half-import refused
 loudly), and the tier-1 soak mode (slow): the commit+snapshot workload
 under the low-probability background plan to a green oracle."""
 
+import copy
 import json
 import os
+import random
 
 import pytest
 
@@ -162,6 +164,137 @@ def test_campaign_writes_repro_for_failing_plan(tmp_path):
         seed=3, index=0,
     )
     assert os.path.isfile(path)
+
+
+# -- single-edit mutants (ISSUE 19 satellite) ---------------------------------
+
+
+def _seeded_registry():
+    """Registry slice covering the seeded plan's two points, with the
+    kinds the pinned faultmap carries — enough for mutate_plan's
+    action-pool lookup."""
+    return {
+        "store.shard_flush": {"kinds": ["point"], "ctx": {}},
+        "store.shard_recover": {"kinds": ["guard"], "ctx": {}},
+    }
+
+
+def test_mutate_plan_same_seed_same_single_edit_mutant():
+    """A mutant is fully derived from its rng seed and differs from
+    its parent by EXACTLY one edit: a dropped rule, a swapped action
+    (from the point's own pool), or a re-sampled trigger.  The plan
+    seed carries over, so a mutant run isolates one variable."""
+    registry = _seeded_registry()
+    snapshot = copy.deepcopy(_SEEDED_PLAN)
+    parent = _SEEDED_PLAN["faults"]
+    kinds_of_edit = set()
+    for j in range(8):
+        a = faultfuzz.mutate_plan(
+            random.Random(f"3:0:m{j}"), _SEEDED_PLAN, registry,
+            f"seeded:m{j}",
+        )
+        b = faultfuzz.mutate_plan(
+            random.Random(f"3:0:m{j}"), _SEEDED_PLAN, registry,
+            f"seeded:m{j}",
+        )
+        assert a == b  # same (seed, plan index, mutant index) -> same mutant
+        assert a["label"] == f"seeded:m{j}"
+        assert a["seed"] == _SEEDED_PLAN["seed"]
+        faults = a["faults"]
+        if len(faults) == len(parent) - 1:
+            kinds_of_edit.add("drop")
+            assert all(f in parent for f in faults)
+        else:
+            assert len(faults) == len(parent)
+            diffs = [k for k in range(len(parent))
+                     if faults[k] != parent[k]]
+            assert len(diffs) == 1, (faults, parent)
+            f, p = faults[diffs[0]], parent[diffs[0]]
+            assert f["point"] == p["point"]  # the rule kept its target
+            if f["action"] != p["action"]:
+                kinds_of_edit.add("action")
+                assert f["action"] in faultfuzz._action_pool(
+                    f["point"], registry[f["point"]]["kinds"]
+                )
+            else:
+                kinds_of_edit.add("trigger")
+    # all three edit kinds show up across the first 8 seeds, and the
+    # parent plan itself is never touched (deepcopy, not aliasing)
+    assert kinds_of_edit == {"drop", "action", "trigger"}
+    assert _SEEDED_PLAN == snapshot
+
+
+def test_campaign_mutants_ride_the_repro_path_and_stay_deterministic(
+        tmp_path, monkeypatch):
+    """Campaign-level mutant plumbing.  Generated plans at test sizes
+    never fail the oracle, so the failing-plan mutant path is pinned
+    by making the generator emit the seeded failure: the campaign
+    derives K seed-addressed mutants, judges each, writes a repro for
+    the still-failing one (mutant m5's trigger tweak keeps the
+    shard-apply crash live), counts it in the summary, and two
+    same-seed campaigns agree byte-for-byte once artifact paths are
+    stripped."""
+    def seeded_generator(rng, registry, label, tripped=frozenset()):
+        plan = copy.deepcopy(_SEEDED_PLAN)
+        plan["label"] = label
+        return plan
+
+    monkeypatch.setattr(faultfuzz, "generate_plan", seeded_generator)
+
+    def strip(summary):
+        out = {k: v for k, v in summary.items()
+               if k not in ("repro", "trace", "profile")}
+        out["results"] = [
+            {
+                **{k: v for k, v in e.items()
+                   if k not in ("repro", "trace", "profile", "mutants")},
+                "mutants": [
+                    {k: v for k, v in m.items() if k != "repro"}
+                    for m in e.get("mutants", ())
+                ],
+            }
+            for e in summary["results"]
+        ]
+        return out
+
+    runs = []
+    for sub in ("r1", "r2"):
+        c = faultfuzz.Campaign(
+            seed=3, plans=1, mutants=6, shrink=False,
+            workdir=str(tmp_path / sub),
+            out_dir=str(tmp_path / sub / "out"),
+        )
+        runs.append(c.run())
+    a, b = runs
+    assert strip(a) == strip(b)
+
+    assert a["mutants_per_failure"] == 6
+    assert a["mutant_failures"] == 1
+    [entry] = a["results"]
+    assert entry["verdict"] == "fail"
+    muts = entry["mutants"]
+    assert [m["index"] for m in muts] == list(range(6))
+    # each mutant label is addressable back to (seed, plan, mutant)
+    assert muts[5]["plan"]["label"] == "fuzz:3:0:m5"
+    assert [m["verdict"] for m in muts] == \
+        ["pass", "pass", "pass", "pass", "pass", "fail"]
+    # mutant trips feed the campaign's coverage ledger
+    assert a["trips_total"] > len(entry["trips"])
+
+    # the failing mutant wrote a repro through the same artifact path
+    # as its parent, and that artifact replays to the same violation
+    assert len(a["repro"]) == 2
+    failing = muts[5]
+    assert failing["repro"].endswith("repro_seed3_plan000_m5.json")
+    assert os.path.isfile(failing["repro"])
+    doc = json.loads(open(failing["repro"]).read())
+    assert doc["format"] == faultfuzz.REPRO_FORMAT
+    replayed = faultfuzz.replay(
+        failing["repro"], str(tmp_path / "replay")
+    )
+    assert replayed["violations"], \
+        "the mutant repro artifact did not reproduce"
+    assert {v["check"] for v in replayed["violations"]} & {"state"}
 
 
 # -- snapshot fault points ----------------------------------------------------

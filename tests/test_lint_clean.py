@@ -638,6 +638,11 @@ def _report_json(report) -> str:
         # v5: the chaos-coverage faultmap and the CFG facts riding the
         # function summaries are cached artifacts too
         "faultmap": report.faultmap(),
+        # v6: the three surface-conformance artifacts are cached too —
+        # a cache hit must serve them byte-identical to the cold run
+        "rpcmap": report.rpcmap(),
+        "knobs": report.knobmap(),
+        "metricmap": report.metricmap(),
     }, sort_keys=True)
 
 
@@ -881,12 +886,11 @@ def test_ci_wrapper_summaries_out_writes_artifact(tmp_path):
 
 
 def test_v5_chaos_coverage_enforced_at_error_in_both_profiles():
-    """ISSUE 18 acceptance: chaos-coverage is the 11th rule, on at
+    """ISSUE 18 acceptance: chaos-coverage is a first-class rule, on at
     error severity in BOTH profiles (a test plan is coverage, so tests
     must lint it), and the tree gate still runs with no baseline."""
     from fabric_tpu.devtools.lint import RELAXED_PROFILE, STRICT_PROFILE
 
-    assert len(RULES) == 11
     assert "chaos-coverage" in RULES
     for prof in (STRICT_PROFILE, RELAXED_PROFILE):
         assert "chaos-coverage" not in prof.disabled
@@ -988,3 +992,100 @@ def test_ci_wrapper_faultmap_out_and_warm_cache_budget(tmp_path):
     assert result["faultmap"]["plans"] == len(fm["plans"]) > 50
     sample = fm["seams"][0]
     assert {"name", "kind", "module", "line"} <= set(sample)
+
+
+# -- v6 "surfcheck": rpc/knob/metrics conformance ----------------------------
+
+
+def test_v6_surface_trio_enforced_at_error_with_no_baseline():
+    """ISSUE 19 acceptance: rpc-conformance, knob-conformance, and
+    metrics-conformance bring the rule count to 14, all on at error
+    severity in the strict profile with no baseline — and off under
+    the relaxed profile (they anchor at production sites only)."""
+    from fabric_tpu.devtools.lint import RELAXED_PROFILE, STRICT_PROFILE
+
+    assert len(RULES) == 14
+    for rule in ("rpc-conformance", "knob-conformance",
+                 "metrics-conformance"):
+        assert rule in RULES
+        assert rule not in STRICT_PROFILE.disabled
+        assert rule not in STRICT_PROFILE.advisory
+        assert rule in RELAXED_PROFILE.disabled
+    import glob
+    import os
+
+    from fabric_tpu.devtools.lint import repo_root
+
+    assert not glob.glob(os.path.join(repo_root(), "*baseline*.json")), (
+        "the tree must stay clean with NO baseline ratchet file"
+    )
+
+
+def test_v6_tree_artifacts_cover_the_real_surfaces():
+    """The whole-tree artifacts are non-degenerate: every gateway/
+    deliver/participation method is mapped with both register and call
+    sites, every registry knob has a read site, and the metric planes
+    carry the production series netscope consumes."""
+    from fabric_tpu.devtools import knob_registry
+
+    report = lint_tree()
+    rpc = report.rpcmap()["methods"]
+    assert len(rpc) >= 25
+    for method in ("ab.Broadcast", "deliver.DeliverFiltered",
+                   "participation.List", "endorser.ProcessProposal",
+                   "net.TraceDump"):
+        assert rpc[method]["registers"], method
+        assert rpc[method]["calls"], method
+    knobs = report.knobmap()
+    assert set(knobs["registry"]) == set(knob_registry.KNOBS)
+    read_names = {r["name"] for r in knobs["reads"]}
+    assert read_names == set(knob_registry.KNOBS)
+    assert knobs["dynamic"] == []
+    mm = report.metricmap()
+    assert all(p["registered"] for p in mm["producers"])
+    assert len(mm["exposed"]) >= 60
+    consumed = {c["name"] for c in mm["consumers"]}
+    assert "ledger_height" in consumed
+    assert consumed <= set(mm["exposed"])
+
+
+def test_ci_wrapper_v6_artifacts_byte_identical_cold_vs_hit(tmp_path):
+    """scripts/lint.py --rpcmap-out/--knobs-out/--metricmap-out (ISSUE
+    19 satellite): all three artifacts land beside the result line,
+    and a --no-cache cold pass writes byte-identical files to a
+    warm-cache hit — determinism of the cached artifact plane."""
+    import os
+
+    from fabric_tpu.devtools.lint import repo_root
+
+    root = repo_root()
+
+    def run(tag, *extra):
+        paths = {
+            kind: str(tmp_path / f"{kind}_{tag}.json")
+            for kind in ("rpcmap", "knobs", "metricmap")
+        }
+        proc = subprocess.run(
+            [sys.executable, os.path.join(root, "scripts", "lint.py"),
+             "--rpcmap-out", paths["rpcmap"],
+             "--knobs-out", paths["knobs"],
+             "--metricmap-out", paths["metricmap"], *extra],
+            capture_output=True, text=True, cwd=root,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        result = json.loads(proc.stdout.strip().splitlines()[-1])
+        return result, paths
+
+    cold, cold_paths = run("cold", "--no-cache")
+    assert cold["cache"] == "off"
+    hit, hit_paths = run("hit")
+    assert hit["cache"] == "hit"
+    for kind in ("rpcmap", "knobs", "metricmap"):
+        a = open(cold_paths[kind], "rb").read()
+        b = open(hit_paths[kind], "rb").read()
+        assert a == b, f"{kind} artifact differs cold vs hit"
+    assert hit["rpcmap"]["methods"] >= 25
+    assert hit["knobs"]["knobs"] == 16
+    assert hit["knobs"]["reads"] >= 16
+    assert hit["metricmap"]["producers"] >= 40
+    assert hit["metricmap"]["exposed"] >= 60

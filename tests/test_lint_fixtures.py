@@ -889,3 +889,206 @@ def test_coverage_orphan_exact_pin_quiet():
         src, "fabric_tpu/gossip/fix_coverage_orphan_clean.py"
     )
     assert _fires(vs, "chaos-coverage") == []
+
+
+# -- v6 rpc-conformance: orphan call site, verb/shape mismatch ---------------
+
+
+def test_rpc_orphan_call_site_fires_at_the_call():
+    src = _load("fix_rpc_orphan_dirty.py")
+    vs = lint_source(src, "fabric_tpu/peer/fix_rpc_orphan_dirty.py")
+    lines = _fires(vs, "rpc-conformance")
+    assert len(lines) == 1
+    assert "orphan call site: HERE" in src.splitlines()[lines[0] - 1]
+    msgs = [v.message for v in vs if v.rule == "rpc-conformance"]
+    assert any("fix.Missing" in m and "no component registers" in m
+               for m in msgs)
+
+
+def test_rpc_orphan_clean_twin_quiet():
+    src = _load("fix_rpc_orphan_clean.py")
+    vs = lint_source(src, "fabric_tpu/peer/fix_rpc_orphan_clean.py")
+    assert vs == []
+
+
+def test_rpc_verb_shape_mismatch_fires_at_the_call():
+    """The register site provably binds a generator (stream-shaped)
+    handler; a unary `call` of the method can never frame up."""
+    src = _load("fix_rpc_shape_dirty.py")
+    vs = lint_source(src, "fabric_tpu/peer/fix_rpc_shape_dirty.py")
+    lines = _fires(vs, "rpc-conformance")
+    assert len(lines) == 1
+    assert "verb/shape mismatch: HERE" in src.splitlines()[lines[0] - 1]
+    msgs = [v.message for v in vs if v.rule == "rpc-conformance"]
+    assert any("stream-shaped" in m for m in msgs)
+
+
+def test_rpc_verb_shape_clean_twin_quiet():
+    src = _load("fix_rpc_shape_clean.py")
+    vs = lint_source(src, "fabric_tpu/peer/fix_rpc_shape_clean.py")
+    assert vs == []
+
+
+def test_rpc_register_without_any_caller_fires_at_the_register():
+    """Deleting the probe from the clean twin orphans the handler: the
+    violation anchors at the register site."""
+    src = _load("fix_rpc_orphan_clean.py")
+    src = src[:src.index("def probe")]
+    vs = lint_source(src, "fabric_tpu/peer/fix_rpc_orphan_clean.py")
+    lines = _fires(vs, "rpc-conformance")
+    assert len(lines) == 1
+    assert "fix.Ping" in src.splitlines()[lines[0] - 1]
+    msgs = [v.message for v in vs if v.rule == "rpc-conformance"]
+    assert any("no caller anywhere" in m for m in msgs)
+
+
+def test_rpc_conformance_disabled_in_relaxed_profile():
+    """The same orphan call site under a tests/ path stays quiet: the
+    v6 surface rules anchor at production sites only."""
+    src = _load("fix_rpc_orphan_dirty.py")
+    vs = lint_source(src, "tests/fix_rpc_orphan_dirty.py")
+    assert _fires(vs, "rpc-conformance") == []
+
+
+# -- v6 knob-conformance: unregistered read, helper bypass, README drift -----
+
+
+def test_knob_unregistered_and_bypass_fire_at_the_reads():
+    src = _load("fix_knob_unregistered_dirty.py")
+    vs = lint_source(
+        src, "fabric_tpu/peer/fix_knob_unregistered_dirty.py"
+    )
+    lines = _fires(vs, "knob-conformance")
+    assert len(lines) == 2
+    src_lines = src.splitlines()
+    assert "<- unregistered" in src_lines[lines[0] - 1]
+    assert "<- helper bypass" in src_lines[lines[1] - 1]
+    msgs = [v.message for v in vs if v.rule == "knob-conformance"]
+    assert any("FABRIC_TPU_FIXTURE_GHOST" in m for m in msgs)
+    assert any("bypasses knob_registry.raw()" in m for m in msgs)
+
+
+def test_knob_clean_twin_quiet():
+    src = _load("fix_knob_unregistered_clean.py")
+    vs = lint_source(
+        src, "fabric_tpu/peer/fix_knob_unregistered_clean.py"
+    )
+    assert vs == []
+
+
+def _registry_project():
+    """The real registry module plus a generated reader covering every
+    entry, so the dead-entry check cannot fire and the README checks
+    are isolated."""
+    from fabric_tpu.devtools import knob_registry
+    from fabric_tpu.devtools.lint import KNOB_REGISTRY_REL
+
+    with open(os.path.join(
+        os.path.dirname(os.path.dirname(__file__)), KNOB_REGISTRY_REL
+    ), encoding="utf-8") as f:
+        reg_src = f.read()
+    reads = "from fabric_tpu.devtools import knob_registry\n\n\n" \
+        "def warm():\n" + "".join(
+            f'    knob_registry.raw("{name}")\n'
+            for name in sorted(knob_registry.KNOBS)
+        )
+    return {
+        KNOB_REGISTRY_REL: reg_src,
+        "fabric_tpu/peer/fix_knob_reads.py": reads,
+    }
+
+
+def test_knob_readme_drift_fires_on_stale_table():
+    from fabric_tpu.devtools.lint import KNOB_REGISTRY_REL
+
+    report = lint_sources(
+        _registry_project(),
+        readme_text=_load("fix_knob_readme_dirty.md"),
+    )
+    vs = [v for v in report.unsuppressed
+          if v.rule == "knob-conformance"]
+    assert [v.path for v in vs] == [KNOB_REGISTRY_REL]
+    assert "drifted" in vs[0].message
+
+
+def test_knob_readme_generated_table_quiet():
+    from fabric_tpu.devtools import knob_registry
+    from fabric_tpu.devtools.lint import (
+        KNOB_TABLE_BEGIN, KNOB_TABLE_END,
+    )
+
+    clean = (
+        "# fixture README\n\n" + KNOB_TABLE_BEGIN + "\n"
+        + knob_registry.render_table() + KNOB_TABLE_END + "\n"
+    )
+    report = lint_sources(_registry_project(), readme_text=clean)
+    assert [v for v in report.unsuppressed
+            if v.rule == "knob-conformance"] == []
+
+
+def test_knob_readme_missing_marker_block_fires():
+    report = lint_sources(
+        _registry_project(), readme_text="# no markers here\n"
+    )
+    msgs = [v.message for v in report.unsuppressed
+            if v.rule == "knob-conformance"]
+    assert len(msgs) == 1 and "no knob-table marker block" in msgs[0]
+
+
+def test_knob_dead_registry_entry_fires_at_the_entry():
+    """Dropping one knob's generated reader orphans its registry entry;
+    the violation anchors at the entry's line in knob_registry.py."""
+    from fabric_tpu.devtools import knob_registry
+    from fabric_tpu.devtools.lint import KNOB_REGISTRY_REL
+
+    victim = sorted(knob_registry.KNOBS)[0]
+    srcs = _registry_project()
+    srcs["fabric_tpu/peer/fix_knob_reads.py"] = srcs[
+        "fabric_tpu/peer/fix_knob_reads.py"
+    ].replace(f'    knob_registry.raw("{victim}")\n', "")
+    report = lint_sources(srcs)
+    vs = [v for v in report.unsuppressed
+          if v.rule == "knob-conformance"]
+    assert len(vs) == 1 and vs[0].path == KNOB_REGISTRY_REL
+    assert victim in vs[0].message and "dead" in vs[0].message
+    reg_lines = srcs[KNOB_REGISTRY_REL].splitlines()
+    assert f'"{victim}"' in reg_lines[vs[0].line - 1]
+
+
+# -- v6 metrics-conformance: consumer without a producer ---------------------
+
+
+def test_metric_orphan_consumer_fires_at_the_consumer():
+    src = _load("fix_metric_consumer_dirty.py")
+    vs = lint_source(
+        src, "fabric_tpu/devtools/fix_metric_consumer_dirty.py"
+    )
+    lines = _fires(vs, "metrics-conformance")
+    assert len(lines) == 1
+    assert "<- orphan consumer" in src.splitlines()[lines[0] - 1]
+    msgs = [v.message for v in vs if v.rule == "metrics-conformance"]
+    assert any("fix_missing_total" in m and "no producer" in m
+               for m in msgs)
+
+
+def test_metric_consumer_clean_twin_quiet():
+    src = _load("fix_metric_consumer_clean.py")
+    vs = lint_source(
+        src, "fabric_tpu/devtools/fix_metric_consumer_clean.py"
+    )
+    assert vs == []
+
+
+def test_metric_unregistered_opts_fires():
+    """An Opts construction that never reaches a provider new_* call is
+    a configured-but-never-constructed series."""
+    src = _load("fix_metric_consumer_clean.py").replace(
+        "provider.new_counter(\n        CounterOpts",
+        "(\n        CounterOpts",
+    )
+    vs = lint_source(
+        src, "fabric_tpu/devtools/fix_metric_consumer_clean.py"
+    )
+    msgs = [v.message for v in vs
+            if v.rule == "metrics-conformance" and not v.suppressed]
+    assert any("never reaches" in m for m in msgs)

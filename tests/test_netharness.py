@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import signal
 import subprocess
 import sys
@@ -77,12 +78,16 @@ def test_smoke_kill9_peer_catches_up(tmp_path):
     # pass/fail data, the byte-determinism contract the soak pins
     verdict = nh.verdict_doc(result)
     assert set(verdict) == {
-        "experiment", "seed", "topology", "kill_schedule", "txs", "ok",
-        "state_digests_agree", "stalled_nodes", "violations", "missing",
-        "caught_up",
+        "experiment", "rpcmap_sha256", "seed", "topology",
+        "kill_schedule", "txs", "ok", "state_digests_agree",
+        "stalled_nodes", "violations", "missing", "caught_up",
     }
     assert verdict["caught_up"] == ["org1-peer1"]
     assert verdict["stalled_nodes"] == []
+    # the verdict pins the static RPC surface it certified (v6): the
+    # embedded hash is the sha256 of the canonical --rpcmap artifact
+    assert verdict["rpcmap_sha256"] == nh.rpcmap_hash()
+    assert re.fullmatch(r"[0-9a-f]{64}", verdict["rpcmap_sha256"])
 
 
 def test_kill_schedule_generation_deterministic():
@@ -429,6 +434,58 @@ def test_traces_since_cursor():
 
 
 # ---------------------------------------------------------------------------
+# tier-1: runtime ⊆ static (v6 rpc-conformance cross-check)
+# ---------------------------------------------------------------------------
+
+
+def test_runtime_rpc_methods_subset_of_static_rpcmap(tmp_path):
+    """v6 runtime ⊆ static contract, RPC plane: every method a live
+    traced session actually exercised — client-side ``rpc.call``/
+    ``rpc.stream``/``rpc.duplex`` spans in the harness process, plus
+    ``rpc.serve`` spans pulled from every node's flight recorder —
+    must appear in the static ``--rpcmap`` artifact.  An observed
+    method missing from the map means the rpc-conformance scan lost a
+    call or register site, which this pins with a real network run
+    rather than a fixture."""
+    from fabric_tpu.common import tracing
+    from fabric_tpu.devtools.lint import lint_tree
+
+    topo = nh.Topology(
+        orgs=1, peers_per_org=1, orderers=1, seed=3, trace=4096,
+    )
+    rpc_span_names = {"rpc.call", "rpc.stream", "rpc.duplex", "rpc.serve"}
+    observed: set[str] = set()
+
+    def harvest(doc):
+        for ev in doc.get("traceEvents", []):
+            if ev.get("name") in rpc_span_names:
+                m = ev.get("args", {}).get("method")
+                if m:
+                    observed.add(m)
+
+    with tracing.scope(4096) as rec:
+        with nh.Network(str(tmp_path / "net"), topo) as net:
+            net.start()
+            result = nh.run_stream(net, txs=10, settle_timeout_s=120)
+            for name in topo.peer_names() + topo.orderer_names():
+                harvest(net.trace_dump(name))
+        harvest(tracing.export(rec))
+    assert result["ok"], result
+
+    # the ⊆ must not be vacuous: the session exercised both the
+    # consensus path (broadcast) and the harness control plane
+    assert "ab.Broadcast" in observed, sorted(observed)
+    assert "net.TraceDump" in observed, sorted(observed)
+    assert any(m.startswith("net.") for m in observed)
+
+    static = set(lint_tree().rpcmap()["methods"])
+    assert observed <= static, (
+        "runtime RPC methods missing from static rpcmap: "
+        f"{sorted(observed - static)}"
+    )
+
+
+# ---------------------------------------------------------------------------
 # slow soak: 3 orgs × 2 peers × 3 orderers, seeded schedule, verdict
 # byte-determinism
 # ---------------------------------------------------------------------------
@@ -466,6 +523,7 @@ def test_soak_multiorg_seeded_schedule(tmp_path):
     ).encode()
     expected = {
         "experiment": "netharness",
+        "rpcmap_sha256": nh.rpcmap_hash(),
         "seed": 11,
         "topology": topo.as_dict(),
         "kill_schedule": [r.as_dict() for r in schedule],

@@ -358,6 +358,52 @@ def test_wedged_peer_flagged_in_verdict_survivors_green(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# tier-1: runtime ⊆ static (v6 metrics-conformance cross-check)
+# ---------------------------------------------------------------------------
+
+
+def test_runtime_scrape_series_subset_of_static_metricmap(tmp_path):
+    """v6 runtime ⊆ static contract, metrics plane: every series name
+    a live per-node ``/metrics`` exposition actually serves must be in
+    the static ``--metricmap`` artifact's ``exposed`` set (which
+    already expands histograms to their ``_bucket``/``_sum``/``_count``
+    series).  A scraped series missing from the map means the
+    metrics-conformance scan lost a producer — pinned here against a
+    real network, not a fixture."""
+    import urllib.request
+
+    from fabric_tpu.devtools.lint import lint_tree
+    from fabric_tpu.devtools.netscope import parse_prometheus
+
+    topo = nh.Topology(
+        orgs=1, peers_per_org=1, orderers=1, seed=13, ops=True,
+    )
+    observed: set[str] = set()
+    with nh.Network(str(tmp_path / "net"), topo) as net:
+        net.start()
+        result = nh.run_stream(net, txs=10, settle_timeout_s=120)
+        for host, port in net.ops_addrs().values():
+            with urllib.request.urlopen(
+                f"http://{host}:{port}/metrics", timeout=10
+            ) as resp:
+                text = resp.read().decode("utf-8")
+            observed.update(
+                name for name, _labels, _v in parse_prometheus(text)
+            )
+    assert result["ok"], result
+
+    # non-vacuous: the scrape saw the consensus plane and a histogram
+    assert "ledger_blocks_committed_total" in observed, sorted(observed)
+    assert any(n.endswith("_bucket") for n in observed), sorted(observed)
+
+    exposed = set(lint_tree().metricmap()["exposed"])
+    assert observed <= exposed, (
+        "scraped series missing from static metricmap: "
+        f"{sorted(observed - exposed)}"
+    )
+
+
+# ---------------------------------------------------------------------------
 # netbench --metrics-out (slow: acceptance-shaped seeded campaign)
 # ---------------------------------------------------------------------------
 
