@@ -33,7 +33,7 @@ import ssl
 import struct
 import threading
 
-from fabric_tpu.devtools import clockskew, faultline
+from fabric_tpu.devtools import clockskew, faultline, netsplit
 from fabric_tpu.devtools.lockwatch import spawn_thread
 
 from fabric_tpu.common import tracing
@@ -185,9 +185,10 @@ class DuplexStream:
     (``finish()``); the handler answers by returning, which surfaces
     here as ``recv() -> None`` (END)."""
 
-    def __init__(self, sock, keepalive: "KeepaliveOptions"):
+    def __init__(self, sock, keepalive: "KeepaliveOptions", ns_token=None):
         self._sock = sock
         self._ka = keepalive
+        self._ns_token = ns_token  # netsplit cut-registry handle
         # recv() owns the socket timeout; sends rely on TCP buffering +
         # kernel keepalive (set_tcp_keepalive) to detect a dead peer
         sock.settimeout(
@@ -226,6 +227,9 @@ class DuplexStream:
             return rest
 
     def close(self) -> None:
+        if self._ns_token is not None:
+            netsplit.untrack(self._ns_token)
+            self._ns_token = None
         try:
             self._sock.close()
         except OSError:
@@ -253,6 +257,11 @@ class _Handler(socketserver.BaseRequestHandler):
         try:
             try:
                 faultline.point("rpc.accept")
+                # accept half of the netsplit seam: plain-TCP accepts
+                # only know the remote's ephemeral address, so denial
+                # here needs a plan that maps it; the outbound check in
+                # RPCClient._connect is the primary enforcement point
+                netsplit.accept(addr=sock.getpeername())
             except OSError:
                 return  # injected accept fault: drop cleanly — real
                 # handler errors must keep surfacing via handle_error
@@ -514,6 +523,10 @@ class RPCClient:
         )
 
     def _connect(self, method: str, body: bytes):
+        # the netsplit seam rules on the destination BEFORE any socket
+        # exists: a denied link raises NetsplitDenied (an OSError)
+        # immediately instead of stalling out the connect timeout
+        netsplit.connect(addr=self._addr)
         sock = socket.create_connection(self._addr, timeout=self._timeout)
         set_tcp_keepalive(sock, self._keepalive)
         if self._ssl_context is not None:
@@ -547,6 +560,7 @@ class RPCClient:
 
     def _call(self, method: str, body: bytes) -> bytes:
         sock = self._connect(method, body)
+        ns_tok = netsplit.track(sock, addr=self._addr)
         try:
             data = b""
             while True:
@@ -562,6 +576,7 @@ class RPCClient:
                     return data
                 data = rest
         finally:
+            netsplit.untrack(ns_tok)
             sock.close()
 
     def duplex(self, method: str, body: bytes = b"") -> DuplexStream:
@@ -571,7 +586,10 @@ class RPCClient:
         The caller owns the handle's lifecycle (``finish``/``close``)."""
         with tracing.span("rpc.duplex", method=method):
             sock = self._connect(method, body)
-        return DuplexStream(sock, self._keepalive)
+        return DuplexStream(
+            sock, self._keepalive,
+            ns_token=netsplit.track(sock, addr=self._addr),
+        )
 
     def stream(self, method: str, body: bytes = b""):
         """Server-streaming call: yields DATA bodies until END.
@@ -587,6 +605,9 @@ class RPCClient:
         with tracing.span("rpc.stream", method=method):
             sock = self._connect(method, body)
         ka = self._keepalive
+        # long-lived streams (deliver especially) register for the
+        # mid-stream cut: arming a severing plan closes this socket
+        ns_tok = netsplit.track(sock, addr=self._addr)
         try:
             sock.settimeout(
                 clockskew.io_timeout(ka.ping_interval + ka.ping_timeout)
@@ -609,6 +630,7 @@ class RPCClient:
                     return
                 yield rest
         finally:
+            netsplit.untrack(ns_tok)
             sock.close()
 
 

@@ -32,6 +32,10 @@ documents, PR 1/2/6 — now machine-checkable):
   snapshot's state records byte-for-byte.
 - **breaker**: TPUCSP circuit-breaker metrics sanity (state is a known
   value, counters non-negative and ordered).
+- **partition**: the split-brain contract over a netsplit episode
+  (``partition_violations``): the quorum side keeps committing, the
+  quorum-less side stalls WITHOUT forking (per-height digest
+  agreement), judged on evidence sampled just before the heal.
 """
 
 from __future__ import annotations
@@ -304,6 +308,92 @@ def state_digest(ledger) -> str:
     return sha256(b"".join(parts)).hex()
 
 
+def partition_violations(
+    mode: str,
+    split_tip: int,
+    pre_heal_heights: dict | None,
+    minority_digests: dict | None,
+    majority: list,
+    minority: list,
+    orderer_names: list,
+    peer_names: list,
+    slack: int = 1,
+    expect_progress: bool = True,
+    stall_tip: int | None = None,
+) -> list[Violation]:
+    """The split-brain judgment over one netsplit episode, evaluated
+    on evidence sampled just BEFORE the heal (netharness's partition
+    executor collects it; see ``run_stream``):
+
+    - ``partition.majority_stalled`` — under ``full``/``oneway`` the
+      side holding raft quorum must have committed PAST the tip
+      observed at the split (skipped when ``expect_progress`` is
+      False: a partition fired after the stream quiesced has no
+      traffic to prove progress with).
+    - ``partition.minority_progressed`` — under ``full`` a minority
+      peer committing more than ``slack`` blocks past ``stall_tip``
+      (the minority's height sampled right AFTER the cut landed;
+      falls back to ``split_tip``) means the quorum-less side kept
+      ordering.  Blocks replicated in the fire→cut window plus one
+      fully in-flight block are legitimate, hence the post-cut
+      baseline and the one-block slack.  ``oneway``/``flaky`` leave
+      paths open by design, so no stall contract there.
+    - ``partition.minority_forked`` — the NO-FORK invariant, every
+      mode: minority peers sampled at the SAME height must agree on
+      their state digest.  Comparing per-height keeps a one-block
+      delivery skew from masquerading as a fork.
+    - ``partition.sample`` — the evidence itself is missing (the
+      pre-heal probe failed); the episode cannot be judged green.
+    """
+    out: list[Violation] = []
+    if pre_heal_heights is None:
+        return [Violation(
+            "partition.sample", "no pre-heal height sample recorded"
+        )]
+    orderer_set = set(orderer_names)
+    peer_set = set(peer_names)
+    if mode in ("full", "oneway") and expect_progress:
+        maj_ord = [n for n in majority if n in orderer_set]
+        maj_tip = max(
+            (pre_heal_heights.get(n, 0) for n in maj_ord), default=0
+        )
+        if maj_tip <= split_tip:
+            out.append(Violation(
+                "partition.majority_stalled",
+                f"majority tip {maj_tip} never passed the split tip "
+                f"{split_tip} (quorum side must keep committing)",
+            ))
+    if mode == "full":
+        base = split_tip if stall_tip is None else stall_tip
+        for n in sorted(minority):
+            if n not in peer_set:
+                continue
+            h = pre_heal_heights.get(n)
+            if h is not None and h > base + slack:
+                out.append(Violation(
+                    "partition.minority_progressed",
+                    f"{n} reached height {h} > stall tip {base} "
+                    f"+ slack {slack} on the quorum-less side",
+                ))
+    by_height: dict[int, dict] = {}
+    for name, rec in sorted((minority_digests or {}).items()):
+        h, digest = rec[0], rec[1]
+        if h is None:
+            out.append(Violation(
+                "partition.sample", f"{name}: {digest}"
+            ))
+            continue
+        by_height.setdefault(int(h), {})[name] = digest
+    for h, members in sorted(by_height.items()):
+        if len(set(members.values())) > 1:
+            out.append(Violation(
+                "partition.minority_forked",
+                f"minority peers at height {h} disagree on state "
+                f"digest: {sorted(members)}",
+            ))
+    return out
+
+
 # -- TPU breaker sanity -------------------------------------------------------
 
 
@@ -348,5 +438,6 @@ __all__ = [
     "check_import_state",
     "check_breaker",
     "check_ledger",
+    "partition_violations",
     "state_digest",
 ]

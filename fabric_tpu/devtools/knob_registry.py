@@ -60,6 +60,9 @@ KNOBS: dict[str, Knob] = {
            "consecutive device failures that trip the TPU breaker"),
         _k("FABRIC_TPU_COLLECT_POOL", "width", "auto", "peer.validation",
            "collect fan-out width in chunks per block (0 = serial)"),
+        _k("FABRIC_TPU_DIAL_TIMEOUT_S", "int", "2", "gossip.comm",
+           "gossip sender dial timeout in seconds (fractions "
+           "accepted)"),
         _k("FABRIC_TPU_FAULTLINE", "plan", "", "devtools.faultline",
            "arm a fault plan: inline JSON or `@/path/plan.json`"),
         _k("FABRIC_TPU_LOCKWATCH", "flag", "", "devtools.lockwatch",
@@ -67,6 +70,9 @@ KNOBS: dict[str, Knob] = {
            "raising)"),
         _k("FABRIC_TPU_MVCC_POOL", "width", "auto", "ledger.txmgmt",
            "MVCC prepare/preload fan-out width (0 = serial)"),
+        _k("FABRIC_TPU_NETSPLIT", "plan", "", "devtools.netsplit",
+           "arm a network-partition plan: inline JSON or "
+           "`@/path/plan.json`"),
         _k("FABRIC_TPU_PROFILE", "flag", "", "common.profile",
            "arm profscope: `1` = 100 Hz sampler, a number > 1 = "
            "sampling rate in Hz"),

@@ -110,6 +110,11 @@ class Topology:
     #                                 on the ops System, so pair with
     #                                 ops=True to fetch artifacts)
     faultline: dict | None = None   # node name -> faultline plan dict
+    netsplit: dict | None = None    # node name -> netsplit plan dict,
+    #                                 armed from process start via the
+    #                                 child env (partition SCHEDULES
+    #                                 push plans mid-run over
+    #                                 net.Netsplit instead)
 
     def peer_names(self) -> list[str]:
         return [
@@ -159,6 +164,74 @@ class KillRule:
             sig=d.get("sig", "kill9"), rejoin=d.get("rejoin", "restart"),
             restart_after_s=float(d.get("restart_after_s", 0.5)),
         )
+
+
+@dataclasses.dataclass
+class PartitionRule:
+    """One partition-schedule entry: when the ORDERER cluster's tip
+    first reaches ``at_height``, arm a netsplit plan partitioning the
+    topology into ``groups`` (every node must appear in exactly one)
+    under ``mode`` (``full`` / ``oneway`` / ``flaky``, see
+    :mod:`devtools.netsplit`); heal after ``heal_after_s`` seconds of
+    wall time, or when the tip reaches ``heal_at_height`` — whichever
+    is configured (``heal_after_s`` wins when both are)."""
+
+    groups: list
+    at_height: int
+    mode: str = "full"
+    heal_after_s: float = 0.0
+    heal_at_height: int = 0
+    p: float = 0.5  # flaky per-link drop probability
+
+    def as_dict(self) -> dict:
+        return {
+            "groups": [list(g) for g in self.groups],
+            "at_height": self.at_height, "mode": self.mode,
+            "heal_after_s": self.heal_after_s,
+            "heal_at_height": self.heal_at_height, "p": self.p,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "PartitionRule":
+        return cls(
+            groups=[list(g) for g in d["groups"]],
+            at_height=int(d["at_height"]),
+            mode=d.get("mode", "full"),
+            heal_after_s=float(d.get("heal_after_s", 0.0)),
+            heal_at_height=int(d.get("heal_at_height", 0)),
+            p=float(d.get("p", 0.5)),
+        )
+
+
+def generate_partition_schedule(seed: int, topo: Topology,
+                                max_height: int) -> list[PartitionRule]:
+    """Seeded, deterministic majority/minority split: the minority
+    side gets a quorum-breaking MINORITY of the orderers (when the
+    cluster has 3+) plus the last org's peers; everyone else stays on
+    the majority side.  The split lands in the middle half of the
+    stream and heals on a timer, so the judge sees committed traffic
+    on both sides of both transitions."""
+    rng = random.Random(f"netsplit:{seed}")
+    orderers = topo.orderer_names()
+    peers = topo.peer_names()
+    n_min_ord = (len(orderers) - 1) // 2 if len(orderers) >= 3 else 0
+    minority = orderers[len(orderers) - n_min_ord:]
+    last_org = f"org{topo.orgs}-"
+    min_peers = [p for p in peers if p.startswith(last_org)]
+    if not min_peers:  # single-org safety: take the last peer
+        min_peers = peers[-1:]
+    minority += min_peers
+    majority = [n for n in orderers + peers if n not in minority]
+    lo = max(2, max_height // 4)
+    hi = max(lo + 1, (3 * max_height) // 4)
+    mode = rng.choice(["full", "full", "oneway", "flaky"])
+    return [PartitionRule(
+        groups=[majority, minority],
+        at_height=rng.randint(lo, hi),
+        mode=mode,
+        heal_after_s=round(rng.uniform(1.5, 3.0), 2),
+        p=0.7,
+    )]
 
 
 def generate_kill_schedule(seed: int, topo: Topology, max_height: int,
@@ -281,6 +354,15 @@ class Network:
                 with open(plan_path, "w", encoding="utf-8") as f:
                     json.dump(plan, f)
                 cfg["env"]["FABRIC_TPU_FAULTLINE"] = "@" + plan_path
+            ns_plan = (topo.netsplit or {}).get(name)
+            if ns_plan is not None:
+                ns_path = os.path.join(
+                    self.workdir, name, "netsplit.json"
+                )
+                os.makedirs(os.path.dirname(ns_path), exist_ok=True)
+                with open(ns_path, "w", encoding="utf-8") as f:
+                    json.dump(ns_plan, f)
+                cfg["env"]["FABRIC_TPU_NETSPLIT"] = "@" + ns_path
             node_dir = os.path.join(self.workdir, name)
             os.makedirs(node_dir, exist_ok=True)
             cfg_path = os.path.join(node_dir, "config.json")
@@ -303,6 +385,7 @@ class Network:
         # the child arms its own seams from its config's env block; a
         # parent-session plan must not leak into every node
         env.pop("FABRIC_TPU_FAULTLINE", None)
+        env.pop("FABRIC_TPU_NETSPLIT", None)
         env.pop("FABRIC_TPU_SOAK", None)
         env.pop("FABRIC_TPU_PROFILE", None)
         ready = node.cfg.get("ready_file")
@@ -430,6 +513,36 @@ class Network:
             ).decode("utf-8")
         )
 
+    def addr_map(self) -> dict[str, str]:
+        """Listener address -> node name, over every data-plane port
+        the harness allocated (node RPC, gossip, raft).  This is the
+        ``addrs`` map a netsplit plan needs to resolve peer addresses
+        into partition-group members; the ops port is deliberately
+        absent so netscope scraping rides through any partition."""
+        addrs: dict[str, str] = {}
+        for name, node in sorted(self.nodes.items()):
+            addrs[f"127.0.0.1:{node.cfg['rpc_port']}"] = name
+            for key in ("gossip_port", "raft_port"):
+                port = node.cfg.get(key)
+                if port is not None:
+                    addrs[f"127.0.0.1:{port}"] = name
+        return addrs
+
+    def netsplit(self, name: str, plan: dict | None) -> dict:
+        """Arm (plan dict) or heal (None) the netsplit seam on one
+        node over the ``net.Netsplit`` control RPC.  The harness
+        itself runs with no plan armed and the node-side accept check
+        cannot resolve the harness's ephemeral source port, so this
+        control path stays open during any partition."""
+        body = b"" if plan is None else json.dumps(
+            plan, sort_keys=True
+        ).encode()
+        return json.loads(
+            self._client(name, timeout=10.0).call(
+                "net.Netsplit", body
+            ).decode("utf-8")
+        )
+
     def trace_dump(self, name: str) -> dict:
         return json.loads(
             self._client(name, timeout=30.0).call(
@@ -542,6 +655,7 @@ def run_stream(
     sample_keys: int = 32,
     scope=None,
     driver: str = "serial",
+    partition_schedule: list[PartitionRule] | None = None,
 ) -> dict:
     """Drive ``txs`` endorser envelopes through broadcast -> raft
     ordering -> gossip dissemination -> commit on every peer, executing
@@ -550,9 +664,19 @@ def run_stream(
     (see ``scripts/netbench.py`` for the JSON line shape).
 
     ``scope`` (a running ``devtools.netscope.Netscope``) receives
-    kill/restart markers from the schedule executor, and its stall
-    detector's currently-flagged nodes land in the result/verdict as
-    ``stalled_nodes``.
+    kill/restart and partition/heal markers from the schedule
+    executors, and its stall detector's currently-flagged nodes land
+    in the result/verdict as ``stalled_nodes``.
+
+    ``partition_schedule`` (a list of :class:`PartitionRule`) arms a
+    netsplit plan on every live node over ``net.Netsplit`` when the
+    orderer tip reaches each rule's ``at_height``, samples per-side
+    heights and minority state digests immediately BEFORE healing (the
+    partition-aware judge: the majority side must keep committing, the
+    minority must stall WITHOUT forking), heals on the rule's timer or
+    height trigger, and then rides the normal convergence/oracle path
+    so post-heal catch-up and cross-network digest agreement are
+    judged by the same machinery as a kill9 run.
 
     ``driver`` selects the submission front-end: ``"serial"`` is the
     original one-unary-RPC-per-tx loop; ``"gateway"`` embeds a
@@ -581,6 +705,18 @@ def run_stream(
     down: dict[str, dict] = {}      # name -> {rule, t_kill, t_restart}
     catch_up: dict[str, float] = {}
     restarts: list[threading.Timer] = []
+    pschedule = sorted(
+        partition_schedule or [], key=lambda r: (r.at_height, r.mode)
+    )
+    pending_parts = list(pschedule)
+    active_parts: list[tuple[PartitionRule, dict]] = []
+    current_plan: list = [None]     # plan pushed to restarted nodes
+    heal_timers: list[threading.Timer] = []
+    partition_checks: list[dict] = []
+    heal_watch: set[str] = set()    # minority nodes not yet caught up
+    last_heal = [0.0]
+    heal_catch_up: dict[str, float] = {}
+    addr_map = net.addr_map() if pschedule else {}
     samples: list[tuple[float, dict[str, int]]] = []
     errors: list[str] = []
     lock = threading.Lock()
@@ -685,6 +821,14 @@ def run_stream(
             net.restart(rule.node, join_snapshot=join_dir)
             with lock:
                 down[rule.node]["t_restart"] = time.monotonic()
+                plan_now = current_plan[0]
+            if plan_now is not None:
+                # a node restarted INTO an active partition rejoins its
+                # side of the split, not the whole network
+                try:
+                    net.netsplit(rule.node, plan_now)
+                except Exception as exc:
+                    errors.append(f"netsplit re-arm {rule.node}: {exc!r}")
             if scope is not None:
                 scope.mark("restart", rule.node, rejoin=rule.rejoin)
         except Exception as exc:
@@ -722,6 +866,116 @@ def run_stream(
             t.start()
             restarts.append(t)
 
+    # -- partition executor ------------------------------------------------
+    def _push_plan(plan: dict | None) -> None:
+        for name in list(net.nodes):
+            if not net.nodes[name].alive():
+                continue
+            try:
+                net.netsplit(name, plan)
+            except Exception as exc:
+                errors.append(f"netsplit push to {name}: {exc!r}")
+
+    def _partition_sides(rule: PartitionRule) -> tuple[list, list]:
+        """majority = the group holding the most orderers (raft quorum
+        lives there; first-listed wins a tie), minority = the rest."""
+        orderer_set = set(topo.orderer_names())
+        best = max(
+            range(len(rule.groups)),
+            key=lambda i: (
+                len([n for n in rule.groups[i] if n in orderer_set]), -i,
+            ),
+        )
+        majority = list(rule.groups[best])
+        minority = [
+            n for g in rule.groups for n in g if n not in set(majority)
+        ]
+        return majority, minority
+
+    def fire_partition(rule: PartitionRule) -> None:
+        pending_parts.remove(rule)
+        plan = {
+            "seed": topo.seed,
+            "label": f"netsplit:{topo.seed}:{len(partition_checks)}",
+            "mode": rule.mode,
+            "groups": [list(g) for g in rule.groups],
+            "p": rule.p,
+            "addrs": addr_map or net.addr_map(),
+        }
+        hs = poll_heights()
+        tip = max((h for n, h in hs.items() if n not in peers), default=0)
+        _push_plan(plan)
+        majority, minority = _partition_sides(rule)
+        # the minority's stall baseline is sampled AFTER the plan push
+        # lands: blocks replicated between the pre-push tip sample and
+        # the cut are legitimately on the minority side already
+        hs2 = poll_heights()
+        stall_tip = max(
+            (h for n, h in hs2.items() if n in set(minority)),
+            default=tip,
+        )
+        entry = {
+            "rule": rule.as_dict(),
+            "majority": sorted(majority),
+            "minority": sorted(minority),
+            "split_tip": tip,
+            "stall_tip": max(stall_tip, tip),
+            "pre_heal": None,
+            # a partition fired after the stream quiesced has no
+            # traffic to prove majority progress with — the judge
+            # skips that expectation (fork/stall checks still apply)
+            "quiesced": not bcast.is_alive(),
+        }
+        with lock:
+            current_plan[0] = plan
+            active_parts.append((rule, entry))
+            partition_checks.append(entry)
+        if scope is not None:
+            for n in sorted(minority):
+                scope.mark("partition", n, mode=rule.mode)
+        if rule.heal_after_s > 0:
+            t = threading.Timer(
+                rule.heal_after_s, do_heal, args=(rule, entry)
+            )
+            t.start()
+            heal_timers.append(t)
+
+    def do_heal(rule: PartitionRule, entry: dict) -> None:
+        with lock:
+            try:
+                active_parts.remove((rule, entry))
+            except ValueError:
+                return  # a racing trigger already healed this rule
+        # the judge's split-side evidence is sampled at the last
+        # instant the partition is still armed: per-node heights plus
+        # each minority peer's state digest (fork detection)
+        try:
+            hs = poll_heights()
+            digests: dict[str, list] = {}
+            for name in entry["minority"]:
+                if name not in peers or not net.nodes[name].alive():
+                    continue
+                try:
+                    c = net.check(name)
+                    digests[name] = [c.get("height"),
+                                     c.get("state_digest")]
+                except Exception as exc:
+                    digests[name] = [None, f"error:{exc!r}"]
+            entry["pre_heal"] = {
+                "heights": dict(sorted(hs.items())),
+                "minority_digests": digests,
+            }
+        except Exception as exc:
+            errors.append(f"pre-heal sample: {exc!r}")
+        _push_plan(None)
+        with lock:
+            current_plan[0] = None
+            last_heal[0] = time.monotonic()
+            heal_watch.update(entry["minority"])
+        if scope is not None:
+            for n in entry["minority"]:
+                scope.mark("heal", n)
+
     final_height: int | None = None
     stable_since = 0.0
     rebroadcasts = 0
@@ -735,6 +989,34 @@ def run_stream(
             h = heights.get(rule.node)
             if h is not None and h >= rule.at_height:
                 fire_kill(rule)
+        # fire due partitions (one active split at a time) and
+        # height-triggered heals, both keyed on the ORDERER tip
+        tip_now = max(
+            (h for n, h in heights.items() if n not in peers), default=0
+        )
+        for prule in list(pending_parts):
+            if tip_now >= prule.at_height and not active_parts:
+                fire_partition(prule)
+        for prule, pentry in list(active_parts):
+            if (
+                prule.heal_after_s <= 0
+                and prule.heal_at_height
+                and tip_now >= prule.heal_at_height
+            ):
+                do_heal(prule, pentry)
+        # heal catch-up: a minority node has rejoined the first poll
+        # its height matches the live maximum after the heal
+        with lock:
+            watch = sorted(heal_watch)
+        if watch and heights:
+            max_h = max(heights.values())
+            for name in watch:
+                if heights.get(name) == max_h:
+                    with lock:
+                        heal_watch.discard(name)
+                    heal_catch_up.setdefault(
+                        name, round(time.monotonic() - last_heal[0], 3)
+                    )
         # catch-up bookkeeping: a restarted node is caught up the first
         # poll its height matches the live maximum
         with lock:
@@ -764,6 +1046,8 @@ def run_stream(
         if (
             not bcast.is_alive()
             and all(not t.is_alive() for t in restarts)
+            and not active_parts
+            and all(not t.is_alive() for t in heal_timers)
             and set(peers) <= set(heights)
             # gateway driver: convergence additionally means every
             # accepted tx has a resolved commit status (the tail keeps
@@ -816,6 +1100,18 @@ def run_stream(
                     # an unreachable trigger
                     fire_kill(pending_kills[0])
                     final_height = None
+                elif pending_parts:
+                    # same deadlock-avoidance for a partition whose
+                    # trigger height the quiesced chain never reached;
+                    # with the chain frozen a height-triggered heal
+                    # would never fire either, so force a timed heal
+                    prule = pending_parts[0]
+                    if prule.heal_after_s <= 0:
+                        prule.heal_after_s = max(
+                            3 * topo.batch_timeout_s, 1.0
+                        )
+                    fire_partition(prule)
+                    final_height = None
                 else:
                     break  # converged: every write on-chain, no kills
         elif settled:
@@ -831,6 +1127,20 @@ def run_stream(
     bcast.join(timeout=10)
     for t in restarts:
         t.cancel()
+    for t in heal_timers:
+        t.cancel()
+    with lock:
+        leftovers = list(active_parts)
+    for prule, pentry in leftovers:
+        # a partition still armed at the settle deadline is a failed
+        # run, but the oracle below must judge a CONNECTED network —
+        # heal forcibly and let the recorded error fail the verdict
+        errors.append(
+            f"partition mode={pentry['rule']['mode']} "
+            f"at_height={pentry['rule']['at_height']} still active at "
+            f"settle deadline"
+        )
+        do_heal(prule, pentry)
     gw_doc = None
     if gateway is not None:
         gw_doc = {
@@ -904,6 +1214,44 @@ def run_stream(
         n: checks[n].get("height") for n in peers
     }
     stalled_nodes = scope.stalled_nodes() if scope is not None else []
+
+    # -- partition-aware judge --------------------------------------------
+    from fabric_tpu.devtools import invariants
+
+    partition_results: list[dict] = []
+    for entry in partition_checks:
+        pre = entry.get("pre_heal") or {}
+        pv = invariants.partition_violations(
+            mode=entry["rule"]["mode"],
+            split_tip=entry["split_tip"],
+            stall_tip=entry.get("stall_tip"),
+            pre_heal_heights=pre.get("heights"),
+            minority_digests=pre.get("minority_digests"),
+            majority=entry["majority"],
+            minority=entry["minority"],
+            orderer_names=topo.orderer_names(),
+            peer_names=peers,
+            expect_progress=not entry["quiesced"],
+        )
+        partition_results.append({
+            "rule": entry["rule"],
+            "majority": entry["majority"],
+            "minority": entry["minority"],
+            "split_tip": entry["split_tip"],
+            "quiesced": entry["quiesced"],
+            "pre_heal": entry.get("pre_heal"),
+            "majority_progressed": not any(
+                v.check == "partition.majority_stalled" for v in pv
+            ),
+            "minority_stalled": not any(
+                v.check == "partition.minority_progressed" for v in pv
+            ),
+            "minority_forked": any(
+                v.check == "partition.minority_forked" for v in pv
+            ),
+            "violations": [v.as_dict() for v in pv],
+        })
+
     converged = (
         final_height is not None
         and len(set(heights_final.values())) == 1
@@ -916,6 +1264,8 @@ def run_stream(
         and all(not v for v in violations.values())
         and sent[0] == txs
         and not stalled_nodes
+        and all(not pc["violations"] for pc in partition_results)
+        and not heal_watch
     )
 
     elapsed = max(t_end - t0, 1e-6)
@@ -932,6 +1282,9 @@ def run_stream(
         "committed_tx_per_s": round(txs / elapsed, 2) if ok else 0.0,
         "elapsed_s": round(elapsed, 3),
         "rebroadcasts": rebroadcasts,
+        "partition_schedule": [r.as_dict() for r in pschedule],
+        "partition_checks": partition_results,
+        "heal_catch_up_s": dict(sorted(heal_catch_up.items())),
         "catch_up_s": dict(sorted(catch_up.items())),
         "max_cross_peer_lag_ms": lag_ms,
         "state_digests_agree": len(digests) == 1,
@@ -986,18 +1339,41 @@ def verdict_doc(result: dict) -> dict:
         "violations": result["violations"],
         "missing": result["missing"],
         "caught_up": sorted(result["catch_up_s"]),
+        "partition_schedule": result.get("partition_schedule", []),
+        # only the seed-derived and pass/fail partition fields —
+        # split_tip and the sampled heights are timing-dependent and
+        # stay out of the byte-deterministic verdict
+        "partition_checks": [
+            {
+                "rule": pc["rule"],
+                "majority": pc["majority"],
+                "minority": pc["minority"],
+                "majority_progressed": bool(pc["majority_progressed"]),
+                "minority_stalled": bool(pc["minority_stalled"]),
+                "minority_forked": bool(pc["minority_forked"]),
+                "violations": [
+                    v["check"] for v in pc["violations"]
+                ],
+            }
+            for pc in result.get("partition_checks", [])
+        ],
+        "healed_caught_up": sorted(result.get("heal_catch_up_s") or []),
     }
 
 
 def write_repro(result: dict, path: str) -> str:
     """A replayable repro artifact for a failing campaign: topology +
-    kill schedule + seed (scripts/chaos.py --kill9 --replay re-runs
-    it)."""
+    kill/partition schedules + seed (scripts/chaos.py --replay routes
+    it back to :func:`replay_repro` by ``kind``)."""
     doc = {
-        "kind": "netharness-kill9",
+        "kind": (
+            "netharness-netsplit" if result.get("partition_schedule")
+            else "netharness-kill9"
+        ),
         "seed": result["seed"],
         "topology": result["topology"],
         "kill_schedule": result["kill_schedule"],
+        "partition_schedule": result.get("partition_schedule", []),
         "txs": result["txs"],
         "verdict": verdict_doc(result),
     }
@@ -1034,7 +1410,8 @@ def attach_netscope(net: "Network", seed: int | None = None,
 
 def replay_repro(path: str, workdir: str,
                  metrics_out: str | None = None) -> dict:
-    """Re-run a kill9 repro artifact over a fresh workload directory.
+    """Re-run a kill9/netsplit repro artifact over a fresh workload
+    directory.
     With ``metrics_out``, the replay runs under a netscope collector
     and ships the same jsonl/html telemetry artifacts a live campaign
     writes — the flag's contract survives replay."""
@@ -1050,12 +1427,19 @@ def replay_repro(path: str, workdir: str,
         profile=metrics_out is not None,
     )
     schedule = [KillRule.from_dict(r) for r in doc["kill_schedule"]]
+    pschedule = [
+        PartitionRule.from_dict(r)
+        for r in doc.get("partition_schedule", [])
+    ]
     with Network(workdir, topo) as net:
         net.start()
         scope = (
             attach_netscope(net) if metrics_out is not None else None
         )
-        result = run_stream(net, doc["txs"], schedule, scope=scope)
+        result = run_stream(
+            net, doc["txs"], schedule, scope=scope,
+            partition_schedule=pschedule or None,
+        )
         if scope is not None:
             from fabric_tpu.devtools.netscope import write_artifacts
 
@@ -1104,8 +1488,9 @@ def merge_traces(net: Network, out_path: str | None = None) -> dict:
 
 
 __all__ = [
-    "Topology", "KillRule", "Network", "NetError",
-    "generate_kill_schedule", "run_stream", "verdict_doc",
+    "Topology", "KillRule", "PartitionRule", "Network", "NetError",
+    "generate_kill_schedule", "generate_partition_schedule",
+    "run_stream", "verdict_doc",
     "rpcmap_hash",
     "write_repro", "replay_repro", "merge_traces", "free_port",
     "attach_netscope",

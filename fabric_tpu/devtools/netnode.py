@@ -56,7 +56,12 @@ def main(argv: list[str]) -> int:
 
     # imports AFTER env plumbing (faultline/tracing arm from env)
     from fabric_tpu.common import tracing
-    from fabric_tpu.devtools import invariants, netident
+    from fabric_tpu.devtools import invariants, netident, netsplit
+
+    # this process's vantage point for the netsplit seam — a plan
+    # pushed later over net.Netsplit then judges links without having
+    # to carry a per-node "node" field itself
+    netsplit.set_local_node(cfg["name"])
 
     if cfg.get("trace"):
         tracing.arm(int(cfg["trace"]))
@@ -88,6 +93,26 @@ def _touch(path: str | None) -> None:
     if path:
         with open(path, "w", encoding="utf-8") as f:
             f.write("ready\n")
+
+
+def _netsplit_handler(body: bytes, stream) -> bytes:
+    """``net.Netsplit``: arm/replace/heal this node's partition plan.
+    Body: a netsplit plan JSON to arm; empty / ``null`` / ``{}`` heals
+    (deactivates).  Shared by both roles — the harness's partition
+    executor pushes per-node plan updates through this."""
+    from fabric_tpu.devtools import netsplit
+
+    raw = body.decode("utf-8").strip() if body else ""
+    if not raw or raw in ("null", "{}"):
+        netsplit.deactivate()
+        return json.dumps({"armed": False}, sort_keys=True).encode()
+    plan = netsplit.activate(raw)
+    return json.dumps({
+        "armed": True,
+        "label": plan.label,
+        "mode": plan.mode,
+        "groups": [list(g) for g in plan.groups],
+    }, sort_keys=True).encode()
 
 
 # -- orderer role -------------------------------------------------------------
@@ -207,6 +232,7 @@ class NetOrderer:
         self.rpc.register("ab.BroadcastStream", self._broadcast_stream)
         self.rpc.register("ab.Deliver", self._deliver)
         self.rpc.register("net.Status", self._status)
+        self.rpc.register("net.Netsplit", _netsplit_handler)
         self.rpc.register("net.TraceDump", self._trace_dump)
 
     def _publish_height(self) -> None:
@@ -423,6 +449,10 @@ class NetPeer:
         self.deliver_client = DeliverClient(
             self.channel,
             [connect_fn(ep) for ep in cfg["orderer_endpoints"]],
+            endpoint_addrs=[
+                f"{ep[0]}:{int(ep[1])}"
+                for ep in cfg["orderer_endpoints"]
+            ],
             height_fn=lambda: self.ledger.height,
             sink=self._receive_block,
             max_backoff_s=2.0,
@@ -479,6 +509,7 @@ class NetPeer:
             instrument(self.rpc, self.operations.metrics_provider)
         self.rpc.register("ab.Deliver", self._deliver)
         self.rpc.register("net.Status", self._status)
+        self.rpc.register("net.Netsplit", _netsplit_handler)
         self.rpc.register("net.Check", self._check)
         self.rpc.register("net.TraceDump", self._trace_dump)
         self.rpc.register("admin.Height", self._height)

@@ -428,8 +428,9 @@ class Netscope:
     # -- harness event markers ---------------------------------------------
 
     def mark(self, event: str, node: str, **extra) -> None:
-        """Record a harness-side marker (kill/restart, from the kill
-        schedule executor) on the collector's timeline."""
+        """Record a harness-side marker (kill/restart from the kill
+        schedule executor, partition/heal from the netsplit executor)
+        on the collector's timeline."""
         doc = {"t": self._now(), "event": event, "node": node}
         doc.update(extra)
         with self._lock:
@@ -513,13 +514,14 @@ class Netscope:
         return vs[idx]
 
     def _catch_up_seconds(self) -> dict[str, float]:
-        """Per restarted node: seconds from its restart marker to the
-        first scrape round its height matches the cluster maximum.
-        Walks the FULL height series rings (window points per node),
-        not the stall detector's short height window — that one only
-        retains ~stall_window rounds, so a run outlasting it would
-        report the earliest *retained* round and grossly inflate the
-        value."""
+        """Per rejoining node: seconds from its restart marker — or its
+        partition-heal marker, a heal being a rejoin over the SAME
+        catch-up machinery — to the first scrape round its height
+        matches the cluster maximum.  Walks the FULL height series
+        rings (window points per node), not the stall detector's short
+        height window — that one only retains ~stall_window rounds, so
+        a run outlasting it would report the earliest *retained* round
+        and grossly inflate the value."""
         heights = self._peer_heights()
         rounds: dict[float, dict[str, float]] = {}
         for node, pts in heights.items():
@@ -527,7 +529,8 @@ class Netscope:
                 rounds.setdefault(t, {})[node] = v
         with self._lock:
             restarts = [
-                e for e in self._events if e["event"] == "restart"
+                e for e in self._events
+                if e["event"] in ("restart", "heal")
             ]
         out: dict[str, float] = {}
         for ev in restarts:
@@ -744,7 +747,8 @@ class Netscope:
             f'<svg width="{w}" height="{h}" viewBox="0 0 {w} {h}">'
         ]
         colors = {"kill": "#c0392b", "restart": "#2980b9",
-                  "stall": "#e67e22", "stall_clear": "#27ae60"}
+                  "stall": "#e67e22", "stall_clear": "#27ae60",
+                  "partition": "#8e44ad", "heal": "#16a085"}
         for ev in events:
             x = round(xs(ev["t"]), 1)
             c = colors.get(ev["event"], "#888")

@@ -24,13 +24,32 @@ import socket
 import struct
 import threading
 
-from fabric_tpu.comm.backoff import DecorrelatedBackoff
+from fabric_tpu.comm.backoff import BackoffGate
 from fabric_tpu.common import tracing
-from fabric_tpu.devtools import clockskew, faultline
+from fabric_tpu.devtools import faultline, knob_registry, netsplit
 from fabric_tpu.devtools.lockwatch import named_lock, spawn_thread
 from fabric_tpu.protos.gossip import message_pb2 as gpb
 
 _LEN = struct.Struct(">I")
+
+_DIAL_TIMEOUT_ENV = "FABRIC_TPU_DIAL_TIMEOUT_S"
+
+
+def _dial_timeout() -> float:
+    """The sender dial timeout, knob-routed: one unreachable member
+    used to cost a hardcoded 2 s connect stall per dial."""
+    raw = knob_registry.raw(_DIAL_TIMEOUT_ENV)
+    if not raw:
+        return 2.0
+    try:
+        t = float(raw)
+    except ValueError:
+        raise ValueError(
+            f"{_DIAL_TIMEOUT_ENV} must be a number of seconds, got {raw!r}"
+        ) from None
+    if t <= 0:
+        raise ValueError(f"{_DIAL_TIMEOUT_ENV} must be > 0, got {raw!r}")
+    return t
 
 # Trace-context piggyback on the TCP transport: a traced sender
 # prefixes the frame's SignedGossipMessage bytes with the wire token,
@@ -313,13 +332,17 @@ class TCPGossipComm(GossipComm):
 
     def _sender(self, endpoint: str, q: queue.Queue) -> None:
         sock = None
+        ns_tok = None
         # deterministic decorrelated jitter, seeded from stable
         # local+peer identity: a down peer (including the dial-back
         # path — responses ride this same sender) is not re-dialed at
         # message rate, chaos runs replay the exact dial cadence, and
         # the local half keeps different peers' retry windows from
-        # aligning against one downed node
-        bo = DecorrelatedBackoff.for_key(f"{self.endpoint}->{endpoint}")
+        # aligning against one downed node.  The gate form (vs sleeping
+        # the jitter inline) keeps this loop non-blocking: a down or
+        # netsplit-denied member costs a dict lookup per message, not a
+        # dial-timeout stall with the queue backing up behind it.
+        gate = BackoffGate.for_key(f"{self.endpoint}->{endpoint}")
         while not self._stop.is_set():
             try:
                 data, trace_ctx = q.get(timeout=0.5)
@@ -327,10 +350,17 @@ class TCPGossipComm(GossipComm):
                 continue
             for _ in range(2):  # one reconnect attempt per message
                 if sock is None:
+                    if not gate.ready():
+                        break  # inside the backoff window: drop the
+                        # message (gossip is loss-tolerant) instead of
+                        # blocking the sender loop
                     try:
                         faultline.point("gossip.dial", endpoint=endpoint)
+                        netsplit.connect(addr=endpoint)
                         host, port = endpoint.rsplit(":", 1)
-                        sock = socket.create_connection((host, int(port)), timeout=2)
+                        sock = socket.create_connection(
+                            (host, int(port)), timeout=_dial_timeout()
+                        )
                         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                         if self._client_ctx is not None:
                             sock = self._client_ctx.wrap_socket(
@@ -338,13 +368,13 @@ class TCPGossipComm(GossipComm):
                             )
                         sock = faultline.io(sock, "gossip.conn")
                         sock.sendall(self._handshake_frame())
+                        ns_tok = netsplit.track(sock, addr=endpoint)
                     except OSError:
                         sock = None
-                        # gossip is loss-tolerant: wait out the backoff
-                        # window here (messages queue or drop meanwhile);
-                        # through the clockskew seam like every other
-                        # reconnect wait in the comm stack
-                        clockskew.wait(self._stop, bo.next())
+                        # denied/unreachable: ARM the member's backoff
+                        # window and move on — the wait happens by
+                        # gating future dials, never by sleeping here
+                        gate.arm()
                         break
                 try:
                     # the enqueuer's context also rides the frame itself
@@ -358,9 +388,12 @@ class TCPGossipComm(GossipComm):
                     # only a completed DATA send proves the link: an
                     # accept-then-reset peer must not restart the
                     # backoff sequence every flap
-                    bo.reset()
+                    gate.reset()
                     break
                 except OSError:
+                    if ns_tok is not None:
+                        netsplit.untrack(ns_tok)
+                        ns_tok = None
                     try:
                         sock.close()
                     except OSError:
@@ -368,7 +401,7 @@ class TCPGossipComm(GossipComm):
                     sock = None
                     # same window as a failed dial — without this, a
                     # connect-ok-send-fail peer is redialed per message
-                    clockskew.wait(self._stop, bo.next())
+                    gate.arm()
 
     # -- inbound -----------------------------------------------------------
 
@@ -409,6 +442,7 @@ class TCPGossipComm(GossipComm):
     def _serve(self, conn: socket.socket) -> None:
         buf = bytearray()
         conn.settimeout(60)
+        ns_tok = None
         peer_der: bytes | None = None
         if self._server_ctx is not None:
             try:
@@ -452,6 +486,13 @@ class TCPGossipComm(GossipComm):
                 # an identity (and an attack endpoint for dial-back
                 # replies); the permissive dev-default MCS accepts all
                 return
+            # the accept half of the netsplit seam: judged by the
+            # sender's signed listen endpoint (the only identity the
+            # dial-back transport has); a denied link drops here like
+            # any other handshake failure, and the stream is tracked so
+            # arming a plan mid-run cuts it
+            netsplit.accept(addr=ce.endpoint)
+            ns_tok = netsplit.track(conn, addr=ce.endpoint)
             self.learn_identity(ce.identity)
             sender_pki = ce.pki_id
             # responses dial back to the sender's SIGNED listen endpoint
@@ -480,6 +521,8 @@ class TCPGossipComm(GossipComm):
         except OSError:
             return
         finally:
+            if ns_tok is not None:
+                netsplit.untrack(ns_tok)
             try:
                 conn.close()
             except OSError:

@@ -34,7 +34,7 @@ import threading
 from fabric_tpu.comm.backoff import BackoffGate
 from fabric_tpu.common import tracing
 from fabric_tpu.common.flogging import must_get_logger
-from fabric_tpu.devtools import faultline
+from fabric_tpu.devtools import faultline, netsplit
 from fabric_tpu.devtools.lockwatch import spawn_thread
 
 from fabric_tpu.protos.orderer import raft_pb2 as rpb
@@ -107,6 +107,7 @@ class OutboundConn:
         )
         self.q: queue.Queue = queue.Queue(maxsize=queue_size)
         self._sock: socket.socket | None = None
+        self._ns_tok: int | None = None  # netsplit cut-registry handle
         self._stop = threading.Event()
         self.dropped = 0
         self._drop_episode = False   # contiguous queue-full drops
@@ -158,6 +159,10 @@ class OutboundConn:
             self._metrics.dials.With("dest", self._dest()).add()
         try:
             faultline.point("raft.connect", peer=self.peer_id)
+            # a netsplit-denied link fails HERE (NetsplitDenied is an
+            # OSError), before the connect timeout can stall the dial,
+            # and rides the same gate-arm drop path as a down peer
+            netsplit.connect(addr=self.addr)
             s = socket.create_connection(self.addr, timeout=2.0)
             s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             if self._ssl_ctx is not None:
@@ -169,7 +174,9 @@ class OutboundConn:
                 ):
                     s.close()
                     return None  # counterparty not in the consenter set
-            return faultline.io(s, "raft.conn")
+            s = faultline.io(s, "raft.conn")
+            self._ns_tok = netsplit.track(s, addr=self.addr)
+            return s
         except OSError:
             return None
 
@@ -217,6 +224,9 @@ class OutboundConn:
                 self._gate.reset()
                 self._down_episode = False
             except OSError:
+                if self._ns_tok is not None:
+                    netsplit.untrack(self._ns_tok)
+                    self._ns_tok = None
                 try:
                     self._sock.close()
                 except OSError:
@@ -232,6 +242,9 @@ class OutboundConn:
 
     def close(self) -> None:
         self._stop.set()
+        if self._ns_tok is not None:
+            netsplit.untrack(self._ns_tok)
+            self._ns_tok = None
         if self._sock is not None:
             try:
                 self._sock.close()
@@ -337,6 +350,17 @@ class TCPTransport:
     def _serve_conn(self, conn: socket.socket) -> None:
         buf = b""
         conn.settimeout(30.0)
+        try:
+            # accept half of the netsplit seam (plain-TCP accept only
+            # knows the remote's ephemeral address; outbound checks in
+            # OutboundConn._connect carry the enforcement)
+            netsplit.accept(addr=conn.getpeername())
+        except OSError:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            return
         if self._server_ctx is not None:
             try:
                 conn = self._server_ctx.wrap_socket(conn, server_side=True)

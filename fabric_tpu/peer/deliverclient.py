@@ -18,7 +18,7 @@ import random
 import threading
 
 from fabric_tpu.common import tracing
-from fabric_tpu.devtools import clockskew, faultline
+from fabric_tpu.devtools import clockskew, faultline, netsplit
 from fabric_tpu.devtools.lockwatch import spawn_thread
 
 from fabric_tpu.orderer.blockwriter import verify_block_signature
@@ -36,10 +36,16 @@ class DeliverClient:
         csp=None,
         max_backoff_s: float = 10.0,
         metrics=None,  # common.metrics.DeliverMetrics | None
+        endpoint_addrs=None,  # optional "host:port"/node-id labels
+        # parallel to `endpoints`, routing each rotation attempt
+        # through the netsplit seam before the opaque connect callable
     ):
         self.channel_id = channel_id
         self._metrics = metrics
         self._endpoints = list(endpoints)
+        self._endpoint_addrs = (
+            list(endpoint_addrs) if endpoint_addrs is not None else None
+        )
         self._height = height_fn
         self._sink = sink
         self._bundle = bundle
@@ -123,6 +129,11 @@ class DeliverClient:
             self.endpoint_log.append(pos)
             try:
                 faultline.point("deliver.connect", endpoint=pos)
+                if self._endpoint_addrs is not None:
+                    # denied endpoints rotate immediately (NetsplitDenied
+                    # is an OSError caught by the reconnect handler
+                    # below) — no stream setup, no connect stall
+                    netsplit.connect(addr=self._endpoint_addrs[pos])
                 for blk in connect(self._height()):
                     if stop.is_set():
                         return

@@ -8,7 +8,17 @@ lint scripts use, so the driver/CI can scrape `"experiment":
 
 Usage: python scripts/chaos.py [--plans N] [--seed S] [--blocks B]
        [--out DIR] [--no-shrink] [--no-comm] [--mutants K]
-       [--replay FILE] [--kill9]
+       [--replay FILE] [--kill9] [--netsplit]
+
+`--netsplit` is the PARTITION campaign mode: each plan stands up a
+2-org/3-orderer topology, splits it into a seeded majority/minority
+partition mid-stream (netsplit plans pushed per node over the
+net.Netsplit control RPC), heals it, and judges with the
+partition-aware oracle (majority keeps committing, minority stalls
+without forking, every node rejoins after heal).  It composes with
+`--kill9` (the seeded kill schedule runs INSIDE the same plans) and
+arms a seeded per-node faultline delay plan; failing plans write a
+`netharness-netsplit` repro JSON that `--replay` routes kind-aware.
 
 `--mutants K` derives K seeded single-edit mutants (trigger tweak,
 action swap within the point's pool, or dropped rule) from every
@@ -78,7 +88,18 @@ def main() -> int:
                          "through the multi-process harness)")
     ap.add_argument("--kill9", action="store_true",
                     help="multi-process campaign: per plan, a real "
-                         "topology with a seeded kill -9 schedule")
+                         "topology with a seeded kill -9 schedule "
+                         "(with --netsplit: composed INTO each "
+                         "partition plan instead of a separate "
+                         "campaign)")
+    ap.add_argument("--netsplit", action="store_true",
+                    help="multi-process partition campaign: per plan, "
+                         "a 2-org/3-orderer topology with a seeded "
+                         "majority/minority netsplit schedule (split "
+                         "at height, heal on a timer), judged by the "
+                         "partition-aware oracle; composes with "
+                         "--kill9 schedules and a seeded per-node "
+                         "faultline delay plan")
     ap.add_argument("--export-registry", nargs="?", default=None,
                     const="", metavar="PATH",
                     help="refresh the pinned chaos-coverage registry "
@@ -150,10 +171,11 @@ def main() -> int:
                 artifact_kind = json.load(f).get("kind", "")
             except ValueError:
                 artifact_kind = ""
-        if artifact_kind == "netharness-kill9":
+        if artifact_kind in ("netharness-kill9", "netharness-netsplit"):
             from fabric_tpu.devtools import netharness as nh
 
-            workdir = tempfile.mkdtemp(prefix="kill9-replay-")
+            flavor = artifact_kind.split("-", 1)[1]
+            workdir = tempfile.mkdtemp(prefix=f"{flavor}-replay-")
             result = None
             try:
                 result = nh.replay_repro(
@@ -165,7 +187,7 @@ def main() -> int:
                 if result is not None and result["ok"]:
                     shutil.rmtree(workdir, ignore_errors=True)
             out = {
-                "experiment": "kill9-replay",
+                "experiment": f"{flavor}-replay",
                 "artifact": args.replay,
                 "reproduced": not result["ok"],
                 "verdict": nh.verdict_doc(result),
@@ -208,6 +230,112 @@ def main() -> int:
             )
         print(json.dumps(out))
         return 0 if res["violations"] else 1
+
+    if args.netsplit:
+        import random as _random
+        import shutil
+        import tempfile
+
+        from fabric_tpu.devtools import netharness as nh
+
+        failures = 0
+        verdicts = []
+        repro_paths = []
+        netscope_paths = []
+        trace_paths = []
+        for i in range(args.plans):
+            seed = args.seed + i
+            topo = nh.Topology(
+                orgs=2, peers_per_org=2, orderers=3, seed=seed,
+                ops=args.metrics_out is not None,
+                profile=args.metrics_out is not None,
+                trace=args.metrics_out is not None,
+            )
+            expected = 1 + -(-args.txs // topo.max_message_count)
+            pschedule = nh.generate_partition_schedule(
+                seed, topo, expected
+            )
+            schedule = (
+                nh.generate_kill_schedule(seed, topo, expected, kills=1)
+                if args.kill9 else []
+            )
+            # composed per-node faultline plan: a seeded, benign
+            # gossip-dial delay on one victim peer, so every netsplit
+            # campaign also exercises partitions UNDER injected faults
+            fl_rng = _random.Random(f"chaos-netsplit-fl:{seed}")
+            victim = fl_rng.choice(topo.peer_names())
+            topo.faultline = {victim: {
+                "seed": seed,
+                "label": f"chaos-netsplit-fl:{seed}",
+                "faults": [{
+                    "point": "gossip.dial", "action": "delay",
+                    "delay_s": 0.02, "prob": 0.2, "count": 10,
+                }],
+            }}
+            workdir = tempfile.mkdtemp(prefix=f"netsplit-s{seed}-")
+            with nh.Network(workdir, topo) as net:
+                net.start()
+                scope = (
+                    nh.attach_netscope(net)
+                    if args.metrics_out is not None else None
+                )
+                result = nh.run_stream(
+                    net, args.txs, schedule, scope=scope,
+                    partition_schedule=pschedule,
+                )
+                profiles = None
+                if scope is not None:
+                    scope.stop()
+                    if not result["ok"]:
+                        profiles = scope.fetch_profiles(
+                            args.metrics_out,
+                            prefix=f"netscope_seed{seed}",
+                        )
+                        # the merged cross-process trace must also be
+                        # pulled while the failing plan's nodes still
+                        # answer net.TraceDump
+                        trace_path = os.path.join(
+                            args.metrics_out,
+                            f"netscope_seed{seed}.trace.json",
+                        )
+                        nh.merge_traces(net, trace_path)
+                        trace_paths.append(trace_path)
+            verdicts.append("ok" if result["ok"] else "FAIL")
+            if result["ok"]:
+                shutil.rmtree(workdir, ignore_errors=True)
+            else:
+                failures += 1
+                repro_paths.append(nh.write_repro(result, os.path.join(
+                    args.out, f"netsplit_seed{seed}.repro.json"
+                )))
+                if scope is not None:
+                    from fabric_tpu.devtools.netscope import (
+                        write_artifacts,
+                    )
+
+                    netscope_paths.append(write_artifacts(
+                        scope, args.metrics_out,
+                        prefix=f"netscope_seed{seed}",
+                        profiles=profiles,
+                    ))
+        out = {
+            "experiment": "chaos-netsplit",
+            "seed": args.seed,
+            "plans": args.plans,
+            "txs": args.txs,
+            "kill9": bool(args.kill9),
+            "failures": failures,
+            "verdicts": verdicts,
+            "repro": repro_paths,
+            "netscope": netscope_paths,
+            "trace": trace_paths,
+            "seconds": round(time.perf_counter() - t0, 4),
+        }
+        print(json.dumps(out, sort_keys=True))
+        for path in repro_paths:
+            print(f"netsplit: repro artifact written: {path}",
+                  file=sys.stderr)
+        return 1 if failures else 0
 
     if args.kill9:
         import shutil

